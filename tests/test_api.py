@@ -1,0 +1,181 @@
+"""Unit tests for the API layer: quantities, CR types, label parsing.
+
+The reference has no tests at all (SURVEY.md §4); this suite is designed
+from scratch, table-driven per the build plan.
+"""
+
+import pytest
+
+from yoda_tpu.api import (
+    GENERATION_RANK,
+    HEALTHY,
+    LabelParseError,
+    PodSpec,
+    QuantityError,
+    TpuNodeMetrics,
+    TpuRequest,
+    parse_quantity,
+)
+from yoda_tpu.api.requests import parse_request, parse_topology
+from yoda_tpu.api.types import make_node
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "text,expected",
+        [
+            ("1000", 1000 << 20),       # bare number = MiB (reference MB parity)
+            ("8000", 8000 << 20),
+            ("16Gi", 16 << 30),
+            ("512Mi", 512 << 20),
+            ("1Ki", 1 << 10),
+            ("2Ti", 2 << 40),
+            ("1G", 10**9),
+            ("1.5Gi", int(1.5 * (1 << 30))),
+            ("0", 0),
+        ],
+    )
+    def test_valid(self, text, expected):
+        assert parse_quantity(text) == expected
+
+    @pytest.mark.parametrize(
+        "text", ["8GB", "", "abc", "-5", "1Qi", "1 2", "16 Gi", "1_000"]
+    )
+    def test_malformed_raises(self, text):
+        # Unlike the reference's silent-zero (filter/filter.go:60-74).
+        with pytest.raises(QuantityError):
+            parse_quantity(text)
+
+
+class TestTpuNodeMetrics:
+    def test_sums_and_counts(self):
+        n = make_node("n1", chips=4, hbm_per_chip=16 << 30)
+        assert n.chip_count == 4
+        assert n.hbm_free_sum == 4 * (16 << 30)
+        assert n.hbm_total_sum == 4 * (16 << 30)
+        assert all(c.healthy for c in n.chips)
+
+    def test_unhealthy_chips_excluded(self):
+        n = make_node("n1", chips=4, unhealthy=[0, 2])
+        assert len(n.healthy_chips()) == 2
+        assert n.chips[0].health != HEALTHY
+
+    def test_cr_roundtrip(self):
+        n = make_node(
+            "host-3",
+            chips=8,
+            generation="v5p",
+            slice_id="slice-a",
+            topology_coords=(1, 0, 1),
+            now=123.0,
+        )
+        n.resource_version = 7
+        back = TpuNodeMetrics.from_obj(n.to_obj())
+        assert back.name == "host-3"
+        assert back.chip_count == 8
+        assert back.generation == "v5p"
+        assert back.topology_coords == (1, 0, 1)
+        assert back.slice_id == "slice-a"
+        assert back.last_updated_unix == 123.0
+        assert back.resource_version == 7
+        assert back.hbm_free_sum == n.hbm_free_sum
+
+    def test_freshness(self):
+        n = make_node("n1", now=100.0)
+        assert n.fresh(max_age_s=30, now=120.0)
+        assert not n.fresh(max_age_s=30, now=200.0)
+
+    def test_generation_rank_ordering(self):
+        assert GENERATION_RANK["v5p"] > GENERATION_RANK["v5e"] > GENERATION_RANK["v4"]
+
+
+class TestPodSpec:
+    def test_roundtrip(self):
+        p = PodSpec("train-0", labels={"tpu/chips": "4"})
+        back = PodSpec.from_obj(p.to_obj())
+        assert back.key == "default/train-0"
+        assert back.labels == {"tpu/chips": "4"}
+        assert back.uid == p.uid
+        assert back.creation_seq == p.creation_seq
+
+    def test_creation_seq_monotonic(self):
+        a, b = PodSpec("a"), PodSpec("b")
+        assert b.creation_seq > a.creation_seq
+
+
+class TestParseRequest:
+    def test_empty_labels(self):
+        r = parse_request({})
+        assert r.chips is None
+        assert r.effective_chips == 1  # reference default, filter/filter.go:14-15
+        assert not r.wants_tpu
+
+    def test_basic(self):
+        r = parse_request({"tpu/chips": "2", "tpu/hbm": "8000", "tpu/clock": "940"})
+        assert r.chips == 2
+        assert r.hbm_per_chip == 8000 << 20
+        assert r.min_clock_mhz == 940
+        assert r.wants_tpu
+
+    def test_generation(self):
+        r = parse_request({"tpu/generation": "v5p"})
+        assert r.min_generation_rank == GENERATION_RANK["v5p"]
+        with pytest.raises(LabelParseError):
+            parse_request({"tpu/generation": "v99"})
+
+    def test_priority_negative_ok(self):
+        assert parse_request({"tpu/priority": "-3"}).priority == -3
+        with pytest.raises(LabelParseError):
+            parse_request({"tpu/priority": "high"})
+        with pytest.raises(LabelParseError):
+            parse_request({"tpu/priority": "+5"})
+        with pytest.raises(LabelParseError):
+            parse_request({"tpu/chips": "1_0"})
+
+    @pytest.mark.parametrize(
+        "labels",
+        [
+            {"tpu/chips": "two"},
+            {"tpu/hbm": "8GB"},       # the reference's silent-zero case
+            {"tpu/clock": "-1"},
+            {"tpu/chips": "-2"},
+        ],
+    )
+    def test_malformed_raises(self, labels):
+        with pytest.raises(LabelParseError):
+            parse_request(labels)
+
+    def test_gang_by_size(self):
+        r = parse_request({"tpu/gang": "job-a", "tpu/gang-size": "4"})
+        assert r.gang.name == "job-a"
+        assert r.gang.size == 4
+        assert r.gang.topology is None
+
+    def test_gang_by_topology(self):
+        r = parse_request({"tpu/gang": "job-a", "tpu/topology": "2x2x2"})
+        assert r.gang.size == 8
+        assert r.gang.topology == (2, 2, 2)
+
+    def test_gang_size_topology_mismatch(self):
+        with pytest.raises(LabelParseError):
+            parse_request(
+                {"tpu/gang": "g", "tpu/gang-size": "3", "tpu/topology": "2x2"}
+            )
+
+    def test_gang_requires_name_and_size(self):
+        with pytest.raises(LabelParseError):
+            parse_request({"tpu/gang-size": "4"})
+        with pytest.raises(LabelParseError):
+            parse_request({"tpu/gang": "g"})
+
+    @pytest.mark.parametrize(
+        "text,expected",
+        [("2x2x2", (2, 2, 2)), ("4x4", (4, 4)), ("8", (8,)), ("2X2", (2, 2))],
+    )
+    def test_topology_parse(self, text, expected):
+        assert parse_topology(text) == expected
+
+    @pytest.mark.parametrize("text", ["", "0x2", "2x2x2x2", "axb"])
+    def test_topology_malformed(self, text):
+        with pytest.raises(LabelParseError):
+            parse_topology(text)

@@ -1,0 +1,25 @@
+"""Yoda-TPU: a TPU-native Kubernetes scheduler framework.
+
+A ground-up rebuild of the capabilities of Yoda-Scheduler
+(reference: /root/reference, an out-of-tree kube-scheduler plugin that
+places pods by GPU metrics from an external "SCV" CRD) — redesigned for
+TPU fleets:
+
+- The per-node GPU metrics CR (SCV: CardNumber / CardList / FreeMemorySum,
+  reference pkg/yoda/scheduler.go:70, filter/filter.go:13-58) is replaced by a
+  ``TpuNodeMetrics`` CR surfacing chip count, per-chip free HBM, chip
+  generation, and ICI topology coordinates, published by a node agent.
+- Pod constraints move from ``scv/number``/``scv/memory``/``scv/clock`` labels
+  (reference readme.md:27-69) to ``tpu/chips``, ``tpu/hbm``, ``tpu/topology``.
+- The scheduling hot path — which in the reference does one uncached API-server
+  round-trip per node per pod in both Filter and Score
+  (reference pkg/yoda/scheduler.go:70,108) — is redesigned as a cached
+  informer snapshot lowered to structure-of-arrays form and scored for ALL
+  nodes in a single fused, jitted XLA computation (``yoda_tpu.ops``), shardable
+  across a device mesh for very large fleets (``yoda_tpu.parallel``).
+- Net-new over the reference: chip/HBM Reserve-Unreserve accounting,
+  gang scheduling with a Permit waitlist, ICI-topology-aware slice placement,
+  and preemption.
+"""
+
+__version__ = "0.1.0"

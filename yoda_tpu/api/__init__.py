@@ -1,0 +1,29 @@
+"""API types: the TpuNodeMetrics CR schema, pod model, and pod-label requests.
+
+This package is the replacement for the reference's external SCV CRD
+(``github.com/NJUPT-ISL/SCV/api/v1``, reference go.mod:6) whose schema is
+inferred from field usage in reference pkg/yoda/filter/filter.go:13-58 and
+pkg/yoda/collection/collection.go:59-78.
+"""
+
+from yoda_tpu.api.quantity import parse_quantity, QuantityError
+from yoda_tpu.api.types import (
+    TpuChip,
+    TpuNodeMetrics,
+    PodSpec,
+    HEALTHY,
+    GENERATION_RANK,
+)
+from yoda_tpu.api.requests import TpuRequest, LabelParseError
+
+__all__ = [
+    "parse_quantity",
+    "QuantityError",
+    "TpuChip",
+    "TpuNodeMetrics",
+    "PodSpec",
+    "HEALTHY",
+    "GENERATION_RANK",
+    "TpuRequest",
+    "LabelParseError",
+]

@@ -1,0 +1,175 @@
+"""Pod-label request parsing: the user-facing constraint API.
+
+The reference's entire user API is four pod labels (reference
+readme.md:27-69, SURVEY.md §1 "User-facing API surface"):
+
+    scv/number    -> tpu/chips       chips required on the node
+    scv/memory    -> tpu/hbm         free HBM required PER CHIP (quantity)
+    scv/clock     -> tpu/clock       minimum chip clock, MHz (>= semantics —
+                                     the reference filtered on EXACT equality,
+                                     filter/filter.go:57, rejecting faster
+                                     cards; fixed here)
+    scv/priority  -> tpu/priority    scheduling-queue priority (higher first)
+
+Net-new labels (no reference analog; mandated by BASELINE.json north star):
+
+    tpu/generation   minimum TPU generation, e.g. "v5e" (ordered by
+                     GENERATION_RANK)
+    tpu/gang         gang name: all pods sharing it are placed atomically
+    tpu/gang-size    number of pods in the gang
+    tpu/topology     ICI slice shape "AxBxC" (hosts), e.g. "2x2x2"
+
+Parsing is strict: a malformed label raises ``LabelParseError`` and the pod is
+reported Unschedulable with the message, instead of the reference's
+silent-zero behavior (filter/filter.go:60-74, SURVEY.md §3.4 quirk 3).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Mapping
+
+from yoda_tpu.api.quantity import (
+    QuantityError,
+    parse_int,
+    parse_quantity,
+    parse_signed_int,
+)
+from yoda_tpu.api.types import GENERATION_RANK
+
+# Label keys.
+CHIPS = "tpu/chips"
+HBM = "tpu/hbm"
+CLOCK = "tpu/clock"
+GENERATION = "tpu/generation"
+PRIORITY = "tpu/priority"
+GANG = "tpu/gang"
+GANG_SIZE = "tpu/gang-size"
+TOPOLOGY = "tpu/topology"
+
+
+class LabelParseError(ValueError):
+    """A tpu/* label failed strict validation."""
+
+
+@dataclass(frozen=True)
+class GangSpec:
+    name: str
+    size: int
+    topology: tuple[int, ...] | None = None  # hosts per ICI dimension
+
+    @property
+    def hosts(self) -> int:
+        return self.size
+
+
+@dataclass(frozen=True)
+class TpuRequest:
+    """Parsed, validated scheduling constraints for one pod."""
+
+    chips: int | None = None          # None: no explicit count (see effective_chips)
+    hbm_per_chip: int = 0             # bytes of free HBM required per chip
+    min_clock_mhz: int = 0
+    min_generation_rank: int = 0
+    priority: int = 0
+    gang: GangSpec | None = None
+
+    @property
+    def effective_chips(self) -> int:
+        """Chip count used for per-chip checks. The reference defaults to one
+        qualifying card when ``scv/number`` is absent (filter/filter.go:14-15:
+        requires CardNumber > 0, number = 1)."""
+        return 1 if self.chips is None else self.chips
+
+    @property
+    def wants_tpu(self) -> bool:
+        """True when the pod expresses any TPU constraint at all."""
+        return (
+            self.chips is not None
+            or self.hbm_per_chip > 0
+            or self.min_clock_mhz > 0
+            or self.min_generation_rank > 0
+            or self.gang is not None
+        )
+
+
+def parse_topology(text: str) -> tuple[int, ...]:
+    """Parse ``"AxBxC"`` (1–3 dims) into a host-count-per-dimension tuple."""
+    parts = text.strip().lower().split("x")
+    if not 1 <= len(parts) <= 3:
+        raise LabelParseError(f"{TOPOLOGY} must have 1-3 dims, got {text!r}")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError as e:
+        raise LabelParseError(f"malformed {TOPOLOGY} {text!r}") from e
+    if any(d < 1 for d in dims):
+        raise LabelParseError(f"{TOPOLOGY} dims must be >= 1, got {text!r}")
+    return dims
+
+
+def parse_request(labels: Mapping[str, str]) -> TpuRequest:
+    """Parse a pod's labels into a ``TpuRequest``. Strict: raises
+    ``LabelParseError`` on any malformed ``tpu/*`` value."""
+    try:
+        chips = parse_int(labels[CHIPS], field=CHIPS) if CHIPS in labels else None
+        hbm = parse_quantity(labels[HBM]) if HBM in labels else 0
+        clock = parse_int(labels[CLOCK], field=CLOCK) if CLOCK in labels else 0
+    except QuantityError as e:
+        raise LabelParseError(str(e)) from e
+
+    gen_rank = 0
+    if GENERATION in labels:
+        gen = labels[GENERATION].strip().lower()
+        if gen not in GENERATION_RANK:
+            raise LabelParseError(
+                f"unknown {GENERATION} {labels[GENERATION]!r}; "
+                f"expected one of {sorted(GENERATION_RANK)}"
+            )
+        gen_rank = GENERATION_RANK[gen]
+
+    priority = 0
+    if PRIORITY in labels:
+        # Queue priority may be negative (the reference's strconv.Atoi accepts
+        # negatives, sort/sort.go:14) — parse as a signed int, but strictly.
+        try:
+            priority = parse_signed_int(labels[PRIORITY], field=PRIORITY)
+        except QuantityError as e:
+            raise LabelParseError(str(e)) from e
+
+    gang = None
+    if GANG in labels or GANG_SIZE in labels or TOPOLOGY in labels:
+        if GANG not in labels:
+            raise LabelParseError(f"{GANG_SIZE}/{TOPOLOGY} require {GANG}")
+        name = labels[GANG].strip()
+        if not name:
+            raise LabelParseError(f"{GANG} must be non-empty")
+        topology = parse_topology(labels[TOPOLOGY]) if TOPOLOGY in labels else None
+        if GANG_SIZE in labels:
+            try:
+                size = parse_int(labels[GANG_SIZE], field=GANG_SIZE)
+            except QuantityError as e:
+                raise LabelParseError(str(e)) from e
+            if size < 1:
+                raise LabelParseError(f"{GANG_SIZE} must be >= 1")
+        elif topology is not None:
+            size = math.prod(topology)
+        else:
+            raise LabelParseError(f"{GANG} requires {GANG_SIZE} or {TOPOLOGY}")
+        if topology is not None:
+            expected = math.prod(topology)
+            if expected != size:
+                raise LabelParseError(
+                    f"{TOPOLOGY} {labels[TOPOLOGY]!r} implies {expected} hosts "
+                    f"but {GANG_SIZE} is {size}"
+                )
+        gang = GangSpec(name=name, size=size, topology=topology)
+
+    return TpuRequest(
+        chips=chips,
+        hbm_per_chip=hbm,
+        min_clock_mhz=clock,
+        min_generation_rank=gen_rank,
+        priority=priority,
+        gang=gang,
+    )

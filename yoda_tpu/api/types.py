@@ -1,0 +1,253 @@
+"""Core API types: the per-node TPU metrics CR and the pod model.
+
+``TpuNodeMetrics`` is the TPU-native replacement for the reference's SCV CRD:
+one cluster-scoped object per node, named after the node (the reference Gets
+it by node name, reference pkg/yoda/scheduler.go:70). The field mapping from
+the SCV schema (inferred at reference pkg/yoda/filter/filter.go:13-58,
+collection/collection.go:59-78, score/algorithm.go:72-87):
+
+    Scv.Status.CardNumber      -> len(TpuNodeMetrics.chips)
+    Scv.Status.CardList        -> TpuNodeMetrics.chips
+    Scv.Status.FreeMemorySum   -> TpuNodeMetrics.hbm_free_sum
+    Scv.Status.TotalMemorySum  -> TpuNodeMetrics.hbm_total_sum
+    Card.Health                -> TpuChip.health
+    Card.FreeMemory (MB)       -> TpuChip.hbm_free (bytes)
+    Card.TotalMemory (MB)      -> TpuChip.hbm_total (bytes)
+    Card.Clock (MHz)           -> TpuChip.clock_mhz
+    Card.Bandwidth             -> TpuChip.hbm_bandwidth_gbps
+    Card.Core                  -> TpuChip.tflops_bf16
+    Card.Power                 -> TpuChip.power_w
+
+Net-new fields with no reference analog (required by the topology-aware gang
+scheduler): ``generation``, ``topology_coords``, ``slice_id``, ``accel_type``,
+and ``last_updated_unix`` (staleness detection — the reference never checks
+freshness, see SURVEY.md §5 failure-detection row).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any, Iterable, Mapping
+
+HEALTHY = "Healthy"
+
+# Rank for ">= generation" admission semantics. The reference demanded an
+# EXACT clock match in Filter (card.Clock == clock, reference
+# pkg/yoda/filter/filter.go:57) while its own collection/score used >=
+# (collection.go:46, algorithm.go:49) — so a pod asking for clock 5705 was
+# rejected by nodes with strictly faster cards. We keep one ordering,
+# "at least this generation", everywhere.
+GENERATION_RANK = {"v2": 2, "v3": 3, "v4": 4, "v5e": 5, "v5p": 6, "v6e": 7}
+
+GROUP = "scheduler.yoda-tpu.dev"
+VERSION = "v1"
+KIND = "TpuNodeMetrics"
+
+
+@dataclass
+class TpuChip:
+    """One TPU chip on a host — the analog of one SCV ``Card``."""
+
+    index: int
+    health: str = HEALTHY
+    hbm_free: int = 0          # bytes
+    hbm_total: int = 0         # bytes
+    clock_mhz: int = 0
+    hbm_bandwidth_gbps: int = 0
+    tflops_bf16: int = 0
+    power_w: int = 0
+
+    @property
+    def healthy(self) -> bool:
+        return self.health == HEALTHY
+
+
+@dataclass
+class TpuNodeMetrics:
+    """Per-node TPU metrics CR, published by the node agent (one per node,
+    named after the node — mirrors the SCV Get-by-node-name contract,
+    reference pkg/yoda/scheduler.go:70)."""
+
+    name: str
+    chips: list[TpuChip] = field(default_factory=list)
+    generation: str = "v5e"
+    accel_type: str = ""                      # e.g. "v5p-16"
+    slice_id: str = ""                        # multi-host slice this node belongs to
+    topology_coords: tuple[int, int, int] = (0, 0, 0)  # host coords within slice
+    last_updated_unix: float = 0.0
+    resource_version: int = 0
+
+    @property
+    def chip_count(self) -> int:
+        return len(self.chips)
+
+    @property
+    def hbm_free_sum(self) -> int:
+        return sum(c.hbm_free for c in self.chips)
+
+    @property
+    def hbm_total_sum(self) -> int:
+        return sum(c.hbm_total for c in self.chips)
+
+    @property
+    def generation_rank(self) -> int:
+        return GENERATION_RANK.get(self.generation, 0)
+
+    def healthy_chips(self) -> list[TpuChip]:
+        return [c for c in self.chips if c.healthy]
+
+    def fresh(self, *, max_age_s: float, now: float | None = None) -> bool:
+        """Staleness check (net-new vs reference; SURVEY.md §5)."""
+        now = time.time() if now is None else now
+        return (now - self.last_updated_unix) <= max_age_s
+
+    # --- CR (de)serialization, used by the fake/real API server paths ---
+
+    def to_obj(self) -> dict[str, Any]:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": KIND,
+            "metadata": {
+                "name": self.name,
+                "resourceVersion": str(self.resource_version),
+            },
+            "status": {
+                "generation": self.generation,
+                "accelType": self.accel_type,
+                "sliceId": self.slice_id,
+                "topologyCoords": list(self.topology_coords),
+                "lastUpdatedUnix": self.last_updated_unix,
+                "chipCount": self.chip_count,
+                "hbmFreeSum": self.hbm_free_sum,
+                "hbmTotalSum": self.hbm_total_sum,
+                "chips": [asdict(c) for c in self.chips],
+            },
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "TpuNodeMetrics":
+        st = obj.get("status", {})
+        return cls(
+            name=obj["metadata"]["name"],
+            chips=[TpuChip(**c) for c in st.get("chips", [])],
+            generation=st.get("generation", "v5e"),
+            accel_type=st.get("accelType", ""),
+            slice_id=st.get("sliceId", ""),
+            topology_coords=tuple(st.get("topologyCoords", (0, 0, 0))),
+            last_updated_unix=st.get("lastUpdatedUnix", 0.0),
+            resource_version=int(obj["metadata"].get("resourceVersion", "0")),
+        )
+
+
+_pod_seq = itertools.count()
+
+
+@dataclass
+class PodSpec:
+    """Minimal pod model: everything the scheduler reads off a v1.Pod.
+
+    The reference reads only pod name and labels (reference
+    pkg/yoda/filter/filter.go:12,19,36; sort/sort.go:13) plus the node's
+    already-placed pods' labels for allocation scoring
+    (score/algorithm.go:77-80).
+    """
+
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = "yoda-tpu"
+    node_name: str | None = None
+    phase: str = "Pending"
+    uid: str = ""
+    creation_seq: int = field(default_factory=lambda: next(_pod_seq))
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}#{self.creation_seq}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def to_obj(self) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": dict(self.labels),
+                "uid": self.uid,
+                # Arrival-order sequence, preserved across (de)serialization so
+                # FIFO tie-breaks survive a scheduler restart / relist.
+                "creationSeq": self.creation_seq,
+            },
+            "spec": {
+                "schedulerName": self.scheduler_name,
+                "nodeName": self.node_name,
+            },
+            "status": {"phase": self.phase},
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "PodSpec":
+        md = obj["metadata"]
+        spec = obj.get("spec", {})
+        kwargs = {}
+        if "creationSeq" in md:
+            kwargs["creation_seq"] = md["creationSeq"]
+        return cls(
+            name=md["name"],
+            namespace=md.get("namespace", "default"),
+            labels=dict(md.get("labels", {})),
+            scheduler_name=spec.get("schedulerName", "yoda-tpu"),
+            node_name=spec.get("nodeName"),
+            phase=obj.get("status", {}).get("phase", "Pending"),
+            uid=md.get("uid", ""),
+            **kwargs,
+        )
+
+
+def make_node(
+    name: str,
+    *,
+    chips: int = 4,
+    hbm_per_chip: int = 16 << 30,
+    hbm_free_per_chip: int | None = None,
+    generation: str = "v5e",
+    clock_mhz: int = 940,
+    hbm_bandwidth_gbps: int = 819,
+    tflops_bf16: int = 197,
+    power_w: int = 170,
+    slice_id: str = "",
+    topology_coords: tuple[int, int, int] = (0, 0, 0),
+    accel_type: str = "",
+    unhealthy: Iterable[int] = (),
+    now: float | None = None,
+) -> TpuNodeMetrics:
+    """Convenience constructor used by the fake publisher and tests."""
+    free = hbm_per_chip if hbm_free_per_chip is None else hbm_free_per_chip
+    bad = set(unhealthy)
+    return TpuNodeMetrics(
+        name=name,
+        generation=generation,
+        accel_type=accel_type or f"{generation}-{chips}",
+        slice_id=slice_id,
+        topology_coords=topology_coords,
+        last_updated_unix=time.time() if now is None else now,
+        chips=[
+            TpuChip(
+                index=i,
+                health=("Unhealthy" if i in bad else HEALTHY),
+                hbm_free=free,
+                hbm_total=hbm_per_chip,
+                clock_mhz=clock_mhz,
+                hbm_bandwidth_gbps=hbm_bandwidth_gbps,
+                tflops_bf16=tflops_bf16,
+                power_w=power_w,
+            )
+            for i in range(chips)
+        ],
+    )

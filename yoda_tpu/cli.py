@@ -1,0 +1,48 @@
+"""Binary entry point — the analog of the reference's ``cmd/scheduler/main.go``.
+
+The reference main seeds rand, builds the upstream scheduler command with the
+yoda plugin injected, and executes it (reference cmd/scheduler/main.go:12-21,
+pkg/register/register.go:9-13). Here the equivalent is: parse flags, assemble
+the framework with the yoda-tpu plugin set, and run the scheduling loop
+against the configured cluster backend (fake in-memory for demos/tests, real
+API server when a kubeconfig is reachable).
+
+The full loop lands with yoda_tpu.cluster / yoda_tpu.framework; until then
+this entry point reports what is available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="yoda-tpu-scheduler",
+        description="TPU-native Kubernetes scheduler (yoda-tpu)",
+    )
+    parser.add_argument("--config", help="scheduler configuration file", default=None)
+    parser.add_argument("-v", "--verbosity", type=int, default=3)
+    parser.add_argument(
+        "--demo",
+        action="store_true",
+        help="run against an in-memory fake cluster with a synthetic TPU fleet",
+    )
+    args = parser.parse_args(argv)
+
+    if args.demo:
+        from yoda_tpu.demo import run_demo
+
+        return run_demo(verbosity=args.verbosity)
+
+    print(
+        "yoda-tpu-scheduler: no in-cluster mode configured in this build; "
+        "run with --demo for the in-memory fleet demo.",
+        file=sys.stderr,
+    )
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
