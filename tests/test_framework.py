@@ -1,0 +1,306 @@
+"""Framework-core tests: queue ordering, extension-point semantics, the
+cycle driver, and the Permit waitlist — using stub plugins (no cluster, per
+the integration-test strategy in SURVEY.md §4)."""
+
+import pytest
+
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.framework import (
+    BindPlugin,
+    Code,
+    CycleState,
+    FilterPlugin,
+    Framework,
+    NodeInfo,
+    PermitPlugin,
+    PostFilterPlugin,
+    QueuedPodInfo,
+    QueueSortPlugin,
+    ReservePlugin,
+    Scheduler,
+    SchedulingQueue,
+    ScorePlugin,
+    Snapshot,
+    Status,
+)
+
+
+def snap(*nodes: NodeInfo) -> Snapshot:
+    return Snapshot({n.name: n for n in nodes})
+
+
+def make_snapshot(names):
+    return snap(*[NodeInfo(name=n, tpu=make_node(n)) for n in names])
+
+
+class PrioritySort(QueueSortPlugin):
+    name = "sort"
+
+    def less(self, a, b):
+        pa = int(a.pod.labels.get("tpu/priority", "0"))
+        pb = int(b.pod.labels.get("tpu/priority", "0"))
+        return pa > pb
+
+
+class AllowAllFilter(FilterPlugin):
+    name = "allow-all"
+
+    def filter(self, state, pod, node):
+        return Status.ok()
+
+
+class DenyNodesFilter(FilterPlugin):
+    name = "deny-some"
+
+    def __init__(self, deny):
+        self.deny = set(deny)
+
+    def filter(self, state, pod, node):
+        if node.name in self.deny:
+            return Status.unschedulable(f"denied {node.name}")
+        return Status.ok()
+
+
+class StaticScore(ScorePlugin):
+    name = "static-score"
+
+    def __init__(self, table):
+        self.table = table
+
+    def score(self, state, pod, node):
+        return self.table.get(node.name, 0), Status.ok()
+
+
+class RecordingBinder(BindPlugin):
+    name = "binder"
+
+    def __init__(self):
+        self.bound = {}
+
+    def bind(self, state, pod, node_name):
+        self.bound[pod.key] = node_name
+        return Status.ok()
+
+
+class CountingReserve(ReservePlugin):
+    name = "reserve"
+
+    def __init__(self, fail_on=None):
+        self.reserved = []
+        self.unreserved = []
+        self.fail_on = fail_on or set()
+
+    def reserve(self, state, pod, node_name):
+        if pod.key in self.fail_on:
+            return Status.unschedulable("reserve refused")
+        self.reserved.append((pod.key, node_name))
+        return Status.ok()
+
+    def unreserve(self, state, pod, node_name):
+        self.unreserved.append((pod.key, node_name))
+
+
+class WaitNPermit(PermitPlugin):
+    """Waits until N pods are waiting, then allows all (mini-gang)."""
+
+    name = "wait-n"
+
+    def __init__(self, n, timeout=10.0):
+        self.n = n
+        self.timeout = timeout
+
+    def permit(self, state, pod, node_name):
+        return Status.wait(), self.timeout
+
+    def on_pod_waiting(self, framework, wp):
+        waiting = framework.waiting_pods()
+        if len(waiting) >= self.n:
+            for w in list(waiting):
+                w.allow(self.name)
+
+
+class TestQueue:
+    def test_fifo_by_default(self):
+        q = SchedulingQueue()
+        a, b = PodSpec("a"), PodSpec("b")
+        q.add(a)
+        q.add(b)
+        assert q.pop(timeout=0).pod.name == "a"
+        assert q.pop(timeout=0).pod.name == "b"
+        assert q.pop(timeout=0) is None
+
+    def test_priority_order_with_fifo_tiebreak(self):
+        # Parity with reference sort/sort.go:8-18 (higher scv/priority first).
+        q = SchedulingQueue(PrioritySort())
+        q.add(PodSpec("low", labels={"tpu/priority": "1"}))
+        q.add(PodSpec("high", labels={"tpu/priority": "5"}))
+        q.add(PodSpec("mid-1", labels={"tpu/priority": "3"}))
+        q.add(PodSpec("mid-2", labels={"tpu/priority": "3"}))
+        order = [q.pop(timeout=0).pod.name for _ in range(4)]
+        assert order == ["high", "mid-1", "mid-2", "low"]
+
+    def test_backoff_then_reactivate(self):
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0])
+        q.add(PodSpec("a"))
+        qpi = q.pop(timeout=0)
+        q.add_unschedulable(qpi, "nope")
+        assert q.pop(timeout=0) is None  # still backing off
+        now[0] += qpi.backoff_seconds() + 0.01
+        assert q.pop(timeout=0).pod.name == "a"
+
+    def test_move_all_to_active_short_circuits_backoff(self):
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0])
+        q.add(PodSpec("a"))
+        q.add_unschedulable(q.pop(timeout=0), "nope")
+        q.move_all_to_active()
+        assert q.pop(timeout=0).pod.name == "a"
+
+    def test_backoff_grows_with_attempts(self):
+        qpi = QueuedPodInfo(pod=PodSpec("a"))
+        qpi.attempts = 1
+        first = qpi.backoff_seconds()
+        qpi.attempts = 5
+        assert qpi.backoff_seconds() > first
+        qpi.attempts = 50
+        assert qpi.backoff_seconds() == 10.0  # capped
+
+
+def build(plugins, nodes):
+    fw = Framework(plugins)
+    snapshot = make_snapshot(nodes)
+    q = SchedulingQueue(fw.queue_sort)
+    sched = Scheduler(fw, lambda: snapshot, q)
+    return fw, q, sched
+
+
+class TestCycle:
+    def test_filter_score_bind(self):
+        binder = RecordingBinder()
+        _, q, sched = build(
+            [
+                AllowAllFilter(),
+                DenyNodesFilter(["n1"]),
+                StaticScore({"n0": 10, "n2": 50}),
+                binder,
+            ],
+            ["n0", "n1", "n2"],
+        )
+        q.add(PodSpec("p"))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "bound"
+        assert r.node == "n2"  # highest score among feasible {n0, n2}
+        assert binder.bound["default/p"] == "n2"
+
+    def test_all_filtered_out_is_unschedulable(self):
+        _, q, sched = build(
+            [DenyNodesFilter(["n0", "n1"]), RecordingBinder()], ["n0", "n1"]
+        )
+        q.add(PodSpec("p"))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "unschedulable"
+        assert "denied" in r.message
+        assert len(q) == 1  # requeued with backoff
+
+    def test_reserve_failure_requeues(self):
+        res = CountingReserve(fail_on={"default/p"})
+        _, q, sched = build([AllowAllFilter(), res, RecordingBinder()], ["n0"])
+        q.add(PodSpec("p"))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "unschedulable"
+        assert res.reserved == []
+
+    def test_reserve_rollback_order(self):
+        # Second reserve plugin fails -> first is unreserved (reverse order).
+        first = CountingReserve()
+        second = CountingReserve(fail_on={"default/p"})
+        fw = Framework([first, second])
+        st = fw.run_reserve(CycleState(), PodSpec("p"), "n0")
+        assert not st.success
+        assert first.reserved == [("default/p", "n0")]
+        assert first.unreserved == [("default/p", "n0")]
+
+    def test_score_tiebreak_deterministic(self):
+        binder = RecordingBinder()
+        _, q, sched = build([AllowAllFilter(), binder], ["nb", "na"])
+        q.add(PodSpec("p"))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.node == "nb"  # equal scores: lexicographically greatest name
+
+    def test_normalize_all_equal_guard(self):
+        # Reference guard: lowest-- when all scores equal (scheduler.go:136-138).
+        from yoda_tpu.framework.scheduler import _normalize
+
+        assert _normalize({"a": 7, "b": 7}) == {"a": 100, "b": 100}
+        assert _normalize({}) == {}
+        out = _normalize({"a": 0, "b": 50, "c": 100})
+        assert out == {"a": 0, "b": 50, "c": 100}
+
+
+class TestPermitWaitlist:
+    def test_gang_of_two_binds_together(self):
+        binder = RecordingBinder()
+        reserve = CountingReserve()
+        _, q, sched = build(
+            [AllowAllFilter(), reserve, WaitNPermit(2), binder], ["n0", "n1"]
+        )
+        q.add(PodSpec("g0"))
+        q.add(PodSpec("g1"))
+        r0 = sched.schedule_one(q.pop(timeout=0))
+        assert r0.outcome == "waiting"
+        assert binder.bound == {}
+        r1 = sched.schedule_one(q.pop(timeout=0))
+        # Second member completes the mini-gang: both bind.
+        assert set(binder.bound) == {"default/g0", "default/g1"}
+        assert r1.outcome in ("waiting", "bound")
+        assert sched.framework.waiting_pods() == []
+
+    def test_permit_timeout_unreserves_and_requeues(self):
+        now = [100.0]
+        binder = RecordingBinder()
+        reserve = CountingReserve()
+        fw = Framework([AllowAllFilter(), reserve, WaitNPermit(2, timeout=5.0), binder])
+        snapshot = make_snapshot(["n0"])
+        q = SchedulingQueue(clock=lambda: now[0])
+        sched = Scheduler(fw, lambda: snapshot, q, clock=lambda: now[0])
+        q.add(PodSpec("solo"))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "waiting"
+        assert fw.expire_waiting(now=102.0) == 0  # not yet
+        assert fw.expire_waiting(now=105.1) == 1
+        assert binder.bound == {}
+        assert reserve.unreserved == [("default/solo", "n0")]
+        assert len(q) == 1  # requeued
+
+    def test_reject_unreserves(self):
+        binder = RecordingBinder()
+        reserve = CountingReserve()
+        _, q, sched = build(
+            [AllowAllFilter(), reserve, WaitNPermit(99), binder], ["n0"]
+        )
+        q.add(PodSpec("p"))
+        sched.schedule_one(q.pop(timeout=0))
+        wp = sched.framework.get_waiting_pod("default/p")
+        wp.reject("gang cancelled")
+        assert reserve.unreserved == [("default/p", "n0")]
+        assert binder.bound == {}
+
+
+class TestPostFilter:
+    def test_nomination_requeues(self):
+        class Nominator(PostFilterPlugin):
+            name = "nominator"
+
+            def post_filter(self, state, pod, snapshot, statuses):
+                return "n0", Status.ok()
+
+        _, q, sched = build(
+            [DenyNodesFilter(["n0"]), Nominator(), RecordingBinder()], ["n0"]
+        )
+        q.add(PodSpec("p"))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "nominated"
+        assert r.node == "n0"
+        assert sched.stats.preempt_nominations == 1
+        assert len(q) == 1

@@ -1,0 +1,276 @@
+"""Yoda plugin unit tests: sort, filter predicates, max collection, scoring.
+
+Table-driven against the reference semantics (pkg/yoda/filter, collection,
+score) including regression tests for the reference quirks that were fixed
+(SURVEY.md §3.4).
+"""
+
+import pytest
+
+from yoda_tpu.api.requests import parse_request
+from yoda_tpu.api.types import PodSpec, TpuChip, make_node
+from yoda_tpu.framework import (
+    CycleState,
+    Framework,
+    NodeInfo,
+    Scheduler,
+    SchedulingQueue,
+    Snapshot,
+    Status,
+)
+from yoda_tpu.framework.interfaces import BindPlugin
+from yoda_tpu.plugins.yoda import (
+    MaxValueData,
+    Weights,
+    YodaFilter,
+    YodaPreFilter,
+    YodaPreScore,
+    YodaScore,
+    YodaSort,
+)
+from yoda_tpu.plugins.yoda.filter_plugin import (
+    RequestData,
+    REQUEST_KEY,
+    pod_fits_chips,
+    pod_fits_clock,
+    pod_fits_hbm,
+    qualifying_chips,
+)
+from yoda_tpu.plugins.yoda.score import (
+    actual_score,
+    allocate_score,
+    basic_score,
+    chip_score,
+)
+
+GIB = 1 << 30
+
+
+def req_of(**labels):
+    return parse_request({k: str(v) for k, v in labels.items()})
+
+
+class TestPredicates:
+    def test_fits_chips_explicit(self):
+        node = make_node("n", chips=4)
+        assert pod_fits_chips(req_of(**{"tpu/chips": 4}), node) == (True, 4)
+        assert pod_fits_chips(req_of(**{"tpu/chips": 5}), node) == (False, 5)
+
+    def test_fits_chips_default_one(self):
+        # Reference default: CardNumber > 0, number = 1 (filter.go:14-15).
+        node = make_node("n", chips=2)
+        assert pod_fits_chips(req_of(), node) == (True, 1)
+        empty = make_node("cpu-only", chips=0)
+        assert pod_fits_chips(req_of(), empty) == (False, 1)
+
+    def test_unhealthy_chips_do_not_count(self):
+        # Deviation from reference (which counted ALL cards, filter.go:13).
+        node = make_node("n", chips=4, unhealthy=[0, 1, 2])
+        assert pod_fits_chips(req_of(**{"tpu/chips": 2}), node) == (False, 2)
+
+    def test_fits_hbm(self):
+        node = make_node("n", chips=4, hbm_per_chip=16 * GIB, hbm_free_per_chip=8 * GIB)
+        assert pod_fits_hbm(4, req_of(**{"tpu/hbm": "8Gi"}), node)
+        assert not pod_fits_hbm(1, req_of(**{"tpu/hbm": "9Gi"}), node)
+        # Unhealthy chips excluded (CardFitsMemory health check, filter.go:52-54)
+        sick = make_node("n", chips=2, unhealthy=[0])
+        assert not pod_fits_hbm(2, req_of(**{"tpu/hbm": "1Gi"}), sick)
+
+    def test_fits_clock_gte_semantics(self):
+        # Regression for quirk 2: the reference rejected FASTER cards
+        # (card.Clock == clock, filter.go:57).
+        node = make_node("n", chips=2, clock_mhz=1000)
+        assert pod_fits_clock(2, req_of(**{"tpu/clock": 940}), node)
+        assert pod_fits_clock(2, req_of(**{"tpu/clock": 1000}), node)
+        assert not pod_fits_clock(2, req_of(**{"tpu/clock": 1001}), node)
+
+    def test_qualifying_chips(self):
+        node = make_node("n", chips=4, hbm_free_per_chip=8 * GIB, unhealthy=[3])
+        node.chips[0].hbm_free = 1 * GIB
+        q = qualifying_chips(node, req_of(**{"tpu/hbm": "4Gi"}))
+        assert [c.index for c in q] == [1, 2]
+
+
+class TestFilterPlugin:
+    def run_filter(self, labels, node_tpu, **kw):
+        state = CycleState()
+        pod = PodSpec("p", labels=labels)
+        snapshot = Snapshot({})
+        st = YodaPreFilter().pre_filter(state, pod, snapshot)
+        if not st.success:
+            return st
+        return YodaFilter(**kw).filter(state, pod, NodeInfo("n", tpu=node_tpu))
+
+    def test_happy_path(self):
+        st = self.run_filter({"tpu/chips": "2", "tpu/hbm": "8Gi"}, make_node("n", chips=4))
+        assert st.success
+
+    def test_no_tpu_cr_unschedulable(self):
+        # Reference parity: SCV Get failure -> Unschedulable (scheduler.go:72-74).
+        st = self.run_filter({}, None)
+        assert st.rejected
+
+    def test_malformed_label_unresolvable(self):
+        st = self.run_filter({"tpu/hbm": "8GB"}, make_node("n"))
+        assert st.code.value == "UnschedulableAndUnresolvable"
+        assert "tpu/" in st.message
+
+    def test_generation_gate(self):
+        v5e = make_node("n", generation="v5e")
+        assert self.run_filter({"tpu/generation": "v5p"}, v5e).rejected
+        v5p = make_node("n", generation="v5p")
+        assert self.run_filter({"tpu/generation": "v5e"}, v5p).success
+
+    def test_stale_metrics_rejected(self):
+        node = make_node("n", now=100.0)
+        st = self.run_filter({}, node, max_metrics_age_s=30.0, now_fn=lambda: 200.0)
+        assert st.rejected and "stale" in st.message
+        st = self.run_filter({}, node, max_metrics_age_s=30.0, now_fn=lambda: 110.0)
+        assert st.success
+
+    def test_reservation_awareness(self):
+        node = make_node("n", chips=4)
+        st = self.run_filter({"tpu/chips": "2"}, node, reserved_chips_fn=lambda n: 3)
+        assert st.rejected and "reserved" in st.message
+        st = self.run_filter({"tpu/chips": "2"}, node, reserved_chips_fn=lambda n: 2)
+        assert st.success
+
+
+class TestCollection:
+    def test_maxima_over_feasible_qualifying_chips(self):
+        state = CycleState()
+        state.write(REQUEST_KEY, RequestData(req_of(**{"tpu/hbm": "4Gi"})))
+        big = make_node("big", chips=2, hbm_per_chip=32 * GIB, clock_mhz=1200, tflops_bf16=400)
+        small = make_node("small", chips=2, hbm_per_chip=16 * GIB, clock_mhz=900)
+        # 'small' is feasible but 'big' is not in the feasible list: its chips
+        # must not contribute maxima.
+        snapshot = Snapshot({
+            "big": NodeInfo("big", tpu=big),
+            "small": NodeInfo("small", tpu=small),
+        })
+        st = YodaPreScore().pre_score(state, PodSpec("p"), snapshot, ["small"])
+        assert st.success
+        data = state.read("Max")
+        assert data.max_clock == 900
+        assert data.max_hbm_free == 16 * GIB
+
+    def test_maxima_initialize_to_one(self):
+        # Parity with collection.go:31-38 (division safety).
+        data = MaxValueData()
+        assert data.max_clock == 1 and data.max_hbm_free == 1
+
+    def test_update_takes_max(self):
+        data = MaxValueData()
+        data.update(TpuChip(index=0, hbm_free=5, hbm_total=10, clock_mhz=7,
+                            hbm_bandwidth_gbps=3, tflops_bf16=2, power_w=9))
+        data.update(TpuChip(index=1, hbm_free=3, hbm_total=20, clock_mhz=2,
+                            hbm_bandwidth_gbps=8, tflops_bf16=1, power_w=4))
+        assert (data.max_hbm_free, data.max_hbm_total, data.max_clock,
+                data.max_hbm_bandwidth, data.max_tflops, data.max_power) == (5, 20, 7, 8, 2, 9)
+
+
+class TestScore:
+    def test_chip_score_normalizes_clock_by_max_clock(self):
+        # Regression for quirk 1 (algorithm.go:61 divided clock by MaxBandwidth).
+        value = MaxValueData(max_clock=1000, max_hbm_bandwidth=1)  # would explode old way
+        chip = TpuChip(index=0, clock_mhz=500, hbm_free=1, hbm_total=1,
+                       hbm_bandwidth_gbps=1, tflops_bf16=1, power_w=1)
+        value.max_hbm_free = value.max_hbm_total = 1
+        value.max_tflops = value.max_power = 1
+        w = Weights()
+        s = chip_score(value, chip, w)
+        # clock term contributes 500*100//1000 = 50, all others 100*weight
+        assert s == 100 * 1 + 50 * 1 + 100 * 1 + 100 * 1 + 100 * 2 + 100 * 1
+
+    def test_basic_score_sums_qualifying_chips(self):
+        # Quirk 7 retained: more qualifying chips -> higher basic score.
+        value = MaxValueData(max_clock=1000, max_hbm_bandwidth=819,
+                             max_tflops=197, max_power=170,
+                             max_hbm_free=16 * GIB, max_hbm_total=16 * GIB)
+        req = req_of()
+        two = make_node("a", chips=2, clock_mhz=1000)
+        four = make_node("b", chips=4, clock_mhz=1000)
+        assert basic_score(value, four, req, Weights()) == 2 * basic_score(value, two, req, Weights())
+
+    def test_actual_score_ratio(self):
+        node = make_node("n", chips=2, hbm_per_chip=10 * GIB, hbm_free_per_chip=5 * GIB)
+        assert actual_score(node, Weights()) == 50 * 2
+        zero = make_node("z", chips=0)
+        assert actual_score(zero, Weights()) == 0  # reference would panic
+
+    def test_allocate_score_counts_placed_pods(self):
+        tpu = make_node("n", chips=4, hbm_per_chip=16 * GIB)  # total 64 GiB
+        placed = PodSpec("old", labels={"tpu/hbm": "8Gi", "tpu/chips": "2"})  # claims 16 GiB
+        node = NodeInfo("n", tpu=tpu, pods=[placed])
+        # (64-16)/64 = 75% headroom * weight 2
+        assert allocate_score(node, tpu, Weights()) == 75 * 2
+        # Over-claimed -> 0 (algorithm.go:84-86)
+        hungry = PodSpec("big", labels={"tpu/hbm": "64Gi", "tpu/chips": "2"})
+        assert allocate_score(NodeInfo("n", tpu=tpu, pods=[hungry]), tpu, Weights()) == 0
+
+
+class RecordingBinder(BindPlugin):
+    name = "binder"
+
+    def __init__(self):
+        self.bound = {}
+
+    def bind(self, state, pod, node_name):
+        self.bound[pod.key] = node_name
+        return Status.ok()
+
+
+def full_framework(binder=None):
+    return Framework([
+        YodaSort(),
+        YodaPreFilter(),
+        YodaFilter(),
+        YodaPreScore(),
+        YodaScore(),
+        binder or RecordingBinder(),
+    ])
+
+
+class TestEndToEndCycle:
+    """The whole plugin set through the framework driver — the integration
+    layer of the test pyramid (SURVEY.md §4)."""
+
+    def make_sched(self, nodes, binder):
+        fw = full_framework(binder)
+        snapshot = Snapshot({n.name: NodeInfo(n.name, tpu=n) for n in nodes})
+        q = SchedulingQueue(fw.queue_sort)
+        return Scheduler(fw, lambda: snapshot, q), q
+
+    def test_picks_freest_node(self):
+        busy = make_node("busy", chips=4, hbm_per_chip=16 * GIB, hbm_free_per_chip=2 * GIB)
+        free = make_node("free", chips=4, hbm_per_chip=16 * GIB)
+        binder = RecordingBinder()
+        sched, q = self.make_sched([busy, free], binder)
+        q.add(PodSpec("p", labels={"tpu/hbm": "1Gi"}))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "bound" and r.node == "free"
+
+    def test_respects_chip_filter(self):
+        small = make_node("small", chips=2)
+        big = make_node("big", chips=8)
+        binder = RecordingBinder()
+        sched, q = self.make_sched([small, big], binder)
+        q.add(PodSpec("p", labels={"tpu/chips": "4"}))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.node == "big"
+
+    def test_unschedulable_when_no_fit(self):
+        sched, q = self.make_sched([make_node("n", chips=2)], RecordingBinder())
+        q.add(PodSpec("p", labels={"tpu/chips": "16"}))
+        r = sched.schedule_one(q.pop(timeout=0))
+        assert r.outcome == "unschedulable"
+        assert "chips" in r.message
+
+    def test_priority_scheduling_order(self):
+        node = make_node("n", chips=8)
+        binder = RecordingBinder()
+        sched, q = self.make_sched([node], binder)
+        q.add(PodSpec("low", labels={"tpu/priority": "0"}))
+        q.add(PodSpec("high", labels={"tpu/priority": "9"}))
+        first = q.pop(timeout=0)
+        assert first.pod.name == "high"

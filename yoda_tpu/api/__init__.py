@@ -13,8 +13,15 @@ from yoda_tpu.api.types import (
     PodSpec,
     HEALTHY,
     GENERATION_RANK,
+    make_node,
 )
-from yoda_tpu.api.requests import TpuRequest, LabelParseError
+from yoda_tpu.api.requests import (
+    GangSpec,
+    LabelParseError,
+    TpuRequest,
+    parse_request,
+    parse_topology,
+)
 
 __all__ = [
     "parse_quantity",
@@ -24,6 +31,10 @@ __all__ = [
     "PodSpec",
     "HEALTHY",
     "GENERATION_RANK",
+    "make_node",
+    "GangSpec",
     "TpuRequest",
     "LabelParseError",
+    "parse_request",
+    "parse_topology",
 ]

@@ -100,8 +100,8 @@ def parse_topology(text: str) -> tuple[int, ...]:
     if not 1 <= len(parts) <= 3:
         raise LabelParseError(f"{TOPOLOGY} must have 1-3 dims, got {text!r}")
     try:
-        dims = tuple(int(p) for p in parts)
-    except ValueError as e:
+        dims = tuple(parse_int(p, field=TOPOLOGY) for p in parts)
+    except QuantityError as e:
         raise LabelParseError(f"malformed {TOPOLOGY} {text!r}") from e
     if any(d < 1 for d in dims):
         raise LabelParseError(f"{TOPOLOGY} dims must be >= 1, got {text!r}")

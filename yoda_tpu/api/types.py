@@ -93,7 +93,7 @@ class TpuNodeMetrics:
 
     @property
     def generation_rank(self) -> int:
-        return GENERATION_RANK.get(self.generation, 0)
+        return GENERATION_RANK.get(self.generation.strip().lower(), 0)
 
     def healthy_chips(self) -> list[TpuChip]:
         return [c for c in self.chips if c.healthy]
@@ -198,6 +198,15 @@ class PodSpec:
         kwargs = {}
         if "creationSeq" in md:
             kwargs["creation_seq"] = md["creationSeq"]
+            # Keep the global counter ahead of restored sequences so pods
+            # created after a restart/relist still sort behind older pods.
+            global _pod_seq
+            restored = md["creationSeq"]
+            nxt = next(_pod_seq)
+            if restored >= nxt:
+                _pod_seq = itertools.count(restored + 1)
+            else:
+                _pod_seq = itertools.count(nxt)
         return cls(
             name=md["name"],
             namespace=md.get("namespace", "default"),

@@ -32,8 +32,15 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     if args.demo:
-        from yoda_tpu.demo import run_demo
-
+        try:
+            from yoda_tpu.demo import run_demo
+        except ImportError:
+            print(
+                "yoda-tpu-scheduler: the --demo loop is not available in this "
+                "build (yoda_tpu.demo missing).",
+                file=sys.stderr,
+            )
+            return 2
         return run_demo(verbosity=args.verbosity)
 
     print(

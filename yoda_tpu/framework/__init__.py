@@ -1,0 +1,70 @@
+"""Scheduling framework: extension points, cycle state, queue, and runtime.
+
+The reference relies on the upstream kube-scheduler scheduling framework
+(k8s 1.17 ``framework/v1alpha1``) which it gets wholesale through
+``app.NewSchedulerCommand`` (reference pkg/register/register.go:9-13) — the
+queues, cache, cycle driver, and binding all live upstream. This package is
+the from-scratch equivalent of that machinery, modeled on the MODERN (v1)
+framework semantics, because every hook the reference uses has moved since
+v1alpha1: the reference's "PostFilter" (a pre-scoring data-collection hook,
+reference pkg/yoda/scheduler.go:85) is today's **PreScore**, and today's
+PostFilter means preemption (SURVEY.md §3.2 note).
+
+Extension-point order for one pod's scheduling cycle:
+
+    QueueSort (queue ordering)
+    -> PreFilter -> Filter (per node) -> [PostFilter on failure: preemption]
+    -> PreScore -> Score (per node) -> NormalizeScore
+    -> Reserve [-> Unreserve on any later failure]
+    -> Permit (may Wait: gang scheduling)
+    -> Bind
+"""
+
+from yoda_tpu.framework.interfaces import (
+    Code,
+    Status,
+    NodeInfo,
+    Snapshot,
+    QueueSortPlugin,
+    PreFilterPlugin,
+    FilterPlugin,
+    PostFilterPlugin,
+    PreScorePlugin,
+    ScorePlugin,
+    BatchFilterScorePlugin,
+    ReservePlugin,
+    PermitPlugin,
+    BindPlugin,
+    MAX_NODE_SCORE,
+)
+from yoda_tpu.framework.cyclestate import CycleState, StateData
+from yoda_tpu.framework.queue import SchedulingQueue, QueuedPodInfo
+from yoda_tpu.framework.runtime import Framework, WaitingPod
+from yoda_tpu.framework.scheduler import ScheduleResult, Scheduler, SchedulerStats
+
+__all__ = [
+    "Code",
+    "Status",
+    "NodeInfo",
+    "Snapshot",
+    "QueueSortPlugin",
+    "PreFilterPlugin",
+    "FilterPlugin",
+    "PostFilterPlugin",
+    "PreScorePlugin",
+    "ScorePlugin",
+    "BatchFilterScorePlugin",
+    "ReservePlugin",
+    "PermitPlugin",
+    "BindPlugin",
+    "MAX_NODE_SCORE",
+    "CycleState",
+    "StateData",
+    "SchedulingQueue",
+    "QueuedPodInfo",
+    "Framework",
+    "WaitingPod",
+    "Scheduler",
+    "ScheduleResult",
+    "SchedulerStats",
+]

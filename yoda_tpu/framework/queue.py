@@ -1,0 +1,159 @@
+"""Scheduling queue: active heap ordered by the QueueSort plugin, with
+backoff for unschedulable pods.
+
+The reference supplies only the ordering function (``Less``, reference
+pkg/yoda/sort/sort.go:8-18) and inherits the queue machinery (active /
+backoff / unschedulable pools, event-driven re-activation) from upstream;
+this module is the from-scratch equivalent of that machinery.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.framework.interfaces import QueueSortPlugin
+
+# Upstream kube-scheduler defaults: initial 1s, doubling, capped at 10s.
+INITIAL_BACKOFF_S = 1.0
+MAX_BACKOFF_S = 10.0
+
+
+@dataclass
+class QueuedPodInfo:
+    pod: PodSpec
+    attempts: int = 0
+    added_unix: float = 0.0
+    unschedulable_message: str = ""
+
+    def backoff_seconds(self) -> float:
+        return min(INITIAL_BACKOFF_S * (2 ** max(self.attempts - 1, 0)), MAX_BACKOFF_S)
+
+
+class _HeapItem:
+    """heapq adapter: delegates ordering to the QueueSort plugin, with a
+    monotonic tiebreak so equal-priority pods stay FIFO."""
+
+    __slots__ = ("qpi", "seq", "less")
+
+    def __init__(self, qpi: QueuedPodInfo, seq: int, less: Callable) -> None:
+        self.qpi = qpi
+        self.seq = seq
+        self.less = less
+
+    def __lt__(self, other: "_HeapItem") -> bool:
+        if self.less(self.qpi, other.qpi):
+            return True
+        if self.less(other.qpi, self.qpi):
+            return False
+        return self.seq < other.seq
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        sort_plugin: QueueSortPlugin | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if sort_plugin is not None:
+            self._less = sort_plugin.less
+        else:
+            self._less = lambda a, b: a.pod.creation_seq < b.pod.creation_seq
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._seq = itertools.count()
+        self._active: list[_HeapItem] = []
+        self._backoff: list[tuple[float, int, QueuedPodInfo]] = []  # (ready_at, seq, qpi)
+        self._unschedulable: dict[str, QueuedPodInfo] = {}  # pod key -> qpi
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._active) + len(self._backoff) + len(self._unschedulable)
+
+    def pending_retry_count(self) -> int:
+        """Pods that will re-enter the active queue without an external
+        event (active + backoff); excludes the parked-unresolvable pool."""
+        with self._lock:
+            return len(self._active) + len(self._backoff)
+
+    def add(self, pod: PodSpec) -> None:
+        with self._cond:
+            self._push_active(QueuedPodInfo(pod=pod, added_unix=self._clock()))
+            self._cond.notify()
+
+    def _push_active(self, qpi: QueuedPodInfo) -> None:
+        heapq.heappush(self._active, _HeapItem(qpi, next(self._seq), self._less))
+
+    def _flush_backoff_locked(self) -> None:
+        now = self._clock()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, qpi = heapq.heappop(self._backoff)
+            self._push_active(qpi)
+
+    def pop(self, timeout: float | None = None) -> QueuedPodInfo | None:
+        """Pop the highest-priority active pod; blocks up to ``timeout``
+        (forever if None) until one is available or the queue is closed."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                self._flush_backoff_locked()
+                if self._active:
+                    item = heapq.heappop(self._active)
+                    item.qpi.attempts += 1
+                    return item.qpi
+                if self._closed:
+                    return None
+                # Wake up when the earliest backoff expires, a pod arrives,
+                # or the caller's timeout passes.
+                waits = []
+                if self._backoff:
+                    waits.append(max(self._backoff[0][0] - self._clock(), 0.0))
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None
+                    waits.append(remaining)
+                self._cond.wait(timeout=min(waits) if waits else None)
+
+    def add_unschedulable(self, qpi: QueuedPodInfo, message: str = "") -> None:
+        """Park a pod that failed a cycle. It re-enters the active queue
+        after backoff (cheap retry loop) AND on any cluster event via
+        ``move_all_to_active`` (the upstream event-driven path)."""
+        qpi.unschedulable_message = message
+        with self._cond:
+            ready_at = self._clock() + qpi.backoff_seconds()
+            heapq.heappush(self._backoff, (ready_at, next(self._seq), qpi))
+            self._cond.notify()
+
+    def park_unresolvable(self, qpi: QueuedPodInfo, message: str = "") -> None:
+        """Park a pod whose failure retries cannot fix (e.g. malformed
+        labels): no backoff retry loop — it returns to the active queue only
+        on an explicit cluster event (``move_all_to_active``), mirroring the
+        upstream UnschedulableAndUnresolvable pool semantics."""
+        qpi.unschedulable_message = message
+        with self._lock:
+            self._unschedulable[qpi.pod.key] = qpi
+
+    def move_all_to_active(self) -> None:
+        """Cluster changed (node/metrics/pod event): retry everything now."""
+        with self._cond:
+            for _, _, qpi in self._backoff:
+                self._push_active(qpi)
+            self._backoff.clear()
+            for qpi in self._unschedulable.values():
+                self._push_active(qpi)
+            self._unschedulable.clear()
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

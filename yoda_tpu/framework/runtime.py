@@ -1,0 +1,278 @@
+"""Framework runtime: drives plugins through the extension points and owns
+the Permit waitlist.
+
+Semantics follow the modern upstream framework runtime:
+- Filter: every FilterPlugin must succeed for a node to be feasible.
+- Score: each ScorePlugin's raw scores are normalized by its ``normalize``
+  then summed across plugins.
+- Reserve: runs in plugin order; on failure, already-reserved plugins are
+  unreserved in reverse order.
+- Permit: any WAIT parks the pod on the waitlist; approval requires every
+  waiting plugin to allow; rejection or timeout unreserves.
+
+The batch fast path (``BatchFilterScorePlugin``) replaces the per-node
+filter/score loops with one fused computation — the TPU-native fix for the
+reference's O(nodes) per-pod round-trips (reference pkg/yoda/scheduler.go:70,108).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import (
+    BatchFilterScorePlugin,
+    BindPlugin,
+    Code,
+    FilterPlugin,
+    NodeInfo,
+    PermitPlugin,
+    Plugin,
+    PostFilterPlugin,
+    PreFilterPlugin,
+    PreScorePlugin,
+    QueueSortPlugin,
+    ReservePlugin,
+    ScorePlugin,
+    Snapshot,
+    Status,
+)
+
+
+class WaitingPod:
+    """A pod parked at Permit (gang members wait here until the gang is
+    complete). Thread-safe; resolution fires ``on_resolved`` exactly once."""
+
+    def __init__(
+        self,
+        pod: PodSpec,
+        node_name: str,
+        state: CycleState,
+        pending_plugins: set[str],
+        deadline: float,
+        on_resolved: Callable[["WaitingPod", Status], None],
+    ) -> None:
+        self.pod = pod
+        self.node_name = node_name
+        self.state = state
+        self.deadline = deadline
+        self._pending = set(pending_plugins)
+        self._on_resolved = on_resolved
+        self._lock = threading.Lock()
+        self._resolved: Status | None = None
+
+    @property
+    def resolved(self) -> Status | None:
+        with self._lock:
+            return self._resolved
+
+    def allow(self, plugin_name: str) -> None:
+        fire = False
+        with self._lock:
+            if self._resolved is not None:
+                return
+            self._pending.discard(plugin_name)
+            if not self._pending:
+                self._resolved = Status.ok()
+                fire = True
+        if fire:
+            self._on_resolved(self, Status.ok())
+
+    def reject(self, message: str) -> None:
+        with self._lock:
+            if self._resolved is not None:
+                return
+            self._resolved = Status.unschedulable(message)
+        self._on_resolved(self, Status.unschedulable(message))
+
+
+class Framework:
+    def __init__(self, plugins: Sequence[Plugin]) -> None:
+        self.queue_sort = next(
+            (p for p in plugins if isinstance(p, QueueSortPlugin)), None
+        )
+        self.pre_filter_plugins = [p for p in plugins if isinstance(p, PreFilterPlugin)]
+        self.filter_plugins = [p for p in plugins if isinstance(p, FilterPlugin)]
+        self.post_filter_plugins = [p for p in plugins if isinstance(p, PostFilterPlugin)]
+        self.pre_score_plugins = [p for p in plugins if isinstance(p, PreScorePlugin)]
+        self.score_plugins = [p for p in plugins if isinstance(p, ScorePlugin)]
+        self.batch_plugins = [p for p in plugins if isinstance(p, BatchFilterScorePlugin)]
+        self.reserve_plugins = [p for p in plugins if isinstance(p, ReservePlugin)]
+        self.permit_plugins = [p for p in plugins if isinstance(p, PermitPlugin)]
+        self.bind_plugins = [p for p in plugins if isinstance(p, BindPlugin)]
+        self._waiting: dict[str, WaitingPod] = {}
+        self._waiting_lock = threading.Lock()
+
+    # --- filter / score ---
+
+    def run_pre_filter(self, state: CycleState, pod: PodSpec, snapshot: Snapshot) -> Status:
+        for p in self.pre_filter_plugins:
+            st = p.pre_filter(state, pod, snapshot)
+            if not st.success and st.code != Code.SKIP:
+                return st
+        return Status.ok()
+
+    def run_filters(
+        self, state: CycleState, pod: PodSpec, snapshot: Snapshot
+    ) -> dict[str, Status]:
+        statuses: dict[str, Status] = {}
+        for node in snapshot.infos():
+            st = Status.ok()
+            for p in self.filter_plugins:
+                st = p.filter(state, pod, node)
+                if not st.success:
+                    break
+            statuses[node.name] = st
+        return statuses
+
+    def run_batch_filter_score(
+        self, state: CycleState, pod: PodSpec, snapshot: Snapshot
+    ) -> tuple[dict[str, Status], dict[str, int]] | None:
+        """Fused fast path; None when no batch plugin is registered."""
+        if not self.batch_plugins:
+            return None
+        statuses: dict[str, Status] = {n: Status.ok() for n in snapshot.names()}
+        totals: dict[str, int] = {n: 0 for n in snapshot.names()}
+        for p in self.batch_plugins:
+            p_statuses, p_scores = p.filter_and_score_batch(state, pod, snapshot)
+            for n, st in p_statuses.items():
+                if not st.success and statuses[n].success:
+                    statuses[n] = st
+            for n, s in p_scores.items():
+                totals[n] += s
+        feasible_scores = {n: totals[n] for n, st in statuses.items() if st.success}
+        return statuses, feasible_scores
+
+    def run_post_filter(
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        snapshot: Snapshot,
+        statuses: Mapping[str, Status],
+    ) -> tuple[str | None, Status]:
+        for p in self.post_filter_plugins:
+            nominated, st = p.post_filter(state, pod, snapshot, statuses)
+            if st.success and nominated:
+                return nominated, st
+            if st.code == Code.ERROR:
+                return None, st
+        return None, Status.unschedulable("no postfilter plugin could make room")
+
+    def run_pre_score(
+        self, state: CycleState, pod: PodSpec, snapshot: Snapshot, feasible: Sequence[str]
+    ) -> Status:
+        for p in self.pre_score_plugins:
+            st = p.pre_score(state, pod, snapshot, feasible)
+            if not st.success and st.code != Code.SKIP:
+                return st
+        return Status.ok()
+
+    def run_scores(
+        self, state: CycleState, pod: PodSpec, snapshot: Snapshot, feasible: Sequence[str]
+    ) -> tuple[dict[str, int], Status]:
+        totals: dict[str, int] = {n: 0 for n in feasible}
+        for p in self.score_plugins:
+            raw: dict[str, int] = {}
+            for n in feasible:
+                s, st = p.score(state, pod, snapshot.get(n))
+                if not st.success:
+                    return {}, st
+                raw[n] = s
+            st = p.normalize(state, pod, raw)
+            if not st.success:
+                return {}, st
+            for n, s in raw.items():
+                totals[n] += s
+        return totals, Status.ok()
+
+    # --- reserve / permit / bind ---
+
+    def run_reserve(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        done: list[ReservePlugin] = []
+        for p in self.reserve_plugins:
+            st = p.reserve(state, pod, node_name)
+            if not st.success:
+                for q in reversed(done):
+                    q.unreserve(state, pod, node_name)
+                return st
+            done.append(p)
+        return Status.ok()
+
+    def run_unreserve(self, state: CycleState, pod: PodSpec, node_name: str) -> None:
+        for p in reversed(self.reserve_plugins):
+            p.unreserve(state, pod, node_name)
+
+    def run_permit(
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        node_name: str,
+        on_resolved: Callable[[WaitingPod, Status], None],
+        *,
+        now: float | None = None,
+    ) -> Status:
+        """Runs Permit plugins. On WAIT, registers a WaitingPod and returns
+        WAIT; ``on_resolved`` fires (possibly on another thread, possibly
+        re-entrantly from a later permit call) once it is allowed/rejected."""
+        waiting_plugins: set[str] = set()
+        max_timeout = 0.0
+        for p in self.permit_plugins:
+            st, timeout = p.permit(state, pod, node_name)
+            if st.code == Code.WAIT:
+                waiting_plugins.add(p.name)
+                max_timeout = max(max_timeout, timeout)
+            elif not st.success:
+                return st
+        if not waiting_plugins:
+            return Status.ok()
+        now = time.monotonic() if now is None else now
+        wp = WaitingPod(
+            pod,
+            node_name,
+            state,
+            waiting_plugins,
+            deadline=now + max_timeout,
+            on_resolved=lambda w, s: self._finish_waiting(w, s, on_resolved),
+        )
+        with self._waiting_lock:
+            self._waiting[pod.key] = wp
+        # A permit plugin may have been waiting for exactly this pod (last
+        # gang member): give plugins a chance to flush now it is registered.
+        for p in self.permit_plugins:
+            post = getattr(p, "on_pod_waiting", None)
+            if post is not None:
+                post(self, wp)
+        return Status.wait()
+
+    def _finish_waiting(
+        self, wp: WaitingPod, status: Status, cb: Callable[[WaitingPod, Status], None]
+    ) -> None:
+        with self._waiting_lock:
+            self._waiting.pop(wp.pod.key, None)
+        cb(wp, status)
+
+    def waiting_pods(self) -> list[WaitingPod]:
+        with self._waiting_lock:
+            return list(self._waiting.values())
+
+    def get_waiting_pod(self, pod_key: str) -> WaitingPod | None:
+        with self._waiting_lock:
+            return self._waiting.get(pod_key)
+
+    def expire_waiting(self, *, now: float | None = None) -> int:
+        """Reject waiting pods past their Permit deadline. Returns count."""
+        now = time.monotonic() if now is None else now
+        expired = [w for w in self.waiting_pods() if now >= w.deadline]
+        for w in expired:
+            w.reject(f"permit wait timed out for pod {w.pod.key}")
+        return len(expired)
+
+    def run_bind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        for p in self.bind_plugins:
+            st = p.bind(state, pod, node_name)
+            if st.code != Code.SKIP:
+                return st
+        return Status.error(f"no bind plugin bound pod {pod.key}")

@@ -1,0 +1,232 @@
+"""The scheduling loop: pop → cycle → reserve → permit → bind.
+
+The from-scratch equivalent of the upstream scheduleOne driver the reference
+inherits via ``app.NewSchedulerCommand`` (reference pkg/register/register.go:10).
+One scheduling cycle is serialized (as upstream); Permit waits do NOT block
+the loop — waiting pods park on the framework waitlist and are bound from the
+resolution callback (gang scheduling, SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import (
+    Code,
+    MAX_NODE_SCORE,
+    Snapshot,
+    Status,
+    summarize_failure,
+)
+from yoda_tpu.framework.queue import QueuedPodInfo, SchedulingQueue
+from yoda_tpu.framework.runtime import Framework, WaitingPod
+
+
+@dataclass
+class ScheduleResult:
+    pod_key: str
+    outcome: str  # "bound" | "waiting" | "unschedulable" | "error" | "nominated"
+    node: str | None = None
+    message: str = ""
+    latency_s: float = 0.0
+
+
+@dataclass
+class SchedulerStats:
+    results: list[ScheduleResult] = field(default_factory=list)
+    binds: int = 0
+    preempt_nominations: int = 0
+
+    def latencies(self) -> list[float]:
+        return [r.latency_s for r in self.results]
+
+
+class Scheduler:
+    def __init__(
+        self,
+        framework: Framework,
+        snapshot_fn: Callable[[], Snapshot],
+        queue: SchedulingQueue,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        on_bound: Callable[[PodSpec, str], None] | None = None,
+        on_unschedulable: Callable[[PodSpec, str], None] | None = None,
+    ) -> None:
+        self.framework = framework
+        self.snapshot_fn = snapshot_fn
+        self.queue = queue
+        self.clock = clock
+        self.stats = SchedulerStats()
+        self.on_bound = on_bound
+        self.on_unschedulable = on_unschedulable
+        self._lock = threading.Lock()
+
+    # --- one pod ---
+
+    def schedule_one(self, qpi: QueuedPodInfo) -> ScheduleResult:
+        pod = qpi.pod
+        t0 = self.clock()
+        state = CycleState()
+        snapshot = self.snapshot_fn()
+
+        def done(
+            outcome: str,
+            node: str | None = None,
+            message: str = "",
+            *,
+            unresolvable: bool = False,
+        ) -> ScheduleResult:
+            r = ScheduleResult(pod.key, outcome, node, message, self.clock() - t0)
+            with self._lock:
+                self.stats.results.append(r)
+            if outcome == "unschedulable":
+                if unresolvable:
+                    self.queue.park_unresolvable(qpi, message)
+                else:
+                    self.queue.add_unschedulable(qpi, message)
+                if self.on_unschedulable:
+                    self.on_unschedulable(pod, message)
+            elif outcome == "nominated":
+                # Preemption made room; victims must terminate before the pod
+                # fits, so requeue and let the next cycle place it.
+                self.queue.add_unschedulable(qpi, message)
+                with self._lock:
+                    self.stats.preempt_nominations += 1
+            return r
+
+        st = self.framework.run_pre_filter(state, pod, snapshot)
+        if not st.success:
+            return done(
+                "unschedulable",
+                message=st.message,
+                unresolvable=st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE,
+            )
+
+        # Fused batch filter+score (TPU-native hot path), else per-node loops.
+        batch = self.framework.run_batch_filter_score(state, pod, snapshot)
+        if batch is not None:
+            statuses, batch_scores = batch
+            feasible = sorted(batch_scores)
+        else:
+            statuses = self.framework.run_filters(state, pod, snapshot)
+            batch_scores = {}
+            feasible = sorted(n for n, s in statuses.items() if s.success)
+
+        if not feasible:
+            nominated, pf_st = self.framework.run_post_filter(state, pod, snapshot, statuses)
+            if nominated:
+                return done("nominated", node=nominated, message=pf_st.message)
+            return done("unschedulable", message=summarize_failure(statuses))
+
+        st = self.framework.run_pre_score(state, pod, snapshot, feasible)
+        if not st.success:
+            return done("error", message=st.message)
+
+        totals, st = self.framework.run_scores(state, pod, snapshot, feasible)
+        if not st.success:
+            return done("error", message=st.message)
+        if batch_scores:
+            normalized = _normalize(batch_scores)
+            for n in feasible:
+                totals[n] = totals.get(n, 0) + normalized[n]
+
+        best = max(feasible, key=lambda n: (totals.get(n, 0), n))
+
+        st = self.framework.run_reserve(state, pod, best)
+        if not st.success:
+            return done("unschedulable", node=best, message=st.message)
+
+        st = self.framework.run_permit(
+            state, pod, best, self._on_permit_resolved, now=self.clock()
+        )
+        if st.code == Code.WAIT:
+            return done("waiting", node=best)
+        if not st.success:
+            self.framework.run_unreserve(state, pod, best)
+            return done("unschedulable", node=best, message=st.message)
+
+        return self._bind(state, qpi, pod, best, done)
+
+    def _bind(self, state, qpi, pod, node_name, done) -> ScheduleResult:
+        st = self.framework.run_bind(state, pod, node_name)
+        if not st.success:
+            self.framework.run_unreserve(state, pod, node_name)
+            return done("unschedulable", node=node_name, message=st.message)
+        with self._lock:
+            self.stats.binds += 1
+        if self.on_bound:
+            self.on_bound(pod, node_name)
+        self.queue.move_all_to_active()  # cluster changed: retry parked pods
+        return done("bound", node=node_name)
+
+    def _on_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
+        """Fires when a waiting pod is allowed (bind it) or rejected
+        (roll back its reservation and requeue)."""
+        pod = wp.pod
+        if status.success:
+            st = self.framework.run_bind(wp.state, pod, wp.node_name)
+            if st.success:
+                with self._lock:
+                    self.stats.binds += 1
+                if self.on_bound:
+                    self.on_bound(pod, wp.node_name)
+                self.queue.move_all_to_active()
+                return
+            status = st
+        self.framework.run_unreserve(wp.state, pod, wp.node_name)
+        self.queue.add_unschedulable(QueuedPodInfo(pod=pod), status.message)
+        if self.on_unschedulable:
+            self.on_unschedulable(pod, status.message)
+
+    # --- the loop ---
+
+    def run_until_idle(self, *, max_wall_s: float = 30.0, settle_s: float = 0.01) -> None:
+        """Drain the queue, resolving Permit waits and expirations, until no
+        active work remains or ``max_wall_s`` passes. Test/demo driver; the
+        production loop is ``serve_forever``."""
+        deadline = time.monotonic() + max_wall_s
+        binds_at_drain = -1  # binds count when the queue last went inactive
+        while time.monotonic() < deadline:
+            qpi = self.queue.pop(timeout=0.0)
+            if qpi is not None:
+                self.schedule_one(qpi)
+                continue
+            self.framework.expire_waiting(now=self.clock())
+            if self.framework.waiting_pods():
+                time.sleep(settle_s)
+                continue
+            if self.queue.pending_retry_count() == 0:
+                return
+            # Only backoff pods remain. Retrying them is useful only if the
+            # cluster changed (a bind) since their last attempt; otherwise
+            # this is a fixed point — leave them to the event-driven path.
+            if self.stats.binds == binds_at_drain:
+                return
+            binds_at_drain = self.stats.binds
+            self.queue.move_all_to_active()
+
+    def serve_forever(self, stop: threading.Event, *, poll_s: float = 0.5) -> None:
+        while not stop.is_set():
+            qpi = self.queue.pop(timeout=poll_s)
+            self.framework.expire_waiting(now=self.clock())
+            if qpi is not None:
+                self.schedule_one(qpi)
+
+
+def _normalize(scores: dict[str, int]) -> dict[str, int]:
+    """Min-max rescale to [0, MAX_NODE_SCORE] — parity with the reference's
+    NormalizeScore including the all-equal guard (reference
+    pkg/yoda/scheduler.go:136-144)."""
+    if not scores:
+        return {}
+    lowest, highest = min(scores.values()), max(scores.values())
+    if highest == lowest:
+        lowest -= 1
+    return {
+        n: (s - lowest) * MAX_NODE_SCORE // (highest - lowest) for n, s in scores.items()
+    }

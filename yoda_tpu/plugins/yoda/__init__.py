@@ -1,0 +1,38 @@
+"""The yoda-tpu plugin set: the TPU-native re-design of the reference's
+``pkg/yoda`` plugin (reference pkg/yoda/scheduler.go:43-171).
+
+Extension-point mapping (reference → here, on modern framework semantics):
+
+    Less (QueueSort)            -> sort.YodaSort
+    Filter                      -> filter_plugin.YodaPreFilter + YodaFilter
+    PostFilter (v1alpha1 = pre- -> collection.YodaPreScore (the v1alpha1
+      scoring data collection)     "PostFilter" is the modern PreScore;
+                                   SURVEY.md §3.2)
+    Score + NormalizeScore      -> score.YodaScore
+    (absent in reference)       -> accounting.ChipAccountant (Reserve),
+                                   gang.GangPlugin (PreFilter+Permit),
+                                   topology. / preemption. (PostFilter)
+"""
+
+from yoda_tpu.plugins.yoda.sort import YodaSort
+from yoda_tpu.plugins.yoda.filter_plugin import (
+    YodaFilter,
+    YodaPreFilter,
+    REQUEST_KEY,
+    get_request,
+)
+from yoda_tpu.plugins.yoda.collection import MaxValueData, YodaPreScore, MAX_KEY
+from yoda_tpu.plugins.yoda.score import YodaScore, Weights
+
+__all__ = [
+    "YodaSort",
+    "YodaFilter",
+    "YodaPreFilter",
+    "YodaPreScore",
+    "YodaScore",
+    "MaxValueData",
+    "Weights",
+    "REQUEST_KEY",
+    "MAX_KEY",
+    "get_request",
+]

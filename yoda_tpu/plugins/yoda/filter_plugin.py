@@ -1,0 +1,184 @@
+"""Feasibility predicates and the Filter/PreFilter plugins.
+
+Parity with reference pkg/yoda/filter/filter.go:11-58, with the documented
+fixes (SURVEY.md §3.4):
+
+- ``PodFitsNumber``  -> ``pod_fits_chips``   (chip count; the reference counted
+  ALL cards including unhealthy ones via ``Status.CardNumber``, filter.go:13 —
+  here only healthy chips count)
+- ``PodFitsMemory``  -> ``pod_fits_hbm``     (>= N chips with enough free HBM)
+- ``PodFitsClock``   -> ``pod_fits_clock``   (>= N chips at >= clock; the
+  reference demanded EXACT equality in Filter, filter.go:57, while its own
+  score path used >=, algorithm.go:49 — unified to >= here)
+- label parsing moved to PreFilter, done ONCE per pod (the reference re-parsed
+  labels per node per predicate) and strict (silent-zero fixed).
+
+Reservation awareness is net-new: the filter subtracts chips already
+reserved by in-flight pods (the reference had no accounting and could
+double-book a card between sniffer refreshes, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from yoda_tpu.api.requests import LabelParseError, TpuRequest, parse_request
+from yoda_tpu.api.types import TpuChip, TpuNodeMetrics
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import (
+    FilterPlugin,
+    NodeInfo,
+    PreFilterPlugin,
+    Snapshot,
+    Status,
+)
+from yoda_tpu.api.types import PodSpec
+
+REQUEST_KEY = "yoda-tpu/request"
+
+
+@dataclass
+class RequestData:
+    """CycleState carrier for the parsed request (immutable)."""
+
+    request: TpuRequest
+
+    def clone(self) -> "RequestData":
+        return self
+
+
+def get_request(state: CycleState) -> TpuRequest:
+    data = state.read(REQUEST_KEY)
+    assert isinstance(data, RequestData)
+    return data.request
+
+
+# --- pure predicates (reference filter.go parity) ---
+
+
+def chip_fits_hbm(hbm: int, chip: TpuChip) -> bool:
+    """Reference ``CardFitsMemory`` (filter.go:52-54)."""
+    return chip.healthy and chip.hbm_free >= hbm
+
+
+def chip_fits_clock(clock_mhz: int, chip: TpuChip) -> bool:
+    """Reference ``CardFitsClock`` (filter.go:56-58), with >= semantics."""
+    return chip.healthy and chip.clock_mhz >= clock_mhz
+
+
+def qualifying_chips(node: TpuNodeMetrics, req: TpuRequest) -> list[TpuChip]:
+    """Healthy chips meeting the per-chip HBM and clock constraints — the
+    chip set both collection and scoring iterate (reference
+    collection.go:45-49, algorithm.go:47-52)."""
+    return [
+        c
+        for c in node.chips
+        if c.healthy and c.hbm_free >= req.hbm_per_chip and c.clock_mhz >= req.min_clock_mhz
+    ]
+
+
+def pod_fits_chips(req: TpuRequest, node: TpuNodeMetrics) -> tuple[bool, int]:
+    """Reference ``PodFitsNumber`` (filter.go:11-16): explicit count must fit;
+    default is "node has at least one (healthy) chip", count 1."""
+    healthy = len(node.healthy_chips())
+    if req.chips is not None:
+        return req.chips <= healthy, req.chips
+    return healthy > 0, 1
+
+
+def pod_fits_hbm(number: int, req: TpuRequest, node: TpuNodeMetrics) -> bool:
+    """Reference ``PodFitsMemory`` (filter.go:18-33): >= ``number`` healthy
+    chips each with enough free HBM."""
+    if req.hbm_per_chip == 0:
+        return True
+    return sum(1 for c in node.chips if chip_fits_hbm(req.hbm_per_chip, c)) >= number
+
+
+def pod_fits_clock(number: int, req: TpuRequest, node: TpuNodeMetrics) -> bool:
+    """Reference ``PodFitsClock`` (filter.go:35-50) with >= semantics."""
+    if req.min_clock_mhz == 0:
+        return True
+    return sum(1 for c in node.chips if chip_fits_clock(req.min_clock_mhz, c)) >= number
+
+
+# --- plugins ---
+
+
+class YodaPreFilter(PreFilterPlugin):
+    """Parses the pod's tpu/* labels once per cycle into CycleState.
+    Malformed labels are UnschedulableAndUnresolvable (retries cannot help),
+    unlike the reference's silent-zero (filter.go:60-74)."""
+
+    name = "yoda-prefilter"
+
+    def pre_filter(self, state: CycleState, pod: PodSpec, snapshot: Snapshot) -> Status:
+        try:
+            req = parse_request(pod.labels)
+        except LabelParseError as e:
+            return Status.unresolvable(f"invalid tpu/* labels: {e}")
+        state.write(REQUEST_KEY, RequestData(req))
+        return Status.ok()
+
+
+class YodaFilter(FilterPlugin):
+    """Per-node feasibility — the reference's Filter hook
+    (pkg/yoda/scheduler.go:66-84) minus its per-node API round-trip: the
+    node's TPU CR arrives on the NodeInfo from the informer snapshot.
+
+    ``reserved_chips_fn`` (injected by the accounting plugin) reports chips
+    already reserved by in-flight pods on a node; ``max_metrics_age_s`` > 0
+    additionally rejects nodes with stale metrics (net-new, SURVEY.md §5).
+    """
+
+    name = "yoda-filter"
+
+    def __init__(
+        self,
+        reserved_chips_fn: Callable[[str], int] | None = None,
+        *,
+        max_metrics_age_s: float = 0.0,
+        now_fn: Callable[[], float] | None = None,
+    ) -> None:
+        self.reserved_chips_fn = reserved_chips_fn
+        self.max_metrics_age_s = max_metrics_age_s
+        self.now_fn = now_fn
+
+    def filter(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> Status:
+        tpu = node.tpu
+        if tpu is None:
+            # Reference: SCV Get error -> Unschedulable (scheduler.go:72-74).
+            return Status.unschedulable(f"node {node.name} has no TPU metrics")
+        if self.max_metrics_age_s > 0:
+            now = self.now_fn() if self.now_fn else None
+            if not tpu.fresh(max_age_s=self.max_metrics_age_s, now=now):
+                return Status.unschedulable(f"node {node.name} TPU metrics are stale")
+
+        req = get_request(state)
+        if req.min_generation_rank and tpu.generation_rank < req.min_generation_rank:
+            return Status.unschedulable(
+                f"node {node.name} generation {tpu.generation} below requested"
+            )
+
+        ok, number = pod_fits_chips(req, tpu)
+        if not ok:
+            return Status.unschedulable(
+                f"node {node.name} has {len(tpu.healthy_chips())} healthy chips, "
+                f"pod needs {number}"
+            )
+        if not pod_fits_hbm(number, req, tpu):
+            return Status.unschedulable(f"node {node.name} lacks free HBM on {number} chips")
+        if not pod_fits_clock(number, req, tpu):
+            return Status.unschedulable(
+                f"node {node.name} lacks {number} chips at >= {req.min_clock_mhz} MHz"
+            )
+
+        if self.reserved_chips_fn is not None:
+            reserved = self.reserved_chips_fn(node.name)
+            available = len(qualifying_chips(tpu, req)) - reserved
+            if available < number:
+                return Status.unschedulable(
+                    f"node {node.name}: {reserved} chips reserved by in-flight pods, "
+                    f"only {max(available, 0)} qualifying chips available"
+                )
+        return Status.ok()

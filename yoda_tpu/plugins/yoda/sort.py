@@ -1,0 +1,31 @@
+"""Queue ordering: strict priority from the ``tpu/priority`` label.
+
+Parity with reference pkg/yoda/sort/sort.go:8-18 (``scv/priority``, default 0,
+higher first), with two deliberate differences: malformed priorities were
+silently 0 there (``strconv.Atoi`` error ignored, sort.go:14) — here the
+strict parse happened at admission, so by queue time the label is valid — and
+equal priorities fall back to FIFO arrival order (the queue's tiebreak)
+instead of Go-heap-arbitrary order.
+"""
+
+from __future__ import annotations
+
+from yoda_tpu.api import requests
+from yoda_tpu.framework.interfaces import QueuedPodLike, QueueSortPlugin
+
+
+def pod_priority(pod_labels: dict[str, str]) -> int:
+    raw = pod_labels.get(requests.PRIORITY)
+    if raw is None:
+        return 0
+    try:
+        return int(raw.strip())
+    except ValueError:
+        return 0  # defensive only; strict parse rejects these at admission
+
+
+class YodaSort(QueueSortPlugin):
+    name = "yoda-sort"
+
+    def less(self, a: QueuedPodLike, b: QueuedPodLike) -> bool:
+        return pod_priority(a.pod.labels) > pod_priority(b.pod.labels)
