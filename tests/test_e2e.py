@@ -1,0 +1,201 @@
+"""End-to-end tests over the full stack (fake cluster + fake agent + informer
++ scheduler): the BASELINE config matrix, configs 1-3.
+
+Config 1: single pod, 1-node cluster with fake TPU CR (reference
+example/test-pod.yaml analog). Config 2: single JAX pod, tpu/chips=1, one
+v5e-1 node. Config 3: bin-packing 4 pods x 2 chips onto one v5e-8 host.
+"""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster import FakeCluster
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestBaselineConfig1And2:
+    def test_single_pod_single_node(self, mode):
+        # Config 1: the reference smoke test (readme.md:27-40) — a pod
+        # requesting per-chip memory lands on the only node.
+        stack, agent = make_stack(mode)
+        agent.add_host("kind-node", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("test-pod", labels={"tpu/hbm": "1000"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        pod = stack.cluster.get_pod("default/test-pod")
+        assert pod.node_name == "kind-node"
+        assert pod.phase == "Running"
+
+    def test_single_jax_pod_one_chip(self, mode):
+        # Config 2: tpu/chips=1 on a v5e-1 node.
+        stack, agent = make_stack(mode)
+        agent.add_host("v5e-1-node", generation="v5e", chips=1)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("jax-pod", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/jax-pod").node_name == "v5e-1-node"
+
+    def test_pod_created_before_scheduler_sees_node(self, mode):
+        # Pod arrives first; node metrics arrive later -> event-driven retry.
+        stack, agent = make_stack(mode)
+        stack.cluster.create_pod(PodSpec("early", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/early").node_name is None
+        agent.add_host("late-node", generation="v5e")
+        agent.publish_all()
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/early").node_name == "late-node"
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestBaselineConfig3BinPacking:
+    def test_four_pods_pack_one_host(self, mode):
+        # Config 3: 4 pods x 2 chips onto one v5e-8 host (8 chips total).
+        stack, agent = make_stack(mode)
+        agent.add_host("v5e-8-host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(f"worker-{i}", labels={"tpu/chips": "2", "tpu/hbm": "8Gi"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        for i in range(4):
+            assert stack.cluster.get_pod(f"default/worker-{i}").node_name == "v5e-8-host"
+        assert stack.accountant.chips_in_use("v5e-8-host") == 8
+
+    def test_fifth_pod_does_not_overcommit(self, mode):
+        # The reference would double-book here (no accounting, SURVEY.md §3.3):
+        # all 5 pods pass its filter until the sniffer refreshes. We must
+        # schedule exactly 4 even with NO metrics refresh in between.
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(5):
+            stack.cluster.create_pod(PodSpec(f"w-{i}", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == 4
+        assert stack.accountant.chips_in_use("host") == 8
+
+    def test_chips_free_after_pod_delete(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("a", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(PodSpec("b", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/b").node_name is None  # full
+        stack.cluster.delete_pod("default/a")  # frees chips + triggers retry
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/b").node_name == "host"
+
+    def test_spreads_by_free_capacity(self, mode):
+        # Two hosts; heavier-loaded one scores lower on free-HBM terms.
+        stack, agent = make_stack(mode)
+        agent.add_host("host-a", generation="v5e", chips=8)
+        agent.add_host("host-b", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p0", labels={"tpu/chips": "4", "tpu/hbm": "8Gi"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        first = stack.cluster.get_pod("default/p0").node_name
+        # Agent refresh makes the first host's lower free HBM visible.
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p1", labels={"tpu/chips": "4", "tpu/hbm": "8Gi"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        second = stack.cluster.get_pod("default/p1").node_name
+        assert {first, second} == {"host-a", "host-b"}
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestAccountingMetricsHandoff:
+    def test_no_double_count_after_agent_refresh(self, mode):
+        # Regression: once the agent publishes the running pod's HBM
+        # consumption, its chips must be charged via metrics OR accounting,
+        # never both. 8 chips; A takes 4 (visible in metrics after refresh);
+        # B's 4 must still fit.
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("a", labels={"tpu/chips": "4", "tpu/hbm": "16Gi"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        agent.publish_all()  # A's consumption now visible
+        stack.cluster.create_pod(PodSpec("b", labels={"tpu/chips": "4", "tpu/hbm": "16Gi"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/b").node_name == "host"
+
+    def test_stale_node_rejected_even_with_cached_arrays(self, mode):
+        # Regression: freshness must be re-evaluated per cycle, not frozen
+        # into cached fleet arrays.
+        import time as _time
+
+        stack, agent = make_stack(mode, max_metrics_age_s=0.2)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("fresh-pod", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/fresh-pod").node_name == "host"
+        _time.sleep(0.3)  # agent goes silent; metrics now stale
+        stack.cluster.create_pod(PodSpec("late-pod", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=1)
+        assert stack.cluster.get_pod("default/late-pod").node_name is None
+
+
+class TestForeignPods:
+    def test_foreign_non_tpu_pod_holds_no_chips(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        daemon = PodSpec("kube-proxy", scheduler_name="default-scheduler")
+        daemon.node_name = "host"
+        stack.cluster.create_pod(daemon)
+        assert stack.accountant.chips_in_use("host") == 0
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/p").node_name == "host"
+
+
+class TestRestartStatelessness:
+    def test_accounting_rebuilt_from_bound_pods(self):
+        # SURVEY.md §5 checkpoint row: a new stack over the same cluster
+        # reconstructs chips_in_use from bound pods (watch replay).
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(PodSpec(f"w-{i}", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.accountant.chips_in_use("host") == 6
+
+        from yoda_tpu.standalone import build_stack as rebuild
+
+        stack2 = rebuild(cluster=stack.cluster)
+        assert stack2.accountant.chips_in_use("host") == 6
+        stack2.cluster.create_pod(PodSpec("late", labels={"tpu/chips": "4"}))
+        stack2.scheduler.run_until_idle(max_wall_s=5)
+        bound = stack2.cluster.get_pod("default/late")
+        assert bound.node_name is None  # only 2 chips left
+
+
+class TestUnhealthyChips:
+    def test_unhealthy_chips_reduce_capacity(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.set_chip_health("host", 0, False)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "4"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/p").node_name is None
+        agent.set_chip_health("host", 0, True)
+        agent.publish_all()
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/p").node_name == "host"

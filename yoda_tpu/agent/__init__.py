@@ -1,0 +1,15 @@
+"""Node metrics agents: publishers of per-node TpuNodeMetrics CRs.
+
+The replacement for the reference's external "SCV sniffer" DaemonSet
+(reference readme.md:9-15; SURVEY.md §1-L5): on each node an agent reads TPU
+hardware state and writes the node's CR. Two implementations:
+
+- ``FakeTpuAgent``: synthetic fleets for tests/benchmarks/e2e (the
+  BASELINE "fake SCV CR" strategy) with simulated HBM consumption.
+- ``native``: ctypes bindings over the C++ host metrics reader
+  (yoda_tpu/agent/native.py, native/ sources) for real nodes.
+"""
+
+from yoda_tpu.agent.fake_publisher import CHIP_SPECS, ChipSpec, FakeTpuAgent
+
+__all__ = ["CHIP_SPECS", "ChipSpec", "FakeTpuAgent"]

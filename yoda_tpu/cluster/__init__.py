@@ -1,0 +1,18 @@
+"""Cluster backends: the in-memory fake API server and the informer cache.
+
+The reference talks to a real Kubernetes API server through an UNCACHED
+controller-runtime client — one HTTP round-trip per node per pod in both
+Filter and Score plus a full List per pod (reference pkg/yoda/scheduler.go:70,
+88,108; SURVEY.md §2 "Distributed communication backend"). The redesign:
+a watch-driven informer cache is the only reader; scheduling cycles see a
+consistent snapshot and never touch the API server (SURVEY.md §7 step 2).
+
+``FakeCluster`` plays the API server for tests, demos, and benchmarks — the
+"1-node kind cluster with fake SCV CR" strategy of BASELINE config 1 without
+kind. A real-cluster client would implement the same watch interface.
+"""
+
+from yoda_tpu.cluster.fake import Event, FakeCluster
+from yoda_tpu.cluster.informer import InformerCache
+
+__all__ = ["Event", "FakeCluster", "InformerCache"]

@@ -1,0 +1,106 @@
+"""In-memory fake API server with watch semantics.
+
+Stores pods and TpuNodeMetrics CRs, delivers create/update/delete events to
+watchers synchronously (the informer), and implements pod binding — the
+subset of the Kubernetes API the scheduler touches. Thread-safe.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Literal
+
+from yoda_tpu.api.types import PodSpec, TpuNodeMetrics
+
+EventType = Literal["added", "modified", "deleted"]
+
+
+@dataclass(frozen=True)
+class Event:
+    type: EventType
+    kind: str  # "Pod" | "TpuNodeMetrics"
+    obj: object
+
+
+class FakeCluster:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._pods: dict[str, PodSpec] = {}
+        self._tpus: dict[str, TpuNodeMetrics] = {}
+        self._watchers: list[Callable[[Event], None]] = []
+        self._rv = 0
+
+    # --- watch ---
+
+    def add_watcher(self, fn: Callable[[Event], None], *, replay: bool = True) -> None:
+        """Register a watcher; with ``replay`` it first receives synthetic
+        'added' events for existing objects (list-then-watch semantics)."""
+        with self._lock:
+            self._watchers.append(fn)
+            if replay:
+                for tpu in self._tpus.values():
+                    fn(Event("added", "TpuNodeMetrics", tpu))
+                for pod in self._pods.values():
+                    fn(Event("added", "Pod", pod))
+
+    def _emit(self, event: Event) -> None:
+        for fn in list(self._watchers):
+            fn(event)
+
+    # --- pods ---
+
+    def create_pod(self, pod: PodSpec) -> PodSpec:
+        with self._lock:
+            if pod.key in self._pods:
+                raise ValueError(f"pod {pod.key} already exists")
+            self._pods[pod.key] = pod
+            self._emit(Event("added", "Pod", pod))
+            return pod
+
+    def bind_pod(self, pod_key: str, node_name: str) -> None:
+        """The pods/binding subresource (upstream default binding POSTs this,
+        SURVEY.md §3.2 [bind])."""
+        with self._lock:
+            pod = self._pods[pod_key]
+            if pod.node_name is not None and pod.node_name != node_name:
+                raise ValueError(
+                    f"pod {pod_key} already bound to {pod.node_name}"
+                )
+            pod.node_name = node_name
+            pod.phase = "Running"
+            self._emit(Event("modified", "Pod", pod))
+
+    def delete_pod(self, pod_key: str) -> None:
+        with self._lock:
+            pod = self._pods.pop(pod_key, None)
+            if pod is not None:
+                self._emit(Event("deleted", "Pod", pod))
+
+    def get_pod(self, pod_key: str) -> PodSpec | None:
+        with self._lock:
+            return self._pods.get(pod_key)
+
+    def list_pods(self) -> list[PodSpec]:
+        with self._lock:
+            return list(self._pods.values())
+
+    # --- TpuNodeMetrics CRs (written by the node agent) ---
+
+    def put_tpu_metrics(self, tpu: TpuNodeMetrics) -> None:
+        with self._lock:
+            self._rv += 1
+            tpu.resource_version = self._rv
+            is_new = tpu.name not in self._tpus
+            self._tpus[tpu.name] = tpu
+            self._emit(Event("added" if is_new else "modified", "TpuNodeMetrics", tpu))
+
+    def delete_tpu_metrics(self, name: str) -> None:
+        with self._lock:
+            tpu = self._tpus.pop(name, None)
+            if tpu is not None:
+                self._emit(Event("deleted", "TpuNodeMetrics", tpu))
+
+    def list_tpu_metrics(self) -> list[TpuNodeMetrics]:
+        with self._lock:
+            return list(self._tpus.values())

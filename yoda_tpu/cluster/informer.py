@@ -1,0 +1,144 @@
+"""Informer cache: watch-driven local store + per-cycle snapshots.
+
+The fix for the reference's hot-loop (SURVEY.md §3.2 ★, §7 step 2): the
+scheduler reads ONLY this cache during a cycle. The cache maintains:
+
+- the TpuNodeMetrics CR per node (watch on the CRD, replacing per-cycle Gets),
+- the pods bound to each node (for allocation scoring, reference
+  pkg/yoda/score/algorithm.go:77-80),
+- incrementally-maintained claimed-HBM per node,
+- two monotonic versions: ``version`` (any change — snapshot cache key) and
+  ``metrics_version`` (TPU CR changes only — fleet-array cache key, so pod
+  binds do not force an O(nodes x chips) array rebuild).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.api.types import PodSpec, TpuNodeMetrics
+from yoda_tpu.cluster.fake import Event
+from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+
+MIB = 1 << 20
+
+
+class InformerCache:
+    def __init__(
+        self,
+        *,
+        scheduler_name: str = "yoda-tpu",
+        on_pod_pending: Callable[[PodSpec], None] | None = None,
+        on_change: Callable[[Event], None] | None = None,
+    ) -> None:
+        self.scheduler_name = scheduler_name
+        self.on_pod_pending = on_pod_pending
+        self.on_change = on_change
+        self._lock = threading.RLock()
+        self._tpus: dict[str, TpuNodeMetrics] = {}
+        self._pods_by_node: dict[str, dict[str, PodSpec]] = {}
+        self._claimed_mib: dict[str, int] = {}
+        # pod uid -> (node counted on, claim MiB added) — the stored claim is
+        # subtracted on uncount so later label mutations cannot skew totals.
+        self._pod_nodes: dict[str, tuple[str, int]] = {}
+        self._version = 1
+        self._metrics_version = 1
+        self._snapshot_cache: Snapshot | None = None
+
+    # --- watch sink ---
+
+    def handle(self, event: Event) -> None:
+        if event.kind == "TpuNodeMetrics":
+            self._handle_tpu(event)
+        elif event.kind == "Pod":
+            self._handle_pod(event)
+        if self.on_change is not None:
+            self.on_change(event)
+
+    def _handle_tpu(self, event: Event) -> None:
+        tpu: TpuNodeMetrics = event.obj  # type: ignore[assignment]
+        with self._lock:
+            if event.type == "deleted":
+                self._tpus.pop(tpu.name, None)
+            else:
+                self._tpus[tpu.name] = tpu
+            self._version += 1
+            self._metrics_version += 1
+            self._snapshot_cache = None
+
+    def _handle_pod(self, event: Event) -> None:
+        pod: PodSpec = event.obj  # type: ignore[assignment]
+        pending = False
+        with self._lock:
+            counted = self._pod_nodes.get(pod.uid)
+            if counted and (event.type == "deleted" or counted[0] != pod.node_name):
+                self._uncount_pod(pod.uid)
+                counted = None
+            if event.type != "deleted" and pod.node_name and counted is None:
+                self._count_pod(pod, pod.node_name)
+            if (
+                event.type == "added"
+                and pod.node_name is None
+                and pod.scheduler_name == self.scheduler_name
+            ):
+                pending = True
+            self._version += 1
+            self._snapshot_cache = None
+        if pending and self.on_pod_pending is not None:
+            self.on_pod_pending(pod)
+
+    def _count_pod(self, pod: PodSpec, node: str) -> None:
+        claim = _pod_claim_mib(pod)
+        self._pods_by_node.setdefault(node, {})[pod.uid] = pod
+        self._pod_nodes[pod.uid] = (node, claim)
+        self._claimed_mib[node] = self._claimed_mib.get(node, 0) + claim
+
+    def _uncount_pod(self, uid: str) -> None:
+        node, claim = self._pod_nodes.pop(uid)
+        self._pods_by_node.get(node, {}).pop(uid, None)
+        self._claimed_mib[node] = max(self._claimed_mib.get(node, 0) - claim, 0)
+
+    # --- readers ---
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    @property
+    def metrics_version(self) -> int:
+        with self._lock:
+            return self._metrics_version
+
+    def claimed_hbm_mib(self, node_name: str) -> int:
+        with self._lock:
+            return self._claimed_mib.get(node_name, 0)
+
+    def snapshot(self) -> Snapshot:
+        """Consistent view for one scheduling cycle. Cached until the next
+        watch event; NodeInfo pod lists are copies, safe across threads."""
+        with self._lock:
+            if self._snapshot_cache is not None:
+                return self._snapshot_cache
+            nodes = {
+                name: NodeInfo(
+                    name=name,
+                    tpu=tpu,
+                    pods=list(self._pods_by_node.get(name, {}).values()),
+                )
+                for name, tpu in self._tpus.items()
+            }
+            snap = Snapshot(nodes, version=self._version)
+            snap.metrics_version = self._metrics_version
+            self._snapshot_cache = snap
+            return snap
+
+
+def _pod_claim_mib(pod: PodSpec) -> int:
+    try:
+        r = parse_request(pod.labels)
+    except LabelParseError:
+        return 0
+    return (r.hbm_per_chip // MIB) * r.effective_chips

@@ -1,0 +1,62 @@
+"""Scheduler configuration knobs.
+
+The reference hard-coded its scoring weights as compile-time consts
+(reference pkg/yoda/score/algorithm.go:17-27) and decoded-but-ignored its
+plugin args (scheduler.go:38-41,55-58). Here the weights and operational
+knobs are real configuration (SURVEY.md §5 config row), loadable from the
+scheduler config YAML (deploy/) and validated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+
+
+@dataclass(frozen=True)
+class Weights:
+    """Score weights — reference consts parity (algorithm.go:17-27):
+    Bandwidth/Clock/Core/Power 1, FreeMemory 2, TotalMemory 1, Actual 2,
+    Allocate 2, with Core->tflops and the memory terms renamed to HBM."""
+
+    hbm_bandwidth: int = 1
+    clock: int = 1
+    tflops: int = 1
+    power: int = 1
+    hbm_free: int = 2
+    hbm_total: int = 1
+    actual: int = 2
+    allocate: int = 2
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Weights":
+        known = {f.name for f in fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown weight keys: {sorted(unknown)}")
+        bad = {k: v for k, v in d.items() if not isinstance(v, int) or v < 0}
+        if bad:
+            raise ValueError(f"weights must be non-negative ints: {bad}")
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Top-level plugin configuration (the reference's pluginConfig Args
+    analog, made real)."""
+
+    mode: str = "batch"               # "batch" (fused kernel) | "loop"
+    weights: Weights = field(default_factory=Weights)
+    gang_permit_timeout_s: float = 120.0
+    max_metrics_age_s: float = 0.0    # 0 disables staleness filtering
+    percentage_nodes_to_score: int = 100
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SchedulerConfig":
+        d = dict(d)
+        w = d.pop("weights", None)
+        cfg = cls(**d, weights=Weights.from_dict(w) if w else Weights())
+        if cfg.mode not in ("batch", "loop"):
+            raise ValueError(f"mode must be 'batch' or 'loop', got {cfg.mode!r}")
+        if cfg.gang_permit_timeout_s <= 0:
+            raise ValueError("gang_permit_timeout_s must be positive")
+        return cfg

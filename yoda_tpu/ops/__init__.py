@@ -1,0 +1,36 @@
+"""JAX compute core: the fleet as structure-of-arrays and the fused
+filter+collect+score kernel.
+
+This is the TPU-native redesign of the reference's per-(pod, node) hot path.
+The reference does, per pod: one live API Get per node in Filter
+(reference pkg/yoda/scheduler.go:70), a full SCV List + O(nodes x cards)
+re-scan in collection (scheduler.go:88, collection/collection.go:30-57), and
+another per-node Get in Score (scheduler.go:108) — with the three Fits
+predicates recomputed three times per (pod, node) (SURVEY.md §3.2).
+
+Here the informer snapshot is lowered once per metrics change into padded,
+statically-shaped int32 arrays (``FleetArrays``), and one jitted XLA
+computation evaluates feasibility, cluster maxima, weighted scores, and the
+argmax selection for EVERY node in a single device launch
+(``fused_filter_score``). Under ``yoda_tpu.parallel`` the same kernel shards
+over a device mesh with the maxima becoming collectives.
+"""
+
+from yoda_tpu.ops.arrays import FleetArrays, MIB
+from yoda_tpu.ops.kernel import (
+    KernelRequest,
+    KernelResult,
+    fused_filter_score,
+    REASON_OK,
+    REASON_MESSAGES,
+)
+
+__all__ = [
+    "FleetArrays",
+    "MIB",
+    "KernelRequest",
+    "KernelResult",
+    "fused_filter_score",
+    "REASON_OK",
+    "REASON_MESSAGES",
+]

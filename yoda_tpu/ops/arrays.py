@@ -1,0 +1,196 @@
+"""Fleet metrics as padded structure-of-arrays for the fused kernel.
+
+Shapes are static per (node-bucket, chip-bucket) so XLA compiles once per
+bucket and reuses the executable as the fleet grows. HBM is stored in MiB as
+int32 (2^31 MiB = 2 PiB max — ample) so all score arithmetic is exact integer
+math matching the Python plugin semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from yoda_tpu.framework.interfaces import Snapshot
+
+MIB = 1 << 20
+
+_MIN_NODE_BUCKET = 8
+_MIN_CHIP_BUCKET = 4
+
+
+def _bucket(n: int, minimum: int) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class FleetArrays:
+    """Structure-of-arrays view of the fleet. ``names[i]`` maps row i back to
+    the node; rows >= len(names) are padding (valid=False)."""
+
+    names: list[str]
+    # [N] node-level
+    node_valid: np.ndarray        # bool
+    generation_rank: np.ndarray   # int32
+    fresh: np.ndarray             # bool
+    last_updated: np.ndarray      # float64 unix (for dynamic re-freshness)
+    reserved_chips: np.ndarray    # int32 (chips held by in-flight pods)
+    claimed_hbm_mib: np.ndarray   # int32 (HBM claimed by placed pods' labels)
+    # [N, C] chip-level
+    chip_valid: np.ndarray        # bool (false for padding columns)
+    chip_healthy: np.ndarray      # bool
+    chip_used: np.ndarray         # bool (byte-exact hbm_free < hbm_total)
+    hbm_free_mib: np.ndarray      # int32
+    hbm_total_mib: np.ndarray     # int32
+    clock_mhz: np.ndarray         # int32
+    hbm_bandwidth: np.ndarray     # int32
+    tflops: np.ndarray            # int32
+    power_w: np.ndarray           # int32
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.names)
+
+    @property
+    def padded_shape(self) -> tuple[int, int]:
+        return self.chip_valid.shape
+
+    @classmethod
+    def from_snapshot(
+        cls,
+        snapshot: Snapshot,
+        *,
+        reserved_fn: Callable[[str], int] | None = None,
+        max_metrics_age_s: float = 0.0,
+        now: float | None = None,
+        node_bucket: int | None = None,
+        chip_bucket: int | None = None,
+    ) -> "FleetArrays":
+        """Lower a snapshot. ``reserved_fn`` supplies in-flight reservations
+        (accounting plugin); ``max_metrics_age_s`` > 0 marks stale nodes
+        unfresh (0 = staleness checking disabled, every node fresh)."""
+        import time as _time
+
+        infos = snapshot.infos()
+        names = [ni.name for ni in infos]
+        max_chips = max((ni.tpu.chip_count for ni in infos if ni.tpu), default=0)
+        n_pad = node_bucket or _bucket(max(len(names), 1), _MIN_NODE_BUCKET)
+        c_pad = chip_bucket or _bucket(max(max_chips, 1), _MIN_CHIP_BUCKET)
+        if n_pad < len(names) or c_pad < max_chips:
+            raise ValueError(
+                f"bucket ({n_pad},{c_pad}) too small for fleet "
+                f"({len(names)} nodes, {max_chips} chips)"
+            )
+
+        node_valid = np.zeros(n_pad, dtype=bool)
+        gen = np.zeros(n_pad, dtype=np.int32)
+        fresh = np.zeros(n_pad, dtype=bool)
+        last_updated = np.zeros(n_pad, dtype=np.float64)
+        reserved = np.zeros(n_pad, dtype=np.int32)
+        claimed = np.zeros(n_pad, dtype=np.int32)
+        chip_valid = np.zeros((n_pad, c_pad), dtype=bool)
+        healthy = np.zeros((n_pad, c_pad), dtype=bool)
+        chip_used = np.zeros((n_pad, c_pad), dtype=bool)
+        hbm_free = np.zeros((n_pad, c_pad), dtype=np.int32)
+        hbm_total = np.zeros((n_pad, c_pad), dtype=np.int32)
+        clock = np.zeros((n_pad, c_pad), dtype=np.int32)
+        bw = np.zeros((n_pad, c_pad), dtype=np.int32)
+        tflops = np.zeros((n_pad, c_pad), dtype=np.int32)
+        power = np.zeros((n_pad, c_pad), dtype=np.int32)
+
+        now = _time.time() if now is None else now
+        for i, ni in enumerate(infos):
+            tpu = ni.tpu
+            if tpu is None:
+                continue  # row stays invalid -> never feasible
+            node_valid[i] = True
+            gen[i] = tpu.generation_rank
+            last_updated[i] = tpu.last_updated_unix
+            fresh[i] = (
+                True
+                if max_metrics_age_s <= 0
+                else tpu.fresh(max_age_s=max_metrics_age_s, now=now)
+            )
+            if reserved_fn is not None:
+                reserved[i] = reserved_fn(ni.name)
+            claimed[i] = min(_claimed_hbm_mib(ni), np.iinfo(np.int32).max)
+            for j, chip in enumerate(tpu.chips[:c_pad]):
+                chip_valid[i, j] = True
+                healthy[i, j] = chip.healthy
+                chip_used[i, j] = chip.hbm_free < chip.hbm_total
+                hbm_free[i, j] = chip.hbm_free // MIB
+                hbm_total[i, j] = chip.hbm_total // MIB
+                clock[i, j] = chip.clock_mhz
+                bw[i, j] = chip.hbm_bandwidth_gbps
+                tflops[i, j] = chip.tflops_bf16
+                power[i, j] = chip.power_w
+
+        return cls(
+            names=names,
+            node_valid=node_valid,
+            generation_rank=gen,
+            fresh=fresh,
+            last_updated=last_updated,
+            reserved_chips=reserved,
+            claimed_hbm_mib=claimed,
+            chip_valid=chip_valid,
+            chip_healthy=healthy,
+            chip_used=chip_used,
+            hbm_free_mib=hbm_free,
+            hbm_total_mib=hbm_total,
+            clock_mhz=clock,
+            hbm_bandwidth=bw,
+            tflops=tflops,
+            power_w=power,
+        )
+
+    def with_dynamic(
+        self,
+        reserved_fn: Callable[[str], int] | None,
+        claimed_fn: Callable[[str], int] | None = None,
+        *,
+        max_metrics_age_s: float = 0.0,
+        now: float | None = None,
+    ) -> "FleetArrays":
+        """Cheap per-cycle refresh of the per-node reservation/claim/freshness
+        vectors (the [N, C] chip metrics are reused between metrics updates,
+        so pod binds cost O(N), not O(N x C)). Freshness is re-evaluated
+        against the CURRENT time so a node whose agent stops publishing goes
+        stale even while the cached arrays are reused."""
+        import time as _time
+
+        out = dict(vars(self))
+        reserved = np.zeros_like(self.reserved_chips)
+        if reserved_fn is not None:
+            for i, name in enumerate(self.names):
+                reserved[i] = reserved_fn(name)
+        out["reserved_chips"] = reserved
+        if claimed_fn is not None:
+            claimed = np.zeros_like(self.claimed_hbm_mib)
+            for i, name in enumerate(self.names):
+                claimed[i] = claimed_fn(name)
+            out["claimed_hbm_mib"] = claimed
+        if max_metrics_age_s > 0:
+            now = _time.time() if now is None else now
+            out["fresh"] = (now - self.last_updated) <= max_metrics_age_s
+        return FleetArrays(**out)
+
+
+def _claimed_hbm_mib(ni) -> int:
+    """HBM claimed by pods already placed on the node (reference
+    CalculateAllocateScore input, pkg/yoda/score/algorithm.go:77-80)."""
+    from yoda_tpu.api.requests import LabelParseError, parse_request
+
+    total = 0
+    for pod in ni.pods:
+        try:
+            r = parse_request(pod.labels)
+        except LabelParseError:
+            continue
+        total += (r.hbm_per_chip // MIB) * r.effective_chips
+    return total

@@ -1,0 +1,245 @@
+"""The fused filter + collect + score + select kernel.
+
+One jitted XLA computation replaces the reference's entire per-pod hot path
+(Filter per node -> CollectMaxValues over all cards -> Score per node ->
+NormalizeScore -> selection; reference pkg/yoda/scheduler.go:66-147):
+
+    feasibility:  chips / HBM / clock / generation / freshness / reservation
+                  predicates, vectorized over [nodes, chips]
+    collection:   cluster maxima over feasible nodes' qualifying chips
+                  (reference collection/collection.go:30-57) as masked maxes
+    scoring:      weighted per-chip scores + allocation headroom + actual
+                  free ratio (reference score/algorithm.go:29-88, with the
+                  clock/MaxBandwidth normalization bug fixed)
+    normalize:    min-max to [0,100] with the all-equal guard (reference
+                  scheduler.go:122-147)
+    select:       argmax with the deterministic name-order tiebreak
+
+All arithmetic is int32 (HBM in MiB), bitwise identical to the Python plugin
+path when HBM values are MiB-multiples. Request scalars are traced (not
+static), so ONE compiled executable serves every pod at a given fleet bucket
+shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yoda_tpu.api.requests import TpuRequest
+from yoda_tpu.config import Weights
+from yoda_tpu.ops.arrays import MIB, FleetArrays
+
+REASON_OK = 0
+REASON_NO_METRICS = 1
+REASON_STALE = 2
+REASON_GENERATION = 3
+REASON_CHIPS = 4
+REASON_HBM = 5
+REASON_CLOCK = 6
+REASON_RESERVED = 7
+
+REASON_MESSAGES = {
+    REASON_NO_METRICS: "node has no TPU metrics",
+    REASON_STALE: "node TPU metrics are stale",
+    REASON_GENERATION: "node generation below requested",
+    REASON_CHIPS: "not enough healthy chips",
+    REASON_HBM: "not enough chips with free HBM",
+    REASON_CLOCK: "not enough chips at requested clock",
+    REASON_RESERVED: "qualifying chips reserved by in-flight pods",
+}
+
+
+@dataclass(frozen=True)
+class KernelRequest:
+    """Traced request scalars (one compiled kernel serves all requests)."""
+
+    number: int          # effective chip count
+    hbm_mib: int         # per-chip free-HBM requirement, MiB
+    clock_mhz: int
+    generation_rank: int
+
+    @classmethod
+    def from_request(cls, req: TpuRequest) -> "KernelRequest":
+        return cls(
+            number=req.effective_chips,
+            # Ceil so sub-MiB requests stay a real constraint (chip free HBM
+            # is floored to MiB, so both roundings are conservative).
+            hbm_mib=-(-req.hbm_per_chip // MIB),
+            clock_mhz=req.min_clock_mhz,
+            generation_rank=req.min_generation_rank,
+        )
+
+
+@dataclass
+class KernelResult:
+    """Numpy views of the kernel outputs, trimmed to the real node count."""
+
+    feasible: np.ndarray      # [N] bool
+    reasons: np.ndarray       # [N] int32 (REASON_*)
+    raw_scores: np.ndarray    # [N] int32 (0 where infeasible)
+    scores: np.ndarray        # [N] int32 normalized to [0,100]
+    best_index: int           # -1 when nothing feasible
+
+
+def _norm(metric: jnp.ndarray, maximum: jnp.ndarray) -> jnp.ndarray:
+    return metric * 100 // maximum
+
+
+@functools.partial(jax.jit, static_argnames=("weights",))
+def _kernel(a: dict, number, hbm_mib, clock_mhz, gen_rank, weights: Weights):
+    healthy = a["chip_valid"] & a["chip_healthy"]
+    hbm_ok = healthy & (a["hbm_free_mib"] >= hbm_mib)
+    clock_ok = healthy & (a["clock_mhz"] >= clock_mhz)
+    qual = hbm_ok & clock_ok
+
+    count_healthy = jnp.sum(healthy, axis=1)
+    count_hbm = jnp.sum(hbm_ok, axis=1)
+    count_clock = jnp.sum(clock_ok, axis=1)
+    count_qual = jnp.sum(qual, axis=1)
+
+    # Predicate parity with plugins/yoda/filter_plugin.py (and reference
+    # filter.go): the hbm/clock counts are independent; the reservation
+    # check uses the fully-qualifying count minus reservations not yet
+    # visible in metrics (see filter_plugin.invisible_reservations).
+    apparently_used = jnp.sum(healthy & a["chip_used"], axis=1)
+    invisible = jnp.clip(a["reserved_chips"] - apparently_used, 0)
+    fits_chips = count_healthy >= number
+    fits_hbm = (hbm_mib == 0) | (count_hbm >= number)
+    fits_clock = (clock_mhz == 0) | (count_clock >= number)
+    fits_reserved = (count_qual - invisible) >= number
+    fits_gen = a["generation_rank"] >= gen_rank
+
+    feasible = (
+        a["node_valid"]
+        & a["fresh"]
+        & fits_gen
+        & fits_chips
+        & fits_hbm
+        & fits_clock
+        & fits_reserved
+    )
+
+    # First failing predicate, in the same order the Python filter checks.
+    reasons = jnp.select(
+        [
+            ~a["node_valid"],
+            ~a["fresh"],
+            ~fits_gen,
+            ~fits_chips,
+            ~fits_hbm,
+            ~fits_clock,
+            ~fits_reserved,
+        ],
+        [
+            REASON_NO_METRICS,
+            REASON_STALE,
+            REASON_GENERATION,
+            REASON_CHIPS,
+            REASON_HBM,
+            REASON_CLOCK,
+            REASON_RESERVED,
+        ],
+        REASON_OK,
+    ).astype(jnp.int32)
+
+    # --- collection: maxima over feasible nodes' qualifying chips ---
+    cmask = feasible[:, None] & qual
+
+    def masked_max(x):
+        return jnp.maximum(jnp.max(jnp.where(cmask, x, 0)), 1)
+
+    max_bw = masked_max(a["hbm_bandwidth"])
+    max_clock = masked_max(a["clock_mhz"])
+    max_tflops = masked_max(a["tflops"])
+    max_power = masked_max(a["power_w"])
+    max_free = masked_max(a["hbm_free_mib"])
+    max_total = masked_max(a["hbm_total_mib"])
+
+    # --- scoring ---
+    w = weights
+    chip_scores = (
+        _norm(a["hbm_bandwidth"], max_bw) * w.hbm_bandwidth
+        + _norm(a["clock_mhz"], max_clock) * w.clock
+        + _norm(a["tflops"], max_tflops) * w.tflops
+        + _norm(a["power_w"], max_power) * w.power
+        + _norm(a["hbm_free_mib"], max_free) * w.hbm_free
+        + _norm(a["hbm_total_mib"], max_total) * w.hbm_total
+    )
+    basic = jnp.sum(jnp.where(qual, chip_scores, 0), axis=1)
+
+    free_sum = jnp.sum(jnp.where(a["chip_valid"], a["hbm_free_mib"], 0), axis=1)
+    total_sum = jnp.sum(jnp.where(a["chip_valid"], a["hbm_total_mib"], 0), axis=1)
+    safe_total = jnp.maximum(total_sum, 1)
+    actual = jnp.where(total_sum > 0, free_sum * 100 // safe_total, 0) * w.actual
+    headroom = jnp.clip(total_sum - a["claimed_hbm_mib"], 0)
+    allocate = (
+        jnp.where(total_sum > 0, headroom * 100 // safe_total, 0) * w.allocate
+    )
+
+    raw = jnp.where(feasible, basic + actual + allocate, 0).astype(jnp.int32)
+
+    # --- normalize (min-max to [0,100], all-equal guard) ---
+    big = jnp.iinfo(jnp.int32).max
+    lowest = jnp.min(jnp.where(feasible, raw, big))
+    highest = jnp.max(jnp.where(feasible, raw, -1))
+    lowest = jnp.where(highest == lowest, lowest - 1, lowest)
+    span = jnp.maximum(highest - lowest, 1)
+    normalized = jnp.where(feasible, (raw - lowest) * 100 // span, 0).astype(jnp.int32)
+
+    # --- select: highest score, ties -> later row (lexicographically
+    # greatest name, matching the Python driver's (score, name) max) ---
+    n = normalized.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(feasible, normalized * n + idx, -1)
+    best = jnp.argmax(key).astype(jnp.int32)
+    best = jnp.where(jnp.any(feasible), best, -1)
+
+    return feasible, reasons, raw, normalized, best
+
+
+def fused_filter_score(
+    arrays: FleetArrays,
+    request: KernelRequest | TpuRequest,
+    *,
+    weights: Weights | None = None,
+) -> KernelResult:
+    if isinstance(request, TpuRequest):
+        request = KernelRequest.from_request(request)
+    a = {
+        "node_valid": arrays.node_valid,
+        "fresh": arrays.fresh,
+        "generation_rank": arrays.generation_rank,
+        "reserved_chips": arrays.reserved_chips,
+        "claimed_hbm_mib": arrays.claimed_hbm_mib,
+        "chip_valid": arrays.chip_valid,
+        "chip_healthy": arrays.chip_healthy,
+        "chip_used": arrays.chip_used,
+        "hbm_free_mib": arrays.hbm_free_mib,
+        "hbm_total_mib": arrays.hbm_total_mib,
+        "clock_mhz": arrays.clock_mhz,
+        "hbm_bandwidth": arrays.hbm_bandwidth,
+        "tflops": arrays.tflops,
+        "power_w": arrays.power_w,
+    }
+    feasible, reasons, raw, normalized, best = _kernel(
+        a,
+        jnp.int32(request.number),
+        jnp.int32(request.hbm_mib),
+        jnp.int32(request.clock_mhz),
+        jnp.int32(request.generation_rank),
+        weights=weights or Weights(),
+    )
+    n = arrays.n_nodes
+    best_i = int(best)
+    return KernelResult(
+        feasible=np.asarray(feasible)[:n],
+        reasons=np.asarray(reasons)[:n],
+        raw_scores=np.asarray(raw)[:n],
+        scores=np.asarray(normalized)[:n],
+        best_index=best_i if best_i < n else -1,
+    )

@@ -14,6 +14,8 @@ Extension-point mapping (reference → here, on modern framework semantics):
                                    topology. / preemption. (PostFilter)
 """
 
+from typing import Callable
+
 from yoda_tpu.plugins.yoda.sort import YodaSort
 from yoda_tpu.plugins.yoda.filter_plugin import (
     YodaFilter,
@@ -23,8 +25,48 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
 )
 from yoda_tpu.plugins.yoda.collection import MaxValueData, YodaPreScore, MAX_KEY
 from yoda_tpu.plugins.yoda.score import YodaScore, Weights
+from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+
+def default_plugins(
+    *,
+    mode: str = "batch",
+    weights: Weights | None = None,
+    reserved_fn: Callable[[str], int] | None = None,
+    max_metrics_age_s: float = 0.0,
+) -> list:
+    """Assemble the standard plugin set.
+
+    ``mode="batch"``: the fused-kernel fast path (one device computation per
+    pod). ``mode="loop"``: the per-node reference-semantics path. Both need
+    YodaPreFilter (label parsing) and YodaSort; batch subsumes
+    Filter+PreScore+Score.
+    """
+    base: list = [YodaSort(), YodaPreFilter()]
+    if mode == "batch":
+        base.append(
+            YodaBatch(
+                reserved_fn,
+                weights=weights,
+                max_metrics_age_s=max_metrics_age_s,
+            )
+        )
+    elif mode == "loop":
+        base.extend(
+            [
+                YodaFilter(reserved_fn, max_metrics_age_s=max_metrics_age_s),
+                YodaPreScore(),
+                YodaScore(weights),
+            ]
+        )
+    else:
+        raise ValueError(f"unknown plugin mode {mode!r}")
+    return base
+
 
 __all__ = [
+    "YodaBatch",
+    "default_plugins",
     "YodaSort",
     "YodaFilter",
     "YodaPreFilter",

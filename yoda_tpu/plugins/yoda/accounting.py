@@ -1,0 +1,106 @@
+"""Chip accounting: Reserve/Unreserve plus lifecycle tracking.
+
+Net-new vs the reference, which had NO schedule-time accounting — it never
+wrote SCVs and relied on the sniffer's eventual refresh, so two pods scheduled
+between refreshes could double-book a card (reference pkg/yoda/scheduler.go
+has no Reserve hook; SURVEY.md §3.3). Model here:
+
+- TPU chips are exclusive: a pod occupies ``effective_chips`` whole chips
+  from Reserve until the pod is DELETED (not merely bound — a running pod
+  keeps its chips).
+- ``chips_in_use(node)`` feeds the filter/kernel reservation predicate, so
+  in-flight reservations and long-running pods both subtract from
+  schedulable capacity immediately, independent of metrics-agent refresh lag.
+- State is reconstructible from the API server: the accountant is a watcher;
+  on replay it re-counts bound pods (scheduler restarts keep accounting
+  correct, the statelessness requirement of SURVEY.md §5 checkpoint row).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.fake import Event
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import ReservePlugin, Status
+from yoda_tpu.plugins.yoda.filter_plugin import get_request
+
+
+@dataclass
+class _Claim:
+    node: str
+    chips: int
+
+
+class ChipAccountant(ReservePlugin):
+    name = "yoda-accountant"
+
+    def __init__(self, *, scheduler_name: str = "yoda-tpu") -> None:
+        self.scheduler_name = scheduler_name
+        self._lock = threading.Lock()
+        self._claims: dict[str, _Claim] = {}  # pod uid -> claim
+        self._in_use: dict[str, int] = {}     # node -> chips
+
+    # --- ReservePlugin ---
+
+    def reserve(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        req = get_request(state)
+        self._claim(pod.uid, node_name, req.effective_chips)
+        return Status.ok()
+
+    def unreserve(self, state: CycleState, pod: PodSpec, node_name: str) -> None:
+        self.release(pod.uid)
+
+    # --- lifecycle (watch events) ---
+
+    def handle(self, event: Event) -> None:
+        if event.kind != "Pod":
+            return
+        pod: PodSpec = event.obj  # type: ignore[assignment]
+        if event.type == "deleted":
+            self.release(pod.uid)
+        elif pod.node_name:
+            # Bound pod (new bind, or replay after restart): ensure counted —
+            # but only pods that occupy chips: ours (we reserve a chip even
+            # for label-less pods, filter.go:14-15 semantics) or any pod that
+            # expresses a TPU request. Foreign non-TPU pods (daemonsets etc.)
+            # hold no chips.
+            try:
+                req = parse_request(pod.labels)
+            except LabelParseError:
+                if pod.scheduler_name != self.scheduler_name:
+                    return
+                req = None
+            if req is not None and not req.wants_tpu and (
+                pod.scheduler_name != self.scheduler_name
+            ):
+                return
+            chips = req.effective_chips if req is not None else 1
+            self._claim(pod.uid, pod.node_name, chips)
+
+    # --- internals / readers ---
+
+    def _claim(self, uid: str, node: str, chips: int) -> None:
+        with self._lock:
+            existing = self._claims.get(uid)
+            if existing is not None:
+                if existing.node == node:
+                    return  # reserve->bind transition: single claim
+                self._in_use[existing.node] -= existing.chips
+            self._claims[uid] = _Claim(node, chips)
+            self._in_use[node] = self._in_use.get(node, 0) + chips
+
+    def release(self, uid: str) -> None:
+        with self._lock:
+            claim = self._claims.pop(uid, None)
+            if claim is not None:
+                self._in_use[claim.node] = max(
+                    self._in_use.get(claim.node, 0) - claim.chips, 0
+                )
+
+    def chips_in_use(self, node_name: str) -> int:
+        with self._lock:
+            return self._in_use.get(node_name, 0)

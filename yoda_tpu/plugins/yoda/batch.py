@@ -1,0 +1,95 @@
+"""YodaBatch: the fused-kernel implementation of Filter+PreScore+Score.
+
+Semantically equivalent to the per-node plugin chain
+(YodaFilter + YodaPreScore + YodaScore) but evaluated for the whole fleet in
+one device computation (yoda_tpu/ops/kernel.py). Use EITHER this batch
+plugin OR the per-node trio in a framework — not both (scores would double).
+``yoda_tpu.plugins.yoda.default_plugins`` assembles the right set.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import BatchFilterScorePlugin, Snapshot, Status
+from yoda_tpu.ops.arrays import FleetArrays
+from yoda_tpu.ops.kernel import (
+    KernelRequest,
+    REASON_MESSAGES,
+    REASON_OK,
+    fused_filter_score,
+)
+from yoda_tpu.config import Weights
+from yoda_tpu.plugins.yoda.filter_plugin import get_request
+
+
+class YodaBatch(BatchFilterScorePlugin):
+    name = "yoda-batch"
+
+    def __init__(
+        self,
+        reserved_fn: Callable[[str], int] | None = None,
+        *,
+        claimed_fn: Callable[[str], int] | None = None,
+        weights: Weights | None = None,
+        max_metrics_age_s: float = 0.0,
+    ) -> None:
+        self.reserved_fn = reserved_fn
+        self.claimed_fn = claimed_fn
+        self.weights = weights or Weights()
+        self.max_metrics_age_s = max_metrics_age_s
+        self._cache_version: int | None = None
+        self._cache_arrays: FleetArrays | None = None
+
+    def _arrays(self, snapshot: Snapshot) -> FleetArrays:
+        # Static [N, C] chip metrics are keyed on the metrics version when the
+        # informer provides one AND claims are supplied dynamically (pod binds
+        # then cost O(N), not O(N x C)); otherwise the static build also bakes
+        # in per-pod claims, so key on the full snapshot version.
+        if self.claimed_fn is not None:
+            version = getattr(snapshot, "metrics_version", None) or snapshot.version
+        else:
+            version = snapshot.version
+        if version and self._cache_version == version and self._cache_arrays is not None:
+            static = self._cache_arrays
+        else:
+            static = FleetArrays.from_snapshot(
+                snapshot, max_metrics_age_s=self.max_metrics_age_s
+            )
+            if version:
+                self._cache_version = version
+                self._cache_arrays = static
+        # Reservations/claims/freshness change cycle-to-cycle without a
+        # metrics bump.
+        return static.with_dynamic(
+            self.reserved_fn,
+            self.claimed_fn,
+            max_metrics_age_s=self.max_metrics_age_s,
+        )
+
+    def filter_and_score_batch(
+        self, state: CycleState, pod: PodSpec, snapshot: Snapshot
+    ) -> tuple[dict[str, Status], dict[str, int]]:
+        if len(snapshot) == 0:
+            return {}, {}
+        req = get_request(state)
+        arrays = self._arrays(snapshot)
+        result = fused_filter_score(
+            arrays, KernelRequest.from_request(req), weights=self.weights
+        )
+        statuses: dict[str, Status] = {}
+        scores: dict[str, int] = {}
+        for i, name in enumerate(arrays.names):
+            if result.feasible[i]:
+                statuses[name] = Status.ok()
+                # Raw (pre-normalization) per the BatchFilterScorePlugin
+                # contract; the driver min-max normalizes once.
+                scores[name] = int(result.raw_scores[i])
+            else:
+                # Bare reason text (no node name) so identical failures
+                # aggregate in summarize_failure ("6 node(s): not enough ...").
+                reason = REASON_MESSAGES.get(int(result.reasons[i]), "infeasible")
+                statuses[name] = Status.unschedulable(reason)
+        return statuses, scores

@@ -1,0 +1,23 @@
+"""Bind plugin: posts the pod->node binding to the cluster backend — the
+step the reference delegates to upstream default binding (SURVEY.md §3.2
+[bind] row)."""
+
+from __future__ import annotations
+
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import BindPlugin, Status
+
+
+class ClusterBinder(BindPlugin):
+    name = "yoda-binder"
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster  # anything with bind_pod(pod_key, node_name)
+
+    def bind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        try:
+            self.cluster.bind_pod(pod.key, node_name)
+        except Exception as e:  # bind conflicts surface as scheduling failures
+            return Status.error(f"binding {pod.key} to {node_name}: {e}")
+        return Status.ok()

@@ -102,6 +102,21 @@ def pod_fits_clock(number: int, req: TpuRequest, node: TpuNodeMetrics) -> bool:
     return sum(1 for c in node.chips if chip_fits_clock(req.min_clock_mhz, c)) >= number
 
 
+def apparently_used_chips(node: TpuNodeMetrics) -> int:
+    """Healthy chips whose metrics already show consumption. Used to avoid
+    double-counting: a chip occupied by a running pod is charged EITHER via
+    the accountant's reservation (before the node agent's next refresh) OR
+    via its reduced free HBM (after), never both. Assumes the agent reports
+    nonzero usage for any occupied chip — true of the TPU runtime, which
+    always allocates some HBM on attach."""
+    return sum(1 for c in node.chips if c.healthy and c.hbm_free < c.hbm_total)
+
+
+def invisible_reservations(node: TpuNodeMetrics, reserved: int) -> int:
+    """Reservations not yet reflected in the node's published metrics."""
+    return max(reserved - apparently_used_chips(node), 0)
+
+
 # --- plugins ---
 
 
@@ -175,10 +190,11 @@ class YodaFilter(FilterPlugin):
 
         if self.reserved_chips_fn is not None:
             reserved = self.reserved_chips_fn(node.name)
-            available = len(qualifying_chips(tpu, req)) - reserved
+            invisible = invisible_reservations(tpu, reserved)
+            available = len(qualifying_chips(tpu, req)) - invisible
             if available < number:
                 return Status.unschedulable(
-                    f"node {node.name}: {reserved} chips reserved by in-flight pods, "
+                    f"node {node.name}: {reserved} chips in use by other pods, "
                     f"only {max(available, 0)} qualifying chips available"
                 )
         return Status.ok()

@@ -23,29 +23,13 @@ panic on TotalMemorySum == 0).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-
 from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.config import Weights
 from yoda_tpu.api.types import PodSpec, TpuChip, TpuNodeMetrics
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import NodeInfo, ScorePlugin, Status
 from yoda_tpu.plugins.yoda.collection import MAX_KEY, MaxValueData
 from yoda_tpu.plugins.yoda.filter_plugin import get_request, qualifying_chips
-
-
-@dataclass(frozen=True)
-class Weights:
-    """Reference weight consts (algorithm.go:17-27), now configurable via
-    plugin config instead of compile-time (SURVEY.md §5 config row)."""
-
-    hbm_bandwidth: int = 1
-    clock: int = 1
-    tflops: int = 1
-    power: int = 1
-    hbm_free: int = 2
-    hbm_total: int = 1
-    actual: int = 2
-    allocate: int = 2
 
 
 def chip_score(value: MaxValueData, chip: TpuChip, w: Weights) -> int:

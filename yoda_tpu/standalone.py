@@ -1,0 +1,81 @@
+"""Standalone assembly: wire cluster, informer, accounting, plugins, and the
+scheduling loop into one runnable stack.
+
+The structural analog of the reference's registration shim + scheduler config
+(reference pkg/register/register.go:9-13 + deploy/yoda-scheduler.yaml:7-30):
+what the upstream ``NewSchedulerCommand`` assembles from YAML there is
+assembled here from ``SchedulerConfig``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from yoda_tpu.cluster import Event, FakeCluster, InformerCache
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework import Framework, Scheduler, SchedulingQueue
+from yoda_tpu.plugins.yoda import default_plugins
+from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+from yoda_tpu.plugins.yoda.binder import ClusterBinder
+
+
+@dataclass
+class Stack:
+    cluster: FakeCluster
+    informer: InformerCache
+    accountant: ChipAccountant
+    framework: Framework
+    queue: SchedulingQueue
+    scheduler: Scheduler
+
+
+def build_stack(
+    cluster: FakeCluster | None = None,
+    config: SchedulerConfig | None = None,
+    *,
+    extra_plugins: list | None = None,
+    clock=time.monotonic,
+) -> Stack:
+    """Build a fully-wired scheduler stack against ``cluster`` (a fresh
+    FakeCluster by default). Watchers are registered list-then-watch, so a
+    stack built against a populated cluster reconstructs accounting state
+    from existing bound pods (scheduler-restart statelessness, SURVEY.md §5).
+    """
+    cluster = cluster or FakeCluster()
+    config = config or SchedulerConfig()
+    accountant = ChipAccountant()
+
+    plugins = default_plugins(
+        mode=config.mode,
+        weights=config.weights,
+        reserved_fn=accountant.chips_in_use,
+        max_metrics_age_s=config.max_metrics_age_s,
+    )
+    plugins.append(accountant)
+    if extra_plugins:
+        plugins.extend(extra_plugins)
+    plugins.append(ClusterBinder(cluster))
+    framework = Framework(plugins)
+    queue = SchedulingQueue(framework.queue_sort, clock=clock)
+
+    def on_change(event: Event) -> None:
+        # New/changed TPU metrics may make parked pods schedulable; pod
+        # deletions free chips. Binds already reactivate via the scheduler.
+        if event.kind == "TpuNodeMetrics" or event.type == "deleted":
+            queue.move_all_to_active()
+
+    informer = InformerCache(on_pod_pending=queue.add, on_change=on_change)
+
+    # Wire claims into our batch plugin now the informer exists.
+    from yoda_tpu.plugins.yoda import YodaBatch
+
+    for p in framework.batch_plugins:
+        if isinstance(p, YodaBatch) and p.claimed_fn is None:
+            p.claimed_fn = informer.claimed_hbm_mib
+
+    cluster.add_watcher(accountant.handle)
+    cluster.add_watcher(informer.handle)
+
+    scheduler = Scheduler(framework, informer.snapshot, queue, clock=clock)
+    return Stack(cluster, informer, accountant, framework, queue, scheduler)
