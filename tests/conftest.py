@@ -8,7 +8,9 @@ first ``import jax`` anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the environment may pre-set JAX_PLATFORMS to a TPU platform
+# (e.g. "axon"); tests must not depend on (or hold) the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +18,12 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A site hook may have imported jax at interpreter startup (before this
+# conftest ran), freezing jax's config on the pre-set platform. If so, the
+# env var above came too late — override the live config as well. Backends
+# are created lazily, so this is still in time as long as no array op ran.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

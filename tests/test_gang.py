@@ -1,0 +1,310 @@
+"""Gang scheduling + ICI topology tests: BASELINE config 4 (atomic multi-host
+slice placement) plus admission, rollback, timeout, and livelock-release
+scenarios — the hard parts ranked #1-2 in SURVEY.md §7."""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.plugins.yoda.topology import find_subblock, normalize_dims
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    return stack, FakeTpuAgent(stack.cluster)
+
+
+def gang_pods(name, n, chips=4, extra=None):
+    labels = {"tpu/gang": name, "tpu/gang-size": str(n), "tpu/chips": str(chips)}
+    labels.update(extra or {})
+    return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+def topo_pods(name, topology, chips=4, extra=None):
+    labels = {"tpu/gang": name, "tpu/topology": topology, "tpu/chips": str(chips)}
+    labels.update(extra or {})
+    import math
+
+    n = math.prod(int(d) for d in topology.split("x"))
+    return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+class TestTopologyMatching:
+    def test_normalize(self):
+        assert normalize_dims((4,)) == (4, 1, 1)
+        assert normalize_dims((2, 2)) == (2, 2, 1)
+
+    def test_find_subblock_exact(self):
+        free = {(x, y, z) for x in range(2) for y in range(2) for z in range(2)}
+        block = find_subblock(free, (2, 2, 2))
+        assert block is not None and len(block) == 8
+
+    def test_find_subblock_within_larger(self):
+        free = {(x, y, 0) for x in range(4) for y in range(4)}
+        block = find_subblock(free, (2, 2, 1))
+        assert block == [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+
+    def test_find_subblock_axis_permutation(self):
+        free = {(0, y, z) for y in range(2) for z in range(4)}  # 1x2x4 region
+        assert find_subblock(free, (4, 2, 1)) is not None
+
+    def test_find_subblock_respects_holes(self):
+        free = {(x, y, 0) for x in range(2) for y in range(2)} - {(0, 1, 0)}
+        assert find_subblock(free, (2, 2, 1)) is None
+        assert find_subblock(free, (2, 1, 1)) is not None
+
+    def test_find_subblock_must_include(self):
+        free = {(x, y, 0) for x in range(4) for y in range(2)}
+        # Without pins the lowest-origin 2x2 wins; a pin at (2,0,0) forces
+        # the block that contains it.
+        block = find_subblock(
+            free - {(2, 0, 0)}, (2, 2, 1), must_include={(2, 0, 0)}
+        )
+        assert block is not None and (2, 0, 0) in block
+        # Pin outside any feasible block -> no plan.
+        assert (
+            find_subblock({(0, 0, 0)}, (2, 1, 1), must_include={(3, 0, 0)}) is None
+        )
+
+    def test_fragmented_no_contiguous_block(self):
+        free = {(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)}  # checkerboard
+        assert find_subblock(free, (2, 1, 1)) is None
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestGangAtomicity:
+    def test_gang_binds_together(self, mode):
+        stack, agent = make_stack(mode)
+        for i in range(4):
+            agent.add_host(f"host-{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("job-a", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = {p.name: p.node_name for p in stack.cluster.list_pods()}
+        assert all(v is not None for v in bound.values()), bound
+        assert stack.gang.gang_status("job-a") == (4, 0, 4)
+
+    def test_incomplete_gang_binds_nothing(self, mode):
+        # Only 3 of 4 members created: nothing must bind, no chips leak.
+        stack, agent = make_stack(mode, gang_permit_timeout_s=0.3)
+        for i in range(4):
+            agent.add_host(f"host-{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("job-a", 4)[:3]:
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=2)
+        assert all(p.node_name is None for p in stack.cluster.list_pods())
+        # After timeout + cascade, reservations must be fully rolled back.
+        assert all(
+            stack.accountant.chips_in_use(f"host-{i}") == 0 for i in range(4)
+        )
+
+    def test_late_member_completes_gang(self, mode):
+        stack, agent = make_stack(mode)
+        for i in range(2):
+            agent.add_host(f"host-{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        pods = gang_pods("job-b", 2)
+        stack.cluster.create_pod(pods[0])
+        stack.scheduler.run_until_idle(max_wall_s=2)
+        assert stack.cluster.get_pod(f"default/{pods[0].name}").node_name is None
+        stack.cluster.create_pod(pods[1])
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert all(p.node_name for p in stack.cluster.list_pods())
+
+    def test_no_partial_reservation_when_gang_cannot_fit(self, mode):
+        # Admission check: a 4-member gang on a 2-host fleet (1 slot each)
+        # must not reserve anything.
+        stack, agent = make_stack(mode)
+        agent.add_host("host-0", generation="v5p", chips=4)
+        agent.add_host("host-1", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("too-big", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=2)
+        assert all(p.node_name is None for p in stack.cluster.list_pods())
+        assert stack.accountant.chips_in_use("host-0") == 0
+        assert stack.accountant.chips_in_use("host-1") == 0
+
+    def test_gang_members_can_share_host(self, mode):
+        # Non-topology gang: 4 members x 2 chips fit one v5e-8 host.
+        stack, agent = make_stack(mode)
+        agent.add_host("big-host", generation="v5e", chips=8)
+        agent.publish_all()
+        for pod in gang_pods("packed", 4, chips=2):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert all(p.node_name == "big-host" for p in stack.cluster.list_pods())
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestBaselineConfig4Topology:
+    def test_v5p_slice_gang_with_ici_affinity(self, mode):
+        # Config 4: gang-scheduled v5p slice — 4 pods, topology 2x2x1,
+        # atomically across the 4 hosts of one slice.
+        stack, agent = make_stack(mode)
+        agent.add_slice("v5p-a", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        for pod in topo_pods("train", "2x2x1", chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        placements = {p.name: p.node_name for p in stack.cluster.list_pods()}
+        assert all(v for v in placements.values()), placements
+        assert len(set(placements.values())) == 4  # one member per host
+        assert all(v.startswith("v5p-a") for v in placements.values())
+
+    def test_topology_gang_picks_slice_with_room(self, mode):
+        # Slice A is half-occupied; the 2x2x1 gang must land on slice B.
+        stack, agent = make_stack(mode)
+        agent.add_slice("slice-a", generation="v5p", host_topology=(2, 2, 1))
+        agent.add_slice("slice-b", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        blocker = PodSpec("blocker", labels={"tpu/chips": "4"})
+        stack.cluster.create_pod(blocker)
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        blocked_host = stack.cluster.get_pod("default/blocker").node_name
+        blocked_slice = "slice-a" if blocked_host.startswith("slice-a") else "slice-b"
+        other = "slice-b" if blocked_slice == "slice-a" else "slice-a"
+        for pod in topo_pods("t", "2x2x1", chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        gang_hosts = {
+            p.node_name for p in stack.cluster.list_pods() if p.name.startswith("t-")
+        }
+        assert all(h and h.startswith(other) for h in gang_hosts), gang_hosts
+
+    def test_topology_gang_unschedulable_without_contiguous_block(self, mode):
+        # 2x2x1 wanted; only fragmented hosts are free.
+        stack, agent = make_stack(mode, gang_permit_timeout_s=0.3)
+        agent.add_slice("s", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        # Occupy two diagonal hosts -> no contiguous 2x2 block remains free.
+        for name, host in [("b0", "s-0"), ("b1", "s-3")]:
+            # s-0 is (0,0,0), s-3 is (1,1,0) per itertools.product order
+            p = PodSpec(name, labels={"tpu/chips": "4"})
+            p.node_name = host
+            p.phase = "Running"
+            stack.cluster.create_pod(p)
+        agent.publish_all()
+        for pod in topo_pods("t", "2x2x1", chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=2)
+        gang = [p for p in stack.cluster.list_pods() if p.name.startswith("t-")]
+        assert all(p.node_name is None for p in gang)
+
+
+class TestGangConsistency:
+    def test_mismatched_gang_size_is_unresolvable(self):
+        stack, agent = make_stack()
+        agent.add_host("h", generation="v5p", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("a", labels={"tpu/gang": "g", "tpu/gang-size": "2"})
+        )
+        stack.cluster.create_pod(
+            PodSpec("b", labels={"tpu/gang": "g", "tpu/gang-size": "3"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=2)
+        results = {r.pod_key: r for r in stack.scheduler.stats.results}
+        assert any("size/topology" in r.message for r in results.values())
+
+    def test_two_gangs_contending_one_completes(self):
+        # Livelock scenario (SURVEY.md §7 hard part 1): two 2-member gangs,
+        # capacity for one. With admission seeing reservations plus timeout
+        # rollback, exactly one gang must fully bind.
+        stack, agent = make_stack(gang_permit_timeout_s=0.5)
+        agent.add_host("h0", generation="v5p", chips=4)
+        agent.add_host("h1", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("gang-a", 2, chips=4) + gang_pods("gang-b", 2, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        bound_by_gang = {"gang-a": 0, "gang-b": 0}
+        for p in stack.cluster.list_pods():
+            if p.node_name:
+                bound_by_gang[p.labels["tpu/gang"]] += 1
+        assert sorted(bound_by_gang.values()) == [0, 2], bound_by_gang
+
+    def test_bind_failure_self_heals(self):
+        # Regression: a bind that fails AFTER Permit released the gang must
+        # not wedge it — the gang optimistically counts the member bound at
+        # resolution; PreFilter drops the stale entry on the retry.
+        from yoda_tpu.framework.interfaces import BindPlugin, Code, Status
+
+        class FlakyBinder(BindPlugin):
+            name = "flaky-binder"
+
+            def __init__(self):
+                self.tripped = False
+
+            def bind(self, state, pod, node_name):
+                if not self.tripped and pod.name == "job-f-1":
+                    self.tripped = True
+                    return Status.error("transient bind failure")
+                return Status(code=Code.SKIP)
+
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack as build
+
+        flaky = FlakyBinder()
+        stack = build(
+            config=SchedulerConfig(mode="batch"), extra_plugins=[flaky]
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"host-{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("job-f", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert flaky.tripped
+        bound = {p.name: p.node_name for p in stack.cluster.list_pods()}
+        assert all(v is not None for v in bound.values()), bound
+        assert stack.gang.gang_status("job-f") == (4, 0, 4)
+
+    def test_topology_gang_reconstructed_after_restart(self):
+        # Regression: a topology gang with a pre-bound member (scheduler
+        # restart) must replan AROUND that member's host, not wedge.
+        stack, agent = make_stack()
+        agent.add_slice("s", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        pods = topo_pods("resume", "2x2x1", chips=4)
+        pods[0].node_name = "s-1"
+        pods[0].phase = "Running"
+        stack.cluster.create_pod(pods[0])
+        agent.publish_all()  # metrics now show s-1's chips consumed
+
+        from yoda_tpu.standalone import build_stack as rebuild
+
+        stack2 = rebuild(cluster=stack.cluster)
+        assert stack2.gang.gang_status("resume") == (4, 0, 1)
+        for p in pods[1:]:
+            stack2.cluster.create_pod(p)
+        stack2.scheduler.run_until_idle(max_wall_s=10)
+        placements = {p.name: p.node_name for p in stack2.cluster.list_pods()}
+        assert all(placements.values()), placements
+        assert len(set(placements.values())) == 4
+
+    def test_gang_reconstructed_after_restart(self):
+        # Half a gang bound, scheduler restarts: the new stack must count the
+        # bound members and complete the gang when the rest arrive.
+        stack, agent = make_stack()
+        for i in range(2):
+            agent.add_host(f"h{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        pods = gang_pods("resume", 2)
+        # Simulate pre-bound member (as if bound before restart).
+        pods[0].node_name = "h0"
+        pods[0].phase = "Running"
+        stack.cluster.create_pod(pods[0])
+
+        from yoda_tpu.standalone import build_stack as rebuild
+
+        stack2 = rebuild(cluster=stack.cluster)
+        assert stack2.gang.gang_status("resume") == (2, 0, 1)
+        stack2.cluster.create_pod(pods[1])
+        stack2.scheduler.run_until_idle(max_wall_s=5)
+        assert stack2.cluster.get_pod(f"default/{pods[1].name}").node_name is not None

@@ -131,7 +131,7 @@ class TestFilterPlugin:
     def test_reservation_awareness(self):
         node = make_node("n", chips=4)
         st = self.run_filter({"tpu/chips": "2"}, node, reserved_chips_fn=lambda n: 3)
-        assert st.rejected and "in use" in st.message
+        assert st.rejected and "reserved in-flight" in st.message
         st = self.run_filter({"tpu/chips": "2"}, node, reserved_chips_fn=lambda n: 2)
         assert st.success
 

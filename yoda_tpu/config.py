@@ -26,6 +26,11 @@ class Weights:
     hbm_total: int = 1
     actual: int = 2
     allocate: int = 2
+    # Anti-fragmentation (net-new, no reference analog): pods with no
+    # tpu/topology requirement prefer hosts OUTSIDE multi-host ICI slices,
+    # keeping slices whole for topology gangs. Tiered above the metric terms
+    # (bonus = SLICE_PROTECT_BONUS x weight); 0 disables.
+    slice_protect: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "Weights":
@@ -37,6 +42,12 @@ class Weights:
         if bad:
             raise ValueError(f"weights must be non-negative ints: {bad}")
         return cls(**d)
+
+
+# Added AFTER the metric score is min-max normalized to [0,100] (so metric
+# resolution is not crushed by the tier): one tier step is 1000 > 100, and
+# slice protection strictly dominates chip quality for non-topology pods.
+SLICE_PROTECT_TIER = 1000
 
 
 @dataclass(frozen=True)

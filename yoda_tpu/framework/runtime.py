@@ -131,7 +131,9 @@ class Framework:
     def run_batch_filter_score(
         self, state: CycleState, pod: PodSpec, snapshot: Snapshot
     ) -> tuple[dict[str, Status], dict[str, int]] | None:
-        """Fused fast path; None when no batch plugin is registered."""
+        """Fused fast path; None when no batch plugin is registered. Regular
+        FilterPlugins (e.g. the gang host-pinning filter) still run, but only
+        over the batch-feasible subset."""
         if not self.batch_plugins:
             return None
         statuses: dict[str, Status] = {n: Status.ok() for n in snapshot.names()}
@@ -143,6 +145,14 @@ class Framework:
                     statuses[n] = st
             for n, s in p_scores.items():
                 totals[n] += s
+        for n, st in statuses.items():
+            if not st.success:
+                continue
+            for p in self.filter_plugins:
+                st2 = p.filter(state, pod, snapshot.get(n))
+                if not st2.success:
+                    statuses[n] = st2
+                    break
         feasible_scores = {n: totals[n] for n, st in statuses.items() if st.success}
         return statuses, feasible_scores
 
@@ -252,6 +262,12 @@ class Framework:
     ) -> None:
         with self._waiting_lock:
             self._waiting.pop(wp.pod.key, None)
+        # Permit plugins observe resolutions first (gang bookkeeping and
+        # cascade rollback), then the scheduler binds or unreserves.
+        for p in self.permit_plugins:
+            hook = getattr(p, "on_pod_resolved", None)
+            if hook is not None:
+                hook(self, wp, status)
         cb(wp, status)
 
     def waiting_pods(self) -> list[WaitingPod]:

@@ -131,9 +131,17 @@ class Scheduler:
         if not st.success:
             return done("error", message=st.message)
         if batch_scores:
-            normalized = _normalize(batch_scores)
-            for n in feasible:
-                totals[n] = totals.get(n, 0) + normalized[n]
+            if self.framework.score_plugins:
+                # Combining with per-node plugins: bring the batch total onto
+                # the same [0,100] scale.
+                normalized = _normalize(batch_scores)
+                for n in feasible:
+                    totals[n] = totals.get(n, 0) + normalized[n]
+            else:
+                # Batch is the only scorer (the normal fused mode): its
+                # scores are already normalized+tiered; re-normalizing would
+                # only quantize away within-tier ordering.
+                totals = dict(batch_scores)
 
         best = max(feasible, key=lambda n: (totals.get(n, 0), n))
 

@@ -37,6 +37,7 @@ class FleetArrays:
     # [N] node-level
     node_valid: np.ndarray        # bool
     generation_rank: np.ndarray   # int32
+    in_slice: np.ndarray          # bool (host belongs to a multi-host ICI slice)
     fresh: np.ndarray             # bool
     last_updated: np.ndarray      # float64 unix (for dynamic re-freshness)
     reserved_chips: np.ndarray    # int32 (chips held by in-flight pods)
@@ -89,6 +90,7 @@ class FleetArrays:
 
         node_valid = np.zeros(n_pad, dtype=bool)
         gen = np.zeros(n_pad, dtype=np.int32)
+        in_slice = np.zeros(n_pad, dtype=bool)
         fresh = np.zeros(n_pad, dtype=bool)
         last_updated = np.zeros(n_pad, dtype=np.float64)
         reserved = np.zeros(n_pad, dtype=np.int32)
@@ -110,6 +112,7 @@ class FleetArrays:
                 continue  # row stays invalid -> never feasible
             node_valid[i] = True
             gen[i] = tpu.generation_rank
+            in_slice[i] = bool(tpu.slice_id)
             last_updated[i] = tpu.last_updated_unix
             fresh[i] = (
                 True
@@ -134,6 +137,7 @@ class FleetArrays:
             names=names,
             node_valid=node_valid,
             generation_rank=gen,
+            in_slice=in_slice,
             fresh=fresh,
             last_updated=last_updated,
             reserved_chips=reserved,

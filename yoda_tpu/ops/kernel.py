@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from yoda_tpu.api.requests import TpuRequest
-from yoda_tpu.config import Weights
+from yoda_tpu.config import SLICE_PROTECT_TIER, Weights
 from yoda_tpu.ops.arrays import MIB, FleetArrays
 
 REASON_OK = 0
@@ -62,6 +62,7 @@ class KernelRequest:
     hbm_mib: int         # per-chip free-HBM requirement, MiB
     clock_mhz: int
     generation_rank: int
+    wants_topology: int  # 1 when the pod is part of a topology gang
 
     @classmethod
     def from_request(cls, req: TpuRequest) -> "KernelRequest":
@@ -72,6 +73,9 @@ class KernelRequest:
             hbm_mib=-(-req.hbm_per_chip // MIB),
             clock_mhz=req.min_clock_mhz,
             generation_rank=req.min_generation_rank,
+            wants_topology=int(
+                req.gang is not None and req.gang.topology is not None
+            ),
         )
 
 
@@ -81,8 +85,8 @@ class KernelResult:
 
     feasible: np.ndarray      # [N] bool
     reasons: np.ndarray       # [N] int32 (REASON_*)
-    raw_scores: np.ndarray    # [N] int32 (0 where infeasible)
-    scores: np.ndarray        # [N] int32 normalized to [0,100]
+    raw_scores: np.ndarray    # [N] int32 metric score, pre-normalization
+    scores: np.ndarray        # [N] int32: minmax-normalized [0,100] + slice tier
     best_index: int           # -1 when nothing feasible
 
 
@@ -91,7 +95,9 @@ def _norm(metric: jnp.ndarray, maximum: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("weights",))
-def _kernel(a: dict, number, hbm_mib, clock_mhz, gen_rank, weights: Weights):
+def _kernel(
+    a: dict, number, hbm_mib, clock_mhz, gen_rank, wants_topology, weights: Weights
+):
     healthy = a["chip_valid"] & a["chip_healthy"]
     hbm_ok = healthy & (a["hbm_free_mib"] >= hbm_mib)
     clock_ok = healthy & (a["clock_mhz"] >= clock_mhz)
@@ -104,14 +110,16 @@ def _kernel(a: dict, number, hbm_mib, clock_mhz, gen_rank, weights: Weights):
 
     # Predicate parity with plugins/yoda/filter_plugin.py (and reference
     # filter.go): the hbm/clock counts are independent; the reservation
-    # check uses the fully-qualifying count minus reservations not yet
-    # visible in metrics (see filter_plugin.invisible_reservations).
+    # check mirrors filter_plugin.available_chips — chips already showing
+    # consumption are excluded (exclusive-chip model), and reservations not
+    # yet visible in metrics are subtracted on top.
     apparently_used = jnp.sum(healthy & a["chip_used"], axis=1)
     invisible = jnp.clip(a["reserved_chips"] - apparently_used, 0)
+    count_avail = jnp.sum(qual & ~a["chip_used"], axis=1)
     fits_chips = count_healthy >= number
     fits_hbm = (hbm_mib == 0) | (count_hbm >= number)
     fits_clock = (clock_mhz == 0) | (count_clock >= number)
-    fits_reserved = (count_qual - invisible) >= number
+    fits_reserved = (count_avail - invisible) >= number
     fits_gen = a["generation_rank"] >= gen_rank
 
     feasible = (
@@ -191,15 +199,26 @@ def _kernel(a: dict, number, hbm_mib, clock_mhz, gen_rank, weights: Weights):
     span = jnp.maximum(highest - lowest, 1)
     normalized = jnp.where(feasible, (raw - lowest) * 100 // span, 0).astype(jnp.int32)
 
+    # Anti-fragmentation tier (config.SLICE_PROTECT_TIER): added AFTER
+    # normalization so the tier dominates without crushing within-tier
+    # metric resolution. Non-topology pods strictly prefer hosts outside
+    # multi-host ICI slices.
+    protect = jnp.where(
+        (wants_topology == 0) & ~a["in_slice"],
+        SLICE_PROTECT_TIER * w.slice_protect,
+        0,
+    ).astype(jnp.int32)
+    final = jnp.where(feasible, normalized + protect, 0).astype(jnp.int32)
+
     # --- select: highest score, ties -> later row (lexicographically
     # greatest name, matching the Python driver's (score, name) max) ---
-    n = normalized.shape[0]
+    n = final.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    key = jnp.where(feasible, normalized * n + idx, -1)
+    key = jnp.where(feasible, final * n + idx, -1)
     best = jnp.argmax(key).astype(jnp.int32)
     best = jnp.where(jnp.any(feasible), best, -1)
 
-    return feasible, reasons, raw, normalized, best
+    return feasible, reasons, raw, final, best
 
 
 def fused_filter_score(
@@ -212,6 +231,7 @@ def fused_filter_score(
         request = KernelRequest.from_request(request)
     a = {
         "node_valid": arrays.node_valid,
+        "in_slice": arrays.in_slice,
         "fresh": arrays.fresh,
         "generation_rank": arrays.generation_rank,
         "reserved_chips": arrays.reserved_chips,
@@ -232,6 +252,7 @@ def fused_filter_score(
         jnp.int32(request.hbm_mib),
         jnp.int32(request.clock_mhz),
         jnp.int32(request.generation_rank),
+        jnp.int32(request.wants_topology),
         weights=weights or Weights(),
     )
     n = arrays.n_nodes

@@ -24,7 +24,7 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
     get_request,
 )
 from yoda_tpu.plugins.yoda.collection import MaxValueData, YodaPreScore, MAX_KEY
-from yoda_tpu.plugins.yoda.score import YodaScore, Weights
+from yoda_tpu.plugins.yoda.score import SliceProtectScore, YodaScore, Weights
 from yoda_tpu.plugins.yoda.batch import YodaBatch
 
 
@@ -57,6 +57,7 @@ def default_plugins(
                 YodaFilter(reserved_fn, max_metrics_age_s=max_metrics_age_s),
                 YodaPreScore(),
                 YodaScore(weights),
+                SliceProtectScore(weights),
             ]
         )
     else:
@@ -72,6 +73,7 @@ __all__ = [
     "YodaPreFilter",
     "YodaPreScore",
     "YodaScore",
+    "SliceProtectScore",
     "MaxValueData",
     "Weights",
     "REQUEST_KEY",

@@ -84,9 +84,10 @@ class YodaBatch(BatchFilterScorePlugin):
         for i, name in enumerate(arrays.names):
             if result.feasible[i]:
                 statuses[name] = Status.ok()
-                # Raw (pre-normalization) per the BatchFilterScorePlugin
-                # contract; the driver min-max normalizes once.
-                scores[name] = int(result.raw_scores[i])
+                # Final comparable score: minmax-normalized metrics [0,100]
+                # plus the slice-protection tier. The driver uses these
+                # directly when no other ScorePlugin is registered.
+                scores[name] = int(result.scores[i])
             else:
                 # Bare reason text (no node name) so identical failures
                 # aggregate in summarize_failure ("6 node(s): not enough ...").

@@ -117,6 +117,20 @@ def invisible_reservations(node: TpuNodeMetrics, reserved: int) -> int:
     return max(reserved - apparently_used_chips(node), 0)
 
 
+def available_chips(node: TpuNodeMetrics, req: TpuRequest, reserved: int) -> int:
+    """Qualifying chips actually claimable under the exclusive-chip model.
+
+    TPU chips attach to one process at a time (unlike the reference's
+    GPU-memory-sharing model, filter.go:18-33), so a chip already showing
+    consumption in metrics is NOT available no matter how much HBM remains
+    free on it; reservations the metrics haven't caught up with are
+    subtracted on top (each occupies one not-yet-visibly-used chip)."""
+    unused = sum(
+        1 for c in qualifying_chips(node, req) if c.hbm_free >= c.hbm_total
+    )
+    return unused - invisible_reservations(node, reserved)
+
+
 # --- plugins ---
 
 
@@ -188,13 +202,13 @@ class YodaFilter(FilterPlugin):
                 f"node {node.name} lacks {number} chips at >= {req.min_clock_mhz} MHz"
             )
 
-        if self.reserved_chips_fn is not None:
-            reserved = self.reserved_chips_fn(node.name)
-            invisible = invisible_reservations(tpu, reserved)
-            available = len(qualifying_chips(tpu, req)) - invisible
-            if available < number:
-                return Status.unschedulable(
-                    f"node {node.name}: {reserved} chips in use by other pods, "
-                    f"only {max(available, 0)} qualifying chips available"
-                )
+        reserved = (
+            self.reserved_chips_fn(node.name) if self.reserved_chips_fn else 0
+        )
+        available = available_chips(tpu, req, reserved)
+        if available < number:
+            return Status.unschedulable(
+                f"node {node.name}: {reserved} chips reserved in-flight, "
+                f"only {max(available, 0)} unoccupied qualifying chips"
+            )
         return Status.ok()

@@ -1,0 +1,326 @@
+"""Gang scheduling: all-or-nothing placement of multi-pod TPU jobs.
+
+Net-new vs the reference, which schedules every pod independently and
+implements no Permit/Reserve hooks (reference pkg/yoda/scheduler.go:29-33;
+SURVEY.md §2 notes gang scheduling as the mandated net-new component). A gang
+is declared by pod labels (``tpu/gang``, ``tpu/gang-size`` or
+``tpu/topology`` — api/requests.py): its members bind atomically or not at
+all.
+
+Mechanism (SURVEY.md §7 step 4):
+
+- **PreFilter — admission.** Before any chips are reserved for a member, the
+  gang's whole remaining demand is checked against CURRENT free capacity
+  (for topology gangs: a concrete slice sub-block plan; otherwise a
+  chip-slot count). If the gang cannot complete now, the member is rejected
+  up front — a gang never takes partial reservations it cannot finish.
+- **Permit — barrier.** Each member reserves its chips, then WAITs on the
+  framework waitlist. When waiting + already-bound members reach the gang
+  size, all waiting members are allowed and bind together.
+- **Rollback.** If any member is rejected or times out, every other waiting
+  member of the gang is rejected too (cascade), all reservations roll back
+  (framework unreserve path), the topology plan is dropped, and members
+  retry via queue backoff.
+
+Deadlock/livelock analysis (SURVEY.md §7 hard part 1): two gangs can still
+interleave reservations in the window between admission checks. Progress is
+guaranteed because (a) admission sees other gangs' reservations (accountant),
+shrinking the window to one scheduling cycle; (b) on conflict, Permit
+timeouts + cascades release ALL of a gang's chips at once, and queue backoff
+desynchronizes the retries, so one gang completes. There is no hold-and-wait
+forever: every hold has a deadline.
+
+For topology gangs the plan maps members onto a contiguous ICI sub-block
+(plugins/yoda/topology.py); the Filter hook restricts members to planned
+hosts (one member per host).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from yoda_tpu.api.requests import GangSpec
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.fake import Event
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.interfaces import (
+    FilterPlugin,
+    NodeInfo,
+    PermitPlugin,
+    PreFilterPlugin,
+    Snapshot,
+    Status,
+)
+from yoda_tpu.plugins.yoda.filter_plugin import available_chips, get_request
+from yoda_tpu.plugins.yoda.topology import plan_slice_placement
+
+ALLOWED_HOSTS_KEY = "yoda-gang/allowed-hosts"
+
+
+@dataclass
+class _AllowedHosts:
+    hosts: frozenset[str]
+
+    def clone(self) -> "_AllowedHosts":
+        return self
+
+
+@dataclass
+class _GangState:
+    spec: GangSpec
+    waiting: set[str] = field(default_factory=set)       # pod keys on waitlist
+    bound: set[str] = field(default_factory=set)         # pod keys bound
+    assigned: dict[str, str] = field(default_factory=dict)  # pod key -> host
+    plan: dict[str, tuple[int, int, int]] | None = None  # host -> coord
+    failing: bool = False
+
+
+class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
+    name = "yoda-gang"
+
+    def __init__(
+        self,
+        *,
+        timeout_s: float = 120.0,
+        reserved_fn: Callable[[str], int] | None = None,
+    ) -> None:
+        self.timeout_s = timeout_s
+        self.reserved_fn = reserved_fn
+        self._lock = threading.RLock()
+        self._gangs: dict[str, _GangState] = {}
+
+    # --- helpers ---
+
+    def _member_slots(self, ni: NodeInfo, req, *, exclude_hosts: set[str]) -> int:
+        """How many members of ``req`` the node could take right now."""
+        if ni.tpu is None or ni.name in exclude_hosts:
+            return 0
+        reserved = self.reserved_fn(ni.name) if self.reserved_fn else 0
+        avail = available_chips(ni.tpu, req, reserved)
+        return max(avail // max(req.effective_chips, 1), 0)
+
+    def _host_fits_member(self, ni: NodeInfo, req, assigned_hosts: set[str]) -> bool:
+        return self._member_slots(ni, req, exclude_hosts=assigned_hosts) >= 1
+
+    # --- PreFilter: gang admission ---
+
+    def pre_filter(self, state: CycleState, pod: PodSpec, snapshot: Snapshot) -> Status:
+        req = get_request(state)
+        if req.gang is None:
+            return Status.ok()
+        with self._lock:
+            gs = self._gangs.get(req.gang.name)
+            if gs is None:
+                gs = _GangState(spec=req.gang)
+                self._gangs[req.gang.name] = gs
+            elif gs.spec.size != req.gang.size or gs.spec.topology != req.gang.topology:
+                return Status.unresolvable(
+                    f"gang {req.gang.name}: member declares size/topology "
+                    f"{req.gang.size}/{req.gang.topology}, gang has "
+                    f"{gs.spec.size}/{gs.spec.topology}"
+                )
+            if pod.key in gs.waiting:
+                return Status.unschedulable(f"pod {pod.key} already waiting in gang")
+            if pod.key in gs.bound:
+                # The scheduler only schedules unbound pods, so this entry is
+                # stale: a bind that failed after permit released the pod, or
+                # a delete+recreate the watch hasn't replayed. Self-heal by
+                # re-admitting (prevents the permanent wedge of counting a
+                # never-bound member as bound).
+                gs.bound.discard(pod.key)
+                gs.assigned.pop(pod.key, None)
+            remaining = gs.spec.size - len(gs.bound) - len(gs.waiting)
+
+            if gs.spec.topology is not None:
+                return self._pre_filter_topology(state, pod, snapshot, gs, req)
+
+            # Plain gang: capacity estimate over free slots. This member plus
+            # the other remaining members must all fit somewhere.
+            slots = sum(
+                self._member_slots(ni, req, exclude_hosts=set())
+                for ni in snapshot.infos()
+            )
+            if slots < remaining:
+                return Status.unschedulable(
+                    f"gang {req.gang.name}: {remaining} members still need "
+                    f"placement but only {slots} slots are free"
+                )
+            return Status.ok()
+
+    def _pre_filter_topology(self, state, pod, snapshot, gs: _GangState, req) -> Status:
+        assigned_hosts = set(gs.assigned.values())
+        plan_hosts_free = (
+            set(gs.plan) - assigned_hosts if gs.plan is not None else set()
+        )
+        # (Re)plan when there is no plan, or planned hosts became infeasible.
+        need_replan = gs.plan is None or not all(
+            self._host_fits_member(snapshot.get(h), req, assigned_hosts)
+            for h in plan_hosts_free
+            if h in snapshot
+        ) or not plan_hosts_free
+        # Replanning is safe while no member is parked at Permit (waiting
+        # members hold reservations on planned hosts). Members already BOUND
+        # (e.g. replayed after a scheduler restart) pin the new plan: the
+        # block must complete around their hosts.
+        if need_replan and len(gs.waiting) == 0:
+            pinned: dict[str, tuple[int, int, int]] = {}
+            for key in gs.bound:
+                host = gs.assigned.get(key)
+                ni = snapshot.get(host) if host and host in snapshot else None
+                if ni is None or ni.tpu is None:
+                    return Status.unschedulable(
+                        f"gang {gs.spec.name}: bound member {key} is on host "
+                        f"{host} with no TPU metrics; cannot plan around it"
+                    )
+                pinned[host] = ni.tpu.topology_coords
+            gs.plan = plan_slice_placement(
+                snapshot,
+                want_dims=gs.spec.topology,
+                host_ok=lambda ni: self._host_fits_member(ni, req, assigned_hosts),
+                pinned=pinned,
+            )
+            gs.assigned = {k: v for k, v in gs.assigned.items() if k in gs.bound}
+            plan_hosts_free = (
+                set(gs.plan) - set(pinned) if gs.plan else set()
+            )
+        if not plan_hosts_free:
+            return Status.unschedulable(
+                f"gang {gs.spec.name}: no slice has a free contiguous "
+                f"{'x'.join(map(str, gs.spec.topology))} host block"
+            )
+        state.write(ALLOWED_HOSTS_KEY, _AllowedHosts(frozenset(plan_hosts_free)))
+        return Status.ok()
+
+    # --- Filter: pin topology-gang members to planned hosts ---
+
+    def filter(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> Status:
+        if not state.contains(ALLOWED_HOSTS_KEY):
+            return Status.ok()
+        allowed = state.read(ALLOWED_HOSTS_KEY)
+        assert isinstance(allowed, _AllowedHosts)
+        if node.name in allowed.hosts:
+            return Status.ok()
+        return Status.unschedulable("host not in gang's planned ICI block")
+
+    # --- Permit: the barrier ---
+
+    def permit(self, state: CycleState, pod: PodSpec, node_name: str) -> tuple[Status, float]:
+        req = get_request(state)
+        if req.gang is None:
+            return Status.ok(), 0.0
+        with self._lock:
+            gs = self._gangs.get(req.gang.name)
+            if gs is None:
+                # A concurrent member-delete event can reap the gang between
+                # this pod's PreFilter and Permit.
+                return (
+                    Status.unschedulable(
+                        f"gang {req.gang.name} state vanished (member deleted?)"
+                    ),
+                    0.0,
+                )
+            gs.waiting.add(pod.key)
+            gs.assigned[pod.key] = node_name
+        return Status.wait(f"waiting for gang {req.gang.name}"), self.timeout_s
+
+    def on_pod_waiting(self, framework, wp) -> None:
+        """Framework hook, fired after the WaitingPod registers: if this was
+        the last member, release the whole gang."""
+        gang_name = None
+        with self._lock:
+            for name, gs in self._gangs.items():
+                if wp.pod.key in gs.waiting:
+                    gang_name = name
+                    break
+            if gang_name is None:
+                return
+            gs = self._gangs[gang_name]
+            complete = len(gs.waiting) + len(gs.bound) >= gs.spec.size
+            targets = list(gs.waiting) if complete else []
+        for key in targets:
+            w = framework.get_waiting_pod(key)
+            if w is not None:
+                w.allow(self.name)
+
+    def on_pod_resolved(self, framework, wp, status: Status) -> None:
+        """Framework hook on waitlist resolution: success moves the member to
+        bound; rejection cascades to the rest of the gang."""
+        with self._lock:
+            gs = next(
+                (g for g in self._gangs.values() if wp.pod.key in g.waiting), None
+            )
+            if gs is None:
+                return
+            gs.waiting.discard(wp.pod.key)
+            if status.success:
+                gs.bound.add(wp.pod.key)
+                if len(gs.bound) >= gs.spec.size:
+                    gs.assigned = {
+                        k: v for k, v in gs.assigned.items() if k in gs.bound
+                    }
+                return
+            # Rejection: roll the rest of the gang back (once).
+            gs.assigned.pop(wp.pod.key, None)
+            if gs.failing:
+                if not gs.waiting:  # cascade finished
+                    gs.failing = False
+                    gs.plan = None
+                return
+            gs.failing = True
+            targets = list(gs.waiting)
+        for key in targets:
+            w = framework.get_waiting_pod(key)
+            if w is not None:
+                w.reject(f"gang member {wp.pod.key} was rejected: {status.message}")
+        with self._lock:
+            if not gs.waiting:
+                gs.failing = False
+                gs.plan = None
+
+    # --- watch: membership lifecycle across restarts and deletions ---
+
+    def handle(self, event: Event) -> None:
+        if event.kind != "Pod":
+            return
+        pod: PodSpec = event.obj  # type: ignore[assignment]
+        gang_name = pod.labels.get("tpu/gang")
+        if not gang_name:
+            return
+        with self._lock:
+            gs = self._gangs.get(gang_name)
+            if event.type == "deleted":
+                if gs is not None:
+                    gs.bound.discard(pod.key)
+                    gs.waiting.discard(pod.key)
+                    gs.assigned.pop(pod.key, None)
+                    if not gs.bound and not gs.waiting:
+                        self._gangs.pop(gang_name, None)
+                return
+            if pod.node_name:
+                # Bound member (bind we initiated, or watch replay after a
+                # scheduler restart): reconstruct membership.
+                if gs is None:
+                    from yoda_tpu.api.requests import LabelParseError, parse_request
+
+                    try:
+                        spec = parse_request(pod.labels).gang
+                    except LabelParseError:
+                        return
+                    if spec is None:
+                        return
+                    gs = _GangState(spec=spec)
+                    self._gangs[gang_name] = gs
+                gs.bound.add(pod.key)
+                gs.assigned.setdefault(pod.key, pod.node_name)
+
+    # --- introspection (tests, metrics) ---
+
+    def gang_status(self, name: str) -> tuple[int, int, int] | None:
+        """(size, waiting, bound) or None."""
+        with self._lock:
+            gs = self._gangs.get(name)
+            if gs is None:
+                return None
+            return gs.spec.size, len(gs.waiting), len(gs.bound)

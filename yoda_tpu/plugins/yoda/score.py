@@ -24,7 +24,7 @@ panic on TotalMemorySum == 0).
 from __future__ import annotations
 
 from yoda_tpu.api.requests import LabelParseError, parse_request
-from yoda_tpu.config import Weights
+from yoda_tpu.config import SLICE_PROTECT_TIER, Weights
 from yoda_tpu.api.types import PodSpec, TpuChip, TpuNodeMetrics
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import NodeInfo, ScorePlugin, Status
@@ -112,3 +112,29 @@ class YodaScore(ScorePlugin):
             + actual_score(tpu, w)
         )
         return total, Status.ok()
+
+
+class SliceProtectScore(ScorePlugin):
+    """Anti-fragmentation tier (net-new; mirrors the tier in ops/kernel.py):
+    pods with no tpu/topology requirement strictly prefer hosts OUTSIDE
+    multi-host ICI slices, keeping slices whole for topology gangs. The
+    score is already tiered (0 or SLICE_PROTECT_TIER x weight > any
+    normalized metric score), so ``normalize`` is the identity."""
+
+    name = "yoda-slice-protect"
+
+    def __init__(self, weights: Weights | None = None) -> None:
+        self.weights = weights or Weights()
+
+    def score(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> tuple[int, Status]:
+        tpu = node.tpu
+        if tpu is None:
+            return 0, Status.ok()
+        req = get_request(state)
+        wants_topology = req.gang is not None and req.gang.topology is not None
+        if not wants_topology and not tpu.slice_id:
+            return SLICE_PROTECT_TIER * self.weights.slice_protect, Status.ok()
+        return 0, Status.ok()
+
+    def normalize(self, state: CycleState, pod: PodSpec, scores: dict[str, int]) -> Status:
+        return Status.ok()

@@ -1,0 +1,119 @@
+"""ICI topology matching: placing a host-grid request onto a slice.
+
+Net-new vs the reference (no topology awareness of any kind; SURVEY.md §2
+"Parallelism strategies" row): the structural TPU analog of sequence/model
+parallelism support is placing a gang so its hosts form a contiguous
+sub-block of one slice's ICI host grid — the job's collectives then ride ICI
+links, never DCN.
+
+Tractability (SURVEY.md §7 hard part 2): rather than general subgraph
+isomorphism, matching is restricted to axis-aligned sub-blocks of the fixed
+GKE-style slice grids (host grids are small — a v5p-128 pool is 4x4x2 = 32
+hosts — so exhaustive origin x axis-permutation search is cheap). Wraparound
+(torus) placements are not considered: GKE exposes plain grids at the host
+level, and non-wrapped blocks are always ICI-contiguous.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+
+from yoda_tpu.framework.interfaces import Snapshot
+
+Coord = tuple[int, int, int]
+
+
+def normalize_dims(dims: tuple[int, ...]) -> tuple[int, int, int]:
+    """Pad a 1-3 dim request to 3D (trailing 1s)."""
+    d = tuple(dims) + (1,) * (3 - len(dims))
+    return d[0], d[1], d[2]
+
+
+def find_subblock(
+    free: set[Coord],
+    want: tuple[int, int, int],
+    *,
+    must_include: frozenset[Coord] | set[Coord] = frozenset(),
+) -> list[Coord] | None:
+    """Find an axis-aligned ``want``-shaped block (any axis permutation)
+    whose coordinates are all in ``free | must_include`` and which contains
+    every ``must_include`` coordinate (hosts already holding gang members —
+    the block must complete around them). Returns the block's coords
+    (sorted) or None. Deterministic: smallest origin, first matching
+    permutation."""
+    usable = set(free) | set(must_include)
+    if not usable:
+        return None
+    xs, ys, zs = zip(*usable)
+    bounds = (max(xs) + 1, max(ys) + 1, max(zs) + 1)
+    seen_shapes: set[tuple[int, int, int]] = set()
+    for perm in itertools.permutations(want):
+        if perm in seen_shapes:
+            continue
+        seen_shapes.add(perm)
+        px, py, pz = perm
+        for ox, oy, oz in itertools.product(
+            range(bounds[0] - px + 1), range(bounds[1] - py + 1), range(bounds[2] - pz + 1)
+        ):
+            block = [
+                (ox + dx, oy + dy, oz + dz)
+                for dx in range(px)
+                for dy in range(py)
+                for dz in range(pz)
+            ]
+            block_set = set(block)
+            if block_set <= usable and must_include <= block_set:
+                return sorted(block)
+    return None
+
+
+def plan_slice_placement(
+    snapshot: Snapshot,
+    *,
+    want_dims: tuple[int, ...],
+    host_ok: "callable",
+    pinned: dict[str, Coord] | None = None,
+) -> dict[str, Coord] | None:
+    """Choose a slice and a contiguous sub-block of it for a gang.
+
+    ``host_ok(node_info) -> bool`` is the per-host feasibility predicate
+    (chips/HBM/health/reservations — the caller supplies the same predicate
+    the Filter uses). ``pinned`` maps hosts that already hold bound gang
+    members (e.g. after a scheduler restart) to their coords; the chosen
+    block must contain all of them, and they are exempt from ``host_ok``.
+    Returns {node_name: coord} for the chosen hosts (pinned included), or
+    None when no slice can currently host the gang.
+
+    Slices are tried in sorted order (deterministic); within a slice the
+    lowest-origin block wins — packing gangs toward slice origins keeps the
+    remaining free region as one large block (anti-fragmentation).
+    """
+    pinned = pinned or {}
+    want = normalize_dims(want_dims)
+    by_slice: dict[str, dict[Coord, str]] = defaultdict(dict)
+    pinned_slice: str | None = None
+    for ni in snapshot.infos():
+        if ni.tpu is None or not ni.tpu.slice_id:
+            continue
+        if ni.name in pinned:
+            if pinned_slice is not None and ni.tpu.slice_id != pinned_slice:
+                return None  # bound members span slices: unplannable
+            pinned_slice = ni.tpu.slice_id
+        elif host_ok(ni):
+            by_slice[ni.tpu.slice_id][ni.tpu.topology_coords] = ni.name
+    if pinned and pinned_slice is None:
+        return None  # pinned hosts no longer in the snapshot
+    must = frozenset(pinned.values())
+    slice_ids = [pinned_slice] if pinned else sorted(by_slice)
+    for slice_id in slice_ids:
+        free_coords = set(by_slice.get(slice_id, {}))
+        block = find_subblock(free_coords, want, must_include=must)
+        if block is None:
+            continue
+        coord_to_pinned = {c: h for h, c in pinned.items()}
+        return {
+            (coord_to_pinned[c] if c in coord_to_pinned else by_slice[slice_id][c]): c
+            for c in block
+        }
+    return None

@@ -18,6 +18,7 @@ from yoda_tpu.framework import Framework, Scheduler, SchedulingQueue
 from yoda_tpu.plugins.yoda import default_plugins
 from yoda_tpu.plugins.yoda.accounting import ChipAccountant
 from yoda_tpu.plugins.yoda.binder import ClusterBinder
+from yoda_tpu.plugins.yoda.gang import GangPlugin
 
 
 @dataclass
@@ -25,6 +26,7 @@ class Stack:
     cluster: FakeCluster
     informer: InformerCache
     accountant: ChipAccountant
+    gang: GangPlugin
     framework: Framework
     queue: SchedulingQueue
     scheduler: Scheduler
@@ -52,6 +54,11 @@ def build_stack(
         reserved_fn=accountant.chips_in_use,
         max_metrics_age_s=config.max_metrics_age_s,
     )
+    gang = GangPlugin(
+        timeout_s=config.gang_permit_timeout_s,
+        reserved_fn=accountant.chips_in_use,
+    )
+    plugins.append(gang)
     plugins.append(accountant)
     if extra_plugins:
         plugins.extend(extra_plugins)
@@ -75,7 +82,8 @@ def build_stack(
             p.claimed_fn = informer.claimed_hbm_mib
 
     cluster.add_watcher(accountant.handle)
+    cluster.add_watcher(gang.handle)
     cluster.add_watcher(informer.handle)
 
     scheduler = Scheduler(framework, informer.snapshot, queue, clock=clock)
-    return Stack(cluster, informer, accountant, framework, queue, scheduler)
+    return Stack(cluster, informer, accountant, gang, framework, queue, scheduler)
