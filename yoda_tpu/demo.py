@@ -10,6 +10,12 @@ from yoda_tpu.standalone import build_stack
 
 
 def run_demo(verbosity: int = 3) -> int:
+    # The demo is an in-memory smoke test: force the compute kernel onto
+    # CPU. (Env vars are not enough — a site hook may pre-import jax and
+    # pin the platform config; see .claude/skills/verify/SKILL.md.)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     stack = build_stack()
     agent = FakeTpuAgent(stack.cluster)
     agent.add_host("v5e-pool-a", generation="v5e", chips=8)

@@ -53,6 +53,49 @@ REASON_MESSAGES = {
     REASON_RESERVED: "qualifying chips reserved by in-flight pods",
 }
 
+# The kernel's input schema: FleetArrays fields, split by shape. [N] node
+# vectors vs [N, C] chip grids — the sharding layer row-shards both but
+# needs the split to build PartitionSpecs. Single source of truth for
+# fused_filter_score, yoda_tpu.parallel, and __graft_entry__.
+NODE_KEYS = (
+    "node_valid",
+    "in_slice",
+    "fresh",
+    "generation_rank",
+    "reserved_chips",
+    "claimed_hbm_mib",
+)
+CHIP_KEYS = (
+    "chip_valid",
+    "chip_healthy",
+    "chip_used",
+    "hbm_free_mib",
+    "hbm_total_mib",
+    "clock_mhz",
+    "hbm_bandwidth",
+    "tflops",
+    "power_w",
+)
+
+
+def arrays_dict(arrays: "FleetArrays") -> dict:
+    """Lower FleetArrays to the kernel's input dict."""
+    return {k: getattr(arrays, k) for k in NODE_KEYS + CHIP_KEYS}
+
+
+def result_from_outputs(arrays: "FleetArrays", outputs) -> "KernelResult":
+    """Trim padded kernel outputs back to the real node count."""
+    feasible, reasons, raw, final, best = outputs
+    n = arrays.n_nodes
+    best_i = int(best)
+    return KernelResult(
+        feasible=np.asarray(feasible)[:n],
+        reasons=np.asarray(reasons)[:n],
+        raw_scores=np.asarray(raw)[:n],
+        scores=np.asarray(final)[:n],
+        best_index=best_i if best_i < n else -1,
+    )
+
 
 @dataclass(frozen=True)
 class KernelRequest:
@@ -94,8 +137,7 @@ def _norm(metric: jnp.ndarray, maximum: jnp.ndarray) -> jnp.ndarray:
     return metric * 100 // maximum
 
 
-@functools.partial(jax.jit, static_argnames=("weights",))
-def _kernel(
+def kernel_impl(
     a: dict, number, hbm_mib, clock_mhz, gen_rank, wants_topology, weights: Weights
 ):
     healthy = a["chip_valid"] & a["chip_healthy"]
@@ -211,14 +253,21 @@ def _kernel(
     final = jnp.where(feasible, normalized + protect, 0).astype(jnp.int32)
 
     # --- select: highest score, ties -> later row (lexicographically
-    # greatest name, matching the Python driver's (score, name) max) ---
+    # greatest name, matching the Python driver's (score, name) max).
+    # argmax returns the FIRST max, so take it over the reversed array (no
+    # `final * n + idx` combined key — that overflows int32 at the fleet
+    # scales the sharded path serves). ---
     n = final.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    key = jnp.where(feasible, final * n + idx, -1)
-    best = jnp.argmax(key).astype(jnp.int32)
+    masked = jnp.where(feasible, final, -1)
+    best = (n - 1 - jnp.argmax(masked[::-1])).astype(jnp.int32)
     best = jnp.where(jnp.any(feasible), best, -1)
 
     return feasible, reasons, raw, final, best
+
+
+# Single-device jit; yoda_tpu.parallel re-jits kernel_impl with node-axis
+# shardings over a device mesh (the reductions become ICI collectives).
+_kernel = functools.partial(jax.jit, static_argnames=("weights",))(kernel_impl)
 
 
 def fused_filter_score(
@@ -229,25 +278,8 @@ def fused_filter_score(
 ) -> KernelResult:
     if isinstance(request, TpuRequest):
         request = KernelRequest.from_request(request)
-    a = {
-        "node_valid": arrays.node_valid,
-        "in_slice": arrays.in_slice,
-        "fresh": arrays.fresh,
-        "generation_rank": arrays.generation_rank,
-        "reserved_chips": arrays.reserved_chips,
-        "claimed_hbm_mib": arrays.claimed_hbm_mib,
-        "chip_valid": arrays.chip_valid,
-        "chip_healthy": arrays.chip_healthy,
-        "chip_used": arrays.chip_used,
-        "hbm_free_mib": arrays.hbm_free_mib,
-        "hbm_total_mib": arrays.hbm_total_mib,
-        "clock_mhz": arrays.clock_mhz,
-        "hbm_bandwidth": arrays.hbm_bandwidth,
-        "tflops": arrays.tflops,
-        "power_w": arrays.power_w,
-    }
-    feasible, reasons, raw, normalized, best = _kernel(
-        a,
+    outputs = _kernel(
+        arrays_dict(arrays),
         jnp.int32(request.number),
         jnp.int32(request.hbm_mib),
         jnp.int32(request.clock_mhz),
@@ -255,12 +287,4 @@ def fused_filter_score(
         jnp.int32(request.wants_topology),
         weights=weights or Weights(),
     )
-    n = arrays.n_nodes
-    best_i = int(best)
-    return KernelResult(
-        feasible=np.asarray(feasible)[:n],
-        reasons=np.asarray(reasons)[:n],
-        raw_scores=np.asarray(raw)[:n],
-        scores=np.asarray(normalized)[:n],
-        best_index=best_i if best_i < n else -1,
-    )
+    return result_from_outputs(arrays, outputs)

@@ -1,0 +1,117 @@
+"""Sharded fused filter+score: node-axis SPMD over a ``jax.sharding.Mesh``.
+
+Design (see package docstring): shard the fleet's row dimension, replicate
+request scalars, and let XLA turn the kernel's global reductions (cluster
+maxima, normalization bounds, argmax) into ICI collectives. No manual
+``psum`` calls — the shardings are declared on the jit boundary and the
+compiler inserts the collectives (the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from yoda_tpu.config import Weights
+from yoda_tpu.ops.arrays import FleetArrays
+from yoda_tpu.ops.kernel import (
+    CHIP_KEYS,
+    NODE_KEYS,
+    KernelRequest,
+    KernelResult,
+    arrays_dict,
+    kernel_impl,
+    result_from_outputs,
+)
+
+FLEET_AXIS = "fleet"
+
+
+def default_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices (all by
+    default): the fleet's row dimension maps onto it. Raises when fewer
+    devices exist than requested (silent truncation would quietly run an
+    n-way workload on fewer shards)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only "
+                f"{len(devs)} devices are available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), axis_names=(FLEET_AXIS,))
+
+
+@dataclass
+class ShardedFleetKernel:
+    """One compiled sharded executable per (mesh, weights, bucket shape).
+
+    Use :func:`sharded_filter_score` for the one-shot convenience path; hold
+    a ``ShardedFleetKernel`` when scheduling many pods against the same mesh
+    (the jit cache then keys only on bucket shape).
+    """
+
+    mesh: Mesh
+    weights: Weights
+
+    def __post_init__(self) -> None:
+        row = NamedSharding(self.mesh, P(FLEET_AXIS))
+        grid = NamedSharding(self.mesh, P(FLEET_AXIS, None))
+        rep = NamedSharding(self.mesh, P())
+        in_shardings = (
+            {k: (row if k in NODE_KEYS else grid) for k in NODE_KEYS + CHIP_KEYS},
+            rep,
+            rep,
+            rep,
+            rep,
+            rep,
+        )
+        # Outputs: per-node vectors stay row-sharded; best index replicated.
+        out_shardings = (row, row, row, row, rep)
+        self._jitted = jax.jit(
+            functools.partial(kernel_impl, weights=self.weights),
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+        )
+
+    def n_shards(self) -> int:
+        return self.mesh.devices.size
+
+    def __call__(
+        self, arrays: FleetArrays, request: KernelRequest
+    ) -> KernelResult:
+        shards = self.n_shards()
+        n_pad, _ = arrays.padded_shape
+        if n_pad % shards:
+            raise ValueError(
+                f"fleet bucket {n_pad} rows not divisible by {shards} mesh "
+                f"devices; pass node_bucket a multiple of the mesh size"
+            )
+        outputs = self._jitted(
+            arrays_dict(arrays),
+            np.int32(request.number),
+            np.int32(request.hbm_mib),
+            np.int32(request.clock_mhz),
+            np.int32(request.generation_rank),
+            np.int32(request.wants_topology),
+        )
+        return result_from_outputs(arrays, outputs)
+
+
+def sharded_filter_score(
+    arrays: FleetArrays,
+    request: KernelRequest,
+    *,
+    mesh: Mesh | None = None,
+    weights: Weights | None = None,
+) -> KernelResult:
+    """One-shot sharded evaluation (builds the kernel; prefer holding a
+    :class:`ShardedFleetKernel` across pods)."""
+    kern = ShardedFleetKernel(mesh or default_mesh(), weights or Weights())
+    return kern(arrays, request)
