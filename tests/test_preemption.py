@@ -1,0 +1,381 @@
+"""Preemption tests: the modern-PostFilter plugin (net-new vs the reference,
+whose v1alpha1 "PostFilter" was a pre-scoring hook and which had no
+preemption — SURVEY.md §3.2, §7 step 6) and the BASELINE config 5 mixed-fleet
+scenario: inference pods displaced by higher-priority training gangs.
+"""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+def bound_pods(stack, prefix=""):
+    return [
+        p for p in stack.cluster.list_pods()
+        if p.node_name and p.name.startswith(prefix)
+    ]
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestSinglePodPreemption:
+    def test_high_priority_evicts_low(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer").node_name == "host"
+
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer") is None  # evicted
+        assert stack.cluster.get_pod("default/train").node_name == "host"
+        assert stack.preemption.preempted_total == 1
+        assert stack.scheduler.stats.preempt_nominations >= 1
+
+    def test_equal_priority_is_not_evicted(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("a", labels={"tpu/chips": "2", "tpu/priority": "5"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec("b", labels={"tpu/chips": "2", "tpu/priority": "5"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/a").node_name == "host"
+        assert stack.cluster.get_pod("default/b").node_name is None
+        assert stack.preemption.preempted_total == 0
+
+    def test_prefers_node_with_lowest_priority_victims(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host-a", generation="v5e", chips=2)
+        agent.add_host("host-b", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("mid", labels={"tpu/chips": "2", "tpu/priority": "5"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        mid_node = stack.cluster.get_pod("default/mid").node_name
+        stack.cluster.create_pod(
+            PodSpec("low", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        low_node = stack.cluster.get_pod("default/low").node_name
+        assert {mid_node, low_node} == {"host-a", "host-b"}
+
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # The cheaper victim (priority 1) is chosen, not the priority-5 pod.
+        assert stack.cluster.get_pod("default/low") is None
+        assert stack.cluster.get_pod("default/mid").node_name == mid_node
+        assert stack.cluster.get_pod("default/train").node_name == low_node
+
+    def test_evicts_fewest_victims(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host-a", generation="v5e", chips=2)
+        agent.add_host("host-b", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("big", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        big_node = stack.cluster.get_pod("default/big").node_name
+        other = "host-b" if big_node == "host-a" else "host-a"
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(f"small-{i}", labels={"tpu/chips": "1", "tpu/priority": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert all(p.node_name == other for p in bound_pods(stack, "small"))
+
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # One 2-chip victim beats two 1-chip victims at equal priority.
+        assert stack.cluster.get_pod("default/big") is None
+        assert len(bound_pods(stack, "small")) == 2
+        assert stack.cluster.get_pod("default/train").node_name == big_node
+
+    def test_unschedulable_when_no_lower_priority_exists(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("top", labels={"tpu/chips": "2", "tpu/priority": "100"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec("mid", labels={"tpu/chips": "2", "tpu/priority": "50"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/top").node_name == "host"
+        assert stack.cluster.get_pod("default/mid").node_name is None
+        assert stack.preemption.preempted_total == 0
+
+    def test_never_evicts_on_nodes_filter_would_reject(self, mode):
+        # Regression: eviction must be restricted to nodes the preemptor
+        # could actually land on. A v5p-requiring pod must not kill pods on
+        # a v5e host it can never pass Filter on.
+        stack, agent = make_stack(mode)
+        agent.add_host("v5e-host", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "4", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer").node_name == "v5e-host"
+        stack.cluster.create_pod(
+            PodSpec(
+                "train",
+                labels={
+                    "tpu/chips": "4",
+                    "tpu/priority": "10",
+                    "tpu/generation": "v5p",
+                },
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer").node_name == "v5e-host"
+        assert stack.cluster.get_pod("default/train").node_name is None
+        assert stack.preemption.preempted_total == 0
+
+    def test_gang_ignores_free_capacity_on_wrong_generation(self, mode):
+        # Regression (plain-gang variant): free v5e capacity must not make
+        # the 'capacity already free; retry' branch livelock a v5p gang —
+        # the v5p host's victims must be evicted.
+        stack, agent = make_stack(mode)
+        agent.add_host("v5e-free", generation="v5e", chips=8)
+        agent.add_host("v5p-host", generation="v5p", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "infer",
+                labels={"tpu/chips": "4", "tpu/priority": "1",
+                        "tpu/generation": "v5p"},
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer").node_name == "v5p-host"
+        stack.cluster.create_pod(
+            PodSpec(
+                "train",
+                labels={
+                    "tpu/gang": "job",
+                    "tpu/gang-size": "1",
+                    "tpu/chips": "4",
+                    "tpu/priority": "10",
+                    "tpu/generation": "v5p",
+                },
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer") is None
+        assert stack.cluster.get_pod("default/train").node_name == "v5p-host"
+
+    def test_disabled_preemption_never_evicts(self, mode):
+        stack, agent = make_stack(mode, enable_preemption=False)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer").node_name == "host"
+        assert stack.cluster.get_pod("default/train").node_name is None
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestGangPreemption:
+    def test_plain_gang_clears_whole_hosts(self, mode):
+        # Members need a full 4-chip host each; victims are 1-chip pods.
+        # Eviction must clear hosts, not spread thin.
+        stack, agent = make_stack(mode)
+        for h in range(3):
+            agent.add_host(f"host-{h}", generation="v5e", chips=4)
+        agent.publish_all()
+        for i in range(12):
+            stack.cluster.create_pod(
+                PodSpec(f"infer-{i}", labels={"tpu/chips": "1", "tpu/priority": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert len(bound_pods(stack, "infer")) == 12
+
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train-{m}",
+                    labels={
+                        "tpu/gang": "job",
+                        "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                        "tpu/priority": "10",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        trained = bound_pods(stack, "train")
+        assert len(trained) == 2
+        assert len({p.node_name for p in trained}) == 2
+        # Exactly two hosts' worth of victims evicted, the third untouched.
+        assert stack.preemption.preempted_total == 8
+        assert len(bound_pods(stack, "infer")) == 4
+
+    def test_topology_gang_preempts_contiguous_block(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_slice("v5p", generation="v5p", host_topology=(2, 2, 1))
+        agent.add_host("v5e-spill", generation="v5e", chips=8)
+        agent.publish_all()
+        # Fill every slice host with low-priority pods (4 chips each host).
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"infer-{i}",
+                    labels={"tpu/chips": "4", "tpu/priority": "1",
+                            "tpu/generation": "v5p"},
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert len(bound_pods(stack, "infer")) == 4
+
+        for m in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train-{m}",
+                    labels={
+                        "tpu/gang": "slice-job",
+                        "tpu/topology": "2x2x1",
+                        "tpu/chips": "4",
+                        "tpu/priority": "10",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        trained = bound_pods(stack, "train")
+        assert len(trained) == 4
+        hosts = {p.node_name for p in trained}
+        assert len(hosts) == 4
+        assert all(h.startswith("v5p-") for h in hosts)
+        assert stack.preemption.preempted_total == 4
+
+    def test_gang_timeout_then_preemption_recovers(self, mode):
+        # A gang that cannot fully fit leaves no reservations behind after
+        # its permit window, and preemption then places it: fault-injection
+        # style (SURVEY.md §5 failure-detection row).
+        stack, agent = make_stack(mode, gang_permit_timeout_s=0.2)
+        agent.add_host("host-a", generation="v5e", chips=4)
+        agent.add_host("host-b", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "4", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train-{m}",
+                    labels={
+                        "tpu/gang": "job",
+                        "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                        "tpu/priority": "10",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        trained = bound_pods(stack, "train")
+        assert len(trained) == 2
+        assert stack.cluster.get_pod("default/infer") is None
+
+
+@pytest.mark.parametrize("mode", ["batch"])
+class TestBaselineConfig5MixedFleet:
+    def test_mixed_fleet_training_displaces_inference(self, mode):
+        # BASELINE config 5: a v5e-64 pool (8 hosts x 8 chips) saturated by
+        # 32 inference pods (2 chips each); two 4-member training gangs
+        # (8 chips/member) arrive at higher priority and must displace them.
+        stack, agent = make_stack(mode)
+        for h in range(8):
+            agent.add_host(f"v5e-{h}", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(32):
+            stack.cluster.create_pod(
+                PodSpec(f"infer-{i}", labels={"tpu/chips": "2", "tpu/priority": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_pods(stack, "infer")) == 32
+
+        for g in range(2):
+            for m in range(4):
+                stack.cluster.create_pod(
+                    PodSpec(
+                        f"train{g}-{m}",
+                        labels={
+                            "tpu/gang": f"job-{g}",
+                            "tpu/gang-size": "4",
+                            "tpu/chips": "8",
+                            "tpu/priority": "100",
+                        },
+                    )
+                )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        for g in range(2):
+            members = bound_pods(stack, f"train{g}")
+            assert len(members) == 4, f"gang {g} incomplete"
+            assert len({p.node_name for p in members}) == 4
+        # The fleet held exactly the two gangs' demand: every inference pod
+        # was evicted.
+        assert len(bound_pods(stack, "infer")) == 0
+        assert stack.preemption.preempted_total == 32
+
+    def test_mixed_fleet_partial_displacement(self, mode):
+        # Training takes only half the fleet: surviving inference pods must
+        # be exactly the fleet remainder and keep running untouched hosts.
+        stack, agent = make_stack(mode)
+        for h in range(8):
+            agent.add_host(f"v5e-{h}", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(32):
+            stack.cluster.create_pod(
+                PodSpec(f"infer-{i}", labels={"tpu/chips": "2", "tpu/priority": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+
+        for m in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train-{m}",
+                    labels={
+                        "tpu/gang": "job",
+                        "tpu/gang-size": "4",
+                        "tpu/chips": "8",
+                        "tpu/priority": "100",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert len(bound_pods(stack, "train")) == 4
+        assert stack.preemption.preempted_total == 16
+        assert len(bound_pods(stack, "infer")) == 16

@@ -60,6 +60,7 @@ class SchedulerConfig:
     gang_permit_timeout_s: float = 120.0
     max_metrics_age_s: float = 0.0    # 0 disables staleness filtering
     percentage_nodes_to_score: int = 100
+    enable_preemption: bool = True    # modern-PostFilter eviction (BASELINE config 5)
 
     @classmethod
     def from_dict(cls, d: dict) -> "SchedulerConfig":

@@ -101,6 +101,17 @@ class Scheduler:
 
         st = self.framework.run_pre_filter(state, pod, snapshot)
         if not st.success:
+            if st.code == Code.UNSCHEDULABLE:
+                # PreFilter rejections (gang admission: not enough capacity
+                # for the whole gang) reach PostFilter too, as upstream —
+                # preemption is how a training gang displaces inference pods
+                # (BASELINE config 5). Unresolvable (bad labels) cannot be
+                # helped by eviction.
+                nominated, pf_st = self.framework.run_post_filter(
+                    state, pod, snapshot, {}
+                )
+                if nominated:
+                    return done("nominated", node=nominated, message=pf_st.message)
             return done(
                 "unschedulable",
                 message=st.message,

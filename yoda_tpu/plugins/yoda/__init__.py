@@ -26,6 +26,7 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
 from yoda_tpu.plugins.yoda.collection import MaxValueData, YodaPreScore, MAX_KEY
 from yoda_tpu.plugins.yoda.score import SliceProtectScore, YodaScore, Weights
 from yoda_tpu.plugins.yoda.batch import YodaBatch
+from yoda_tpu.plugins.yoda.preemption import TpuPreemption
 
 
 def default_plugins(
@@ -66,6 +67,7 @@ def default_plugins(
 
 
 __all__ = [
+    "TpuPreemption",
     "YodaBatch",
     "default_plugins",
     "YodaSort",

@@ -324,3 +324,14 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             if gs is None:
                 return None
             return gs.spec.size, len(gs.waiting), len(gs.bound)
+
+    def planned_unassigned_hosts(self, name: str) -> list[str] | None:
+        """Hosts of a topology gang's current plan that no member has
+        reserved yet — the hosts the remaining members MUST land on. Used by
+        preemption to evict squatters from a mid-flight gang's plan without
+        replanning (plugins/yoda/preemption.py). None when no plan exists."""
+        with self._lock:
+            gs = self._gangs.get(name)
+            if gs is None or gs.plan is None:
+                return None
+            return sorted(set(gs.plan) - set(gs.assigned.values()))

@@ -19,6 +19,7 @@ from yoda_tpu.plugins.yoda import default_plugins
 from yoda_tpu.plugins.yoda.accounting import ChipAccountant
 from yoda_tpu.plugins.yoda.binder import ClusterBinder
 from yoda_tpu.plugins.yoda.gang import GangPlugin
+from yoda_tpu.plugins.yoda.preemption import TpuPreemption
 
 
 @dataclass
@@ -30,6 +31,7 @@ class Stack:
     framework: Framework
     queue: SchedulingQueue
     scheduler: Scheduler
+    preemption: TpuPreemption | None = None
 
 
 def build_stack(
@@ -60,6 +62,15 @@ def build_stack(
     )
     plugins.append(gang)
     plugins.append(accountant)
+    preemption = None
+    if config.enable_preemption:
+        preemption = TpuPreemption(
+            cluster.delete_pod,
+            reserved_fn=accountant.chips_in_use,
+            gang_status_fn=gang.gang_status,
+            gang_plan_fn=gang.planned_unassigned_hosts,
+        )
+        plugins.append(preemption)
     if extra_plugins:
         plugins.extend(extra_plugins)
     plugins.append(ClusterBinder(cluster))
@@ -86,4 +97,6 @@ def build_stack(
     cluster.add_watcher(informer.handle)
 
     scheduler = Scheduler(framework, informer.snapshot, queue, clock=clock)
-    return Stack(cluster, informer, accountant, gang, framework, queue, scheduler)
+    return Stack(
+        cluster, informer, accountant, gang, framework, queue, scheduler, preemption
+    )
