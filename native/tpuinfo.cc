@@ -1,0 +1,241 @@
+// libyoda_tpuinfo: host-side TPU metrics reader for the node agent.
+//
+// The reference's metric source was an external GPU "sniffer" DaemonSet
+// feeding the SCV CRD (reference readme.md:9-15; SURVEY.md §1-L5). This is
+// its TPU-native, in-tree equivalent: a small native library the agent
+// (yoda_tpu/agent/native.py, via ctypes) calls to inventory the host's TPU
+// chips. Native because it runs on every node at a tight interval and must
+// not depend on a Python TPU runtime being importable on the host.
+//
+// Collection sources, in priority order (yoda_tpuinfo_source() reports
+// which fired):
+//   1. YODA_TPUINFO_SPEC env override — deterministic spec string for tests
+//      and development clusters ("generation=v5e;chips=8;hbm_gib=16;...").
+//   2. TPU device inventory: /dev/accel* (TPU VM runtime) or /dev/vfio/*
+//      device nodes for the chip count, plus the GKE TPU environment
+//      (TPU_ACCELERATOR_TYPE, TPU_WORKER_ID) for generation/topology, with
+//      per-generation chip characteristics from a built-in table (the same
+//      table as yoda_tpu/agent/fake_publisher.py CHIP_SPECS).
+//   3. None: chip_count = 0 (the agent then publishes nothing, and the
+//      scheduler filters the node out — "no TPU metrics").
+//
+// Free HBM is reported as total when no runtime counter is available: chip
+// occupancy is tracked scheduler-side by the accountant
+// (yoda_tpu/plugins/yoda/accounting.py), so over-reporting free HBM is safe
+// (availability is clamped by reservations), while under-reporting would
+// strand capacity.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include <dirent.h>
+
+#define YODA_TPUINFO_MAX_CHIPS 16
+
+extern "C" {
+
+typedef struct {
+  int32_t index;
+  int32_t healthy;  // 1 = healthy
+  int64_t hbm_free;
+  int64_t hbm_total;
+  int32_t clock_mhz;
+  int32_t hbm_bandwidth_gbps;
+  int32_t tflops_bf16;
+  int32_t power_w;
+} yoda_tpuinfo_chip;
+
+typedef struct {
+  char generation[8];
+  char accel_type[32];
+  char slice_id[64];
+  int32_t coords[3];
+  int32_t chip_count;
+  yoda_tpuinfo_chip chips[YODA_TPUINFO_MAX_CHIPS];
+} yoda_tpuinfo_host;
+
+}  // extern "C"
+
+namespace {
+
+struct ChipSpec {
+  const char* generation;
+  int hbm_gib;
+  int clock_mhz;
+  int hbm_bandwidth_gbps;
+  int tflops_bf16;
+  int power_w;
+  int default_chips_per_host;
+};
+
+// Keep in sync with CHIP_SPECS in yoda_tpu/agent/fake_publisher.py.
+constexpr ChipSpec kSpecs[] = {
+    {"v4", 32, 940, 1200, 275, 170, 4},
+    {"v5e", 16, 940, 819, 197, 130, 8},
+    {"v5p", 95, 1050, 2765, 459, 250, 4},
+    {"v6e", 32, 1050, 1640, 918, 200, 8},
+};
+
+const ChipSpec* find_spec(const std::string& generation) {
+  for (const auto& s : kSpecs) {
+    if (generation == s.generation) return &s;
+  }
+  return nullptr;
+}
+
+const char* g_source = "none";
+
+void fill_chips(yoda_tpuinfo_host* out, const ChipSpec& spec, int count,
+                int64_t hbm_gib_override, int clock_override) {
+  if (count > YODA_TPUINFO_MAX_CHIPS) count = YODA_TPUINFO_MAX_CHIPS;
+  out->chip_count = count;
+  const int64_t gib = 1ll << 30;
+  const int64_t hbm =
+      (hbm_gib_override > 0 ? hbm_gib_override : spec.hbm_gib) * gib;
+  for (int i = 0; i < count; ++i) {
+    yoda_tpuinfo_chip& c = out->chips[i];
+    c.index = i;
+    c.healthy = 1;
+    c.hbm_free = hbm;
+    c.hbm_total = hbm;
+    c.clock_mhz = clock_override > 0 ? clock_override : spec.clock_mhz;
+    c.hbm_bandwidth_gbps = spec.hbm_bandwidth_gbps;
+    c.tflops_bf16 = spec.tflops_bf16;
+    c.power_w = spec.power_w;
+  }
+}
+
+// --- source 1: env spec override ---
+
+// "generation=v5e;chips=8;hbm_gib=16;clock=940;slice=pool-a;coords=1,0,2;
+//  accel_type=v5e-8" — unknown keys ignored, any order.
+bool collect_from_env_spec(yoda_tpuinfo_host* out) {
+  const char* spec_env = std::getenv("YODA_TPUINFO_SPEC");
+  if (spec_env == nullptr || spec_env[0] == '\0') return false;
+
+  std::string generation = "v5e";
+  int chips = -1;
+  int hbm_gib = -1;
+  int clock = -1;
+  std::string slice_id, accel_type;
+  int coords[3] = {0, 0, 0};
+
+  std::string s(spec_env);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    std::string kv = s.substr(pos, end - pos);
+    pos = end + 1;
+    size_t eq = kv.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = kv.substr(0, eq), val = kv.substr(eq + 1);
+    if (key == "generation") generation = val;
+    else if (key == "chips") chips = std::atoi(val.c_str());
+    else if (key == "hbm_gib") hbm_gib = std::atoi(val.c_str());
+    else if (key == "clock") clock = std::atoi(val.c_str());
+    else if (key == "slice") slice_id = val;
+    else if (key == "accel_type") accel_type = val;
+    else if (key == "coords")
+      std::sscanf(val.c_str(), "%d,%d,%d", &coords[0], &coords[1], &coords[2]);
+  }
+  const ChipSpec* spec = find_spec(generation);
+  if (spec == nullptr) return false;
+  if (chips < 0) chips = spec->default_chips_per_host;
+
+  std::snprintf(out->generation, sizeof(out->generation), "%s",
+                generation.c_str());
+  std::snprintf(out->accel_type, sizeof(out->accel_type), "%s",
+                accel_type.empty()
+                    ? (generation + "-" + std::to_string(chips)).c_str()
+                    : accel_type.c_str());
+  std::snprintf(out->slice_id, sizeof(out->slice_id), "%s", slice_id.c_str());
+  std::memcpy(out->coords, coords, sizeof(coords));
+  fill_chips(out, *spec, chips, hbm_gib, clock);
+  g_source = "env";
+  return true;
+}
+
+// --- source 2: device inventory + GKE TPU environment ---
+
+int count_matching(const char* dir, const char* prefix) {
+  DIR* d = opendir(dir);
+  if (d == nullptr) return 0;
+  int n = 0;
+  while (dirent* e = readdir(d)) {
+    if (std::strncmp(e->d_name, prefix, std::strlen(prefix)) == 0 &&
+        std::strcmp(e->d_name, ".") != 0 && std::strcmp(e->d_name, "..") != 0) {
+      ++n;
+    }
+  }
+  closedir(d);
+  return n;
+}
+
+// "v5p-16" -> generation "v5p"; "v5litepod-8" (GKE v5e name) -> "v5e".
+std::string generation_from_accel_type(const std::string& accel) {
+  size_t dash = accel.find('-');
+  std::string head = dash == std::string::npos ? accel : accel.substr(0, dash);
+  if (head == "v5litepod") return "v5e";
+  return head;
+}
+
+bool collect_from_devices(yoda_tpuinfo_host* out) {
+  // TPU VM runtime exposes one /dev/accel<N> per chip; VFIO setups expose
+  // /dev/vfio/<group> per chip (plus the "vfio" control node).
+  int chips = count_matching("/dev", "accel");
+  if (chips == 0) {
+    int vfio = count_matching("/dev/vfio", "");
+    if (vfio > 1) chips = vfio - 1;  // minus the /dev/vfio/vfio control node
+  }
+  if (chips == 0) return false;
+
+  const char* accel_env = std::getenv("TPU_ACCELERATOR_TYPE");
+  std::string accel = accel_env ? accel_env : "";
+  std::string generation =
+      accel.empty() ? "v5e" : generation_from_accel_type(accel);
+  const ChipSpec* spec = find_spec(generation);
+  if (spec == nullptr) spec = &kSpecs[1];  // default v5e characteristics
+
+  std::snprintf(out->generation, sizeof(out->generation), "%s",
+                generation.c_str());
+  std::snprintf(out->accel_type, sizeof(out->accel_type), "%s",
+                accel.empty()
+                    ? (generation + "-" + std::to_string(chips)).c_str()
+                    : accel.c_str());
+  // Multi-host slices: GKE sets TPU_WORKER_ID (host index within the slice)
+  // and the agent passes the slice identity via YODA_TPUINFO_SLICE (derived
+  // from the node pool); coords default to a 1-D layout by worker id — the
+  // control plane's richer topology labels refine this in the agent.
+  const char* slice = std::getenv("YODA_TPUINFO_SLICE");
+  std::snprintf(out->slice_id, sizeof(out->slice_id), "%s", slice ? slice : "");
+  const char* worker = std::getenv("TPU_WORKER_ID");
+  out->coords[0] = worker ? std::atoi(worker) : 0;
+  out->coords[1] = 0;
+  out->coords[2] = 0;
+  fill_chips(out, *spec, chips, -1, -1);
+  g_source = "device-files";
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fills *out; returns the chip count (0 = no TPU found).
+int yoda_tpuinfo_collect(yoda_tpuinfo_host* out) {
+  std::memset(out, 0, sizeof(*out));
+  if (collect_from_env_spec(out)) return out->chip_count;
+  if (collect_from_devices(out)) return out->chip_count;
+  g_source = "none";
+  return 0;
+}
+
+const char* yoda_tpuinfo_source(void) { return g_source; }
+
+int yoda_tpuinfo_max_chips(void) { return YODA_TPUINFO_MAX_CHIPS; }
+
+}  // extern "C"

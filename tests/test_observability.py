@@ -1,0 +1,161 @@
+"""Observability tests: registry rendering, histograms/quantiles, scheduler
+metric wiring, scheduling trace, and the /metrics endpoint (SURVEY.md §5
+tracing + metrics rows — all net-new; the reference had only klog lines)."""
+
+import urllib.request
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.metrics_server import MetricsServer
+from yoda_tpu.observability import Histogram, Registry
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(**cfg):
+    stack = build_stack(config=SchedulerConfig(**cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+class TestRegistry:
+    def test_counter_labels_and_render(self):
+        r = Registry()
+        c = r.counter("hits_total", "hits")
+        c.inc(result="bound")
+        c.inc(result="bound")
+        c.inc(result="error")
+        assert c.value(result="bound") == 2
+        assert c.total() == 3
+        text = r.render_prometheus()
+        assert 'hits_total{result="bound"} 2.0' in text
+        assert "# TYPE hits_total counter" in text
+
+    def test_gauge_lazy_collection(self):
+        r = Registry()
+        state = {"v": 5.0}
+        g = r.gauge("free_chips", "free", lambda: state["v"])
+        assert g.value() == 5.0
+        state["v"] = 2.0
+        assert "free_chips 2.0" in r.render_prometheus()
+
+    def test_histogram_buckets_and_quantile(self):
+        h = Histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+        for v in [0.005, 0.05, 0.5, 0.05, 0.07]:
+            h.observe(v)
+        assert h.count() == 5
+        assert h.quantile(0.5) == 0.05
+        text = "\n".join(h.render())
+        assert 'lat_bucket{le="0.01"} 1' in text
+        assert 'lat_bucket{le="+Inf"} 5' in text
+        assert "lat_count 5" in text
+
+    def test_histogram_labeled_series(self):
+        h = Histogram("lat", "latency")
+        h.observe(0.01, phase="filter")
+        h.observe(0.02, phase="score")
+        assert h.count(phase="filter") == 1
+        assert h.count(phase="score") == 1
+
+
+class TestSchedulerMetrics:
+    def test_cycle_metrics_populated(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        m = stack.metrics
+        assert m.attempts.value(result="bound") == 1
+        assert m.binds.value() == 1
+        assert m.latency.count(phase="total") == 1
+        assert m.latency.count(phase="filter") == 1
+        assert m.latency.quantile(0.99, phase="total") > 0
+
+    def test_fleet_gauges_track_reservations(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_tpu_chips_total 8.0" in text
+        assert "yoda_tpu_chips_free 8.0" in text
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "3"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_tpu_chips_free 5.0" in text
+
+    def test_chips_free_stable_across_agent_refresh(self):
+        # Regression: a bound pod's chips must be charged once (reservation
+        # OR visible HBM use), so the gauge must not drop when the agent
+        # republishes metrics.
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(PodSpec(f"p{i}", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert "yoda_tpu_chips_free 5.0" in stack.metrics.registry.render_prometheus()
+        agent.publish_all()  # usage now visible in metrics
+        assert "yoda_tpu_chips_free 5.0" in stack.metrics.registry.render_prometheus()
+
+    def test_gang_wait_and_preemption_metrics(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "4", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train-{m}",
+                    labels={
+                        "tpu/gang": "job",
+                        "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                        "tpu/priority": "10",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.metrics.preemptions.total() == 1
+        assert stack.metrics.gang_wait.count() == 2  # both members parked
+
+    def test_trace_records_decisions(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        traces = stack.metrics.recent_traces()
+        assert traces, "no trace recorded"
+        t = traces[-1]
+        assert t.pod_key == "default/p"
+        assert t.outcome == "bound" and t.node == "host"
+        assert t.nodes_feasible == 1 and t.nodes_total == 1
+        assert "filter" in t.phases_ms and "total" not in t.phases_ms
+        assert "bound" in t.oneline()
+
+
+class TestMetricsServer:
+    def test_endpoints(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert 'yoda_scheduling_attempts_total{result="bound"} 1.0' in metrics
+            assert "yoda_binds_total 1.0" in metrics
+            health = urllib.request.urlopen(f"{base}/healthz").read().decode()
+            assert health == "ok\n"
+            trace = urllib.request.urlopen(f"{base}/trace").read().decode()
+            assert "default/p: bound -> host" in trace
+        finally:
+            server.stop()

@@ -46,6 +46,32 @@ class TestSinglePodPreemption:
         assert stack.preemption.preempted_total == 1
         assert stack.scheduler.stats.preempt_nominations >= 1
 
+    def test_preempts_after_agent_refresh_makes_usage_visible(self, mode):
+        # Regression: once the node agent republishes metrics, a victim's
+        # chips are charged via visible HBM use instead of reservations; the
+        # eviction simulation must credit those chips as freeable or
+        # preemption is inert in steady state (real agents refresh every
+        # few seconds, deploy/yoda-tpu-agent.yaml).
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        agent.publish_all()  # victim's usage now metrics-visible
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer") is None
+        # The freed host's metrics still show the evicted pod's usage until
+        # the next agent refresh; publish and let the retry land.
+        agent.publish_all()
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/train").node_name == "host"
+        assert stack.preemption.preempted_total == 1
+
     def test_equal_priority_is_not_evicted(self, mode):
         stack, agent = make_stack(mode)
         agent.add_host("host", generation="v5e", chips=2)

@@ -11,5 +11,19 @@ hardware state and writes the node's CR. Two implementations:
 """
 
 from yoda_tpu.agent.fake_publisher import CHIP_SPECS, ChipSpec, FakeTpuAgent
+from yoda_tpu.agent.native import (
+    NativeTpuAgent,
+    collect_host_metrics,
+    collection_source,
+    load_library,
+)
 
-__all__ = ["CHIP_SPECS", "ChipSpec", "FakeTpuAgent"]
+__all__ = [
+    "CHIP_SPECS",
+    "ChipSpec",
+    "FakeTpuAgent",
+    "NativeTpuAgent",
+    "collect_host_metrics",
+    "collection_source",
+    "load_library",
+]

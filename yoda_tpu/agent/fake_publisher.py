@@ -19,6 +19,23 @@ from yoda_tpu.api.types import HEALTHY, TpuChip, TpuNodeMetrics
 GIB = 1 << 30
 
 
+def charge_bound_pods(free: list[int], pods, node_name: str) -> None:
+    """Attribute the HBM of pods bound to ``node_name`` onto per-chip free
+    values (greedy whole-chip packing, most-free chip first) — the one
+    occupancy model shared by the fake and native agents; the accountant and
+    preemption simulate against exactly this behavior."""
+    for pod in pods:
+        if pod.node_name != node_name or pod.phase not in ("Running", "Pending"):
+            continue
+        try:
+            req = parse_request(pod.labels)
+        except LabelParseError:
+            continue
+        for _ in range(req.effective_chips):
+            j = max(range(len(free)), key=lambda k: free[k])
+            free[j] = max(free[j] - max(req.hbm_per_chip, 1), 0)  # occupied chip
+
+
 @dataclass(frozen=True)
 class ChipSpec:
     hbm_gib: int
@@ -129,21 +146,11 @@ class FakeTpuAgent:
 
     def refresh(self, name: str) -> None:
         """Recompute and publish one host's CR, accounting for bound pods'
-        HBM (greedy whole-chip packing, most-free chip first)."""
+        HBM via the shared attribution model (``charge_bound_pods``)."""
         h = self._hosts[name]
         spec = CHIP_SPECS[h.generation]
         free = [spec.hbm_gib * GIB] * h.chips
-        for pod in self.cluster.list_pods():
-            if pod.node_name != name or pod.phase not in ("Running", "Pending"):
-                continue
-            try:
-                req = parse_request(pod.labels)
-            except LabelParseError:
-                continue
-            need = req.hbm_per_chip
-            for _ in range(req.effective_chips):
-                j = max(range(h.chips), key=lambda k: free[k])
-                free[j] = max(free[j] - max(need, 1), 0)  # occupied chip
+        charge_bound_pods(free, self.cluster.list_pods(), name)
         self.cluster.put_tpu_metrics(
             TpuNodeMetrics(
                 name=name,

@@ -1,0 +1,145 @@
+"""Native node agent: publishes this host's TpuNodeMetrics CR from the
+C++ metrics reader (native/tpuinfo.cc, built as libyoda_tpuinfo.so).
+
+The real-cluster counterpart of the fake publisher — the role the external
+SCV sniffer DaemonSet played for the reference (reference readme.md:9-15;
+SURVEY.md §1-L5). The ctypes binding keeps the agent free of any Python TPU
+runtime dependency: one dlopen, one struct, one call per refresh interval.
+
+Free-HBM attribution: the library over-reports free HBM (= total) when no
+runtime counter exists; the agent then subtracts the label-declared HBM of
+pods bound to this node (the same greedy whole-chip model as the fake
+publisher), so published metrics converge to the accountant's view between
+scheduler restarts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from pathlib import Path
+
+from yoda_tpu.api.types import HEALTHY, TpuChip, TpuNodeMetrics
+
+MAX_CHIPS = 16
+
+
+class _Chip(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("healthy", ctypes.c_int32),
+        ("hbm_free", ctypes.c_int64),
+        ("hbm_total", ctypes.c_int64),
+        ("clock_mhz", ctypes.c_int32),
+        ("hbm_bandwidth_gbps", ctypes.c_int32),
+        ("tflops_bf16", ctypes.c_int32),
+        ("power_w", ctypes.c_int32),
+    ]
+
+
+class _Host(ctypes.Structure):
+    _fields_ = [
+        ("generation", ctypes.c_char * 8),
+        ("accel_type", ctypes.c_char * 32),
+        ("slice_id", ctypes.c_char * 64),
+        ("coords", ctypes.c_int32 * 3),
+        ("chip_count", ctypes.c_int32),
+        ("chips", _Chip * MAX_CHIPS),
+    ]
+
+
+_SEARCH_PATHS = (
+    Path(__file__).resolve().parent.parent.parent / "native",
+    Path("/usr/local/lib/yoda_tpu"),
+)
+
+
+def load_library(path: str | os.PathLike | None = None):
+    """dlopen libyoda_tpuinfo.so; None if it is not built/installed
+    (callers fall back to the fake publisher)."""
+    candidates = (
+        [Path(path)] if path else [p / "libyoda_tpuinfo.so" for p in _SEARCH_PATHS]
+    )
+    for c in candidates:
+        if c.exists():
+            lib = ctypes.CDLL(str(c))
+            lib.yoda_tpuinfo_collect.argtypes = [ctypes.POINTER(_Host)]
+            lib.yoda_tpuinfo_collect.restype = ctypes.c_int
+            lib.yoda_tpuinfo_source.restype = ctypes.c_char_p
+            return lib
+    return None
+
+
+def collect_host_metrics(
+    node_name: str,
+    *,
+    lib=None,
+    now_fn=time.time,
+) -> TpuNodeMetrics | None:
+    """One native collection -> a TpuNodeMetrics CR (None: no TPU found or
+    library unavailable)."""
+    lib = lib or load_library()
+    if lib is None:
+        return None
+    host = _Host()
+    if lib.yoda_tpuinfo_collect(ctypes.byref(host)) <= 0:
+        return None
+    return TpuNodeMetrics(
+        name=node_name,
+        generation=host.generation.decode(),
+        accel_type=host.accel_type.decode(),
+        slice_id=host.slice_id.decode(),
+        topology_coords=tuple(host.coords),
+        last_updated_unix=now_fn(),
+        chips=[
+            TpuChip(
+                index=c.index,
+                health=HEALTHY if c.healthy else "Unhealthy",
+                hbm_free=c.hbm_free,
+                hbm_total=c.hbm_total,
+                clock_mhz=c.clock_mhz,
+                hbm_bandwidth_gbps=c.hbm_bandwidth_gbps,
+                tflops_bf16=c.tflops_bf16,
+                power_w=c.power_w,
+            )
+            for c in host.chips[: host.chip_count]
+        ],
+    )
+
+
+def collection_source(lib=None) -> str:
+    """Which collection path fired on the last collect:
+    "env" | "device-files" | "none"."""
+    lib = lib or load_library()
+    return lib.yoda_tpuinfo_source().decode() if lib else "unavailable"
+
+
+class NativeTpuAgent:
+    """Per-node publisher loop body: collect via the native library, attribute
+    bound pods' HBM, publish the CR. ``run_once`` is what the DaemonSet's
+    interval loop calls (deploy/yoda-tpu-agent.yaml --interval-s)."""
+
+    def __init__(self, cluster, node_name: str, *, lib=None, now_fn=time.time):
+        self.cluster = cluster  # needs put_tpu_metrics / list_pods
+        self.node_name = node_name
+        self.lib = lib or load_library()
+        self.now_fn = now_fn
+
+    def run_once(self) -> TpuNodeMetrics | None:
+        tpu = collect_host_metrics(self.node_name, lib=self.lib, now_fn=self.now_fn)
+        if tpu is None:
+            return None
+        self._attribute_bound_pods(tpu)
+        self.cluster.put_tpu_metrics(tpu)
+        return tpu
+
+    def _attribute_bound_pods(self, tpu: TpuNodeMetrics) -> None:
+        """HBM attribution via the one shared occupancy model
+        (agent/fake_publisher.py ``charge_bound_pods``)."""
+        from yoda_tpu.agent.fake_publisher import charge_bound_pods
+
+        free = [c.hbm_free for c in tpu.chips]
+        charge_bound_pods(free, self.cluster.list_pods(), self.node_name)
+        for chip, f in zip(tpu.chips, free):
+            chip.hbm_free = f

@@ -54,11 +54,13 @@ class WaitingPod:
         pending_plugins: set[str],
         deadline: float,
         on_resolved: Callable[["WaitingPod", Status], None],
+        parked_at: float | None = None,
     ) -> None:
         self.pod = pod
         self.node_name = node_name
         self.state = state
         self.deadline = deadline
+        self.parked_at = parked_at  # clock time the pod entered the waitlist
         self._pending = set(pending_plugins)
         self._on_resolved = on_resolved
         self._lock = threading.Lock()
@@ -246,6 +248,7 @@ class Framework:
             waiting_plugins,
             deadline=now + max_timeout,
             on_resolved=lambda w, s: self._finish_waiting(w, s, on_resolved),
+            parked_at=now,
         )
         with self._waiting_lock:
             self._waiting[pod.key] = wp

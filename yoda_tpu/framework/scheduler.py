@@ -25,6 +25,7 @@ from yoda_tpu.framework.interfaces import (
 )
 from yoda_tpu.framework.queue import QueuedPodInfo, SchedulingQueue
 from yoda_tpu.framework.runtime import Framework, WaitingPod
+from yoda_tpu.observability import PhaseTimer, SchedulingMetrics, TraceEntry
 
 
 @dataclass
@@ -56,6 +57,7 @@ class Scheduler:
         clock: Callable[[], float] = time.monotonic,
         on_bound: Callable[[PodSpec, str], None] | None = None,
         on_unschedulable: Callable[[PodSpec, str], None] | None = None,
+        metrics: SchedulingMetrics | None = None,
     ) -> None:
         self.framework = framework
         self.snapshot_fn = snapshot_fn
@@ -64,6 +66,7 @@ class Scheduler:
         self.stats = SchedulerStats()
         self.on_bound = on_bound
         self.on_unschedulable = on_unschedulable
+        self.metrics = metrics
         self._lock = threading.Lock()
 
     # --- one pod ---
@@ -73,6 +76,8 @@ class Scheduler:
         t0 = self.clock()
         state = CycleState()
         snapshot = self.snapshot_fn()
+        timer = PhaseTimer(self.clock)
+        feasible_count = 0
 
         def done(
             outcome: str,
@@ -84,6 +89,21 @@ class Scheduler:
             r = ScheduleResult(pod.key, outcome, node, message, self.clock() - t0)
             with self._lock:
                 self.stats.results.append(r)
+            if self.metrics is not None:
+                self.metrics.attempts.inc(result=outcome)
+                self.metrics.latency.observe(r.latency_s, phase="total")
+                timer.observe_into(self.metrics.latency)
+                self.metrics.trace(
+                    TraceEntry(
+                        pod_key=pod.key,
+                        outcome=outcome,
+                        node=node,
+                        nodes_total=len(snapshot),
+                        nodes_feasible=feasible_count,
+                        message=message,
+                        phases_ms=dict(timer.phases_ms),
+                    )
+                )
             if outcome == "unschedulable":
                 if unresolvable:
                     self.queue.park_unresolvable(qpi, message)
@@ -99,7 +119,8 @@ class Scheduler:
                     self.stats.preempt_nominations += 1
             return r
 
-        st = self.framework.run_pre_filter(state, pod, snapshot)
+        with timer.span("prefilter"):
+            st = self.framework.run_pre_filter(state, pod, snapshot)
         if not st.success:
             if st.code == Code.UNSCHEDULABLE:
                 # PreFilter rejections (gang admission: not enough capacity
@@ -107,9 +128,10 @@ class Scheduler:
                 # preemption is how a training gang displaces inference pods
                 # (BASELINE config 5). Unresolvable (bad labels) cannot be
                 # helped by eviction.
-                nominated, pf_st = self.framework.run_post_filter(
-                    state, pod, snapshot, {}
-                )
+                with timer.span("postfilter"):
+                    nominated, pf_st = self.framework.run_post_filter(
+                        state, pod, snapshot, {}
+                    )
                 if nominated:
                     return done("nominated", node=nominated, message=pf_st.message)
             return done(
@@ -119,26 +141,32 @@ class Scheduler:
             )
 
         # Fused batch filter+score (TPU-native hot path), else per-node loops.
-        batch = self.framework.run_batch_filter_score(state, pod, snapshot)
-        if batch is not None:
-            statuses, batch_scores = batch
-            feasible = sorted(batch_scores)
-        else:
-            statuses = self.framework.run_filters(state, pod, snapshot)
-            batch_scores = {}
-            feasible = sorted(n for n, s in statuses.items() if s.success)
+        with timer.span("filter"):
+            batch = self.framework.run_batch_filter_score(state, pod, snapshot)
+            if batch is not None:
+                statuses, batch_scores = batch
+                feasible = sorted(batch_scores)
+            else:
+                statuses = self.framework.run_filters(state, pod, snapshot)
+                batch_scores = {}
+                feasible = sorted(n for n, s in statuses.items() if s.success)
+        feasible_count = len(feasible)
 
         if not feasible:
-            nominated, pf_st = self.framework.run_post_filter(state, pod, snapshot, statuses)
+            with timer.span("postfilter"):
+                nominated, pf_st = self.framework.run_post_filter(
+                    state, pod, snapshot, statuses
+                )
             if nominated:
                 return done("nominated", node=nominated, message=pf_st.message)
             return done("unschedulable", message=summarize_failure(statuses))
 
-        st = self.framework.run_pre_score(state, pod, snapshot, feasible)
-        if not st.success:
-            return done("error", message=st.message)
+        with timer.span("score"):
+            st = self.framework.run_pre_score(state, pod, snapshot, feasible)
+            if not st.success:
+                return done("error", message=st.message)
 
-        totals, st = self.framework.run_scores(state, pod, snapshot, feasible)
+            totals, st = self.framework.run_scores(state, pod, snapshot, feasible)
         if not st.success:
             return done("error", message=st.message)
         if batch_scores:
@@ -156,13 +184,15 @@ class Scheduler:
 
         best = max(feasible, key=lambda n: (totals.get(n, 0), n))
 
-        st = self.framework.run_reserve(state, pod, best)
+        with timer.span("reserve"):
+            st = self.framework.run_reserve(state, pod, best)
         if not st.success:
             return done("unschedulable", node=best, message=st.message)
 
-        st = self.framework.run_permit(
-            state, pod, best, self._on_permit_resolved, now=self.clock()
-        )
+        with timer.span("permit"):
+            st = self.framework.run_permit(
+                state, pod, best, self._on_permit_resolved, now=self.clock()
+            )
         if st.code == Code.WAIT:
             return done("waiting", node=best)
         if not st.success:
@@ -178,6 +208,8 @@ class Scheduler:
             return done("unschedulable", node=node_name, message=st.message)
         with self._lock:
             self.stats.binds += 1
+        if self.metrics is not None:
+            self.metrics.binds.inc()
         if self.on_bound:
             self.on_bound(pod, node_name)
         self.queue.move_all_to_active()  # cluster changed: retry parked pods
@@ -187,11 +219,15 @@ class Scheduler:
         """Fires when a waiting pod is allowed (bind it) or rejected
         (roll back its reservation and requeue)."""
         pod = wp.pod
+        if self.metrics is not None and wp.parked_at is not None:
+            self.metrics.gang_wait.observe(max(self.clock() - wp.parked_at, 0.0))
         if status.success:
             st = self.framework.run_bind(wp.state, pod, wp.node_name)
             if st.success:
                 with self._lock:
                     self.stats.binds += 1
+                if self.metrics is not None:
+                    self.metrics.binds.inc()
                 if self.on_bound:
                     self.on_bound(pod, wp.node_name)
                 self.queue.move_all_to_active()

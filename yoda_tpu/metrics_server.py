@@ -1,0 +1,72 @@
+"""HTTP endpoint for metrics, health, and the scheduling trace.
+
+The reference exposed /metrics and pprof only via the wrapped upstream
+command (reference pkg/register/register.go:10; SURVEY.md §5). Here the
+endpoint is first-party and dependency-free (stdlib http.server):
+
+    GET /metrics  -> Prometheus text exposition of the registry
+    GET /healthz  -> 200 "ok" (liveness; the Deployment probes this,
+                     deploy/yoda-tpu-scheduler.yaml)
+    GET /trace    -> last N scheduling traces, one line each
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from yoda_tpu.observability import SchedulingMetrics
+
+
+class MetricsServer:
+    def __init__(self, metrics: SchedulingMetrics, *, host: str = "", port: int = 10259):
+        self.metrics = metrics
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer.metrics.registry.render_prometheus()
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/healthz":
+                    body, ctype = "ok\n", "text/plain"
+                elif path == "/trace":
+                    body = (
+                        "\n".join(
+                            t.oneline() for t in outer.metrics.recent_traces(100)
+                        )
+                        + "\n"
+                    )
+                    ctype = "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args) -> None:  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="yoda-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

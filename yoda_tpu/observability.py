@@ -1,0 +1,348 @@
+"""Observability: metrics registry, per-phase latency histograms, and the
+scheduling trace.
+
+The reference has NO first-party observability — only klog verbosity lines
+(reference pkg/yoda/scheduler.go:58,67,86,143) and whatever the wrapped
+upstream command exposes (SURVEY.md §5 tracing/metrics rows). Here the
+metrics the BASELINE targets are measured in (p99 scheduling latency,
+bin-packing efficiency) are first-class:
+
+- ``yoda_scheduling_attempts_total{result}``, ``yoda_binds_total``,
+  ``yoda_preemptions_total`` — counters.
+- ``yoda_scheduling_latency_seconds{phase}`` — histogram over the whole
+  cycle and each extension-point phase (the per-hook breakdown the <200 ms
+  p99 budget is debugged with).
+- ``yoda_gang_wait_seconds`` — histogram of Permit-parking time per gang
+  member.
+- ``yoda_tpu_chips_free`` / ``yoda_tpu_chips_total`` — fleet gauges
+  (bin-packing efficiency = 1 - free/total under load), collected lazily at
+  scrape time.
+- A bounded scheduling-trace ring (pod → feasible count → chosen node →
+  outcome, with per-phase timings) — the "scheduling-trace log" of
+  SURVEY.md §5, queryable in-process and dumped on demand.
+
+Everything is dependency-free (no prometheus_client in the image) and
+renders the Prometheus text exposition format for the /metrics endpoint
+(yoda_tpu/metrics_server.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Mapping
+
+# Latency buckets tuned around the 200 ms p99 target: resolution where the
+# budget lives, coarse tails for pathologies.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5, 1.0, 2.5, 10.0,
+)
+
+
+def _fmt_labels(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Gauge:
+    """A gauge; ``collect_fn`` makes it lazy (evaluated at scrape time),
+    which is how fleet-state gauges avoid a watch pipeline of their own."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        collect_fn: Callable[[], float | dict[tuple[tuple[str, str], ...], float]]
+        | None = None,
+    ) -> None:
+        self.name = name
+        self.help = help_
+        self.collect_fn = collect_fn
+        self._lock = threading.Lock()
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def value(self, **labels: str) -> float:
+        if self.collect_fn is not None:
+            got = self.collect_fn()
+            if isinstance(got, dict):
+                return got.get(tuple(sorted(labels.items())), 0.0)
+            return float(got)
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        if self.collect_fn is not None:
+            got = self.collect_fn()
+            values = got if isinstance(got, dict) else {(): float(got)}
+        else:
+            with self._lock:
+                values = dict(self._values)
+        for key, v in sorted(values.items()) or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        # label-key -> (per-bucket counts, count, sum, recent ring for quantiles)
+        self._series: dict[tuple[tuple[str, str], ...], list] = {}
+
+    def _series_for(self, key):
+        s = self._series.get(key)
+        if s is None:
+            s = [[0] * len(self.buckets), 0, 0.0, deque(maxlen=4096)]
+            self._series[key] = s
+        return s
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series_for(key)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    s[0][i] += 1
+            s[1] += 1
+            s[2] += value
+            s[3].append(value)
+
+    def count(self, **labels: str) -> int:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            return s[1] if s else 0
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Quantile over the recent-observation ring (exact for <=4096
+        samples — the BASELINE p99 is computed from this, not from bucket
+        interpolation)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            if not s or not s[3]:
+                return 0.0
+            data = sorted(s[3])
+        return data[min(int(len(data) * q), len(data) - 1)]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            series = {k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()}
+        for key, (counts, n, total) in sorted(series.items()):
+            labels = dict(key)
+            for b, c in zip(self.buckets, counts):
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels({**labels, 'le': repr(b)})} {c}"
+                )
+            out.append(f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {n}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {n}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: list = []
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self.register(Counter(name, help_))
+
+    def gauge(self, name: str, help_: str, collect_fn=None) -> Gauge:
+        return self.register(Gauge(name, help_, collect_fn))
+
+    def histogram(self, name: str, help_: str, buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, buckets))
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+@dataclass
+class TraceEntry:
+    """One scheduling attempt, end to end — the trace the reference lacked
+    (its debugging story was klog.V(3) lines, reference scheduler.go:67,143)."""
+
+    pod_key: str
+    outcome: str
+    node: str | None
+    nodes_total: int
+    nodes_feasible: int
+    message: str = ""
+    phases_ms: dict[str, float] = field(default_factory=dict)
+    wall_unix: float = 0.0
+
+    def oneline(self) -> str:
+        ph = " ".join(f"{k}={v:.2f}ms" for k, v in self.phases_ms.items())
+        return (
+            f"{self.pod_key}: {self.outcome}"
+            f"{' -> ' + self.node if self.node else ''} "
+            f"[{self.nodes_feasible}/{self.nodes_total} feasible] {ph}"
+            f"{' | ' + self.message if self.message else ''}"
+        )
+
+
+class SchedulingMetrics:
+    """The scheduler's metric set + trace ring, shared across plugins."""
+
+    def __init__(self, *, registry: Registry | None = None, trace_capacity: int = 512):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.attempts = r.counter(
+            "yoda_scheduling_attempts_total",
+            "Scheduling attempts by result (bound/waiting/unschedulable/nominated/error)",
+        )
+        self.binds = r.counter("yoda_binds_total", "Pods successfully bound")
+        self.preemptions = r.counter(
+            "yoda_preemptions_total", "Pods evicted by the preemption plugin"
+        )
+        self.latency = r.histogram(
+            "yoda_scheduling_latency_seconds",
+            "Scheduling cycle latency by phase (phase=total for the full cycle)",
+        )
+        self.gang_wait = r.histogram(
+            "yoda_gang_wait_seconds",
+            "Time gang members spend parked at Permit before bind/reject",
+        )
+        self._trace_lock = threading.Lock()
+        self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
+
+    # --- fleet gauges (lazy, fed by the informer at scrape time) ---
+
+    def attach_fleet(self, snapshot_fn, reserved_fn=None) -> None:
+        def chips_total() -> float:
+            return float(
+                sum(len(ni.tpu.healthy_chips()) for ni in snapshot_fn().infos() if ni.tpu)
+            )
+
+        def chips_free() -> float:
+            # A chip occupied by a running pod is charged either via its
+            # metrics-visible HBM use OR via an accountant reservation,
+            # never both — the same handoff model the filter uses
+            # (filter_plugin.invisible_reservations); subtracting full
+            # reservations here would double-count after agent refreshes.
+            from yoda_tpu.plugins.yoda.filter_plugin import invisible_reservations
+
+            free = 0
+            for ni in snapshot_fn().infos():
+                if ni.tpu is None:
+                    continue
+                reserved = reserved_fn(ni.name) if reserved_fn else 0
+                unused = sum(
+                    1
+                    for c in ni.tpu.healthy_chips()
+                    if c.hbm_free >= c.hbm_total
+                )
+                free += max(unused - invisible_reservations(ni.tpu, reserved), 0)
+            return float(free)
+
+        self.registry.gauge(
+            "yoda_tpu_chips_total", "Healthy TPU chips in the fleet", chips_total
+        )
+        self.registry.gauge(
+            "yoda_tpu_chips_free",
+            "Healthy TPU chips not occupied or reserved "
+            "(bin-packing efficiency = 1 - free/total under saturation)",
+            chips_free,
+        )
+
+    # --- trace ---
+
+    def trace(self, entry: TraceEntry) -> None:
+        entry.wall_unix = entry.wall_unix or time.time()
+        with self._trace_lock:
+            self._trace.append(entry)
+
+    def recent_traces(self, n: int = 50) -> list[TraceEntry]:
+        with self._trace_lock:
+            return list(self._trace)[-n:]
+
+
+class PhaseTimer:
+    """Accumulates per-phase wall time for one scheduling cycle."""
+
+    def __init__(self, clock: Callable[[], float]) -> None:
+        self.clock = clock
+        self.phases_ms: dict[str, float] = {}
+
+    class _Span:
+        def __init__(self, timer: "PhaseTimer", name: str) -> None:
+            self.timer = timer
+            self.name = name
+
+        def __enter__(self):
+            self.t0 = self.timer.clock()
+            return self
+
+        def __exit__(self, *exc):
+            dt = (self.timer.clock() - self.t0) * 1e3
+            self.timer.phases_ms[self.name] = (
+                self.timer.phases_ms.get(self.name, 0.0) + dt
+            )
+            return False
+
+    def span(self, name: str) -> "_Span":
+        return self._Span(self, name)
+
+    def observe_into(self, hist: Histogram) -> None:
+        for phase, ms in self.phases_ms.items():
+            hist.observe(ms / 1e3, phase=phase)

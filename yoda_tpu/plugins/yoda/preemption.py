@@ -55,8 +55,10 @@ from yoda_tpu.framework.interfaces import (
 )
 from yoda_tpu.plugins.yoda.filter_plugin import (
     REQUEST_KEY,
+    apparently_used_chips,
     available_chips,
     get_request,
+    qualifying_chips,
 )
 from yoda_tpu.plugins.yoda.topology import plan_slice_placement
 
@@ -84,12 +86,14 @@ class TpuPreemption(PostFilterPlugin):
         reserved_fn: Callable[[str], int] | None = None,
         gang_status_fn: Callable[[str], tuple[int, int, int] | None] | None = None,
         gang_plan_fn: Callable[[str], list[str] | None] | None = None,
+        on_evicted: Callable[[int], None] | None = None,
         scheduler_name: str = "yoda-tpu",
     ) -> None:
         self.evict_fn = evict_fn
         self.reserved_fn = reserved_fn
         self.gang_status_fn = gang_status_fn
         self.gang_plan_fn = gang_plan_fn
+        self.on_evicted = on_evicted
         self.scheduler_name = scheduler_name
         self._lock = threading.Lock()
         self.preempted_total = 0  # pods evicted (metrics: preemptions_total)
@@ -134,8 +138,33 @@ class TpuPreemption(PostFilterPlugin):
         )
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
+        """Qualifying chips claimable once victims freeing ``freed`` chips
+        are gone. A victim's chips may be charged either as an accountant
+        reservation (before the node agent's refresh) or as metrics-visible
+        HBM use (after) — never both (the handoff model of
+        filter_plugin.available_chips). Eviction must credit BOTH forms:
+        subtracting only from ``reserved`` would make preemption inert in
+        steady state, when every victim's usage is already visible."""
         reserved = self.reserved_fn(ni.name) if self.reserved_fn else 0
-        return available_chips(ni.tpu, req, max(reserved - freed, 0))
+        if freed == 0:
+            return available_chips(ni.tpu, req, reserved)
+        # Chips whose metrics-visible usage could return to service and then
+        # satisfy this request (full HBM and clock qualify once freed).
+        freeable_visible = sum(
+            1
+            for c in ni.tpu.chips
+            if c.healthy
+            and c.hbm_free < c.hbm_total
+            and c.hbm_total >= req.hbm_per_chip
+            and c.clock_mhz >= req.min_clock_mhz
+        )
+        visible = apparently_used_chips(ni.tpu)
+        visible_freed = min(freed, freeable_visible)
+        unused = sum(
+            1 for c in qualifying_chips(ni.tpu, req) if c.hbm_free >= c.hbm_total
+        )
+        new_invisible = max((reserved - freed) - (visible - min(freed, visible)), 0)
+        return unused + visible_freed - new_invisible
 
     def _minimal_set(
         self, ni: NodeInfo, req: TpuRequest, needed: int, max_priority: int
@@ -372,3 +401,5 @@ class TpuPreemption(PostFilterPlugin):
             self.evict_fn(v.pod.key)
         with self._lock:
             self.preempted_total += len(victims)
+        if self.on_evicted is not None:
+            self.on_evicted(len(victims))

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from yoda_tpu.cluster import Event, FakeCluster, InformerCache
 from yoda_tpu.config import SchedulerConfig
 from yoda_tpu.framework import Framework, Scheduler, SchedulingQueue
+from yoda_tpu.observability import SchedulingMetrics
 from yoda_tpu.plugins.yoda import default_plugins
 from yoda_tpu.plugins.yoda.accounting import ChipAccountant
 from yoda_tpu.plugins.yoda.binder import ClusterBinder
@@ -32,6 +33,7 @@ class Stack:
     queue: SchedulingQueue
     scheduler: Scheduler
     preemption: TpuPreemption | None = None
+    metrics: SchedulingMetrics | None = None
 
 
 def build_stack(
@@ -49,6 +51,7 @@ def build_stack(
     cluster = cluster or FakeCluster()
     config = config or SchedulerConfig()
     accountant = ChipAccountant()
+    metrics = SchedulingMetrics()
 
     plugins = default_plugins(
         mode=config.mode,
@@ -69,6 +72,7 @@ def build_stack(
             reserved_fn=accountant.chips_in_use,
             gang_status_fn=gang.gang_status,
             gang_plan_fn=gang.planned_unassigned_hosts,
+            on_evicted=metrics.preemptions.inc,
         )
         plugins.append(preemption)
     if extra_plugins:
@@ -96,7 +100,18 @@ def build_stack(
     cluster.add_watcher(gang.handle)
     cluster.add_watcher(informer.handle)
 
-    scheduler = Scheduler(framework, informer.snapshot, queue, clock=clock)
+    metrics.attach_fleet(informer.snapshot, accountant.chips_in_use)
+    scheduler = Scheduler(
+        framework, informer.snapshot, queue, clock=clock, metrics=metrics
+    )
     return Stack(
-        cluster, informer, accountant, gang, framework, queue, scheduler, preemption
+        cluster,
+        informer,
+        accountant,
+        gang,
+        framework,
+        queue,
+        scheduler,
+        preemption,
+        metrics,
     )
