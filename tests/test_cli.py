@@ -1,0 +1,124 @@
+"""CLI entry points driven end-to-end against the fake API server.
+
+The reference's only 'test' of its binary was deploying it to a cluster
+(SURVEY.md §4); here both binary modes — scheduler and node agent — run
+in-process against real HTTP.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
+from yoda_tpu.testing import FakeKubeApiServer
+
+
+def wait_until(cond, timeout_s: float = 15.0, msg: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def server(monkeypatch):
+    with FakeKubeApiServer() as srv:
+        monkeypatch.setenv("YODA_KUBE_API_URL", srv.base_url)
+        yield srv
+
+
+@pytest.fixture()
+def run_main_bg():
+    """Run cli.main in a thread; guarantees the loop is stopped (via the
+    embedded-caller stop event) at teardown so leaked scheduler/agent loops
+    cannot spin against a dead API server across tests."""
+    from yoda_tpu.cli import main
+
+    stops: list[tuple[threading.Event, threading.Thread]] = []
+
+    def run(argv: list[str]) -> threading.Thread:
+        stop = threading.Event()
+        t = threading.Thread(target=main, args=(argv,), kwargs={"stop": stop})
+        t.daemon = True
+        t.start()
+        stops.append((stop, t))
+        return t
+
+    yield run
+    for stop, t in stops:
+        stop.set()
+    for _, t in stops:
+        t.join(timeout=10)
+
+
+class TestSchedulerMode:
+    def test_binds_pod_from_api_server(self, server, tmp_path, run_main_bg):
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("mode: batch\nweights:\n  hbm_free: 3\n")
+        run_main_bg(["--config", str(cfg), "--metrics-port", "-1"])
+        seed = KubeCluster(
+            KubeApiClient(KubeApiConfig(base_url=server.base_url, watch_timeout_s=2))
+        )
+        seed.put_tpu_metrics(make_node("n1", chips=4))
+        seed.create_pod(PodSpec("cli-pod", labels={"tpu/chips": "1"}))
+        wait_until(
+            lambda: (server.get_object("Pod", "default/cli-pod") or {})
+            .get("spec", {})
+            .get("nodeName")
+            == "n1",
+            msg="CLI scheduler bound the pod",
+        )
+
+    def test_bad_config_rejected(self, server, tmp_path):
+        from yoda_tpu.cli import main
+
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("mode: warp\n")
+        with pytest.raises(ValueError, match="mode"):
+            main(["--config", str(cfg), "--metrics-port", "-1"])
+
+
+class TestAgentMode:
+    def test_agent_requires_node_name(self, server, monkeypatch, capsys):
+        from yoda_tpu.cli import main
+
+        monkeypatch.delenv("NODE_NAME", raising=False)
+        assert main(["--agent"]) == 2
+
+    def test_agent_refuses_fake_without_flag(self, server, monkeypatch, tmp_path):
+        from yoda_tpu.cli import main
+
+        monkeypatch.setenv("NODE_NAME", "worker-0")
+        # Point at a nonexistent lib path so the native reader is absent.
+        assert (
+            main(["--agent", "--tpuinfo-lib", str(tmp_path / "nope.so")]) == 2
+        )
+
+    def test_agent_publishes_fake_profile(self, server, monkeypatch, tmp_path, run_main_bg):
+        monkeypatch.setenv("NODE_NAME", "worker-0")
+        # Bogus lib path: force the fake-publisher fallback even on hosts
+        # where the native reader is built (it finds no TPU here anyway).
+        run_main_bg(
+            [
+                "--agent",
+                "--allow-fake",
+                "--tpuinfo-lib",
+                str(tmp_path / "absent.so"),
+                "--fake-chips",
+                "8",
+                "--interval-s",
+                "0.2",
+            ]
+        )
+        wait_until(
+            lambda: server.get_object("TpuNodeMetrics", "worker-0") is not None,
+            msg="agent published CR",
+        )
+        obj = server.get_object("TpuNodeMetrics", "worker-0")
+        assert obj["status"]["chipCount"] == 8

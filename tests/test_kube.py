@@ -1,0 +1,238 @@
+"""KubeCluster against the in-process fake Kubernetes API server.
+
+Drives the production wire path — HTTP list/watch with resourceVersion
+resume, chunked watch streams, 410-Gone relists, the pods/binding
+subresource — which the reference never tests (it has no tests; SURVEY.md
+§4). The e2e case at the bottom is BASELINE config 1 on the real-client
+stack: fake API server standing in for the kind cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
+from yoda_tpu.cluster.kube import CR_PATH, KubeApiError
+from yoda_tpu.testing import FakeKubeApiServer
+
+POLL_S = 0.02
+
+
+def wait_until(cond, timeout_s: float = 10.0, msg: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(POLL_S)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def server():
+    with FakeKubeApiServer() as srv:
+        yield srv
+
+
+@pytest.fixture()
+def cluster(server):
+    api = KubeApiClient(KubeApiConfig(base_url=server.base_url, watch_timeout_s=2))
+    kc = KubeCluster(api, backoff_initial_s=0.05, backoff_max_s=0.2)
+    kc.start()
+    assert kc.wait_for_sync(10.0)
+    yield kc
+    kc.stop()
+
+
+class TestApiClient:
+    def test_request_and_error(self, server):
+        api = KubeApiClient(KubeApiConfig(base_url=server.base_url))
+        data = api.request("GET", "/api/v1/pods")
+        assert data["items"] == []
+        with pytest.raises(KubeApiError) as e:
+            api.request("GET", "/api/v1/namespaces/default/pods/nope")
+        assert e.value.status == 404
+
+    def test_watch_sees_event_then_orderly_end(self, server):
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=1)
+        )
+        server.put_object(
+            "Pod",
+            "default/a",
+            PodSpec("a").to_obj(),
+        )
+        events = list(api.watch("/api/v1/pods"))
+        assert [e["type"] for e in events] == ["ADDED"]
+        assert events[0]["object"]["metadata"]["name"] == "a"
+
+
+class TestKubeCluster:
+    def test_initial_sync_and_replay(self, server):
+        server.put_object("Pod", "default/p1", PodSpec("p1").to_obj())
+        server.put_object(
+            "TpuNodeMetrics", "node-1", make_node("node-1", chips=4).to_obj()
+        )
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05)
+        kc.start()
+        assert kc.wait_for_sync(10.0)
+        try:
+            assert [p.name for p in kc.list_pods()] == ["p1"]
+            assert [t.name for t in kc.list_tpu_metrics()] == ["node-1"]
+            seen = []
+            kc.add_watcher(lambda e: seen.append((e.type, e.kind)))
+            assert ("added", "Pod") in seen
+            assert ("added", "TpuNodeMetrics") in seen
+        finally:
+            kc.stop()
+
+    def test_watch_event_flow(self, cluster, server):
+        events = []
+        cluster.add_watcher(lambda e: events.append(e))
+        cluster.create_pod(PodSpec("w1", labels={"tpu/chips": "1"}))
+        wait_until(
+            lambda: any(
+                e.type == "added" and e.kind == "Pod" and e.obj.name == "w1"
+                for e in events
+            ),
+            msg="pod added event",
+        )
+        cluster.bind_pod("default/w1", "node-9")
+        wait_until(
+            lambda: any(
+                e.type == "modified" and e.obj.node_name == "node-9"
+                for e in events
+                if e.kind == "Pod"
+            ),
+            msg="pod bind event",
+        )
+        assert server.get_object("Pod", "default/w1")["spec"]["nodeName"] == "node-9"
+        cluster.delete_pod("default/w1")
+        wait_until(
+            lambda: any(e.type == "deleted" and e.kind == "Pod" for e in events),
+            msg="pod deleted event",
+        )
+        assert cluster.get_pod("default/w1") is None
+
+    def test_bind_conflict_raises(self, cluster):
+        cluster.create_pod(PodSpec("c1"))
+        cluster.bind_pod("default/c1", "node-1")
+        with pytest.raises(ValueError, match="already bound"):
+            cluster.bind_pod("default/c1", "node-2")
+        # Same-node rebind is idempotent on the server.
+        cluster.bind_pod("default/c1", "node-1")
+
+    def test_delete_absent_pod_is_noop(self, cluster):
+        cluster.delete_pod("default/ghost")
+
+    def test_tpu_metrics_create_then_update(self, cluster, server):
+        node = make_node("tpu-a", chips=8)
+        cluster.put_tpu_metrics(node)
+        wait_until(
+            lambda: [t.name for t in cluster.list_tpu_metrics()] == ["tpu-a"],
+            msg="CR synced",
+        )
+        node2 = make_node("tpu-a", chips=8, hbm_free_per_chip=1 << 30)
+        cluster.put_tpu_metrics(node2)  # update path (GET + PUT with rv)
+        wait_until(
+            lambda: cluster.list_tpu_metrics()
+            and cluster.list_tpu_metrics()[0].hbm_free_sum == 8 << 30,
+            msg="CR update observed",
+        )
+        assert server.get_object("TpuNodeMetrics", "tpu-a") is not None
+        cluster.delete_tpu_metrics("tpu-a")
+        wait_until(
+            lambda: cluster.list_tpu_metrics() == [], msg="CR delete observed"
+        )
+
+    def test_410_gone_forces_relist(self, cluster, server):
+        cluster.create_pod(PodSpec("before"))
+        wait_until(
+            lambda: cluster.get_pod("default/before") is not None,
+            msg="pre-compaction pod",
+        )
+        server.compact()
+        # Mutations after compaction: the in-flight watch cursor predates the
+        # window, so the next (re)watch gets 410 and the client must relist.
+        server.put_object("Pod", "default/after", PodSpec("after").to_obj())
+        server.delete_object("Pod", "default/before")
+        wait_until(
+            lambda: cluster.get_pod("default/after") is not None
+            and cluster.get_pod("default/before") is None,
+            timeout_s=15.0,
+            msg="post-compaction relist reconciliation",
+        )
+
+    def test_relist_diff_emits_events(self, server):
+        """Deletions that happen while the client is disconnected surface as
+        'deleted' events from the relist diff (informer accounting depends
+        on this)."""
+        server.put_object("Pod", "default/stay", PodSpec("stay").to_obj())
+        server.put_object("Pod", "default/go", PodSpec("go").to_obj())
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=1)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05)
+        kc.start()
+        assert kc.wait_for_sync(10.0)
+        events = []
+        kc.add_watcher(lambda e: events.append(e))
+        try:
+            server.compact()
+            server.delete_object("Pod", "default/go")
+            wait_until(
+                lambda: any(
+                    e.type == "deleted" and e.kind == "Pod" and e.obj.name == "go"
+                    for e in events
+                ),
+                timeout_s=15.0,
+                msg="deleted event from relist diff",
+            )
+            assert kc.get_pod("default/stay") is not None
+        finally:
+            kc.stop()
+
+
+class TestKubeE2E:
+    def test_pod_scheduled_through_real_client_stack(self, server):
+        """BASELINE config 1 on the production client: fake API server +
+        KubeCluster + full plugin stack; a tpu/hbm pod binds to the only
+        node advertising TPUs, and the binding lands in the (fake) API
+        server."""
+        from yoda_tpu.standalone import build_stack
+
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05)
+        kc.start()
+        assert kc.wait_for_sync(10.0)
+        stack = build_stack(cluster=kc)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=stack.scheduler.serve_forever, args=(stop,), daemon=True
+        )
+        t.start()
+        try:
+            kc.put_tpu_metrics(make_node("tpu-node-1", chips=4))
+            kc.create_pod(
+                PodSpec("smoke", labels={"tpu/hbm": "1000", "tpu/chips": "1"})
+            )
+            wait_until(
+                lambda: (server.get_object("Pod", "default/smoke") or {})
+                .get("spec", {})
+                .get("nodeName")
+                == "tpu-node-1",
+                timeout_s=20.0,
+                msg="pod bound via API server",
+            )
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            kc.stop()

@@ -405,3 +405,75 @@ class TestBaselineConfig5MixedFleet:
         assert len(bound_pods(stack, "train")) == 4
         assert stack.preemption.preempted_total == 16
         assert len(bound_pods(stack, "infer")) == 16
+
+
+class TestAvailAfterModel:
+    """Unit pins for the eviction capacity simulation (_avail_after): each
+    occupied chip is charged exactly once — accountant reservation while the
+    chip still reads fully-free, or metrics-visible HBM use after the agent
+    refresh — and eviction credits one claimable chip per freed chip."""
+
+    def _prep(self, tpu, reserved):
+        from yoda_tpu.api.requests import parse_request
+        from yoda_tpu.framework.interfaces import NodeInfo
+        from yoda_tpu.plugins.yoda.preemption import TpuPreemption
+
+        plugin = TpuPreemption(lambda key: None, reserved_fn=lambda n: reserved)
+        req = parse_request({"tpu/chips": "4", "tpu/priority": "10"})
+        return plugin, NodeInfo("host", tpu=tpu), req
+
+    def test_mixed_visible_victim_and_invisible_bystander(self):
+        """4 chips: victim V's 2 chips metrics-visible, bystander X's 2
+        reservations not yet visible (reserved=4 counts both). Evicting V
+        must yield 2 claimable chips — X's claim still holds — never 4."""
+        from yoda_tpu.api.types import make_node
+
+        tpu = make_node("host", chips=4, generation="v5e")
+        for c in tpu.chips[:2]:  # V's usage, already visible
+            c.hbm_free = c.hbm_total // 2
+        plugin, ni, req = self._prep(tpu, reserved=4)
+        assert plugin._avail_after(ni, req, freed=2) == 2
+
+    def test_steady_state_visible_victims(self):
+        """4 chips: victims' 4 chips all metrics-visible (reserved=4 counts
+        the same pods). Evicting everything must credit all 4 chips —
+        subtracting freed only from reservations would leave preemption
+        inert in steady state."""
+        from yoda_tpu.api.types import make_node
+
+        tpu = make_node("host", chips=4, generation="v5e")
+        for c in tpu.chips:
+            c.hbm_free = 0
+        plugin, ni, req = self._prep(tpu, reserved=4)
+        assert plugin._avail_after(ni, req, freed=4) == 4
+
+    def test_just_bound_invisible_victims(self):
+        """Victims bound between agent refreshes: charges are reservations,
+        chips still read free. Eviction removes the claims; the chips were
+        already unused."""
+        from yoda_tpu.api.types import make_node
+
+        tpu = make_node("host", chips=4, generation="v5e")
+        plugin, ni, req = self._prep(tpu, reserved=4)
+        assert plugin._avail_after(ni, req, freed=4) == 4
+        assert plugin._avail_after(ni, req, freed=2) == 2
+
+    def test_unqualifiable_visible_chips_not_credited(self):
+        """Visible chips whose total HBM can never satisfy the request are
+        not credited as freeable, worst case."""
+        from yoda_tpu.api.requests import parse_request
+        from yoda_tpu.api.types import make_node
+        from yoda_tpu.framework.interfaces import NodeInfo
+        from yoda_tpu.plugins.yoda.preemption import TpuPreemption
+
+        tpu = make_node("host", chips=4, generation="v5e", hbm_per_chip=16 << 30)
+        for c in tpu.chips[:2]:  # small chips, in use
+            c.hbm_total = 1 << 30
+            c.hbm_free = 0
+        plugin = TpuPreemption(lambda key: None, reserved_fn=lambda n: 2)
+        req = parse_request(
+            {"tpu/chips": "2", "tpu/hbm": "8Gi", "tpu/priority": "10"}
+        )
+        ni = NodeInfo("host", tpu=tpu)
+        # Evicting the small-chip squatters frees nothing usable.
+        assert plugin._avail_after(ni, req, freed=2) == 2

@@ -1,54 +1,220 @@
-"""Binary entry point — the analog of the reference's ``cmd/scheduler/main.go``.
+"""Binary entry points — the analog of the reference's ``cmd/scheduler/main.go``.
 
 The reference main seeds rand, builds the upstream scheduler command with the
 yoda plugin injected, and executes it (reference cmd/scheduler/main.go:12-21,
-pkg/register/register.go:9-13). Here the equivalent is: parse flags, assemble
-the framework with the yoda-tpu plugin set, and run the scheduling loop
-against the configured cluster backend (fake in-memory for demos/tests, real
-API server when a kubeconfig is reachable).
+pkg/register/register.go:9-13); the external SCV sniffer DaemonSet is a
+separate repo. Here ONE binary carries both roles, selected by subcommand-ish
+flags (the Deployment/DaemonSet manifests in deploy/ pick the mode):
 
-The full loop lands with yoda_tpu.cluster / yoda_tpu.framework; until then
-this entry point reports what is available.
+    yoda-tpu-scheduler                  in-cluster scheduler (KubeCluster)
+    yoda-tpu-scheduler --demo           in-memory fleet demo (FakeCluster)
+    yoda-tpu-scheduler --agent          node-agent publisher loop (DaemonSet)
+
+``--config`` takes a YAML file whose top-level keys are
+``SchedulerConfig`` fields (weights, mode, gang_permit_timeout_s, ...) —
+the reference decoded its pluginConfig Args and ignored them (reference
+pkg/yoda/scheduler.go:38-41,55-58); here config is validated and used.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import signal
 import sys
+import threading
 
 
-def main(argv: list[str] | None = None) -> int:
+def _load_config(path: str | None):
+    from yoda_tpu.config import SchedulerConfig
+
+    if not path:
+        return SchedulerConfig()
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"scheduler config {path} must be a YAML mapping")
+    return SchedulerConfig.from_dict(raw)
+
+
+def _build_kube_cluster():
+    from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
+
+    cfg = KubeApiConfig.from_env()
+    cluster = KubeCluster(KubeApiClient(cfg))
+    cluster.start()
+    if not cluster.wait_for_sync(60.0):
+        raise RuntimeError("timed out syncing informer caches from the API server")
+    return cluster
+
+
+def _init_jax(platform: str) -> None:
+    """Pin the JAX platform for the scheduler process. The scheduler
+    Deployment runs on a CPU node (it schedules TPUs, it does not use
+    them), so the fused kernel defaults to the CPU backend; site-wide
+    platform overrides (e.g. a TPU-tunnel sitecustomize) must not leak into
+    the scheduling hot path. ``--jax-platform ''`` keeps the ambient
+    default."""
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
+def _install_stop_handlers(stop: threading.Event) -> None:
+    """SIGTERM/SIGINT -> orderly drain. Signals can only be bound from the
+    main thread; tests drive main() from worker threads and stop the loop
+    through the cluster instead."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+
+
+def _run_scheduler(args, stop: threading.Event) -> int:
+    """In-cluster scheduler: KubeCluster backend + full plugin stack +
+    metrics endpoint, running until SIGTERM/SIGINT (or ``stop`` is set by
+    an embedding caller)."""
+    from yoda_tpu.metrics_server import MetricsServer
+    from yoda_tpu.standalone import build_stack
+
+    config = _load_config(args.config)
+    _init_jax(args.jax_platform)
+    cluster = _build_kube_cluster()
+    stack = build_stack(cluster=cluster, config=config)
+
+    metrics_srv = None
+    if args.metrics_port >= 0:
+        metrics_srv = MetricsServer(stack.metrics, port=args.metrics_port)
+        metrics_srv.start()
+        print(f"metrics on :{metrics_srv.port}/metrics", file=sys.stderr)
+
+    _install_stop_handlers(stop)
+    print(
+        f"yoda-tpu-scheduler: serving (mode={config.mode}, "
+        f"nodes={len(cluster.list_tpu_metrics())}, pods={len(cluster.list_pods())})",
+        file=sys.stderr,
+    )
+    try:
+        stack.scheduler.serve_forever(stop)
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.stop()
+        cluster.stop()
+    return 0
+
+
+def _run_agent(args, stop: threading.Event) -> int:
+    """Node-agent mode (the DaemonSet): publish this node's TpuNodeMetrics
+    CR every ``--interval-s``, via the native reader when available, else —
+    only if ``--allow-fake`` — a synthetic host profile."""
+    from yoda_tpu.agent.native import NativeTpuAgent, collection_source, load_library
+
+    node_name = args.node_name or os.environ.get("NODE_NAME")
+    if not node_name:
+        print(
+            "yoda-tpu-scheduler --agent: --node-name or $NODE_NAME required",
+            file=sys.stderr,
+        )
+        return 2
+    cluster = _build_kube_cluster()
+    lib = load_library(args.tpuinfo_lib)
+    agent = NativeTpuAgent(cluster, node_name, lib=lib)
+
+    fake = None
+    if lib is None:
+        if not args.allow_fake:
+            print(
+                "yoda-tpu-scheduler --agent: libyoda_tpuinfo.so not found "
+                "(build native/ or pass --tpuinfo-lib); refusing to publish "
+                "without --allow-fake",
+                file=sys.stderr,
+            )
+            return 2
+        from yoda_tpu.agent.fake_publisher import FakeTpuAgent
+
+        fake = FakeTpuAgent(cluster)
+        fake.add_host(node_name, generation=args.fake_generation, chips=args.fake_chips)
+
+    _install_stop_handlers(stop)
+    print(
+        f"yoda-tpu-agent: publishing {node_name} every {args.interval_s}s "
+        f"(source={collection_source(lib) if lib else 'fake'})",
+        file=sys.stderr,
+    )
+    while not stop.is_set():
+        try:
+            if fake is not None:
+                fake.publish_all()
+            else:
+                agent.run_once()
+        except Exception as e:  # keep the DaemonSet loop alive across blips
+            print(f"yoda-tpu-agent: publish failed: {e}", file=sys.stderr)
+        stop.wait(args.interval_s)
+    cluster.stop()
+    return 0
+
+
+def main(
+    argv: list[str] | None = None, *, stop: threading.Event | None = None
+) -> int:
+    """``stop`` lets an embedding caller (tests, a supervising process)
+    terminate the scheduler/agent loop; standalone runs get SIGTERM/SIGINT
+    handlers instead."""
     parser = argparse.ArgumentParser(
         prog="yoda-tpu-scheduler",
         description="TPU-native Kubernetes scheduler (yoda-tpu)",
     )
-    parser.add_argument("--config", help="scheduler configuration file", default=None)
+    parser.add_argument("--config", help="scheduler configuration YAML", default=None)
     parser.add_argument("-v", "--verbosity", type=int, default=3)
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=10259,
+        help="port for /metrics, /healthz, /trace (-1 disables)",
+    )
     parser.add_argument(
         "--demo",
         action="store_true",
         help="run against an in-memory fake cluster with a synthetic TPU fleet",
     )
+    parser.add_argument(
+        "--jax-platform",
+        default="cpu",
+        help="JAX platform for the scheduler's fused kernel ('' = ambient default)",
+    )
+    agent = parser.add_argument_group("agent mode")
+    agent.add_argument(
+        "--agent",
+        action="store_true",
+        help="run the node-agent publisher loop instead of the scheduler",
+    )
+    agent.add_argument("--node-name", default=None, help="defaults to $NODE_NAME")
+    agent.add_argument("--interval-s", type=float, default=10.0)
+    agent.add_argument(
+        "--tpuinfo-lib", default=None, help="path to libyoda_tpuinfo.so"
+    )
+    agent.add_argument(
+        "--allow-fake",
+        action="store_true",
+        help="publish a synthetic host profile when no TPU reader is available",
+    )
+    agent.add_argument("--fake-generation", default="v5e")
+    agent.add_argument("--fake-chips", type=int, default=4)
     args = parser.parse_args(argv)
 
     if args.demo:
-        try:
-            from yoda_tpu.demo import run_demo
-        except ImportError:
-            print(
-                "yoda-tpu-scheduler: the --demo loop is not available in this "
-                "build (yoda_tpu.demo missing).",
-                file=sys.stderr,
-            )
-            return 2
-        return run_demo(verbosity=args.verbosity)
+        _init_jax(args.jax_platform)
+        from yoda_tpu.demo import run_demo
 
-    print(
-        "yoda-tpu-scheduler: no in-cluster mode configured in this build; "
-        "run with --demo for the in-memory fleet demo.",
-        file=sys.stderr,
-    )
-    return 2
+        return run_demo(verbosity=args.verbosity)
+    stop = stop if stop is not None else threading.Event()
+    if args.agent:
+        return _run_agent(args, stop)
+    return _run_scheduler(args, stop)
 
 
 if __name__ == "__main__":

@@ -9,10 +9,20 @@ consistent snapshot and never touch the API server (SURVEY.md §7 step 2).
 
 ``FakeCluster`` plays the API server for tests, demos, and benchmarks — the
 "1-node kind cluster with fake SCV CR" strategy of BASELINE config 1 without
-kind. A real-cluster client would implement the same watch interface.
+kind. ``KubeCluster`` is the real-cluster client on the same watch surface:
+stdlib-HTTP list+watch loops (resourceVersion resume, 410 relist, backoff)
+feeding the same Event stream, plus pods/binding and CR publish writes.
 """
 
 from yoda_tpu.cluster.fake import Event, FakeCluster
 from yoda_tpu.cluster.informer import InformerCache
+from yoda_tpu.cluster.kube import KubeApiClient, KubeApiConfig, KubeCluster
 
-__all__ = ["Event", "FakeCluster", "InformerCache"]
+__all__ = [
+    "Event",
+    "FakeCluster",
+    "InformerCache",
+    "KubeApiClient",
+    "KubeApiConfig",
+    "KubeCluster",
+]

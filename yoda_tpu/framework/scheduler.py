@@ -163,10 +163,13 @@ class Scheduler:
 
         with timer.span("score"):
             st = self.framework.run_pre_score(state, pod, snapshot, feasible)
-            if not st.success:
-                return done("error", message=st.message)
-
-            totals, st = self.framework.run_scores(state, pod, snapshot, feasible)
+            totals = {}
+            if st.success:
+                totals, st = self.framework.run_scores(
+                    state, pod, snapshot, feasible
+                )
+        # Outside the span: returning from inside it would drop the score
+        # phase from this cycle's trace entry and latency histogram.
         if not st.success:
             return done("error", message=st.message)
         if batch_scores:

@@ -139,18 +139,31 @@ class TpuPreemption(PostFilterPlugin):
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
         """Qualifying chips claimable once victims freeing ``freed`` chips
-        are gone. A victim's chips may be charged either as an accountant
-        reservation (before the node agent's refresh) or as metrics-visible
-        HBM use (after) — never both (the handoff model of
-        filter_plugin.available_chips). Eviction must credit BOTH forms:
-        subtracting only from ``reserved`` would make preemption inert in
-        steady state, when every victim's usage is already visible."""
+        are gone.
+
+        Each occupied chip is charged EXACTLY once (the handoff model of
+        filter_plugin.available_chips): as an accountant reservation whose
+        physical chip still reads fully-free (before the node agent's
+        refresh — the chip already counts in ``unused``, discounted via
+        ``invisible``), or as metrics-visible HBM use (after — the chip is
+        outside ``unused``). Eviction therefore credits one claimable chip
+        per freed chip: an invisible charge vanishes (its chip was already
+        in ``unused``), a visible chip returns to ``unused`` once metrics
+        refresh — EXCEPT visible chips that can never serve this request
+        (hbm_total/clock too small). The victims' split between the two
+        forms is unknown, so the worst case is assumed: all such
+        unqualifiable visible chips belong to the victims. Conservative —
+        may pick one victim more than strictly needed, never evicts a set
+        that cannot make the preemptor schedulable."""
         reserved = self.reserved_fn(ni.name) if self.reserved_fn else 0
         if freed == 0:
             return available_chips(ni.tpu, req, reserved)
-        # Chips whose metrics-visible usage could return to service and then
-        # satisfy this request (full HBM and clock qualify once freed).
-        freeable_visible = sum(
+        unused = sum(
+            1 for c in qualifying_chips(ni.tpu, req) if c.hbm_free >= c.hbm_total
+        )
+        visible = apparently_used_chips(ni.tpu)
+        invisible = max(reserved - visible, 0)
+        qualifiable_visible = sum(
             1
             for c in ni.tpu.chips
             if c.healthy
@@ -158,13 +171,9 @@ class TpuPreemption(PostFilterPlugin):
             and c.hbm_total >= req.hbm_per_chip
             and c.clock_mhz >= req.min_clock_mhz
         )
-        visible = apparently_used_chips(ni.tpu)
-        visible_freed = min(freed, freeable_visible)
-        unused = sum(
-            1 for c in qualifying_chips(ni.tpu, req) if c.hbm_free >= c.hbm_total
-        )
-        new_invisible = max((reserved - freed) - (visible - min(freed, visible)), 0)
-        return unused + visible_freed - new_invisible
+        unqualifiable_visible = max(visible - qualifiable_visible, 0)
+        credit = freed - min(freed, unqualifiable_visible)
+        return unused - invisible + credit
 
     def _minimal_set(
         self, ni: NodeInfo, req: TpuRequest, needed: int, max_priority: int

@@ -1,0 +1,13 @@
+"""Test infrastructure shipped with the framework.
+
+The reference has no tests and its multi-node behavior is exercised only in
+production (SURVEY.md §4). Here the e2e story is explicit: a real in-process
+HTTP server speaking the subset of the Kubernetes API the scheduler uses
+(`FakeKubeApiServer`), so the full KubeCluster list/watch/bind path is
+driven without a cluster — the single-process analog of the "kind cluster +
+fake TPU metrics DaemonSet" harness.
+"""
+
+from yoda_tpu.testing.fake_kube_api import FakeKubeApiServer
+
+__all__ = ["FakeKubeApiServer"]
