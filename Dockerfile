@@ -1,0 +1,17 @@
+# One image, two roles (scheduler Deployment / agent DaemonSet select via
+# args) — the analog of the reference's Dockerfile (reference Dockerfile:1-7:
+# debian-slim + prebuilt binary), except the native metrics reader is built
+# in a proper builder stage instead of copying a host-built artifact.
+FROM python:3.12-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+COPY native/ /src/native/
+RUN make -C /src/native
+
+FROM python:3.12-slim
+# The scheduler's fused scoring kernel runs JAX on CPU inside the pod.
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml
+COPY yoda_tpu/ /app/yoda_tpu/
+COPY --from=builder /src/native/libyoda_tpuinfo.so /usr/local/lib/yoda_tpu/
+ENV PYTHONPATH=/app
+ENTRYPOINT ["python", "-m", "yoda_tpu.cli"]

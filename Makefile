@@ -1,0 +1,38 @@
+# Build targets — the analog of the reference's Makefile (reference
+# Makefile:1-15: local / build / push / format / clean), adapted: the
+# "binary" is the yoda_tpu package + the native metrics reader, and — unlike
+# the reference's build-only CI (reference .github/workflows/ci.yaml:35-40,
+# no tests) — `make test` is the default gate.
+
+IMAGE ?= yoda-tpu/scheduler
+TAG ?= latest
+PY ?= python
+
+.PHONY: all test native bench demo image push format clean
+
+all: native test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench: native
+	$(PY) bench.py
+
+demo:
+	$(PY) -m yoda_tpu.cli --demo
+
+image:
+	docker build -t $(IMAGE):$(TAG) .
+
+push: image
+	docker push $(IMAGE):$(TAG)
+
+format:
+	$(PY) -m black yoda_tpu tests bench.py __graft_entry__.py 2>/dev/null || true
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache yoda_tpu/__pycache__ tests/__pycache__

@@ -14,16 +14,12 @@ import pytest
 
 from yoda_tpu.api.types import PodSpec, make_node
 from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
+import functools
+
 from yoda_tpu.testing import FakeKubeApiServer
+from yoda_tpu.testing import wait_until as _wait_until
 
-
-def wait_until(cond, timeout_s: float = 15.0, msg: str = "condition"):
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        if cond():
-            return
-        time.sleep(0.02)
-    raise AssertionError(f"timed out waiting for {msg}")
+wait_until = functools.partial(_wait_until, timeout_s=15.0)
 
 
 @pytest.fixture()

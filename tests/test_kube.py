@@ -17,18 +17,7 @@ import pytest
 from yoda_tpu.api.types import PodSpec, make_node
 from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
 from yoda_tpu.cluster.kube import CR_PATH, KubeApiError
-from yoda_tpu.testing import FakeKubeApiServer
-
-POLL_S = 0.02
-
-
-def wait_until(cond, timeout_s: float = 10.0, msg: str = "condition"):
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        if cond():
-            return
-        time.sleep(POLL_S)
-    raise AssertionError(f"timed out waiting for {msg}")
+from yoda_tpu.testing import FakeKubeApiServer, wait_until
 
 
 @pytest.fixture()

@@ -1,0 +1,134 @@
+"""Deploy/example manifests stay consistent with the code contracts.
+
+The reference's YAML could silently drift from its plugin (nothing tested
+it; SURVEY.md §4). Here the manifests are pinned to the code: the ConfigMap
+must parse as a valid SchedulerConfig, the CRD must match the API group /
+kind / schema the client serializes, example pod labels must pass the strict
+parser, and RBAC must grant exactly the verbs KubeCluster issues.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import yaml
+
+from yoda_tpu.api.requests import parse_request
+from yoda_tpu.api.types import GROUP, KIND, VERSION, make_node
+from yoda_tpu.config import SchedulerConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def load_all(rel: str) -> list[dict]:
+    return [
+        d
+        for d in yaml.safe_load_all((REPO / rel).read_text())
+        if d is not None
+    ]
+
+
+def by_kind(docs: list[dict], kind: str) -> list[dict]:
+    return [d for d in docs if d.get("kind") == kind]
+
+
+class TestSchedulerManifest:
+    def setup_method(self):
+        self.docs = load_all("deploy/yoda-tpu-scheduler.yaml")
+
+    def test_configmap_parses_as_scheduler_config(self):
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(yaml.safe_load(cm["data"]["config.yaml"]))
+        assert cfg.mode in ("batch", "loop")
+        assert cfg.gang_permit_timeout_s > 0
+
+    def test_deployment_mounts_config_and_probes_healthz(self):
+        (dep,) = by_kind(self.docs, "Deployment")
+        spec = dep["spec"]["template"]["spec"]
+        (container,) = spec["containers"]
+        assert any(a.startswith("--config=") for a in container["args"])
+        assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        (vol,) = spec["volumes"]
+        assert vol["configMap"]["name"] == "yoda-tpu-scheduler-config"
+
+    def test_rbac_covers_client_verbs(self):
+        """KubeCluster issues: pod list/watch/delete, pods/binding create,
+        TpuNodeMetrics list/watch (read-only for the scheduler)."""
+        (role,) = by_kind(self.docs, "ClusterRole")
+        rules = {
+            (g, r): set(rule["verbs"])
+            for rule in role["rules"]
+            for g in rule["apiGroups"]
+            for r in rule["resources"]
+        }
+        assert {"list", "watch", "delete"} <= rules[("", "pods")]
+        assert "create" in rules[("", "pods/binding")]
+        assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
+        # Least privilege: the scheduler never writes CRs (unlike the
+        # reference's full-verbs grant, deploy/yoda-scheduler.yaml:204-215).
+        assert not {"create", "update", "delete"} & rules[(GROUP, "tpunodemetrics")]
+
+
+class TestAgentManifest:
+    def setup_method(self):
+        self.docs = load_all("deploy/yoda-tpu-agent.yaml")
+
+    def test_daemonset_runs_agent_mode_with_node_name(self):
+        (ds,) = by_kind(self.docs, "DaemonSet")
+        (container,) = ds["spec"]["template"]["spec"]["containers"]
+        assert "--agent" in container["args"]
+        (env,) = [e for e in container["env"] if e["name"] == "NODE_NAME"]
+        assert env["valueFrom"]["fieldRef"]["fieldPath"] == "spec.nodeName"
+
+    def test_rbac_covers_publish_verbs(self):
+        (role,) = by_kind(self.docs, "ClusterRole")
+        rules = {
+            (g, r): set(rule["verbs"])
+            for rule in role["rules"]
+            for g in rule["apiGroups"]
+            for r in rule["resources"]
+        }
+        # put_tpu_metrics: GET then POST/PUT; delete_tpu_metrics on drain.
+        assert {"get", "create", "update", "delete"} <= rules[
+            (GROUP, "tpunodemetrics")
+        ]
+        assert {"list", "watch"} <= rules[("", "pods")]
+
+
+class TestCrdManifest:
+    def test_crd_matches_client_serialization(self):
+        (crd,) = load_all("deploy/crd.yaml")
+        spec = crd["spec"]
+        assert spec["group"] == GROUP
+        assert spec["names"]["kind"] == KIND
+        assert spec["names"]["plural"] == "tpunodemetrics"  # CR_PATH segment
+        assert spec["scope"] == "Cluster"  # Get-by-node-name contract
+        (version,) = spec["versions"]
+        assert version["name"] == VERSION
+
+        # Every field the client writes must be in the schema.
+        status_schema = version["schema"]["openAPIV3Schema"]["properties"][
+            "status"
+        ]["properties"]
+        obj = make_node("n", chips=1).to_obj()
+        assert set(obj["status"]) <= set(status_schema)
+        chip_schema = status_schema["chips"]["items"]["properties"]
+        assert set(obj["status"]["chips"][0]) <= set(chip_schema)
+
+
+class TestExamples:
+    def test_example_pod_labels_parse_strictly(self):
+        for rel in ("example/test-pod.yaml", "example/test-gang.yaml"):
+            for doc in load_all(rel):
+                labels = doc["metadata"]["labels"]
+                req = parse_request(labels)
+                assert doc["spec"]["schedulerName"] == "yoda-tpu"
+                if "tpu/gang" in labels:
+                    assert req.gang is not None and req.gang.size == 4
+
+    def test_example_deployment_template_parses(self):
+        (dep,) = load_all("example/test-deployment.yaml")
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        req = parse_request(labels)
+        assert req.chips == 2
+        assert req.priority == 1

@@ -67,11 +67,18 @@ def load_library(path: str | os.PathLike | None = None):
             lib.yoda_tpuinfo_collect.argtypes = [ctypes.POINTER(_Host)]
             lib.yoda_tpuinfo_collect.restype = ctypes.c_int
             lib.yoda_tpuinfo_source.restype = ctypes.c_char_p
-            lib.yoda_tpuinfo_max_chips.restype = ctypes.c_int
             # ABI guard: the library fills a caller-allocated _Host; a chip
             # array bound drifting between the .so and this binding would be
-            # silent heap corruption in the node agent.
-            lib_max = lib.yoda_tpuinfo_max_chips()
+            # silent heap corruption in the node agent. A build so old it
+            # lacks the probe symbol is itself a mismatch.
+            probe = getattr(lib, "yoda_tpuinfo_max_chips", None)
+            if probe is None:
+                raise RuntimeError(
+                    f"libyoda_tpuinfo ABI mismatch: {c} predates the "
+                    "yoda_tpuinfo_max_chips probe; rebuild native/"
+                )
+            probe.restype = ctypes.c_int
+            lib_max = probe()
             if lib_max != MAX_CHIPS:
                 raise RuntimeError(
                     f"libyoda_tpuinfo ABI mismatch: library max_chips="

@@ -113,6 +113,10 @@ def _run_agent(args, stop: threading.Event) -> int:
     only if ``--allow-fake`` — a synthetic host profile."""
     from yoda_tpu.agent.native import NativeTpuAgent, collection_source, load_library
 
+    # Validate everything local BEFORE touching the API server: a
+    # misconfigured DaemonSet pod should fail with the actionable message
+    # immediately, not after a (up to 60 s) informer sync, and the refusal
+    # path must not leave watch threads running.
     node_name = args.node_name or os.environ.get("NODE_NAME")
     if not node_name:
         print(
@@ -120,41 +124,45 @@ def _run_agent(args, stop: threading.Event) -> int:
             file=sys.stderr,
         )
         return 2
-    cluster = _build_kube_cluster()
     lib = load_library(args.tpuinfo_lib)
-    agent = NativeTpuAgent(cluster, node_name, lib=lib)
+    if lib is None and not args.allow_fake:
+        print(
+            "yoda-tpu-scheduler --agent: libyoda_tpuinfo.so not found "
+            "(build native/ or pass --tpuinfo-lib); refusing to publish "
+            "without --allow-fake",
+            file=sys.stderr,
+        )
+        return 2
 
-    fake = None
-    if lib is None:
-        if not args.allow_fake:
-            print(
-                "yoda-tpu-scheduler --agent: libyoda_tpuinfo.so not found "
-                "(build native/ or pass --tpuinfo-lib); refusing to publish "
-                "without --allow-fake",
-                file=sys.stderr,
+    cluster = _build_kube_cluster()
+    try:
+        agent = NativeTpuAgent(cluster, node_name, lib=lib)
+        fake = None
+        if lib is None:
+            from yoda_tpu.agent.fake_publisher import FakeTpuAgent
+
+            fake = FakeTpuAgent(cluster)
+            fake.add_host(
+                node_name, generation=args.fake_generation, chips=args.fake_chips
             )
-            return 2
-        from yoda_tpu.agent.fake_publisher import FakeTpuAgent
 
-        fake = FakeTpuAgent(cluster)
-        fake.add_host(node_name, generation=args.fake_generation, chips=args.fake_chips)
-
-    _install_stop_handlers(stop)
-    print(
-        f"yoda-tpu-agent: publishing {node_name} every {args.interval_s}s "
-        f"(source={collection_source(lib) if lib else 'fake'})",
-        file=sys.stderr,
-    )
-    while not stop.is_set():
-        try:
-            if fake is not None:
-                fake.publish_all()
-            else:
-                agent.run_once()
-        except Exception as e:  # keep the DaemonSet loop alive across blips
-            print(f"yoda-tpu-agent: publish failed: {e}", file=sys.stderr)
-        stop.wait(args.interval_s)
-    cluster.stop()
+        _install_stop_handlers(stop)
+        print(
+            f"yoda-tpu-agent: publishing {node_name} every {args.interval_s}s "
+            f"(source={collection_source(lib) if lib else 'fake'})",
+            file=sys.stderr,
+        )
+        while not stop.is_set():
+            try:
+                if fake is not None:
+                    fake.publish_all()
+                else:
+                    agent.run_once()
+            except Exception as e:  # keep the DaemonSet loop alive across blips
+                print(f"yoda-tpu-agent: publish failed: {e}", file=sys.stderr)
+            stop.wait(args.interval_s)
+    finally:
+        cluster.stop()
     return 0
 
 

@@ -333,8 +333,12 @@ class KubeCluster:
                     gone = store.pop(key)
                     self._rvs.pop((target.kind, key), None)
                     events.append(Event("deleted", target.kind, gone))
-        for event in events:
-            self._emit(event)
+            # Emit while still holding the lock: add_watcher(replay=True)
+            # serializes against this, so a registering watcher sees each
+            # object exactly once — via replay or via these events, never
+            # both (the FakeCluster mutate+emit-under-lock contract).
+            for event in events:
+                self._emit(event)
         return data.get("metadata", {}).get("resourceVersion", "")
 
     def _watch_loop(self, target: _WatchTarget) -> None:
@@ -396,7 +400,9 @@ class KubeCluster:
                 self._rvs[(kind, key)] = obj.get("metadata", {}).get(
                     "resourceVersion", ""
                 )
-        self._emit(Event(mapped, kind, decoded))
+            # Under the lock (see _list_rv): no duplicate delivery around a
+            # concurrent add_watcher replay.
+            self._emit(Event(mapped, kind, decoded))
 
     # --- FakeCluster surface: watch ---
 
@@ -410,10 +416,11 @@ class KubeCluster:
                     fn(Event("added", "Pod", pod))
 
     def _emit(self, event: Event) -> None:
+        # Callers hold self._lock (RLock) so store mutation + delivery are
+        # atomic w.r.t. add_watcher replay, as in FakeCluster._emit.
         with self._lock:
-            watchers = list(self._watchers)
-        for fn in watchers:
-            fn(event)
+            for fn in list(self._watchers):
+                fn(event)
 
     # --- FakeCluster surface: pods ---
 

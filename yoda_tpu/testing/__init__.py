@@ -8,6 +8,24 @@ driven without a cluster — the single-process analog of the "kind cluster +
 fake TPU metrics DaemonSet" harness.
 """
 
+import time
+
 from yoda_tpu.testing.fake_kube_api import FakeKubeApiServer
 
-__all__ = ["FakeKubeApiServer"]
+__all__ = ["FakeKubeApiServer", "wait_until"]
+
+
+def wait_until(
+    cond,
+    timeout_s: float = 10.0,
+    msg: str = "condition",
+    poll_s: float = 0.02,
+) -> None:
+    """Poll ``cond`` until truthy or raise after ``timeout_s`` — the one
+    synchronization helper for tests driving the asynchronous watch paths."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out waiting for {msg}")
