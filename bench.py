@@ -30,6 +30,62 @@ FLEET_SLICES = 8          # 8 x (2x2x1) v5p slices = 32 hosts
 FLEET_SINGLES = 16        # + 16 v5e single hosts
 
 
+def _binpack_scenario() -> float:
+    """BASELINE config-3 style saturation packing: fill a fresh fleet with
+    mixed 2- and 3-chip pods until nothing else fits; returns chips-in-use /
+    chips-allocatable from the yoda_tpu_binpack_efficiency gauge."""
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    stack = build_stack(config=SchedulerConfig(mode="batch"))
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(8):
+        agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+    agent.publish_all()
+    total_chips = 64
+    # Enough demand to oversubscribe; alternate 2/3-chip pods so host
+    # divisibility is not a free ride (8 = 2+3+3 needs real packing).
+    sizes = [2, 3] * (total_chips // 2)
+    for i, size in enumerate(sizes):
+        stack.cluster.create_pod(
+            PodSpec(f"pack-{i}", labels={"tpu/chips": str(size)})
+        )
+    stack.scheduler.run_until_idle(max_wall_s=60)
+    return stack.metrics.binpack_efficiency.value()
+
+
+def _device_probe() -> dict:
+    """Measure the device-resident kernel's per-eval latency on the default
+    accelerator vs host CPU at a bench-scale bucket — the data behind the
+    'auto' platform policy (plugins/yoda/batch.py). Skipped when the default
+    platform IS cpu."""
+    import jax
+    import numpy as np
+
+    if jax.default_backend() == "cpu":
+        return {}
+    from yoda_tpu.config import Weights
+    from yoda_tpu.ops.kernel import DeviceFleetKernel, KernelRequest
+
+    import __graft_entry__ as g
+
+    arrays, req = g._example_fleet(48)
+    dyn = arrays.dyn_packed(None)
+    out = {}
+    for label, dev in (("accel", None), ("cpu", jax.devices("cpu")[0])):
+        kern = DeviceFleetKernel(Weights(), device=dev)
+        kern.put_static(arrays)
+        kern.evaluate(dyn, req)  # compile
+        t0 = time.monotonic()
+        iters = 5
+        for _ in range(iters):
+            kern.evaluate(dyn, req)
+        out[f"kernel_{label}_ms"] = round((time.monotonic() - t0) / iters * 1e3, 2)
+    return out
+
+
 def run_bench() -> dict:
     from yoda_tpu.agent import FakeTpuAgent
     from yoda_tpu.api.types import PodSpec
@@ -86,11 +142,21 @@ def run_bench() -> dict:
     p99 = latencies_ms[min(int(len(latencies_ms) * 0.99), len(latencies_ms) - 1)]
     p50 = statistics.median(latencies_ms)
     print(f"gang latency p50={p50:.1f}ms p99={p99:.1f}ms n={GANGS}", file=sys.stderr)
+
+    efficiency = _binpack_scenario()
+    print(f"binpack efficiency (saturated v5e-64): {efficiency:.3f}", file=sys.stderr)
+    probe = _device_probe()
+    if probe:
+        print(f"kernel device probe: {probe}", file=sys.stderr)
+
     return {
         "metric": "v5p_gang_p99_ms",
         "value": round(p99, 2),
         "unit": "ms",
         "vs_baseline": round(BASELINE_P99_MS / p99, 2),
+        "p50_ms": round(p50, 2),
+        "binpack_efficiency": round(efficiency, 4),
+        **probe,
     }
 
 

@@ -173,3 +173,122 @@ class TestKernelUnits:
         refreshed = static.with_dynamic(lambda n: 2)
         assert refreshed.reserved_chips[0] == 2
         assert refreshed.hbm_free_mib is static.hbm_free_mib  # static part shared
+
+
+class TestDeviceFleetKernel:
+    """The transfer-minimal device-resident path (ops.kernel.DeviceFleetKernel)
+    must agree exactly with fused_filter_score."""
+
+    def _random_case(self, seed):
+        rng = random.Random(seed)
+        nodes = random_fleet(rng, rng.randrange(3, 20))
+        labels = random_labels(rng)
+        snapshot = Snapshot({n.name: NodeInfo(n.name, tpu=n) for n in nodes})
+        arrays = FleetArrays.from_snapshot(snapshot)
+        req = KernelRequest.from_request(parse_request(labels))
+        return arrays, req
+
+    @pytest.mark.parametrize("seed", range(20, 26))
+    def test_packed_parity_with_fused(self, seed):
+        from yoda_tpu.config import Weights
+        from yoda_tpu.ops.kernel import DeviceFleetKernel
+
+        arrays, req = self._random_case(seed)
+        kern = DeviceFleetKernel(Weights())
+        kern.put_static(arrays)
+        packed = kern.evaluate(arrays.dyn_packed(None), req)
+        ref = fused_filter_score(arrays, req)
+        np.testing.assert_array_equal(packed.feasible, ref.feasible)
+        np.testing.assert_array_equal(packed.reasons, ref.reasons)
+        np.testing.assert_array_equal(packed.scores, ref.scores)
+        assert packed.best_index == ref.best_index
+
+    def test_dyn_packed_matches_with_dynamic(self):
+        nodes = [make_node("a", chips=4), make_node("b", chips=2)]
+        snapshot = Snapshot({n.name: NodeInfo(n.name, tpu=n) for n in nodes})
+        static = FleetArrays.from_snapshot(snapshot)
+        reserved = {"a": 2, "b": 1}.get
+        claimed = {"a": 100, "b": 0}.get
+        dyn = static.dyn_packed(reserved, claimed)
+        ref = static.with_dynamic(reserved, claimed)
+        np.testing.assert_array_equal(dyn[0].astype(bool), ref.fresh)
+        np.testing.assert_array_equal(dyn[1], ref.reserved_chips)
+        np.testing.assert_array_equal(dyn[2], ref.claimed_hbm_mib)
+
+    def test_dyn_packed_staleness(self):
+        nodes = [make_node("a", chips=1, now=100.0)]
+        snapshot = Snapshot({n.name: NodeInfo(n.name, tpu=n) for n in nodes})
+        static = FleetArrays.from_snapshot(snapshot)
+        fresh = static.dyn_packed(None, max_metrics_age_s=30.0, now=120.0)
+        stale = static.dyn_packed(None, max_metrics_age_s=30.0, now=200.0)
+        assert fresh[0, 0] == 1 and stale[0, 0] == 0
+
+    def test_evaluate_requires_put_static(self):
+        from yoda_tpu.config import Weights
+        from yoda_tpu.ops.kernel import DeviceFleetKernel
+
+        kern = DeviceFleetKernel(Weights())
+        with pytest.raises(RuntimeError, match="put_static"):
+            kern.evaluate(np.zeros((3, 8), np.int32), KernelRequest(1, 0, 0, 0, 0))
+
+    def test_static_reupload_tracks_new_fleet(self):
+        from yoda_tpu.config import Weights
+        from yoda_tpu.ops.kernel import DeviceFleetKernel
+
+        kern = DeviceFleetKernel(Weights())
+        first = Snapshot({"a": NodeInfo("a", tpu=make_node("a", chips=2))})
+        arrays1 = FleetArrays.from_snapshot(first)
+        kern.put_static(arrays1)
+        r1 = kern.evaluate(arrays1.dyn_packed(None), KernelRequest(1, 0, 0, 0, 0))
+        assert arrays1.names[r1.best_index] == "a"
+        second = Snapshot({"b": NodeInfo("b", tpu=make_node("b", chips=2))})
+        arrays2 = FleetArrays.from_snapshot(second)
+        kern.put_static(arrays2)
+        r2 = kern.evaluate(arrays2.dyn_packed(None), KernelRequest(1, 0, 0, 0, 0))
+        assert arrays2.names[r2.best_index] == "b"
+
+
+class TestBatchPlatformPolicy:
+    def _arrays(self, n=2):
+        nodes = [make_node(f"n{i}", chips=4) for i in range(n)]
+        snapshot = Snapshot({x.name: NodeInfo(x.name, tpu=x) for x in nodes})
+        return FleetArrays.from_snapshot(snapshot)
+
+    def test_auto_small_fleet_pins_cpu(self):
+        import jax
+
+        from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+        b = YodaBatch(platform="auto")
+        assert b._device_for(self._arrays()) == jax.devices("cpu")[0]
+
+    def test_auto_large_fleet_uses_default_device(self):
+        from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+        b = YodaBatch(platform="auto", device_min_elems=4)
+        assert b._device_for(self._arrays()) is None
+
+    def test_forced_platforms(self):
+        import jax
+
+        from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+        assert YodaBatch(platform="device")._device_for(self._arrays()) is None
+        assert (
+            YodaBatch(platform="cpu")._device_for(self._arrays())
+            == jax.devices("cpu")[0]
+        )
+
+    def test_invalid_platform_rejected(self):
+        from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+        with pytest.raises(ValueError, match="platform"):
+            YodaBatch(platform="gpu")
+
+    def test_config_validates_kernel_platform(self):
+        from yoda_tpu.config import SchedulerConfig
+
+        with pytest.raises(ValueError, match="kernel_platform"):
+            SchedulerConfig.from_dict({"kernel_platform": "gpu"})
+        cfg = SchedulerConfig.from_dict({"kernel_platform": "device"})
+        assert cfg.kernel_platform == "device"

@@ -61,6 +61,12 @@ class SchedulerConfig:
     max_metrics_age_s: float = 0.0    # 0 disables staleness filtering
     percentage_nodes_to_score: int = 100
     enable_preemption: bool = True    # modern-PostFilter eviction (BASELINE config 5)
+    # Where the fused kernel runs: "auto" pins small fleets to host CPU
+    # (accelerator dispatch latency dominates sub-device_min_elems work) and
+    # large fleets to the default accelerator; "cpu"/"device" force a side.
+    # None defers the threshold to plugins.yoda.batch.AUTO_DEVICE_MIN_ELEMS.
+    kernel_platform: str = "auto"
+    kernel_device_min_elems: int | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SchedulerConfig":
@@ -71,4 +77,9 @@ class SchedulerConfig:
             raise ValueError(f"mode must be 'batch' or 'loop', got {cfg.mode!r}")
         if cfg.gang_permit_timeout_s <= 0:
             raise ValueError("gang_permit_timeout_s must be positive")
+        if cfg.kernel_platform not in ("auto", "cpu", "device"):
+            raise ValueError(
+                "kernel_platform must be 'auto', 'cpu' or 'device', "
+                f"got {cfg.kernel_platform!r}"
+            )
         return cfg

@@ -304,6 +304,17 @@ class SchedulingMetrics:
             "(bin-packing efficiency = 1 - free/total under saturation)",
             chips_free,
         )
+        # THE BASELINE north-star companion to p99 latency (BASELINE.md):
+        # fraction of allocatable chips actually in use.
+        def binpack_efficiency() -> float:
+            total = chips_total()
+            return (total - chips_free()) / total if total > 0 else 0.0
+
+        self.binpack_efficiency = self.registry.gauge(
+            "yoda_tpu_binpack_efficiency",
+            "Chips in use / chips allocatable (0 when the fleet is empty)",
+            binpack_efficiency,
+        )
 
     # --- trace ---
 

@@ -184,6 +184,37 @@ class FleetArrays:
             out["fresh"] = (now - self.last_updated) <= max_metrics_age_s
         return FleetArrays(**out)
 
+    def dyn_packed(
+        self,
+        reserved_fn: Callable[[str], int] | None,
+        claimed_fn: Callable[[str], int] | None = None,
+        *,
+        max_metrics_age_s: float = 0.0,
+        now: float | None = None,
+    ) -> np.ndarray:
+        """The per-cycle node vectors as ONE [3, N] int32 array (rows =
+        ops.kernel.DYN_KEYS: fresh, reserved_chips, claimed_hbm_mib) for the
+        device-resident kernel — same semantics as :meth:`with_dynamic`,
+        packed so a scheduling cycle uploads a single array."""
+        import time as _time
+
+        n = self.node_valid.shape[0]
+        dyn = np.zeros((3, n), dtype=np.int32)
+        if max_metrics_age_s > 0:
+            now = _time.time() if now is None else now
+            dyn[0] = (now - self.last_updated) <= max_metrics_age_s
+        else:
+            dyn[0] = self.fresh
+        if reserved_fn is not None:
+            for i, name in enumerate(self.names):
+                dyn[1, i] = reserved_fn(name)
+        if claimed_fn is not None:
+            for i, name in enumerate(self.names):
+                dyn[2, i] = min(claimed_fn(name), np.iinfo(np.int32).max)
+        else:
+            dyn[2] = self.claimed_hbm_mib
+        return dyn
+
 
 def _claimed_hbm_mib(ni) -> int:
     """HBM claimed by pods already placed on the node (reference

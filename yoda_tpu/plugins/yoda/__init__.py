@@ -35,6 +35,8 @@ def default_plugins(
     weights: Weights | None = None,
     reserved_fn: Callable[[str], int] | None = None,
     max_metrics_age_s: float = 0.0,
+    kernel_platform: str = "auto",
+    kernel_device_min_elems: int | None = None,
 ) -> list:
     """Assemble the standard plugin set.
 
@@ -43,6 +45,8 @@ def default_plugins(
     YodaPreFilter (label parsing) and YodaSort; batch subsumes
     Filter+PreScore+Score.
     """
+    from yoda_tpu.plugins.yoda.batch import AUTO_DEVICE_MIN_ELEMS
+
     base: list = [YodaSort(), YodaPreFilter()]
     if mode == "batch":
         base.append(
@@ -50,6 +54,12 @@ def default_plugins(
                 reserved_fn,
                 weights=weights,
                 max_metrics_age_s=max_metrics_age_s,
+                platform=kernel_platform,
+                device_min_elems=(
+                    AUTO_DEVICE_MIN_ELEMS
+                    if kernel_device_min_elems is None
+                    else kernel_device_min_elems
+                ),
             )
         )
     elif mode == "loop":

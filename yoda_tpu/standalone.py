@@ -58,6 +58,8 @@ def build_stack(
         weights=config.weights,
         reserved_fn=accountant.chips_in_use,
         max_metrics_age_s=config.max_metrics_age_s,
+        kernel_platform=config.kernel_platform,
+        kernel_device_min_elems=config.kernel_device_min_elems,
     )
     gang = GangPlugin(
         timeout_s=config.gang_permit_timeout_s,
