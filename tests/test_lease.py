@@ -1,0 +1,223 @@
+"""Leader election over the coordination.k8s.io/v1 Lease API.
+
+The reference inherits leader election wholesale from upstream
+kube-scheduler (reference deploy/yoda-scheduler.yaml:11-14); here the
+mechanism is first-party (yoda_tpu/cluster/lease.py) and testable against
+the fake API server: acquire, renew, expiry takeover, orderly release, and
+the two-replica failover scenario end to end through the CLI.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import pytest
+
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster, LeaderElector
+from yoda_tpu.testing import FakeKubeApiServer
+from yoda_tpu.testing import wait_until as _wait_until
+
+wait_until = functools.partial(_wait_until, timeout_s=15.0)
+
+
+@pytest.fixture()
+def server(monkeypatch):
+    with FakeKubeApiServer() as srv:
+        monkeypatch.setenv("YODA_KUBE_API_URL", srv.base_url)
+        yield srv
+
+
+@pytest.fixture()
+def api(server):
+    return KubeApiClient(KubeApiConfig(base_url=server.base_url))
+
+
+def elector(api, identity, clock=None, **kw):
+    kw.setdefault("namespace", "kube-system")
+    kw.setdefault("name", "test-lease")
+    if clock is not None:
+        kw["clock"] = clock
+    return LeaderElector(api, identity=identity, **kw)
+
+
+class TestAcquireRenew:
+    def test_acquires_absent_lease(self, api):
+        a = elector(api, "a")
+        assert a.try_acquire_or_renew()
+        view = a.observe()
+        assert view.holder == "a"
+        assert view.duration_s == 15
+        assert view.transitions == 0
+
+    def test_second_candidate_stays_standby(self, api):
+        a, b = elector(api, "a"), elector(api, "b")
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        assert a.observe().holder == "a"
+
+    def test_holder_renews(self, api):
+        t = [100.0]
+        a = elector(api, "a", clock=lambda: t[0])
+        assert a.try_acquire_or_renew()
+        first = a.observe().renew_unix
+        t[0] = 105.0
+        assert a.try_acquire_or_renew()
+        assert a.observe().renew_unix == pytest.approx(105.0)
+        assert a.observe().renew_unix > first
+
+    def test_takeover_after_expiry(self, api):
+        a = elector(api, "a", clock=lambda: 0.0)
+        b = elector(api, "b", clock=lambda: 1000.0)  # lease long expired
+        assert a.try_acquire_or_renew()
+        assert b.try_acquire_or_renew()
+        view = b.observe()
+        assert view.holder == "b"
+        assert view.transitions == 1
+
+    def test_no_takeover_of_valid_lease(self, api):
+        a = elector(api, "a", clock=lambda: 0.0)
+        b = elector(api, "b", clock=lambda: 10.0)  # within 15s duration
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+
+    def test_release_lets_standby_acquire(self, api):
+        a, b = elector(api, "a"), elector(api, "b")
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        a.release()
+        assert a.observe().holder == ""
+        assert b.try_acquire_or_renew()
+        assert b.observe().holder == "b"
+
+    def test_release_of_foreign_lease_is_noop(self, api):
+        a, b = elector(api, "a"), elector(api, "b")
+        assert a.try_acquire_or_renew()
+        b.release()
+        assert a.observe().holder == "a"
+
+    def test_identity_required(self, api):
+        with pytest.raises(ValueError, match="identity"):
+            LeaderElector(api, identity="")
+
+
+class TestRunLoop:
+    def _start(self, el, stop, started, stopped):
+        t = threading.Thread(
+            target=el.run,
+            args=(stop,),
+            kwargs={
+                "on_started_leading": started.set,
+                "on_stopped_leading": stopped.set,
+            },
+            daemon=True,
+        )
+        t.start()
+        return t
+
+    def test_failover_on_orderly_stop(self, api):
+        a = elector(api, "a", renew_period_s=0.05)
+        b = elector(api, "b", renew_period_s=0.05)
+        stop_a, stop_b = threading.Event(), threading.Event()
+        a_up, a_down = threading.Event(), threading.Event()
+        b_up, b_down = threading.Event(), threading.Event()
+        ta = self._start(a, stop_a, a_up, a_down)
+        assert a_up.wait(5), "first candidate acquired"
+        self._start(b, stop_b, b_up, b_down)
+        assert not b_up.wait(0.5), "standby must not lead while lease is held"
+        stop_a.set()
+        ta.join(timeout=5)
+        assert b_up.wait(5), "standby took over after release"
+        assert a.observe().holder == "b"
+        stop_b.set()
+
+    def test_loss_reported_when_lease_stolen(self, api):
+        from yoda_tpu.cluster.lease import lease_path
+
+        a = elector(api, "a", renew_period_s=0.05)
+        stop = threading.Event()
+        up, down = threading.Event(), threading.Event()
+        self._start(a, stop, up, down)
+        assert up.wait(5)
+        # Another controller force-takes the lease (valid, far-future renew).
+        view = a.observe()
+        api.request(
+            "PUT",
+            lease_path("kube-system", "test-lease"),
+            body={
+                "metadata": {
+                    "name": "test-lease",
+                    "namespace": "kube-system",
+                    "resourceVersion": view.resource_version,
+                },
+                "spec": {
+                    "holderIdentity": "intruder",
+                    "leaseDurationSeconds": 9999,
+                    "renewTime": "2999-01-01T00:00:00.000000Z",
+                },
+            },
+        )
+        assert down.wait(5), "loss callback fired after takeover observed"
+        assert not a.is_leader()
+        stop.set()
+
+
+class TestCliFailover:
+    """VERDICT item 3's done-criterion: two stacks against one fake API
+    server — exactly one schedules; kill the holder, the other takes over."""
+
+    def _run_cli(self, argv):
+        from yoda_tpu.cli import main
+
+        stop = threading.Event()
+        t = threading.Thread(
+            target=main, args=(argv,), kwargs={"stop": stop}, daemon=True
+        )
+        t.start()
+        return stop, t
+
+    def test_two_replicas_one_schedules_then_failover(self, server):
+        seed = KubeCluster(
+            KubeApiClient(KubeApiConfig(base_url=server.base_url, watch_timeout_s=2))
+        )
+        seed.put_tpu_metrics(make_node("n1", chips=8))
+
+        def holder():
+            lease = server.get_object("Lease", "kube-system/yoda-tpu-scheduler")
+            return (lease or {}).get("spec", {}).get("holderIdentity")
+
+        argv = ["--metrics-port", "-1", "--leader-elect", "--lease-identity"]
+        stop_a, ta = self._run_cli(argv + ["replica-a"])
+        wait_until(lambda: holder() == "replica-a", msg="replica-a acquired")
+        stop_b, tb = self._run_cli(argv + ["replica-b"])
+
+        try:
+            seed.create_pod(PodSpec("ha-pod-1", labels={"tpu/chips": "1"}))
+            wait_until(
+                lambda: (server.get_object("Pod", "default/ha-pod-1") or {})
+                .get("spec", {})
+                .get("nodeName")
+                == "n1",
+                msg="leader bound the first pod",
+            )
+            assert holder() == "replica-a", "standby must not have taken the lease"
+
+            stop_a.set()
+            ta.join(timeout=10)
+            wait_until(lambda: holder() == "replica-b", msg="failover to replica-b")
+
+            seed.create_pod(PodSpec("ha-pod-2", labels={"tpu/chips": "1"}))
+            wait_until(
+                lambda: (server.get_object("Pod", "default/ha-pod-2") or {})
+                .get("spec", {})
+                .get("nodeName")
+                == "n1",
+                msg="new leader bound the second pod",
+            )
+        finally:
+            stop_a.set()
+            stop_b.set()
+            ta.join(timeout=10)
+            tb.join(timeout=10)
+            seed.stop()

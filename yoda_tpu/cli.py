@@ -77,7 +77,11 @@ def _install_stop_handlers(stop: threading.Event) -> None:
 def _run_scheduler(args, stop: threading.Event) -> int:
     """In-cluster scheduler: KubeCluster backend + full plugin stack +
     metrics endpoint, running until SIGTERM/SIGINT (or ``stop`` is set by
-    an embedding caller)."""
+    an embedding caller). With ``--leader-elect``, the scheduling loop only
+    runs while this replica holds the Lease (standbys keep their informer
+    caches warm for fast takeover); losing leadership exits nonzero so the
+    Deployment restarts the pod into standby (upstream kube-scheduler
+    behavior, reference deploy/yoda-scheduler.yaml:11-14)."""
     from yoda_tpu.metrics_server import MetricsServer
     from yoda_tpu.standalone import build_stack
 
@@ -93,18 +97,70 @@ def _run_scheduler(args, stop: threading.Event) -> int:
         print(f"metrics on :{metrics_srv.port}/metrics", file=sys.stderr)
 
     _install_stop_handlers(stop)
-    print(
-        f"yoda-tpu-scheduler: serving (mode={config.mode}, "
-        f"nodes={len(cluster.list_tpu_metrics())}, pods={len(cluster.list_pods())})",
-        file=sys.stderr,
-    )
+
+    elector_thread = None
+    lost_leadership = threading.Event()
     try:
+        if args.leader_elect:
+            import socket
+
+            from yoda_tpu.cluster.lease import LeaderElector
+
+            identity = (
+                args.lease_identity
+                or os.environ.get("HOSTNAME")
+                or socket.gethostname()
+            )
+            elector = LeaderElector(
+                cluster.api,
+                identity=identity,
+                namespace=args.lease_namespace,
+                name=args.lease_name,
+            )
+            became_leader = threading.Event()
+
+            def _on_lost() -> None:
+                print(
+                    f"yoda-tpu-scheduler: lost leadership ({identity}); exiting",
+                    file=sys.stderr,
+                )
+                lost_leadership.set()
+                stop.set()
+
+            elector_thread = threading.Thread(
+                target=elector.run,
+                args=(stop,),
+                kwargs={
+                    "on_started_leading": became_leader.set,
+                    "on_stopped_leading": _on_lost,
+                },
+                name="leader-elector",
+                daemon=True,
+            )
+            elector_thread.start()
+            print(
+                f"yoda-tpu-scheduler: standby, waiting for lease "
+                f"{args.lease_namespace}/{args.lease_name} as {identity}",
+                file=sys.stderr,
+            )
+            while not stop.is_set() and not became_leader.wait(0.2):
+                pass
+            if stop.is_set() and not became_leader.is_set():
+                return 0  # stopped while standby
+
+        print(
+            f"yoda-tpu-scheduler: serving (mode={config.mode}, "
+            f"nodes={len(cluster.list_tpu_metrics())}, pods={len(cluster.list_pods())})",
+            file=sys.stderr,
+        )
         stack.scheduler.serve_forever(stop)
     finally:
         if metrics_srv is not None:
             metrics_srv.stop()
+        if elector_thread is not None:
+            elector_thread.join(timeout=5.0)  # lets the elector release the lease
         cluster.stop()
-    return 0
+    return 1 if lost_leadership.is_set() else 0
 
 
 def _run_agent(args, stop: threading.Event) -> int:
@@ -193,6 +249,17 @@ def main(
         "--jax-platform",
         default="cpu",
         help="JAX platform for the scheduler's fused kernel ('' = ambient default)",
+    )
+    ha = parser.add_argument_group("leader election")
+    ha.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="run scheduling only while holding the coordination.k8s.io Lease",
+    )
+    ha.add_argument("--lease-namespace", default="kube-system")
+    ha.add_argument("--lease-name", default="yoda-tpu-scheduler")
+    ha.add_argument(
+        "--lease-identity", default=None, help="defaults to $HOSTNAME"
     )
     agent = parser.add_argument_group("agent mode")
     agent.add_argument(

@@ -17,6 +17,7 @@ feeding the same Event stream, plus pods/binding and CR publish writes.
 from yoda_tpu.cluster.fake import Event, FakeCluster
 from yoda_tpu.cluster.informer import InformerCache
 from yoda_tpu.cluster.kube import KubeApiClient, KubeApiConfig, KubeCluster
+from yoda_tpu.cluster.lease import LeaderElector
 
 __all__ = [
     "Event",
@@ -25,4 +26,5 @@ __all__ = [
     "KubeApiClient",
     "KubeApiConfig",
     "KubeCluster",
+    "LeaderElector",
 ]

@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 POD_KIND = "Pod"
 CR_KIND = "TpuNodeMetrics"
+LEASE_KIND = "Lease"
 
 
 @dataclass
@@ -32,15 +33,15 @@ class _State:
     rv: int = 0
     # kind -> key -> object dict (with metadata.resourceVersion set)
     objects: dict[str, dict[str, dict]] = field(
-        default_factory=lambda: {POD_KIND: {}, CR_KIND: {}}
+        default_factory=lambda: {POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}}
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
-        default_factory=lambda: {POD_KIND: [], CR_KIND: []}
+        default_factory=lambda: {POD_KIND: [], CR_KIND: [], LEASE_KIND: []}
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
-        default_factory=lambda: {POD_KIND: 0, CR_KIND: 0}
+        default_factory=lambda: {POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0}
     )
     uid_seq: int = 0
     stopping: bool = False
@@ -196,12 +197,21 @@ class _Handler(BaseHTTPRequestHandler):
             ]:
                 name = parts[4] if len(parts) > 4 else None
                 return CR_KIND, None, name, None
+            if (
+                parts[1] == "coordination.k8s.io"
+                and parts[2] == "v1"
+                and len(parts) >= 5
+                and parts[3] == "namespaces"
+                and parts[5:6] == ["leases"]
+            ):
+                name = parts[6] if len(parts) > 6 else None
+                return LEASE_KIND, parts[4], name, None
             return None
         return None
 
     @staticmethod
     def _key(kind: str, namespace: str | None, obj_or_name) -> str:
-        if kind == POD_KIND:
+        if kind in (POD_KIND, LEASE_KIND):  # namespaced kinds
             if isinstance(obj_or_name, dict):
                 md = obj_or_name.get("metadata", {})
                 return f"{md.get('namespace', namespace or 'default')}/{md['name']}"
