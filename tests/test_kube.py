@@ -225,3 +225,98 @@ class TestKubeE2E:
             stop.set()
             t.join(timeout=5)
             kc.stop()
+
+
+class TestNodeWatch:
+    def test_node_watch_and_store(self, cluster, server):
+        from yoda_tpu.api.types import K8sNode, Taint
+
+        events = []
+        cluster.add_watcher(lambda e: events.append(e))
+        node = K8sNode("worker-1", taints=[Taint("dedicated", "tpu", "NoSchedule")])
+        server.put_object("Node", "worker-1", node.to_obj())
+        wait_until(
+            lambda: any(
+                e.kind == "Node" and e.type == "added" and e.obj.name == "worker-1"
+                for e in events
+            ),
+            msg="node added event",
+        )
+        assert [n.name for n in cluster.list_nodes()] == ["worker-1"]
+        assert cluster.list_nodes()[0].taints[0].key == "dedicated"
+
+        cordoned = K8sNode("worker-1", unschedulable=True)
+        server.put_object("Node", "worker-1", cordoned.to_obj())
+        wait_until(
+            lambda: any(
+                e.kind == "Node" and e.type == "modified" and e.obj.unschedulable
+                for e in events
+            ),
+            msg="node cordon event",
+        )
+        server.delete_object("Node", "worker-1")
+        wait_until(
+            lambda: any(e.kind == "Node" and e.type == "deleted" for e in events),
+            msg="node deleted event",
+        )
+        assert cluster.list_nodes() == []
+
+    def test_agent_kinds_issue_no_node_or_cr_reads(self, server):
+        # Agent-mode cluster (kinds=("Pod",)) must sync with ONLY pod
+        # list/watch available — the RBAC shape of the DaemonSet.
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05, kinds=("Pod",))
+        kc.start()
+        try:
+            assert kc.wait_for_sync(10.0)
+            # Publish path still works without any watch on the CR.
+            kc.put_tpu_metrics(make_node("agent-host", chips=4))
+            assert server.get_object("TpuNodeMetrics", "agent-host") is not None
+        finally:
+            kc.stop()
+
+    def test_cordon_respected_over_http(self, server):
+        # Full stack over the wire: cordoned node gets no pods.
+        from yoda_tpu.api.types import K8sNode
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05)
+        kc.start()
+        assert kc.wait_for_sync(10.0)
+        try:
+            stack = build_stack(cluster=kc, config=SchedulerConfig())
+            server.put_object("Node", "ok-node", K8sNode("ok-node").to_obj())
+            server.put_object(
+                "Node",
+                "bad-node",
+                K8sNode("bad-node", unschedulable=True).to_obj(),
+            )
+            kc.put_tpu_metrics(make_node("ok-node", chips=4))
+            kc.put_tpu_metrics(make_node("bad-node", chips=4))
+            wait_until(
+                lambda: len(stack.informer.snapshot()) == 2
+                and stack.informer.snapshot().get("bad-node").node is not None,
+                msg="informer sees both nodes",
+            )
+            kc.create_pod(PodSpec("pod-http", labels={"tpu/chips": "1"}))
+            wait_until(
+                lambda: len(stack.queue) > 0
+                or (kc.get_pod("default/pod-http") or PodSpec("x")).node_name
+                is not None,
+                msg="pod reaches the queue",
+            )
+            stack.scheduler.run_until_idle(max_wall_s=5)
+            wait_until(
+                lambda: (
+                    server.get_object("Pod", "default/pod-http") or {}
+                ).get("spec", {}).get("nodeName") == "ok-node",
+                msg="pod bound to the uncordoned node",
+            )
+        finally:
+            kc.stop()

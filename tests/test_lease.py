@@ -101,6 +101,50 @@ class TestAcquireRenew:
         with pytest.raises(ValueError, match="identity"):
             LeaderElector(api, identity="")
 
+    def test_acquire_time_survives_renewal(self, api):
+        # PUT replaces the whole spec on real API servers; the elector must
+        # carry acquireTime through every renew.
+        t = [100.0]
+        a = elector(api, "a", clock=lambda: t[0])
+        assert a.try_acquire_or_renew()
+        acquired = a.observe().acquire_time
+        assert acquired
+        t[0] = 105.0
+        assert a.try_acquire_or_renew()
+        assert a.observe().acquire_time == acquired
+
+    def test_margin_validation(self, api):
+        with pytest.raises(ValueError, match="renew"):
+            LeaderElector(
+                api, identity="a", lease_duration_s=15.0, renew_deadline_s=20.0
+            )
+        with pytest.raises(ValueError, match="renew"):
+            # Detection granularity must fit inside the safety margin.
+            LeaderElector(
+                api,
+                identity="a",
+                lease_duration_s=15.0,
+                renew_deadline_s=14.5,
+                renew_period_s=2.0,
+            )
+
+    def test_renew_deadline_stands_down_before_lease_expiry(self, api):
+        # The holder must report loss once renew_deadline_s passes without a
+        # successful renew — strictly before a standby could acquire at
+        # lease_duration_s.
+        t = [0.0]
+        a = elector(api, "a", clock=lambda: t[0])
+        assert a.try_acquire_or_renew()
+        a._leading.set()
+        # Simulate renew failures by advancing past the deadline only.
+        t[0] = a.renew_deadline_s + 0.1
+        assert t[0] < a.lease_duration_s
+        # Standby cannot acquire yet at this clock...
+        b = elector(api, "b", clock=lambda: t[0])
+        assert not b.try_acquire_or_renew()
+        # ...but the leader's loss condition is already met.
+        assert t[0] - a._last_renew >= a.renew_deadline_s
+
 
 class TestRunLoop:
     def _start(self, el, stop, started, stopped):
@@ -140,24 +184,37 @@ class TestRunLoop:
         up, down = threading.Event(), threading.Event()
         self._start(a, stop, up, down)
         assert up.wait(5)
-        # Another controller force-takes the lease (valid, far-future renew).
-        view = a.observe()
-        api.request(
-            "PUT",
-            lease_path("kube-system", "test-lease"),
-            body={
-                "metadata": {
-                    "name": "test-lease",
-                    "namespace": "kube-system",
-                    "resourceVersion": view.resource_version,
-                },
-                "spec": {
-                    "holderIdentity": "intruder",
-                    "leaseDurationSeconds": 9999,
-                    "renewTime": "2999-01-01T00:00:00.000000Z",
-                },
-            },
-        )
+        # Another controller force-takes the lease (valid, far-future
+        # renew). The elector renews every 50 ms, so the observed
+        # resourceVersion can go stale between observe() and PUT — retry
+        # the write on 409 like any real controller would.
+        from yoda_tpu.cluster.kube import KubeApiError
+
+        for _ in range(50):
+            view = a.observe()
+            try:
+                api.request(
+                    "PUT",
+                    lease_path("kube-system", "test-lease"),
+                    body={
+                        "metadata": {
+                            "name": "test-lease",
+                            "namespace": "kube-system",
+                            "resourceVersion": view.resource_version,
+                        },
+                        "spec": {
+                            "holderIdentity": "intruder",
+                            "leaseDurationSeconds": 9999,
+                            "renewTime": "2999-01-01T00:00:00.000000Z",
+                        },
+                    },
+                )
+                break
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+        else:
+            pytest.fail("intruder PUT lost the write race 50 times")
         assert down.wait(5), "loss callback fired after takeover observed"
         assert not a.is_leader()
         stop.set()

@@ -29,7 +29,7 @@ from __future__ import annotations
 import itertools
 import time
 from dataclasses import dataclass, field, asdict
-from typing import Any, Iterable, Mapping
+from typing import Any, Iterable, Mapping, Sequence
 
 HEALTHY = "Healthy"
 
@@ -44,6 +44,11 @@ GENERATION_RANK = {"v2": 2, "v3": 3, "v4": 4, "v5e": 5, "v5p": 6, "v6e": 7}
 GROUP = "scheduler.yoda-tpu.dev"
 VERSION = "v1"
 KIND = "TpuNodeMetrics"
+
+# Pod annotation carrying the scheduler's arrival-order sequence (FIFO
+# tie-break that survives restart/relist; annotations persist arbitrary keys
+# on real API servers, unlike unknown bare metadata fields).
+SEQ_ANNOTATION = f"{GROUP}/creation-seq"
 
 
 @dataclass
@@ -141,6 +146,125 @@ class TpuNodeMetrics:
         )
 
 
+@dataclass(frozen=True)
+class Taint:
+    """A v1.Taint (spec.taints entry on a Node)."""
+
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"   # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """A v1.Toleration (spec.tolerations entry on a Pod)."""
+
+    key: str = ""                # empty key + Exists tolerates everything
+    operator: str = "Equal"      # Equal | Exists
+    value: str = ""
+    effect: str = ""             # empty matches every effect
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return not self.key or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+    def to_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.key:
+            out["key"] = self.key
+        out["operator"] = self.operator
+        if self.operator == "Equal":
+            out["value"] = self.value
+        if self.effect:
+            out["effect"] = self.effect
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "Toleration":
+        return cls(
+            key=obj.get("key", ""),
+            operator=obj.get("operator", "Equal"),
+            value=obj.get("value", ""),
+            effect=obj.get("effect", ""),
+        )
+
+
+@dataclass
+class K8sNode:
+    """The scheduler-relevant slice of a v1.Node.
+
+    The reference never reads Node objects itself, but its upstream
+    snapshot carries them (reference pkg/yoda/scheduler.go:101), so cordon
+    (spec.unschedulable), NoSchedule taints, and node deletion are honored
+    for free there. This type restores that awareness first-party: the
+    cluster backends watch /api/v1/nodes and the informer folds these into
+    each NodeInfo."""
+
+    name: str
+    unschedulable: bool = False
+    taints: list[Taint] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def to_obj(self) -> dict[str, Any]:
+        spec: dict[str, Any] = {}
+        if self.unschedulable:
+            spec["unschedulable"] = True
+        if self.taints:
+            spec["taints"] = [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in self.taints
+            ]
+        return {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": self.name, "labels": dict(self.labels)},
+            "spec": spec,
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "K8sNode":
+        spec = obj.get("spec", {})
+        return cls(
+            name=obj["metadata"]["name"],
+            unschedulable=bool(spec.get("unschedulable", False)),
+            taints=[
+                Taint(
+                    key=t.get("key", ""),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", "NoSchedule"),
+                )
+                for t in spec.get("taints", [])
+            ],
+            labels=dict(obj.get("metadata", {}).get("labels", {})),
+        )
+
+
+def node_admits_pod(
+    node: "K8sNode | None", tolerations: Sequence[Toleration]
+) -> tuple[bool, str]:
+    """Cordon + taint admission: can the pod be placed on the node at all?
+
+    Mirrors what upstream kube-scheduler's NodeUnschedulable and
+    TaintToleration plugins give the reference for free via its snapshot
+    (reference pkg/yoda/scheduler.go:101). ``None`` (no Node object known —
+    e.g. a fake-cluster test without node records) admits. Only hard
+    effects reject: NoSchedule / NoExecute; PreferNoSchedule is a scoring
+    concern, not a filter."""
+    if node is None:
+        return True, ""
+    if node.unschedulable:
+        return False, "node is cordoned (spec.unschedulable)"
+    for taint in node.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return False, f"node has untolerated taint {taint.key}:{taint.effect}"
+    return True, ""
+
+
 _pod_seq = itertools.count()
 
 
@@ -161,6 +285,7 @@ class PodSpec:
     node_name: str | None = None
     phase: str = "Pending"
     uid: str = ""
+    tolerations: list[Toleration] = field(default_factory=list)
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -172,6 +297,12 @@ class PodSpec:
         return f"{self.namespace}/{self.name}"
 
     def to_obj(self) -> dict[str, Any]:
+        spec: dict[str, Any] = {
+            "schedulerName": self.scheduler_name,
+            "nodeName": self.node_name,
+        }
+        if self.tolerations:
+            spec["tolerations"] = [t.to_obj() for t in self.tolerations]
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -181,13 +312,13 @@ class PodSpec:
                 "labels": dict(self.labels),
                 "uid": self.uid,
                 # Arrival-order sequence, preserved across (de)serialization so
-                # FIFO tie-breaks survive a scheduler restart / relist.
-                "creationSeq": self.creation_seq,
+                # FIFO tie-breaks survive a scheduler restart / relist. An
+                # annotation (not a bare metadata field) so real API servers
+                # persist it; absent it, relists fall back to the
+                # creationTimestamp ordering in the list path.
+                "annotations": {SEQ_ANNOTATION: str(self.creation_seq)},
             },
-            "spec": {
-                "schedulerName": self.scheduler_name,
-                "nodeName": self.node_name,
-            },
+            "spec": spec,
             "status": {"phase": self.phase},
         }
 
@@ -196,12 +327,17 @@ class PodSpec:
         md = obj["metadata"]
         spec = obj.get("spec", {})
         kwargs = {}
-        if "creationSeq" in md:
-            kwargs["creation_seq"] = md["creationSeq"]
+        restored = md.get("annotations", {}).get(SEQ_ANNOTATION)
+        if restored is not None:
+            try:
+                restored = int(restored)
+            except ValueError:
+                restored = None
+        if restored is not None:
+            kwargs["creation_seq"] = restored
             # Keep the global counter ahead of restored sequences so pods
             # created after a restart/relist still sort behind older pods.
             global _pod_seq
-            restored = md["creationSeq"]
             nxt = next(_pod_seq)
             if restored >= nxt:
                 _pod_seq = itertools.count(restored + 1)
@@ -215,6 +351,9 @@ class PodSpec:
             node_name=spec.get("nodeName"),
             phase=obj.get("status", {}).get("phase", "Pending"),
             uid=md.get("uid", ""),
+            tolerations=[
+                Toleration.from_obj(t) for t in spec.get("tolerations", [])
+            ],
             **kwargs,
         )
 

@@ -11,7 +11,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Literal
 
-from yoda_tpu.api.types import PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
 
 EventType = Literal["added", "modified", "deleted"]
 
@@ -19,7 +19,7 @@ EventType = Literal["added", "modified", "deleted"]
 @dataclass(frozen=True)
 class Event:
     type: EventType
-    kind: str  # "Pod" | "TpuNodeMetrics"
+    kind: str  # "Pod" | "TpuNodeMetrics" | "Node"
     obj: object
 
 
@@ -28,6 +28,7 @@ class FakeCluster:
         self._lock = threading.RLock()
         self._pods: dict[str, PodSpec] = {}
         self._tpus: dict[str, TpuNodeMetrics] = {}
+        self._nodes: dict[str, K8sNode] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
 
@@ -39,6 +40,8 @@ class FakeCluster:
         with self._lock:
             self._watchers.append(fn)
             if replay:
+                for node in self._nodes.values():
+                    fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():
                     fn(Event("added", "TpuNodeMetrics", tpu))
                 for pod in self._pods.values():
@@ -104,3 +107,21 @@ class FakeCluster:
     def list_tpu_metrics(self) -> list[TpuNodeMetrics]:
         with self._lock:
             return list(self._tpus.values())
+
+    # --- Node objects (cordon / taints / lifecycle) ---
+
+    def put_node(self, node: K8sNode) -> None:
+        with self._lock:
+            is_new = node.name not in self._nodes
+            self._nodes[node.name] = node
+            self._emit(Event("added" if is_new else "modified", "Node", node))
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is not None:
+                self._emit(Event("deleted", "Node", node))
+
+    def list_nodes(self) -> list[K8sNode]:
+        with self._lock:
+            return list(self._nodes.values())

@@ -18,7 +18,7 @@ import threading
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, parse_request
-from yoda_tpu.api.types import PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
 
@@ -38,6 +38,13 @@ class InformerCache:
         self.on_change = on_change
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
+        self._nodes: dict[str, K8sNode] = {}
+        # True once any Node event arrived: from then on a TPU CR without a
+        # live Node object is excluded from snapshots (node deleted — the
+        # reference's upstream snapshot drops such nodes for free, reference
+        # pkg/yoda/scheduler.go:101). False = backend has no Node watch;
+        # every CR is trusted.
+        self._node_informed = False
         self._pods_by_node: dict[str, dict[str, PodSpec]] = {}
         self._claimed_mib: dict[str, int] = {}
         # pod uid -> (node counted on, claim MiB added) — the stored claim is
@@ -54,8 +61,27 @@ class InformerCache:
             self._handle_tpu(event)
         elif event.kind == "Pod":
             self._handle_pod(event)
+        elif event.kind == "Node":
+            self._handle_node(event)
         if self.on_change is not None:
             self.on_change(event)
+
+    def _handle_node(self, event: Event) -> None:
+        node: K8sNode = event.obj  # type: ignore[assignment]
+        with self._lock:
+            self._node_informed = True
+            if event.type == "deleted":
+                self._nodes.pop(node.name, None)
+            else:
+                self._nodes[node.name] = node
+            self._version += 1
+            if event.type in ("added", "deleted"):
+                # The candidate-node SET changed (a CR may enter/leave the
+                # snapshot), which invalidates the fleet arrays keyed on
+                # metrics_version. A cordon/taint flip (modified) does not:
+                # admission is evaluated per cycle, not baked into arrays.
+                self._metrics_version += 1
+            self._snapshot_cache = None
 
     def _handle_tpu(self, event: Event) -> None:
         tpu: TpuNodeMetrics = event.obj  # type: ignore[assignment]
@@ -127,8 +153,14 @@ class InformerCache:
                     name=name,
                     tpu=tpu,
                     pods=list(self._pods_by_node.get(name, {}).values()),
+                    node=self._nodes.get(name),
                 )
                 for name, tpu in self._tpus.items()
+                # Once Node-informed, a CR whose Node is gone is a deleted
+                # node with a not-yet-expired metrics object: never a
+                # candidate (the round-1 gap: pods could bind to deleted
+                # nodes on stale-but-fresh CRs).
+                if not self._node_informed or name in self._nodes
             }
             snap = Snapshot(nodes, version=self._version)
             snap.metrics_version = self._metrics_version

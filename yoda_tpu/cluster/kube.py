@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import os
 import ssl
 import threading
@@ -33,12 +34,23 @@ import time
 import urllib.parse
 from dataclasses import dataclass, field
 
-from yoda_tpu.api.types import GROUP, VERSION, PodSpec, TpuNodeMetrics
+log = logging.getLogger("yoda_tpu.cluster")
+
+from yoda_tpu.api.types import GROUP, VERSION, K8sNode, PodSpec, TpuNodeMetrics
 from yoda_tpu.cluster.fake import Event
 
 PODS_PATH = "/api/v1/pods"
+NODES_PATH = "/api/v1/nodes"
 CR_PLURAL = "tpunodemetrics"
 CR_PATH = f"/apis/{GROUP}/{VERSION}/{CR_PLURAL}"
+
+# Kinds KubeCluster can list+watch. The scheduler needs all three; the node
+# agent passes kinds=("Pod",) — it reads pods (HBM attribution of bound
+# pods) but never list/watches TpuNodeMetrics or Nodes, so its RBAC needs
+# pod reads plus only the tpunodemetrics WRITE verbs (ADVICE round 1: the
+# unconditional three-kind watch 403-crash-looped the DaemonSet on a real
+# cluster).
+SCHEDULER_KINDS = ("Pod", "TpuNodeMetrics", "Node")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -239,6 +251,7 @@ class KubeCluster:
         *,
         backoff_initial_s: float = 0.5,
         backoff_max_s: float = 30.0,
+        kinds: tuple[str, ...] = SCHEDULER_KINDS,
     ) -> None:
         self.api = api
         self._backoff_initial_s = backoff_initial_s
@@ -247,23 +260,34 @@ class KubeCluster:
         self._watchers: list = []
         self._pods: dict[str, PodSpec] = {}
         self._tpus: dict[str, TpuNodeMetrics] = {}
+        self._nodes: dict[str, K8sNode] = {}
         self._rvs: dict[tuple[str, str], str] = {}  # (kind, key) -> resourceVersion
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._targets = [
-            _WatchTarget(
+        all_targets = {
+            "Pod": _WatchTarget(
                 "Pod",
                 PODS_PATH,
                 decode=PodSpec.from_obj,
                 key=lambda p: p.key,
             ),
-            _WatchTarget(
+            "TpuNodeMetrics": _WatchTarget(
                 "TpuNodeMetrics",
                 CR_PATH,
                 decode=TpuNodeMetrics.from_obj,
                 key=lambda t: t.name,
             ),
-        ]
+            "Node": _WatchTarget(
+                "Node",
+                NODES_PATH,
+                decode=K8sNode.from_obj,
+                key=lambda n: n.name,
+            ),
+        }
+        unknown = set(kinds) - set(all_targets)
+        if unknown:
+            raise ValueError(f"unknown watch kinds: {sorted(unknown)}")
+        self._targets = [all_targets[k] for k in kinds]
 
     # --- lifecycle ---
 
@@ -293,7 +317,11 @@ class KubeCluster:
     # --- watch plumbing ---
 
     def _store(self, kind: str):
-        return self._pods if kind == "Pod" else self._tpus
+        return {
+            "Pod": self._pods,
+            "TpuNodeMetrics": self._tpus,
+            "Node": self._nodes,
+        }[kind]
 
     def _list_rv(self, target: _WatchTarget) -> str:
         """One LIST: reconcile the local store (diff → added/modified/
@@ -375,9 +403,18 @@ class KubeCluster:
                         break  # relist
                     # Orderly stream end (server watch timeout): re-watch
                     # from the last seen rv without relisting.
-            except Exception:
+            except Exception as e:
                 if self._stop.is_set():
                     return
+                # Surface persistent failures (401/403/TLS would otherwise
+                # only show up as an opaque sync timeout — ADVICE round 1).
+                log.warning(
+                    "watch %s failed (%s: %s); retrying in %.1fs",
+                    target.kind,
+                    type(e).__name__,
+                    e,
+                    backoff,
+                )
                 time.sleep(backoff)
                 backoff = min(backoff * 2, self._backoff_max_s)
 
@@ -410,6 +447,8 @@ class KubeCluster:
         with self._lock:
             self._watchers.append(fn)
             if replay:
+                for node in self._nodes.values():
+                    fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():
                     fn(Event("added", "TpuNodeMetrics", tpu))
                 for pod in sorted(self._pods.values(), key=lambda p: p.creation_seq):
@@ -492,3 +531,9 @@ class KubeCluster:
     def list_tpu_metrics(self) -> list[TpuNodeMetrics]:
         with self._lock:
             return list(self._tpus.values())
+
+    # --- FakeCluster surface: Node objects ---
+
+    def list_nodes(self) -> list[K8sNode]:
+        with self._lock:
+            return list(self._nodes.values())

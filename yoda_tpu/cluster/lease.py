@@ -13,8 +13,11 @@ Semantics follow upstream leaderelection.LeaderElector:
 - A candidate acquires the lease when it is absent, expired
   (``renewTime + leaseDurationSeconds < now``), or already its own.
 - The holder renews every ``renew_period_s``; on failure it keeps acting as
-  leader until the lease it last wrote would have expired (transient API
-  blips do not flap leadership), then reports loss.
+  leader until ``renew_deadline_s`` since the last successful renew
+  (transient API blips do not flap leadership), then reports loss. The
+  deadline is strictly inside the lease duration, so the old leader always
+  stands down BEFORE a standby may acquire (upstream renewDeadline
+  semantics — no split-brain window).
 - Observing ANOTHER holder's valid lease while leading reports loss
   immediately (the lock moved: split-brain window closed).
 - ``release()`` clears the holder on orderly shutdown so a standby takes
@@ -62,6 +65,7 @@ class LeaseView:
     duration_s: float
     transitions: int
     resource_version: str
+    acquire_time: str | None = None  # raw spec.acquireTime, carried on renew
 
 
 class LeaderElector:
@@ -76,16 +80,38 @@ class LeaderElector:
         namespace: str = "kube-system",
         name: str = "yoda-tpu-scheduler",
         lease_duration_s: float = 15.0,
+        renew_deadline_s: float | None = None,
         renew_period_s: float = 2.0,
         clock: Callable[[], float] = time.time,
     ) -> None:
         if not identity:
             raise ValueError("leader election requires a non-empty identity")
+        # Upstream leaderelection margins: the holder ABANDONS leadership
+        # once it has failed to renew for renew_deadline_s — strictly less
+        # than lease_duration_s, the point where standbys may acquire — so
+        # even with a detection granularity of renew_period_s the old leader
+        # stops scheduling before a new one can start (no split-brain
+        # window). Default: 2/3 of the lease duration, like upstream's
+        # 10s/15s.
+        if renew_deadline_s is None:
+            renew_deadline_s = lease_duration_s * 2.0 / 3.0
+        if not (renew_period_s < renew_deadline_s < lease_duration_s):
+            raise ValueError(
+                f"need renew_period ({renew_period_s}) < renew_deadline "
+                f"({renew_deadline_s}) < lease_duration ({lease_duration_s})"
+            )
+        if lease_duration_s - renew_deadline_s <= renew_period_s:
+            raise ValueError(
+                "lease_duration - renew_deadline must exceed renew_period "
+                "(the loss-detection tick granularity), or a standby could "
+                "acquire before the old leader notices it must stop"
+            )
         self.api = api
         self.identity = identity
         self.namespace = namespace
         self.name = name
         self.lease_duration_s = lease_duration_s
+        self.renew_deadline_s = renew_deadline_s
         self.renew_period_s = renew_period_s
         self.clock = clock
         self._leading = threading.Event()
@@ -113,11 +139,19 @@ class LeaderElector:
             duration_s=float(spec.get("leaseDurationSeconds") or 0),
             transitions=int(spec.get("leaseTransitions") or 0),
             resource_version=obj.get("metadata", {}).get("resourceVersion", ""),
+            acquire_time=spec.get("acquireTime"),
         )
 
     # --- acquire / renew ---
 
-    def _lease_body(self, *, acquire: bool, transitions: int, rv: str) -> dict:
+    def _lease_body(
+        self,
+        *,
+        acquire: bool,
+        transitions: int,
+        rv: str,
+        acquire_time: str | None = None,
+    ) -> dict:
         now = _fmt_micro(self.clock())
         body = {
             "apiVersion": "coordination.k8s.io/v1",
@@ -132,6 +166,10 @@ class LeaderElector:
         }
         if acquire:
             body["spec"]["acquireTime"] = now
+        elif acquire_time:
+            # PUT replaces the whole spec on a real API server: carry the
+            # acquireTime recorded at acquisition through every renewal.
+            body["spec"]["acquireTime"] = acquire_time
         if rv:
             body["metadata"]["resourceVersion"] = rv
         return body
@@ -157,6 +195,7 @@ class LeaderElector:
                     acquire=False,
                     transitions=view.transitions,
                     rv=view.resource_version,
+                    acquire_time=view.acquire_time,
                 )
                 self.api.request(
                     "PUT", lease_path(self.namespace, self.name), body=body
@@ -192,7 +231,10 @@ class LeaderElector:
             if view is None or view.holder != self.identity:
                 return
             body = self._lease_body(
-                acquire=False, transitions=view.transitions, rv=view.resource_version
+                acquire=False,
+                transitions=view.transitions,
+                rv=view.resource_version,
+                acquire_time=view.acquire_time,
             )
             body["spec"]["holderIdentity"] = ""
             self.api.request("PUT", lease_path(self.namespace, self.name), body=body)
@@ -230,10 +272,10 @@ class LeaderElector:
                         "",
                         self.identity,
                     )
-                    expired = (
-                        self.clock() - self._last_renew >= self.lease_duration_s
+                    deadline_passed = (
+                        self.clock() - self._last_renew >= self.renew_deadline_s
                     )
-                    if taken_over or expired:
+                    if taken_over or deadline_passed:
                         self._leading.clear()
                         if on_stopped_leading:
                             on_stopped_leading()

@@ -14,7 +14,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Iterable, Mapping, Protocol, Sequence
 
-from yoda_tpu.api.types import PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
 
 if TYPE_CHECKING:
     from yoda_tpu.framework.cyclestate import CycleState
@@ -81,6 +81,9 @@ class NodeInfo:
     name: str
     tpu: TpuNodeMetrics | None = None
     pods: list[PodSpec] = field(default_factory=list)
+    # The v1.Node object when the cluster backend watches Nodes; None in
+    # minimal test setups (admission checks then pass vacuously).
+    node: K8sNode | None = None
 
 
 class Snapshot:

@@ -39,6 +39,9 @@ class FleetArrays:
     generation_rank: np.ndarray   # int32
     in_slice: np.ndarray          # bool (host belongs to a multi-host ICI slice)
     fresh: np.ndarray             # bool
+    host_ok: np.ndarray           # bool: Node-object admission (not cordoned;
+                                  # per-pod taint/toleration results override
+                                  # this via the dyn vector at evaluation time)
     last_updated: np.ndarray      # float64 unix (for dynamic re-freshness)
     reserved_chips: np.ndarray    # int32 (chips held by in-flight pods)
     claimed_hbm_mib: np.ndarray   # int32 (HBM claimed by placed pods' labels)
@@ -92,6 +95,7 @@ class FleetArrays:
         gen = np.zeros(n_pad, dtype=np.int32)
         in_slice = np.zeros(n_pad, dtype=bool)
         fresh = np.zeros(n_pad, dtype=bool)
+        host_ok = np.zeros(n_pad, dtype=bool)
         last_updated = np.zeros(n_pad, dtype=np.float64)
         reserved = np.zeros(n_pad, dtype=np.int32)
         claimed = np.zeros(n_pad, dtype=np.int32)
@@ -111,6 +115,9 @@ class FleetArrays:
             if tpu is None:
                 continue  # row stays invalid -> never feasible
             node_valid[i] = True
+            # No-pod-context default: cordon only. Taint/toleration admission
+            # is per pod and arrives via the dyn vector (dyn_packed host_ok).
+            host_ok[i] = ni.node is None or not ni.node.unschedulable
             gen[i] = tpu.generation_rank
             in_slice[i] = bool(tpu.slice_id)
             last_updated[i] = tpu.last_updated_unix
@@ -139,6 +146,7 @@ class FleetArrays:
             generation_rank=gen,
             in_slice=in_slice,
             fresh=fresh,
+            host_ok=host_ok,
             last_updated=last_updated,
             reserved_chips=reserved,
             claimed_hbm_mib=claimed,
@@ -160,15 +168,19 @@ class FleetArrays:
         *,
         max_metrics_age_s: float = 0.0,
         now: float | None = None,
+        host_ok: np.ndarray | None = None,
     ) -> "FleetArrays":
         """Cheap per-cycle refresh of the per-node reservation/claim/freshness
         vectors (the [N, C] chip metrics are reused between metrics updates,
         so pod binds cost O(N), not O(N x C)). Freshness is re-evaluated
         against the CURRENT time so a node whose agent stops publishing goes
-        stale even while the cached arrays are reused."""
+        stale even while the cached arrays are reused. ``host_ok`` overrides
+        the static cordon-only admission vector with a per-pod one."""
         import time as _time
 
         out = dict(vars(self))
+        if host_ok is not None:
+            out["host_ok"] = host_ok
         reserved = np.zeros_like(self.reserved_chips)
         if reserved_fn is not None:
             for i, name in enumerate(self.names):
@@ -191,15 +203,19 @@ class FleetArrays:
         *,
         max_metrics_age_s: float = 0.0,
         now: float | None = None,
+        host_ok: np.ndarray | None = None,
     ) -> np.ndarray:
-        """The per-cycle node vectors as ONE [3, N] int32 array (rows =
-        ops.kernel.DYN_KEYS: fresh, reserved_chips, claimed_hbm_mib) for the
-        device-resident kernel — same semantics as :meth:`with_dynamic`,
-        packed so a scheduling cycle uploads a single array."""
+        """The per-cycle node vectors as ONE [4, N] int32 array (rows =
+        ops.kernel.DYN_KEYS: fresh, reserved_chips, claimed_hbm_mib,
+        host_ok) for the device-resident kernel — same semantics as
+        :meth:`with_dynamic`, packed so a scheduling cycle uploads a single
+        array. ``host_ok`` carries the per-pod Node-object admission
+        (cordon + taints vs THIS pod's tolerations); default: the static
+        cordon-only view."""
         import time as _time
 
         n = self.node_valid.shape[0]
-        dyn = np.zeros((3, n), dtype=np.int32)
+        dyn = np.zeros((4, n), dtype=np.int32)
         if max_metrics_age_s > 0:
             now = _time.time() if now is None else now
             dyn[0] = (now - self.last_updated) <= max_metrics_age_s
@@ -213,6 +229,7 @@ class FleetArrays:
                 dyn[2, i] = min(claimed_fn(name), np.iinfo(np.int32).max)
         else:
             dyn[2] = self.claimed_hbm_mib
+        dyn[3] = self.host_ok if host_ok is None else host_ok
         return dyn
 
 

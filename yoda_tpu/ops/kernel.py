@@ -42,6 +42,7 @@ REASON_CHIPS = 4
 REASON_HBM = 5
 REASON_CLOCK = 6
 REASON_RESERVED = 7
+REASON_NODE = 8
 
 REASON_MESSAGES = {
     REASON_NO_METRICS: "node has no TPU metrics",
@@ -51,6 +52,7 @@ REASON_MESSAGES = {
     REASON_HBM: "not enough chips with free HBM",
     REASON_CLOCK: "not enough chips at requested clock",
     REASON_RESERVED: "qualifying chips reserved by in-flight pods",
+    REASON_NODE: "node is cordoned or has untolerated taints",
 }
 
 # The kernel's input schema: FleetArrays fields, split by shape. [N] node
@@ -61,6 +63,7 @@ NODE_KEYS = (
     "node_valid",
     "in_slice",
     "fresh",
+    "host_ok",
     "generation_rank",
     "reserved_chips",
     "claimed_hbm_mib",
@@ -79,9 +82,9 @@ CHIP_KEYS = (
 
 # Split of NODE_KEYS for the device-resident path (DeviceFleetKernel):
 # static per metrics version vs changing every scheduling cycle. DYN_KEYS
-# order defines the rows of the packed [3, N] dynamics array.
+# order defines the rows of the packed [4, N] dynamics array.
 STATIC_NODE_KEYS = ("node_valid", "in_slice", "generation_rank")
-DYN_KEYS = ("fresh", "reserved_chips", "claimed_hbm_mib")
+DYN_KEYS = ("fresh", "reserved_chips", "claimed_hbm_mib", "host_ok")
 
 
 def arrays_dict(arrays: "FleetArrays") -> dict:
@@ -172,6 +175,7 @@ def kernel_impl(
 
     feasible = (
         a["node_valid"]
+        & a["host_ok"]
         & a["fresh"]
         & fits_gen
         & fits_chips
@@ -184,6 +188,7 @@ def kernel_impl(
     reasons = jnp.select(
         [
             ~a["node_valid"],
+            ~a["host_ok"],
             ~a["fresh"],
             ~fits_gen,
             ~fits_chips,
@@ -193,6 +198,7 @@ def kernel_impl(
         ],
         [
             REASON_NO_METRICS,
+            REASON_NODE,
             REASON_STALE,
             REASON_GENERATION,
             REASON_CHIPS,
@@ -278,7 +284,7 @@ _kernel = functools.partial(jax.jit, static_argnames=("weights",))(kernel_impl)
 
 def kernel_packed(static: dict, dyn, reqv, weights: Weights):
     """kernel_impl with transfer-minimal I/O: per-cycle node vectors arrive
-    as ONE [3, N] int32 array (DYN_KEYS rows), request scalars as ONE [5]
+    as ONE [4, N] int32 array (DYN_KEYS rows), request scalars as ONE [5]
     int32 vector, and all outputs leave as ONE [5, N] int32 array (rows:
     feasible, reasons, raw, final, best broadcast). Under a remote-device
     transport every host<->device transfer is a round trip, so the packing
@@ -289,6 +295,7 @@ def kernel_packed(static: dict, dyn, reqv, weights: Weights):
     a["fresh"] = dyn[0].astype(bool)
     a["reserved_chips"] = dyn[1]
     a["claimed_hbm_mib"] = dyn[2]
+    a["host_ok"] = dyn[3].astype(bool)
     feasible, reasons, raw, final, best = kernel_impl(
         a, reqv[0], reqv[1], reqv[2], reqv[3], reqv[4], weights=weights
     )
@@ -366,7 +373,7 @@ class DeviceFleetKernel:
 
     def evaluate(
         self,
-        dyn: np.ndarray,           # [3, N] int32, DYN_KEYS rows
+        dyn: np.ndarray,           # [4, N] int32, DYN_KEYS rows
         request: "KernelRequest",
     ) -> KernelResult:
         if self._static is None:

@@ -8,7 +8,7 @@ plugin OR the per-node trio in a framework — not both (scores would double).
 
 Transfer discipline (the p99 budget): the [N, C] chip grids live on the
 kernel's device, uploaded once per metrics version; a scheduling cycle
-transfers one packed [3, N] dynamics array + one [5] request vector and
+transfers one packed [4, N] dynamics array + one [5] request vector and
 fetches one packed [5, N] result — O(1) host<->device round trips per pod
 (ops.kernel.DeviceFleetKernel). The reference instead paid O(nodes)
 API-server round trips per pod (pkg/yoda/scheduler.go:70,108).
@@ -26,7 +26,9 @@ from __future__ import annotations
 
 from typing import Callable
 
-from yoda_tpu.api.types import PodSpec
+import numpy as np
+
+from yoda_tpu.api.types import PodSpec, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import BatchFilterScorePlugin, Snapshot, Status
 from yoda_tpu.ops.arrays import FleetArrays
@@ -44,6 +46,25 @@ from yoda_tpu.plugins.yoda.filter_plugin import get_request
 # realistic fleet size (measured: 0.2 ms CPU vs 66 ms tunnel at 64x4,
 # 32 ms CPU vs 222 ms tunnel at 131072x8).
 AUTO_DEVICE_MIN_ELEMS = 1 << 22
+
+
+def _host_admission(
+    static: FleetArrays, snapshot: Snapshot, pod: PodSpec
+) -> np.ndarray:
+    """Per-pod Node-object admission vector: cordon + taints vs the pod's
+    tolerations (semantics: api.types.node_admits_pod). Padding rows are
+    masked by node_valid in the kernel, so their value is irrelevant."""
+    ok = np.array(
+        [
+            node_admits_pod(snapshot.get(name).node, pod.tolerations)[0]
+            if name in snapshot
+            else True
+            for name in static.names
+        ]
+        + [True] * (static.node_valid.shape[0] - len(static.names)),
+        dtype=bool,
+    )
+    return ok
 
 
 class YodaBatch(BatchFilterScorePlugin):
@@ -117,11 +138,13 @@ class YodaBatch(BatchFilterScorePlugin):
         req = get_request(state)
         static = self._refresh_static(snapshot)
         # Reservations/claims/freshness change cycle-to-cycle without a
-        # metrics bump: one packed upload.
+        # metrics bump, and Node-object admission (cordon + taints vs THIS
+        # pod's tolerations) is per (pod, cycle): one packed upload.
         dyn = static.dyn_packed(
             self.reserved_fn,
             self.claimed_fn,
             max_metrics_age_s=self.max_metrics_age_s,
+            host_ok=_host_admission(static, snapshot, pod),
         )
         result = self._kern.evaluate(dyn, KernelRequest.from_request(req))
         statuses: dict[str, Status] = {}

@@ -24,7 +24,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, parse_request
-from yoda_tpu.api.types import TpuChip, TpuNodeMetrics
+from yoda_tpu.api.types import TpuChip, TpuNodeMetrics, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
     FilterPlugin,
@@ -174,6 +174,13 @@ class YodaFilter(FilterPlugin):
         self.now_fn = now_fn
 
     def filter(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> Status:
+        # Node-object admission first: cordon / untolerated hard taints make
+        # every capacity question moot (the reference gets this from its
+        # upstream snapshot's NodeUnschedulable/TaintToleration plugins,
+        # reference pkg/yoda/scheduler.go:101).
+        admitted, why = node_admits_pod(node.node, pod.tolerations)
+        if not admitted:
+            return Status.unschedulable(f"node {node.name}: {why}")
         tpu = node.tpu
         if tpu is None:
             # Reference: SCV Get error -> Unschedulable (scheduler.go:72-74).

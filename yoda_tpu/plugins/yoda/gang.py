@@ -42,7 +42,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from yoda_tpu.api.requests import GangSpec
-from yoda_tpu.api.types import PodSpec
+from yoda_tpu.api.types import PodSpec, node_admits_pod
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
@@ -101,7 +101,14 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         avail = available_chips(ni.tpu, req, reserved)
         return max(avail // max(req.effective_chips, 1), 0)
 
-    def _host_fits_member(self, ni: NodeInfo, req, assigned_hosts: set[str]) -> bool:
+    def _host_fits_member(
+        self, ni: NodeInfo, req, assigned_hosts: set[str], tolerations=()
+    ) -> bool:
+        # Node-object admission (cordon / untolerated taints) gates planning
+        # the same way it gates Filter — a planned block must never include
+        # a host the members cannot bind to.
+        if not node_admits_pod(ni.node, tolerations)[0]:
+            return False
         return self._member_slots(ni, req, exclude_hosts=assigned_hosts) >= 1
 
     # --- PreFilter: gang admission ---
@@ -141,6 +148,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             slots = sum(
                 self._member_slots(ni, req, exclude_hosts=set())
                 for ni in snapshot.infos()
+                if node_admits_pod(ni.node, pod.tolerations)[0]
             )
             if slots < remaining:
                 return Status.unschedulable(
@@ -156,7 +164,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         )
         # (Re)plan when there is no plan, or planned hosts became infeasible.
         need_replan = gs.plan is None or not all(
-            self._host_fits_member(snapshot.get(h), req, assigned_hosts)
+            self._host_fits_member(
+                snapshot.get(h), req, assigned_hosts, pod.tolerations
+            )
             for h in plan_hosts_free
             if h in snapshot
         ) or not plan_hosts_free
@@ -178,7 +188,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             gs.plan = plan_slice_placement(
                 snapshot,
                 want_dims=gs.spec.topology,
-                host_ok=lambda ni: self._host_fits_member(ni, req, assigned_hosts),
+                host_ok=lambda ni: self._host_fits_member(
+                    ni, req, assigned_hosts, pod.tolerations
+                ),
                 pinned=pinned,
             )
             gs.assigned = {k: v for k, v in gs.assigned.items() if k in gs.bound}

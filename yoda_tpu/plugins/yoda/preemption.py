@@ -45,7 +45,7 @@ from dataclasses import dataclass
 from typing import Callable, Mapping
 
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, parse_request
-from yoda_tpu.api.types import PodSpec
+from yoda_tpu.api.types import PodSpec, Toleration, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
     NodeInfo,
@@ -126,15 +126,21 @@ class TpuPreemption(PostFilterPlugin):
         out.sort(key=lambda v: v.eviction_order)
         return out
 
-    def _node_eligible(self, ni: NodeInfo, req: TpuRequest) -> bool:
+    def _node_eligible(
+        self,
+        ni: NodeInfo,
+        req: TpuRequest,
+        tolerations: tuple[Toleration, ...] = (),
+    ) -> bool:
         """Eviction can only ever help on nodes the preemptor could pass
         Filter on once capacity frees up — generation is immutable
-        (YodaFilter checks it before capacity, plugins/yoda/filter_plugin.py);
-        without this guard preemption would evict victims on nodes the
-        pod can never land on."""
+        (YodaFilter checks it before capacity, plugins/yoda/filter_plugin.py)
+        and so are cordon/taints within this cycle; without this guard
+        preemption would evict victims on nodes the pod can never land on."""
         return (
             ni.tpu is not None
             and ni.tpu.generation_rank >= req.min_generation_rank
+            and node_admits_pod(ni.node, tolerations)[0]
         )
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
@@ -176,11 +182,16 @@ class TpuPreemption(PostFilterPlugin):
         return unused - invisible + credit
 
     def _minimal_set(
-        self, ni: NodeInfo, req: TpuRequest, needed: int, max_priority: int
+        self,
+        ni: NodeInfo,
+        req: TpuRequest,
+        needed: int,
+        max_priority: int,
+        tolerations: tuple[Toleration, ...] = (),
     ) -> list[Victim] | None:
         """Smallest eviction-ordered victim prefix making ``needed`` member
         slots of ``req`` available on the node, or None."""
-        if not self._node_eligible(ni, req):
+        if not self._node_eligible(ni, req, tolerations):
             return None
         victims = self._victims_on(ni, max_priority)
         chosen: list[Victim] = []
@@ -216,7 +227,9 @@ class TpuPreemption(PostFilterPlugin):
     ) -> tuple[str | None, Status]:
         best: tuple[tuple[int, int, int, str], list[Victim], str] | None = None
         for ni in snapshot.infos():
-            victims = self._minimal_set(ni, req, 1, req.priority)
+            victims = self._minimal_set(
+                ni, req, 1, req.priority, tuple(pod.tolerations)
+            )
             if victims is None or not victims:
                 continue
             cost = (
@@ -257,8 +270,9 @@ class TpuPreemption(PostFilterPlugin):
         # Plain gang: evict globally-cheapest victims until enough slots.
         per_node: dict[str, list[Victim]] = {}
         slots = 0
+        tols = tuple(pod.tolerations)
         for ni in snapshot.infos():
-            if not self._node_eligible(ni, req):
+            if not self._node_eligible(ni, req, tols):
                 continue
             slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
             per_node[ni.name] = self._victims_on(ni, req.priority)
@@ -280,12 +294,14 @@ class TpuPreemption(PostFilterPlugin):
                     continue
                 ni = snapshot.get(name)
                 freed = freed_by_node.get(name, 0)
-                base = self._member_slots_after(ni, req, freed)
+                base = self._member_slots_after(ni, req, freed, tols)
                 acc, prefix = 0, []
                 for v in vs:
                     prefix.append(v)
                     acc += v.chips
-                    gained = self._member_slots_after(ni, req, freed + acc) - base
+                    gained = (
+                        self._member_slots_after(ni, req, freed + acc, tols) - base
+                    )
                     if gained > 0:
                         cost = (
                             max(x.priority for x in prefix),
@@ -316,8 +332,14 @@ class TpuPreemption(PostFilterPlugin):
             )
         )
 
-    def _member_slots_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
-        if not self._node_eligible(ni, req):
+    def _member_slots_after(
+        self,
+        ni: NodeInfo,
+        req: TpuRequest,
+        freed: int,
+        tolerations: tuple[Toleration, ...] = (),
+    ) -> int:
+        if not self._node_eligible(ni, req, tolerations):
             return 0
         return self._avail_after(ni, req, freed) // max(req.effective_chips, 1)
 
@@ -339,7 +361,9 @@ class TpuPreemption(PostFilterPlugin):
         for h in hosts:
             if h not in snapshot:
                 continue
-            vs = self._minimal_set(snapshot.get(h), req, 1, req.priority)
+            vs = self._minimal_set(
+                snapshot.get(h), req, 1, req.priority, tuple(pod.tolerations)
+            )
             if vs is None:
                 continue
             clear.append(h)
@@ -374,9 +398,11 @@ class TpuPreemption(PostFilterPlugin):
         # block search; the chosen block reuses them below.
         sets: dict[str, list[Victim] | None] = {}
 
+        tols = tuple(pod.tolerations)
+
         def host_ok(ni: NodeInfo) -> bool:
             if ni.name not in sets:
-                sets[ni.name] = self._minimal_set(ni, req, 1, req.priority)
+                sets[ni.name] = self._minimal_set(ni, req, 1, req.priority, tols)
             return sets[ni.name] is not None
 
         plan = plan_slice_placement(

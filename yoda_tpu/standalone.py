@@ -85,8 +85,9 @@ def build_stack(
 
     def on_change(event: Event) -> None:
         # New/changed TPU metrics may make parked pods schedulable; pod
-        # deletions free chips. Binds already reactivate via the scheduler.
-        if event.kind == "TpuNodeMetrics" or event.type == "deleted":
+        # deletions free chips; Node changes (uncordon, taint removal, node
+        # re-added) re-open hosts. Binds already reactivate via the scheduler.
+        if event.kind in ("TpuNodeMetrics", "Node") or event.type == "deleted":
             queue.move_all_to_active()
 
     informer = InformerCache(on_pod_pending=queue.add, on_change=on_change)

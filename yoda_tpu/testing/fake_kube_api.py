@@ -23,6 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 POD_KIND = "Pod"
 CR_KIND = "TpuNodeMetrics"
 LEASE_KIND = "Lease"
+NODE_KIND = "Node"
 
 
 @dataclass
@@ -33,15 +34,21 @@ class _State:
     rv: int = 0
     # kind -> key -> object dict (with metadata.resourceVersion set)
     objects: dict[str, dict[str, dict]] = field(
-        default_factory=lambda: {POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}}
+        default_factory=lambda: {
+            POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {}
+        }
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
-        default_factory=lambda: {POD_KIND: [], CR_KIND: [], LEASE_KIND: []}
+        default_factory=lambda: {
+            POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: []
+        }
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
-        default_factory=lambda: {POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0}
+        default_factory=lambda: {
+            POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0
+        }
     )
     uid_seq: int = 0
     stopping: bool = False
@@ -183,6 +190,9 @@ class _Handler(BaseHTTPRequestHandler):
             rest = parts[2:]
             if rest == ["pods"]:
                 return POD_KIND, None, None, None
+            if rest[:1] == ["nodes"]:
+                name = rest[1] if len(rest) > 1 else None
+                return NODE_KIND, None, name, None
             if len(rest) >= 3 and rest[0] == "namespaces" and rest[2] == "pods":
                 ns = rest[1]
                 name = rest[3] if len(rest) > 3 else None
