@@ -52,8 +52,9 @@ class TestSchedulerManifest:
         assert vol["configMap"]["name"] == "yoda-tpu-scheduler-config"
 
     def test_rbac_covers_client_verbs(self):
-        """KubeCluster issues: pod list/watch/delete, pods/binding create,
-        TpuNodeMetrics list/watch (read-only for the scheduler)."""
+        """KubeCluster issues: pod list/watch, pods/binding create,
+        pods/eviction create (preemption), node list/watch, TpuNodeMetrics
+        list/watch (read-only for the scheduler)."""
         (role,) = by_kind(self.docs, "ClusterRole")
         rules = {
             (g, r): set(rule["verbs"])
@@ -61,9 +62,13 @@ class TestSchedulerManifest:
             for g in rule["apiGroups"]
             for r in rule["resources"]
         }
-        assert {"list", "watch", "delete"} <= rules[("", "pods")]
+        assert {"list", "watch"} <= rules[("", "pods")]
         assert "create" in rules[("", "pods/binding")]
+        assert "create" in rules[("", "pods/eviction")]
+        assert {"list", "watch"} <= rules[("", "nodes")]
         assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
+        # Preemption goes through pods/eviction, never bare pod DELETE.
+        assert "delete" not in rules[("", "pods")]
         # Least privilege: the scheduler never writes CRs (unlike the
         # reference's full-verbs grant, deploy/yoda-scheduler.yaml:204-215).
         assert not {"create", "update", "delete"} & rules[(GROUP, "tpunodemetrics")]

@@ -31,6 +31,8 @@ class FakeCluster:
         self._nodes: dict[str, K8sNode] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
+        # Pod keys whose eviction a PodDisruptionBudget would block (tests).
+        self.eviction_blocked: set[str] = set()
 
     # --- watch ---
 
@@ -79,6 +81,16 @@ class FakeCluster:
             pod = self._pods.pop(pod_key, None)
             if pod is not None:
                 self._emit(Event("deleted", "Pod", pod))
+
+    def evict_pod(self, pod_key: str) -> bool:
+        """The pods/eviction subresource, fake-side: deletes unless the test
+        marked the pod PDB-protected via ``eviction_blocked`` (the 429 path
+        of KubeCluster.evict_pod)."""
+        with self._lock:
+            if pod_key in self.eviction_blocked:
+                return False
+        self.delete_pod(pod_key)
+        return True
 
     def get_pod(self, pod_key: str) -> PodSpec | None:
         with self._lock:

@@ -492,6 +492,36 @@ class KubeCluster:
             if e.status != 404:
                 raise
 
+    def evict_pod(self, pod_key: str) -> bool:
+        """Evict via the ``pods/eviction`` subresource — the API-server path
+        that honors PodDisruptionBudgets and grace periods, which a bare
+        DELETE bypasses (upstream preemption evicts; the reference's cluster
+        exhibits that behavior via its upstream scheduler). Returns False
+        when the server refuses the eviction (429: a PDB would be violated)
+        so the caller can retry a later cycle; an already-gone pod counts
+        as evicted."""
+        namespace, name = _split_key(pod_key)
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        try:
+            self.api.request(
+                "POST", f"{_pod_path(namespace, name)}/eviction", body=body
+            )
+        except KubeApiError as e:
+            if e.status == 404:
+                return True
+            if e.status == 429:
+                log.warning(
+                    "eviction of %s refused (disruption budget); will retry",
+                    pod_key,
+                )
+                return False
+            raise
+        return True
+
     def get_pod(self, pod_key: str) -> PodSpec | None:
         with self._lock:
             return self._pods.get(pod_key)

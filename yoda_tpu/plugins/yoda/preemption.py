@@ -40,6 +40,7 @@ latency, never double-booking).
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Callable, Mapping
@@ -62,6 +63,8 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
 )
 from yoda_tpu.plugins.yoda.topology import plan_slice_placement
 
+log = logging.getLogger("yoda_tpu.preemption")
+
 
 @dataclass(frozen=True)
 class Victim:
@@ -81,7 +84,9 @@ class TpuPreemption(PostFilterPlugin):
 
     def __init__(
         self,
-        evict_fn: Callable[[str], None],
+        # Returns False when the eviction was refused (e.g. a
+        # PodDisruptionBudget, KubeCluster.evict_pod); None/True = accepted.
+        evict_fn: Callable[[str], "bool | None"],
         *,
         reserved_fn: Callable[[str], int] | None = None,
         gang_status_fn: Callable[[str], tuple[int, int, int] | None] | None = None,
@@ -246,9 +251,15 @@ class TpuPreemption(PostFilterPlugin):
                 f"pods below priority {req.priority}"
             )
         _, victims, node = best
-        self._evict(victims)
+        evicted, refused = self._evict_or_refused(
+            victims,
+            f"eviction of all {len(victims)} victim(s) on {node} was "
+            "refused (disruption budgets); retrying later",
+        )
+        if refused is not None:
+            return None, refused
         return node, Status(
-            message=f"preempted {len(victims)} pod(s) on {node} for {pod.key}"
+            message=f"preempted {evicted} pod(s) on {node} for {pod.key}"
         )
 
     def _preempt_for_gang(
@@ -324,10 +335,16 @@ class TpuPreemption(PostFilterPlugin):
             )
             victims_left[name] = victims_left[name][len(prefix):]
             slots += gained
-        self._evict(chosen)
+        evicted, refused = self._evict_or_refused(
+            chosen,
+            f"gang {gang.name}: every victim eviction was refused "
+            "(disruption budgets); retrying later",
+        )
+        if refused is not None:
+            return None, refused
         return chosen[-1].node, Status(
             message=(
-                f"preempted {len(chosen)} pod(s) for gang {gang.name} "
+                f"preempted {evicted} pod(s) for gang {gang.name} "
                 f"({remaining} members needed slots)"
             )
         )
@@ -373,10 +390,16 @@ class TpuPreemption(PostFilterPlugin):
                 f"gang {gang.name}: planned hosts cannot all be cleared by "
                 f"preempting below priority {req.priority}"
             )
-        self._evict(victims)
+        evicted, refused = self._evict_or_refused(
+            victims,
+            f"gang {gang.name}: squatter evictions were all refused "
+            "(disruption budgets); retrying later",
+        )
+        if refused is not None:
+            return None, refused
         return clear[0], Status(
             message=(
-                f"preempted {len(victims)} squatter(s) on gang {gang.name}'s "
+                f"preempted {evicted} squatter(s) on gang {gang.name}'s "
                 f"planned hosts {clear}"
             )
         )
@@ -423,18 +446,53 @@ class TpuPreemption(PostFilterPlugin):
             return None, Status.unschedulable(
                 f"gang {gang.name}: planned block is already free; retry"
             )
-        self._evict(victims)
+        evicted, refused = self._evict_or_refused(
+            victims,
+            f"gang {gang.name}: block victim evictions were all refused "
+            "(disruption budgets); retrying later",
+        )
+        if refused is not None:
+            return None, refused
         return next(iter(plan)), Status(
             message=(
-                f"preempted {len(victims)} pod(s) across {len(plan)} host(s) "
+                f"preempted {evicted} pod(s) across {len(plan)} host(s) "
                 f"for gang {gang.name}"
             )
         )
 
-    def _evict(self, victims: list[Victim]) -> None:
+    def _evict(self, victims: list[Victim]) -> int:
+        """Evict the victim set; returns how many evictions the API accepted.
+        ``evict_fn`` returning False (pods/eviction refused: a
+        PodDisruptionBudget would be violated, KubeCluster.evict_pod) or
+        raising does not abort the rest — surviving victims keep their
+        chips, the preemptor simply retries a later cycle against the
+        remaining occupancy. Hard errors (RBAC 403, connection loss) are
+        logged so a permanent failure is diagnosable, not mistaken for a
+        disruption budget."""
+        evicted = 0
         for v in victims:
-            self.evict_fn(v.pod.key)
-        with self._lock:
-            self.preempted_total += len(victims)
-        if self.on_evicted is not None:
-            self.on_evicted(len(victims))
+            try:
+                ok = self.evict_fn(v.pod.key) is not False
+            except Exception as e:
+                log.warning(
+                    "evicting %s failed (%s: %s)", v.pod.key, type(e).__name__, e
+                )
+                ok = False
+            if ok:
+                evicted += 1
+        if evicted:
+            with self._lock:
+                self.preempted_total += evicted
+            if self.on_evicted is not None:
+                self.on_evicted(evicted)
+        return evicted
+
+    def _evict_or_refused(
+        self, victims: list[Victim], refused_msg: str
+    ) -> "tuple[int, Status | None]":
+        """Evict; when EVERY eviction was refused, the preemption attempt
+        failed — return the Unschedulable status the caller should report."""
+        evicted = self._evict(victims)
+        if evicted == 0:
+            return 0, Status.unschedulable(refused_msg)
+        return evicted, None

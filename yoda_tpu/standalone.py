@@ -69,8 +69,11 @@ def build_stack(
     plugins.append(accountant)
     preemption = None
     if config.enable_preemption:
+        # Prefer the pods/eviction subresource (PDB- and grace-aware,
+        # KubeCluster.evict_pod); bare DELETE only for backends without it.
+        evict = getattr(cluster, "evict_pod", cluster.delete_pod)
         preemption = TpuPreemption(
-            cluster.delete_pod,
+            evict,
             reserved_fn=accountant.chips_in_use,
             gang_status_fn=gang.gang_status,
             gang_plan_fn=gang.planned_unassigned_hosts,

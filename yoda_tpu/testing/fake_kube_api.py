@@ -52,6 +52,9 @@ class _State:
     )
     uid_seq: int = 0
     stopping: bool = False
+    # Pod keys whose eviction returns 429 (a PodDisruptionBudget would be
+    # violated) — set via FakeKubeApiServer.set_eviction_blocked.
+    eviction_blocked: set = field(default_factory=set)
 
 
 class FakeKubeApiServer:
@@ -126,6 +129,14 @@ class FakeKubeApiServer:
     def list_keys(self, kind: str) -> list[str]:
         with self.state.lock:
             return sorted(self.state.objects[kind])
+
+    def set_eviction_blocked(self, pod_key: str, blocked: bool = True) -> None:
+        """Mark a pod PDB-protected: POST pods/<name>/eviction returns 429."""
+        with self.state.lock:
+            if blocked:
+                self.state.eviction_blocked.add(pod_key)
+            else:
+                self.state.eviction_blocked.discard(pod_key)
 
 
 def _record(state: _State, kind: str, key: str, obj: dict, etype: str) -> None:
@@ -267,6 +278,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body()
         if kind == POD_KIND and sub == "binding":
             return self._bind(ns, name, body)
+        if kind == POD_KIND and sub == "eviction":
+            return self._evict(ns, name)
         if name:
             return self._send_status(405, "POST to a named resource")
         key = self._key(kind, ns, body)
@@ -321,6 +334,25 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_status(404, f"{kind} {key} not found")
             _append_event(self.state, kind, "DELETED", obj)
         self._send_json(200, obj)
+
+    # --- eviction subresource ---
+
+    def _evict(self, ns: str, name: str) -> None:
+        key = self._key(POD_KIND, ns, name)
+        with self.state.lock:
+            if key in self.state.eviction_blocked:
+                # The real server answers 429 TooManyRequests when deleting
+                # the pod would violate a PodDisruptionBudget.
+                return self._send_status(
+                    429,
+                    f"Cannot evict pod as it would violate the pod's "
+                    f"disruption budget ({key})",
+                )
+            obj = self.state.objects[POD_KIND].pop(key, None)
+            if obj is None:
+                return self._send_status(404, f"pod {key} not found")
+            _append_event(self.state, POD_KIND, "DELETED", obj)
+        self._send_status(201, "evicted")
 
     # --- binding subresource ---
 
