@@ -26,11 +26,14 @@ Semantics follow upstream leaderelection.LeaderElector:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass
 from datetime import datetime, timezone
 from typing import Callable
+
+log = logging.getLogger("yoda_tpu.lease")
 
 LEASE_API_BASE = "/apis/coordination.k8s.io/v1"
 
@@ -259,6 +262,8 @@ class LeaderElector:
             while not stop.is_set():
                 got = self.try_acquire_or_renew()
                 if got and not self._leading.is_set():
+                    log.info("acquired lease %s/%s as %s",
+                             self.namespace, self.name, self.identity)
                     self._leading.set()
                     if on_started_leading:
                         on_started_leading()
@@ -276,6 +281,12 @@ class LeaderElector:
                         self.clock() - self._last_renew >= self.renew_deadline_s
                     )
                     if taken_over or deadline_passed:
+                        log.warning(
+                            "lost leadership of %s/%s (%s)",
+                            self.namespace, self.name,
+                            "taken over by " + view.holder if taken_over
+                            else "renew deadline passed",
+                        )
                         self._leading.clear()
                         if on_stopped_leading:
                             on_stopped_leading()

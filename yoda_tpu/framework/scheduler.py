@@ -9,10 +9,13 @@ resolution callback (gang scheduling, SURVEY.md §7 step 4).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
+
+log = logging.getLogger("yoda_tpu.scheduler")
 
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.framework.cyclestate import CycleState
@@ -87,6 +90,23 @@ class Scheduler:
             unresolvable: bool = False,
         ) -> ScheduleResult:
             r = ScheduleResult(pod.key, outcome, node, message, self.clock() - t0)
+            # One line per outcome at INFO (the reference's operational klog
+            # trail, reference pkg/yoda/scheduler.go:143); waiting members
+            # are routine gang mechanics -> DEBUG.
+            if outcome == "bound":
+                log.info(
+                    "bound %s -> %s (%d/%d nodes feasible, %.1f ms)",
+                    pod.key, node, feasible_count, len(snapshot),
+                    r.latency_s * 1e3,
+                )
+            elif outcome == "nominated":
+                log.info("nominated %s -> %s: %s", pod.key, node, message)
+            elif outcome == "unschedulable":
+                log.info("unschedulable %s: %s", pod.key, message)
+            elif outcome == "error":
+                log.warning("error scheduling %s: %s", pod.key, message)
+            else:
+                log.debug("pod %s waiting at permit on %s", pod.key, node)
             with self._lock:
                 self.stats.results.append(r)
             if self.metrics is not None:
@@ -151,6 +171,16 @@ class Scheduler:
                 batch_scores = {}
                 feasible = sorted(n for n, s in statuses.items() if s.success)
         feasible_count = len(feasible)
+        # The reference's V(3) per-node decision detail (scheduler.go:67).
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug(
+                "pod %s: %d/%d nodes feasible", pod.key, feasible_count,
+                len(snapshot),
+            )
+            for n in sorted(statuses):
+                s = statuses[n]
+                if not s.success:
+                    log.debug("pod %s: node %s rejected: %s", pod.key, n, s.message)
 
         if not feasible:
             with timer.span("postfilter"):
@@ -186,6 +216,17 @@ class Scheduler:
                 totals = dict(batch_scores)
 
         best = max(feasible, key=lambda n: (totals.get(n, 0), n))
+        # Final scores (the reference's V(3) score log, scheduler.go:143).
+        if log.isEnabledFor(logging.DEBUG):
+            ranked = sorted(
+                ((totals.get(n, 0), n) for n in feasible), reverse=True
+            )
+            log.debug(
+                "pod %s: scores %s -> %s",
+                pod.key,
+                [(n, s) for s, n in ranked[:8]],
+                best,
+            )
 
         with timer.span("reserve"):
             st = self.framework.run_reserve(state, pod, best)
@@ -227,6 +268,7 @@ class Scheduler:
         if status.success:
             st = self.framework.run_bind(wp.state, pod, wp.node_name)
             if st.success:
+                log.info("bound %s -> %s (permit released)", pod.key, wp.node_name)
                 with self._lock:
                     self.stats.binds += 1
                 if self.metrics is not None:
@@ -236,6 +278,9 @@ class Scheduler:
                 self.queue.move_all_to_active()
                 return
             status = st
+        log.info(
+            "permit rejected %s on %s: %s", pod.key, wp.node_name, status.message
+        )
         self.framework.run_unreserve(wp.state, pod, wp.node_name)
         self.queue.add_unschedulable(QueuedPodInfo(pod=pod), status.message)
         if self.on_unschedulable:

@@ -41,6 +41,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
+import logging
+
 from yoda_tpu.api.requests import GangSpec
 from yoda_tpu.api.types import PodSpec, node_admits_pod
 from yoda_tpu.cluster.fake import Event
@@ -55,6 +57,8 @@ from yoda_tpu.framework.interfaces import (
 )
 from yoda_tpu.plugins.yoda.filter_plugin import available_chips, get_request
 from yoda_tpu.plugins.yoda.topology import plan_slice_placement
+
+log = logging.getLogger("yoda_tpu.gang")
 
 ALLOWED_HOSTS_KEY = "yoda-gang/allowed-hosts"
 
@@ -193,6 +197,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 ),
                 pinned=pinned,
             )
+            if gs.plan is not None:
+                log.info(
+                    "gang %s: planned %s block on hosts %s",
+                    gs.spec.name,
+                    "x".join(map(str, gs.spec.topology)),
+                    sorted(gs.plan),
+                )
             gs.assigned = {k: v for k, v in gs.assigned.items() if k in gs.bound}
             plan_hosts_free = (
                 set(gs.plan) - set(pinned) if gs.plan else set()
@@ -251,6 +262,11 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             gs = self._gangs[gang_name]
             complete = len(gs.waiting) + len(gs.bound) >= gs.spec.size
             targets = list(gs.waiting) if complete else []
+        if targets:
+            log.info(
+                "gang %s complete: releasing %d waiting member(s)",
+                gang_name, len(targets),
+            )
         for key in targets:
             w = framework.get_waiting_pod(key)
             if w is not None:
@@ -282,6 +298,12 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 return
             gs.failing = True
             targets = list(gs.waiting)
+        if targets:
+            log.warning(
+                "gang %s: member %s rejected (%s); rolling back %d waiting "
+                "member(s)",
+                gs.spec.name, wp.pod.key, status.message, len(targets),
+            )
         for key in targets:
             w = framework.get_waiting_pod(key)
             if w is not None:

@@ -479,6 +479,10 @@ class TpuPreemption(PostFilterPlugin):
                 )
                 ok = False
             if ok:
+                log.info(
+                    "evicted %s (priority %d, %d chip(s)) on %s",
+                    v.pod.key, v.priority, v.chips, v.node,
+                )
                 evicted += 1
         if evicted:
             with self._lock:
