@@ -67,6 +67,12 @@ class SchedulerConfig:
     # None defers the threshold to plugins.yoda.batch.AUTO_DEVICE_MIN_ELEMS.
     kernel_platform: str = "auto"
     kernel_device_min_elems: int | None = None
+    # Shard the fused kernel's fleet row axis over an N-device
+    # jax.sharding.Mesh (parallel.ShardedDeviceFleetKernel): the global
+    # reductions become XLA-inserted ICI collectives. None = single-device
+    # kernel under the kernel_platform policy; when set, mesh devices come
+    # from jax.devices() and kernel_platform is ignored.
+    mesh_devices: int | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SchedulerConfig":
@@ -81,5 +87,11 @@ class SchedulerConfig:
             raise ValueError(
                 "kernel_platform must be 'auto', 'cpu' or 'device', "
                 f"got {cfg.kernel_platform!r}"
+            )
+        if cfg.mesh_devices is not None and (
+            not isinstance(cfg.mesh_devices, int) or cfg.mesh_devices < 1
+        ):
+            raise ValueError(
+                f"mesh_devices must be a positive int, got {cfg.mesh_devices!r}"
             )
         return cfg

@@ -28,6 +28,15 @@ def _bucket(n: int, minimum: int) -> int:
     return b
 
 
+def bucket_rows(n_nodes: int, *, multiple_of: int = 1) -> int:
+    """Padded row count for a fleet of ``n_nodes``: power-of-two growth from
+    the minimum bucket, rounded up to ``multiple_of`` (the mesh-sharded
+    kernel needs rows divisible by the mesh size —
+    parallel.ShardedDeviceFleetKernel)."""
+    b = _bucket(max(n_nodes, 1), _MIN_NODE_BUCKET)
+    return -(-b // multiple_of) * multiple_of
+
+
 @dataclass
 class FleetArrays:
     """Structure-of-arrays view of the fleet. ``names[i]`` maps row i back to

@@ -15,16 +15,24 @@ the per-pod filter+score computation across chips the SPMD way:
   pod at a given fleet bucket shape.
 
 At kind-cluster fleet sizes a single chip is faster end-to-end (no
-collective latency); the sharded path exists for fleet scales where the
-arrays outgrow one chip's HBM/VPU and — more importantly — as the proof
-that the framework's hot computation is mesh-ready (driver contract:
-``__graft_entry__.dryrun_multichip``).
+collective latency); the sharded path serves fleet scales where the arrays
+outgrow one chip's HBM/VPU. It is a first-class product mode:
+``SchedulerConfig(mesh_devices=N)`` makes the batch plugin hold a
+:class:`ShardedDeviceFleetKernel` (device-resident sharded fleet state,
+O(1) round trips per cycle), and ``__graft_entry__.dryrun_multichip`` is
+the driver contract that the mesh path compiles and runs.
 """
 
 from yoda_tpu.parallel.sharded import (
+    ShardedDeviceFleetKernel,
     ShardedFleetKernel,
     default_mesh,
     sharded_filter_score,
 )
 
-__all__ = ["ShardedFleetKernel", "default_mesh", "sharded_filter_score"]
+__all__ = [
+    "ShardedDeviceFleetKernel",
+    "ShardedFleetKernel",
+    "default_mesh",
+    "sharded_filter_score",
+]

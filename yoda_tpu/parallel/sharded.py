@@ -22,14 +22,27 @@ from yoda_tpu.ops.arrays import FleetArrays
 from yoda_tpu.ops.kernel import (
     CHIP_KEYS,
     NODE_KEYS,
+    STATIC_NODE_KEYS,
     KernelRequest,
     KernelResult,
     arrays_dict,
     kernel_impl,
+    kernel_packed,
+    pack_request,
     result_from_outputs,
+    result_from_packed,
 )
 
 FLEET_AXIS = "fleet"
+
+
+def _check_divisible(n_pad: int, shards: int) -> None:
+    if n_pad % shards:
+        raise ValueError(
+            f"fleet bucket {n_pad} rows not divisible by {shards} mesh "
+            f"devices; pass node_bucket a multiple of the mesh size "
+            f"(ops.arrays.bucket_rows)"
+        )
 
 
 def default_mesh(n_devices: int | None = None) -> Mesh:
@@ -86,13 +99,8 @@ class ShardedFleetKernel:
     def __call__(
         self, arrays: FleetArrays, request: KernelRequest
     ) -> KernelResult:
-        shards = self.n_shards()
         n_pad, _ = arrays.padded_shape
-        if n_pad % shards:
-            raise ValueError(
-                f"fleet bucket {n_pad} rows not divisible by {shards} mesh "
-                f"devices; pass node_bucket a multiple of the mesh size"
-            )
+        _check_divisible(n_pad, self.n_shards())
         outputs = self._jitted(
             arrays_dict(arrays),
             np.int32(request.number),
@@ -102,6 +110,65 @@ class ShardedFleetKernel:
             np.int32(request.wants_topology),
         )
         return result_from_outputs(arrays, outputs)
+
+
+class ShardedDeviceFleetKernel:
+    """Mesh-sharded evaluator with device-resident fleet state.
+
+    The ``DeviceFleetKernel`` protocol (``put_static`` once per metrics
+    version, ``evaluate`` per cycle with O(1) host<->device round trips —
+    ops/kernel.py) over a 1-D device mesh: the [N, C] chip grids and static
+    node vectors live row-sharded across the mesh, the per-cycle [4, N]
+    dynamics and [5, N] result are column-sharded, and the kernel's global
+    reductions (cluster maxima, normalization bounds, argmax) become
+    XLA-inserted ICI collectives. Selected by
+    ``SchedulerConfig(mesh_devices=N)`` (plugins/yoda/batch.py); the fleet
+    bucket must be a multiple of the mesh size (ops.arrays.bucket_rows).
+    """
+
+    def __init__(self, weights: Weights, mesh: Mesh | None = None) -> None:
+        self.weights = weights
+        self.mesh = mesh or default_mesh()
+        row = NamedSharding(self.mesh, P(FLEET_AXIS))
+        grid = NamedSharding(self.mesh, P(FLEET_AXIS, None))
+        rep = NamedSharding(self.mesh, P())
+        packed = NamedSharding(self.mesh, P(None, FLEET_AXIS))
+        self._static_shardings = {
+            k: (row if k in STATIC_NODE_KEYS else grid)
+            for k in STATIC_NODE_KEYS + CHIP_KEYS
+        }
+        self._dyn_sharding = packed
+        self._rep = rep
+        self._jitted = jax.jit(
+            functools.partial(kernel_packed, weights=self.weights),
+            in_shardings=(self._static_shardings, packed, rep),
+            out_shardings=packed,
+        )
+        self._static: dict | None = None
+        self._names: list[str] = []
+
+    @property
+    def names(self) -> list[str]:
+        return self._names
+
+    def n_shards(self) -> int:
+        return self.mesh.devices.size
+
+    def put_static(self, arrays: FleetArrays) -> None:
+        """Shard the metrics-version-static arrays across the mesh."""
+        n_pad, _ = arrays.padded_shape
+        _check_divisible(n_pad, self.n_shards())
+        host = {k: getattr(arrays, k) for k in STATIC_NODE_KEYS + CHIP_KEYS}
+        self._static = jax.device_put(host, self._static_shardings)
+        self._names = list(arrays.names)
+
+    def evaluate(self, dyn: np.ndarray, request: KernelRequest) -> KernelResult:
+        if self._static is None:
+            raise RuntimeError("put_static() must run before evaluate()")
+        dyn_d = jax.device_put(dyn, self._dyn_sharding)
+        reqv = jax.device_put(pack_request(request), self._rep)
+        packed = self._jitted(self._static, dyn_d, reqv)
+        return result_from_packed(self._names, np.asarray(packed))
 
 
 def sharded_filter_score(

@@ -37,6 +37,7 @@ def default_plugins(
     max_metrics_age_s: float = 0.0,
     kernel_platform: str = "auto",
     kernel_device_min_elems: int | None = None,
+    mesh_devices: int | None = None,
 ) -> list:
     """Assemble the standard plugin set.
 
@@ -60,6 +61,7 @@ def default_plugins(
                     if kernel_device_min_elems is None
                     else kernel_device_min_elems
                 ),
+                mesh_devices=mesh_devices,
             )
         )
     elif mode == "loop":

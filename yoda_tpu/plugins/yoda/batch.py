@@ -31,7 +31,7 @@ import numpy as np
 from yoda_tpu.api.types import PodSpec, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import BatchFilterScorePlugin, Snapshot, Status
-from yoda_tpu.ops.arrays import FleetArrays
+from yoda_tpu.ops.arrays import FleetArrays, bucket_rows
 from yoda_tpu.ops.kernel import (
     DeviceFleetKernel,
     KernelRequest,
@@ -79,19 +79,35 @@ class YodaBatch(BatchFilterScorePlugin):
         max_metrics_age_s: float = 0.0,
         platform: str = "auto",
         device_min_elems: int = AUTO_DEVICE_MIN_ELEMS,
+        mesh_devices: int | None = None,
     ) -> None:
         if platform not in ("auto", "cpu", "device"):
             raise ValueError(f"platform must be auto|cpu|device, got {platform!r}")
+        if mesh_devices is not None and mesh_devices < 1:
+            raise ValueError(f"mesh_devices must be >= 1, got {mesh_devices}")
         self.reserved_fn = reserved_fn
         self.claimed_fn = claimed_fn
         self.weights = weights or Weights()
         self.max_metrics_age_s = max_metrics_age_s
         self.platform = platform
         self.device_min_elems = device_min_elems
+        self.mesh_devices = mesh_devices
         self._cache_version: int | None = None
         self._static: FleetArrays | None = None
+        # DeviceFleetKernel, or parallel.ShardedDeviceFleetKernel in mesh
+        # mode — same put_static/evaluate protocol.
         self._kern: DeviceFleetKernel | None = None
         self._kern_device = None
+        if mesh_devices:
+            # Eager: an infeasible mesh (more devices than exist) must fail
+            # at construction, not mid-scheduling-cycle. The mesh is fixed
+            # for the plugin's lifetime; the platform policy does not apply
+            # (the mesh IS the device set).
+            from yoda_tpu.parallel import ShardedDeviceFleetKernel, default_mesh
+
+            self._kern = ShardedDeviceFleetKernel(
+                self.weights, mesh=default_mesh(mesh_devices)
+            )
 
     def _device_for(self, arrays: FleetArrays):
         """None = process default device (the accelerator in production)."""
@@ -118,12 +134,19 @@ class YodaBatch(BatchFilterScorePlugin):
         if version and self._cache_version == version and self._static is not None:
             return self._static
         static = FleetArrays.from_snapshot(
-            snapshot, max_metrics_age_s=self.max_metrics_age_s
+            snapshot,
+            max_metrics_age_s=self.max_metrics_age_s,
+            node_bucket=(
+                bucket_rows(len(snapshot), multiple_of=self.mesh_devices)
+                if self.mesh_devices
+                else None
+            ),
         )
-        device = self._device_for(static)
-        if self._kern is None or device != self._kern_device:
-            self._kern = DeviceFleetKernel(self.weights, device=device)
-            self._kern_device = device
+        if not self.mesh_devices:
+            device = self._device_for(static)
+            if self._kern is None or device != self._kern_device:
+                self._kern = DeviceFleetKernel(self.weights, device=device)
+                self._kern_device = device
         self._kern.put_static(static)
         if version:
             self._cache_version = version

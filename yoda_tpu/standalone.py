@@ -60,6 +60,7 @@ def build_stack(
         max_metrics_age_s=config.max_metrics_age_s,
         kernel_platform=config.kernel_platform,
         kernel_device_min_elems=config.kernel_device_min_elems,
+        mesh_devices=config.mesh_devices,
     )
     gang = GangPlugin(
         timeout_s=config.gang_permit_timeout_s,
