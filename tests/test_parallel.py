@@ -184,6 +184,9 @@ class TestMeshMode:
             SchedulerConfig.from_dict({"mesh_devices": 0})
         with pytest.raises(ValueError, match="mesh_devices"):
             SchedulerConfig.from_dict({"mesh_devices": -2})
+        # YAML `mesh_devices: true` must not silently mean a 1-device mesh.
+        with pytest.raises(ValueError, match="mesh_devices"):
+            SchedulerConfig.from_dict({"mesh_devices": True})
 
     def test_infeasible_mesh_fails_at_construction(self):
         """An over-sized mesh must fail when the plugin is built (scheduler

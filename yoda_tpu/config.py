@@ -89,7 +89,9 @@ class SchedulerConfig:
                 f"got {cfg.kernel_platform!r}"
             )
         if cfg.mesh_devices is not None and (
-            not isinstance(cfg.mesh_devices, int) or cfg.mesh_devices < 1
+            isinstance(cfg.mesh_devices, bool)
+            or not isinstance(cfg.mesh_devices, int)
+            or cfg.mesh_devices < 1
         ):
             raise ValueError(
                 f"mesh_devices must be a positive int, got {cfg.mesh_devices!r}"

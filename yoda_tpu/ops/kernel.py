@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
+from typing import Protocol
 
 import jax
 import jax.numpy as jnp
@@ -336,6 +337,20 @@ def result_from_packed(names: list[str], packed: np.ndarray) -> KernelResult:
         scores=packed[3, :n],
         best_index=best if 0 <= best < n else -1,
     )
+
+
+class FleetKernelLike(Protocol):
+    """The device-resident evaluator contract YodaBatch drives: upload the
+    metrics-version-static arrays once, then evaluate per cycle with O(1)
+    host<->device round trips. Satisfied by :class:`DeviceFleetKernel`
+    (single device) and ``parallel.ShardedDeviceFleetKernel`` (mesh)."""
+
+    @property
+    def names(self) -> list[str]: ...
+
+    def put_static(self, arrays: FleetArrays) -> None: ...
+
+    def evaluate(self, dyn: np.ndarray, request: "KernelRequest") -> "KernelResult": ...
 
 
 class DeviceFleetKernel:

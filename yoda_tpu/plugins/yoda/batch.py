@@ -34,6 +34,7 @@ from yoda_tpu.framework.interfaces import BatchFilterScorePlugin, Snapshot, Stat
 from yoda_tpu.ops.arrays import FleetArrays, bucket_rows
 from yoda_tpu.ops.kernel import (
     DeviceFleetKernel,
+    FleetKernelLike,
     KernelRequest,
     REASON_MESSAGES,
 )
@@ -94,9 +95,7 @@ class YodaBatch(BatchFilterScorePlugin):
         self.mesh_devices = mesh_devices
         self._cache_version: int | None = None
         self._static: FleetArrays | None = None
-        # DeviceFleetKernel, or parallel.ShardedDeviceFleetKernel in mesh
-        # mode — same put_static/evaluate protocol.
-        self._kern: DeviceFleetKernel | None = None
+        self._kern: FleetKernelLike | None = None
         self._kern_device = None
         if mesh_devices:
             # Eager: an infeasible mesh (more devices than exist) must fail
