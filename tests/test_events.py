@@ -1,0 +1,176 @@
+"""Scheduling Event emission (cluster/events.py): the upstream-parity
+`kubectl describe pod` trail the reference inherits from the wrapped
+kube-scheduler (reference pkg/register/register.go:10) — Scheduled /
+FailedScheduling / Preempted, with count aggregation per (pod, reason)."""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.events import EventRecorder
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_stack
+
+
+def events_for(stack, pod_name, reason=None):
+    out = [
+        e
+        for e in stack.cluster.list_events()
+        if e["involvedObject"]["name"] == pod_name
+        and (reason is None or e["reason"] == reason)
+    ]
+    return out
+
+
+class TestEventRecorder:
+    def test_aggregates_counts_per_pod_and_reason(self):
+        writes = []
+        rec = EventRecorder(lambda obj, update: writes.append((obj, update)))
+        pod = PodSpec("p")
+        rec.failed_scheduling(pod, "no chips")
+        rec.failed_scheduling(pod, "still no chips")
+        rec.scheduled(pod, "node-1")
+        assert [u for _, u in writes] == [False, True, False]
+        first, second, third = (o for o, _ in writes)
+        assert first["metadata"]["name"] == second["metadata"]["name"]
+        assert second["count"] == 2
+        assert second["message"] == "still no chips"  # latest message wins
+        assert third["reason"] == "Scheduled"
+        assert third["count"] == 1
+        assert third["type"] == "Normal"
+        assert first["type"] == "Warning"
+        assert first["involvedObject"] == {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "namespace": "default",
+            "name": "p",
+            "uid": pod.uid,
+        }
+
+    def test_sink_failures_are_swallowed(self):
+        def boom(obj, update):
+            raise RuntimeError("API server down")
+
+        rec = EventRecorder(boom)
+        rec.scheduled(PodSpec("p"), "n")  # must not raise
+
+
+class TestStackEvents:
+    def test_bound_pod_gets_scheduled_event(self):
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("ok-pod", labels={"tpu/chips": "1", "tpu/hbm": "100"})
+        )
+        stack.scheduler.run_until_idle()
+        evs = events_for(stack, "ok-pod", "Scheduled")
+        assert len(evs) == 1
+        assert "host-1" in evs[0]["message"]
+
+    def test_unschedulable_pod_aggregates_failed_scheduling(self):
+        stack = build_stack(config=SchedulerConfig(enable_preemption=False))
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("greedy", labels={"tpu/chips": "16", "tpu/hbm": "100"})
+        )
+        stack.scheduler.run_until_idle()
+        # Republish to reactivate the parked pod: another failed attempt
+        # must aggregate into the SAME event with count >= 2.
+        agent.publish_all()
+        stack.scheduler.run_until_idle()
+        evs = events_for(stack, "greedy", "FailedScheduling")
+        assert len(evs) == 1
+        assert evs[0]["count"] >= 2
+        assert "chips" in evs[0]["message"]
+
+    def test_preemption_victim_gets_preempted_event(self):
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "victim",
+                labels={"tpu/chips": "4", "tpu/hbm": "100", "tpu/priority": "1"},
+            )
+        )
+        stack.scheduler.run_until_idle()
+        assert stack.cluster.get_pod("default/victim").node_name == "host-1"
+        agent.publish_all()  # metrics reflect the victim's chips
+        stack.cluster.create_pod(
+            PodSpec(
+                "vip",
+                labels={"tpu/chips": "4", "tpu/hbm": "100", "tpu/priority": "9"},
+            )
+        )
+        stack.scheduler.run_until_idle()
+        evs = events_for(stack, "victim", "Preempted")
+        assert len(evs) == 1
+        assert "host-1" in evs[0]["message"]
+
+
+class TestWireEvents:
+    """KubeCluster.write_event over real HTTP: POST on create, PUT on
+    count aggregation, POST->PUT fallthrough on a 409 name collision."""
+
+    @pytest.fixture()
+    def server(self):
+        from yoda_tpu.testing.fake_kube_api import FakeKubeApiServer
+
+        with FakeKubeApiServer() as srv:
+            yield srv
+
+    @pytest.fixture()
+    def kc(self, server):
+        from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
+
+        return KubeCluster(
+            KubeApiClient(
+                KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+            )
+        )
+
+    def test_create_then_aggregate(self, server, kc):
+        rec = EventRecorder(kc.write_event)
+        pod = PodSpec("wire-pod")
+        rec.failed_scheduling(pod, "attempt 1")
+        rec.failed_scheduling(pod, "attempt 2")
+        keys = server.list_keys("Event")
+        assert len(keys) == 1
+        obj = server.get_object("Event", keys[0])
+        assert obj["count"] == 2
+        assert obj["message"] == "attempt 2"
+        rec.scheduled(pod, "node-9")
+        assert len(server.list_keys("Event")) == 2
+
+    def test_ttl_reaped_event_is_recreated(self, server, kc):
+        """The API server garbage-collects Events after --event-ttl; an
+        aggregation PUT hitting 404 must fall back to re-creating, or a
+        long-pending pod silently loses its FailedScheduling trail."""
+        rec = EventRecorder(kc.write_event)
+        pod = PodSpec("long-pending")
+        rec.failed_scheduling(pod, "attempt 1")
+        key = server.list_keys("Event")[0]
+        server.delete_object("Event", key)  # TTL reaper
+        rec.failed_scheduling(pod, "attempt 2")  # PUT 404 -> POST
+        keys = server.list_keys("Event")
+        assert len(keys) == 1
+        obj = server.get_object("Event", keys[0])
+        assert obj["message"] == "attempt 2" and obj["count"] == 2
+
+    def test_conflicting_create_falls_through_to_update(self, server, kc):
+        pod = PodSpec("collide")
+        # Two recorders (scheduler restart): same event name pre-exists.
+        rec1 = EventRecorder(kc.write_event, clock=lambda: 1000.0)
+        rec2 = EventRecorder(kc.write_event, clock=lambda: 1000.0)
+        rec1.failed_scheduling(pod, "before restart")
+        rec2.failed_scheduling(pod, "after restart")  # POST 409 -> PUT
+        keys = server.list_keys("Event")
+        assert len(keys) == 1
+        assert (
+            server.get_object("Event", keys[0])["message"] == "after restart"
+        )

@@ -304,3 +304,73 @@ class TestPostFilter:
         assert r.node == "n0"
         assert sched.stats.preempt_nominations == 1
         assert len(q) == 1
+
+
+class TestPercentageNodesToScore:
+    """percentage_nodes_to_score caps per-node score work (upstream
+    percentageOfNodesToScore; loop path only — the fused kernel scores the
+    fleet in one dispatch)."""
+
+    class CountingScore(ScorePlugin):
+        name = "counting-score"
+
+        def __init__(self):
+            self.calls_per_cycle = []
+            self._calls = 0
+
+        def score(self, state, pod, node):
+            self._calls += 1
+            return 10, Status.ok()
+
+        def flush(self):
+            self.calls_per_cycle.append(self._calls)
+            self._calls = 0
+
+    def _run_pods(self, pct, n_nodes, n_pods):
+        counter = self.CountingScore()
+        fw = Framework([AllowAllFilter(), counter, RecordingBinder()])
+        snapshot = make_snapshot([f"n{i:02d}" for i in range(n_nodes)])
+        q = SchedulingQueue(fw.queue_sort)
+        sched = Scheduler(
+            fw, lambda: snapshot, q, percentage_nodes_to_score=pct
+        )
+        results = []
+        for i in range(n_pods):
+            q.add(PodSpec(f"p{i}"))
+            results.append(sched.schedule_one(q.pop(timeout=0)))
+            counter.flush()
+        return counter, results
+
+    def test_caps_scored_nodes(self):
+        counter, results = self._run_pods(pct=50, n_nodes=24, n_pods=4)
+        assert all(r.outcome == "bound" for r in results)
+        # cap = max(ceil(24 * 50%), MIN_FEASIBLE_TO_SCORE=8) = 12
+        assert counter.calls_per_cycle == [12, 12, 12, 12]
+
+    def test_window_rotates_between_cycles(self):
+        # With equal scores the (score, name) max picks the greatest name IN
+        # THE WINDOW; a rotating window therefore binds different nodes.
+        _, results = self._run_pods(pct=50, n_nodes=24, n_pods=4)
+        assert len({r.node for r in results}) > 1
+
+    def test_small_fleets_score_everything(self):
+        counter, results = self._run_pods(pct=10, n_nodes=6, n_pods=2)
+        assert counter.calls_per_cycle == [6, 6]
+
+    def test_default_scores_all(self):
+        counter, results = self._run_pods(pct=100, n_nodes=24, n_pods=2)
+        assert counter.calls_per_cycle == [24, 24]
+
+    def test_config_validates_range(self):
+        from yoda_tpu.config import SchedulerConfig
+
+        with pytest.raises(ValueError, match="percentage_nodes_to_score"):
+            SchedulerConfig.from_dict({"percentage_nodes_to_score": 0})
+        with pytest.raises(ValueError, match="percentage_nodes_to_score"):
+            SchedulerConfig.from_dict({"percentage_nodes_to_score": 101})
+        # A YAML float would crash rotated[:k] slicing; a bool would
+        # silently mean 1%.
+        with pytest.raises(ValueError, match="percentage_nodes_to_score"):
+            SchedulerConfig.from_dict({"percentage_nodes_to_score": 50.5})
+        with pytest.raises(ValueError, match="percentage_nodes_to_score"):
+            SchedulerConfig.from_dict({"percentage_nodes_to_score": True})

@@ -1,0 +1,128 @@
+"""Kubernetes Event emission: the `kubectl describe pod` trail.
+
+The reference's clusters get scheduling Events for free from the upstream
+kube-scheduler it wraps (reference pkg/register/register.go:10 — the
+framework's EventRecorder emits Scheduled / FailedScheduling); this repo's
+from-scratch loop must emit its own. The recorder follows the upstream
+aggregation discipline: one Event object per (involved pod UID, reason),
+POSTed on first occurrence and updated with an incremented ``count`` and
+refreshed ``lastTimestamp`` on repeats — so a pod retried 50 times shows
+one FailedScheduling row with count=50, not 50 objects.
+
+Reasons emitted (upstream-parity names):
+
+- ``Scheduled`` (Normal) — pod bound to a node,
+- ``FailedScheduling`` (Warning) — no feasible node this attempt,
+- ``Preempted`` (Warning) — on the victim, when preemption evicts it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from yoda_tpu.api.types import PodSpec
+
+# Bounded memory: beyond this many distinct (uid, reason) keys the oldest
+# aggregation entry is dropped (its next event just POSTs a fresh object).
+_MAX_TRACKED = 4096
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+class EventRecorder:
+    """Builds and aggregates k8s Event objects, handing them to ``sink``.
+
+    ``sink(obj, update)`` persists the Event: ``update=False`` means create
+    (POST), ``update=True`` means rewrite the same named object (PUT) — the
+    count-aggregation path. Both cluster backends implement this as
+    ``write_event``. Sink failures are swallowed: events are best-effort
+    observability, never scheduling-path errors (matching upstream, where a
+    broken event broadcaster does not fail the scheduler).
+    """
+
+    def __init__(
+        self,
+        sink: Callable[[dict, bool], None],
+        *,
+        component: str = "yoda-tpu-scheduler",
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.sink = sink
+        self.component = component
+        self.clock = clock
+        self._lock = threading.Lock()
+        # (uid, reason) -> (event name, count, firstTimestamp)
+        self._seen: dict[tuple[str, str], tuple[str, int, float]] = {}
+
+    # --- the public reasons ---
+
+    def scheduled(self, pod: PodSpec, node_name: str) -> None:
+        self._emit(
+            pod,
+            "Normal",
+            "Scheduled",
+            f"Successfully assigned {pod.key} to {node_name}",
+        )
+
+    def failed_scheduling(self, pod: PodSpec, message: str) -> None:
+        self._emit(pod, "Warning", "FailedScheduling", message)
+
+    def preempted(self, victim: PodSpec, node: str) -> None:
+        self._emit(
+            victim,
+            "Warning",
+            "Preempted",
+            f"Preempted by {self.component} on node {node} to make room for "
+            "a higher-priority TPU workload",
+        )
+
+    # --- mechanics ---
+
+    def _emit(self, pod: PodSpec, etype: str, reason: str, message: str) -> None:
+        now = self.clock()
+        key = (pod.uid, reason)
+        with self._lock:
+            prior = self._seen.get(key)
+            if prior is None:
+                # Unique, deterministic-enough name: upstream uses
+                # <pod>.<hex timestamp>; collisions just surface as a 409
+                # the sink's create-then-update handles.
+                name = f"{pod.name}.{format(int(now * 1e6), 'x')}"
+                entry = (name, 1, now)
+            else:
+                entry = (prior[0], prior[1] + 1, prior[2])
+            if len(self._seen) >= _MAX_TRACKED and key not in self._seen:
+                self._seen.pop(next(iter(self._seen)))
+            self._seen[key] = entry
+        name, count, first = entry
+        obj = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": pod.namespace},
+            "involvedObject": {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "namespace": pod.namespace,
+                "name": pod.name,
+                "uid": pod.uid,
+            },
+            "reason": reason,
+            "message": message,
+            "type": etype,
+            "source": {"component": self.component},
+            "firstTimestamp": _iso(first),
+            "lastTimestamp": _iso(now),
+            "count": count,
+        }
+        try:
+            self.sink(obj, count > 1)
+        except Exception:  # noqa: BLE001 — best-effort, see class docstring
+            import logging
+
+            logging.getLogger("yoda_tpu.events").warning(
+                "failed to write event %s/%s", pod.key, reason, exc_info=True
+            )

@@ -29,6 +29,7 @@ class FakeCluster:
         self._pods: dict[str, PodSpec] = {}
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
+        self._events: dict[str, dict] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
         # Pod keys whose eviction a PodDisruptionBudget would block (tests).
@@ -99,6 +100,18 @@ class FakeCluster:
     def list_pods(self) -> list[PodSpec]:
         with self._lock:
             return list(self._pods.values())
+
+    # --- Events (written by cluster.events.EventRecorder) ---
+
+    def write_event(self, obj: dict, update: bool = False) -> None:
+        md = obj.get("metadata", {})
+        key = f"{md.get('namespace', 'default')}/{md['name']}"
+        with self._lock:
+            self._events[key] = obj
+
+    def list_events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events.values())
 
     # --- TpuNodeMetrics CRs (written by the node agent) ---
 

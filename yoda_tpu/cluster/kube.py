@@ -492,6 +492,31 @@ class KubeCluster:
             if e.status != 404:
                 raise
 
+    def write_event(self, obj: dict, update: bool = False) -> None:
+        """Persist a scheduling Event (cluster.events.EventRecorder sink):
+        POST on first occurrence, PUT the same named object on count
+        aggregation. A 409 on create (name collision after recorder
+        restart) falls through to the update path; a 404 on update (the
+        API server TTL-garbage-collected the Event while the recorder
+        still aggregates it — default --event-ttl is 1h, long-pending
+        pods outlive it) falls back to re-creating."""
+        md = obj.get("metadata", {})
+        ns, name = md.get("namespace", "default"), md["name"]
+        base = f"/api/v1/namespaces/{ns}/events"
+        if not update:
+            try:
+                self.api.request("POST", base, body=obj)
+                return
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+        try:
+            self.api.request("PUT", f"{base}/{name}", body=obj)
+        except KubeApiError as e:
+            if e.status != 404:
+                raise
+            self.api.request("POST", base, body=obj)
+
     def evict_pod(self, pod_key: str) -> bool:
         """Evict via the ``pods/eviction`` subresource — the API-server path
         that honors PodDisruptionBudgets and grace periods, which a bare

@@ -59,6 +59,9 @@ class SchedulerConfig:
     weights: Weights = field(default_factory=Weights)
     gang_permit_timeout_s: float = 120.0
     max_metrics_age_s: float = 0.0    # 0 disables staleness filtering
+    # Cap per-node score-plugin work to this % of feasible nodes (upstream
+    # percentageOfNodesToScore; rotating window, min 8 nodes). Applies to
+    # mode="loop" only — the fused kernel scores the fleet in one dispatch.
     percentage_nodes_to_score: int = 100
     enable_preemption: bool = True    # modern-PostFilter eviction (BASELINE config 5)
     # Where the fused kernel runs: "auto" pins small fleets to host CPU
@@ -83,6 +86,15 @@ class SchedulerConfig:
             raise ValueError(f"mode must be 'batch' or 'loop', got {cfg.mode!r}")
         if cfg.gang_permit_timeout_s <= 0:
             raise ValueError("gang_permit_timeout_s must be positive")
+        if (
+            isinstance(cfg.percentage_nodes_to_score, bool)
+            or not isinstance(cfg.percentage_nodes_to_score, int)
+            or not 1 <= cfg.percentage_nodes_to_score <= 100
+        ):
+            raise ValueError(
+                "percentage_nodes_to_score must be an int in [1, 100], got "
+                f"{cfg.percentage_nodes_to_score!r}"
+            )
         if cfg.kernel_platform not in ("auto", "cpu", "device"):
             raise ValueError(
                 "kernel_platform must be 'auto', 'cpu' or 'device', "

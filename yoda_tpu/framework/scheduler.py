@@ -50,6 +50,12 @@ class SchedulerStats:
         return [r.latency_s for r in self.results]
 
 
+# Never score fewer feasible nodes than this when percentage_nodes_to_score
+# caps the set (the scaled-down analog of upstream's minFeasibleNodesToFind,
+# which is 100 — TPU fleets are 1-2 orders smaller than general clusters).
+MIN_FEASIBLE_TO_SCORE = 8
+
+
 class Scheduler:
     def __init__(
         self,
@@ -61,6 +67,7 @@ class Scheduler:
         on_bound: Callable[[PodSpec, str], None] | None = None,
         on_unschedulable: Callable[[PodSpec, str], None] | None = None,
         metrics: SchedulingMetrics | None = None,
+        percentage_nodes_to_score: int = 100,
     ) -> None:
         self.framework = framework
         self.snapshot_fn = snapshot_fn
@@ -70,7 +77,28 @@ class Scheduler:
         self.on_bound = on_bound
         self.on_unschedulable = on_unschedulable
         self.metrics = metrics
+        self.percentage_nodes_to_score = percentage_nodes_to_score
+        self._score_rotor = 0
         self._lock = threading.Lock()
+
+    def _limit_scored_nodes(self, feasible: list[str]) -> list[str]:
+        """Cap how many feasible nodes the per-node score plugins run over
+        (upstream percentageOfNodesToScore). The window rotates between
+        cycles so the cap spreads load instead of always favoring the same
+        name-ordered prefix. Only the per-node ("loop") path calls this: the
+        fused kernel scores the whole fleet in one dispatch, so capping
+        there would cost placement quality and save nothing."""
+        pct = self.percentage_nodes_to_score
+        if pct >= 100 or len(feasible) <= MIN_FEASIBLE_TO_SCORE:
+            return feasible
+        k = max(-(-(len(feasible) * pct) // 100), MIN_FEASIBLE_TO_SCORE)
+        if k >= len(feasible):
+            return feasible
+        with self._lock:
+            start = self._score_rotor % len(feasible)
+            self._score_rotor += k
+        rotated = feasible[start:] + feasible[:start]
+        return sorted(rotated[:k])
 
     # --- one pod ---
 
@@ -169,7 +197,9 @@ class Scheduler:
             else:
                 statuses = self.framework.run_filters(state, pod, snapshot)
                 batch_scores = {}
-                feasible = sorted(n for n, s in statuses.items() if s.success)
+                feasible = self._limit_scored_nodes(
+                    sorted(n for n, s in statuses.items() if s.success)
+                )
         feasible_count = len(feasible)
         # The reference's V(3) per-node decision detail (scheduler.go:67).
         if log.isEnabledFor(logging.DEBUG):

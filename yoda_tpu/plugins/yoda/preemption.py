@@ -92,6 +92,7 @@ class TpuPreemption(PostFilterPlugin):
         gang_status_fn: Callable[[str], tuple[int, int, int] | None] | None = None,
         gang_plan_fn: Callable[[str], list[str] | None] | None = None,
         on_evicted: Callable[[int], None] | None = None,
+        on_victim: Callable[[Victim], None] | None = None,
         scheduler_name: str = "yoda-tpu",
     ) -> None:
         self.evict_fn = evict_fn
@@ -99,6 +100,7 @@ class TpuPreemption(PostFilterPlugin):
         self.gang_status_fn = gang_status_fn
         self.gang_plan_fn = gang_plan_fn
         self.on_evicted = on_evicted
+        self.on_victim = on_victim
         self.scheduler_name = scheduler_name
         self._lock = threading.Lock()
         self.preempted_total = 0  # pods evicted (metrics: preemptions_total)
@@ -484,6 +486,8 @@ class TpuPreemption(PostFilterPlugin):
                     v.pod.key, v.priority, v.chips, v.node,
                 )
                 evicted += 1
+                if self.on_victim is not None:
+                    self.on_victim(v)
         if evicted:
             with self._lock:
                 self.preempted_total += evicted

@@ -13,6 +13,7 @@ import time
 from dataclasses import dataclass
 
 from yoda_tpu.cluster import Event, FakeCluster, InformerCache
+from yoda_tpu.cluster.events import EventRecorder
 from yoda_tpu.config import SchedulerConfig
 from yoda_tpu.framework import Framework, Scheduler, SchedulingQueue
 from yoda_tpu.observability import SchedulingMetrics
@@ -34,6 +35,7 @@ class Stack:
     scheduler: Scheduler
     preemption: TpuPreemption | None = None
     metrics: SchedulingMetrics | None = None
+    events: EventRecorder | None = None
 
 
 def build_stack(
@@ -52,6 +54,13 @@ def build_stack(
     config = config or SchedulerConfig()
     accountant = ChipAccountant()
     metrics = SchedulingMetrics()
+    # Scheduling Events (kubectl describe pod): the reference got these from
+    # the upstream scheduler's recorder; here the loop emits its own.
+    recorder = (
+        EventRecorder(cluster.write_event)
+        if hasattr(cluster, "write_event")
+        else None
+    )
 
     plugins = default_plugins(
         mode=config.mode,
@@ -79,6 +88,11 @@ def build_stack(
             gang_status_fn=gang.gang_status,
             gang_plan_fn=gang.planned_unassigned_hosts,
             on_evicted=metrics.preemptions.inc,
+            on_victim=(
+                (lambda v: recorder.preempted(v.pod, v.node))
+                if recorder
+                else None
+            ),
         )
         plugins.append(preemption)
     if extra_plugins:
@@ -109,7 +123,14 @@ def build_stack(
 
     metrics.attach_fleet(informer.snapshot, accountant.chips_in_use)
     scheduler = Scheduler(
-        framework, informer.snapshot, queue, clock=clock, metrics=metrics
+        framework,
+        informer.snapshot,
+        queue,
+        clock=clock,
+        metrics=metrics,
+        percentage_nodes_to_score=config.percentage_nodes_to_score,
+        on_bound=recorder.scheduled if recorder else None,
+        on_unschedulable=recorder.failed_scheduling if recorder else None,
     )
     return Stack(
         cluster,
@@ -121,4 +142,5 @@ def build_stack(
         scheduler,
         preemption,
         metrics,
+        recorder,
     )

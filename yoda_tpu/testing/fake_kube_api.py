@@ -24,6 +24,7 @@ POD_KIND = "Pod"
 CR_KIND = "TpuNodeMetrics"
 LEASE_KIND = "Lease"
 NODE_KIND = "Node"
+EVENT_KIND = "Event"
 
 
 @dataclass
@@ -35,19 +36,22 @@ class _State:
     # kind -> key -> object dict (with metadata.resourceVersion set)
     objects: dict[str, dict[str, dict]] = field(
         default_factory=lambda: {
-            POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {}
+            POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {},
+            EVENT_KIND: {}
         }
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
         default_factory=lambda: {
-            POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: []
+            POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: [],
+            EVENT_KIND: []
         }
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
         default_factory=lambda: {
-            POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0
+            POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0,
+            EVENT_KIND: 0
         }
     )
     uid_seq: int = 0
@@ -209,6 +213,10 @@ class _Handler(BaseHTTPRequestHandler):
                 name = rest[3] if len(rest) > 3 else None
                 sub = rest[4] if len(rest) > 4 else None
                 return POD_KIND, ns, name, sub
+            if len(rest) >= 3 and rest[0] == "namespaces" and rest[2] == "events":
+                ns = rest[1]
+                name = rest[3] if len(rest) > 3 else None
+                return EVENT_KIND, ns, name, None
             return None
         if len(parts) >= 3 and parts[0] == "apis":
             from yoda_tpu.api.types import GROUP, VERSION
@@ -232,7 +240,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _key(kind: str, namespace: str | None, obj_or_name) -> str:
-        if kind in (POD_KIND, LEASE_KIND):  # namespaced kinds
+        if kind in (POD_KIND, LEASE_KIND, EVENT_KIND):  # namespaced kinds
             if isinstance(obj_or_name, dict):
                 md = obj_or_name.get("metadata", {})
                 return f"{md.get('namespace', namespace or 'default')}/{md['name']}"
