@@ -30,6 +30,7 @@ class TestEventRecorder:
         rec.failed_scheduling(pod, "no chips")
         rec.failed_scheduling(pod, "still no chips")
         rec.scheduled(pod, "node-1")
+        assert rec.flush()
         assert [u for _, u in writes] == [False, True, False]
         first, second, third = (o for o, _ in writes)
         assert first["metadata"]["name"] == second["metadata"]["name"]
@@ -53,6 +54,7 @@ class TestEventRecorder:
 
         rec = EventRecorder(boom)
         rec.scheduled(PodSpec("p"), "n")  # must not raise
+        assert rec.flush()  # worker swallowed the sink failure
 
 
 class TestStackEvents:
@@ -65,6 +67,7 @@ class TestStackEvents:
             PodSpec("ok-pod", labels={"tpu/chips": "1", "tpu/hbm": "100"})
         )
         stack.scheduler.run_until_idle()
+        assert stack.events.flush()
         evs = events_for(stack, "ok-pod", "Scheduled")
         assert len(evs) == 1
         assert "host-1" in evs[0]["message"]
@@ -82,6 +85,7 @@ class TestStackEvents:
         # must aggregate into the SAME event with count >= 2.
         agent.publish_all()
         stack.scheduler.run_until_idle()
+        assert stack.events.flush()
         evs = events_for(stack, "greedy", "FailedScheduling")
         assert len(evs) == 1
         assert evs[0]["count"] >= 2
@@ -108,6 +112,7 @@ class TestStackEvents:
             )
         )
         stack.scheduler.run_until_idle()
+        assert stack.events.flush()
         evs = events_for(stack, "victim", "Preempted")
         assert len(evs) == 1
         assert "host-1" in evs[0]["message"]
@@ -139,12 +144,14 @@ class TestWireEvents:
         pod = PodSpec("wire-pod")
         rec.failed_scheduling(pod, "attempt 1")
         rec.failed_scheduling(pod, "attempt 2")
+        assert rec.flush()
         keys = server.list_keys("Event")
         assert len(keys) == 1
         obj = server.get_object("Event", keys[0])
         assert obj["count"] == 2
         assert obj["message"] == "attempt 2"
         rec.scheduled(pod, "node-9")
+        assert rec.flush()
         assert len(server.list_keys("Event")) == 2
 
     def test_ttl_reaped_event_is_recreated(self, server, kc):
@@ -154,9 +161,11 @@ class TestWireEvents:
         rec = EventRecorder(kc.write_event)
         pod = PodSpec("long-pending")
         rec.failed_scheduling(pod, "attempt 1")
+        assert rec.flush()
         key = server.list_keys("Event")[0]
         server.delete_object("Event", key)  # TTL reaper
         rec.failed_scheduling(pod, "attempt 2")  # PUT 404 -> POST
+        assert rec.flush()
         keys = server.list_keys("Event")
         assert len(keys) == 1
         obj = server.get_object("Event", keys[0])
@@ -168,7 +177,9 @@ class TestWireEvents:
         rec1 = EventRecorder(kc.write_event, clock=lambda: 1000.0)
         rec2 = EventRecorder(kc.write_event, clock=lambda: 1000.0)
         rec1.failed_scheduling(pod, "before restart")
+        assert rec1.flush()
         rec2.failed_scheduling(pod, "after restart")  # POST 409 -> PUT
+        assert rec2.flush()
         keys = server.list_keys("Event")
         assert len(keys) == 1
         assert (

@@ -18,15 +18,23 @@ Reasons emitted (upstream-parity names):
 
 from __future__ import annotations
 
+import logging
+import queue
 import threading
 import time
 from typing import Callable
 
 from yoda_tpu.api.types import PodSpec
 
+log = logging.getLogger("yoda_tpu.events")
+
 # Bounded memory: beyond this many distinct (uid, reason) keys the oldest
 # aggregation entry is dropped (its next event just POSTs a fresh object).
 _MAX_TRACKED = 4096
+
+# Bounded backlog of unsent events; overflow drops the newest (best-effort,
+# like upstream's broadcaster, which also sheds under pressure).
+_MAX_PENDING = 1024
 
 
 def _iso(ts: float) -> str:
@@ -39,9 +47,17 @@ class EventRecorder:
     ``sink(obj, update)`` persists the Event: ``update=False`` means create
     (POST), ``update=True`` means rewrite the same named object (PUT) — the
     count-aggregation path. Both cluster backends implement this as
-    ``write_event``. Sink failures are swallowed: events are best-effort
-    observability, never scheduling-path errors (matching upstream, where a
-    broken event broadcaster does not fail the scheduler).
+    ``write_event``.
+
+    The sink runs on a dedicated worker thread (upstream keeps event
+    emission off the scheduling path via an async broadcaster for the same
+    reason: with a real API server every write is a blocking HTTP round
+    trip, and the scheduling loop must not pay it). Enqueueing happens
+    inside the aggregation lock, so sink calls for one (pod, reason) are
+    strictly ordered — a later count can never be overwritten by an earlier
+    one. Sink failures are logged and swallowed: events are best-effort
+    observability, never scheduling-path errors. Call :meth:`flush` to wait
+    for the backlog (tests, shutdown).
     """
 
     def __init__(
@@ -57,6 +73,36 @@ class EventRecorder:
         self._lock = threading.Lock()
         # (uid, reason) -> (event name, count, firstTimestamp)
         self._seen: dict[tuple[str, str], tuple[str, int, float]] = {}
+        self._pending: queue.Queue = queue.Queue(maxsize=_MAX_PENDING)
+        self._worker = threading.Thread(
+            target=self._drain, daemon=True, name="yoda-events"
+        )
+        self._worker.start()
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until all enqueued events reached the sink (True) or the
+        timeout passed (False)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._pending.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def _drain(self) -> None:
+        while True:
+            obj, update = self._pending.get()
+            try:
+                self.sink(obj, update)
+            except Exception:  # noqa: BLE001 — best-effort, see docstring
+                log.warning(
+                    "failed to write event %s/%s",
+                    obj["metadata"].get("namespace"),
+                    obj["metadata"].get("name"),
+                    exc_info=True,
+                )
+            finally:
+                self._pending.task_done()
 
     # --- the public reasons ---
 
@@ -98,8 +144,27 @@ class EventRecorder:
             if len(self._seen) >= _MAX_TRACKED and key not in self._seen:
                 self._seen.pop(next(iter(self._seen)))
             self._seen[key] = entry
-        name, count, first = entry
-        obj = {
+            name, count, first = entry
+            obj = self._build(pod, etype, reason, message, name, count, first, now)
+            try:
+                # Inside the lock: enqueue order == aggregation order, so
+                # the worker can never persist counts out of order.
+                self._pending.put_nowait((obj, count > 1))
+            except queue.Full:
+                log.warning("event backlog full; dropping %s/%s", pod.key, reason)
+
+    def _build(
+        self,
+        pod: PodSpec,
+        etype: str,
+        reason: str,
+        message: str,
+        name: str,
+        count: int,
+        first: float,
+        now: float,
+    ) -> dict:
+        return {
             "apiVersion": "v1",
             "kind": "Event",
             "metadata": {"name": name, "namespace": pod.namespace},
@@ -118,11 +183,3 @@ class EventRecorder:
             "lastTimestamp": _iso(now),
             "count": count,
         }
-        try:
-            self.sink(obj, count > 1)
-        except Exception:  # noqa: BLE001 — best-effort, see class docstring
-            import logging
-
-            logging.getLogger("yoda_tpu.events").warning(
-                "failed to write event %s/%s", pod.key, reason, exc_info=True
-            )

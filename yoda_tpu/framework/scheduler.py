@@ -200,7 +200,9 @@ class Scheduler:
                 feasible = self._limit_scored_nodes(
                     sorted(n for n, s in statuses.items() if s.success)
                 )
-        feasible_count = len(feasible)
+        # The true filter-pass count — NOT len(feasible), which
+        # _limit_scored_nodes may have capped to the scoring window.
+        feasible_count = sum(1 for s in statuses.values() if s.success)
         # The reference's V(3) per-node decision detail (scheduler.go:67).
         if log.isEnabledFor(logging.DEBUG):
             log.debug(
