@@ -56,6 +56,67 @@ def _binpack_scenario() -> float:
     return stack.metrics.binpack_efficiency.value()
 
 
+def _mixed_fleet_scenario() -> dict:
+    """BASELINE config 5: low-priority inference pods + 2 high-priority
+    training gangs contending for a v5e-64 pool, with preemption. 40
+    inference chips + 32 gang chips > 64 chips forces eviction. Returns the
+    per-pod scheduling-attempt p99 under contention and the eviction count;
+    asserts both gangs bound atomically."""
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    stack = build_stack(config=SchedulerConfig(mode="batch"))
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(8):
+        agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+    agent.publish_all()
+
+    # Warmup: pay the kernel compile at this fleet bucket outside the
+    # measurement (same discipline as the gang scenario).
+    stack.cluster.create_pod(PodSpec("mixed-warmup", labels={"tpu/chips": "1"}))
+    stack.scheduler.run_until_idle(max_wall_s=120)
+    stack.cluster.delete_pod("default/mixed-warmup")
+    stack.scheduler.run_until_idle(max_wall_s=10)
+    n_warm = len(stack.scheduler.stats.results)
+
+    for i in range(40):
+        stack.cluster.create_pod(
+            PodSpec(f"inf-{i}", labels={"tpu/chips": "1", "tpu/priority": "1"})
+        )
+    stack.scheduler.run_until_idle(max_wall_s=60)
+    agent.publish_all()  # metrics reflect inference usage
+
+    for g in range(2):
+        for m in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train{g}-{m}",
+                    labels={
+                        "tpu/gang": f"train{g}",
+                        "tpu/gang-size": "4",
+                        "tpu/chips": "4",
+                        "tpu/priority": "9",
+                    },
+                )
+            )
+    stack.scheduler.run_until_idle(max_wall_s=120)
+
+    pods = stack.cluster.list_pods()
+    for g in range(2):
+        bound = [
+            p for p in pods if p.name.startswith(f"train{g}-") and p.node_name
+        ]
+        assert len(bound) == 4, f"train{g}: only {len(bound)}/4 members bound"
+    lats = sorted(r.latency_s for r in stack.scheduler.stats.results[n_warm:])
+    p99 = lats[min(int(len(lats) * 0.99), len(lats) - 1)] * 1000.0
+    return {
+        "mixed_p99_ms": round(p99, 2),
+        "mixed_evictions": stack.preemption.preempted_total,
+    }
+
+
 def _device_probe() -> dict:
     """Measure the device-resident kernel's per-eval latency on the default
     accelerator vs host CPU at a bench-scale bucket — the data behind the
@@ -145,6 +206,8 @@ def run_bench() -> dict:
 
     efficiency = _binpack_scenario()
     print(f"binpack efficiency (saturated v5e-64): {efficiency:.3f}", file=sys.stderr)
+    mixed = _mixed_fleet_scenario()
+    print(f"mixed-fleet contention (config 5): {mixed}", file=sys.stderr)
     probe = _device_probe()
     if probe:
         print(f"kernel device probe: {probe}", file=sys.stderr)
@@ -156,6 +219,7 @@ def run_bench() -> dict:
         "vs_baseline": round(BASELINE_P99_MS / p99, 2),
         "p50_ms": round(p50, 2),
         "binpack_efficiency": round(efficiency, 4),
+        **mixed,
         **probe,
     }
 

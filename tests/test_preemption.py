@@ -477,3 +477,55 @@ class TestAvailAfterModel:
         ni = NodeInfo("host", tpu=tpu)
         # Evicting the small-chip squatters frees nothing usable.
         assert plugin._avail_after(ni, req, freed=2) == 2
+
+
+class TestNoEvictionCascade:
+    """Regression: stale metrics must not cause over-eviction. Before the
+    stale-freed correction (filter_plugin.stale_freed_chips), each gang
+    member's cycle saw already-evicted chips as still occupied (the agent
+    had not re-scraped) and evicted MORE victims — a cascade that could
+    empty the whole fleet's inference tier for one gang."""
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_gang_preemption_evicts_minimally(self, mode):
+        stack, agent = make_stack(mode)
+        for i in range(2):
+            agent.add_host(f"host-{i}", chips=8)
+        agent.publish_all()
+        # 5 one-chip inference pods per host: 3 chips free on each.
+        for i in range(10):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"inf-{i}", labels={"tpu/chips": "1", "tpu/priority": "1"}
+                )
+            )
+        stack.scheduler.run_until_idle()
+        agent.publish_all()  # metrics reflect inference usage
+
+        # Gang of 2 members x 4 chips: each host must free exactly 1 chip.
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"train-{m}",
+                    labels={
+                        "tpu/gang": "train",
+                        "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                        "tpu/priority": "9",
+                    },
+                )
+            )
+        # NO republish between cycles: the scheduler must see its own
+        # evictions through accounting, not wait for the agent.
+        stack.scheduler.run_until_idle(max_wall_s=30)
+
+        bound = [
+            p
+            for p in stack.cluster.list_pods()
+            if p.name.startswith("train-") and p.node_name
+        ]
+        assert len(bound) == 2, "gang did not fully bind"
+        assert stack.preemption.preempted_total == 2, (
+            f"expected exactly 2 evictions (1 per host), got "
+            f"{stack.preemption.preempted_total} — eviction cascade"
+        )

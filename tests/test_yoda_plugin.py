@@ -274,3 +274,105 @@ class TestEndToEndCycle:
         q.add(PodSpec("high", labels={"tpu/priority": "9"}))
         first = q.pop(timeout=0)
         assert first.pod.name == "high"
+
+
+class TestStaleFreedChips:
+    """Metrics-lag symmetry: chips the metrics show used with no live claim
+    behind them were freed by a delete/evict the agent hasn't re-scraped
+    (filter_plugin.stale_freed_chips) — the release-direction mirror of
+    invisible_reservations. Without it, preemption cascades: every gang
+    member's cycle re-evicts because the freed chips still look occupied."""
+
+    def test_freed_chips_count_as_available(self):
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            stale_freed_chips,
+        )
+
+        # All 4 chips show consumption in metrics, but no pod claims any:
+        # everything was deleted since the last scrape.
+        node = make_node("n", chips=4, hbm_free_per_chip=1 * GIB)
+        req = req_of(**{"tpu/chips": 2, "tpu/hbm": "8Gi"})
+        assert stale_freed_chips(node, req, reserved=0) == 4
+        assert available_chips(node, req, reserved=0) == 4
+        # Two live claims: only the other two chips are stale-freed.
+        assert stale_freed_chips(node, req, reserved=2) == 2
+        assert available_chips(node, req, reserved=2) == 2
+        # Claims cover all visible usage: nothing freed.
+        assert stale_freed_chips(node, req, reserved=4) == 0
+
+    def test_freed_chips_must_qualify_when_full(self):
+        from yoda_tpu.plugins.yoda.filter_plugin import stale_freed_chips
+
+        # hbm_total below the per-chip ask: freed chips can never satisfy it.
+        node = make_node(
+            "n", chips=4, hbm_per_chip=4 * GIB, hbm_free_per_chip=1 * GIB
+        )
+        assert stale_freed_chips(node, req_of(**{"tpu/hbm": "8Gi"}), 0) == 0
+        # Clock below the ask: same.
+        slow = make_node(
+            "slow", chips=4, clock_mhz=700, hbm_free_per_chip=1 * GIB
+        )
+        assert stale_freed_chips(slow, req_of(**{"tpu/clock": 900}), 0) == 0
+
+    def test_live_claims_assumed_on_qualifying_chips(self):
+        """WHICH used chips are free is unknown: worst case, the live claim
+        sits on the qualifying chip, so a stale unqualifying chip earns no
+        credit (count-vs-identity hazard)."""
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            stale_freed_chips,
+        )
+
+        node = make_node("n", chips=2, hbm_free_per_chip=1 * GIB)
+        node.chips[1].clock_mhz = 700  # the stale chip is the slow one
+        req = req_of(**{"tpu/chips": 1, "tpu/clock": 900})
+        # One live claim (on either chip), one stale: the qualifying fast
+        # chip may be the claimed one, so nothing is creditable.
+        assert stale_freed_chips(node, req, reserved=1) == 0
+        assert available_chips(node, req, reserved=1) == 0
+
+    def test_no_accounting_source_gives_no_credit(self):
+        """reserved=None (no accountant wired): a fully-occupied node must
+        NOT look free just because nothing claims its chips — in both the
+        Python predicate and the fused kernel."""
+        from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+        from yoda_tpu.ops.arrays import FleetArrays
+        from yoda_tpu.ops.kernel import fused_filter_score
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            stale_freed_chips,
+        )
+
+        node = make_node("n", chips=4, hbm_free_per_chip=1 * GIB)
+        req = req_of(**{"tpu/chips": 2, "tpu/hbm": "8Gi"})
+        assert stale_freed_chips(node, req, reserved=None) == 0
+        assert available_chips(node, req, reserved=None) == 0
+
+        snapshot = Snapshot({"n": NodeInfo("n", tpu=node)})
+        arrays = FleetArrays.from_snapshot(snapshot)  # reserved_fn=None
+        result = fused_filter_score(arrays, req)
+        assert not result.feasible[0]
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_deleted_pods_chips_rebind_without_republish(self, mode):
+        """A full host whose pod is deleted must accept a replacement pod
+        IMMEDIATELY — before the node agent republishes metrics."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(config=SchedulerConfig(mode=mode))
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("first", labels={"tpu/chips": "4"}))
+        stack.scheduler.run_until_idle()
+        assert stack.cluster.get_pod("default/first").node_name == "host-1"
+        agent.publish_all()  # metrics now show all 4 chips consumed
+
+        stack.cluster.delete_pod("default/first")
+        # NO publish_all here: metrics still claim the chips are used.
+        stack.cluster.create_pod(PodSpec("second", labels={"tpu/chips": "4"}))
+        stack.scheduler.run_until_idle()
+        assert stack.cluster.get_pod("default/second").node_name == "host-1"

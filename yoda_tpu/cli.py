@@ -158,6 +158,10 @@ def _run_scheduler(args, stop: threading.Event) -> int:
         )
         stack.scheduler.serve_forever(stop)
     finally:
+        if stack.events is not None:
+            # Drain pending Scheduled/FailedScheduling/Preempted events so a
+            # SIGTERM right after a decision doesn't lose its trail.
+            stack.events.close(timeout_s=5.0)
         if metrics_srv is not None:
             metrics_srv.stop()
         if elector_thread is not None:

@@ -89,9 +89,20 @@ class EventRecorder:
             time.sleep(0.005)
         return False
 
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Flush the backlog and stop the worker thread. Events emitted
+        after close() are aggregated but never sent."""
+        self.flush(timeout_s)
+        self._pending.put(None)
+        self._worker.join(timeout=timeout_s)
+
     def _drain(self) -> None:
         while True:
-            obj, update = self._pending.get()
+            item = self._pending.get()
+            if item is None:
+                self._pending.task_done()
+                return
+            obj, update = item
             try:
                 self.sink(obj, update)
             except Exception:  # noqa: BLE001 — best-effort, see docstring

@@ -69,6 +69,11 @@ class FleetArrays:
     def n_nodes(self) -> int:
         return len(self.names)
 
+    def _apparently_used(self) -> np.ndarray:
+        """Per-node count of healthy chips whose metrics show consumption
+        (kernel_impl's apparently_used, host-side)."""
+        return np.sum(self.chip_healthy & self.chip_used, axis=1).astype(np.int32)
+
     @property
     def padded_shape(self) -> tuple[int, int]:
         return self.chip_valid.shape
@@ -135,8 +140,6 @@ class FleetArrays:
                 if max_metrics_age_s <= 0
                 else tpu.fresh(max_age_s=max_metrics_age_s, now=now)
             )
-            if reserved_fn is not None:
-                reserved[i] = reserved_fn(ni.name)
             claimed[i] = min(_claimed_hbm_mib(ni), np.iinfo(np.int32).max)
             for j, chip in enumerate(tpu.chips[:c_pad]):
                 chip_valid[i, j] = True
@@ -148,6 +151,13 @@ class FleetArrays:
                 bw[i, j] = chip.hbm_bandwidth_gbps
                 tflops[i, j] = chip.tflops_bf16
                 power[i, j] = chip.power_w
+            if reserved_fn is not None:
+                reserved[i] = reserved_fn(ni.name)
+            else:
+                # No accounting: pin reserved to metrics-visible usage so
+                # the kernel's invisible-reservation and stale-freed
+                # corrections both vanish (kernel_impl comment).
+                reserved[i] = int(np.sum(healthy[i] & chip_used[i]))
 
         return cls(
             names=names,
@@ -190,10 +200,16 @@ class FleetArrays:
         out = dict(vars(self))
         if host_ok is not None:
             out["host_ok"] = host_ok
-        reserved = np.zeros_like(self.reserved_chips)
         if reserved_fn is not None:
+            reserved = np.zeros_like(self.reserved_chips)
             for i, name in enumerate(self.names):
                 reserved[i] = reserved_fn(name)
+        else:
+            # No accounting source: pin reserved to the metrics-visible
+            # usage so the kernel's invisible-reservation AND stale-freed
+            # corrections both vanish (a fully-occupied node must not look
+            # free just because nothing claims it — kernel_impl comment).
+            reserved = self._apparently_used()
         out["reserved_chips"] = reserved
         if claimed_fn is not None:
             claimed = np.zeros_like(self.claimed_hbm_mib)
@@ -233,6 +249,10 @@ class FleetArrays:
         if reserved_fn is not None:
             for i, name in enumerate(self.names):
                 dyn[1, i] = reserved_fn(name)
+        else:
+            # No accounting: neutralize both reservation corrections (see
+            # with_dynamic).
+            dyn[1] = self._apparently_used()
         if claimed_fn is not None:
             for i, name in enumerate(self.names):
                 dyn[2, i] = min(claimed_fn(name), np.iinfo(np.int32).max)

@@ -163,15 +163,34 @@ def kernel_impl(
     # Predicate parity with plugins/yoda/filter_plugin.py (and reference
     # filter.go): the hbm/clock counts are independent; the reservation
     # check mirrors filter_plugin.available_chips — chips already showing
-    # consumption are excluded (exclusive-chip model), and reservations not
-    # yet visible in metrics are subtracted on top.
+    # consumption are excluded (exclusive-chip model), reservations not yet
+    # visible in metrics are subtracted on top, and chips whose metrics
+    # usage has no live claim behind it (freed by a delete/evict the agent
+    # hasn't re-scraped — filter_plugin.stale_freed_chips) are added back
+    # at full HBM, gated on qualifying-when-full.
     apparently_used = jnp.sum(healthy & a["chip_used"], axis=1)
     invisible = jnp.clip(a["reserved_chips"] - apparently_used, 0)
+    stale_freed = jnp.clip(apparently_used - a["reserved_chips"], 0)
+    # WHICH used chips are free is unknown: worst case, the remaining live
+    # claims sit on qualifying used chips first (filter_plugin.
+    # stale_freed_chips parity). No-accounting callers neutralize both
+    # corrections by passing reserved_chips == apparently_used
+    # (ops.arrays.dyn_packed / with_dynamic).
+    freed_candidates = jnp.sum(
+        healthy
+        & a["chip_used"]
+        & (a["clock_mhz"] >= clock_mhz)
+        & (a["hbm_total_mib"] >= hbm_mib),
+        axis=1,
+    )
+    freed = jnp.minimum(
+        stale_freed, jnp.clip(freed_candidates - a["reserved_chips"], 0)
+    )
     count_avail = jnp.sum(qual & ~a["chip_used"], axis=1)
     fits_chips = count_healthy >= number
-    fits_hbm = (hbm_mib == 0) | (count_hbm >= number)
+    fits_hbm = (hbm_mib == 0) | ((count_hbm + freed) >= number)
     fits_clock = (clock_mhz == 0) | (count_clock >= number)
-    fits_reserved = (count_avail - invisible) >= number
+    fits_reserved = (count_avail + freed - invisible) >= number
     fits_gen = a["generation_rank"] >= gen_rank
 
     feasible = (

@@ -117,18 +117,67 @@ def invisible_reservations(node: TpuNodeMetrics, reserved: int) -> int:
     return max(reserved - apparently_used_chips(node), 0)
 
 
-def available_chips(node: TpuNodeMetrics, req: TpuRequest, reserved: int) -> int:
+def stale_freed_chips(
+    node: TpuNodeMetrics, req: TpuRequest, reserved: int | None
+) -> int:
+    """Chips the metrics still show as used but NO live pod claims — freed
+    by a delete/evict the node agent hasn't re-scraped yet. The mirror of
+    :func:`invisible_reservations`: the accountant tracks every live
+    chip-holding pod (accounting.py), so metrics-used minus reserved is
+    usage that no longer exists. Without this, preemption cascades: each
+    gang member's cycle sees the evicted chips as still occupied and evicts
+    MORE victims until the agent republishes (SURVEY.md §3.3's stale-data
+    class, in the release direction).
+
+    ``reserved=None`` means NO accounting source exists: then "used with no
+    live claim" is indistinguishable from plain usage and the credit must
+    be zero (a fully-occupied node must not look free).
+
+    A freed chip returns to full HBM (exclusive-chip model), so it counts
+    only if it would qualify when full (healthy, clock ok, total HBM >= the
+    per-chip ask) — and WHICH used chips are free is unknown, so the worst
+    case is assumed: the remaining live claims sit on the qualifying used
+    chips first, leaving only the surplus beyond ``reserved`` creditable."""
+    if reserved is None:
+        return 0
+    reserved = max(reserved, 0)
+    stale = apparently_used_chips(node) - reserved
+    if stale <= 0:
+        return 0
+    candidates = sum(
+        1
+        for c in node.chips
+        if c.healthy
+        and c.hbm_free < c.hbm_total
+        and c.clock_mhz >= req.min_clock_mhz
+        and c.hbm_total >= req.hbm_per_chip
+    )
+    return min(stale, max(candidates - reserved, 0))
+
+
+def available_chips(
+    node: TpuNodeMetrics, req: TpuRequest, reserved: int | None
+) -> int:
     """Qualifying chips actually claimable under the exclusive-chip model.
 
     TPU chips attach to one process at a time (unlike the reference's
     GPU-memory-sharing model, filter.go:18-33), so a chip already showing
     consumption in metrics is NOT available no matter how much HBM remains
     free on it; reservations the metrics haven't caught up with are
-    subtracted on top (each occupies one not-yet-visibly-used chip)."""
+    subtracted on top (each occupies one not-yet-visibly-used chip), and
+    chips freed by deletions the metrics haven't caught up with are added
+    back (:func:`stale_freed_chips`). ``reserved=None`` = no accounting:
+    neither correction applies."""
     unused = sum(
         1 for c in qualifying_chips(node, req) if c.hbm_free >= c.hbm_total
     )
-    return unused - invisible_reservations(node, reserved)
+    if reserved is None:
+        return unused
+    return (
+        unused
+        - invisible_reservations(node, reserved)
+        + stale_freed_chips(node, req, reserved)
+    )
 
 
 # --- plugins ---
@@ -196,26 +245,33 @@ class YodaFilter(FilterPlugin):
                 f"node {node.name} generation {tpu.generation} below requested"
             )
 
+        reserved = (
+            self.reserved_chips_fn(node.name)
+            if self.reserved_chips_fn
+            else None
+        )
+        freed = stale_freed_chips(tpu, req, reserved)
+
         ok, number = pod_fits_chips(req, tpu)
         if not ok:
             return Status.unschedulable(
                 f"node {node.name} has {len(tpu.healthy_chips())} healthy chips, "
                 f"pod needs {number}"
             )
-        if not pod_fits_hbm(number, req, tpu):
+        # Freed-but-not-yet-rescraped chips will have full HBM, so they
+        # satisfy the per-chip HBM predicate (stale_freed_chips already
+        # required hbm_total >= the requirement).
+        if not pod_fits_hbm(max(number - freed, 0), req, tpu):
             return Status.unschedulable(f"node {node.name} lacks free HBM on {number} chips")
         if not pod_fits_clock(number, req, tpu):
             return Status.unschedulable(
                 f"node {node.name} lacks {number} chips at >= {req.min_clock_mhz} MHz"
             )
 
-        reserved = (
-            self.reserved_chips_fn(node.name) if self.reserved_chips_fn else 0
-        )
         available = available_chips(tpu, req, reserved)
         if available < number:
             return Status.unschedulable(
-                f"node {node.name}: {reserved} chips reserved in-flight, "
+                f"node {node.name}: {reserved or 0} chips reserved in-flight, "
                 f"only {max(available, 0)} unoccupied qualifying chips"
             )
         return Status.ok()

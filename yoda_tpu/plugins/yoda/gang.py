@@ -101,7 +101,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         """How many members of ``req`` the node could take right now."""
         if ni.tpu is None or ni.name in exclude_hosts:
             return 0
-        reserved = self.reserved_fn(ni.name) if self.reserved_fn else 0
+        reserved = self.reserved_fn(ni.name) if self.reserved_fn else None
         avail = available_chips(ni.tpu, req, reserved)
         return max(avail // max(req.effective_chips, 1), 0)
 

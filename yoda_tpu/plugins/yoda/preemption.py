@@ -168,14 +168,22 @@ class TpuPreemption(PostFilterPlugin):
         unqualifiable visible chips belong to the victims. Conservative —
         may pick one victim more than strictly needed, never evicts a set
         that cannot make the preemptor schedulable."""
-        reserved = self.reserved_fn(ni.name) if self.reserved_fn else 0
+        # With an accounting source the whole model reduces to one identity:
+        # evicting victims that free ``freed`` chips removes their live
+        # claims, so availability after is exactly available_chips at
+        # reserved - freed — monotone in ``freed`` by construction, and it
+        # shares the stale-freed credit with the Filter path (a divergence
+        # here re-opens the over-eviction cascade that credit closed).
+        reserved = self.reserved_fn(ni.name) if self.reserved_fn else None
+        if reserved is not None:
+            return available_chips(ni.tpu, req, max(reserved - freed, 0))
         if freed == 0:
-            return available_chips(ni.tpu, req, reserved)
+            return available_chips(ni.tpu, req, None)
+        # No accounting: metrics-only worst case (original formula).
         unused = sum(
             1 for c in qualifying_chips(ni.tpu, req) if c.hbm_free >= c.hbm_total
         )
         visible = apparently_used_chips(ni.tpu)
-        invisible = max(reserved - visible, 0)
         qualifiable_visible = sum(
             1
             for c in ni.tpu.chips
@@ -186,7 +194,7 @@ class TpuPreemption(PostFilterPlugin):
         )
         unqualifiable_visible = max(visible - qualifiable_visible, 0)
         credit = freed - min(freed, unqualifiable_visible)
-        return unused - invisible + credit
+        return unused + credit
 
     def _minimal_set(
         self,
