@@ -179,3 +179,97 @@ class TestParseRequest:
     def test_topology_malformed(self, text):
         with pytest.raises(LabelParseError):
             parse_topology(text)
+
+
+class TestTpuResourceLimit:
+    """GKE-style chip requests: containers' google.com/tpu resource limits
+    (no reference analog — the reference was label-only). The limit is the
+    chip-count fallback; an explicit tpu/chips label wins."""
+
+    def test_pod_roundtrip_carries_limit(self):
+        from yoda_tpu.api.types import PodSpec
+
+        pod = PodSpec("gke-pod", tpu_resource_limit=4)
+        restored = PodSpec.from_obj(pod.to_obj())
+        assert restored.tpu_resource_limit == 4
+
+    def test_from_obj_sums_containers(self):
+        from yoda_tpu.api.types import PodSpec
+
+        obj = {
+            "metadata": {"name": "multi"},
+            "spec": {
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "4"}}},
+                    {"resources": {"limits": {"google.com/tpu": "2"}}},
+                    {"resources": {}},  # no limits at all
+                ]
+            },
+        }
+        assert PodSpec.from_obj(obj).tpu_resource_limit == 6
+
+    def test_limit_is_chip_fallback_and_label_wins(self):
+        from yoda_tpu.api.requests import pod_request
+        from yoda_tpu.api.types import PodSpec
+
+        plain = PodSpec("p", tpu_resource_limit=4)
+        assert pod_request(plain).effective_chips == 4
+        assert pod_request(plain).wants_tpu
+        labeled = PodSpec(
+            "q", labels={"tpu/chips": "2"}, tpu_resource_limit=4
+        )
+        assert pod_request(labeled).effective_chips == 2
+
+    def test_resource_limit_pod_schedules_and_accounts(self):
+        """A label-less GKE pod (resource limit only) binds AND its chips
+        are accounted: a second such pod must not double-book the host."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("gke-a", tpu_resource_limit=4))
+        stack.cluster.create_pod(PodSpec("gke-b", tpu_resource_limit=4))
+        stack.scheduler.run_until_idle()
+        a = stack.cluster.get_pod("default/gke-a")
+        b = stack.cluster.get_pod("default/gke-b")
+        assert a.node_name == "host-1"
+        assert b.node_name is None  # host full; no double-booking
+        assert stack.accountant.chips_in_use("host-1") == 4
+
+    def test_quantity_suffix_notation(self):
+        from yoda_tpu.api.types import PodSpec
+
+        obj = {
+            "metadata": {"name": "q"},
+            "spec": {
+                "containers": [
+                    {"resources": {"limits": {"google.com/tpu": "2k"}}}
+                ]
+            },
+        }
+        assert PodSpec.from_obj(obj).tpu_resource_limit == 2000
+
+    def test_foreign_pod_with_bad_labels_still_accounted(self):
+        """A default-scheduler pod with a malformed tpu/* label but a valid
+        google.com/tpu limit holds real chips: it must stay in accounting,
+        or stale_freed_chips would credit its usage as free capacity."""
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.cluster.fake import Event
+        from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+
+        acct = ChipAccountant()
+        foreign = PodSpec(
+            "foreign",
+            labels={"tpu/clock": "fast"},  # malformed
+            scheduler_name="default-scheduler",
+            node_name="host-1",
+            tpu_resource_limit=4,
+        )
+        acct.handle(Event("added", "Pod", foreign))
+        assert acct.chips_in_use("host-1") == 4
+        acct.handle(Event("deleted", "Pod", foreign))
+        assert acct.chips_in_use("host-1") == 0

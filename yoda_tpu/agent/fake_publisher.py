@@ -13,7 +13,7 @@ import itertools
 import time
 from dataclasses import dataclass
 
-from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.api.requests import LabelParseError, pod_request
 from yoda_tpu.api.types import HEALTHY, TpuChip, TpuNodeMetrics
 
 GIB = 1 << 30
@@ -28,7 +28,7 @@ def charge_bound_pods(free: list[int], pods, node_name: str) -> None:
         if pod.node_name != node_name or pod.phase not in ("Running", "Pending"):
             continue
         try:
-            req = parse_request(pod.labels)
+            req = pod_request(pod)
         except LabelParseError:
             continue
         for _ in range(req.effective_chips):

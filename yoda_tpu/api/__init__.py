@@ -20,6 +20,7 @@ from yoda_tpu.api.requests import (
     LabelParseError,
     TpuRequest,
     parse_request,
+    pod_request,
     parse_topology,
 )
 
@@ -36,5 +37,6 @@ __all__ = [
     "TpuRequest",
     "LabelParseError",
     "parse_request",
+    "pod_request",
     "parse_topology",
 ]

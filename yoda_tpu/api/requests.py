@@ -108,15 +108,24 @@ def parse_topology(text: str) -> tuple[int, ...]:
     return dims
 
 
-def parse_request(labels: Mapping[str, str]) -> TpuRequest:
+def parse_request(
+    labels: Mapping[str, str], *, tpu_limit: int = 0
+) -> TpuRequest:
     """Parse a pod's labels into a ``TpuRequest``. Strict: raises
-    ``LabelParseError`` on any malformed ``tpu/*`` value."""
+    ``LabelParseError`` on any malformed ``tpu/*`` value.
+
+    ``tpu_limit`` carries the pod's ``google.com/tpu`` container resource
+    limit (the way unmodified GKE TPU workloads request chips — no
+    reference analog, the reference was label-only): it becomes the chip
+    count when no ``tpu/chips`` label is present; an explicit label wins."""
     try:
         chips = parse_int(labels[CHIPS], field=CHIPS) if CHIPS in labels else None
         hbm = parse_quantity(labels[HBM]) if HBM in labels else 0
         clock = parse_int(labels[CLOCK], field=CLOCK) if CLOCK in labels else 0
     except QuantityError as e:
         raise LabelParseError(str(e)) from e
+    if chips is None and tpu_limit > 0:
+        chips = tpu_limit
 
     gen_rank = 0
     if GENERATION in labels:
@@ -172,4 +181,15 @@ def parse_request(labels: Mapping[str, str]) -> TpuRequest:
         min_generation_rank=gen_rank,
         priority=priority,
         gang=gang,
+    )
+
+
+def pod_request(pod) -> TpuRequest:
+    """Parse a pod's scheduling constraints: ``tpu/*`` labels plus the GKE
+    ``google.com/tpu`` container resource limit as the chip-count fallback
+    (api.types.PodSpec.tpu_resource_limit). Use this — not bare
+    ``parse_request(pod.labels)`` — wherever a whole pod is in hand, so
+    label pods and resource-limit pods are accounted identically."""
+    return parse_request(
+        pod.labels, tpu_limit=getattr(pod, "tpu_resource_limit", 0)
     )

@@ -27,6 +27,7 @@ freshness, see SURVEY.md §5 failure-detection row).
 from __future__ import annotations
 
 import itertools
+import logging
 import time
 from dataclasses import dataclass, field, asdict
 from typing import Any, Iterable, Mapping, Sequence
@@ -49,6 +50,46 @@ KIND = "TpuNodeMetrics"
 # tie-break that survives restart/relist; annotations persist arbitrary keys
 # on real API servers, unlike unknown bare metadata fields).
 SEQ_ANNOTATION = f"{GROUP}/creation-seq"
+
+# The extended-resource name GKE TPU node pools expose; pods request chips
+# through container resource limits on it (the label API's real-world twin).
+TPU_RESOURCE = "google.com/tpu"
+
+
+# Decimal/binary suffixes K8s integer quantities may carry. Extended
+# resources must be whole numbers, so fractional ("0.5", "500m") forms are
+# invalid for google.com/tpu and rejected below.
+_QTY_SUFFIX = {
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+}
+
+
+def _tpu_limit_of(spec: "Mapping[str, Any]") -> int:
+    """Sum the containers' google.com/tpu limits, accepting the integer
+    Kubernetes quantity notations ('4', '2k', '1Ki'). Unparseable values
+    are logged and skipped — loudly, not silently (the repo's
+    no-silent-zero rule): on a real cluster the API server validates
+    quantities, so this only fires on hand-written fixtures."""
+    total = 0
+    for c in spec.get("containers", []) or []:
+        raw = (c.get("resources", {}) or {}).get("limits", {}).get(TPU_RESOURCE)
+        if raw is None:
+            continue
+        s = str(raw).strip()
+        mult = 1
+        for suffix, m in _QTY_SUFFIX.items():
+            if s.endswith(suffix):
+                s, mult = s[: -len(suffix)], m
+                break
+        try:
+            total += int(s) * mult
+        except ValueError:
+            logging.getLogger("yoda_tpu.api").warning(
+                "ignoring unparseable %s quantity %r", TPU_RESOURCE, raw
+            )
+            continue
+    return total
 
 
 @dataclass
@@ -286,6 +327,10 @@ class PodSpec:
     phase: str = "Pending"
     uid: str = ""
     tolerations: list[Toleration] = field(default_factory=list)
+    # Sum of the containers' google.com/tpu resource limits — how
+    # unmodified GKE TPU workloads request chips (requests.pod_request uses
+    # it as the chip count when no tpu/chips label is present).
+    tpu_resource_limit: int = 0
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -303,6 +348,17 @@ class PodSpec:
         }
         if self.tolerations:
             spec["tolerations"] = [t.to_obj() for t in self.tolerations]
+        if self.tpu_resource_limit:
+            spec["containers"] = [
+                {
+                    "name": "main",
+                    "resources": {
+                        "limits": {
+                            TPU_RESOURCE: str(self.tpu_resource_limit)
+                        }
+                    },
+                }
+            ]
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -354,6 +410,7 @@ class PodSpec:
             tolerations=[
                 Toleration.from_obj(t) for t in spec.get("tolerations", [])
             ],
+            tpu_resource_limit=_tpu_limit_of(spec),
             **kwargs,
         )
 

@@ -91,9 +91,15 @@ class EventRecorder:
 
     def close(self, timeout_s: float = 5.0) -> None:
         """Flush the backlog and stop the worker thread. Events emitted
-        after close() are aggregated but never sent."""
+        after close() are aggregated but never sent. Bounded: if the
+        backlog is wedged (sink blocked on an unreachable API server) the
+        sentinel is skipped and the daemon worker dies with the process —
+        close() must never hold a SIGTERM handler past its timeout."""
         self.flush(timeout_s)
-        self._pending.put(None)
+        try:
+            self._pending.put_nowait(None)
+        except queue.Full:
+            return
         self._worker.join(timeout=timeout_s)
 
     def _drain(self) -> None:

@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
-from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.api.requests import LabelParseError, pod_request
 from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
@@ -170,7 +170,7 @@ class InformerCache:
 
 def _pod_claim_mib(pod: PodSpec) -> int:
     try:
-        r = parse_request(pod.labels)
+        r = pod_request(pod)
     except LabelParseError:
         return 0
     return (r.hbm_per_chip // MIB) * r.effective_chips

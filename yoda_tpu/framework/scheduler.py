@@ -320,7 +320,7 @@ class Scheduler:
 
     # --- the loop ---
 
-    def run_until_idle(self, *, max_wall_s: float = 30.0, settle_s: float = 0.01) -> None:
+    def run_until_idle(self, *, max_wall_s: float = 30.0, settle_s: float = 0.002) -> None:
         """Drain the queue, resolving Permit waits and expirations, until no
         active work remains or ``max_wall_s`` passes. Test/demo driver; the
         production loop is ``serve_forever``."""

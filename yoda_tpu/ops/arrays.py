@@ -265,12 +265,12 @@ class FleetArrays:
 def _claimed_hbm_mib(ni) -> int:
     """HBM claimed by pods already placed on the node (reference
     CalculateAllocateScore input, pkg/yoda/score/algorithm.go:77-80)."""
-    from yoda_tpu.api.requests import LabelParseError, parse_request
+    from yoda_tpu.api.requests import LabelParseError, pod_request
 
     total = 0
     for pod in ni.pods:
         try:
-            r = parse_request(pod.labels)
+            r = pod_request(pod)
         except LabelParseError:
             continue
         total += (r.hbm_per_chip // MIB) * r.effective_chips

@@ -21,7 +21,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 
-from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.api.requests import LabelParseError, pod_request
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.cyclestate import CycleState
@@ -69,8 +69,18 @@ class ChipAccountant(ReservePlugin):
             # expresses a TPU request. Foreign non-TPU pods (daemonsets etc.)
             # hold no chips.
             try:
-                req = parse_request(pod.labels)
+                req = pod_request(pod)
             except LabelParseError:
+                # Malformed tpu/* labels: still account what is knowable.
+                # A google.com/tpu resource limit attaches real chips no
+                # matter what the labels say — dropping the claim would turn
+                # this pod's usage into stale-freed credit
+                # (filter_plugin.stale_freed_chips) and double-book it.
+                if pod.tpu_resource_limit > 0:
+                    self._claim(
+                        pod.uid, pod.node_name, pod.tpu_resource_limit
+                    )
+                    return
                 if pod.scheduler_name != self.scheduler_name:
                     return
                 req = None

@@ -23,7 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable
 
-from yoda_tpu.api.requests import LabelParseError, TpuRequest, parse_request
+from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
 from yoda_tpu.api.types import TpuChip, TpuNodeMetrics, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
@@ -156,7 +156,11 @@ def stale_freed_chips(
 
 
 def available_chips(
-    node: TpuNodeMetrics, req: TpuRequest, reserved: int | None
+    node: TpuNodeMetrics,
+    req: TpuRequest,
+    reserved: int | None,
+    *,
+    freed: int | None = None,
 ) -> int:
     """Qualifying chips actually claimable under the exclusive-chip model.
 
@@ -166,18 +170,17 @@ def available_chips(
     free on it; reservations the metrics haven't caught up with are
     subtracted on top (each occupies one not-yet-visibly-used chip), and
     chips freed by deletions the metrics haven't caught up with are added
-    back (:func:`stale_freed_chips`). ``reserved=None`` = no accounting:
-    neither correction applies."""
+    back (:func:`stale_freed_chips`; pass ``freed`` when the caller already
+    computed it). ``reserved=None`` = no accounting: neither correction
+    applies."""
     unused = sum(
         1 for c in qualifying_chips(node, req) if c.hbm_free >= c.hbm_total
     )
     if reserved is None:
         return unused
-    return (
-        unused
-        - invisible_reservations(node, reserved)
-        + stale_freed_chips(node, req, reserved)
-    )
+    if freed is None:
+        freed = stale_freed_chips(node, req, reserved)
+    return unused - invisible_reservations(node, reserved) + freed
 
 
 # --- plugins ---
@@ -192,7 +195,7 @@ class YodaPreFilter(PreFilterPlugin):
 
     def pre_filter(self, state: CycleState, pod: PodSpec, snapshot: Snapshot) -> Status:
         try:
-            req = parse_request(pod.labels)
+            req = pod_request(pod)
         except LabelParseError as e:
             return Status.unresolvable(f"invalid tpu/* labels: {e}")
         state.write(REQUEST_KEY, RequestData(req))
@@ -245,19 +248,18 @@ class YodaFilter(FilterPlugin):
                 f"node {node.name} generation {tpu.generation} below requested"
             )
 
-        reserved = (
-            self.reserved_chips_fn(node.name)
-            if self.reserved_chips_fn
-            else None
-        )
-        freed = stale_freed_chips(tpu, req, reserved)
-
         ok, number = pod_fits_chips(req, tpu)
         if not ok:
             return Status.unschedulable(
                 f"node {node.name} has {len(tpu.healthy_chips())} healthy chips, "
                 f"pod needs {number}"
             )
+        reserved = (
+            self.reserved_chips_fn(node.name)
+            if self.reserved_chips_fn
+            else None
+        )
+        freed = stale_freed_chips(tpu, req, reserved)
         # Freed-but-not-yet-rescraped chips will have full HBM, so they
         # satisfy the per-chip HBM predicate (stale_freed_chips already
         # required hbm_total >= the requirement).
@@ -268,7 +270,7 @@ class YodaFilter(FilterPlugin):
                 f"node {node.name} lacks {number} chips at >= {req.min_clock_mhz} MHz"
             )
 
-        available = available_chips(tpu, req, reserved)
+        available = available_chips(tpu, req, reserved, freed=freed)
         if available < number:
             return Status.unschedulable(
                 f"node {node.name}: {reserved or 0} chips reserved in-flight, "

@@ -336,10 +336,10 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 # Bound member (bind we initiated, or watch replay after a
                 # scheduler restart): reconstruct membership.
                 if gs is None:
-                    from yoda_tpu.api.requests import LabelParseError, parse_request
+                    from yoda_tpu.api.requests import LabelParseError, pod_request
 
                     try:
-                        spec = parse_request(pod.labels).gang
+                        spec = pod_request(pod).gang
                     except LabelParseError:
                         return
                     if spec is None:

@@ -45,7 +45,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Mapping
 
-from yoda_tpu.api.requests import LabelParseError, TpuRequest, parse_request
+from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
 from yoda_tpu.api.types import PodSpec, Toleration, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
@@ -113,7 +113,7 @@ class TpuPreemption(PostFilterPlugin):
         both priority and chips. Mirrors the accountant's occupancy rules
         (plugins/yoda/accounting.py)."""
         try:
-            req = parse_request(pod.labels)
+            req = pod_request(pod)
         except LabelParseError:
             if pod.scheduler_name != self.scheduler_name:
                 return None

@@ -23,7 +23,7 @@ panic on TotalMemorySum == 0).
 
 from __future__ import annotations
 
-from yoda_tpu.api.requests import LabelParseError, parse_request
+from yoda_tpu.api.requests import LabelParseError, pod_request
 from yoda_tpu.config import SLICE_PROTECT_TIER, Weights
 from yoda_tpu.api.types import PodSpec, TpuChip, TpuNodeMetrics
 from yoda_tpu.framework.cyclestate import CycleState
@@ -74,7 +74,7 @@ def allocate_score(node: NodeInfo, tpu: TpuNodeMetrics, w: Weights) -> int:
     claimed = 0
     for placed in node.pods:
         try:
-            r = parse_request(placed.labels)
+            r = pod_request(placed)
         except LabelParseError:
             continue  # unparseable placed pod claims nothing
         claimed += r.hbm_per_chip * r.effective_chips
