@@ -273,3 +273,37 @@ class TestTpuResourceLimit:
         assert acct.chips_in_use("host-1") == 4
         acct.handle(Event("deleted", "Pod", foreign))
         assert acct.chips_in_use("host-1") == 0
+
+    def test_spec_priority_fallback_and_label_wins(self):
+        from yoda_tpu.api.requests import pod_request
+        from yoda_tpu.api.types import PodSpec
+
+        gke = PodSpec("p", spec_priority=1000)
+        assert pod_request(gke).priority == 1000
+        restored = PodSpec.from_obj(gke.to_obj())
+        assert restored.spec_priority == 1000
+        labeled = PodSpec("q", labels={"tpu/priority": "5"}, spec_priority=1000)
+        assert pod_request(labeled).priority == 5
+
+    def test_spec_priority_drives_preemption(self):
+        """A PriorityClass pod (spec.priority, no labels) preempts a
+        lower-priority label pod — both priority systems interoperate."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("low", labels={"tpu/chips": "4", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle()
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("vip", tpu_resource_limit=4, spec_priority=1000)
+        )
+        stack.scheduler.run_until_idle()
+        assert stack.cluster.get_pod("default/low") is None  # evicted
+        assert stack.cluster.get_pod("default/vip").node_name == "host-1"

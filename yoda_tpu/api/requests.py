@@ -109,7 +109,7 @@ def parse_topology(text: str) -> tuple[int, ...]:
 
 
 def parse_request(
-    labels: Mapping[str, str], *, tpu_limit: int = 0
+    labels: Mapping[str, str], *, tpu_limit: int = 0, spec_priority: int = 0
 ) -> TpuRequest:
     """Parse a pod's labels into a ``TpuRequest``. Strict: raises
     ``LabelParseError`` on any malformed ``tpu/*`` value.
@@ -137,7 +137,7 @@ def parse_request(
             )
         gen_rank = GENERATION_RANK[gen]
 
-    priority = 0
+    priority = spec_priority
     if PRIORITY in labels:
         # Queue priority may be negative (the reference's strconv.Atoi accepts
         # negatives, sort/sort.go:14) — parse as a signed int, but strictly.
@@ -191,5 +191,7 @@ def pod_request(pod) -> TpuRequest:
     ``parse_request(pod.labels)`` — wherever a whole pod is in hand, so
     label pods and resource-limit pods are accounted identically."""
     return parse_request(
-        pod.labels, tpu_limit=getattr(pod, "tpu_resource_limit", 0)
+        pod.labels,
+        tpu_limit=getattr(pod, "tpu_resource_limit", 0),
+        spec_priority=getattr(pod, "spec_priority", 0),
     )

@@ -331,6 +331,10 @@ class PodSpec:
     # unmodified GKE TPU workloads request chips (requests.pod_request uses
     # it as the chip count when no tpu/chips label is present).
     tpu_resource_limit: int = 0
+    # spec.priority — what the admission controller resolves from
+    # priorityClassName; the fallback when no tpu/priority label is set
+    # (upstream preemption orders by this field).
+    spec_priority: int = 0
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -348,6 +352,8 @@ class PodSpec:
         }
         if self.tolerations:
             spec["tolerations"] = [t.to_obj() for t in self.tolerations]
+        if self.spec_priority:
+            spec["priority"] = self.spec_priority
         if self.tpu_resource_limit:
             spec["containers"] = [
                 {
@@ -411,6 +417,7 @@ class PodSpec:
                 Toleration.from_obj(t) for t in spec.get("tolerations", [])
             ],
             tpu_resource_limit=_tpu_limit_of(spec),
+            spec_priority=int(spec.get("priority") or 0),
             **kwargs,
         )
 

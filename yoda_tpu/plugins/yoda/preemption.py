@@ -115,11 +115,18 @@ class TpuPreemption(PostFilterPlugin):
         try:
             req = pod_request(pod)
         except LabelParseError:
+            # Mirrors the accountant's malformed-label rules: a valid
+            # google.com/tpu limit occupies real chips (and must be
+            # evictable, or accounting counts chips preemption can never
+            # free); spec.priority still ranks the victim.
+            prio = getattr(pod, "spec_priority", 0)
+            if pod.tpu_resource_limit > 0:
+                return Victim(pod, node, prio, pod.tpu_resource_limit)
             if pod.scheduler_name != self.scheduler_name:
                 return None
-            # Our own strict PreFilter never binds unparseable pods; rank a
-            # replayed legacy pod lowest.
-            return Victim(pod, node, 0, 1)
+            # Our own strict PreFilter never binds unparseable pods: a
+            # replayed legacy pod, ranked by its spec priority alone.
+            return Victim(pod, node, prio, 1)
         if not req.wants_tpu and pod.scheduler_name != self.scheduler_name:
             return None
         return Victim(pod, node, req.priority, req.effective_chips)

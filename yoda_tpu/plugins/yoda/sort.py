@@ -14,10 +14,13 @@ from yoda_tpu.api import requests
 from yoda_tpu.framework.interfaces import QueuedPodLike, QueueSortPlugin
 
 
-def pod_priority(pod_labels: dict[str, str]) -> int:
-    raw = pod_labels.get(requests.PRIORITY)
+def pod_priority(pod) -> int:
+    """Queue priority: the ``tpu/priority`` label, falling back to
+    ``spec.priority`` (the PriorityClass-resolved field, how unmodified GKE
+    workloads express it — requests.pod_request parity)."""
+    raw = pod.labels.get(requests.PRIORITY)
     if raw is None:
-        return 0
+        return getattr(pod, "spec_priority", 0)
     try:
         return int(raw.strip())
     except ValueError:
@@ -28,4 +31,4 @@ class YodaSort(QueueSortPlugin):
     name = "yoda-sort"
 
     def less(self, a: QueuedPodLike, b: QueuedPodLike) -> bool:
-        return pod_priority(a.pod.labels) > pod_priority(b.pod.labels)
+        return pod_priority(a.pod) > pod_priority(b.pod)
