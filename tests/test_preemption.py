@@ -529,3 +529,23 @@ class TestNoEvictionCascade:
             f"expected exactly 2 evictions (1 per host), got "
             f"{stack.preemption.preempted_total} — eviction cascade"
         )
+
+
+class TestMalformedLabelVictimRanking:
+    def test_valid_priority_label_ranks_victim_despite_other_bad_labels(self):
+        """LabelParseError fallback: a parseable tpu/priority still ranks
+        the victim (best-effort), so a priority-100 foreign pod is not the
+        cheapest eviction just because its tpu/hbm label is malformed."""
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.plugins.yoda.preemption import TpuPreemption
+
+        p = TpuPreemption(lambda key: True)
+        pod = PodSpec(
+            "foreign",
+            labels={"tpu/priority": "100", "tpu/hbm": "8 Gi"},  # hbm malformed
+            scheduler_name="default-scheduler",
+            node_name="h1",
+            tpu_resource_limit=4,
+        )
+        v = p._victim_of(pod, "h1")
+        assert v is not None and v.priority == 100 and v.chips == 4

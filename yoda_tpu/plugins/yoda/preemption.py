@@ -118,8 +118,12 @@ class TpuPreemption(PostFilterPlugin):
             # Mirrors the accountant's malformed-label rules: a valid
             # google.com/tpu limit occupies real chips (and must be
             # evictable, or accounting counts chips preemption can never
-            # free); spec.priority still ranks the victim.
-            prio = getattr(pod, "spec_priority", 0)
+            # free). Rank best-effort: a parseable tpu/priority label still
+            # counts even when a DIFFERENT label is malformed (sort.py's
+            # lenient read, with the spec.priority fallback).
+            from yoda_tpu.plugins.yoda.sort import pod_priority
+
+            prio = pod_priority(pod)
             if pod.tpu_resource_limit > 0:
                 return Victim(pod, node, prio, pod.tpu_resource_limit)
             if pod.scheduler_name != self.scheduler_name:
