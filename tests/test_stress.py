@@ -5,8 +5,9 @@ codebase: run the real scheduling thread while (a) agents republish the
 whole fleet's metrics, (b) single-chip pods churn (create + delete, some of
 them bound), and (c) three topology gangs contend for two ICI slices —
 thousands of watch events interleaving with ``_on_permit_resolved``
-callbacks and ``expire_waiting``. Five seeded runs; each asserts the
-invariants that concurrency bugs break:
+callbacks and ``expire_waiting``. Five seeded runs plus one in the
+mesh-sharded kernel mode (``mesh_devices=8``); each asserts the invariants
+that concurrency bugs break:
 
 - the scheduler thread survives and exits (no deadlock, no uncaught
   exception — a double-bind raises inside FakeCluster.bind_pod),
@@ -46,10 +47,15 @@ def topo_gang(name: str, topology: str = "2x2") -> list[PodSpec]:
     return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(4)]
 
 
-@pytest.mark.parametrize("seed", range(5))
-def test_serve_forever_under_churn_and_gang_contention(seed):
+@pytest.mark.parametrize(
+    "seed,mesh",
+    [(s, None) for s in range(5)] + [(0, 8)],  # +1 run in mesh-sharded mode
+)
+def test_serve_forever_under_churn_and_gang_contention(seed, mesh):
     rng = random.Random(seed)
-    stack = build_stack(config=SchedulerConfig(gang_permit_timeout_s=1.0))
+    stack = build_stack(
+        config=SchedulerConfig(gang_permit_timeout_s=1.0, mesh_devices=mesh)
+    )
     agent = FakeTpuAgent(stack.cluster)
     agent.add_slice("slice-a", host_topology=(2, 2, 1))
     agent.add_slice("slice-b", host_topology=(2, 2, 1))
