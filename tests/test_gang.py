@@ -308,3 +308,148 @@ class TestGangConsistency:
         stack2.cluster.create_pod(pods[1])
         stack2.scheduler.run_until_idle(max_wall_s=5)
         assert stack2.cluster.get_pod(f"default/{pods[1].name}").node_name is not None
+
+
+class TestNodeFailureMidGang:
+    """SURVEY.md §5 fault-injection: a planned host dies while members wait
+    at the Permit barrier. The waitlist must expire, the cascade must roll
+    back EVERY reservation (including those on surviving hosts), and the
+    gang must re-plan onto an intact slice and complete."""
+
+    def test_host_death_during_permit_wait(self):
+        # Permit timeout far beyond the test budget: recovery must be
+        # EVENT-driven (the host-death handler), not the timeout backstop.
+        stack, agent = make_stack(gang_permit_timeout_s=300.0)
+        a_hosts = agent.add_slice("slice-a", host_topology=(2, 2, 1))
+        b_hosts = agent.add_slice("slice-b", host_topology=(2, 2, 1))
+        agent.publish_all()
+
+        # Pay the kernel compile before the permit-timeout-sensitive phase.
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+
+        pods = topo_pods("g", "2x2", chips=4)
+        for p in pods[:3]:
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=0.4)  # < permit timeout
+        status = stack.gang.gang_status("g")
+        assert status is not None and status[1] == 3, f"want 3 waiting: {status}"
+        reserved_hosts = [
+            h for h in a_hosts + b_hosts if stack.accountant.chips_in_use(h) > 0
+        ]
+        assert len(reserved_hosts) == 3
+        (planned_slice,) = {h.rsplit("-", 1)[0] for h in reserved_hosts}
+
+        # Fault injection: one reserved host dies (agent deletes its CR).
+        agent.remove_host(reserved_hosts[0])
+
+        # The 4th member arrives; the dead host blocks the old plan, the
+        # waitlist expires, the cascade rolls everything back, and the gang
+        # re-plans onto the intact slice.
+        stack.cluster.create_pod(pods[3])
+        stack.scheduler.run_until_idle(max_wall_s=15.0)
+
+        bound = [
+            stack.cluster.get_pod(p.key)
+            for p in pods
+        ]
+        hosts = {p.node_name for p in bound if p and p.node_name}
+        assert all(p is not None and p.node_name for p in bound), (
+            f"gang did not complete after host death: "
+            f"{[(p.name, p.node_name) for p in bound if p]}"
+        )
+        other_slice = {"slice-a": "slice-b", "slice-b": "slice-a"}[planned_slice]
+        assert len(hosts) == 4
+        assert {h.rsplit("-", 1)[0] for h in hosts} == {other_slice}
+        # No leaked reservations on the first slice's survivors.
+        for h in a_hosts + b_hosts:
+            if h.rsplit("-", 1)[0] == planned_slice and h in hosts:
+                continue
+            if h == reserved_hosts[0]:
+                continue
+            if h.rsplit("-", 1)[0] == planned_slice:
+                assert stack.accountant.chips_in_use(h) == 0, h
+
+    def test_free_planned_host_death_cancels_waiters(self):
+        """The dying host holds NO reservation (it is the plan's still-free
+        slot): the broken plan must cancel the waiting members so the gang
+        re-plans — not strand their reservations until the permit timeout."""
+        stack, agent = make_stack(gang_permit_timeout_s=300.0)
+        agent.add_slice("slice-a", host_topology=(2, 2, 1))
+        agent.add_slice("slice-b", host_topology=(2, 2, 1))
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+
+        pods = topo_pods("g", "2x2", chips=4)
+        for p in pods[:3]:
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=0.4)
+        assert stack.gang.gang_status("g")[1] == 3
+        free = stack.gang.planned_unassigned_hosts("g")
+        assert free is not None and len(free) == 1
+
+        agent.remove_host(free[0])  # the un-reserved planned slot dies
+
+        stack.cluster.create_pod(pods[3])
+        stack.scheduler.run_until_idle(max_wall_s=15.0)
+        bound = [stack.cluster.get_pod(p.key) for p in pods]
+        assert all(p is not None and p.node_name for p in bound), (
+            f"{[(p.name, p.node_name) for p in bound if p]}"
+        )
+        hosts = {p.node_name for p in bound}
+        assert len(hosts) == 4
+        assert len({h.rsplit("-", 1)[0] for h in hosts}) == 1  # one slice
+
+    def test_plain_gang_recovers_when_dead_host_returns(self):
+        """A host that dies mid-wait and then REJOINS must be usable again:
+        the dead-host blacklist clears on the host's re-publish (plain
+        gangs never hit the topology replan path's clear site)."""
+        stack, agent = make_stack(gang_permit_timeout_s=300.0)
+        agent.add_host("h1", generation="v5p", chips=4)
+        agent.add_host("h2", generation="v5p", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+
+        pods = gang_pods("pg", 2, chips=4)
+        stack.cluster.create_pod(pods[0])
+        stack.scheduler.run_until_idle(max_wall_s=0.4)
+        assert stack.gang.gang_status("pg")[1] == 1  # waiting
+        host = next(
+            h for h in ("h1", "h2") if stack.accountant.chips_in_use(h) > 0
+        )
+        agent.remove_host(host)           # dies mid-wait -> cascade
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+        agent.add_host(host, generation="v5p", chips=4)
+        agent.publish_all()               # host rejoins -> un-blacklisted
+
+        stack.cluster.create_pod(pods[1])
+        stack.scheduler.run_until_idle(max_wall_s=15.0)
+        bound = [stack.cluster.get_pod(p.key) for p in pods]
+        assert all(p is not None and p.node_name for p in bound), (
+            f"{[(p.name, p.node_name) for p in bound if p]}"
+        )
+
+    def test_dead_mark_cleared_only_by_same_kind(self):
+        """A Node-object deletion mark survives the agent's CR republish
+        (the node is still gone); only a Node re-add clears it."""
+        from yoda_tpu.api.requests import GangSpec
+        from yoda_tpu.api.types import K8sNode, make_node
+        from yoda_tpu.cluster.fake import Event
+        from yoda_tpu.plugins.yoda.gang import GangPlugin, _GangState
+
+        g = GangPlugin()
+        g._gangs["x"] = _GangState(spec=GangSpec(name="x", size=2, topology=None))
+        g.handle(Event("deleted", "Node", K8sNode("h1")))
+        assert "h1" in g._gangs["x"].dead_hosts
+        g.handle(Event("modified", "TpuNodeMetrics", make_node("h1")))
+        assert "h1" in g._gangs["x"].dead_hosts  # CR republish: still dead
+        g.handle(Event("added", "Node", K8sNode("h1")))
+        assert "h1" not in g._gangs["x"].dead_hosts

@@ -79,6 +79,13 @@ class _GangState:
     assigned: dict[str, str] = field(default_factory=dict)  # pod key -> host
     plan: dict[str, tuple[int, int, int]] | None = None  # host -> coord
     failing: bool = False
+    # Hosts that died (value: which kinds' deletion marked them — a Node
+    # deletion is only cleared by a Node re-add, not by the agent's CR
+    # republish, and vice versa). Marked on EVERY gang so a death landing
+    # between a member's Reserve and its waitlist registration is still
+    # caught by on_pod_waiting. Consulted by the replan check and
+    # on_pod_waiting; cleared per kind on host re-add, wholesale on replan.
+    dead_hosts: dict[str, set[str]] = field(default_factory=dict)
 
 
 class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
@@ -94,6 +101,12 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         self.reserved_fn = reserved_fn
         self._lock = threading.RLock()
         self._gangs: dict[str, _GangState] = {}
+        self._framework = None
+
+    def attach_framework(self, framework) -> None:
+        """Give the plugin a handle to the waitlist so host-death events can
+        reject waiting members (standalone.build_stack wires this)."""
+        self._framework = framework
 
     # --- helpers ---
 
@@ -145,35 +158,81 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             remaining = gs.spec.size - len(gs.bound) - len(gs.waiting)
 
             if gs.spec.topology is not None:
-                return self._pre_filter_topology(state, pod, snapshot, gs, req)
-
-            # Plain gang: capacity estimate over free slots. This member plus
-            # the other remaining members must all fit somewhere.
-            slots = sum(
-                self._member_slots(ni, req, exclude_hosts=set())
-                for ni in snapshot.infos()
-                if node_admits_pod(ni.node, pod.tolerations)[0]
-            )
-            if slots < remaining:
-                return Status.unschedulable(
-                    f"gang {req.gang.name}: {remaining} members still need "
-                    f"placement but only {slots} slots are free"
+                # deferred: a waiting member to reject AFTER the lock is
+                # released (reject() re-enters the resolution chain — the
+                # same collect-then-reject-outside-lock discipline as
+                # on_pod_resolved / _on_host_gone).
+                deferred: list[str] = []
+                st = self._pre_filter_topology(
+                    state, pod, snapshot, gs, req, deferred
                 )
-            return Status.ok()
+            else:
+                # Plain gang: capacity estimate over free slots. This member
+                # plus the other remaining members must all fit somewhere.
+                deferred = []
+                slots = sum(
+                    self._member_slots(ni, req, exclude_hosts=set())
+                    for ni in snapshot.infos()
+                    if node_admits_pod(ni.node, pod.tolerations)[0]
+                )
+                if slots < remaining:
+                    st = Status.unschedulable(
+                        f"gang {req.gang.name}: {remaining} members still "
+                        f"need placement but only {slots} slots are free"
+                    )
+                else:
+                    st = Status.ok()
+        for key in deferred:
+            w = (
+                self._framework.get_waiting_pod(key)
+                if self._framework is not None
+                else None
+            )
+            if w is not None:
+                w.reject("gang plan lost a host; rolling back to re-plan")
+        return st
 
-    def _pre_filter_topology(self, state, pod, snapshot, gs: _GangState, req) -> Status:
+    def _pre_filter_topology(
+        self, state, pod, snapshot, gs: _GangState, req, deferred: list[str]
+    ) -> Status:
         assigned_hosts = set(gs.assigned.values())
         plan_hosts_free = (
             set(gs.plan) - assigned_hosts if gs.plan is not None else set()
         )
-        # (Re)plan when there is no plan, or planned hosts became infeasible.
-        need_replan = gs.plan is None or not all(
+        # (Re)plan when there is no plan, or planned hosts became infeasible
+        # — a free planned host MISSING from the snapshot (CR deleted) or in
+        # dead_hosts counts as infeasible, not skipped: a stale plan keeping
+        # a dead host would strand the gang on its reservations until the
+        # permit timeout.
+        plan_broken = gs.plan is not None and any(
+            h not in snapshot or h in gs.dead_hosts for h in plan_hosts_free
+        )
+        need_replan = gs.plan is None or plan_broken or not all(
             self._host_fits_member(
                 snapshot.get(h), req, assigned_hosts, pod.tolerations
             )
             for h in plan_hosts_free
             if h in snapshot
         ) or not plan_hosts_free
+        # A plan that LOST a host can never complete — waiting members would
+        # hold their reservations until the permit timeout. Cancel via the
+        # caller's deferred list (rejected outside the gang lock): one
+        # member suffices, the standard cascade rolls back the rest. Only
+        # for gone hosts: transient infeasibility (another pod's
+        # reservations) keeps the normal wait-for-timeout behavior, else
+        # contending gangs would thrash each other's plans.
+        if plan_broken and gs.waiting:
+            log.warning(
+                "gang %s: plan lost host(s) %s; rolling back %d waiting "
+                "member(s) for re-plan",
+                gs.spec.name,
+                sorted(gs.dead_hosts) or "<gone from snapshot>",
+                len(gs.waiting),
+            )
+            deferred.append(next(iter(gs.waiting)))
+            return Status.unschedulable(
+                f"gang {gs.spec.name}: plan lost a host; retry after rollback"
+            )
         # Replanning is safe while no member is parked at Permit (waiting
         # members hold reservations on planned hosts). Members already BOUND
         # (e.g. replayed after a scheduler restart) pin the new plan: the
@@ -197,6 +256,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 ),
                 pinned=pinned,
             )
+            # The new plan is computed against the CURRENT snapshot; a host
+            # that died and came back is eligible again.
+            gs.dead_hosts.clear()
             if gs.plan is not None:
                 log.info(
                     "gang %s: planned %s block on hosts %s",
@@ -250,7 +312,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
 
     def on_pod_waiting(self, framework, wp) -> None:
         """Framework hook, fired after the WaitingPod registers: if this was
-        the last member, release the whole gang."""
+        the last member, release the whole gang. A member whose assigned
+        host died between Reserve and this registration (the event could
+        not reject it — it was not on the waitlist yet) is rejected now."""
         gang_name = None
         with self._lock:
             for name, gs in self._gangs.items():
@@ -260,8 +324,15 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             if gang_name is None:
                 return
             gs = self._gangs[gang_name]
+            dead = gs.assigned.get(wp.pod.key) in gs.dead_hosts
             complete = len(gs.waiting) + len(gs.bound) >= gs.spec.size
-            targets = list(gs.waiting) if complete else []
+            targets = list(gs.waiting) if complete and not dead else []
+        if dead:
+            wp.reject(
+                f"assigned host {gs.assigned.get(wp.pod.key)} disappeared "
+                "mid-gang"
+            )
+            return
         if targets:
             log.info(
                 "gang %s complete: releasing %d waiting member(s)",
@@ -316,6 +387,31 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
     # --- watch: membership lifecycle across restarts and deletions ---
 
     def handle(self, event: Event) -> None:
+        if event.kind in ("TpuNodeMetrics", "Node"):
+            if event.type == "deleted":
+                # Fault injection / node death while members wait at Permit
+                # (SURVEY.md §5 failure-detection row): admission re-checks
+                # only the plan's FREE hosts, so a dead host holding a
+                # waiting member's reservation would otherwise go unnoticed
+                # until the gang completes and binds onto it. Reject the
+                # affected members; the standard cascade rolls back the
+                # rest and drops the plan.
+                self._on_host_gone(event.obj.name, event.kind)
+            else:
+                # Host (re)appeared: clear THIS kind's death mark. Only the
+                # same kind clears it — the agent's CR republish must not
+                # erase a Node-object deletion (and vice versa). Without
+                # any clearing, a plain gang (which never replans, the
+                # topology path's clear site) would reject members placed
+                # on a rebooted host forever.
+                with self._lock:
+                    for gs in self._gangs.values():
+                        kinds = gs.dead_hosts.get(event.obj.name)
+                        if kinds:
+                            kinds.discard(event.kind)
+                            if not kinds:
+                                del gs.dead_hosts[event.obj.name]
+            return
         if event.kind != "Pod":
             return
         pod: PodSpec = event.obj  # type: ignore[assignment]
@@ -348,6 +444,30 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     self._gangs[gang_name] = gs
                 gs.bound.add(pod.key)
                 gs.assigned.setdefault(pod.key, pod.node_name)
+
+    def _on_host_gone(self, host: str, kind: str) -> None:
+        with self._lock:
+            targets = []
+            for gs in self._gangs.values():
+                # Mark on every gang: a member racing between Reserve and
+                # waitlist registration has the host in neither plan nor
+                # assigned yet, and on_pod_waiting must still catch it.
+                gs.dead_hosts.setdefault(host, set()).add(kind)
+                targets.extend(
+                    key for key in gs.waiting if gs.assigned.get(key) == host
+                )
+        fw = self._framework
+        if fw is None:
+            return
+        for key in targets:
+            w = fw.get_waiting_pod(key)
+            if w is not None:
+                log.warning(
+                    "gang member %s: assigned host %s disappeared while "
+                    "waiting at permit; rejecting (cascade will re-plan)",
+                    key, host,
+                )
+                w.reject(f"assigned host {host} disappeared mid-gang")
 
     # --- introspection (tests, metrics) ---
 

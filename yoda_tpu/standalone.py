@@ -99,6 +99,7 @@ def build_stack(
         plugins.extend(extra_plugins)
     plugins.append(ClusterBinder(cluster))
     framework = Framework(plugins)
+    gang.attach_framework(framework)
     queue = SchedulingQueue(framework.queue_sort, clock=clock)
 
     def on_change(event: Event) -> None:
