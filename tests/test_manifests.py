@@ -67,6 +67,12 @@ class TestSchedulerManifest:
         assert "create" in rules[("", "pods/eviction")]
         assert {"list", "watch"} <= rules[("", "nodes")]
         assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
+        # write_event POSTs then PUTs (count aggregation) — cluster/events.py.
+        assert {"create", "update"} <= rules[("", "events")]
+        # Leader election: LeaderElector issues lease get/create/update.
+        assert {"get", "create", "update"} <= rules[
+            ("coordination.k8s.io", "leases")
+        ]
         # Preemption goes through pods/eviction, never bare pod DELETE.
         assert "delete" not in rules[("", "pods")]
         # Least privilege: the scheduler never writes CRs (unlike the
