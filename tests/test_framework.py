@@ -374,3 +374,27 @@ class TestPercentageNodesToScore:
             SchedulerConfig.from_dict({"percentage_nodes_to_score": 50.5})
         with pytest.raises(ValueError, match="percentage_nodes_to_score"):
             SchedulerConfig.from_dict({"percentage_nodes_to_score": True})
+
+
+class TestDeletedQueuedPod:
+    def test_deleted_pending_pod_is_dropped_not_retried(self):
+        """A pod deleted while parked unschedulable must be dropped at its
+        next cycle, not requeued forever through the bind/retry loop."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("tiny", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("wanter", labels={"tpu/chips": "8"})  # cannot fit
+        )
+        stack.scheduler.run_until_idle()
+        assert len(stack.queue) == 1  # parked in backoff
+        # The delete event itself reactivates the parked pod (build_stack's
+        # on_change calls move_all_to_active for deletions).
+        stack.cluster.delete_pod("default/wanter")
+        stack.scheduler.run_until_idle()
+        assert len(stack.queue) == 0
+        assert stack.scheduler.stats.results[-1].outcome == "gone"

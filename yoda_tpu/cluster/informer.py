@@ -47,6 +47,11 @@ class InformerCache:
         self._node_informed = False
         self._pods_by_node: dict[str, dict[str, PodSpec]] = {}
         self._claimed_mib: dict[str, int] = {}
+        # Every pod uid currently alive in the cluster (any event kind).
+        # The scheduler consults this at cycle start so a pod deleted while
+        # queued is dropped instead of retried forever (upstream removes
+        # deleted pods from its scheduling queues).
+        self._live_uids: set[str] = set()
         # pod uid -> (node counted on, claim MiB added) — the stored claim is
         # subtracted on uncount so later label mutations cannot skew totals.
         self._pod_nodes: dict[str, tuple[str, int]] = {}
@@ -98,6 +103,10 @@ class InformerCache:
         pod: PodSpec = event.obj  # type: ignore[assignment]
         pending = False
         with self._lock:
+            if event.type == "deleted":
+                self._live_uids.discard(pod.uid)
+            else:
+                self._live_uids.add(pod.uid)
             counted = self._pod_nodes.get(pod.uid)
             if counted and (event.type == "deleted" or counted[0] != pod.node_name):
                 self._uncount_pod(pod.uid)
@@ -141,6 +150,12 @@ class InformerCache:
     def claimed_hbm_mib(self, node_name: str) -> int:
         with self._lock:
             return self._claimed_mib.get(node_name, 0)
+
+    def pod_alive(self, pod: PodSpec) -> bool:
+        """False once the watch saw the pod's deletion (by uid — a deleted
+        and re-created pod has a fresh uid and is unaffected)."""
+        with self._lock:
+            return pod.uid in self._live_uids
 
     def snapshot(self) -> Snapshot:
         """Consistent view for one scheduling cycle. Cached until the next

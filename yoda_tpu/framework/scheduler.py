@@ -34,7 +34,7 @@ from yoda_tpu.observability import PhaseTimer, SchedulingMetrics, TraceEntry
 @dataclass
 class ScheduleResult:
     pod_key: str
-    outcome: str  # "bound" | "waiting" | "unschedulable" | "error" | "nominated"
+    outcome: str  # "bound" | "waiting" | "unschedulable" | "error" | "nominated" | "gone"
     node: str | None = None
     message: str = ""
     latency_s: float = 0.0
@@ -68,6 +68,7 @@ class Scheduler:
         on_unschedulable: Callable[[PodSpec, str], None] | None = None,
         metrics: SchedulingMetrics | None = None,
         percentage_nodes_to_score: int = 100,
+        pod_alive: Callable[[PodSpec], bool] | None = None,
     ) -> None:
         self.framework = framework
         self.snapshot_fn = snapshot_fn
@@ -78,6 +79,7 @@ class Scheduler:
         self.on_unschedulable = on_unschedulable
         self.metrics = metrics
         self.percentage_nodes_to_score = percentage_nodes_to_score
+        self.pod_alive = pod_alive
         self._score_rotor = 0
         self._lock = threading.Lock()
 
@@ -105,6 +107,18 @@ class Scheduler:
     def schedule_one(self, qpi: QueuedPodInfo) -> ScheduleResult:
         pod = qpi.pod
         t0 = self.clock()
+        # A pod deleted while queued must be dropped, not retried forever
+        # through the bind-error path (upstream removes deleted pods from
+        # its queues; here the check is at cycle start, which also covers
+        # deletion races around requeues).
+        if self.pod_alive is not None and not self.pod_alive(pod):
+            log.debug("pod %s deleted while queued; dropping", pod.key)
+            r = ScheduleResult(pod.key, "gone", latency_s=self.clock() - t0)
+            with self._lock:
+                self.stats.results.append(r)
+            if self.metrics is not None:
+                self.metrics.attempts.inc(result="gone")
+            return r
         state = CycleState()
         snapshot = self.snapshot_fn()
         timer = PhaseTimer(self.clock)

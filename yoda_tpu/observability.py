@@ -249,7 +249,8 @@ class SchedulingMetrics:
         r = self.registry
         self.attempts = r.counter(
             "yoda_scheduling_attempts_total",
-            "Scheduling attempts by result (bound/waiting/unschedulable/nominated/error)",
+            "Scheduling attempts by result "
+            "(bound/waiting/unschedulable/nominated/error/gone)",
         )
         self.binds = r.counter("yoda_binds_total", "Pods successfully bound")
         self.preemptions = r.counter(

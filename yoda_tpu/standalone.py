@@ -132,6 +132,7 @@ def build_stack(
         percentage_nodes_to_score=config.percentage_nodes_to_score,
         on_bound=recorder.scheduled if recorder else None,
         on_unschedulable=recorder.failed_scheduling if recorder else None,
+        pod_alive=informer.pod_alive,
     )
     return Stack(
         cluster,
