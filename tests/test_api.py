@@ -285,6 +285,18 @@ class TestTpuResourceLimit:
         labeled = PodSpec("q", labels={"tpu/priority": "5"}, spec_priority=1000)
         assert pod_request(labeled).priority == 5
 
+    def test_queue_priority_malformed_label_falls_back_to_spec(self):
+        """ADVICE r2: a typo'd tpu/priority label must fall back to
+        spec.priority like the absent-label path — not rank the pod at 0
+        below its PriorityClass."""
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.plugins.yoda.sort import pod_priority
+
+        typo = PodSpec("p", labels={"tpu/priority": "1O0"}, spec_priority=1000)
+        assert pod_priority(typo) == 1000
+        assert pod_priority(PodSpec("q", spec_priority=7)) == 7
+        assert pod_priority(PodSpec("r", labels={"tpu/priority": "5"})) == 5
+
     def test_spec_priority_drives_preemption(self):
         """A PriorityClass pod (spec.priority, no labels) preempts a
         lower-priority label pod — both priority systems interoperate."""

@@ -453,3 +453,51 @@ class TestNodeFailureMidGang:
         assert "h1" in g._gangs["x"].dead_hosts  # CR republish: still dead
         g.handle(Event("added", "Node", K8sNode("h1")))
         assert "h1" not in g._gangs["x"].dead_hosts
+
+    def test_bound_member_host_death_unwedges_replan(self):
+        """ADVICE r2: a host holding a BOUND member (restart-reconstructed
+        gang) dies. The lost membership must be dropped at the host-death
+        event so the surviving members re-plan a fresh block immediately —
+        not wedge every cycle pinning a dead host until pod GC."""
+        stack, agent = make_stack(gang_permit_timeout_s=300.0)
+        a_hosts = agent.add_slice("slice-a", host_topology=(2, 2, 1))
+        agent.add_slice("slice-b", host_topology=(2, 2, 1))
+        agent.publish_all()
+        pods = topo_pods("resume", "2x2x1", chips=4)
+        pods[0].node_name = a_hosts[1]
+        pods[0].phase = "Running"
+        stack.cluster.create_pod(pods[0])
+        agent.publish_all()  # metrics show the bound member's chips consumed
+
+        from yoda_tpu.standalone import build_stack as rebuild
+
+        stack2 = rebuild(cluster=stack.cluster)
+        assert stack2.gang.gang_status("resume") == (4, 0, 1)
+        # Pay the kernel compile before the timing-sensitive phase.
+        stack2.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack2.scheduler.run_until_idle(max_wall_s=60.0)
+        stack2.cluster.delete_pod("default/warm")
+        stack2.scheduler.run_until_idle(max_wall_s=5.0)
+
+        agent.remove_host(a_hosts[1])  # the bound member's host dies
+        for p in pods[1:]:
+            stack2.cluster.create_pod(p)
+        stack2.scheduler.run_until_idle(max_wall_s=3.0)
+        # The lost member was dropped and the survivors planned a fresh
+        # block: they park at the permit barrier. (Pre-fix: bound stayed 1
+        # and every replan wedged on the dead pinned host.)
+        assert stack2.gang.gang_status("resume") == (4, 3, 0)
+
+        # Node-lifecycle GC deletes the lost pod; its controller recreates.
+        stack2.cluster.delete_pod(pods[0].key)
+        stack2.cluster.create_pod(
+            PodSpec("resume-0r", labels=dict(pods[1].labels))
+        )
+        stack2.scheduler.run_until_idle(max_wall_s=15.0)
+        placements = {
+            p.name: p.node_name for p in stack2.cluster.list_pods()
+        }
+        assert all(placements.values()), placements
+        hosts = set(placements.values())
+        assert len(hosts) == 4
+        assert {h.rsplit("-", 1)[0] for h in hosts} == {"slice-b"}

@@ -89,7 +89,11 @@ class Scheduler:
         cycles so the cap spreads load instead of always favoring the same
         name-ordered prefix. Only the per-node ("loop") path calls this: the
         fused kernel scores the whole fleet in one dispatch, so capping
-        there would cost placement quality and save nothing."""
+        there would cost placement quality and save nothing. Deliberate
+        divergence from upstream (docs/OPERATIONS.md): upstream truncates
+        the feasible-node SEARCH (capping Filter work too); here Filter
+        always runs fleet-wide so PostFilter/preemption sees every node's
+        status, and only score fan-out is capped."""
         pct = self.percentage_nodes_to_score
         if pct >= 100 or len(feasible) <= MIN_FEASIBLE_TO_SCORE:
             return feasible

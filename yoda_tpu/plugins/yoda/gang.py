@@ -239,8 +239,24 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # block must complete around their hosts.
         if need_replan and len(gs.waiting) == 0:
             pinned: dict[str, tuple[int, int, int]] = {}
-            for key in gs.bound:
+            for key in list(gs.bound):
                 host = gs.assigned.get(key)
+                if host in gs.dead_hosts:
+                    # The bound member's host died (ADVICE r2): the member
+                    # is lost — node GC owns its pod, and pinning a host
+                    # that cannot return would wedge the replan every
+                    # cycle. Drop the membership; the replacement pod the
+                    # controller creates after GC re-joins normally. (A
+                    # watch re-add racing this drop lands back here at the
+                    # next replan — the dead mark outlives it.)
+                    log.warning(
+                        "gang %s: dropping bound member %s — its host %s "
+                        "is dead; planning around it",
+                        gs.spec.name, key, host,
+                    )
+                    gs.bound.discard(key)
+                    gs.assigned.pop(key, None)
+                    continue
                 ni = snapshot.get(host) if host and host in snapshot else None
                 if ni is None or ni.tpu is None:
                     return Status.unschedulable(
@@ -454,20 +470,37 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 # assigned yet, and on_pod_waiting must still catch it.
                 gs.dead_hosts.setdefault(host, set()).add(kind)
                 targets.extend(
-                    key for key in gs.waiting if gs.assigned.get(key) == host
+                    (key, f"assigned host {host} disappeared mid-gang")
+                    for key in gs.waiting
+                    if gs.assigned.get(key) == host
                 )
+                # A BOUND member on the dead host is lost (ADVICE r2): the
+                # gang cannot complete until node GC + the pod's controller
+                # replace it. Cascade one waiting member so all held
+                # reservations release now, not at the permit timeout; the
+                # membership itself is dropped lazily at replan time (see
+                # _pre_filter_topology), so a transient CR blip that heals
+                # before any replan never forgets a running member.
+                lost = [k for k in gs.bound if gs.assigned.get(k) == host]
+                if lost and gs.waiting:
+                    targets.append((
+                        next(iter(gs.waiting)),
+                        f"gang lost bound member {lost[0]} with host {host}; "
+                        "releasing reservations to re-plan",
+                    ))
+            targets = list(dict.fromkeys(targets))
         fw = self._framework
         if fw is None:
             return
-        for key in targets:
+        for key, reason in targets:
             w = fw.get_waiting_pod(key)
             if w is not None:
                 log.warning(
-                    "gang member %s: assigned host %s disappeared while "
-                    "waiting at permit; rejecting (cascade will re-plan)",
-                    key, host,
+                    "gang member %s waiting at permit: %s; rejecting "
+                    "(cascade will re-plan)",
+                    key, reason,
                 )
-                w.reject(f"assigned host {host} disappeared mid-gang")
+                w.reject(reason)
 
     # --- introspection (tests, metrics) ---
 

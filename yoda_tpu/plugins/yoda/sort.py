@@ -24,7 +24,11 @@ def pod_priority(pod) -> int:
     try:
         return int(raw.strip())
     except ValueError:
-        return 0  # defensive only; strict parse rejects these at admission
+        # Defensive only (strict parse rejects these at admission), but fall
+        # back the same way as the absent-label path: a GKE pod with a
+        # PriorityClass plus a typo'd label must not sort/victim-rank at 0
+        # below its spec priority (ADVICE r2).
+        return getattr(pod, "spec_priority", 0)
 
 
 class YodaSort(QueueSortPlugin):
