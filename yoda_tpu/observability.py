@@ -256,6 +256,11 @@ class SchedulingMetrics:
         self.preemptions = r.counter(
             "yoda_preemptions_total", "Pods evicted by the preemption plugin"
         )
+        self.events_dropped = r.counter(
+            "yoda_events_dropped_total",
+            "Events shed from the recorder backlog under pressure "
+            "(oldest first)",
+        )
         self.latency = r.histogram(
             "yoda_scheduling_latency_seconds",
             "Scheduling cycle latency by phase (phase=total for the full cycle)",

@@ -83,8 +83,10 @@ class _GangState:
     # deletion is only cleared by a Node re-add, not by the agent's CR
     # republish, and vice versa). Marked on EVERY gang so a death landing
     # between a member's Reserve and its waitlist registration is still
-    # caught by on_pod_waiting. Consulted by the replan check and
-    # on_pod_waiting; cleared per kind on host re-add, wholesale on replan.
+    # caught by on_pod_waiting. Consulted by the replan check, handle()'s
+    # bound-member reconstruction, and on_pod_waiting; cleared ONLY per
+    # kind on host re-add — a mark must outlive replans so zombie-pod
+    # watch events cannot resurrect a lost membership.
     dead_hosts: dict[str, set[str]] = field(default_factory=dict)
 
 
@@ -96,9 +98,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         *,
         timeout_s: float = 120.0,
         reserved_fn: Callable[[str], int] | None = None,
+        on_rollback: Callable[[PodSpec, str, str], None] | None = None,
     ) -> None:
         self.timeout_s = timeout_s
         self.reserved_fn = reserved_fn
+        # (member pod, gang name, why) — standalone wires the Event
+        # recorder's GangRollback reason here (VERDICT r2 #6).
+        self.on_rollback = on_rollback
         self._lock = threading.RLock()
         self._gangs: dict[str, _GangState] = {}
         self._framework = None
@@ -246,9 +252,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     # is lost — node GC owns its pod, and pinning a host
                     # that cannot return would wedge the replan every
                     # cycle. Drop the membership; the replacement pod the
-                    # controller creates after GC re-joins normally. (A
-                    # watch re-add racing this drop lands back here at the
-                    # next replan — the dead mark outlives it.)
+                    # controller creates after GC re-joins normally (watch
+                    # events for the zombie pod are ignored by handle()
+                    # while the dead mark stands).
                     log.warning(
                         "gang %s: dropping bound member %s — its host %s "
                         "is dead; planning around it",
@@ -272,9 +278,12 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 ),
                 pinned=pinned,
             )
-            # The new plan is computed against the CURRENT snapshot; a host
-            # that died and came back is eligible again.
-            gs.dead_hosts.clear()
+            # Dead marks are NOT cleared here: a host that died and came
+            # back was already un-marked by handle()'s per-kind re-add
+            # clearing, and a mark for a still-gone host must outlive the
+            # replan — it is what keeps a watch event for the lost
+            # member's zombie pod from resurrecting its membership
+            # (handle() skips dead-marked hosts).
             if gs.plan is not None:
                 log.info(
                     "gang %s: planned %s block on hosts %s",
@@ -385,16 +394,25 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 return
             gs.failing = True
             targets = list(gs.waiting)
+            had_bound = bool(gs.bound)
+        why = f"member {wp.pod.key} was rejected: {status.message}"
         if targets:
             log.warning(
                 "gang %s: member %s rejected (%s); rolling back %d waiting "
                 "member(s)",
                 gs.spec.name, wp.pod.key, status.message, len(targets),
             )
+        if self.on_rollback is not None and (targets or had_bound):
+            # The gang-level reason, on the TRIGGERING member too — its own
+            # FailedScheduling row only says what happened to it, not that
+            # it took the gang down.
+            self.on_rollback(wp.pod, gs.spec.name, why)
         for key in targets:
             w = framework.get_waiting_pod(key)
             if w is not None:
-                w.reject(f"gang member {wp.pod.key} was rejected: {status.message}")
+                if self.on_rollback is not None:
+                    self.on_rollback(w.pod, gs.spec.name, why)
+                w.reject(f"gang {why}")
         with self._lock:
             if not gs.waiting:
                 gs.failing = False
@@ -446,7 +464,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 return
             if pod.node_name:
                 # Bound member (bind we initiated, or watch replay after a
-                # scheduler restart): reconstruct membership.
+                # scheduler restart): reconstruct membership — unless its
+                # host is dead-marked: then this is a zombie pod awaiting
+                # node GC (a status update from the node controller, say),
+                # and re-adding it would let the Permit barrier count a
+                # dead member toward gang completion.
+                if gs is not None and pod.node_name in gs.dead_hosts:
+                    return
                 if gs is None:
                     from yoda_tpu.api.requests import LabelParseError, pod_request
 

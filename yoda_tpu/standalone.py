@@ -57,7 +57,7 @@ def build_stack(
     # Scheduling Events (kubectl describe pod): the reference got these from
     # the upstream scheduler's recorder; here the loop emits its own.
     recorder = (
-        EventRecorder(cluster.write_event)
+        EventRecorder(cluster.write_event, on_drop=metrics.events_dropped.inc)
         if hasattr(cluster, "write_event")
         else None
     )
@@ -74,6 +74,7 @@ def build_stack(
     gang = GangPlugin(
         timeout_s=config.gang_permit_timeout_s,
         reserved_fn=accountant.chips_in_use,
+        on_rollback=recorder.gang_rollback if recorder else None,
     )
     plugins.append(gang)
     plugins.append(accountant)
@@ -121,6 +122,9 @@ def build_stack(
     cluster.add_watcher(accountant.handle)
     cluster.add_watcher(gang.handle)
     cluster.add_watcher(informer.handle)
+    if recorder is not None:
+        # Prune aggregation state for deleted pods (ADVICE r2).
+        cluster.add_watcher(recorder.handle)
 
     metrics.attach_fleet(informer.snapshot, accountant.chips_in_use)
     scheduler = Scheduler(
