@@ -310,6 +310,112 @@ class TestGangConsistency:
         assert stack2.cluster.get_pod(f"default/{pods[1].name}").node_name is not None
 
 
+class TestGangBatchedDispatch:
+    """VERDICT r2 #5: ONE YodaBatch kernel dispatch places the whole gang —
+    siblings are served host-side from the dispatch's claimable-chips plan,
+    shrinking the inter-member atomicity window to a single evaluation."""
+
+    @staticmethod
+    def _batch(stack):
+        from yoda_tpu.plugins.yoda import YodaBatch
+
+        return next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+
+    @staticmethod
+    def _warm(stack):
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+
+    def test_one_dispatch_per_topology_gang(self):
+        stack, agent = make_stack()
+        agent.add_slice("s", host_topology=(2, 2, 1))
+        agent.add_slice("t", host_topology=(2, 2, 1))
+        agent.publish_all()
+        self._warm(stack)
+        batch = self._batch(stack)
+        d0 = batch.dispatch_count
+        pods = topo_pods("tg", "2x2x1", chips=4)
+        for p in pods:
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=15.0)
+        placed = {p.name: p.node_name for p in stack.cluster.list_pods()}
+        assert all(placed.values()), placed
+        hosts = set(placed.values())
+        assert len(hosts) == 4
+        assert len({h.rsplit("-", 1)[0] for h in hosts}) == 1
+        assert batch.dispatch_count == d0 + 1
+
+    def test_one_dispatch_per_plain_gang_sharing_hosts(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5p", chips=4)
+        agent.add_host("h1", generation="v5p", chips=4)
+        agent.publish_all()
+        self._warm(stack)
+        batch = self._batch(stack)
+        d0 = batch.dispatch_count
+        for p in gang_pods("pg", 4, chips=2):  # 2 members per 4-chip host
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=15.0)
+        placed = {p.name: p.node_name for p in stack.cluster.list_pods()}
+        assert all(placed.values()), placed
+        # The host-side claimable decrement must not oversubscribe a host.
+        from collections import Counter
+
+        per_host = Counter(placed.values())
+        assert all(c <= 2 for c in per_host.values()), per_host
+        assert batch.dispatch_count == d0 + 1
+
+    def test_foreign_interference_invalidates_plan(self):
+        """A foreign pod reserving onto a planned node between member
+        cycles must invalidate the plan (reserved_fn validation) — the
+        siblings fall back to fresh dispatches and the gang still binds
+        correctly with no oversubscription."""
+        stack, agent = make_stack(gang_permit_timeout_s=300.0)
+        hosts = [f"h{i}" for i in range(4)]
+        for h in hosts:
+            agent.add_host(h, generation="v5p", chips=4)
+        agent.publish_all()
+        self._warm(stack)
+        batch = self._batch(stack)
+        # Member 0 schedules alone: plan for all 3 members is built.
+        pods = gang_pods("fg", 3, chips=4)
+        stack.cluster.create_pod(pods[0])
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+        assert stack.gang.gang_status("fg")[1] == 1
+        assert "fg" in batch._gang_plans
+        # A foreign pod lands on the node planned for member 1 (same
+        # argmax tie-break over the same free set).
+        planned = batch._gang_plans["fg"].picks[1]
+        stack.cluster.create_pod(
+            PodSpec("foreign", labels={"tpu/chips": "4", "tpu/priority": "9"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+        foreign = stack.cluster.get_pod("default/foreign")
+        assert foreign is not None and foreign.node_name == planned
+        # Remaining members arrive: the plan must NOT serve the taken node.
+        for p in pods[1:]:
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=15.0)
+        placed = {
+            p.name: p.node_name
+            for p in stack.cluster.list_pods()
+            if p.name.startswith("fg")
+        }
+        assert all(placed.values()), placed
+        from collections import Counter
+
+        per_host = Counter(placed.values())
+        assert all(c <= 1 for c in per_host.values()), per_host
+        assert planned not in placed.values()  # the taken node was not served
+        # No host holds more than its 4 chips.
+        for h in hosts:
+            assert stack.accountant.chips_in_use(h) <= 4, h
+
+
 class TestNodeFailureMidGang:
     """SURVEY.md §5 fault-injection: a planned host dies while members wait
     at the Permit barrier. The waitlist must expire, the cascade must roll

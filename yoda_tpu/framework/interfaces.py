@@ -49,7 +49,9 @@ class Status:
 
     @classmethod
     def ok(cls) -> "Status":
-        return cls(Code.SUCCESS)
+        # Shared frozen instance: ok() is the hottest Status constructor
+        # (every node of every cycle) and carries no per-call data.
+        return _STATUS_OK
 
     @classmethod
     def unschedulable(cls, message: str) -> "Status":
@@ -70,6 +72,9 @@ class Status:
     @classmethod
     def skip(cls) -> "Status":
         return cls(Code.SKIP)
+
+
+_STATUS_OK = Status(Code.SUCCESS)
 
 
 @dataclass

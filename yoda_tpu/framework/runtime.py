@@ -138,15 +138,29 @@ class Framework:
         over the batch-feasible subset."""
         if not self.batch_plugins:
             return None
-        statuses: dict[str, Status] = {n: Status.ok() for n in snapshot.names()}
-        totals: dict[str, int] = {n: 0 for n in snapshot.names()}
-        for p in self.batch_plugins:
-            p_statuses, p_scores = p.filter_and_score_batch(state, pod, snapshot)
-            for n, st in p_statuses.items():
-                if not st.success and statuses[n].success:
-                    statuses[n] = st
-            for n, s in p_scores.items():
-                totals[n] += s
+        if len(self.batch_plugins) == 1:
+            # Hot path: the plugin's dicts are used directly (the batch
+            # contract hands ownership to the caller — plugins must return
+            # fresh dicts), skipping the init + merge passes below.
+            statuses, totals = self.batch_plugins[0].filter_and_score_batch(
+                state, pod, snapshot
+            )
+            for n in snapshot.names():
+                if n not in statuses:
+                    statuses[n] = Status.ok()
+                    totals.setdefault(n, 0)
+        else:
+            statuses = {n: Status.ok() for n in snapshot.names()}
+            totals = {n: 0 for n in snapshot.names()}
+            for p in self.batch_plugins:
+                p_statuses, p_scores = p.filter_and_score_batch(
+                    state, pod, snapshot
+                )
+                for n, st in p_statuses.items():
+                    if not st.success and statuses[n].success:
+                        statuses[n] = st
+                for n, s in p_scores.items():
+                    totals[n] += s
         for n, st in statuses.items():
             if not st.success:
                 continue
@@ -155,7 +169,9 @@ class Framework:
                 if not st2.success:
                     statuses[n] = st2
                     break
-        feasible_scores = {n: totals[n] for n, st in statuses.items() if st.success}
+        feasible_scores = {
+            n: totals.get(n, 0) for n, st in statuses.items() if st.success
+        }
         return statuses, feasible_scores
 
     def run_post_filter(

@@ -95,7 +95,7 @@ def arrays_dict(arrays: "FleetArrays") -> dict:
 
 def result_from_outputs(arrays: "FleetArrays", outputs) -> "KernelResult":
     """Trim padded kernel outputs back to the real node count."""
-    feasible, reasons, raw, final, best = outputs
+    feasible, reasons, raw, final, best, claimable = outputs
     n = arrays.n_nodes
     best_i = int(best)
     return KernelResult(
@@ -104,6 +104,7 @@ def result_from_outputs(arrays: "FleetArrays", outputs) -> "KernelResult":
         raw_scores=np.asarray(raw)[:n],
         scores=np.asarray(final)[:n],
         best_index=best_i if best_i < n else -1,
+        claimable=np.asarray(claimable)[:n],
     )
 
 
@@ -141,6 +142,10 @@ class KernelResult:
     raw_scores: np.ndarray    # [N] int32 metric score, pre-normalization
     scores: np.ndarray        # [N] int32: minmax-normalized [0,100] + slice tier
     best_index: int           # -1 when nothing feasible
+    # [N] int32 chips claimable right now (after the reservation/stale-freed
+    # corrections) — what the gang batcher decrements host-side when placing
+    # N members from ONE dispatch (plugins/yoda/batch.py).
+    claimable: np.ndarray | None = None
 
 
 def _norm(metric: jnp.ndarray, maximum: jnp.ndarray) -> jnp.ndarray:
@@ -294,7 +299,9 @@ def kernel_impl(
     best = (n - 1 - jnp.argmax(masked[::-1])).astype(jnp.int32)
     best = jnp.where(jnp.any(feasible), best, -1)
 
-    return feasible, reasons, raw, final, best
+    claimable = jnp.clip(count_avail + freed - invisible, 0).astype(jnp.int32)
+
+    return feasible, reasons, raw, final, best, claimable
 
 
 # Single-device jit; yoda_tpu.parallel re-jits kernel_impl with node-axis
@@ -305,22 +312,29 @@ _kernel = functools.partial(jax.jit, static_argnames=("weights",))(kernel_impl)
 def kernel_packed(static: dict, dyn, reqv, weights: Weights):
     """kernel_impl with transfer-minimal I/O: per-cycle node vectors arrive
     as ONE [4, N] int32 array (DYN_KEYS rows), request scalars as ONE [5]
-    int32 vector, and all outputs leave as ONE [5, N] int32 array (rows:
-    feasible, reasons, raw, final, best broadcast). Under a remote-device
-    transport every host<->device transfer is a round trip, so the packing
-    — not the FLOPs — is what makes the device path fast (the reference's
-    analogous hot-loop cost was per-node API round trips,
+    int32 vector, and all outputs leave as ONE [6, N] int32 array (rows:
+    feasible, reasons, raw, final, best broadcast, claimable). Under a
+    remote-device transport every host<->device transfer is a round trip, so
+    the packing — not the FLOPs — is what makes the device path fast (the
+    reference's analogous hot-loop cost was per-node API round trips,
     pkg/yoda/scheduler.go:70,108)."""
     a = dict(static)
     a["fresh"] = dyn[0].astype(bool)
     a["reserved_chips"] = dyn[1]
     a["claimed_hbm_mib"] = dyn[2]
     a["host_ok"] = dyn[3].astype(bool)
-    feasible, reasons, raw, final, best = kernel_impl(
+    feasible, reasons, raw, final, best, claimable = kernel_impl(
         a, reqv[0], reqv[1], reqv[2], reqv[3], reqv[4], weights=weights
     )
     return jnp.stack(
-        [feasible.astype(jnp.int32), reasons, raw, final, jnp.full_like(final, best)]
+        [
+            feasible.astype(jnp.int32),
+            reasons,
+            raw,
+            final,
+            jnp.full_like(final, best),
+            claimable,
+        ]
     )
 
 
@@ -346,7 +360,7 @@ def pack_request(request: "KernelRequest") -> np.ndarray:
 
 
 def result_from_packed(names: list[str], packed: np.ndarray) -> KernelResult:
-    """Unpack the [5, N] kernel_packed output, trimmed to the real fleet."""
+    """Unpack the [6, N] kernel_packed output, trimmed to the real fleet."""
     n = len(names)
     best = int(packed[4, 0]) if packed.shape[1] else -1
     return KernelResult(
@@ -355,6 +369,7 @@ def result_from_packed(names: list[str], packed: np.ndarray) -> KernelResult:
         raw_scores=packed[2, :n],
         scores=packed[3, :n],
         best_index=best if 0 <= best < n else -1,
+        claimable=packed[5, :n],
     )
 
 
@@ -388,6 +403,11 @@ class DeviceFleetKernel:
     def __init__(self, weights: Weights, device=None) -> None:
         self.weights = weights
         self.device = device
+        # Explicit device_put is only needed to steer placement AWAY from
+        # the default device (e.g. pinning to host CPU while the process
+        # default is the TPU); when the target IS the default, jit's own
+        # dispatch transfers the numpy args without an extra round trip.
+        self._needs_put = device is not None and device != jax.devices()[0]
         self._jitted = _kernel_packed
         self._static: dict | None = None
         self._names: list[str] = []
@@ -413,7 +433,7 @@ class DeviceFleetKernel:
         if self._static is None:
             raise RuntimeError("put_static() must run before evaluate()")
         reqv = pack_request(request)
-        if self.device is not None:
+        if self._needs_put:
             dyn = jax.device_put(dyn, self.device)
             reqv = jax.device_put(reqv, self.device)
         packed = self._jitted(self._static, dyn, reqv, weights=self.weights)

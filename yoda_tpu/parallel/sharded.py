@@ -86,7 +86,7 @@ class ShardedFleetKernel:
             rep,
         )
         # Outputs: per-node vectors stay row-sharded; best index replicated.
-        out_shardings = (row, row, row, row, rep)
+        out_shardings = (row, row, row, row, rep, row)
         self._jitted = jax.jit(
             functools.partial(kernel_impl, weights=self.weights),
             in_shardings=in_shardings,
@@ -119,7 +119,7 @@ class ShardedDeviceFleetKernel:
     version, ``evaluate`` per cycle with O(1) host<->device round trips —
     ops/kernel.py) over a 1-D device mesh: the [N, C] chip grids and static
     node vectors live row-sharded across the mesh, the per-cycle [4, N]
-    dynamics and [5, N] result are column-sharded, and the kernel's global
+    dynamics and [6, N] result are column-sharded, and the kernel's global
     reductions (cluster maxima, normalization bounds, argmax) become
     XLA-inserted ICI collectives. Selected by
     ``SchedulerConfig(mesh_devices=N)`` (plugins/yoda/batch.py); the fleet

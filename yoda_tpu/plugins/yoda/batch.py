@@ -24,6 +24,8 @@ force either side.
 
 from __future__ import annotations
 
+from collections import Counter
+from dataclasses import dataclass
 from typing import Callable
 
 import numpy as np
@@ -36,10 +38,12 @@ from yoda_tpu.ops.kernel import (
     DeviceFleetKernel,
     FleetKernelLike,
     KernelRequest,
+    KernelResult,
     REASON_MESSAGES,
 )
 from yoda_tpu.config import Weights
 from yoda_tpu.plugins.yoda.filter_plugin import get_request
+from yoda_tpu.plugins.yoda.gang import ALLOWED_HOSTS_KEY, GANG_REMAINING_KEY
 
 # Below this many padded [N, C] elements the kernel is pinned to host CPU in
 # "auto" mode. Conservative: on a locally-attached TPU the device wins from
@@ -66,6 +70,35 @@ def _host_admission(
         dtype=bool,
     )
     return ok
+
+
+@dataclass
+class _GangPlan:
+    """One dispatch's placement plan for a whole gang (VERDICT r2 #5).
+
+    Built when the FIRST unplaced member of a gang is evaluated: the kernel
+    result's per-node ``claimable`` chips let the remaining members be
+    placed host-side against the SAME snapshot — one YodaBatch dispatch per
+    gang instead of one per member, shrinking the inter-member atomicity
+    window to a single evaluation. Each sibling cycle is served from
+    ``picks`` after validating that (a) the snapshot hasn't changed and
+    (b) every previously-served member actually reserved where predicted
+    (``base`` + chips x served picks on that node, via ``reserved_fn``) —
+    any divergence, including a foreign pod sneaking a reservation onto a
+    planned node, invalidates the plan and falls back to a fresh dispatch.
+    """
+
+    gang: str
+    snapshot_version: int
+    request: KernelRequest              # members must request identically
+    tolerations: tuple                  # ...and tolerate identically (the
+                                        # dispatch's host_ok used pick 0's)
+    picks: list[str]                    # node per member, picks[0] = the
+                                        # dispatching member's own placement
+    base: dict[str, int]                # reserved_fn(node) at dispatch time
+    statuses: dict[str, Status]         # private copy of the dispatch's map
+    scores: dict[str, int]
+    next_idx: int = 1                   # picks[0] is consumed by the dispatch
 
 
 class YodaBatch(BatchFilterScorePlugin):
@@ -97,6 +130,12 @@ class YodaBatch(BatchFilterScorePlugin):
         self._static: FleetArrays | None = None
         self._kern: FleetKernelLike | None = None
         self._kern_device = None
+        # Whole-gang placement plans: gang name -> _GangPlan. One kernel
+        # dispatch places every remaining member; siblings are served from
+        # the plan (VERDICT r2 #5). dispatch_count counts REAL dispatches
+        # (tests assert one per gang).
+        self._gang_plans: dict[str, _GangPlan] = {}
+        self.dispatch_count = 0
         if mesh_devices:
             # Eager: an infeasible mesh (more devices than exist) must fail
             # at construction, not mid-scheduling-cycle. The mesh is fixed
@@ -158,6 +197,12 @@ class YodaBatch(BatchFilterScorePlugin):
         if len(snapshot) == 0:
             return {}, {}
         req = get_request(state)
+        reqk = KernelRequest.from_request(req)
+        gang_name = req.gang.name if req.gang is not None else None
+        if gang_name is not None:
+            served = self._serve_gang_plan(state, pod, gang_name, snapshot, reqk)
+            if served is not None:
+                return served
         static = self._refresh_static(snapshot)
         # Reservations/claims/freshness change cycle-to-cycle without a
         # metrics bump, and Node-object admission (cordon + taints vs THIS
@@ -168,7 +213,8 @@ class YodaBatch(BatchFilterScorePlugin):
             max_metrics_age_s=self.max_metrics_age_s,
             host_ok=_host_admission(static, snapshot, pod),
         )
-        result = self._kern.evaluate(dyn, KernelRequest.from_request(req))
+        result = self._kern.evaluate(dyn, reqk)
+        self.dispatch_count += 1
         statuses: dict[str, Status] = {}
         scores: dict[str, int] = {}
         for i, name in enumerate(static.names):
@@ -183,4 +229,132 @@ class YodaBatch(BatchFilterScorePlugin):
                 # aggregate in summarize_failure ("6 node(s): not enough ...").
                 reason = REASON_MESSAGES.get(int(result.reasons[i]), "infeasible")
                 statuses[name] = Status.unschedulable(reason)
+        if gang_name is not None:
+            self._build_gang_plan(
+                state, pod, gang_name, snapshot, reqk, static, result,
+                statuses, scores,
+            )
         return statuses, scores
+
+    # --- whole-gang batched placement (VERDICT r2 #5) ---
+
+    def _build_gang_plan(
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        gang: str,
+        snapshot: Snapshot,
+        reqk: KernelRequest,
+        static: FleetArrays,
+        result: KernelResult,
+        statuses: dict[str, Status],
+        scores: dict[str, int],
+    ) -> None:
+        """Place every remaining gang member host-side from THIS dispatch's
+        result: greedy argmax by (score, name) — identical to the driver's
+        pick — decrementing per-node ``claimable`` chips between members
+        (and, for topology gangs, consuming one planned host per member).
+        picks[0] reproduces the driver's choice for the dispatching member;
+        the rest are served to sibling cycles by :meth:`_serve_gang_plan`."""
+        self._gang_plans.pop(gang, None)
+        if (
+            self.reserved_fn is None
+            or result.claimable is None
+            or not snapshot.version  # 0 = uncacheable snapshot
+        ):
+            return
+        k = (
+            state.read(GANG_REMAINING_KEY).count
+            if state.contains(GANG_REMAINING_KEY)
+            else 0
+        )
+        if k <= 1:
+            return
+        chips = max(reqk.number, 1)
+        names = static.names
+        n = len(names)
+        one_per_host = False
+        eligible = result.feasible[:n].astype(bool).copy()
+        if state.contains(ALLOWED_HOSTS_KEY):
+            hosts = state.read(ALLOWED_HOSTS_KEY).hosts
+            eligible &= np.fromiter(
+                (nm in hosts for nm in names), dtype=bool, count=n
+            )
+            one_per_host = True  # topology plans are one member per host
+        avail = result.claimable[:n].astype(np.int64).copy()
+        sc = result.scores
+        picks: list[str] = []
+        for _ in range(k):
+            cand = np.nonzero(eligible & (avail >= chips))[0]
+            if cand.size == 0:
+                break
+            best = max(cand, key=lambda i: (sc[i], names[i]))
+            picks.append(names[best])
+            avail[best] -= chips
+            if one_per_host:
+                eligible[best] = False
+        if len(picks) < 2:
+            return  # nothing to serve beyond the current member
+        self._gang_plans[gang] = _GangPlan(
+            gang=gang,
+            snapshot_version=snapshot.version,
+            request=reqk,
+            tolerations=tuple(pod.tolerations),
+            picks=picks,
+            # Copies: the runtime owns and may mutate the returned dicts
+            # (single-plugin hot path writes FilterPlugin rejections in).
+            base={nm: self.reserved_fn(nm) for nm in set(picks)},
+            statuses=dict(statuses),
+            scores=dict(scores),
+        )
+        if len(self._gang_plans) > 16:  # bounded: drop the oldest plan
+            self._gang_plans.pop(next(iter(self._gang_plans)))
+
+    def _serve_gang_plan(
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        gang: str,
+        snapshot: Snapshot,
+        reqk: KernelRequest,
+    ) -> tuple[dict[str, Status], dict[str, int]] | None:
+        """Serve a sibling member its pre-planned node — after validating
+        the plan still describes reality. None = dispatch normally."""
+        plan = self._gang_plans.get(gang)
+        if plan is None:
+            return None
+        if (
+            snapshot.version != plan.snapshot_version
+            or plan.next_idx >= len(plan.picks)
+            or reqk != plan.request  # members must be requesting identically
+            or tuple(pod.tolerations) != plan.tolerations  # and tolerating
+            or self.reserved_fn is None
+        ):
+            self._gang_plans.pop(gang, None)
+            return None
+        node = plan.picks[plan.next_idx]
+        # Every previously-served member must have reserved where predicted,
+        # and the node about to be served must hold exactly its predicted
+        # reservations — a foreign pod reserving onto ANY planned node
+        # (no watch event, so no version bump) invalidates the plan.
+        chips = max(plan.request.number, 1)
+        served = Counter(plan.picks[: plan.next_idx])
+        for nm in set(plan.picks[: plan.next_idx]) | {node}:
+            if self.reserved_fn(nm) != plan.base[nm] + chips * served[nm]:
+                self._gang_plans.pop(gang, None)
+                return None
+        if state.contains(ALLOWED_HOSTS_KEY) and node not in state.read(
+            ALLOWED_HOSTS_KEY
+        ).hosts:
+            self._gang_plans.pop(gang, None)  # the gang re-planned
+            return None
+        plan.next_idx += 1
+        held = Status.unschedulable(
+            "chips held for gang siblings (batched placement)"
+        )
+        ok = Status.ok()
+        statuses = {
+            nm: (st if not st.success else (ok if nm == node else held))
+            for nm, st in plan.statuses.items()
+        }
+        return statuses, {node: plan.scores.get(node, 0)}

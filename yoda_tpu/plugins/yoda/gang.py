@@ -61,13 +61,25 @@ from yoda_tpu.plugins.yoda.topology import plan_slice_placement
 log = logging.getLogger("yoda_tpu.gang")
 
 ALLOWED_HOSTS_KEY = "yoda-gang/allowed-hosts"
+# Members of this pod's gang still unplaced (this pod included) — written at
+# admission so the batch plugin can place the WHOLE remainder from one
+# kernel dispatch (plugins/yoda/batch.py gang batching, VERDICT r2 #5).
+GANG_REMAINING_KEY = "yoda-gang/remaining"
 
 
-@dataclass
+@dataclass(frozen=True)
 class _AllowedHosts:
     hosts: frozenset[str]
 
     def clone(self) -> "_AllowedHosts":
+        return self
+
+
+@dataclass(frozen=True)
+class _GangRemaining:
+    count: int
+
+    def clone(self) -> "_GangRemaining":
         return self
 
 
@@ -162,6 +174,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 gs.bound.discard(pod.key)
                 gs.assigned.pop(pod.key, None)
             remaining = gs.spec.size - len(gs.bound) - len(gs.waiting)
+            state.write(GANG_REMAINING_KEY, _GangRemaining(remaining))
 
             if gs.spec.topology is not None:
                 # deferred: a waiting member to reject AFTER the lock is
