@@ -123,3 +123,161 @@ class TestNativeAgent:
         assert agent.run_once().last_updated_unix == 100.0
         assert agent.run_once().last_updated_unix == 200.0
         assert len(cluster.list_tpu_metrics()) == 1
+
+
+class _FakeDev:
+    """A PJRT-device stand-in: identity + optional memory_stats."""
+
+    def __init__(self, kind="TPU v5 lite", coords=(1, 2, 0), stats=None):
+        self.platform = "tpu"
+        self.device_kind = kind
+        self.coords = list(coords)
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+class TestRuntimeReader:
+    """agent/runtime.py: real hardware values through the live JAX/libtpu
+    runtime (VERDICT r2 #4 — the sniffer's hardware-reading role)."""
+
+    def test_reads_identity_and_memory_counters(self):
+        from yoda_tpu.agent.runtime import read_runtime
+
+        devs = [
+            _FakeDev(stats={"bytes_limit": 16 * GIB, "bytes_in_use": 4 * GIB})
+            for _ in range(4)
+        ]
+        r = read_runtime(lambda: devs)
+        assert r is not None
+        assert r.device_kind == "TPU v5 lite"
+        assert r.generation == "v5e"
+        assert r.coords == (1, 2, 0)
+        assert len(r.chips) == 4
+        assert r.chips[0].hbm_total == 16 * GIB
+        assert r.chips[0].hbm_free == 12 * GIB
+        assert r.has_real_hbm
+        assert r.source == "jax-runtime+memstats"
+
+    def test_memstats_absent_falls_back_to_spec_table(self):
+        from yoda_tpu.agent.runtime import metrics_from_runtime, read_runtime
+
+        r = read_runtime(lambda: [_FakeDev(stats=None)])
+        assert r is not None and not r.has_real_hbm
+        assert r.source == "jax-runtime+spec-hbm"
+        tpu = metrics_from_runtime("n1", r, now_fn=lambda: 5.0)
+        assert tpu.generation == "v5e"
+        assert tpu.chips[0].hbm_total == 16 * GIB  # spec table, recorded as such
+        assert tpu.source == "jax-runtime+spec-hbm"
+        assert tpu.last_updated_unix == 5.0
+
+    def test_no_devices_returns_none(self):
+        from yoda_tpu.agent.runtime import read_runtime
+
+        assert read_runtime(lambda: []) is None
+
+    def test_source_survives_cr_round_trip(self):
+        from yoda_tpu.agent.runtime import metrics_from_runtime, read_runtime
+        from yoda_tpu.api.types import TpuNodeMetrics
+
+        r = read_runtime(lambda: [_FakeDev()])
+        tpu = metrics_from_runtime("n1", r, now_fn=lambda: 1.0)
+        restored = TpuNodeMetrics.from_obj(tpu.to_obj())
+        assert restored.source == "jax-runtime+spec-hbm"
+
+
+class TestAgentRuntimeOverlay:
+    def test_real_counters_override_and_skip_label_attribution(
+        self, lib, env_spec
+    ):
+        """With real memory counters, the published free HBM is what the
+        hardware reports — label-declared HBM must NOT be subtracted on top
+        (that would double-count actual usage)."""
+        env_spec("generation=v5e;chips=2")
+        cluster = FakeCluster()
+        pod = PodSpec("occupant", labels={"tpu/chips": "1", "tpu/hbm": "4Gi"})
+        cluster.create_pod(pod)
+        cluster.bind_pod(pod.key, "real-node")
+        devs = [
+            _FakeDev(stats={"bytes_limit": 16 * GIB, "bytes_in_use": 10 * GIB})
+            for _ in range(2)
+        ]
+        agent = NativeTpuAgent(
+            cluster, "real-node", lib=lib, runtime_devices_fn=lambda: devs
+        )
+        tpu = agent.run_once()
+        assert tpu.source == "env+jax-runtime+memstats"
+        assert all(c.hbm_total == 16 * GIB for c in tpu.chips)
+        assert all(c.hbm_free == 6 * GIB for c in tpu.chips)  # hardware, not labels
+
+    def test_ids_only_overlay_keeps_label_attribution(self, lib, env_spec):
+        """Runtime enumerates but exposes no memory counters: identity is
+        overlaid, HBM stays native/spec and bound-pod labels ARE charged."""
+        env_spec("generation=v5p;chips=2")
+        cluster = FakeCluster()
+        pod = PodSpec("occupant", labels={"tpu/chips": "1", "tpu/hbm": "4Gi"})
+        cluster.create_pod(pod)
+        cluster.bind_pod(pod.key, "real-node")
+        devs = [_FakeDev(kind="TPU v5 lite", stats=None) for _ in range(2)]
+        agent = NativeTpuAgent(
+            cluster, "real-node", lib=lib, runtime_devices_fn=lambda: devs
+        )
+        tpu = agent.run_once()
+        assert tpu.source == "env+jax-runtime+spec-hbm"
+        assert tpu.generation == "v5e"  # device_kind is authoritative
+        frees = sorted(c.hbm_free for c in tpu.chips)
+        assert frees[0] == 95 * GIB - 4 * GIB  # label charged (v5p spec HBM)
+
+    def test_runtime_alone_when_native_finds_nothing(self, lib, monkeypatch):
+        """No env spec and no device files: the live runtime alone feeds
+        the CR."""
+        monkeypatch.delenv("YODA_TPUINFO_SPEC", raising=False)
+        cluster = FakeCluster()
+        devs = [
+            _FakeDev(stats={"bytes_limit": 16 * GIB, "bytes_in_use": 0})
+            for _ in range(4)
+        ]
+        agent = NativeTpuAgent(
+            cluster, "n1", lib=lib, runtime_devices_fn=lambda: devs
+        )
+        tpu = agent.run_once()
+        if tpu is None:
+            pytest.skip("host has real accelerator device files")
+        if "jax-runtime" not in tpu.source:
+            pytest.skip("native device inventory fired on this host")
+        assert tpu.chip_count == 4
+        assert tpu.source == "jax-runtime+memstats"
+        assert tpu.generation == "v5e"
+
+
+@pytest.mark.skipif(
+    not os.environ.get("YODA_REAL_TPU_TEST"),
+    reason="set YODA_REAL_TPU_TEST=1 to read the real chip (slow tunnel init)",
+)
+class TestRealChip:
+    def test_reads_the_real_tpu(self):
+        """On the bench host: the runtime reader must report the real chip's
+        identity (the per-round hardware evidence lands in BENCH_r{N}.json
+        via bench.py _agent_hw_probe)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from yoda_tpu.agent.runtime import read_runtime\n"
+            "r = read_runtime()\n"
+            "assert r is not None, 'no TPU devices'\n"
+            "assert r.device_kind.startswith('TPU'), r.device_kind\n"
+            "print(r.device_kind, r.source)\n" % REPO
+        )
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]

@@ -262,11 +262,34 @@ class TestBatchPlatformPolicy:
         b = YodaBatch(platform="auto")
         assert b._device_for(self._arrays()) == jax.devices("cpu")[0]
 
-    def test_auto_large_fleet_uses_default_device(self):
+    def test_auto_large_fleet_uses_default_device_when_local(self):
         from yoda_tpu.plugins.yoda.batch import YodaBatch
 
         b = YodaBatch(platform="auto", device_min_elems=4)
+        b._floor_ms = 0.1  # locally-attached-class dispatch floor
         assert b._device_for(self._arrays()) is None
+
+    def test_auto_refuses_remote_class_device(self):
+        """BENCH_r03 kernel_sweep: a remote/tunnel-attached accelerator
+        loses to host CPU at every measured fleet scale (0.9 vs 119 ms at
+        256 rows through 139 vs 866 ms at 262144 rows) — 'auto' must keep
+        the kernel on CPU regardless of size when the measured dispatch
+        floor is remote-class."""
+        import jax
+
+        from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+        b = YodaBatch(platform="auto", device_min_elems=4)
+        b._floor_ms = 95.0  # tunnel-class dispatch floor
+        assert b._device_for(self._arrays()) == jax.devices("cpu")[0]
+
+    def test_dispatch_floor_probe_runs_and_caches(self):
+        from yoda_tpu.plugins.yoda.batch import YodaBatch
+
+        b = YodaBatch(platform="auto")
+        floor = b._dispatch_floor_ms()
+        assert floor > 0
+        assert b._dispatch_floor_ms() == floor  # cached, no re-probe
 
     def test_forced_platforms(self):
         import jax

@@ -133,30 +133,76 @@ def collection_source(lib=None) -> str:
 
 
 class NativeTpuAgent:
-    """Per-node publisher loop body: collect via the native library, attribute
+    """Per-node publisher loop body: collect via the native library, overlay
+    live-runtime hardware counters (agent/runtime.py) when enabled, attribute
     bound pods' HBM, publish the CR. ``run_once`` is what the DaemonSet's
     interval loop calls (deploy/yoda-tpu-agent.yaml --interval-s)."""
 
-    def __init__(self, cluster, node_name: str, *, lib=None, now_fn=time.time):
+    def __init__(
+        self,
+        cluster,
+        node_name: str,
+        *,
+        lib=None,
+        now_fn=time.time,
+        runtime_devices_fn=None,
+    ):
         self.cluster = cluster  # needs put_tpu_metrics / list_pods
         self.node_name = node_name
         self.lib = lib or load_library()
         self.now_fn = now_fn
+        # None = runtime probing disabled (--runtime-probe wires
+        # agent.runtime.probe_devices, tests inject fakes).
+        self.runtime_devices_fn = runtime_devices_fn
 
     def run_once(self) -> TpuNodeMetrics | None:
+        from yoda_tpu.agent import runtime as rt
+
         tpu = collect_host_metrics(self.node_name, lib=self.lib, now_fn=self.now_fn)
+        if tpu is not None:
+            tpu.source = collection_source(self.lib)
+        reading = (
+            rt.read_runtime(self.runtime_devices_fn)
+            if self.runtime_devices_fn is not None
+            else None
+        )
+        if reading is not None:
+            if tpu is None:
+                # No native inventory (no device files / env spec): the
+                # live runtime alone is authoritative.
+                tpu = rt.metrics_from_runtime(
+                    self.node_name, reading, now_fn=self.now_fn
+                )
+            else:
+                rt.overlay_runtime(tpu, reading)
         if tpu is None:
             return None
-        self._attribute_bound_pods(tpu)
+        # Chips with REAL memory counters already reflect actual usage —
+        # attributing label-declared HBM on top would double-count it. The
+        # check is per chip: a runtime that covers only some chips (fewer
+        # devices than native inventory, or memory_stats absent on some)
+        # must not exempt the uncovered ones from attribution.
+        real_idx = (
+            {rc.index for rc in reading.chips if rc.hbm_total is not None}
+            if reading is not None
+            else frozenset()
+        )
+        if any(c.index not in real_idx for c in tpu.chips):
+            self._attribute_bound_pods(tpu, skip=real_idx)
         self.cluster.put_tpu_metrics(tpu)
         return tpu
 
-    def _attribute_bound_pods(self, tpu: TpuNodeMetrics) -> None:
+    def _attribute_bound_pods(self, tpu: TpuNodeMetrics, skip=frozenset()) -> None:
         """HBM attribution via the one shared occupancy model
-        (agent/fake_publisher.py ``charge_bound_pods``)."""
+        (agent/fake_publisher.py ``charge_bound_pods``), over the chips
+        whose free HBM is NOT hardware-read (``skip`` = chip indices with
+        real counters)."""
         from yoda_tpu.agent.fake_publisher import charge_bound_pods
 
-        free = [c.hbm_free for c in tpu.chips]
+        chips = [c for c in tpu.chips if c.index not in skip]
+        if not chips:
+            return
+        free = [c.hbm_free for c in chips]
         charge_bound_pods(free, self.cluster.list_pods(), self.node_name)
-        for chip, f in zip(tpu.chips, free):
+        for chip, f in zip(chips, free):
             chip.hbm_free = f

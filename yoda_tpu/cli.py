@@ -188,11 +188,11 @@ def _run_agent(args, stop: threading.Event) -> int:
         )
         return 2
     lib = load_library(args.tpuinfo_lib)
-    if lib is None and not args.allow_fake:
+    if lib is None and not args.allow_fake and not args.runtime_probe:
         print(
             "yoda-tpu-scheduler --agent: libyoda_tpuinfo.so not found "
             "(build native/ or pass --tpuinfo-lib); refusing to publish "
-            "without --allow-fake",
+            "without --runtime-probe or --allow-fake",
             file=sys.stderr,
         )
         return 2
@@ -203,9 +203,19 @@ def _run_agent(args, stop: threading.Event) -> int:
     # unconditional three-kind watch made the DaemonSet 403-crash-loop).
     cluster = _build_kube_cluster(kinds=("Pod",))
     try:
-        agent = NativeTpuAgent(cluster, node_name, lib=lib)
+        runtime_fn = None
+        if args.runtime_probe:
+            from yoda_tpu.agent.runtime import probe_devices
+
+            runtime_fn = probe_devices
+        agent = NativeTpuAgent(
+            cluster, node_name, lib=lib, runtime_devices_fn=runtime_fn
+        )
+        # Synthetic fallback, used per-iteration only when neither the
+        # native library nor the runtime probe yields anything — real data
+        # always wins over fake.
         fake = None
-        if lib is None:
+        if args.allow_fake and lib is None:
             from yoda_tpu.agent.fake_publisher import FakeTpuAgent
 
             fake = FakeTpuAgent(cluster)
@@ -216,15 +226,16 @@ def _run_agent(args, stop: threading.Event) -> int:
         _install_stop_handlers(stop)
         print(
             f"yoda-tpu-agent: publishing {node_name} every {args.interval_s}s "
-            f"(source={collection_source(lib) if lib else 'fake'})",
+            f"(native={collection_source(lib) if lib else 'unavailable'}"
+            f" runtime-probe={'on' if runtime_fn else 'off'}"
+            f" fake-fallback={'on' if fake else 'off'})",
             file=sys.stderr,
         )
         while not stop.is_set():
             try:
-                if fake is not None:
+                published = agent.run_once()
+                if published is None and fake is not None:
                     fake.publish_all()
-                else:
-                    agent.run_once()
             except Exception as e:  # keep the DaemonSet loop alive across blips
                 print(f"yoda-tpu-agent: publish failed: {e}", file=sys.stderr)
             stop.wait(args.interval_s)
@@ -287,6 +298,17 @@ def main(
         "--allow-fake",
         action="store_true",
         help="publish a synthetic host profile when no TPU reader is available",
+    )
+    agent.add_argument(
+        "--runtime-probe",
+        action="store_true",
+        help="read real per-chip values (identity, coords, HBM counters "
+        "where exposed) through the live JAX/libtpu runtime and overlay "
+        "them onto the native inventory; the CR's source field records "
+        "what was hardware-read. CAUTION: initializes the TPU runtime in "
+        "the agent process — on configurations where libtpu acquires "
+        "chips exclusively this locks out workload pods; enable only "
+        "where multi-process access is configured (docs/OPERATIONS.md)",
     )
     agent.add_argument("--fake-generation", default="v5e")
     agent.add_argument("--fake-chips", type=int, default=4)

@@ -14,21 +14,24 @@ fetches one packed [5, N] result — O(1) host<->device round trips per pod
 API-server round trips per pod (pkg/yoda/scheduler.go:70,108).
 
 Platform policy: this kernel is latency-bound integer math, not MXU work.
-On a remotely-attached TPU (the axon tunnel) each dispatch has a ~66 ms RPC
-floor (measured), so tiny fleets run faster on the host CPU via the SAME
-XLA kernel. ``platform="auto"`` therefore pins the kernel to CPU below
-``device_min_elems`` padded elements and to the default accelerator above
-it, where a locally-attached device's bandwidth wins; ``"cpu"``/``"device"``
-force either side.
+``platform="auto"`` measures the default device's dispatch floor once: a
+remote/tunnel-attached accelerator (~100 ms/eval measured — BENCH_r03
+kernel_sweep, where CPU beat the tunnel at EVERY fleet scale up to
+262144x8) is refused outright, and a locally-attached device is used only
+above ``device_min_elems`` padded elements, where its bandwidth outweighs
+the ~0.1 ms local dispatch cost. ``"cpu"``/``"device"`` force either side.
 """
 
 from __future__ import annotations
 
+import logging
 from collections import Counter
 from dataclasses import dataclass
 from typing import Callable
 
 import numpy as np
+
+log = logging.getLogger("yoda_tpu.batch")
 
 from yoda_tpu.api.types import PodSpec, node_admits_pod
 from yoda_tpu.framework.cyclestate import CycleState
@@ -46,11 +49,22 @@ from yoda_tpu.plugins.yoda.filter_plugin import get_request
 from yoda_tpu.plugins.yoda.gang import ALLOWED_HOSTS_KEY, GANG_REMAINING_KEY
 
 # Below this many padded [N, C] elements the kernel is pinned to host CPU in
-# "auto" mode. Conservative: on a locally-attached TPU the device wins from
-# roughly 10^5-10^6 elements; over a remote tunnel the CPU wins at every
-# realistic fleet size (measured: 0.2 ms CPU vs 66 ms tunnel at 64x4,
-# 32 ms CPU vs 222 ms tunnel at 131072x8).
+# "auto" mode. Measured (BENCH_r03 kernel_sweep, remote-tunnel TPU vs host
+# CPU, rows x 8 chips): 256: 0.87 vs 119 ms; 4096: 1.8 vs 146 ms;
+# 65536: 32 vs 288 ms; 262144: 139 vs 866 ms — on a REMOTE-attached device
+# the per-eval RPC floor plus transfer dominates and CPU wins at every
+# measured scale, so 'auto' additionally probes the dispatch floor below
+# and refuses remote-class devices outright. The element threshold then
+# only governs locally-attached devices (floor < AUTO_REMOTE_FLOOR_MS),
+# where dispatch costs ~100 us and the device's bandwidth advantage is
+# worth taking once the arrays are big enough to matter.
 AUTO_DEVICE_MIN_ELEMS = 1 << 22
+
+# 'auto' treats a device whose measured dispatch floor exceeds this as
+# remotely attached (tunnel/RPC) and keeps the kernel on host CPU: the
+# measured tunnel floor here is ~100 ms/eval vs ~0.1 ms locally — three
+# orders of magnitude, so the cut does not need to be precise.
+AUTO_REMOTE_FLOOR_MS = 2.0
 
 
 def _host_admission(
@@ -136,6 +150,7 @@ class YodaBatch(BatchFilterScorePlugin):
         # (tests assert one per gang).
         self._gang_plans: dict[str, _GangPlan] = {}
         self.dispatch_count = 0
+        self._floor_ms: float | None = None  # lazy dispatch-floor probe
         if mesh_devices:
             # Eager: an infeasible mesh (more devices than exist) must fail
             # at construction, not mid-scheduling-cycle. The mesh is fixed
@@ -156,9 +171,47 @@ class YodaBatch(BatchFilterScorePlugin):
         if self.platform == "cpu":
             return jax.devices("cpu")[0]
         n, c = arrays.padded_shape
-        if n * c >= self.device_min_elems:
+        if (
+            n * c >= self.device_min_elems
+            and self._dispatch_floor_ms() <= AUTO_REMOTE_FLOOR_MS
+        ):
             return None
         return jax.devices("cpu")[0]
+
+    def _dispatch_floor_ms(self) -> float:
+        """Measured once per plugin: the default device's per-dispatch floor
+        (a tiny jitted op, round-tripped). Distinguishes locally-attached
+        accelerators (~0.1 ms) from remote/tunnel transports (~100 ms),
+        which lose to host CPU at every fleet scale (BENCH_r03
+        kernel_sweep; VERDICT r2 #3)."""
+        if self._floor_ms is None:
+            import time as _time
+
+            import jax
+            import jax.numpy as jnp
+
+            x = jax.device_put(np.zeros(8, np.int32))
+            f = jax.jit(lambda a: a + jnp.int32(1))
+            f(x).block_until_ready()  # compile outside the measurement
+            # Min of several: robust against a contention spike at process
+            # start permanently misclassifying a local device as remote
+            # (the local/remote gap is 3 orders of magnitude, the cut 2 ms).
+            samples = []
+            for _ in range(5):
+                t0 = _time.monotonic()
+                f(x).block_until_ready()
+                samples.append((_time.monotonic() - t0) * 1e3)
+            self._floor_ms = min(samples)
+            log.info(
+                "kernel auto policy: default-device dispatch floor %.2f ms "
+                "-> %s path above %d elements",
+                self._floor_ms,
+                "device"
+                if self._floor_ms <= AUTO_REMOTE_FLOOR_MS
+                else "cpu (remote-class device)",
+                self.device_min_elems,
+            )
+        return self._floor_ms
 
     def _refresh_static(self, snapshot: Snapshot) -> FleetArrays:
         # Static [N, C] chip metrics are keyed on the metrics version when the
@@ -282,17 +335,22 @@ class YodaBatch(BatchFilterScorePlugin):
             )
             one_per_host = True  # topology plans are one member per host
         avail = result.claimable[:n].astype(np.int64).copy()
-        sc = result.scores
+        # One vectorized descending (score, name) ranking, then a walk:
+        # scores never change between picks, so the greedy argmax is always
+        # the first still-eligible node in this order (equivalent to the
+        # driver's max((score, name)) without O(k*N) Python lambdas).
+        order = np.lexsort((np.array(names), result.scores[:n]))[::-1]
         picks: list[str] = []
-        for _ in range(k):
-            cand = np.nonzero(eligible & (avail >= chips))[0]
-            if cand.size == 0:
+        for i in order:
+            if not eligible[i]:
+                continue
+            while len(picks) < k and avail[i] >= chips:
+                picks.append(names[i])
+                avail[i] -= chips
+                if one_per_host:
+                    break
+            if len(picks) >= k:
                 break
-            best = max(cand, key=lambda i: (sc[i], names[i]))
-            picks.append(names[best])
-            avail[best] -= chips
-            if one_per_host:
-                eligible[best] = False
         if len(picks) < 2:
             return  # nothing to serve beyond the current member
         self._gang_plans[gang] = _GangPlan(
