@@ -348,6 +348,18 @@ class TestGangBatchedDispatch:
         assert len(hosts) == 4
         assert len({h.rsplit("-", 1)[0] for h in hosts}) == 1
         assert batch.dispatch_count == d0 + 1
+        assert batch.plan_served == 3  # members 2-4 answered from the plan
+        assert not batch._gang_plans  # fully-served plan released
+        # The counters are scraped via /metrics as counter-typed series.
+        rendered = stack.metrics.registry.render_prometheus()
+        assert "# TYPE yoda_gang_plan_served_total counter" in rendered
+        assert "# TYPE yoda_kernel_dispatches_total counter" in rendered
+        served = next(
+            m
+            for m in stack.metrics.registry._metrics
+            if m.name == "yoda_gang_plan_served_total"
+        )
+        assert served.value() == 3
 
     def test_one_dispatch_per_plain_gang_sharing_hosts(self):
         stack, agent = make_stack()

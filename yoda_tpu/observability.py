@@ -49,28 +49,47 @@ def _fmt_labels(labels: Mapping[str, str]) -> str:
 
 
 class Counter:
-    def __init__(self, name: str, help_: str) -> None:
+    """A counter; ``collect_fn`` makes it lazy (the monotonic value lives
+    elsewhere — e.g. a plugin's attribute — and is read at scrape time),
+    mirroring Gauge's lazy mode but keeping the Prometheus ``counter``
+    type for ``_total``-named series."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        collect_fn: Callable[[], float] | None = None,
+    ) -> None:
         self.name = name
         self.help = help_
+        self.collect_fn = collect_fn
         self._lock = threading.Lock()
         self._values: dict[tuple[tuple[str, str], ...], float] = {}
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
+        assert self.collect_fn is None, "lazy counters are scrape-only"
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
+        if self.collect_fn is not None:
+            return float(self.collect_fn())
         key = tuple(sorted(labels.items()))
         with self._lock:
             return self._values.get(key, 0.0)
 
     def total(self) -> float:
+        if self.collect_fn is not None:
+            return float(self.collect_fn())
         with self._lock:
             return sum(self._values.values())
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        if self.collect_fn is not None:
+            out.append(f"{self.name} {float(self.collect_fn())}")
+            return out
         with self._lock:
             items = sorted(self._values.items())
         for key, v in items or [((), 0.0)]:
@@ -199,8 +218,8 @@ class Registry:
             self._metrics.append(metric)
         return metric
 
-    def counter(self, name: str, help_: str) -> Counter:
-        return self.register(Counter(name, help_))
+    def counter(self, name: str, help_: str, collect_fn=None) -> Counter:
+        return self.register(Counter(name, help_, collect_fn))
 
     def gauge(self, name: str, help_: str, collect_fn=None) -> Gauge:
         return self.register(Gauge(name, help_, collect_fn))

@@ -112,12 +112,34 @@ def build_stack(
 
     informer = InformerCache(on_pod_pending=queue.add, on_change=on_change)
 
-    # Wire claims into our batch plugin now the informer exists.
+    # Wire claims into our batch plugin now the informer exists, and expose
+    # the batched-gang placement counters (lazy, summed over plugins and
+    # registered ONCE — duplicate metric families would break the whole
+    # /metrics scrape).
     from yoda_tpu.plugins.yoda import YodaBatch
 
-    for p in framework.batch_plugins:
-        if isinstance(p, YodaBatch) and p.claimed_fn is None:
+    batches = [p for p in framework.batch_plugins if isinstance(p, YodaBatch)]
+    for p in batches:
+        if p.claimed_fn is None:
             p.claimed_fn = informer.claimed_hbm_mib
+    if batches:
+        metrics.registry.counter(
+            "yoda_kernel_dispatches_total",
+            "Real fused-kernel dispatches (gang siblings served from a "
+            "placement plan do not dispatch)",
+            lambda: sum(p.dispatch_count for p in batches),
+        )
+        metrics.registry.counter(
+            "yoda_gang_plan_served_total",
+            "Gang member cycles answered from a whole-gang placement plan",
+            lambda: sum(p.plan_served for p in batches),
+        )
+        metrics.registry.counter(
+            "yoda_gang_plan_invalidated_total",
+            "Live gang placement plans dropped before being fully served "
+            "(validation failure or concurrent-gang eviction)",
+            lambda: sum(p.plan_invalidated for p in batches),
+        )
 
     cluster.add_watcher(accountant.handle)
     cluster.add_watcher(gang.handle)
