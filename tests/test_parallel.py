@@ -66,6 +66,7 @@ def test_sharded_matches_single_device(n_devices):
     np.testing.assert_array_equal(sharded.feasible, single.feasible)
     np.testing.assert_array_equal(sharded.reasons, single.reasons)
     np.testing.assert_array_equal(sharded.scores, single.scores)
+    np.testing.assert_array_equal(sharded.claimable, single.claimable)
     assert sharded.best_index == single.best_index
 
 
@@ -168,6 +169,7 @@ class TestShardedDeviceKernel:
         np.testing.assert_array_equal(sharded.feasible, single.feasible)
         np.testing.assert_array_equal(sharded.reasons, single.reasons)
         np.testing.assert_array_equal(sharded.scores, single.scores)
+        np.testing.assert_array_equal(sharded.claimable, single.claimable)
         assert sharded.best_index == single.best_index
 
     def test_rejects_indivisible_bucket(self):

@@ -189,9 +189,25 @@ def pod_request(pod) -> TpuRequest:
     ``google.com/tpu`` container resource limit as the chip-count fallback
     (api.types.PodSpec.tpu_resource_limit). Use this — not bare
     ``parse_request(pod.labels)`` — wherever a whole pod is in hand, so
-    label pods and resource-limit pods are accounted identically."""
-    return parse_request(
-        pod.labels,
-        tpu_limit=getattr(pod, "tpu_resource_limit", 0),
-        spec_priority=getattr(pod, "spec_priority", 0),
+    label pods and resource-limit pods are accounted identically.
+
+    Memoized per pod object (TpuRequest is frozen): snapshot pods stored by
+    the informer are re-parsed every scheduling cycle (scoring, accounting,
+    claims, fleet lowering) — those repeats hit the memo. Watch events
+    decode fresh PodSpec objects, so they always miss. In-place label edits
+    are re-detected by the input-key comparison, so the memo can never
+    serve stale constraints."""
+    key = (
+        tuple(sorted(pod.labels.items())),
+        getattr(pod, "tpu_resource_limit", 0),
+        getattr(pod, "spec_priority", 0),
     )
+    memo = getattr(pod, "_req_memo", None)
+    if memo is not None and memo[0] == key:
+        return memo[1]
+    req = parse_request(pod.labels, tpu_limit=key[1], spec_priority=key[2])
+    try:
+        pod._req_memo = (key, req)
+    except Exception:  # noqa: BLE001 — slots/frozen pods just skip the memo
+        pass
+    return req

@@ -226,13 +226,6 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         plan_broken = gs.plan is not None and any(
             h not in snapshot or h in gs.dead_hosts for h in plan_hosts_free
         )
-        need_replan = gs.plan is None or plan_broken or not all(
-            self._host_fits_member(
-                snapshot.get(h), req, assigned_hosts, pod.tolerations
-            )
-            for h in plan_hosts_free
-            if h in snapshot
-        ) or not plan_hosts_free
         # A plan that LOST a host can never complete — waiting members would
         # hold their reservations until the permit timeout. Cancel via the
         # caller's deferred list (rejected outside the gang lock): one
@@ -256,7 +249,21 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # members hold reservations on planned hosts). Members already BOUND
         # (e.g. replayed after a scheduler restart) pin the new plan: the
         # block must complete around their hosts.
-        if need_replan and len(gs.waiting) == 0:
+        # Short-circuit order matters: the O(free-hosts) fit scan only runs
+        # when replanning is permitted (no member parked at Permit), so
+        # sibling admissions mid-gang skip it.
+        if len(gs.waiting) == 0 and (
+            gs.plan is None
+            or plan_broken
+            or not plan_hosts_free
+            or not all(
+                self._host_fits_member(
+                    snapshot.get(h), req, assigned_hosts, pod.tolerations
+                )
+                for h in plan_hosts_free
+                if h in snapshot
+            )
+        ):
             pinned: dict[str, tuple[int, int, int]] = {}
             for key in list(gs.bound):
                 host = gs.assigned.get(key)
