@@ -33,13 +33,17 @@ FLEET_SINGLES = 16        # + 16 v5e single hosts
 def _binpack_scenario() -> float:
     """BASELINE config-3 style saturation packing: fill a fresh fleet with
     mixed 2- and 3-chip pods until nothing else fits; returns chips-in-use /
-    chips-allocatable from the yoda_tpu_binpack_efficiency gauge."""
+    chips-allocatable from the yoda_tpu_binpack_efficiency gauge. Uses
+    scoring_strategy="most-allocated" — the bin-packing strategy this
+    scenario exists to measure (the default "least-allocated" spreads)."""
     from yoda_tpu.agent import FakeTpuAgent
     from yoda_tpu.api.types import PodSpec
     from yoda_tpu.config import SchedulerConfig
     from yoda_tpu.standalone import build_stack
 
-    stack = build_stack(config=SchedulerConfig(mode="batch"))
+    stack = build_stack(
+        config=SchedulerConfig(mode="batch", scoring_strategy="most-allocated")
+    )
     agent = FakeTpuAgent(stack.cluster)
     for i in range(8):
         agent.add_host(f"v5e-{i}", generation="v5e", chips=8)

@@ -199,3 +199,76 @@ class TestUnhealthyChips:
         agent.publish_all()
         stack.scheduler.run_until_idle(max_wall_s=5)
         assert stack.cluster.get_pod("default/p").node_name == "host"
+
+
+class TestScoringStrategy:
+    """Upstream NodeResourcesFit scoringStrategy analog
+    (SchedulerConfig.scoring_strategy): "least-allocated" (default)
+    spreads load across the freest nodes; "most-allocated" inverts the
+    free-leaning score terms to bin-pack saturation fleets (the BASELINE
+    config-3 efficiency scenario)."""
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_most_allocated_packs_one_host(self, mode):
+        stack, agent = make_stack(mode, scoring_strategy="most-allocated")
+        for h in ("pack-0", "pack-1"):
+            agent.add_host(h, generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", labels={"tpu/chips": "2", "tpu/hbm": "1Gi"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        hosts = {
+            stack.cluster.get_pod(f"default/p{i}").node_name for i in range(3)
+        }
+        assert len(hosts) == 1, hosts  # everything onto the fullest node
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_least_allocated_spreads(self, mode):
+        stack, agent = make_stack(mode)  # default strategy
+        for h in ("spread-0", "spread-1"):
+            agent.add_host(h, generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", labels={"tpu/chips": "2", "tpu/hbm": "1Gi"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        hosts = {
+            stack.cluster.get_pod(f"default/p{i}").node_name for i in range(2)
+        }
+        assert len(hosts) == 2, hosts  # one pod per (freest) node
+
+    def test_strategy_validated(self):
+        with pytest.raises(ValueError, match="scoring_strategy"):
+            SchedulerConfig.from_dict({"scoring_strategy": "binpack"})
+        cfg = SchedulerConfig.from_dict(
+            {"scoring_strategy": "most-allocated"}
+        )
+        w = cfg.effective_weights()
+        assert (w.hbm_free, w.actual, w.allocate) == (-2, -2, -2)
+        assert (w.hbm_bandwidth, w.hbm_total, w.slice_protect) == (1, 1, 1)
+        assert SchedulerConfig().effective_weights() == SchedulerConfig().weights
+
+    def test_most_allocated_still_respects_capacity(self):
+        """Bin-packing must never overcommit: once the preferred host is
+        full, the next pod goes to the other host."""
+        stack, agent = make_stack(scoring_strategy="most-allocated")
+        for h in ("full-0", "full-1"):
+            agent.add_host(h, generation="v5e", chips=4)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", labels={"tpu/chips": "2"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        placements = [
+            stack.cluster.get_pod(f"default/p{i}").node_name for i in range(3)
+        ]
+        assert all(placements)
+        from collections import Counter
+
+        counts = Counter(placements)
+        assert max(counts.values()) == 2  # one host filled (2x2 chips)...
+        assert len(counts) == 2           # ...then spillover, no overcommit

@@ -165,6 +165,62 @@ class TestKernelUnits:
         res = fused_filter_score(arrays, KernelRequest.from_request(parse_request({})))
         assert res.best_index == 0
 
+    def test_negative_weights_normalize_correctly(self):
+        """most-allocated negates the free-leaning weights, so all feasible
+        raw scores can be negative. The normalization fillers must sit
+        outside the real range on BOTH sides — with the old `-1` filler for
+        `highest`, an all-negative feasible set inflated the span and
+        crushed distinct fullness levels toward 0 (regression: the fuller
+        node must still normalize to 100)."""
+        from yoda_tpu.config import SchedulerConfig, Weights
+
+        weights = SchedulerConfig(
+            weights=Weights(
+                hbm_bandwidth=0, clock=0, tflops=0, power=0, hbm_total=0,
+                slice_protect=0,
+            ),
+            scoring_strategy="most-allocated",
+        ).effective_weights()
+        from yoda_tpu.api.types import HEALTHY, TpuChip, TpuNodeMetrics
+
+        def node(name, free_per_chip):
+            return TpuNodeMetrics(
+                name=name,
+                generation="v5e",
+                chips=[
+                    TpuChip(
+                        index=i,
+                        health=HEALTHY,
+                        hbm_free=f,
+                        hbm_total=16 * GIB,
+                        clock_mhz=940,
+                        hbm_bandwidth_gbps=819,
+                        tflops_bf16=197,
+                        power_w=130,
+                    )
+                    for i, f in enumerate(free_per_chip)
+                ],
+            )
+
+        # Exclusive-chip model: "fuller" means some chips fully consumed,
+        # the rest fully free (still feasible for a 1-chip request).
+        fuller = node("a-full", [0, 0, 16 * GIB, 16 * GIB])
+        emptier = node("b-free", [16 * GIB] * 4)
+        snapshot = Snapshot(
+            {n.name: NodeInfo(n.name, tpu=n) for n in (fuller, emptier)}
+        )
+        arrays = FleetArrays.from_snapshot(snapshot)  # padding rows exist
+        res = fused_filter_score(
+            arrays,
+            KernelRequest.from_request(parse_request({"tpu/chips": "1"})),
+            weights=weights,
+        )
+        assert all(res.raw_scores[res.feasible] < 0)  # the regression input
+        by_name = dict(zip(arrays.names, res.scores))
+        assert by_name["a-full"] == 100  # fullest normalizes to the top
+        assert by_name["b-free"] == 0
+        assert arrays.names[res.best_index] == "a-full"
+
     def test_dynamic_reservation_refresh(self):
         nodes = [make_node("a", chips=4)]
         snapshot = Snapshot({n.name: NodeInfo(n.name, tpu=n) for n in nodes})

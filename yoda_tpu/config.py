@@ -57,6 +57,14 @@ class SchedulerConfig:
 
     mode: str = "batch"               # "batch" (fused kernel) | "loop"
     weights: Weights = field(default_factory=Weights)
+    # Upstream NodeResourcesFit scoringStrategy analog:
+    # "least-allocated" (default) prefers the freest qualifying node —
+    # spreads load, upstream's default; "most-allocated" inverts the
+    # free-leaning score terms (hbm_free / actual / allocate) to prefer the
+    # fullest node that still fits — bin-packing for saturation fleets
+    # (BASELINE config 3). Hardware-quality terms and the slice-protect
+    # tier keep their sign either way.
+    scoring_strategy: str = "least-allocated"
     gang_permit_timeout_s: float = 120.0
     max_metrics_age_s: float = 0.0    # 0 disables staleness filtering
     # Cap per-node score-plugin work to this % of feasible nodes (upstream
@@ -95,6 +103,11 @@ class SchedulerConfig:
                 "percentage_nodes_to_score must be an int in [1, 100], got "
                 f"{cfg.percentage_nodes_to_score!r}"
             )
+        if cfg.scoring_strategy not in ("least-allocated", "most-allocated"):
+            raise ValueError(
+                "scoring_strategy must be 'least-allocated' or "
+                f"'most-allocated', got {cfg.scoring_strategy!r}"
+            )
         if cfg.kernel_platform not in ("auto", "cpu", "device"):
             raise ValueError(
                 "kernel_platform must be 'auto', 'cpu' or 'device', "
@@ -109,3 +122,19 @@ class SchedulerConfig:
                 f"mesh_devices must be a positive int, got {cfg.mesh_devices!r}"
             )
         return cfg
+
+    def effective_weights(self) -> Weights:
+        """The weights the score path actually runs with: under
+        "most-allocated" the free-leaning terms are negated (a fuller node
+        scores higher), while hardware-quality terms (bandwidth, clock,
+        tflops, power, total HBM) and the slice-protect tier keep their
+        sign. User-facing weights stay non-negative (Weights.from_dict);
+        the sign is strategy-owned."""
+        if self.scoring_strategy != "most-allocated":
+            return self.weights
+        from dataclasses import replace
+
+        w = self.weights
+        return replace(
+            w, hbm_free=-w.hbm_free, actual=-w.actual, allocate=-w.allocate
+        )

@@ -271,9 +271,13 @@ def kernel_impl(
     raw = jnp.where(feasible, basic + actual + allocate, 0).astype(jnp.int32)
 
     # --- normalize (min-max to [0,100], all-equal guard) ---
+    # Fillers must sit outside BOTH reductions' ranges: raw scores can be
+    # negative under most-allocated's negated weights, so the `highest`
+    # filler is -big, not -1 (a -1 filler would beat an all-negative
+    # feasible set and crush the span).
     big = jnp.iinfo(jnp.int32).max
     lowest = jnp.min(jnp.where(feasible, raw, big))
-    highest = jnp.max(jnp.where(feasible, raw, -1))
+    highest = jnp.max(jnp.where(feasible, raw, -big))
     lowest = jnp.where(highest == lowest, lowest - 1, lowest)
     span = jnp.maximum(highest - lowest, 1)
     normalized = jnp.where(feasible, (raw - lowest) * 100 // span, 0).astype(jnp.int32)

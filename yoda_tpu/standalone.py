@@ -64,7 +64,7 @@ def build_stack(
 
     plugins = default_plugins(
         mode=config.mode,
-        weights=config.weights,
+        weights=config.effective_weights(),
         reserved_fn=accountant.chips_in_use,
         max_metrics_age_s=config.max_metrics_age_s,
         kernel_platform=config.kernel_platform,
