@@ -106,3 +106,39 @@ class TestStackAtScale:
         # 8 pods against 1024 nodes: the whole burst must stay well under
         # the 200 ms-per-pod BASELINE budget.
         assert dt_ms < 8 * 200, f"burst took {dt_ms:.0f} ms"
+
+    def test_gang_at_scale_is_one_dispatch(self):
+        """An 8-member gang against 1024 nodes: one kernel dispatch places
+        the whole gang (the batched-plan path must not degrade with fleet
+        size), and the burst stays within the per-pod budget."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.plugins.yoda import YodaBatch
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(N_NODES):
+            agent.add_host(f"h{i:04d}", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=120)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        batch = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        d0 = batch.dispatch_count
+
+        t0 = time.monotonic()
+        labels = {"tpu/gang": "big", "tpu/gang-size": "8", "tpu/chips": "8"}
+        for i in range(8):
+            stack.cluster.create_pod(PodSpec(f"g{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        pods = [p for p in stack.cluster.list_pods() if p.name.startswith("g")]
+        assert len(pods) == 8 and all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 8  # 8 chips each: 1/host
+        assert batch.dispatch_count == d0 + 1
+        assert batch.plan_served == 7
+        assert dt_ms < 8 * 200, f"gang burst took {dt_ms:.0f} ms"
