@@ -74,8 +74,11 @@ class Binder(BindPlugin):
         return Status.ok()
 
 
-def schedule_with(mode, nodes, pod, reserved_fn=None):
-    fw = Framework(default_plugins(mode=mode, reserved_fn=reserved_fn) + [Binder()])
+def schedule_with(mode, nodes, pod, reserved_fn=None, weights=None):
+    fw = Framework(
+        default_plugins(mode=mode, reserved_fn=reserved_fn, weights=weights)
+        + [Binder()]
+    )
     snapshot = Snapshot({n.name: NodeInfo(n.name, tpu=n) for n in nodes})
     q = SchedulingQueue(fw.queue_sort)
     sched = Scheduler(fw, lambda: snapshot, q)
@@ -85,12 +88,20 @@ def schedule_with(mode, nodes, pod, reserved_fn=None):
 
 class TestKernelParity:
     @pytest.mark.parametrize("seed", range(8))
-    def test_batch_and_loop_agree(self, seed):
+    @pytest.mark.parametrize("strategy", ["least-allocated", "most-allocated"])
+    def test_batch_and_loop_agree(self, seed, strategy):
+        from yoda_tpu.config import SchedulerConfig
+
+        w = SchedulerConfig(scoring_strategy=strategy).effective_weights()
         rng = random.Random(seed)
         nodes = random_fleet(rng, rng.randrange(3, 20))
         labels = random_labels(rng)
-        r_loop = schedule_with("loop", nodes, PodSpec("p", labels=dict(labels)))
-        r_batch = schedule_with("batch", nodes, PodSpec("p", labels=dict(labels)))
+        r_loop = schedule_with(
+            "loop", nodes, PodSpec("p", labels=dict(labels)), weights=w
+        )
+        r_batch = schedule_with(
+            "batch", nodes, PodSpec("p", labels=dict(labels)), weights=w
+        )
         assert r_loop.outcome == r_batch.outcome, (labels, r_loop, r_batch)
         if r_loop.outcome == "bound":
             assert r_loop.node == r_batch.node, (labels, r_loop, r_batch)
