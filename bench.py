@@ -210,6 +210,47 @@ def _device_probe() -> dict:
     return out
 
 
+def _fragmentation_scenario() -> dict:
+    """What scoring_strategy buys under partial load: 8 x 2-chip pods onto
+    4 x v5e-8 hosts, then ONE whole-host (8-chip) pod. least-allocated
+    spreads the small pods across all hosts (no whole host survives);
+    most-allocated packs them onto two hosts, keeping whole hosts free for
+    the big pod. Returns whether the 8-chip pod bound per strategy."""
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    out = {}
+    for key, strategy in (
+        ("frag_whole_host_least", "least-allocated"),
+        ("frag_whole_host_most", "most-allocated"),
+    ):
+        stack = build_stack(
+            config=SchedulerConfig(
+                mode="batch", scoring_strategy=strategy, enable_preemption=False
+            )
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(8):
+            # tpu/hbm makes the pods visible to the allocate/headroom score
+            # term immediately (claims need no metrics republish), so the
+            # strategies actually diverge: spread avoids claimed hosts,
+            # pack prefers them.
+            stack.cluster.create_pod(
+                PodSpec(f"small-{i}", labels={"tpu/chips": "2", "tpu/hbm": "4Gi"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        stack.cluster.create_pod(PodSpec("big", labels={"tpu/chips": "8"}))
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        big = stack.cluster.get_pod("default/big")
+        out[key] = int(big is not None and big.node_name is not None)
+    return out
+
+
 def _agent_hw_probe() -> dict:
     """What the node agent's runtime reader (agent/runtime.py) reads off
     THIS host's real TPU — recorded per round as evidence of which values
@@ -293,6 +334,8 @@ def run_bench() -> dict:
 
     efficiency = _binpack_scenario()
     print(f"binpack efficiency (saturated v5e-64): {efficiency:.3f}", file=sys.stderr)
+    frag = _fragmentation_scenario()
+    print(f"fragmentation (whole-host pod after partial load): {frag}", file=sys.stderr)
     mixed = _mixed_fleet_scenario()
     print(f"mixed-fleet contention (config 5): {mixed}", file=sys.stderr)
     probe = _device_probe()
@@ -310,6 +353,7 @@ def run_bench() -> dict:
         "vs_baseline": round(BASELINE_P99_MS / p99, 2),
         "p50_ms": round(p50, 2),
         "binpack_efficiency": round(efficiency, 4),
+        **frag,
         **mixed,
         **probe,
     }
