@@ -71,6 +71,52 @@ class TestSchedulerMode:
             msg="CLI scheduler bound the pod",
         )
 
+    def test_gang_binds_atomically_over_the_wire(self, server, run_main_bg):
+        """Gang scheduling end-to-end over real HTTP: 4 topology members
+        arrive via the watch, park at Permit, release together, and bind
+        one-per-host onto one slice — the full multi-pod interleaving
+        (watch ordering, permit callbacks, Events) through the production
+        wire path, not the in-memory fake."""
+        run_main_bg(["--metrics-port", "-1"])
+        seed = KubeCluster(
+            KubeApiClient(KubeApiConfig(base_url=server.base_url, watch_timeout_s=2))
+        )
+        for i in range(4):
+            seed.put_tpu_metrics(
+                make_node(
+                    f"s-{i}",
+                    chips=4,
+                    slice_id="wire-slice",
+                    topology_coords=(i % 2, i // 2, 0),
+                )
+            )
+        labels = {"tpu/gang": "wg", "tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(4):
+            seed.create_pod(PodSpec(f"wg-{i}", labels=dict(labels)))
+
+        def all_bound():
+            hosts = set()
+            for i in range(4):
+                obj = server.get_object("Pod", f"default/wg-{i}") or {}
+                node = obj.get("spec", {}).get("nodeName")
+                if not node:
+                    return False
+                hosts.add(node)
+            return len(hosts) == 4
+
+        _wait_until(all_bound, timeout_s=90.0, msg="gang bound over the wire")
+        # The Scheduled Events made it through the wire recorder too.
+        evs = [
+            server.get_object("Event", k)
+            for k in server.list_keys("Event")
+        ]
+        scheduled = {
+            e["involvedObject"]["name"]
+            for e in evs
+            if e.get("reason") == "Scheduled"
+        }
+        assert {f"wg-{i}" for i in range(4)} <= scheduled
+
     def test_bad_config_rejected(self, server, tmp_path):
         from yoda_tpu.cli import main
 
