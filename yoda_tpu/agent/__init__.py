@@ -8,6 +8,10 @@ hardware state and writes the node's CR. Two implementations:
   BASELINE "fake SCV CR" strategy) with simulated HBM consumption.
 - ``native``: ctypes bindings over the C++ host metrics reader
   (yoda_tpu/agent/native.py, native/ sources) for real nodes.
+- ``runtime``: live JAX/libtpu hardware reads (device identity, coords,
+  HBM counters where exposed) overlaid onto the native inventory
+  (``--runtime-probe``; see the libtpu-exclusivity caveat in
+  docs/OPERATIONS.md).
 """
 
 from yoda_tpu.agent.fake_publisher import CHIP_SPECS, ChipSpec, FakeTpuAgent
@@ -17,13 +21,21 @@ from yoda_tpu.agent.native import (
     collection_source,
     load_library,
 )
+from yoda_tpu.agent.runtime import (
+    RuntimeReading,
+    metrics_from_runtime,
+    read_runtime,
+)
 
 __all__ = [
     "CHIP_SPECS",
     "ChipSpec",
     "FakeTpuAgent",
     "NativeTpuAgent",
+    "RuntimeReading",
     "collect_host_metrics",
     "collection_source",
     "load_library",
+    "metrics_from_runtime",
+    "read_runtime",
 ]
