@@ -220,6 +220,34 @@ class TestGangRollbackEvents:
             assert victim_host in evs[0]["message"]
 
 
+class TestGangRollbackOnTimeout:
+    def test_timeout_cascade_emits_rollback_events(self):
+        """The OTHER cascade trigger: a member's permit wait expires (the
+        gang never completed). Every waiting member gets the gang-level
+        reason."""
+        stack = build_stack(
+            config=SchedulerConfig(gang_permit_timeout_s=0.05)
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(3):
+            agent.add_host(f"h{i}", chips=4)
+        agent.publish_all()
+        labels = {"tpu/gang": "t", "tpu/gang-size": "3", "tpu/chips": "4"}
+        for i in range(2):  # 2 of 3: the gang can never complete
+            stack.cluster.create_pod(PodSpec(f"t-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=20.0)
+        assert stack.events.flush()
+        rollbacks = [
+            e
+            for e in stack.cluster.list_events()
+            if e["reason"] == "GangRollback"
+        ]
+        assert rollbacks, "timeout cascade emitted no GangRollback events"
+        names = {e["involvedObject"]["name"] for e in rollbacks}
+        assert names <= {"t-0", "t-1"} and names
+        assert all("gang t:" in e["message"] for e in rollbacks)
+
+
 class TestWireEvents:
     """KubeCluster.write_event over real HTTP: POST on create, PUT on
     count aggregation, POST->PUT fallthrough on a 409 name collision."""
