@@ -188,12 +188,19 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             else:
                 # Plain gang: capacity estimate over free slots. This member
                 # plus the other remaining members must all fit somewhere.
+                # The scan short-circuits at `remaining` — admission only
+                # needs enough slots, not the fleet total, so on a
+                # 1024-node fleet with capacity it touches a handful of
+                # nodes instead of every one (the full count is still paid
+                # when the answer is "not enough", where it IS the answer).
                 deferred = []
-                slots = sum(
-                    self._member_slots(ni, req, exclude_hosts=set())
-                    for ni in snapshot.infos()
-                    if node_admits_pod(ni.node, pod.tolerations)[0]
-                )
+                slots = 0
+                for ni in snapshot.infos():
+                    if not node_admits_pod(ni.node, pod.tolerations)[0]:
+                        continue
+                    slots += self._member_slots(ni, req, exclude_hosts=set())
+                    if slots >= remaining:
+                        break
                 if slots < remaining:
                     st = Status.unschedulable(
                         f"gang {req.gang.name}: {remaining} members still "
