@@ -217,3 +217,112 @@ class TestPreemptionRespectsNodes:
         stack.scheduler.run_until_idle(max_wall_s=5)
         assert stack.cluster.get_pod("default/victim") is not None
         assert stack.cluster.get_pod("default/vip").node_name is None
+
+
+class TestNodeSelector:
+    """spec.nodeSelector enforcement (upstream NodeAffinity/
+    matchNodeSelector parity): how unmodified GKE TPU workloads steer onto
+    node pools via cloud.google.com/gke-tpu-* node labels."""
+
+    def test_selector_matches_and_mismatches(self):
+        node = K8sNode("n", labels={"pool": "tpu", "zone": "a"})
+        assert node_admits_pod(node, (), {"pool": "tpu"})[0]
+        assert node_admits_pod(node, (), {"pool": "tpu", "zone": "a"})[0]
+        ok, why = node_admits_pod(node, (), {"pool": "gpu"})
+        assert not ok and "nodeSelector" in why
+        ok, why = node_admits_pod(node, (), {"missing": "x"})
+        assert not ok
+
+    def test_selector_without_node_object_rejects(self):
+        """The scheduler is the enforcement point — an unverifiable
+        selector must not pass vacuously."""
+        ok, why = node_admits_pod(None, (), {"pool": "tpu"})
+        assert not ok and "unknown" in why
+        assert node_admits_pod(None, (), {})[0]  # no selector: vacuous
+
+    def test_selector_roundtrip(self):
+        pod = PodSpec("p", node_selector={"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"})
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.node_selector == pod.node_selector
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestNodeSelectorE2E:
+    def test_gke_style_steering(self, mode):
+        """A GKE-style pod (google.com/tpu limit + nodeSelector, zero
+        tpu/* labels) lands only on the node pool its selector names."""
+        stack, agent = make_stack(mode)
+        agent.add_host("v5e-pool-node", generation="v5e", chips=8)
+        agent.add_host("v5p-pool-node", generation="v5p", chips=4)
+        agent.publish_all()
+        stack.cluster.put_node(
+            K8sNode(
+                "v5e-pool-node",
+                labels={"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"},
+            )
+        )
+        stack.cluster.put_node(
+            K8sNode(
+                "v5p-pool-node",
+                labels={"cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice"},
+            )
+        )
+        pod = PodSpec(
+            "gke-pod",
+            tpu_resource_limit=4,
+            node_selector={"cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice"},
+        )
+        stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert (
+            stack.cluster.get_pod("default/gke-pod").node_name
+            == "v5p-pool-node"
+        )
+
+    def test_unsatisfiable_selector_pends_with_reason(self, mode):
+        stack, agent = make_stack(mode, enable_preemption=False)
+        agent.add_host("n1", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_node(K8sNode("n1", labels={"pool": "a"}))
+        stack.cluster.create_pod(
+            PodSpec("picky", labels={"tpu/chips": "1"}, node_selector={"pool": "b"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.cluster.get_pod("default/picky").node_name is None
+        # The FailedScheduling trail names the selector, not some
+        # capacity reason.
+        assert stack.events.flush()
+        evs = [
+            e
+            for e in stack.cluster.list_events()
+            if e["involvedObject"]["name"] == "picky"
+            and e["reason"] == "FailedScheduling"
+        ]
+        assert evs and "nodeSelector" in evs[-1]["message"], evs
+
+    def test_gang_honors_selector(self, mode):
+        """Gang members' selector restricts planning and placement to the
+        labeled pool. The non-matching pool's hosts sort LAST in the
+        tie-break (lexicographically greatest), so only enforcement — not
+        name order — can steer the members onto pool-b."""
+        stack, agent = make_stack(mode)
+        pools = {"pool-b-0": "b", "pool-b-1": "b", "pool-z-0": "z", "pool-z-1": "z"}
+        for h, pool in pools.items():
+            agent.add_host(h, generation="v5e", chips=4)
+            stack.cluster.put_node(K8sNode(h, labels={"pool": pool}))
+        agent.publish_all()
+        labels = {"tpu/gang": "sel", "tpu/gang-size": "2", "tpu/chips": "4"}
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"sel-{i}",
+                    labels=dict(labels),
+                    node_selector={"pool": "b"},
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        placements = {
+            stack.cluster.get_pod(f"default/sel-{i}").node_name
+            for i in range(2)
+        }
+        assert placements == {"pool-b-0", "pool-b-1"}

@@ -290,16 +290,32 @@ class K8sNode:
 
 
 def node_admits_pod(
-    node: "K8sNode | None", tolerations: Sequence[Toleration]
+    node: "K8sNode | None",
+    tolerations: Sequence[Toleration],
+    node_selector: Mapping[str, str] | None = None,
 ) -> tuple[bool, str]:
-    """Cordon + taint admission: can the pod be placed on the node at all?
+    """Cordon + taint + nodeSelector admission: can the pod be placed on
+    the node at all?
 
-    Mirrors what upstream kube-scheduler's NodeUnschedulable and
-    TaintToleration plugins give the reference for free via its snapshot
-    (reference pkg/yoda/scheduler.go:101). ``None`` (no Node object known —
-    e.g. a fake-cluster test without node records) admits. Only hard
-    effects reject: NoSchedule / NoExecute; PreferNoSchedule is a scoring
-    concern, not a filter."""
+    Mirrors what upstream kube-scheduler's NodeUnschedulable,
+    TaintToleration, and NodeAffinity(matchNodeSelector) plugins give the
+    reference for free via its snapshot (reference
+    pkg/yoda/scheduler.go:101). ``node is None`` (no Node object known —
+    e.g. a fake-cluster test without node records) admits UNLESS the pod
+    has a nodeSelector: the scheduler is the enforcement point for
+    selectors (kubelet does not re-check them), so an unverifiable
+    constraint must reject, not pass vacuously. Only hard taint effects
+    reject: NoSchedule / NoExecute; PreferNoSchedule is a scoring concern,
+    not a filter."""
+    if node_selector and (
+        node is None
+        or any(node.labels.get(k) != v for k, v in node_selector.items())
+    ):
+        return False, (
+            "node labels do not match the pod's nodeSelector"
+            if node is not None
+            else "pod has a nodeSelector but the node object is unknown"
+        )
     if node is None:
         return True, ""
     if node.unschedulable:
@@ -333,6 +349,11 @@ class PodSpec:
     phase: str = "Pending"
     uid: str = ""
     tolerations: list[Toleration] = field(default_factory=list)
+    # spec.nodeSelector — how unmodified GKE TPU workloads steer onto node
+    # pools (cloud.google.com/gke-tpu-accelerator / -topology node labels).
+    # Enforced by node_admits_pod against K8sNode.labels: the scheduler is
+    # the selector's enforcement point.
+    node_selector: dict[str, str] = field(default_factory=dict)
     # Sum of the containers' google.com/tpu resource limits — how
     # unmodified GKE TPU workloads request chips (requests.pod_request uses
     # it as the chip count when no tpu/chips label is present).
@@ -358,6 +379,8 @@ class PodSpec:
         }
         if self.tolerations:
             spec["tolerations"] = [t.to_obj() for t in self.tolerations]
+        if self.node_selector:
+            spec["nodeSelector"] = dict(self.node_selector)
         if self.spec_priority:
             spec["priority"] = self.spec_priority
         if self.tpu_resource_limit:
@@ -422,6 +445,7 @@ class PodSpec:
             tolerations=[
                 Toleration.from_obj(t) for t in spec.get("tolerations", [])
             ],
+            node_selector=dict(spec.get("nodeSelector") or {}),
             tpu_resource_limit=_tpu_limit_of(spec),
             spec_priority=int(spec.get("priority") or 0),
             **kwargs,

@@ -53,7 +53,8 @@ REASON_MESSAGES = {
     REASON_HBM: "not enough chips with free HBM",
     REASON_CLOCK: "not enough chips at requested clock",
     REASON_RESERVED: "qualifying chips reserved by in-flight pods",
-    REASON_NODE: "node is cordoned or has untolerated taints",
+    REASON_NODE: "node is cordoned, has untolerated taints, or does not "
+    "match the pod's nodeSelector",
 }
 
 # The kernel's input schema: FleetArrays fields, split by shape. [N] node

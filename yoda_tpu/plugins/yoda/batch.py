@@ -75,7 +75,9 @@ def _host_admission(
     masked by node_valid in the kernel, so their value is irrelevant."""
     ok = np.array(
         [
-            node_admits_pod(snapshot.get(name).node, pod.tolerations)[0]
+            node_admits_pod(
+                snapshot.get(name).node, pod.tolerations, pod.node_selector
+            )[0]
             if name in snapshot
             else True
             for name in static.names
@@ -107,6 +109,7 @@ class _GangPlan:
     request: KernelRequest              # members must request identically
     tolerations: tuple                  # ...and tolerate identically (the
                                         # dispatch's host_ok used pick 0's)
+    node_selector: tuple                # ...and select identically
     picks: list[str]                    # node per member, picks[0] = the
                                         # dispatching member's own placement
     base: dict[str, int]                # reserved_fn(node) at dispatch time
@@ -360,6 +363,7 @@ class YodaBatch(BatchFilterScorePlugin):
             snapshot_version=snapshot.version,
             request=reqk,
             tolerations=tuple(pod.tolerations),
+            node_selector=tuple(sorted(pod.node_selector.items())),
             picks=picks,
             # Copies: the runtime owns and may mutate the returned dicts
             # (single-plugin hot path writes FilterPlugin rejections in).
@@ -396,6 +400,7 @@ class YodaBatch(BatchFilterScorePlugin):
             snapshot.version != plan.snapshot_version
             or reqk != plan.request  # members must be requesting identically
             or tuple(pod.tolerations) != plan.tolerations  # and tolerating
+            or tuple(sorted(pod.node_selector.items())) != plan.node_selector
         ):
             self._invalidate_plan(gang)
             return None

@@ -230,7 +230,9 @@ class YodaFilter(FilterPlugin):
         # every capacity question moot (the reference gets this from its
         # upstream snapshot's NodeUnschedulable/TaintToleration plugins,
         # reference pkg/yoda/scheduler.go:101).
-        admitted, why = node_admits_pod(node.node, pod.tolerations)
+        admitted, why = node_admits_pod(
+            node.node, pod.tolerations, pod.node_selector
+        )
         if not admitted:
             return Status.unschedulable(f"node {node.name}: {why}")
         tpu = node.tpu

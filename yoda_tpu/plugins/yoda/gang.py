@@ -137,12 +137,17 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         return max(avail // max(req.effective_chips, 1), 0)
 
     def _host_fits_member(
-        self, ni: NodeInfo, req, assigned_hosts: set[str], tolerations=()
+        self,
+        ni: NodeInfo,
+        req,
+        assigned_hosts: set[str],
+        tolerations=(),
+        node_selector=None,
     ) -> bool:
-        # Node-object admission (cordon / untolerated taints) gates planning
-        # the same way it gates Filter — a planned block must never include
-        # a host the members cannot bind to.
-        if not node_admits_pod(ni.node, tolerations)[0]:
+        # Node-object admission (cordon / untolerated taints / nodeSelector)
+        # gates planning the same way it gates Filter — a planned block must
+        # never include a host the members cannot bind to.
+        if not node_admits_pod(ni.node, tolerations, node_selector)[0]:
             return False
         return self._member_slots(ni, req, exclude_hosts=assigned_hosts) >= 1
 
@@ -196,7 +201,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 deferred = []
                 slots = 0
                 for ni in snapshot.infos():
-                    if not node_admits_pod(ni.node, pod.tolerations)[0]:
+                    if not node_admits_pod(
+                        ni.node, pod.tolerations, pod.node_selector
+                    )[0]:
                         continue
                     slots += self._member_slots(ni, req, exclude_hosts=set())
                     if slots >= remaining:
@@ -265,7 +272,8 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             or not plan_hosts_free
             or not all(
                 self._host_fits_member(
-                    snapshot.get(h), req, assigned_hosts, pod.tolerations
+                    snapshot.get(h), req, assigned_hosts, pod.tolerations,
+                    pod.node_selector,
                 )
                 for h in plan_hosts_free
                 if h in snapshot
@@ -301,7 +309,8 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 snapshot,
                 want_dims=gs.spec.topology,
                 host_ok=lambda ni: self._host_fits_member(
-                    ni, req, assigned_hosts, pod.tolerations
+                    ni, req, assigned_hosts, pod.tolerations,
+                    pod.node_selector,
                 ),
                 pinned=pinned,
             )

@@ -149,6 +149,7 @@ class TpuPreemption(PostFilterPlugin):
         ni: NodeInfo,
         req: TpuRequest,
         tolerations: tuple[Toleration, ...] = (),
+        node_selector=None,
     ) -> bool:
         """Eviction can only ever help on nodes the preemptor could pass
         Filter on once capacity frees up — generation is immutable
@@ -158,7 +159,7 @@ class TpuPreemption(PostFilterPlugin):
         return (
             ni.tpu is not None
             and ni.tpu.generation_rank >= req.min_generation_rank
-            and node_admits_pod(ni.node, tolerations)[0]
+            and node_admits_pod(ni.node, tolerations, node_selector)[0]
         )
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
@@ -214,10 +215,11 @@ class TpuPreemption(PostFilterPlugin):
         needed: int,
         max_priority: int,
         tolerations: tuple[Toleration, ...] = (),
+        node_selector=None,
     ) -> list[Victim] | None:
         """Smallest eviction-ordered victim prefix making ``needed`` member
         slots of ``req`` available on the node, or None."""
-        if not self._node_eligible(ni, req, tolerations):
+        if not self._node_eligible(ni, req, tolerations, node_selector):
             return None
         victims = self._victims_on(ni, max_priority)
         chosen: list[Victim] = []
@@ -254,7 +256,7 @@ class TpuPreemption(PostFilterPlugin):
         best: tuple[tuple[int, int, int, str], list[Victim], str] | None = None
         for ni in snapshot.infos():
             victims = self._minimal_set(
-                ni, req, 1, req.priority, tuple(pod.tolerations)
+                ni, req, 1, req.priority, tuple(pod.tolerations), pod.node_selector
             )
             if victims is None or not victims:
                 continue
@@ -304,7 +306,7 @@ class TpuPreemption(PostFilterPlugin):
         slots = 0
         tols = tuple(pod.tolerations)
         for ni in snapshot.infos():
-            if not self._node_eligible(ni, req, tols):
+            if not self._node_eligible(ni, req, tols, pod.node_selector):
                 continue
             slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
             per_node[ni.name] = self._victims_on(ni, req.priority)
@@ -326,13 +328,18 @@ class TpuPreemption(PostFilterPlugin):
                     continue
                 ni = snapshot.get(name)
                 freed = freed_by_node.get(name, 0)
-                base = self._member_slots_after(ni, req, freed, tols)
+                base = self._member_slots_after(
+                    ni, req, freed, tols, pod.node_selector
+                )
                 acc, prefix = 0, []
                 for v in vs:
                     prefix.append(v)
                     acc += v.chips
                     gained = (
-                        self._member_slots_after(ni, req, freed + acc, tols) - base
+                        self._member_slots_after(
+                            ni, req, freed + acc, tols, pod.node_selector
+                        )
+                        - base
                     )
                     if gained > 0:
                         cost = (
@@ -376,8 +383,9 @@ class TpuPreemption(PostFilterPlugin):
         req: TpuRequest,
         freed: int,
         tolerations: tuple[Toleration, ...] = (),
+        node_selector=None,
     ) -> int:
-        if not self._node_eligible(ni, req, tolerations):
+        if not self._node_eligible(ni, req, tolerations, node_selector):
             return 0
         return self._avail_after(ni, req, freed) // max(req.effective_chips, 1)
 
@@ -400,7 +408,8 @@ class TpuPreemption(PostFilterPlugin):
             if h not in snapshot:
                 continue
             vs = self._minimal_set(
-                snapshot.get(h), req, 1, req.priority, tuple(pod.tolerations)
+                snapshot.get(h), req, 1, req.priority, tuple(pod.tolerations),
+                pod.node_selector,
             )
             if vs is None:
                 continue
@@ -446,7 +455,9 @@ class TpuPreemption(PostFilterPlugin):
 
         def host_ok(ni: NodeInfo) -> bool:
             if ni.name not in sets:
-                sets[ni.name] = self._minimal_set(ni, req, 1, req.priority, tols)
+                sets[ni.name] = self._minimal_set(
+                    ni, req, 1, req.priority, tols, pod.node_selector
+                )
             return sets[ni.name] is not None
 
         plan = plan_slice_placement(
