@@ -326,3 +326,114 @@ class TestNodeSelectorE2E:
             for i in range(2)
         }
         assert placements == {"pool-b-0", "pool-b-1"}
+
+
+class TestNodeAffinity:
+    """Required node affinity (spec.affinity.nodeAffinity.required...):
+    terms OR together, a term's matchExpressions AND together, operators
+    match upstream labels.Selector semantics."""
+
+    def test_operators(self):
+        from yoda_tpu.api.types import NodeSelectorRequirement as R
+
+        labels = {"pool": "tpu", "gen": "5"}
+        assert R("pool", "In", ("tpu", "gpu")).matches(labels)
+        assert not R("pool", "In", ("gpu",)).matches(labels)
+        assert not R("missing", "In", ("x",)).matches(labels)
+        assert R("pool", "NotIn", ("gpu",)).matches(labels)
+        assert R("missing", "NotIn", ("x",)).matches(labels)  # absent matches
+        assert R("pool", "Exists").matches(labels)
+        assert not R("missing", "Exists").matches(labels)
+        assert R("missing", "DoesNotExist").matches(labels)
+        assert R("gen", "Gt", ("4",)).matches(labels)
+        assert not R("gen", "Gt", ("5",)).matches(labels)
+        assert R("gen", "Lt", ("6",)).matches(labels)
+        assert not R("pool", "Gt", ("1",)).matches(labels)  # non-int value
+        assert not R("pool", "Frobnicate", ("x",)).matches(labels)  # closed
+
+    def test_terms_or_expressions_and(self):
+        from yoda_tpu.api.types import (
+            NodeSelectorRequirement as R,
+            NodeSelectorTerm as T,
+        )
+
+        terms = (
+            T((R("pool", "In", ("a",)), R("zone", "In", ("z1",)))),
+            T((R("pool", "In", ("b",)),)),
+        )
+        node_a_z1 = K8sNode("n", labels={"pool": "a", "zone": "z1"})
+        node_a_z2 = K8sNode("n", labels={"pool": "a", "zone": "z2"})
+        node_b = K8sNode("n", labels={"pool": "b"})
+        assert node_admits_pod(node_a_z1, (), None, terms)[0]
+        assert not node_admits_pod(node_a_z2, (), None, terms)[0]  # AND fails
+        assert node_admits_pod(node_b, (), None, terms)[0]         # OR holds
+        ok, why = node_admits_pod(None, (), None, terms)
+        assert not ok and "unknown" in why  # unverifiable: fail closed
+
+    def test_affinity_roundtrip(self):
+        from yoda_tpu.api.types import (
+            NodeSelectorRequirement as R,
+            NodeSelectorTerm as T,
+        )
+
+        pod = PodSpec(
+            "p",
+            node_affinity=(
+                T((R("cloud.google.com/gke-tpu-topology", "In", ("2x2x1",)),)),
+            ),
+        )
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.node_affinity == pod.node_affinity
+        # Explicit null affinity subtrees deserialize as "no constraint".
+        obj = pod.to_obj()
+        obj["spec"]["affinity"] = None
+        assert PodSpec.from_obj(obj).node_affinity == ()
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_affinity_steers_e2e(self, mode):
+        from yoda_tpu.api.types import (
+            NodeSelectorRequirement as R,
+            NodeSelectorTerm as T,
+        )
+
+        stack, agent = make_stack(mode)
+        # "z" sorts above "a": only enforcement can pick the a-pool node.
+        agent.add_host("pool-a-node", generation="v5e", chips=8)
+        agent.add_host("pool-z-node", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_node(K8sNode("pool-a-node", labels={"pool": "a"}))
+        stack.cluster.put_node(K8sNode("pool-z-node", labels={"pool": "z"}))
+        stack.cluster.create_pod(
+            PodSpec(
+                "affine",
+                labels={"tpu/chips": "1"},
+                node_affinity=(T((R("pool", "In", ("a",)),)),),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert (
+            stack.cluster.get_pod("default/affine").node_name == "pool-a-node"
+        )
+
+    def test_match_fields_and_empty_term(self):
+        """matchFields keys on metadata.name (the DaemonSet node-pinning
+        pattern); an EMPTY term matches nothing (upstream semantics), and
+        unknown field keys fail closed."""
+        from yoda_tpu.api.types import (
+            NodeSelectorRequirement as R,
+            NodeSelectorTerm as T,
+        )
+
+        pin = T(match_fields=(R("metadata.name", "In", ("node-x",)),))
+        node_x = K8sNode("node-x", labels={})
+        node_y = K8sNode("node-y", labels={})
+        assert node_admits_pod(node_x, (), None, (pin,))[0]
+        assert not node_admits_pod(node_y, (), None, (pin,))[0]
+        # Empty term: matches no node — a hard constraint never fails open.
+        assert not node_admits_pod(node_x, (), None, (T(),))[0]
+        # Unknown field key: fail closed.
+        bad = T(match_fields=(R("metadata.uid", "In", ("u",)),))
+        assert not node_admits_pod(node_x, (), None, (bad,))[0]
+        # Round-trip preserves matchFields.
+        pod = PodSpec("p", node_affinity=(pin,))
+        assert PodSpec.from_obj(pod.to_obj()).node_affinity == (pin,)

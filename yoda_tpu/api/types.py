@@ -239,6 +239,100 @@ class Toleration:
         )
 
 
+@dataclass(frozen=True)
+class NodeSelectorRequirement:
+    """One matchExpressions entry of a v1.NodeSelectorTerm. Operator
+    semantics mirror upstream labels.Selector: NotIn and DoesNotExist also
+    match nodes MISSING the key; Gt/Lt compare single integer values;
+    unknown operators fail closed."""
+
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: tuple[str, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        v = labels.get(self.key)
+        op = self.operator
+        if op == "In":
+            return v is not None and v in self.values
+        if op == "NotIn":
+            return v is None or v not in self.values
+        if op == "Exists":
+            return v is not None
+        if op == "DoesNotExist":
+            return v is None
+        if op in ("Gt", "Lt"):
+            if v is None or not self.values:
+                return False
+            try:
+                have, want = int(v), int(self.values[0])
+            except ValueError:
+                return False
+            return have > want if op == "Gt" else have < want
+        return False
+
+    def to_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"key": self.key, "operator": self.operator}
+        if self.values:
+            out["values"] = list(self.values)
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "NodeSelectorRequirement":
+        return cls(
+            key=obj.get("key", ""),
+            operator=obj.get("operator", ""),
+            values=tuple(obj.get("values") or ()),
+        )
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    """A v1.NodeSelectorTerm: matchExpressions and matchFields AND
+    together within the term; terms OR together at the affinity level.
+    Upstream semantics: an EMPTY term matches no objects, and the only
+    valid matchFields key is ``metadata.name`` (evaluated against the
+    node's name); anything else fails closed."""
+
+    match_expressions: tuple[NodeSelectorRequirement, ...] = ()
+    match_fields: tuple[NodeSelectorRequirement, ...] = ()
+
+    def matches(self, labels: Mapping[str, str], node_name: str = "") -> bool:
+        if not self.match_expressions and not self.match_fields:
+            return False  # upstream: an empty term selects nothing
+        if not all(r.matches(labels) for r in self.match_expressions):
+            return False
+        for f in self.match_fields:
+            if f.key != "metadata.name":
+                return False  # the only upstream-valid field key
+            if not f.matches({"metadata.name": node_name}):
+                return False
+        return True
+
+    def to_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.match_expressions:
+            out["matchExpressions"] = [
+                r.to_obj() for r in self.match_expressions
+            ]
+        if self.match_fields:
+            out["matchFields"] = [r.to_obj() for r in self.match_fields]
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "NodeSelectorTerm":
+        return cls(
+            match_expressions=tuple(
+                NodeSelectorRequirement.from_obj(r)
+                for r in obj.get("matchExpressions") or ()
+            ),
+            match_fields=tuple(
+                NodeSelectorRequirement.from_obj(r)
+                for r in obj.get("matchFields") or ()
+            ),
+        )
+
+
 @dataclass
 class K8sNode:
     """The scheduler-relevant slice of a v1.Node.
@@ -293,20 +387,21 @@ def node_admits_pod(
     node: "K8sNode | None",
     tolerations: Sequence[Toleration],
     node_selector: Mapping[str, str] | None = None,
+    node_affinity: Sequence[NodeSelectorTerm] = (),
 ) -> tuple[bool, str]:
-    """Cordon + taint + nodeSelector admission: can the pod be placed on
-    the node at all?
+    """Cordon + taint + nodeSelector + required-node-affinity admission:
+    can the pod be placed on the node at all?
 
     Mirrors what upstream kube-scheduler's NodeUnschedulable,
-    TaintToleration, and NodeAffinity(matchNodeSelector) plugins give the
-    reference for free via its snapshot (reference
-    pkg/yoda/scheduler.go:101). ``node is None`` (no Node object known —
-    e.g. a fake-cluster test without node records) admits UNLESS the pod
-    has a nodeSelector: the scheduler is the enforcement point for
-    selectors (kubelet does not re-check them), so an unverifiable
-    constraint must reject, not pass vacuously. Only hard taint effects
-    reject: NoSchedule / NoExecute; PreferNoSchedule is a scoring concern,
-    not a filter."""
+    TaintToleration, and NodeAffinity plugins give the reference for free
+    via its snapshot (reference pkg/yoda/scheduler.go:101). ``node is
+    None`` (no Node object known — e.g. a fake-cluster test without node
+    records) admits UNLESS the pod has a selector/affinity constraint:
+    the scheduler is the enforcement point for those (kubelet does not
+    re-check them), so an unverifiable constraint must reject, not pass
+    vacuously. Only hard taint effects reject: NoSchedule / NoExecute;
+    PreferNoSchedule (and preferred affinity) are scoring concerns, not
+    filters."""
     if node_selector and (
         node is None
         or any(node.labels.get(k) != v for k, v in node_selector.items())
@@ -316,6 +411,17 @@ def node_admits_pod(
             if node is not None
             else "pod has a nodeSelector but the node object is unknown"
         )
+    if node_affinity:
+        # Terms OR; a term's matchExpressions AND (upstream semantics).
+        if node is None:
+            return False, (
+                "pod has required node affinity but the node object is "
+                "unknown"
+            )
+        if not any(t.matches(node.labels, node.name) for t in node_affinity):
+            return False, (
+                "node labels do not match the pod's required node affinity"
+            )
     if node is None:
         return True, ""
     if node.unschedulable:
@@ -326,6 +432,15 @@ def node_admits_pod(
         if not any(t.tolerates(taint) for t in tolerations):
             return False, f"node has untolerated taint {taint.key}:{taint.effect}"
     return True, ""
+
+
+def pod_admits_on(node: "K8sNode | None", pod: "PodSpec") -> tuple[bool, str]:
+    """:func:`node_admits_pod` with the pod's own constraint set — the
+    form every scheduler-side caller wants (filter, batch admission
+    vector, gang planning, preemption eligibility)."""
+    return node_admits_pod(
+        node, pod.tolerations, pod.node_selector, pod.node_affinity
+    )
 
 
 _pod_seq = itertools.count()
@@ -354,6 +469,10 @@ class PodSpec:
     # Enforced by node_admits_pod against K8sNode.labels: the scheduler is
     # the selector's enforcement point.
     node_selector: dict[str, str] = field(default_factory=dict)
+    # spec.affinity.nodeAffinity.requiredDuringSchedulingIgnoredDuring
+    # Execution.nodeSelectorTerms — the hard-affinity terms (OR of terms,
+    # AND within a term). Preferred affinity is not modeled (scoring-only).
+    node_affinity: tuple[NodeSelectorTerm, ...] = ()
     # Sum of the containers' google.com/tpu resource limits — how
     # unmodified GKE TPU workloads request chips (requests.pod_request uses
     # it as the chip count when no tpu/chips label is present).
@@ -381,6 +500,16 @@ class PodSpec:
             spec["tolerations"] = [t.to_obj() for t in self.tolerations]
         if self.node_selector:
             spec["nodeSelector"] = dict(self.node_selector)
+        if self.node_affinity:
+            spec["affinity"] = {
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            t.to_obj() for t in self.node_affinity
+                        ]
+                    }
+                }
+            }
         if self.spec_priority:
             spec["priority"] = self.spec_priority
         if self.tpu_resource_limit:
@@ -446,6 +575,15 @@ class PodSpec:
                 Toleration.from_obj(t) for t in spec.get("tolerations", [])
             ],
             node_selector=dict(spec.get("nodeSelector") or {}),
+            node_affinity=tuple(
+                NodeSelectorTerm.from_obj(t)
+                for t in (
+                    ((spec.get("affinity") or {}).get("nodeAffinity") or {})
+                    .get("requiredDuringSchedulingIgnoredDuringExecution")
+                    or {}
+                ).get("nodeSelectorTerms")
+                or ()
+            ),
             tpu_resource_limit=_tpu_limit_of(spec),
             spec_priority=int(spec.get("priority") or 0),
             **kwargs,

@@ -54,7 +54,7 @@ REASON_MESSAGES = {
     REASON_CLOCK: "not enough chips at requested clock",
     REASON_RESERVED: "qualifying chips reserved by in-flight pods",
     REASON_NODE: "node is cordoned, has untolerated taints, or does not "
-    "match the pod's nodeSelector",
+    "match the pod's nodeSelector/required node affinity",
 }
 
 # The kernel's input schema: FleetArrays fields, split by shape. [N] node

@@ -33,7 +33,7 @@ import numpy as np
 
 log = logging.getLogger("yoda_tpu.batch")
 
-from yoda_tpu.api.types import PodSpec, node_admits_pod
+from yoda_tpu.api.types import PodSpec, pod_admits_on
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import BatchFilterScorePlugin, Snapshot, Status
 from yoda_tpu.ops.arrays import FleetArrays, bucket_rows
@@ -75,9 +75,7 @@ def _host_admission(
     masked by node_valid in the kernel, so their value is irrelevant."""
     ok = np.array(
         [
-            node_admits_pod(
-                snapshot.get(name).node, pod.tolerations, pod.node_selector
-            )[0]
+            pod_admits_on(snapshot.get(name).node, pod)[0]
             if name in snapshot
             else True
             for name in static.names
@@ -110,6 +108,7 @@ class _GangPlan:
     tolerations: tuple                  # ...and tolerate identically (the
                                         # dispatch's host_ok used pick 0's)
     node_selector: tuple                # ...and select identically
+    node_affinity: tuple                # ...and require identically
     picks: list[str]                    # node per member, picks[0] = the
                                         # dispatching member's own placement
     base: dict[str, int]                # reserved_fn(node) at dispatch time
@@ -364,6 +363,7 @@ class YodaBatch(BatchFilterScorePlugin):
             request=reqk,
             tolerations=tuple(pod.tolerations),
             node_selector=tuple(sorted(pod.node_selector.items())),
+            node_affinity=tuple(pod.node_affinity),
             picks=picks,
             # Copies: the runtime owns and may mutate the returned dicts
             # (single-plugin hot path writes FilterPlugin rejections in).
@@ -401,6 +401,7 @@ class YodaBatch(BatchFilterScorePlugin):
             or reqk != plan.request  # members must be requesting identically
             or tuple(pod.tolerations) != plan.tolerations  # and tolerating
             or tuple(sorted(pod.node_selector.items())) != plan.node_selector
+            or tuple(pod.node_affinity) != plan.node_affinity
         ):
             self._invalidate_plan(gang)
             return None

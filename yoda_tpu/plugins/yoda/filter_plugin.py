@@ -24,7 +24,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
-from yoda_tpu.api.types import TpuChip, TpuNodeMetrics, node_admits_pod
+from yoda_tpu.api.types import TpuChip, TpuNodeMetrics, pod_admits_on
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
     FilterPlugin,
@@ -230,9 +230,7 @@ class YodaFilter(FilterPlugin):
         # every capacity question moot (the reference gets this from its
         # upstream snapshot's NodeUnschedulable/TaintToleration plugins,
         # reference pkg/yoda/scheduler.go:101).
-        admitted, why = node_admits_pod(
-            node.node, pod.tolerations, pod.node_selector
-        )
+        admitted, why = pod_admits_on(node.node, pod)
         if not admitted:
             return Status.unschedulable(f"node {node.name}: {why}")
         tpu = node.tpu

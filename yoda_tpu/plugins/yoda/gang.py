@@ -44,7 +44,7 @@ from typing import Callable
 import logging
 
 from yoda_tpu.api.requests import GangSpec
-from yoda_tpu.api.types import PodSpec, node_admits_pod
+from yoda_tpu.api.types import PodSpec, pod_admits_on
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
@@ -137,17 +137,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         return max(avail // max(req.effective_chips, 1), 0)
 
     def _host_fits_member(
-        self,
-        ni: NodeInfo,
-        req,
-        assigned_hosts: set[str],
-        tolerations=(),
-        node_selector=None,
+        self, ni: NodeInfo, req, assigned_hosts: set[str], pod: PodSpec
     ) -> bool:
-        # Node-object admission (cordon / untolerated taints / nodeSelector)
-        # gates planning the same way it gates Filter — a planned block must
-        # never include a host the members cannot bind to.
-        if not node_admits_pod(ni.node, tolerations, node_selector)[0]:
+        # Node-object admission (cordon / untolerated taints / selector /
+        # required affinity) gates planning the same way it gates Filter —
+        # a planned block must never include a host the members cannot
+        # bind to.
+        if not pod_admits_on(ni.node, pod)[0]:
             return False
         return self._member_slots(ni, req, exclude_hosts=assigned_hosts) >= 1
 
@@ -201,9 +197,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 deferred = []
                 slots = 0
                 for ni in snapshot.infos():
-                    if not node_admits_pod(
-                        ni.node, pod.tolerations, pod.node_selector
-                    )[0]:
+                    if not pod_admits_on(ni.node, pod)[0]:
                         continue
                     slots += self._member_slots(ni, req, exclude_hosts=set())
                     if slots >= remaining:
@@ -272,8 +266,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             or not plan_hosts_free
             or not all(
                 self._host_fits_member(
-                    snapshot.get(h), req, assigned_hosts, pod.tolerations,
-                    pod.node_selector,
+                    snapshot.get(h), req, assigned_hosts, pod
                 )
                 for h in plan_hosts_free
                 if h in snapshot
@@ -309,8 +302,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 snapshot,
                 want_dims=gs.spec.topology,
                 host_ok=lambda ni: self._host_fits_member(
-                    ni, req, assigned_hosts, pod.tolerations,
-                    pod.node_selector,
+                    ni, req, assigned_hosts, pod
                 ),
                 pinned=pinned,
             )

@@ -46,7 +46,7 @@ from dataclasses import dataclass
 from typing import Callable, Mapping
 
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
-from yoda_tpu.api.types import PodSpec, Toleration, node_admits_pod
+from yoda_tpu.api.types import PodSpec, pod_admits_on
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
     NodeInfo,
@@ -145,11 +145,7 @@ class TpuPreemption(PostFilterPlugin):
         return out
 
     def _node_eligible(
-        self,
-        ni: NodeInfo,
-        req: TpuRequest,
-        tolerations: tuple[Toleration, ...] = (),
-        node_selector=None,
+        self, ni: NodeInfo, req: TpuRequest, pod: PodSpec
     ) -> bool:
         """Eviction can only ever help on nodes the preemptor could pass
         Filter on once capacity frees up — generation is immutable
@@ -159,7 +155,7 @@ class TpuPreemption(PostFilterPlugin):
         return (
             ni.tpu is not None
             and ni.tpu.generation_rank >= req.min_generation_rank
-            and node_admits_pod(ni.node, tolerations, node_selector)[0]
+            and pod_admits_on(ni.node, pod)[0]
         )
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
@@ -214,12 +210,11 @@ class TpuPreemption(PostFilterPlugin):
         req: TpuRequest,
         needed: int,
         max_priority: int,
-        tolerations: tuple[Toleration, ...] = (),
-        node_selector=None,
+        pod: PodSpec,
     ) -> list[Victim] | None:
         """Smallest eviction-ordered victim prefix making ``needed`` member
         slots of ``req`` available on the node, or None."""
-        if not self._node_eligible(ni, req, tolerations, node_selector):
+        if not self._node_eligible(ni, req, pod):
             return None
         victims = self._victims_on(ni, max_priority)
         chosen: list[Victim] = []
@@ -256,7 +251,7 @@ class TpuPreemption(PostFilterPlugin):
         best: tuple[tuple[int, int, int, str], list[Victim], str] | None = None
         for ni in snapshot.infos():
             victims = self._minimal_set(
-                ni, req, 1, req.priority, tuple(pod.tolerations), pod.node_selector
+                ni, req, 1, req.priority, pod
             )
             if victims is None or not victims:
                 continue
@@ -304,9 +299,8 @@ class TpuPreemption(PostFilterPlugin):
         # Plain gang: evict globally-cheapest victims until enough slots.
         per_node: dict[str, list[Victim]] = {}
         slots = 0
-        tols = tuple(pod.tolerations)
         for ni in snapshot.infos():
-            if not self._node_eligible(ni, req, tols, pod.node_selector):
+            if not self._node_eligible(ni, req, pod):
                 continue
             slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
             per_node[ni.name] = self._victims_on(ni, req.priority)
@@ -328,17 +322,13 @@ class TpuPreemption(PostFilterPlugin):
                     continue
                 ni = snapshot.get(name)
                 freed = freed_by_node.get(name, 0)
-                base = self._member_slots_after(
-                    ni, req, freed, tols, pod.node_selector
-                )
+                base = self._member_slots_after(ni, req, freed, pod)
                 acc, prefix = 0, []
                 for v in vs:
                     prefix.append(v)
                     acc += v.chips
                     gained = (
-                        self._member_slots_after(
-                            ni, req, freed + acc, tols, pod.node_selector
-                        )
+                        self._member_slots_after(ni, req, freed + acc, pod)
                         - base
                     )
                     if gained > 0:
@@ -382,10 +372,9 @@ class TpuPreemption(PostFilterPlugin):
         ni: NodeInfo,
         req: TpuRequest,
         freed: int,
-        tolerations: tuple[Toleration, ...] = (),
-        node_selector=None,
+        pod: PodSpec,
     ) -> int:
-        if not self._node_eligible(ni, req, tolerations, node_selector):
+        if not self._node_eligible(ni, req, pod):
             return 0
         return self._avail_after(ni, req, freed) // max(req.effective_chips, 1)
 
@@ -408,8 +397,7 @@ class TpuPreemption(PostFilterPlugin):
             if h not in snapshot:
                 continue
             vs = self._minimal_set(
-                snapshot.get(h), req, 1, req.priority, tuple(pod.tolerations),
-                pod.node_selector,
+                snapshot.get(h), req, 1, req.priority, pod
             )
             if vs is None:
                 continue
@@ -451,13 +439,9 @@ class TpuPreemption(PostFilterPlugin):
         # block search; the chosen block reuses them below.
         sets: dict[str, list[Victim] | None] = {}
 
-        tols = tuple(pod.tolerations)
-
         def host_ok(ni: NodeInfo) -> bool:
             if ni.name not in sets:
-                sets[ni.name] = self._minimal_set(
-                    ni, req, 1, req.priority, tols, pod.node_selector
-                )
+                sets[ni.name] = self._minimal_set(ni, req, 1, req.priority, pod)
             return sets[ni.name] is not None
 
         plan = plan_slice_placement(
