@@ -437,3 +437,97 @@ class TestNodeAffinity:
         # Round-trip preserves matchFields.
         pod = PodSpec("p", node_affinity=(pin,))
         assert PodSpec.from_obj(pod.to_obj()).node_affinity == (pin,)
+
+
+class TestPreferredAffinity:
+    """Soft steering (preferredDuringScheduling...): a scoring term, not a
+    filter — unmatched preferences degrade gracefully."""
+
+    def _prefs(self, pool, weight=10):
+        from yoda_tpu.api.types import (
+            NodeSelectorRequirement as R,
+            NodeSelectorTerm as T,
+        )
+
+        return ((weight, T((R("pool", "In", (pool,)),))),)
+
+    def test_score_fraction(self):
+        from yoda_tpu.api.types import preferred_affinity_score
+
+        pod = PodSpec("p", preferred_node_affinity=self._prefs("a"))
+        assert preferred_affinity_score(K8sNode("n", labels={"pool": "a"}), pod) == 100
+        assert preferred_affinity_score(K8sNode("n", labels={"pool": "z"}), pod) == 0
+        assert preferred_affinity_score(None, pod) == 0  # soft: no reject
+        assert preferred_affinity_score(K8sNode("n"), PodSpec("q")) == 0
+
+    def test_roundtrip(self):
+        pod = PodSpec("p", preferred_node_affinity=self._prefs("a", 7))
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.preferred_node_affinity == pod.preferred_node_affinity
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_preference_steers_but_never_blocks(self, mode):
+        stack, agent = make_stack(mode)
+        # "z" wins the tie-break; only the preference can steer onto "a".
+        agent.add_host("pool-a-node", generation="v5e", chips=8)
+        agent.add_host("pool-z-node", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_node(K8sNode("pool-a-node", labels={"pool": "a"}))
+        stack.cluster.put_node(K8sNode("pool-z-node", labels={"pool": "z"}))
+        stack.cluster.create_pod(
+            PodSpec(
+                "soft",
+                labels={"tpu/chips": "8"},
+                preferred_node_affinity=self._prefs("a"),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert (
+            stack.cluster.get_pod("default/soft").node_name == "pool-a-node"
+        )
+        # Preferred pool full: the next preferring pod still schedules
+        # (soft, not a filter) — onto the other node.
+        stack.cluster.create_pod(
+            PodSpec(
+                "soft-2",
+                labels={"tpu/chips": "8"},
+                preferred_node_affinity=self._prefs("a"),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert (
+            stack.cluster.get_pod("default/soft-2").node_name == "pool-z-node"
+        )
+
+    def test_gang_plan_respects_preference(self):
+        """The plan's picks rank by the SAME preference-adjusted score the
+        driver uses: a gang preferring pool-a lands there, one dispatch."""
+        from yoda_tpu.plugins.yoda import YodaBatch
+
+        stack, agent = make_stack()
+        for h in ("pa-0", "pa-1", "pz-0", "pz-1"):
+            agent.add_host(h, generation="v5e", chips=4)
+            stack.cluster.put_node(
+                K8sNode(h, labels={"pool": "a" if h.startswith("pa") else "z"})
+            )
+        agent.publish_all()
+        batch = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        d0 = batch.dispatch_count
+        labels = {"tpu/gang": "pg", "tpu/gang-size": "2", "tpu/chips": "4"}
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"pg-{i}",
+                    labels=dict(labels),
+                    preferred_node_affinity=self._prefs("a"),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        placements = {
+            stack.cluster.get_pod(f"default/pg-{i}").node_name
+            for i in range(2)
+        }
+        assert placements == {"pa-0", "pa-1"}
+        assert batch.dispatch_count == d0 + 1  # plan served the sibling

@@ -443,6 +443,24 @@ def pod_admits_on(node: "K8sNode | None", pod: "PodSpec") -> tuple[bool, str]:
     )
 
 
+def preferred_affinity_score(node: "K8sNode | None", pod: "PodSpec") -> int:
+    """Soft steering: [0, 100] fraction of the pod's
+    preferredDuringSchedulingIgnoredDuringExecution term weights this node
+    satisfies (upstream NodeAffinity scoring). 0 when the pod declares no
+    preferences or the node object is unknown — soft constraints degrade
+    gracefully, unlike the hard ones, which fail closed."""
+    prefs = pod.preferred_node_affinity
+    if not prefs or node is None:
+        return 0
+    total = sum(w for w, _ in prefs)
+    if total <= 0:
+        return 0
+    matched = sum(
+        w for w, t in prefs if t.matches(node.labels, node.name)
+    )
+    return matched * 100 // total
+
+
 _pod_seq = itertools.count()
 
 
@@ -471,8 +489,11 @@ class PodSpec:
     node_selector: dict[str, str] = field(default_factory=dict)
     # spec.affinity.nodeAffinity.requiredDuringSchedulingIgnoredDuring
     # Execution.nodeSelectorTerms — the hard-affinity terms (OR of terms,
-    # AND within a term). Preferred affinity is not modeled (scoring-only).
+    # AND within a term).
     node_affinity: tuple[NodeSelectorTerm, ...] = ()
+    # preferredDuringSchedulingIgnoredDuringExecution — (weight, term)
+    # pairs, scored by preferred_affinity_score (soft steering).
+    preferred_node_affinity: tuple[tuple[int, NodeSelectorTerm], ...] = ()
     # Sum of the containers' google.com/tpu resource limits — how
     # unmodified GKE TPU workloads request chips (requests.pod_request uses
     # it as the chip count when no tpu/chips label is present).
@@ -500,16 +521,20 @@ class PodSpec:
             spec["tolerations"] = [t.to_obj() for t in self.tolerations]
         if self.node_selector:
             spec["nodeSelector"] = dict(self.node_selector)
-        if self.node_affinity:
-            spec["affinity"] = {
-                "nodeAffinity": {
-                    "requiredDuringSchedulingIgnoredDuringExecution": {
-                        "nodeSelectorTerms": [
-                            t.to_obj() for t in self.node_affinity
-                        ]
-                    }
+        if self.node_affinity or self.preferred_node_affinity:
+            na: dict[str, Any] = {}
+            if self.node_affinity:
+                na["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                    "nodeSelectorTerms": [
+                        t.to_obj() for t in self.node_affinity
+                    ]
                 }
-            }
+            if self.preferred_node_affinity:
+                na["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                    {"weight": w, "preference": t.to_obj()}
+                    for w, t in self.preferred_node_affinity
+                ]
+            spec["affinity"] = {"nodeAffinity": na}
         if self.spec_priority:
             spec["priority"] = self.spec_priority
         if self.tpu_resource_limit:
@@ -582,6 +607,16 @@ class PodSpec:
                     .get("requiredDuringSchedulingIgnoredDuringExecution")
                     or {}
                 ).get("nodeSelectorTerms")
+                or ()
+            ),
+            preferred_node_affinity=tuple(
+                (
+                    int(p.get("weight") or 0),
+                    NodeSelectorTerm.from_obj(p.get("preference") or {}),
+                )
+                for p in ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+                    "preferredDuringSchedulingIgnoredDuringExecution"
+                )
                 or ()
             ),
             tpu_resource_limit=_tpu_limit_of(spec),

@@ -31,6 +31,10 @@ class Weights:
     # keeping slices whole for topology gangs. Tiered above the metric terms
     # (bonus = SLICE_PROTECT_BONUS x weight); 0 disables.
     slice_protect: int = 1
+    # Soft steering: the pod's preferredDuringScheduling node-affinity
+    # satisfaction ([0,100], api.types.preferred_affinity_score) x this
+    # weight, added alongside the normalized metric score; 0 disables.
+    preferred_affinity: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "Weights":

@@ -24,7 +24,12 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
     get_request,
 )
 from yoda_tpu.plugins.yoda.collection import MaxValueData, YodaPreScore, MAX_KEY
-from yoda_tpu.plugins.yoda.score import SliceProtectScore, YodaScore, Weights
+from yoda_tpu.plugins.yoda.score import (
+    PreferredAffinityScore,
+    SliceProtectScore,
+    YodaScore,
+    Weights,
+)
 from yoda_tpu.plugins.yoda.batch import YodaBatch
 from yoda_tpu.plugins.yoda.preemption import TpuPreemption
 
@@ -71,6 +76,7 @@ def default_plugins(
                 YodaPreScore(),
                 YodaScore(weights),
                 SliceProtectScore(weights),
+                PreferredAffinityScore(weights),
             ]
         )
     else:
@@ -88,6 +94,7 @@ __all__ = [
     "YodaPreScore",
     "YodaScore",
     "SliceProtectScore",
+    "PreferredAffinityScore",
     "MaxValueData",
     "Weights",
     "REQUEST_KEY",

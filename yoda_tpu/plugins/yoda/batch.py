@@ -33,7 +33,7 @@ import numpy as np
 
 log = logging.getLogger("yoda_tpu.batch")
 
-from yoda_tpu.api.types import PodSpec, pod_admits_on
+from yoda_tpu.api.types import PodSpec, pod_admits_on, preferred_affinity_score
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import BatchFilterScorePlugin, Snapshot, Status
 from yoda_tpu.ops.arrays import FleetArrays, bucket_rows
@@ -109,6 +109,9 @@ class _GangPlan:
                                         # dispatch's host_ok used pick 0's)
     node_selector: tuple                # ...and select identically
     node_affinity: tuple                # ...and require identically
+    preferred: tuple                    # ...and prefer identically (the
+                                        # plan's ranking baked pick 0's
+                                        # soft-affinity bonus in)
     picks: list[str]                    # node per member, picks[0] = the
                                         # dispatching member's own placement
     base: dict[str, int]                # reserved_fn(node) at dispatch time
@@ -272,15 +275,22 @@ class YodaBatch(BatchFilterScorePlugin):
         )
         result = self._kern.evaluate(dyn, reqk)
         self.dispatch_count += 1
+        # Soft steering (preferredDuringScheduling node affinity) is a
+        # host-side additive term — per (pod, node), like the admission
+        # vector, so it stays out of the fleet-static kernel inputs. It
+        # must be part of the ONE score the driver and the gang plan both
+        # rank by, or plan picks would diverge from the driver's argmax.
+        pref_bonus = self._preference_bonus(static, snapshot, pod)
         statuses: dict[str, Status] = {}
         scores: dict[str, int] = {}
         for i, name in enumerate(static.names):
             if result.feasible[i]:
                 statuses[name] = Status.ok()
                 # Final comparable score: minmax-normalized metrics [0,100]
-                # plus the slice-protection tier. The driver uses these
-                # directly when no other ScorePlugin is registered.
-                scores[name] = int(result.scores[i])
+                # plus the slice-protection tier and the soft-affinity
+                # bonus. The driver uses these directly when no other
+                # ScorePlugin is registered.
+                scores[name] = int(result.scores[i]) + int(pref_bonus[i])
             else:
                 # Bare reason text (no node name) so identical failures
                 # aggregate in summarize_failure ("6 node(s): not enough ...").
@@ -289,9 +299,25 @@ class YodaBatch(BatchFilterScorePlugin):
         if gang_name is not None:
             self._build_gang_plan(
                 state, pod, gang_name, snapshot, reqk, static, result,
-                statuses, scores,
+                statuses, scores, pref_bonus,
             )
         return statuses, scores
+
+    def _preference_bonus(
+        self, static: FleetArrays, snapshot: Snapshot, pod: PodSpec
+    ) -> np.ndarray:
+        """[n_nodes] int64 soft-affinity bonus per real node row."""
+        n = len(static.names)
+        out = np.zeros(n, dtype=np.int64)
+        w = self.weights.preferred_affinity
+        if not w or not pod.preferred_node_affinity:
+            return out
+        for i, name in enumerate(static.names):
+            ni = snapshot.get(name) if name in snapshot else None
+            out[i] = (
+                preferred_affinity_score(ni.node if ni else None, pod) * w
+            )
+        return out
 
     # --- whole-gang batched placement (VERDICT r2 #5) ---
 
@@ -306,6 +332,7 @@ class YodaBatch(BatchFilterScorePlugin):
         result: KernelResult,
         statuses: dict[str, Status],
         scores: dict[str, int],
+        pref_bonus: np.ndarray,
     ) -> None:
         """Place every remaining gang member host-side from THIS dispatch's
         result: greedy argmax by (score, name) — identical to the driver's
@@ -343,7 +370,9 @@ class YodaBatch(BatchFilterScorePlugin):
         # scores never change between picks, so the greedy argmax is always
         # the first still-eligible node in this order (equivalent to the
         # driver's max((score, name)) without O(k*N) Python lambdas).
-        order = np.lexsort((np.array(names), result.scores[:n]))[::-1]
+        order = np.lexsort(
+            (np.array(names), result.scores[:n] + pref_bonus[:n])
+        )[::-1]
         picks: list[str] = []
         for i in order:
             if not eligible[i]:
@@ -364,6 +393,7 @@ class YodaBatch(BatchFilterScorePlugin):
             tolerations=tuple(pod.tolerations),
             node_selector=tuple(sorted(pod.node_selector.items())),
             node_affinity=tuple(pod.node_affinity),
+            preferred=tuple(pod.preferred_node_affinity),
             picks=picks,
             # Copies: the runtime owns and may mutate the returned dicts
             # (single-plugin hot path writes FilterPlugin rejections in).
@@ -402,6 +432,7 @@ class YodaBatch(BatchFilterScorePlugin):
             or tuple(pod.tolerations) != plan.tolerations  # and tolerating
             or tuple(sorted(pod.node_selector.items())) != plan.node_selector
             or tuple(pod.node_affinity) != plan.node_affinity
+            or tuple(pod.preferred_node_affinity) != plan.preferred
         ):
             self._invalidate_plan(gang)
             return None

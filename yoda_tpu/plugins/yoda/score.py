@@ -25,7 +25,12 @@ from __future__ import annotations
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
 from yoda_tpu.config import SLICE_PROTECT_TIER, Weights
-from yoda_tpu.api.types import PodSpec, TpuChip, TpuNodeMetrics
+from yoda_tpu.api.types import (
+    PodSpec,
+    TpuChip,
+    TpuNodeMetrics,
+    preferred_affinity_score,
+)
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import NodeInfo, ScorePlugin, Status
 from yoda_tpu.plugins.yoda.collection import MAX_KEY, MaxValueData
@@ -112,6 +117,28 @@ class YodaScore(ScorePlugin):
             + actual_score(tpu, w)
         )
         return total, Status.ok()
+
+
+class PreferredAffinityScore(ScorePlugin):
+    """Soft node-affinity steering (upstream NodeAffinity scoring):
+    preferredDuringScheduling term-weight satisfaction, [0,100] x weight.
+    Already on the final scale — ``normalize`` is the identity (same
+    pattern as SliceProtectScore)."""
+
+    name = "yoda-preferred-affinity"
+
+    def __init__(self, weights: Weights | None = None) -> None:
+        self.weights = weights or Weights()
+
+    def score(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> tuple[int, Status]:
+        return (
+            preferred_affinity_score(node.node, pod)
+            * self.weights.preferred_affinity,
+            Status.ok(),
+        )
+
+    def normalize(self, state: CycleState, pod: PodSpec, scores: dict[str, int]) -> Status:
+        return Status.ok()
 
 
 class SliceProtectScore(ScorePlugin):
