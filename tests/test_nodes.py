@@ -531,3 +531,44 @@ class TestPreferredAffinity:
         }
         assert placements == {"pa-0", "pa-1"}
         assert batch.dispatch_count == d0 + 1  # plan served the sibling
+
+
+class TestPreferNoScheduleScoring:
+    """PreferNoSchedule is a scoring concern: untolerated soft taints
+    steer pods away without ever blocking them."""
+
+    def test_counting(self):
+        from yoda_tpu.api.types import untolerated_soft_taints
+
+        node = K8sNode(
+            "n",
+            taints=[
+                Taint("soft-a", "", "PreferNoSchedule"),
+                Taint("soft-b", "", "PreferNoSchedule"),
+                Taint("hard", "", "NoSchedule"),
+            ],
+        )
+        pod = PodSpec("p")
+        assert untolerated_soft_taints(node, pod) == 2  # hard not counted
+        tol = Toleration(key="soft-a", operator="Exists", effect="PreferNoSchedule")
+        assert untolerated_soft_taints(node, PodSpec("q", tolerations=[tol])) == 1
+        assert untolerated_soft_taints(None, pod) == 0
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_soft_taint_steers_but_never_blocks(self, mode):
+        stack, agent = make_stack(mode)
+        # "z" wins ties; only the penalty can steer onto "a".
+        agent.add_host("a-clean", generation="v5e", chips=8)
+        agent.add_host("z-soft", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_node(K8sNode("a-clean"))
+        stack.cluster.put_node(
+            K8sNode("z-soft", taints=[Taint("maint", "", "PreferNoSchedule")])
+        )
+        stack.cluster.create_pod(PodSpec("p1", labels={"tpu/chips": "8"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.cluster.get_pod("default/p1").node_name == "a-clean"
+        # Clean node full: the soft-tainted node still takes the next pod.
+        stack.cluster.create_pod(PodSpec("p2", labels={"tpu/chips": "8"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.cluster.get_pod("default/p2").node_name == "z-soft"

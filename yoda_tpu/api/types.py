@@ -443,6 +443,20 @@ def pod_admits_on(node: "K8sNode | None", pod: "PodSpec") -> tuple[bool, str]:
     )
 
 
+def untolerated_soft_taints(node: "K8sNode | None", pod: "PodSpec") -> int:
+    """How many PreferNoSchedule taints on the node the pod does NOT
+    tolerate — the soft companion to the hard taint filter (upstream
+    TaintToleration's scoring half). 0 when no Node object is known."""
+    if node is None:
+        return 0
+    return sum(
+        1
+        for taint in node.taints
+        if taint.effect == "PreferNoSchedule"
+        and not any(t.tolerates(taint) for t in pod.tolerations)
+    )
+
+
 def preferred_affinity_score(node: "K8sNode | None", pod: "PodSpec") -> int:
     """Soft steering: [0, 100] fraction of the pod's
     preferredDuringSchedulingIgnoredDuringExecution term weights this node

@@ -35,6 +35,11 @@ class Weights:
     # satisfaction ([0,100], api.types.preferred_affinity_score) x this
     # weight, added alongside the normalized metric score; 0 disables.
     preferred_affinity: int = 1
+    # Soft avoidance: each PreferNoSchedule taint the pod does not
+    # tolerate subtracts 100 x this weight (upstream TaintToleration's
+    # scoring half, simplified: per-taint penalty, no fleet-wide
+    # normalization); 0 disables.
+    taint_prefer: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "Weights":

@@ -30,6 +30,7 @@ from yoda_tpu.api.types import (
     TpuChip,
     TpuNodeMetrics,
     preferred_affinity_score,
+    untolerated_soft_taints,
 )
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import NodeInfo, ScorePlugin, Status
@@ -120,10 +121,11 @@ class YodaScore(ScorePlugin):
 
 
 class PreferredAffinityScore(ScorePlugin):
-    """Soft node-affinity steering (upstream NodeAffinity scoring):
-    preferredDuringScheduling term-weight satisfaction, [0,100] x weight.
-    Already on the final scale — ``normalize`` is the identity (same
-    pattern as SliceProtectScore)."""
+    """Soft steering and avoidance (upstream NodeAffinity scoring +
+    TaintToleration's scoring half): preferredDuringScheduling term-weight
+    satisfaction ([0,100] x weight) minus 100 x weight per untolerated
+    PreferNoSchedule taint. Already on the final scale — ``normalize`` is
+    the identity (same pattern as SliceProtectScore)."""
 
     name = "yoda-preferred-affinity"
 
@@ -131,9 +133,10 @@ class PreferredAffinityScore(ScorePlugin):
         self.weights = weights or Weights()
 
     def score(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> tuple[int, Status]:
+        w = self.weights
         return (
-            preferred_affinity_score(node.node, pod)
-            * self.weights.preferred_affinity,
+            preferred_affinity_score(node.node, pod) * w.preferred_affinity
+            - 100 * w.taint_prefer * untolerated_soft_taints(node.node, pod),
             Status.ok(),
         )
 
