@@ -117,6 +117,37 @@ class TestSchedulerMode:
         }
         assert {f"wg-{i}" for i in range(4)} <= scheduled
 
+    def test_node_selector_enforced_over_the_wire(self, server, run_main_bg):
+        """Node labels flow through the real HTTP Node watch and gate
+        placement: the GKE-style selector pod lands only on the matching
+        pool, though the other node wins every tie-break."""
+        from yoda_tpu.api.types import K8sNode
+
+        run_main_bg(["--metrics-port", "-1"])
+        seed = KubeCluster(
+            KubeApiClient(KubeApiConfig(base_url=server.base_url, watch_timeout_s=2))
+        )
+        seed.put_tpu_metrics(make_node("a-pool", chips=4))
+        seed.put_tpu_metrics(make_node("z-pool", chips=4))
+        # Node objects are kubelet-owned; seed them at the API server.
+        server.put_object(
+            "Node", "a-pool", K8sNode("a-pool", labels={"pool": "a"}).to_obj()
+        )
+        server.put_object(
+            "Node", "z-pool", K8sNode("z-pool", labels={"pool": "z"}).to_obj()
+        )
+        pod = PodSpec(
+            "steered", labels={"tpu/chips": "1"}, node_selector={"pool": "a"}
+        )
+        seed.create_pod(pod)
+        wait_until(
+            lambda: (server.get_object("Pod", "default/steered") or {})
+            .get("spec", {})
+            .get("nodeName")
+            == "a-pool",
+            msg="selector steered the pod over the wire",
+        )
+
     def test_bad_config_rejected(self, server, tmp_path):
         from yoda_tpu.cli import main
 
