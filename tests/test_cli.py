@@ -105,17 +105,21 @@ class TestSchedulerMode:
             return len(hosts) == 4
 
         _wait_until(all_bound, timeout_s=90.0, msg="gang bound over the wire")
-        # The Scheduled Events made it through the wire recorder too.
-        evs = [
-            server.get_object("Event", k)
-            for k in server.list_keys("Event")
-        ]
-        scheduled = {
-            e["involvedObject"]["name"]
-            for e in evs
-            if e.get("reason") == "Scheduled"
-        }
-        assert {f"wg-{i}" for i in range(4)} <= scheduled
+
+        # The Scheduled Events reach the API server too — asynchronously
+        # (the recorder's worker thread), so poll rather than assert.
+        def events_scheduled():
+            scheduled = {
+                e["involvedObject"]["name"]
+                for e in (
+                    server.get_object("Event", k)
+                    for k in server.list_keys("Event")
+                )
+                if e and e.get("reason") == "Scheduled"
+            }
+            return {f"wg-{i}" for i in range(4)} <= scheduled
+
+        wait_until(events_scheduled, msg="Scheduled events for all members")
 
     def test_node_selector_enforced_over_the_wire(self, server, run_main_bg):
         """Node labels flow through the real HTTP Node watch and gate
