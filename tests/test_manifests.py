@@ -143,3 +143,21 @@ class TestExamples:
         req = parse_request(labels)
         assert req.chips == 2
         assert req.priority == 1
+
+    def test_example_gke_pod_round_trips(self):
+        """The unmodified-GKE example exercises every non-label intake:
+        resource-limit chips, nodeSelector, preferred affinity."""
+        from yoda_tpu.api.requests import pod_request
+        from yoda_tpu.api.types import PodSpec
+
+        (obj,) = load_all("example/test-gke-pod.yaml")
+        pod = PodSpec.from_obj(obj)
+        assert pod.tpu_resource_limit == 4
+        assert pod_request(pod).effective_chips == 4
+        assert pod.node_selector == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"
+        }
+        (pref,) = pod.preferred_node_affinity
+        assert pref[0] == 10
+        assert pref[1].match_expressions[0].operator == "DoesNotExist"
+        assert obj["spec"]["schedulerName"] == "yoda-tpu"

@@ -176,3 +176,107 @@ def test_serve_forever_under_churn_and_gang_contention(seed, mesh):
                 f"gang-{g} not on one slice's 2x2 block: {sorted(hosts)}"
             )
     assert fully_bound >= 1, "no gang ever completed under contention"
+
+
+def test_serve_forever_with_node_constraints(seed=42):
+    """The chaos run with the full admission family in play: labeled
+    nodes, PreferNoSchedule taints, and selector-carrying churn pods.
+    Invariants: the scheduler survives, NO selector pod ever lands off its
+    pool (hard constraints hold under concurrency), no oversubscription,
+    accounting converges."""
+    from yoda_tpu.api.types import K8sNode, Taint
+
+    rng = random.Random(seed)
+    stack = build_stack(config=SchedulerConfig(gang_permit_timeout_s=1.0))
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(6):
+        agent.add_host(f"pool-a-{i}", chips=8)
+        stack.cluster.put_node(K8sNode(f"pool-a-{i}", labels={"pool": "a"}))
+    for i in range(6):
+        agent.add_host(f"pool-b-{i}", chips=8)
+        stack.cluster.put_node(
+            K8sNode(
+                f"pool-b-{i}",
+                labels={"pool": "b"},
+                taints=[Taint("maint", "", "PreferNoSchedule")],
+            )
+        )
+    agent.publish_all()
+
+    stack.cluster.create_pod(PodSpec("warmup", labels={"tpu/chips": "1"}))
+    stack.scheduler.run_until_idle(max_wall_s=60.0)
+    stack.cluster.delete_pod("default/warmup")
+
+    stop = threading.Event()
+    crashes: list[BaseException] = []
+
+    def serve():
+        try:
+            stack.scheduler.serve_forever(stop, poll_s=0.005)
+        except BaseException as e:  # noqa: BLE001
+            crashes.append(e)
+
+    server = threading.Thread(target=serve, daemon=True)
+    server.start()
+
+    def republish():
+        while not stop.is_set():
+            agent.publish_all()
+            time.sleep(0.002)
+
+    def churn():
+        for n in range(100):
+            if stop.is_set():
+                return
+            selector = (
+                {"pool": rng.choice(["a", "b"])} if n % 2 else {}
+            )
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"sel-{n}",
+                    labels={"tpu/chips": "1", "tpu/hbm": "100"},
+                    node_selector=selector,
+                )
+            )
+            if n % 4 == 3:
+                stack.cluster.delete_pod(f"default/sel-{rng.randrange(n)}")
+            time.sleep(0.001)
+
+    writers = [
+        threading.Thread(target=republish, daemon=True),
+        threading.Thread(target=churn, daemon=True),
+    ]
+    for w in writers:
+        w.start()
+    writers[1].join(timeout=30)
+    assert not writers[1].is_alive(), "churn thread wedged"
+    deadline = time.monotonic() + 20.0
+    while stack.scheduler.stats.binds == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.5)
+    stop.set()
+    server.join(timeout=30)
+    assert not server.is_alive(), "serve_forever deadlocked"
+    writers[0].join(timeout=5)
+    assert not crashes, f"scheduler thread crashed: {crashes!r}"
+
+    stack.scheduler.run_until_idle(max_wall_s=20.0)
+
+    pods = stack.cluster.list_pods()
+    for p in pods:
+        if p.node_name and p.node_selector:
+            want = p.node_selector["pool"]
+            got = "a" if p.node_name.startswith("pool-a") else "b"
+            assert got == want, (
+                f"{p.name} selected pool={want} but landed on {p.node_name}"
+            )
+    bound_by_node: dict[str, int] = {}
+    for p in pods:
+        if p.node_name:
+            bound_by_node[p.node_name] = (
+                bound_by_node.get(p.node_name, 0) + pod_chips(p)
+            )
+    for m in stack.cluster.list_tpu_metrics():
+        used = bound_by_node.get(m.name, 0)
+        assert used <= m.chip_count, f"{m.name} oversubscribed"
+        assert stack.accountant.chips_in_use(m.name) == used, m.name
