@@ -72,6 +72,19 @@ AUTO_DEVICE_MIN_ELEMS = 1 << 22
 AUTO_REMOTE_FLOOR_MS = 2.0
 
 
+def _pod_constraints(pod: PodSpec) -> tuple:
+    """Everything pod-side that shapes admission or ranking beyond the
+    KernelRequest. Gang siblings must match the dispatching member on ALL
+    of it for a plan to be servable — one tuple, so adding a constraint
+    type cannot silently skip the plan-equality check again."""
+    return (
+        tuple(pod.tolerations),
+        tuple(sorted(pod.node_selector.items())),
+        tuple(pod.node_affinity),
+        tuple(pod.preferred_node_affinity),
+    )
+
+
 def _host_admission(
     static: FleetArrays, snapshot: Snapshot, pod: PodSpec
 ) -> np.ndarray:
@@ -110,13 +123,10 @@ class _GangPlan:
     gang: str
     snapshot_version: int
     request: KernelRequest              # members must request identically
-    tolerations: tuple                  # ...and tolerate identically (the
-                                        # dispatch's host_ok used pick 0's)
-    node_selector: tuple                # ...and select identically
-    node_affinity: tuple                # ...and require identically
-    preferred: tuple                    # ...and prefer identically (the
-                                        # plan's ranking baked pick 0's
-                                        # soft-affinity bonus in)
+    constraints: tuple                  # ...and constrain identically —
+                                        # _pod_constraints(pod): the
+                                        # dispatch's admission vector and
+                                        # soft-score ranking used pick 0's
     picks: list[str]                    # node per member, picks[0] = the
                                         # dispatching member's own placement
     base: dict[str, int]                # reserved_fn(node) at dispatch time
@@ -427,10 +437,7 @@ class YodaBatch(BatchFilterScorePlugin):
             gang=gang,
             snapshot_version=snapshot.version,
             request=reqk,
-            tolerations=tuple(pod.tolerations),
-            node_selector=tuple(sorted(pod.node_selector.items())),
-            node_affinity=tuple(pod.node_affinity),
-            preferred=tuple(pod.preferred_node_affinity),
+            constraints=_pod_constraints(pod),
             picks=picks,
             # Copies: the runtime owns and may mutate the returned dicts
             # (single-plugin hot path writes FilterPlugin rejections in).
@@ -465,11 +472,8 @@ class YodaBatch(BatchFilterScorePlugin):
             return None
         if (
             snapshot.version != plan.snapshot_version
-            or reqk != plan.request  # members must be requesting identically
-            or tuple(pod.tolerations) != plan.tolerations  # and tolerating
-            or tuple(sorted(pod.node_selector.items())) != plan.node_selector
-            or tuple(pod.node_affinity) != plan.node_affinity
-            or tuple(pod.preferred_node_affinity) != plan.preferred
+            or reqk != plan.request  # members must request identically
+            or _pod_constraints(pod) != plan.constraints  # and constrain so
         ):
             self._invalidate_plan(gang)
             return None
