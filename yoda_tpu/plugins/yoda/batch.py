@@ -9,7 +9,7 @@ plugin OR the per-node trio in a framework — not both (scores would double).
 Transfer discipline (the p99 budget): the [N, C] chip grids live on the
 kernel's device, uploaded once per metrics version; a scheduling cycle
 transfers one packed [4, N] dynamics array + one [5] request vector and
-fetches one packed [5, N] result — O(1) host<->device round trips per pod
+fetches one packed [6, N] result — O(1) host<->device round trips per pod
 (ops.kernel.DeviceFleetKernel). The reference instead paid O(nodes)
 API-server round trips per pod (pkg/yoda/scheduler.go:70,108).
 
