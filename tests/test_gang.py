@@ -648,3 +648,187 @@ class TestNodeFailureMidGang:
         hosts = set(placements.values())
         assert len(hosts) == 4
         assert {h.rsplit("-", 1)[0] for h in hosts} == {"slice-b"}
+
+
+class TestMultislice:
+    """tpu/multislice: one gang spanning M disjoint topology blocks — the
+    Multislice pattern (ICI within each block, DCN between). All-or-
+    nothing across ALL blocks; blocks pack into one big slice or spread
+    across slices."""
+
+    def test_label_parsing(self):
+        from yoda_tpu.api.requests import LabelParseError, parse_request
+
+        req = parse_request(
+            {"tpu/gang": "m", "tpu/topology": "2x2", "tpu/multislice": "2"}
+        )
+        assert req.gang.slices == 2 and req.gang.size == 8
+        with pytest.raises(LabelParseError, match="requires tpu/topology"):
+            parse_request({"tpu/gang": "m", "tpu/gang-size": "4", "tpu/multislice": "2"})
+        with pytest.raises(LabelParseError, match="implies 8"):
+            parse_request(
+                {
+                    "tpu/gang": "m",
+                    "tpu/topology": "2x2",
+                    "tpu/multislice": "2",
+                    "tpu/gang-size": "4",
+                }
+            )
+        with pytest.raises(LabelParseError, match="must be >= 1"):
+            parse_request(
+                {"tpu/gang": "m", "tpu/topology": "2x2", "tpu/multislice": "0"}
+            )
+
+    def test_planner_two_blocks_across_slices(self):
+        from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
+
+        stack, agent = make_stack()
+        agent.add_slice("s-a", host_topology=(2, 2, 1))
+        agent.add_slice("s-b", host_topology=(2, 2, 1))
+        agent.publish_all()
+        snap = stack.informer.snapshot()
+        plan = plan_multislice_placement(
+            snap, want_dims=(2, 2, 1), slices=2, host_ok=lambda ni: True
+        )
+        assert plan is not None and len(plan) == 8
+        assert {h.rsplit("-", 1)[0] for h in plan} == {"s-a", "s-b"}
+
+    def test_planner_two_blocks_pack_one_big_slice(self):
+        from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
+
+        stack, agent = make_stack()
+        agent.add_slice("big", host_topology=(4, 2, 1))  # 8 hosts
+        agent.publish_all()
+        snap = stack.informer.snapshot()
+        plan = plan_multislice_placement(
+            snap, want_dims=(2, 2, 1), slices=2, host_ok=lambda ni: True
+        )
+        assert plan is not None and len(plan) == 8  # both blocks fit inside
+
+    def test_pack_blocks_backtracks_past_greedy_traps(self):
+        """Review repro: an L-shaped free region fits two 2x1 blocks only
+        if the first pick is NOT the lowest-origin block — greedy packing
+        reported feasible placements as unschedulable."""
+        from yoda_tpu.plugins.yoda.topology import pack_blocks
+
+        free = {(0, 0, 0), (1, 0, 0), (2, 0, 0), (0, 1, 0)}
+        blocks = pack_blocks(free, (2, 1, 1), 2)
+        assert blocks is not None
+        used = [c for b in blocks for c in b]
+        assert sorted(used) == sorted(free)
+        assert pack_blocks(free, (2, 1, 1), 3) is None
+
+    def test_planner_multi_pin_blocks_in_one_slice(self):
+        """Review repro: a restart can pin members of BOTH blocks inside
+        one big slice with more pins than fit one block — the anchor
+        fallback must keep the other pins usable, not wedge."""
+        from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
+
+        stack, agent = make_stack()
+        hosts = agent.add_slice("wide", host_topology=(4, 2, 1))
+        agent.publish_all()
+        snap = stack.informer.snapshot()
+        by_coord = {
+            snap.get(h).tpu.topology_coords: h for h in hosts
+        }
+        pinned = {
+            by_coord[(0, 0, 0)]: (0, 0, 0),
+            by_coord[(0, 1, 0)]: (0, 1, 0),
+            by_coord[(2, 0, 0)]: (2, 0, 0),
+        }
+        plan = plan_multislice_placement(
+            snap,
+            want_dims=(2, 2, 1),
+            slices=2,
+            host_ok=lambda ni: ni.name not in pinned,
+            pinned=pinned,
+        )
+        assert plan is not None and len(plan) == 8
+        for h, c in pinned.items():
+            assert plan.get(h) == c  # every pinned member kept its host
+
+    def test_planner_insufficient_blocks(self):
+        from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
+
+        stack, agent = make_stack()
+        agent.add_slice("only", host_topology=(2, 2, 1))
+        agent.publish_all()
+        snap = stack.informer.snapshot()
+        assert (
+            plan_multislice_placement(
+                snap, want_dims=(2, 2, 1), slices=2, host_ok=lambda ni: True
+            )
+            is None
+        )
+
+    def test_multislice_gang_binds_atomically_one_dispatch(self):
+        from yoda_tpu.plugins.yoda import YodaBatch
+
+        stack, agent = make_stack()
+        agent.add_slice("ms-a", host_topology=(2, 2, 1))
+        agent.add_slice("ms-b", host_topology=(2, 2, 1))
+        agent.add_host("edge-0", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+        batch = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        d0 = batch.dispatch_count
+        labels = {
+            "tpu/gang": "ms",
+            "tpu/topology": "2x2x1",
+            "tpu/multislice": "2",
+            "tpu/chips": "4",
+        }
+        for i in range(8):
+            stack.cluster.create_pod(PodSpec(f"ms-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=20.0)
+        placed = {
+            p.name: p.node_name
+            for p in stack.cluster.list_pods()
+            if p.labels.get("tpu/gang") == "ms"
+        }
+        assert all(placed.values()), placed
+        hosts = set(placed.values())
+        assert len(hosts) == 8  # one member per host
+        slices = {h.rsplit("-", 1)[0] for h in hosts}
+        assert slices == {"ms-a", "ms-b"}  # both blocks, never the edge host
+        assert batch.dispatch_count == d0 + 1  # ONE dispatch for all 8
+
+    def test_multislice_restart_reconstruction(self):
+        """Bound members replayed after a restart pin their blocks; the
+        remaining members complete around them."""
+        stack, agent = make_stack()
+        a_hosts = agent.add_slice("rs-a", host_topology=(2, 2, 1))
+        agent.add_slice("rs-b", host_topology=(2, 2, 1))
+        agent.publish_all()
+        labels = {
+            "tpu/gang": "rms",
+            "tpu/topology": "2x2x1",
+            "tpu/multislice": "2",
+            "tpu/chips": "4",
+        }
+        pods = [PodSpec(f"rms-{i}", labels=dict(labels)) for i in range(8)]
+        pods[0].node_name = a_hosts[0]
+        pods[0].phase = "Running"
+        stack.cluster.create_pod(pods[0])
+        agent.publish_all()
+
+        from yoda_tpu.standalone import build_stack as rebuild
+
+        stack2 = rebuild(cluster=stack.cluster)
+        assert stack2.gang.gang_status("rms") == (8, 0, 1)
+        for p in pods[1:]:
+            stack2.cluster.create_pod(p)
+        stack2.scheduler.run_until_idle(max_wall_s=20.0)
+        placed = {
+            p.name: p.node_name
+            for p in stack2.cluster.list_pods()
+            if p.labels.get("tpu/gang") == "rms"
+        }
+        assert all(placed.values()), placed
+        assert len(set(placed.values())) == 8
+        assert placed["rms-0"] == a_hosts[0]  # the pinned member stayed put

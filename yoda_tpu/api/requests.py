@@ -18,6 +18,9 @@ Net-new labels (no reference analog; mandated by BASELINE.json north star):
     tpu/gang         gang name: all pods sharing it are placed atomically
     tpu/gang-size    number of pods in the gang
     tpu/topology     ICI slice shape "AxBxC" (hosts), e.g. "2x2x2"
+    tpu/multislice   number of tpu/topology blocks the gang spans (the
+                     Multislice pattern: ICI within each block, DCN
+                     between blocks); gang size = multislice x prod(dims)
 
 Parsing is strict: a malformed label raises ``LabelParseError`` and the pod is
 reported Unschedulable with the message, instead of the reference's
@@ -47,6 +50,7 @@ PRIORITY = "tpu/priority"
 GANG = "tpu/gang"
 GANG_SIZE = "tpu/gang-size"
 TOPOLOGY = "tpu/topology"
+MULTISLICE = "tpu/multislice"
 
 
 class LabelParseError(ValueError):
@@ -58,6 +62,10 @@ class GangSpec:
     name: str
     size: int
     topology: tuple[int, ...] | None = None  # hosts per ICI dimension
+    # Number of disjoint `topology` blocks the gang spans (Multislice:
+    # data parallelism over DCN between blocks, ICI within each).
+    # size == slices x prod(topology) when topology is set.
+    slices: int = 1
 
     @property
     def hosts(self) -> int:
@@ -147,13 +155,30 @@ def parse_request(
             raise LabelParseError(str(e)) from e
 
     gang = None
-    if GANG in labels or GANG_SIZE in labels or TOPOLOGY in labels:
+    if (
+        GANG in labels
+        or GANG_SIZE in labels
+        or TOPOLOGY in labels
+        or MULTISLICE in labels
+    ):
         if GANG not in labels:
-            raise LabelParseError(f"{GANG_SIZE}/{TOPOLOGY} require {GANG}")
+            raise LabelParseError(
+                f"{GANG_SIZE}/{TOPOLOGY}/{MULTISLICE} require {GANG}"
+            )
         name = labels[GANG].strip()
         if not name:
             raise LabelParseError(f"{GANG} must be non-empty")
         topology = parse_topology(labels[TOPOLOGY]) if TOPOLOGY in labels else None
+        n_slices = 1
+        if MULTISLICE in labels:
+            if topology is None:
+                raise LabelParseError(f"{MULTISLICE} requires {TOPOLOGY}")
+            try:
+                n_slices = parse_int(labels[MULTISLICE], field=MULTISLICE)
+            except QuantityError as e:
+                raise LabelParseError(str(e)) from e
+            if n_slices < 1:
+                raise LabelParseError(f"{MULTISLICE} must be >= 1")
         if GANG_SIZE in labels:
             try:
                 size = parse_int(labels[GANG_SIZE], field=GANG_SIZE)
@@ -162,17 +187,21 @@ def parse_request(
             if size < 1:
                 raise LabelParseError(f"{GANG_SIZE} must be >= 1")
         elif topology is not None:
-            size = math.prod(topology)
+            size = n_slices * math.prod(topology)
         else:
             raise LabelParseError(f"{GANG} requires {GANG_SIZE} or {TOPOLOGY}")
         if topology is not None:
-            expected = math.prod(topology)
+            expected = n_slices * math.prod(topology)
             if expected != size:
+                what = f"{TOPOLOGY} {labels[TOPOLOGY]!r}"
+                if MULTISLICE in labels:
+                    what += f" x {MULTISLICE} {n_slices}"
                 raise LabelParseError(
-                    f"{TOPOLOGY} {labels[TOPOLOGY]!r} implies {expected} hosts "
-                    f"but {GANG_SIZE} is {size}"
+                    f"{what} implies {expected} hosts but {GANG_SIZE} is {size}"
                 )
-        gang = GangSpec(name=name, size=size, topology=topology)
+        gang = GangSpec(
+            name=name, size=size, topology=topology, slices=n_slices
+        )
 
     return TpuRequest(
         chips=chips,

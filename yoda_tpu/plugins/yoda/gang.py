@@ -56,7 +56,7 @@ from yoda_tpu.framework.interfaces import (
     Status,
 )
 from yoda_tpu.plugins.yoda.filter_plugin import available_chips, get_request
-from yoda_tpu.plugins.yoda.topology import plan_slice_placement
+from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
 
 log = logging.getLogger("yoda_tpu.gang")
 
@@ -158,11 +158,12 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             if gs is None:
                 gs = _GangState(spec=req.gang)
                 self._gangs[req.gang.name] = gs
-            elif gs.spec.size != req.gang.size or gs.spec.topology != req.gang.topology:
+            elif gs.spec != req.gang:
                 return Status.unresolvable(
-                    f"gang {req.gang.name}: member declares size/topology "
-                    f"{req.gang.size}/{req.gang.topology}, gang has "
-                    f"{gs.spec.size}/{gs.spec.topology}"
+                    f"gang {req.gang.name}: member declares "
+                    f"size/topology/slices {req.gang.size}/"
+                    f"{req.gang.topology}/{req.gang.slices}, gang has "
+                    f"{gs.spec.size}/{gs.spec.topology}/{gs.spec.slices}"
                 )
             if pod.key in gs.waiting:
                 return Status.unschedulable(f"pod {pod.key} already waiting in gang")
@@ -298,9 +299,10 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                         f"{host} with no TPU metrics; cannot plan around it"
                     )
                 pinned[host] = ni.tpu.topology_coords
-            gs.plan = plan_slice_placement(
+            gs.plan = plan_multislice_placement(
                 snapshot,
                 want_dims=gs.spec.topology,
+                slices=gs.spec.slices,
                 host_ok=lambda ni: self._host_fits_member(
                     ni, req, assigned_hosts, pod
                 ),
@@ -314,8 +316,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             # (handle() skips dead-marked hosts).
             if gs.plan is not None:
                 log.info(
-                    "gang %s: planned %s block on hosts %s",
+                    "gang %s: planned %dx %s block(s) on hosts %s",
                     gs.spec.name,
+                    gs.spec.slices,
                     "x".join(map(str, gs.spec.topology)),
                     sorted(gs.plan),
                 )

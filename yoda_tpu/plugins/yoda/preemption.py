@@ -61,7 +61,7 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
     get_request,
     qualifying_chips,
 )
-from yoda_tpu.plugins.yoda.topology import plan_slice_placement
+from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
 
 log = logging.getLogger("yoda_tpu.preemption")
 
@@ -444,8 +444,12 @@ class TpuPreemption(PostFilterPlugin):
                 sets[ni.name] = self._minimal_set(ni, req, 1, req.priority, pod)
             return sets[ni.name] is not None
 
-        plan = plan_slice_placement(
-            snapshot, want_dims=gang.topology, host_ok=host_ok, pinned=pinned
+        plan = plan_multislice_placement(
+            snapshot,
+            want_dims=gang.topology,
+            slices=gang.slices,
+            host_ok=host_ok,
+            pinned=pinned,
         )
         if plan is None:
             return None, Status.unschedulable(
