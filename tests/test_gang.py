@@ -798,6 +798,53 @@ class TestMultislice:
         assert slices == {"ms-a", "ms-b"}  # both blocks, never the edge host
         assert batch.dispatch_count == d0 + 1  # ONE dispatch for all 8
 
+    def test_two_multislice_gangs_contend_atomically(self):
+        """2 gangs x 2 blocks over 3 slices: only one gang can complete;
+        the loser holds nothing (all-or-nothing), then completes after the
+        winner tears down."""
+        stack, agent = make_stack(gang_permit_timeout_s=1.0)
+        for s in ("c-a", "c-b", "c-c"):
+            agent.add_slice(s, host_topology=(2, 2, 1))
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=5.0)
+
+        def gang(name):
+            labels = {
+                "tpu/gang": name,
+                "tpu/topology": "2x2x1",
+                "tpu/multislice": "2",
+                "tpu/chips": "4",
+            }
+            return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(8)]
+
+        for p in gang("g1") + gang("g2"):
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=30.0)
+        bound = {
+            g: [
+                p
+                for p in stack.cluster.list_pods()
+                if p.labels.get("tpu/gang") == g and p.node_name
+            ]
+            for g in ("g1", "g2")
+        }
+        counts = sorted(len(v) for v in bound.values())
+        assert counts == [0, 8], counts  # exactly one gang fully bound
+        winner = next(g for g, v in bound.items() if len(v) == 8)
+        for p in bound[winner]:
+            stack.cluster.delete_pod(p.key)
+        stack.scheduler.run_until_idle(max_wall_s=30.0)
+        loser = "g2" if winner == "g1" else "g1"
+        loser_bound = [
+            p
+            for p in stack.cluster.list_pods()
+            if p.labels.get("tpu/gang") == loser and p.node_name
+        ]
+        assert len(loser_bound) == 8  # the loser completed after teardown
+
     def test_multislice_restart_reconstruction(self):
         """Bound members replayed after a restart pin their blocks; the
         remaining members complete around them."""
