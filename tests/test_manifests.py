@@ -144,6 +144,15 @@ class TestExamples:
         assert req.chips == 2
         assert req.priority == 1
 
+    def test_example_multislice_pod_parses(self):
+        (obj,) = load_all("example/test-multislice.yaml")
+        req = parse_request(obj["metadata"]["labels"])
+        assert req.gang is not None
+        assert req.gang.slices == 2
+        assert req.gang.topology == (2, 2, 1)
+        assert req.gang.size == 8
+        assert obj["spec"]["schedulerName"] == "yoda-tpu"
+
     def test_example_gke_pod_round_trips(self):
         """The unmodified-GKE example exercises every non-label intake:
         resource-limit chips, nodeSelector, preferred affinity."""
