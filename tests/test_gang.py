@@ -845,6 +845,36 @@ class TestMultislice:
         ]
         assert len(loser_bound) == 8  # the loser completed after teardown
 
+    def test_multislice_on_mesh_sharded_kernel(self):
+        """mesh_devices mode: the sharded kernel's claimable row feeds the
+        same one-dispatch multislice plan."""
+        from yoda_tpu.plugins.yoda import YodaBatch
+
+        stack, agent = make_stack(mesh_devices=8)
+        agent.add_slice("mm-a", host_topology=(2, 2, 1))
+        agent.add_slice("mm-b", host_topology=(2, 2, 1))
+        agent.publish_all()
+        batch = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        labels = {
+            "tpu/gang": "mm",
+            "tpu/topology": "2x2x1",
+            "tpu/multislice": "2",
+            "tpu/chips": "4",
+        }
+        for i in range(8):
+            stack.cluster.create_pod(PodSpec(f"mm-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=60.0)
+        placed = {
+            p.name: p.node_name
+            for p in stack.cluster.list_pods()
+            if p.labels.get("tpu/gang") == "mm"
+        }
+        assert all(placed.values()), placed
+        assert len(set(placed.values())) == 8
+        assert batch.plan_served == 7  # siblings served, one dispatch total
+
     def test_multislice_restart_reconstruction(self):
         """Bound members replayed after a restart pin their blocks; the
         remaining members complete around them."""
