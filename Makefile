@@ -8,7 +8,7 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test native bench demo image push format clean
+.PHONY: all test native bench demo soak image push format clean
 
 all: native test
 
@@ -23,6 +23,10 @@ bench: native
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo
+
+# Randomized-seed concurrency sweep (the CI stress suite runs fixed seeds).
+soak:
+	$(PY) tools/soak.py $(SOAK_ROUNDS)
 
 image:
 	docker build -t $(IMAGE):$(TAG) .
