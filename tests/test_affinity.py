@@ -1,0 +1,532 @@
+"""Inter-pod affinity/anti-affinity and topology-spread constraints.
+
+The reference ran alongside the upstream default plugins (reference
+deploy/yoda-scheduler.yaml:15-27 adds yoda to the defaults), so its users
+got InterPodAffinity and PodTopologySpread behavior for free; here both
+are first-party (yoda_tpu/api/affinity.py) and enforced on the loop and
+fused-kernel paths alike.
+"""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.affinity import (
+    InterPodEvaluator,
+    LabelSelector,
+    PodAffinityTerm,
+    SpreadEvaluator,
+    TopologySpreadConstraint,
+)
+from yoda_tpu.api.types import K8sNode, PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+from yoda_tpu.standalone import build_stack
+
+HOSTNAME = "kubernetes.io/hostname"
+ZONE = "topology.kubernetes.io/zone"
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+def term(topology_key=HOSTNAME, match=None, namespaces=()):
+    return PodAffinityTerm(
+        topology_key=topology_key,
+        selector=LabelSelector(match_labels=tuple(sorted((match or {}).items()))),
+        namespaces=tuple(namespaces),
+    )
+
+
+def snap(*entries):
+    """entries: (name, labels, pods)."""
+    return Snapshot(
+        {
+            name: NodeInfo(
+                name, node=K8sNode(name, labels=dict(labels)), pods=list(pods)
+            )
+            for name, labels, pods in entries
+        }
+    )
+
+
+class TestSelectorSemantics:
+    def test_empty_selector_matches_everything(self):
+        assert LabelSelector().matches({"a": "b"})
+        assert LabelSelector().matches({})
+
+    def test_absent_selector_matches_nothing(self):
+        t = PodAffinityTerm(topology_key=HOSTNAME, selector=None)
+        assert not t.matches_pod(PodSpec("p", labels={"a": "b"}), "default")
+
+    def test_namespace_default_is_owner(self):
+        t = term(match={"app": "db"})
+        same_ns = PodSpec("p", namespace="default", labels={"app": "db"})
+        other_ns = PodSpec("p", namespace="other", labels={"app": "db"})
+        assert t.matches_pod(same_ns, "default")
+        assert not t.matches_pod(other_ns, "default")
+        assert term(match={"app": "db"}, namespaces=("other",)).matches_pod(
+            other_ns, "default"
+        )
+
+    def test_roundtrip_through_pod_obj(self):
+        pod = PodSpec(
+            "p",
+            labels={"app": "web"},
+            pod_affinity=(term(ZONE, {"app": "db"}),),
+            pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+            preferred_pod_affinity=((10, term(ZONE, {"tier": "cache"})),),
+            preferred_pod_anti_affinity=((5, term(ZONE, {"noisy": "yes"})),),
+            topology_spread=(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    selector=LabelSelector(match_labels=(("app", "web"),)),
+                ),
+            ),
+        )
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.pod_affinity == pod.pod_affinity
+        assert back.pod_anti_affinity == pod.pod_anti_affinity
+        assert back.preferred_pod_affinity == pod.preferred_pod_affinity
+        assert (
+            back.preferred_pod_anti_affinity == pod.preferred_pod_anti_affinity
+        )
+        assert back.topology_spread == pod.topology_spread
+
+
+class TestInterPodEvaluator:
+    def test_affinity_requires_matching_domain(self):
+        db = PodSpec("db", labels={"app": "db"})
+        s = snap(
+            ("n1", {ZONE: "a"}, [db]),
+            ("n2", {ZONE: "b"}, []),
+        )
+        pod = PodSpec("web", pod_affinity=(term(ZONE, {"app": "db"}),))
+        ev = InterPodEvaluator.build(s, pod)
+        assert ev.feasible(s.get("n1"))[0]
+        ok, why = ev.feasible(s.get("n2"))
+        assert not ok and ZONE in why
+
+    def test_affinity_missing_topology_key_rejects(self):
+        db = PodSpec("db", labels={"app": "db"})
+        s = snap(("n1", {ZONE: "a"}, [db]), ("bare", {}, []))
+        pod = PodSpec("web", pod_affinity=(term(ZONE, {"app": "db"}),))
+        ev = InterPodEvaluator.build(s, pod)
+        assert not ev.feasible(s.get("bare"))[0]
+
+    def test_first_pod_self_match_bootstraps(self):
+        # No pod matches the term anywhere, but the incoming pod matches
+        # its own selector: the term is satisfied (upstream rule) — the
+        # group's first replica can schedule.
+        s = snap(("n1", {ZONE: "a"}, []))
+        pod = PodSpec(
+            "web-0", labels={"app": "web"}, pod_affinity=(term(ZONE, {"app": "web"}),)
+        )
+        ev = InterPodEvaluator.build(s, pod)
+        assert ev.feasible(s.get("n1"))[0]
+
+    def test_first_pod_rule_not_applied_when_pod_does_not_self_match(self):
+        s = snap(("n1", {ZONE: "a"}, []))
+        pod = PodSpec("web", pod_affinity=(term(ZONE, {"app": "db"}),))
+        ev = InterPodEvaluator.build(s, pod)
+        assert not ev.feasible(s.get("n1"))[0]
+
+    def test_anti_affinity_rejects_same_domain_only(self):
+        web = PodSpec("web-0", labels={"app": "web"})
+        s = snap(
+            ("n1", {HOSTNAME: "n1"}, [web]),
+            ("n2", {HOSTNAME: "n2"}, []),
+            ("bare", {}, []),
+        )
+        pod = PodSpec(
+            "web-1",
+            labels={"app": "web"},
+            pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+        )
+        ev = InterPodEvaluator.build(s, pod)
+        assert not ev.feasible(s.get("n1"))[0]
+        assert ev.feasible(s.get("n2"))[0]
+        # A node without the topology key belongs to no domain: no conflict.
+        assert ev.feasible(s.get("bare"))[0]
+
+    def test_symmetry_existing_anti_affinity_repels_incoming(self):
+        # The EXISTING pod declares anti-affinity against app=web; the
+        # incoming web pod carries no terms of its own but is still
+        # repelled from the lonely pod's host (upstream symmetry).
+        loner = PodSpec(
+            "loner",
+            labels={"app": "sensitive"},
+            pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+        )
+        s = snap(
+            ("n1", {HOSTNAME: "n1"}, [loner]),
+            ("n2", {HOSTNAME: "n2"}, []),
+        )
+        pod = PodSpec("web", labels={"app": "web"})
+        ev = InterPodEvaluator.build(s, pod)
+        assert not ev.feasible(s.get("n1"))[0]
+        assert ev.feasible(s.get("n2"))[0]
+
+    def test_preference_signed_sum(self):
+        cache = PodSpec("cache", labels={"tier": "cache"})
+        noisy = PodSpec("noisy", labels={"noisy": "yes"})
+        s = snap(
+            ("n1", {ZONE: "a"}, [cache]),
+            ("n2", {ZONE: "b"}, [noisy]),
+            ("n3", {ZONE: "c"}, []),
+        )
+        pod = PodSpec(
+            "web",
+            preferred_pod_affinity=((10, term(ZONE, {"tier": "cache"})),),
+            preferred_pod_anti_affinity=((7, term(ZONE, {"noisy": "yes"})),),
+        )
+        ev = InterPodEvaluator.build(s, pod)
+        assert ev.preference(s.get("n1")) == 10
+        assert ev.preference(s.get("n2")) == -7
+        assert ev.preference(s.get("n3")) == 0
+
+    def test_trivial_when_no_terms_anywhere(self):
+        s = snap(("n1", {}, [PodSpec("p")]))
+        ev = InterPodEvaluator.build(s, PodSpec("q"))
+        assert ev.trivial
+
+
+class TestSpreadEvaluator:
+    def c(self, when="DoNotSchedule", skew=1, key=ZONE, match=None):
+        return TopologySpreadConstraint(
+            max_skew=skew,
+            topology_key=key,
+            when_unsatisfiable=when,
+            selector=LabelSelector(
+                match_labels=tuple(sorted((match or {"app": "web"}).items()))
+            ),
+        )
+
+    def test_do_not_schedule_enforces_max_skew(self):
+        w = lambda i: PodSpec(f"w{i}", labels={"app": "web"})
+        s = snap(
+            ("a1", {ZONE: "a"}, [w(0), w(1)]),
+            ("b1", {ZONE: "b"}, [w(2)]),
+            ("c1", {ZONE: "c"}, []),
+        )
+        pod = PodSpec("w3", labels={"app": "web"}, topology_spread=(self.c(),))
+        ev = SpreadEvaluator.build(s, pod)
+        # counts: a=2, b=1, c=0; min=0. Placing in a -> skew 3 > 1 reject;
+        # b -> 2 > 1 reject; c -> 1 ok.
+        assert not ev.feasible(s.get("a1"))[0]
+        assert not ev.feasible(s.get("b1"))[0]
+        assert ev.feasible(s.get("c1"))[0]
+
+    def test_node_without_key_rejected_for_hard_constraint(self):
+        s = snap(("bare", {}, []))
+        pod = PodSpec("w", labels={"app": "web"}, topology_spread=(self.c(),))
+        ev = SpreadEvaluator.build(s, pod)
+        ok, why = ev.feasible(s.get("bare"))
+        assert not ok and "topology key" in why
+
+    def test_schedule_anyway_scores_but_never_filters(self):
+        w = lambda i: PodSpec(f"w{i}", labels={"app": "web"})
+        s = snap(
+            ("a1", {ZONE: "a"}, [w(0), w(1)]),
+            ("b1", {ZONE: "b"}, []),
+        )
+        pod = PodSpec(
+            "w2",
+            labels={"app": "web"},
+            topology_spread=(self.c(when="ScheduleAnyway"),),
+        )
+        ev = SpreadEvaluator.build(s, pod)
+        assert ev.feasible(s.get("a1"))[0]
+        assert ev.score(s.get("b1")) > ev.score(s.get("a1"))
+
+    def test_selector_scopes_counting(self):
+        other = PodSpec("other", labels={"app": "db"})
+        s = snap(
+            ("a1", {ZONE: "a"}, [other]),
+            ("b1", {ZONE: "b"}, []),
+        )
+        pod = PodSpec("w", labels={"app": "web"}, topology_spread=(self.c(),))
+        ev = SpreadEvaluator.build(s, pod)
+        # The db pod does not count toward app=web skew.
+        assert ev.feasible(s.get("a1"))[0]
+        assert ev.feasible(s.get("b1"))[0]
+
+    def test_other_namespace_pods_do_not_count(self):
+        foreign = PodSpec("f", namespace="other", labels={"app": "web"})
+        s = snap(("a1", {ZONE: "a"}, [foreign]), ("b1", {ZONE: "b"}, []))
+        pod = PodSpec("w", labels={"app": "web"}, topology_spread=(self.c(),))
+        ev = SpreadEvaluator.build(s, pod)
+        assert ev.feasible(s.get("a1"))[0]
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestAffinityE2E:
+    def _nodes(self, stack, agent, names, label_key=HOSTNAME, values=None):
+        for i, n in enumerate(names):
+            agent.add_host(n, generation="v5e", chips=8)
+            labels = {label_key: values[i] if values else n}
+            stack.cluster.put_node(K8sNode(n, labels=labels))
+        agent.publish_all()
+
+    def test_anti_affinity_spreads_replicas(self, mode):
+        stack, agent = make_stack(mode)
+        self._nodes(stack, agent, ["h1", "h2", "h3"])
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"web-{i}",
+                    labels={"app": "web", "tpu/chips": "1"},
+                    pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        hosts = {
+            stack.cluster.get_pod(f"default/web-{i}").node_name
+            for i in range(3)
+        }
+        assert hosts == {"h1", "h2", "h3"}
+
+    def test_fourth_anti_affinity_replica_pends(self, mode):
+        stack, agent = make_stack(mode)
+        self._nodes(stack, agent, ["h1", "h2"])
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"web-{i}",
+                    labels={"app": "web", "tpu/chips": "1"},
+                    pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        bound = [
+            stack.cluster.get_pod(f"default/web-{i}").node_name
+            for i in range(3)
+        ]
+        assert sorted(n for n in bound if n) == ["h1", "h2"]
+        assert bound.count(None) == 1
+
+    def test_affinity_co_locates_by_zone(self, mode):
+        stack, agent = make_stack(mode)
+        self._nodes(
+            stack, agent, ["a1", "a2", "b1"], label_key=ZONE,
+            values=["za", "za", "zb"],
+        )
+        stack.cluster.create_pod(
+            PodSpec("db", labels={"app": "db", "tpu/chips": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        db_node = stack.cluster.get_pod("default/db").node_name
+        db_zone = {"a1": "za", "a2": "za", "b1": "zb"}[db_node]
+        stack.cluster.create_pod(
+            PodSpec(
+                "web",
+                labels={"app": "web", "tpu/chips": "1"},
+                pod_affinity=(term(ZONE, {"app": "db"}),),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        web_node = stack.cluster.get_pod("default/web").node_name
+        assert {"a1": "za", "a2": "za", "b1": "zb"}[web_node] == db_zone
+
+    def test_symmetry_e2e(self, mode):
+        stack, agent = make_stack(mode)
+        self._nodes(stack, agent, ["h1", "h2"])
+        stack.cluster.create_pod(
+            PodSpec(
+                "sensitive",
+                labels={"app": "sensitive", "tpu/chips": "1"},
+                pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        sens_node = stack.cluster.get_pod("default/sensitive").node_name
+        stack.cluster.create_pod(
+            PodSpec("web", labels={"app": "web", "tpu/chips": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        web_node = stack.cluster.get_pod("default/web").node_name
+        assert web_node is not None and web_node != sens_node
+
+    def test_spread_do_not_schedule_balances_zones(self, mode):
+        stack, agent = make_stack(mode)
+        self._nodes(
+            stack, agent, ["a1", "b1"], label_key=ZONE, values=["za", "zb"]
+        )
+        spread = (
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                selector=LabelSelector(match_labels=(("app", "web"),)),
+            ),
+        )
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"web-{i}",
+                    labels={"app": "web", "tpu/chips": "1"},
+                    topology_spread=spread,
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        zones = [
+            {"a1": "za", "b1": "zb"}[
+                stack.cluster.get_pod(f"default/web-{i}").node_name
+            ]
+            for i in range(4)
+        ]
+        assert zones.count("za") == 2 and zones.count("zb") == 2
+
+    def test_preferred_pod_affinity_steers(self, mode):
+        stack, agent = make_stack(mode)
+        self._nodes(
+            stack, agent, ["a1", "b1"], label_key=ZONE, values=["za", "zb"]
+        )
+        stack.cluster.create_pod(
+            PodSpec("cache", labels={"tier": "cache", "tpu/chips": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        cache_node = stack.cluster.get_pod("default/cache").node_name
+        stack.cluster.create_pod(
+            PodSpec(
+                "web",
+                labels={"tpu/chips": "1"},
+                preferred_pod_affinity=((50, term(ZONE, {"tier": "cache"})),),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/web").node_name == cache_node
+
+
+class TestReviewRegressions:
+    """Fixes from the medium-effort review of the affinity change."""
+
+    def test_spread_score_ignores_do_not_schedule_constraints(self):
+        # Upstream PodTopologySpread scores only ScheduleAnyway constraints;
+        # a DoNotSchedule-only pod must not receive a balance score.
+        w = PodSpec("w0", labels={"app": "web"})
+        s = snap(("a1", {ZONE: "a"}, [w]), ("b1", {ZONE: "b"}, []))
+        pod = PodSpec(
+            "w1",
+            labels={"app": "web"},
+            topology_spread=(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    selector=LabelSelector(match_labels=(("app", "web"),)),
+                ),
+            ),
+        )
+        ev = SpreadEvaluator.build(s, pod)
+        assert not ev.has_soft and ev.has_hard
+        assert ev.score(s.get("a1")) == 0 and ev.score(s.get("b1")) == 0
+
+    def test_symmetry_only_evaluator_has_no_preferences(self):
+        # An evaluator built only because some bound pod declares
+        # anti-affinity must not claim scoring relevance (the batch path's
+        # O(N) fast-path gate keys on this).
+        loner = PodSpec(
+            "loner",
+            labels={"app": "x"},
+            pod_anti_affinity=(term(HOSTNAME, {"app": "web"}),),
+        )
+        s = snap(("n1", {HOSTNAME: "n1"}, [loner]))
+        ev = InterPodEvaluator.build(s, PodSpec("web", labels={"app": "web"}))
+        assert not ev.trivial and not ev.has_preferences
+
+    def test_gang_plan_refused_for_anti_affinity_members(self):
+        # A whole-gang plan cannot see the mutual exclusion between its own
+        # (unbound) members, so pods with required inter-pod terms must be
+        # placed by per-member dispatches, never from one plan.
+        from yoda_tpu.plugins.yoda import YodaBatch
+
+        stack, agent = make_stack("batch")
+        for n in ("h1", "h2", "h3"):
+            agent.add_host(n, generation="v5e", chips=8)
+            stack.cluster.put_node(
+                K8sNode(n, labels={HOSTNAME: n})
+            )
+        agent.publish_all()
+        batch = next(
+            p
+            for p in stack.framework.batch_plugins
+            if isinstance(p, YodaBatch)
+        )
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{i}",
+                    labels={
+                        "tpu/gang": "g",
+                        "tpu/gang-size": "3",
+                        "tpu/chips": "1",
+                        "app": "g",
+                    },
+                    pod_anti_affinity=(term(HOSTNAME, {"app": "g"}),),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert batch.plan_served == 0
+        bound = [
+            stack.cluster.get_pod(f"default/g-{i}").node_name
+            for i in range(3)
+        ]
+        assert all(bound)
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_preemption_skips_affinity_infeasible_nodes(self, mode):
+        # The preemptor requires pod affinity to app=db over zone; eviction
+        # can never create a matching pod in the wrong zone, so victims
+        # there must be left alone even when they are cheaper.
+        stack, agent = make_stack(mode)
+        for n, z in (("a1", "za"), ("b1", "zb")):
+            agent.add_host(n, generation="v5e", chips=2)
+            stack.cluster.put_node(K8sNode(n, labels={ZONE: z}))
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "db",
+                labels={"app": "db", "tpu/chips": "1", "tpu/priority": "10"},
+                node_selector={ZONE: "za"},
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/db").node_name == "a1"
+        # Squatters: cheap one on zb, pricier one filling za's last chip.
+        stack.cluster.create_pod(
+            PodSpec(
+                "cheap-b",
+                labels={"tpu/chips": "2", "tpu/priority": "1"},
+                node_selector={ZONE: "zb"},
+            )
+        )
+        stack.cluster.create_pod(
+            PodSpec(
+                "mid-a",
+                labels={"tpu/chips": "1", "tpu/priority": "5"},
+                node_selector={ZONE: "za"},
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/cheap-b").node_name == "b1"
+        assert stack.cluster.get_pod("default/mid-a").node_name == "a1"
+        stack.cluster.create_pod(
+            PodSpec(
+                "web",
+                labels={"app": "web", "tpu/chips": "1", "tpu/priority": "9"},
+                pod_affinity=(term(ZONE, {"app": "db"}),),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # The cheap zb victim survives; the za squatter is evicted and the
+        # preemptor lands (or is nominated) in the db zone.
+        assert stack.cluster.get_pod("default/cheap-b") is not None
+        assert stack.cluster.get_pod("default/mid-a") is None
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        web = stack.cluster.get_pod("default/web")
+        assert web.node_name in (None, "a1")

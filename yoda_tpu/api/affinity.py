@@ -1,0 +1,504 @@
+"""Inter-pod affinity/anti-affinity and topology-spread constraints.
+
+The reference scheduler wrapped the whole upstream kube-scheduler
+(reference pkg/register/register.go:10), so pods it scheduled got the
+default plugin set's InterPodAffinity and PodTopologySpread behavior for
+free alongside the yoda plugin (reference deploy/yoda-scheduler.yaml:15-27
+enables yoda *in addition to* the defaults). This module restores those
+first-party, on the same evaluation model upstream uses:
+
+- **Required pod affinity**: the candidate node must share a topology
+  domain (same value of ``topologyKey`` in node labels) with at least one
+  existing pod matching the term's label selector. Upstream's first-pod
+  rule applies: a term that matches NO existing pod anywhere, but whose
+  selector matches the incoming pod itself (in its own namespace), is
+  treated as satisfied — otherwise the first replica of a
+  self-affinitizing group could never schedule.
+- **Required pod anti-affinity**: the candidate node must NOT share a
+  topology domain with any existing pod matching the term. A node without
+  the topology key belongs to no domain and never conflicts (upstream
+  semantics).
+- **Anti-affinity symmetry**: an EXISTING pod's required anti-affinity
+  terms also repel the incoming pod (upstream checks both directions;
+  without this, "spread me" pods are only protected against later
+  arrivals, not earlier ones).
+- **Preferred terms** contribute a signed weight sum for scoring.
+- **Topology spread**: ``maxSkew``/``topologyKey``/``whenUnsatisfiable``
+  over the pods matching the constraint's selector in the incoming pod's
+  namespace. ``DoNotSchedule`` filters; ``ScheduleAnyway`` scores.
+
+Scope notes (documented divergences from upstream):
+
+- Only pods on nodes the scheduler snapshots (TPU nodes) are visible; pods
+  on non-TPU nodes neither satisfy affinity nor trigger anti-affinity.
+- In-flight (reserved-but-unbound) pods — e.g. gang siblings waiting in
+  Permit — are not yet "existing pods": enforcement is against bound pods,
+  the same visibility upstream has for unbound nominees.
+- ``namespaceSelector`` and ``minDomains`` are not supported (terms list
+  namespaces explicitly or default to the owner's).
+
+Evaluators are built once per (pod, scheduling cycle) — O(pods x terms)
+precomputation — and answer per-node queries from dict lookups, keeping
+the per-node cost O(terms) on the hot path (SURVEY.md §3.2's hot-loop
+discipline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Iterable, Mapping
+
+from yoda_tpu.api.types import K8sNode, NodeSelectorRequirement, PodSpec
+
+if TYPE_CHECKING:  # the evaluators take duck-typed snapshot/NodeInfo views
+    from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """A v1.LabelSelector. Upstream semantics: an EMPTY selector (present
+    but with no requirements) matches everything; an ABSENT selector is
+    represented by ``None`` at the use site and matches nothing."""
+
+    match_labels: tuple[tuple[str, str], ...] = ()
+    match_expressions: tuple[NodeSelectorRequirement, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        if any(labels.get(k) != v for k, v in self.match_labels):
+            return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+    def to_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.match_labels:
+            out["matchLabels"] = dict(self.match_labels)
+        if self.match_expressions:
+            out["matchExpressions"] = [
+                r.to_obj() for r in self.match_expressions
+            ]
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any] | None) -> "LabelSelector | None":
+        if obj is None:
+            return None
+        return cls(
+            match_labels=tuple(sorted((obj.get("matchLabels") or {}).items())),
+            match_expressions=tuple(
+                NodeSelectorRequirement.from_obj(r)
+                for r in obj.get("matchExpressions") or ()
+            ),
+        )
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """A v1.PodAffinityTerm: selector over pods + the topology key that
+    defines co-location. ``namespaces`` empty = the owner pod's namespace
+    (upstream default)."""
+
+    topology_key: str
+    selector: LabelSelector | None = None
+    namespaces: tuple[str, ...] = ()
+
+    def matches_pod(self, other: PodSpec, owner_namespace: str) -> bool:
+        if self.selector is None:
+            return False  # absent selector matches no objects (upstream)
+        ns = self.namespaces or (owner_namespace,)
+        return other.namespace in ns and self.selector.matches(other.labels)
+
+    def to_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"topologyKey": self.topology_key}
+        if self.selector is not None:
+            out["labelSelector"] = self.selector.to_obj()
+        if self.namespaces:
+            out["namespaces"] = list(self.namespaces)
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "PodAffinityTerm":
+        return cls(
+            topology_key=obj.get("topologyKey", ""),
+            selector=LabelSelector.from_obj(obj.get("labelSelector")),
+            namespaces=tuple(obj.get("namespaces") or ()),
+        )
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    """A v1.TopologySpreadConstraint (selector-scoped skew over topology
+    domains). ``when_unsatisfiable`` is DoNotSchedule (hard) or
+    ScheduleAnyway (soft)."""
+
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = "DoNotSchedule"
+    selector: LabelSelector | None = None
+
+    def to_obj(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "maxSkew": self.max_skew,
+            "topologyKey": self.topology_key,
+            "whenUnsatisfiable": self.when_unsatisfiable,
+        }
+        if self.selector is not None:
+            out["labelSelector"] = self.selector.to_obj()
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "TopologySpreadConstraint":
+        return cls(
+            max_skew=int(obj.get("maxSkew") or 1),
+            topology_key=obj.get("topologyKey", ""),
+            when_unsatisfiable=obj.get("whenUnsatisfiable", "DoNotSchedule"),
+            selector=LabelSelector.from_obj(obj.get("labelSelector")),
+        )
+
+
+# --- v1.Pod spec parsing helpers (used by PodSpec.from_obj) ---
+
+
+def parse_pod_affinity(
+    spec: Mapping[str, Any],
+) -> tuple[
+    tuple[PodAffinityTerm, ...],
+    tuple[PodAffinityTerm, ...],
+    tuple[tuple[int, PodAffinityTerm], ...],
+    tuple[tuple[int, PodAffinityTerm], ...],
+]:
+    """(required affinity, required anti-affinity, preferred affinity,
+    preferred anti-affinity) from a v1.Pod spec mapping."""
+    aff = spec.get("affinity") or {}
+
+    def _required(block: Mapping[str, Any]) -> tuple[PodAffinityTerm, ...]:
+        return tuple(
+            PodAffinityTerm.from_obj(t)
+            for t in block.get("requiredDuringSchedulingIgnoredDuringExecution")
+            or ()
+        )
+
+    def _preferred(
+        block: Mapping[str, Any],
+    ) -> tuple[tuple[int, PodAffinityTerm], ...]:
+        return tuple(
+            (
+                int(p.get("weight") or 0),
+                PodAffinityTerm.from_obj(p.get("podAffinityTerm") or {}),
+            )
+            for p in block.get("preferredDuringSchedulingIgnoredDuringExecution")
+            or ()
+        )
+
+    pa = aff.get("podAffinity") or {}
+    paa = aff.get("podAntiAffinity") or {}
+    return _required(pa), _required(paa), _preferred(pa), _preferred(paa)
+
+
+def parse_topology_spread(
+    spec: Mapping[str, Any],
+) -> tuple[TopologySpreadConstraint, ...]:
+    return tuple(
+        TopologySpreadConstraint.from_obj(c)
+        for c in spec.get("topologySpreadConstraints") or ()
+    )
+
+
+# --- evaluation ---
+
+
+def _node_labels(ni: "NodeInfo") -> Mapping[str, str]:
+    return ni.node.labels if ni.node is not None else {}
+
+
+def pod_has_inter_pod_terms(pod: PodSpec) -> bool:
+    return bool(
+        pod.pod_affinity
+        or pod.pod_anti_affinity
+        or pod.preferred_pod_affinity
+        or pod.preferred_pod_anti_affinity
+    )
+
+
+def fleet_has_anti_affinity(infos: Iterable["NodeInfo"]) -> bool:
+    """Any bound pod anywhere declaring required anti-affinity — the
+    trigger for the symmetry check (callers cache this per snapshot
+    version so affinity-free fleets pay nothing per cycle)."""
+    return any(
+        p.pod_anti_affinity for ni in infos for p in ni.pods
+    )
+
+
+@dataclass
+class InterPodEvaluator:
+    """Per-(pod, cycle) inter-pod affinity oracle.
+
+    Precomputes, from one pass over the snapshot's bound pods:
+
+    - per required-affinity term: the set of topology values whose domain
+      contains a matching pod (``_ok_values``), or the self-match flag;
+    - per required-anti-affinity term: the set of forbidden values;
+    - symmetry: (key, value) domains forbidden by EXISTING pods'
+      anti-affinity terms that match the incoming pod;
+    - per preferred term: value sets for the signed score.
+
+    Per-node queries are then O(terms) dict lookups.
+    """
+
+    pod: PodSpec
+    _ok_values: list[set[str]] = field(default_factory=list)
+    _self_satisfied: list[bool] = field(default_factory=list)
+    _bad_values: list[set[str]] = field(default_factory=list)
+    _symmetry_bad: set[tuple[str, str]] = field(default_factory=set)
+    _pref_values: list[tuple[int, str, set[str]]] = field(default_factory=list)
+
+    @classmethod
+    def build(
+        cls, snapshot: "Snapshot", pod: PodSpec, *, check_symmetry: bool = True
+    ) -> "InterPodEvaluator":
+        ev = cls(pod)
+        n_aff = len(pod.pod_affinity)
+        ev._ok_values = [set() for _ in range(n_aff)]
+        ev._bad_values = [set() for _ in range(len(pod.pod_anti_affinity))]
+        # signed weight, topology key, matching values
+        ev._pref_values = [
+            (w, t.topology_key, set()) for w, t in pod.preferred_pod_affinity
+        ] + [
+            (-w, t.topology_key, set())
+            for w, t in pod.preferred_pod_anti_affinity
+        ]
+        pref_terms = [t for _, t in pod.preferred_pod_affinity] + [
+            t for _, t in pod.preferred_pod_anti_affinity
+        ]
+        any_term_matched = [False] * n_aff
+        for ni in snapshot.infos():
+            labels = _node_labels(ni)
+            for other in ni.pods:
+                if other.uid == pod.uid:
+                    continue  # a relisted copy of the pod itself never
+                    # satisfies its own affinity (upstream parity)
+                for i, term in enumerate(pod.pod_affinity):
+                    if term.matches_pod(other, pod.namespace):
+                        any_term_matched[i] = True
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            ev._ok_values[i].add(v)
+                for j, term in enumerate(pod.pod_anti_affinity):
+                    if term.matches_pod(other, pod.namespace):
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            ev._bad_values[j].add(v)
+                for k, term in enumerate(pref_terms):
+                    if term.matches_pod(other, pod.namespace):
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            ev._pref_values[k][2].add(v)
+                if check_symmetry and other.pod_anti_affinity:
+                    for term in other.pod_anti_affinity:
+                        if term.matches_pod(pod, other.namespace):
+                            v = labels.get(term.topology_key)
+                            if v is not None:
+                                ev._symmetry_bad.add((term.topology_key, v))
+        # Upstream first-pod rule: a required-affinity term matching no
+        # existing pod anywhere is satisfied iff the incoming pod matches
+        # its own term — the group's first member bootstraps the domain.
+        ev._self_satisfied = [
+            (not any_term_matched[i]) and term.matches_pod(pod, pod.namespace)
+            for i, term in enumerate(pod.pod_affinity)
+        ]
+        return ev
+
+    @property
+    def trivial(self) -> bool:
+        """True when no per-node check or score could ever fire."""
+        return (
+            not self.pod.pod_affinity
+            and not self.pod.pod_anti_affinity
+            and not self._symmetry_bad
+            and not self._pref_values
+        )
+
+    @property
+    def has_preferences(self) -> bool:
+        """True when some node could receive a nonzero preference() —
+        scoring fast-paths gate on this, not on evaluator existence (an
+        evaluator built only for the symmetry check has no preferences)."""
+        return bool(self._pref_values)
+
+    def required_affinity_feasible(self, ni: "NodeInfo") -> bool:
+        """Just the required-AFFINITY half of :meth:`feasible`. Within a
+        cycle, eviction can only REMOVE matching pods — an ok-domain set
+        never grows — so preemption uses this to skip nodes the preemptor
+        could never land on no matter what is evicted (anti-affinity /
+        symmetry / spread conflicts are deliberately NOT checked here:
+        eviction can cure those)."""
+        labels = _node_labels(ni)
+        for i, term in enumerate(self.pod.pod_affinity):
+            if self._self_satisfied[i]:
+                continue
+            v = labels.get(term.topology_key)
+            if v is None or v not in self._ok_values[i]:
+                return False
+        return True
+
+    def feasible(self, ni: "NodeInfo") -> tuple[bool, str]:
+        labels = _node_labels(ni)
+        for i, term in enumerate(self.pod.pod_affinity):
+            if self._self_satisfied[i]:
+                continue
+            v = labels.get(term.topology_key)
+            if v is None or v not in self._ok_values[i]:
+                return False, (
+                    "no pod matching required pod affinity in the node's "
+                    f"{term.topology_key!r} domain"
+                )
+        for j, term in enumerate(self.pod.pod_anti_affinity):
+            v = labels.get(term.topology_key)
+            if v is not None and v in self._bad_values[j]:
+                return False, (
+                    "required pod anti-affinity conflicts with a pod in the "
+                    f"node's {term.topology_key!r} domain"
+                )
+        for key, bad in self._symmetry_bad:
+            if labels.get(key) == bad:
+                return False, (
+                    "an existing pod's required anti-affinity repels this "
+                    f"pod from the node's {key!r} domain"
+                )
+        return True, ""
+
+    def preference(self, ni: "NodeInfo") -> int:
+        """Signed sum of preferred term weights this node satisfies."""
+        if not self._pref_values:
+            return 0
+        labels = _node_labels(ni)
+        total = 0
+        for w, key, values in self._pref_values:
+            v = labels.get(key)
+            if v is not None and v in values:
+                total += w
+        return total
+
+
+@dataclass
+class SpreadEvaluator:
+    """Per-(pod, cycle) topology-spread oracle.
+
+    For each constraint, counts pods matching its selector (in the
+    incoming pod's namespace) per topology domain, over nodes eligible for
+    the pod (nodeSelector + required node affinity, upstream's
+    domain-eligibility rule) that carry the topology key. Skew for placing
+    on domain ``v`` is ``count[v] + 1 - min(counts)``.
+    """
+
+    pod: PodSpec
+    # per constraint: (constraint, counts by value, min count over domains)
+    _per: list[tuple[TopologySpreadConstraint, dict[str, int], int]] = field(
+        default_factory=list
+    )
+
+    @staticmethod
+    def _domain_eligible(ni: "NodeInfo", pod: PodSpec) -> bool:
+        """Upstream's domain-eligibility rule: only the pod's own node
+        steering (nodeSelector + required node affinity) decides which
+        domains "exist" for balancing — taints and cordon deliberately
+        excluded (upstream default)."""
+        if not pod.node_selector and not pod.node_affinity:
+            return True
+        if ni.node is None:
+            return False
+        labels = ni.node.labels
+        if any(labels.get(k) != v for k, v in pod.node_selector.items()):
+            return False
+        if pod.node_affinity and not any(
+            t.matches(labels, ni.node.name) for t in pod.node_affinity
+        ):
+            return False
+        return True
+
+    @classmethod
+    def build(cls, snapshot: "Snapshot", pod: PodSpec) -> "SpreadEvaluator":
+        ev = cls(pod)
+        if not pod.topology_spread:
+            return ev
+        counted: list[dict[str, int]] = [{} for _ in pod.topology_spread]
+        for ni in snapshot.infos():
+            if not cls._domain_eligible(ni, pod):
+                continue
+            labels = _node_labels(ni)
+            for c_i, c in enumerate(pod.topology_spread):
+                v = labels.get(c.topology_key)
+                if v is None:
+                    continue
+                counts = counted[c_i]
+                counts.setdefault(v, 0)
+                for other in ni.pods:
+                    if other.uid == pod.uid:
+                        continue
+                    if other.namespace != pod.namespace:
+                        continue
+                    if c.selector is not None and c.selector.matches(
+                        other.labels
+                    ):
+                        counts[v] += 1
+        ev._per = [
+            (c, counts, min(counts.values()) if counts else 0)
+            for c, counts in zip(pod.topology_spread, counted)
+        ]
+        return ev
+
+    @property
+    def trivial(self) -> bool:
+        return not self._per
+
+    @property
+    def has_soft(self) -> bool:
+        """Any ScheduleAnyway constraint — the only kind :meth:`score`
+        considers, so scoring fast-paths gate on this."""
+        return any(
+            c.when_unsatisfiable == "ScheduleAnyway" for c, _, _ in self._per
+        )
+
+    @property
+    def has_hard(self) -> bool:
+        return any(
+            c.when_unsatisfiable == "DoNotSchedule" for c, _, _ in self._per
+        )
+
+    def feasible(self, ni: "NodeInfo") -> tuple[bool, str]:
+        labels = _node_labels(ni)
+        for c, counts, lo in self._per:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            v = labels.get(c.topology_key)
+            if v is None:
+                return False, (
+                    f"node lacks topology key {c.topology_key!r} required "
+                    "by a DoNotSchedule spread constraint"
+                )
+            if counts.get(v, 0) + 1 - lo > c.max_skew:
+                return False, (
+                    f"placing here would exceed maxSkew={c.max_skew} over "
+                    f"{c.topology_key!r}"
+                )
+        return True, ""
+
+    def score(self, ni: "NodeInfo") -> int:
+        """[0, 100] balance score, averaged over the soft (ScheduleAnyway)
+        constraints only — upstream PodTopologySpread's scorer ignores
+        DoNotSchedule constraints (those already filtered): 100 = the
+        emptiest domain, 0 = the fullest."""
+        total = 0
+        n = 0
+        for c, counts, lo in self._per:
+            if c.when_unsatisfiable != "ScheduleAnyway":
+                continue
+            v = _node_labels(ni).get(c.topology_key)
+            n += 1
+            if v is None or not counts:
+                continue
+            hi = max(counts.values())
+            if hi <= lo:
+                total += 100
+            else:
+                total += 100 * (hi - counts.get(v, 0)) // (hi - lo)
+        return total // n if n else 0

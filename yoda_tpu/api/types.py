@@ -508,6 +508,17 @@ class PodSpec:
     # preferredDuringSchedulingIgnoredDuringExecution — (weight, term)
     # pairs, scored by preferred_affinity_score (soft steering).
     preferred_node_affinity: tuple[tuple[int, NodeSelectorTerm], ...] = ()
+    # spec.affinity.podAffinity / podAntiAffinity (api.affinity module):
+    # required terms filter, preferred terms score, existing pods'
+    # anti-affinity is enforced symmetrically. The reference inherited
+    # these from the upstream default plugins it ran alongside
+    # (deploy/yoda-scheduler.yaml:15-27 adds yoda to the defaults).
+    pod_affinity: tuple = ()          # tuple[PodAffinityTerm, ...]
+    pod_anti_affinity: tuple = ()     # tuple[PodAffinityTerm, ...]
+    preferred_pod_affinity: tuple = ()       # tuple[(int, PodAffinityTerm)]
+    preferred_pod_anti_affinity: tuple = ()  # tuple[(int, PodAffinityTerm)]
+    # spec.topologySpreadConstraints (api.affinity.TopologySpreadConstraint).
+    topology_spread: tuple = ()
     # Sum of the containers' google.com/tpu resource limits — how
     # unmodified GKE TPU workloads request chips (requests.pod_request uses
     # it as the chip count when no tpu/chips label is present).
@@ -535,6 +546,7 @@ class PodSpec:
             spec["tolerations"] = [t.to_obj() for t in self.tolerations]
         if self.node_selector:
             spec["nodeSelector"] = dict(self.node_selector)
+        affinity: dict[str, Any] = {}
         if self.node_affinity or self.preferred_node_affinity:
             na: dict[str, Any] = {}
             if self.node_affinity:
@@ -548,7 +560,34 @@ class PodSpec:
                     {"weight": w, "preference": t.to_obj()}
                     for w, t in self.preferred_node_affinity
                 ]
-            spec["affinity"] = {"nodeAffinity": na}
+            affinity["nodeAffinity"] = na
+        for key, req, pref in (
+            ("podAffinity", self.pod_affinity, self.preferred_pod_affinity),
+            (
+                "podAntiAffinity",
+                self.pod_anti_affinity,
+                self.preferred_pod_anti_affinity,
+            ),
+        ):
+            if not req and not pref:
+                continue
+            block: dict[str, Any] = {}
+            if req:
+                block["requiredDuringSchedulingIgnoredDuringExecution"] = [
+                    t.to_obj() for t in req
+                ]
+            if pref:
+                block["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                    {"weight": w, "podAffinityTerm": t.to_obj()}
+                    for w, t in pref
+                ]
+            affinity[key] = block
+        if affinity:
+            spec["affinity"] = affinity
+        if self.topology_spread:
+            spec["topologySpreadConstraints"] = [
+                c.to_obj() for c in self.topology_spread
+            ]
         if self.spec_priority:
             spec["priority"] = self.spec_priority
         if self.tpu_resource_limit:
@@ -602,9 +641,21 @@ class PodSpec:
                 _pod_seq = itertools.count(restored + 1)
             else:
                 _pod_seq = itertools.count(nxt)
+        # Deferred import: affinity builds on this module's selector types.
+        from yoda_tpu.api.affinity import (
+            parse_pod_affinity,
+            parse_topology_spread,
+        )
+
+        pa, paa, ppa, ppaa = parse_pod_affinity(spec)
         return cls(
             name=md["name"],
             namespace=md.get("namespace", "default"),
+            pod_affinity=pa,
+            pod_anti_affinity=paa,
+            preferred_pod_affinity=ppa,
+            preferred_pod_anti_affinity=ppaa,
+            topology_spread=parse_topology_spread(spec),
             labels=dict(md.get("labels", {})),
             scheduler_name=spec.get("schedulerName", "yoda-tpu"),
             node_name=spec.get("nodeName"),

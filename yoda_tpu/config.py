@@ -40,6 +40,14 @@ class Weights:
     # scoring half, simplified: per-taint penalty, no fleet-wide
     # normalization); 0 disables.
     taint_prefer: int = 1
+    # Inter-pod soft steering: the signed preferred pod-(anti-)affinity
+    # weight sum (api.affinity.InterPodEvaluator.preference) x this weight;
+    # 0 disables (upstream InterPodAffinity's scoring half).
+    pod_affinity: int = 1
+    # Topology-spread balance: the [0,100] ScheduleAnyway balance score
+    # (api.affinity.SpreadEvaluator.score) x this weight; 0 disables
+    # (upstream PodTopologySpread's scoring half).
+    topology_spread: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "Weights":

@@ -50,7 +50,11 @@ from yoda_tpu.ops.kernel import (
     REASON_MESSAGES,
 )
 from yoda_tpu.config import Weights
-from yoda_tpu.plugins.yoda.filter_plugin import get_request
+from yoda_tpu.plugins.yoda.filter_plugin import (
+    AffinityData,
+    get_affinity,
+    get_request,
+)
 from yoda_tpu.plugins.yoda.gang import ALLOWED_HOSTS_KEY, GANG_REMAINING_KEY
 
 # Below this many padded [N, C] elements the kernel is pinned to host CPU in
@@ -82,26 +86,41 @@ def _pod_constraints(pod: PodSpec) -> tuple:
         tuple(sorted(pod.node_selector.items())),
         tuple(pod.node_affinity),
         tuple(pod.preferred_node_affinity),
+        pod.pod_affinity,
+        pod.pod_anti_affinity,
+        pod.preferred_pod_affinity,
+        pod.preferred_pod_anti_affinity,
+        pod.topology_spread,
     )
 
 
 def _host_admission(
-    static: FleetArrays, snapshot: Snapshot, pod: PodSpec
+    static: FleetArrays,
+    snapshot: Snapshot,
+    pod: PodSpec,
+    aff: "AffinityData | None" = None,
 ) -> np.ndarray:
     """Per-pod Node-object admission vector: cordon + taints vs the pod's
-    tolerations (semantics: api.types.node_admits_pod). Padding rows are
-    masked by node_valid in the kernel, so their value is irrelevant."""
-    ok = np.array(
-        [
-            pod_admits_on(snapshot.get(name).node, pod)[0]
-            if name in snapshot
-            else True
-            for name in static.names
-        ]
+    tolerations (semantics: api.types.node_admits_pod), plus inter-pod
+    affinity / topology-spread feasibility when the PreFilter built
+    evaluators (api.affinity — absent for the vast majority of pods, so
+    the common path stays one pod_admits_on call per node). Padding rows
+    are masked by node_valid in the kernel, so their value is
+    irrelevant."""
+
+    def _ok(name: str) -> bool:
+        if name not in snapshot:
+            return True
+        ni = snapshot.get(name)
+        if not pod_admits_on(ni.node, pod)[0]:
+            return False
+        return aff is None or aff.feasible(ni)[0]
+
+    return np.array(
+        [_ok(name) for name in static.names]
         + [True] * (static.node_valid.shape[0] - len(static.names)),
         dtype=bool,
     )
-    return ok
 
 
 @dataclass
@@ -282,23 +301,26 @@ class YodaBatch(BatchFilterScorePlugin):
             if served is not None:
                 return served
         static = self._refresh_static(snapshot)
+        aff = get_affinity(state)
         # Reservations/claims/freshness change cycle-to-cycle without a
-        # metrics bump, and Node-object admission (cordon + taints vs THIS
-        # pod's tolerations) is per (pod, cycle): one packed upload.
+        # metrics bump, and Node-object admission (cordon + taints +
+        # inter-pod affinity/spread vs THIS pod) is per (pod, cycle): one
+        # packed upload.
         dyn = static.dyn_packed(
             self.reserved_fn,
             self.claimed_fn,
             max_metrics_age_s=self.max_metrics_age_s,
-            host_ok=_host_admission(static, snapshot, pod),
+            host_ok=_host_admission(static, snapshot, pod, aff),
         )
         result = self._kern.evaluate(dyn, reqk)
         self.dispatch_count += 1
-        # Soft steering (preferredDuringScheduling node affinity) is a
-        # host-side additive term — per (pod, node), like the admission
-        # vector, so it stays out of the fleet-static kernel inputs. It
-        # must be part of the ONE score the driver and the gang plan both
-        # rank by, or plan picks would diverge from the driver's argmax.
-        pref_bonus = self._preference_bonus(static, snapshot, pod)
+        # Soft steering (preferredDuringScheduling node affinity, preferred
+        # pod affinity, spread balance) is a host-side additive term — per
+        # (pod, node), like the admission vector, so it stays out of the
+        # fleet-static kernel inputs. It must be part of the ONE score the
+        # driver and the gang plan both rank by, or plan picks would
+        # diverge from the driver's argmax.
+        pref_bonus = self._preference_bonus(static, snapshot, pod, aff)
         statuses: dict[str, Status] = {}
         scores: dict[str, int] = {}
         for i, name in enumerate(static.names):
@@ -322,12 +344,17 @@ class YodaBatch(BatchFilterScorePlugin):
         return statuses, scores
 
     def _preference_bonus(
-        self, static: FleetArrays, snapshot: Snapshot, pod: PodSpec
+        self,
+        static: FleetArrays,
+        snapshot: Snapshot,
+        pod: PodSpec,
+        aff: AffinityData | None = None,
     ) -> np.ndarray:
         """[n_nodes] int64 soft score per real node row: preferred-affinity
         bonus minus the PreferNoSchedule penalty (100 per untolerated soft
-        taint) — api.types semantics, mirrored by loop mode's
-        PreferredAffinityScore."""
+        taint), plus the signed preferred pod-(anti-)affinity sum and the
+        [0,100] spread-balance score — api.types / api.affinity semantics,
+        mirrored by loop mode's PreferredAffinityScore."""
         n = len(static.names)
         out = np.zeros(n, dtype=np.int64)
         w_pref = self.weights.preferred_affinity
@@ -336,8 +363,25 @@ class YodaBatch(BatchFilterScorePlugin):
             if self._fleet_has_soft_taints(snapshot)
             else 0
         )
+        w_pod = self.weights.pod_affinity
+        w_spread = self.weights.topology_spread
+        # Gate on actual contribution, not evaluator existence: an
+        # evaluator built only for the symmetry filter has no preferred
+        # terms and must not re-introduce the O(N) loop.
+        inter = (
+            aff.inter
+            if (aff is not None and w_pod and aff.inter is not None
+                and aff.inter.has_preferences)
+            else None
+        )
+        spread = (
+            aff.spread
+            if (aff is not None and w_spread and aff.spread is not None
+                and aff.spread.has_soft)
+            else None
+        )
         want_pref = w_pref and pod.preferred_node_affinity
-        if not want_pref and not w_taint:
+        if not want_pref and not w_taint and inter is None and spread is None:
             # The common case (no preferences, taint-free fleet) pays no
             # O(N) Python loop — the batch path's whole point.
             return out
@@ -349,6 +393,11 @@ class YodaBatch(BatchFilterScorePlugin):
                 v += preferred_affinity_score(node, pod) * w_pref
             if w_taint:
                 v -= 100 * w_taint * untolerated_soft_taints(node, pod)
+            if ni is not None:
+                if inter is not None:
+                    v += inter.preference(ni) * w_pod
+                if spread is not None:
+                    v += spread.score(ni) * w_spread
             out[i] = v
         return out
 
@@ -392,6 +441,22 @@ class YodaBatch(BatchFilterScorePlugin):
             self.reserved_fn is None
             or result.claimable is None
             or not snapshot.version  # 0 = uncacheable snapshot
+        ):
+            return
+        # Required inter-pod terms / hard spread constraints are evaluated
+        # against BOUND pods only, so a plan placing k siblings at once
+        # cannot see the mutual exclusion between its own members (e.g.
+        # self-anti-affinity over hostname would stack all k on the
+        # top-ranked node). Refuse to plan; per-member dispatches keep the
+        # per-cycle evaluator semantics. Preferred-only terms are safe: they
+        # rank, never exclude, and are identical across plan-served siblings.
+        if (
+            pod.pod_affinity
+            or pod.pod_anti_affinity
+            or any(
+                c.when_unsatisfiable == "DoNotSchedule"
+                for c in pod.topology_spread
+            )
         ):
             return
         k = (

@@ -23,6 +23,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable
 
+from yoda_tpu.api.affinity import (
+    InterPodEvaluator,
+    SpreadEvaluator,
+    fleet_has_anti_affinity,
+    pod_has_inter_pod_terms,
+)
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
 from yoda_tpu.api.types import TpuChip, TpuNodeMetrics, pod_admits_on
 from yoda_tpu.framework.cyclestate import CycleState
@@ -36,6 +42,7 @@ from yoda_tpu.framework.interfaces import (
 from yoda_tpu.api.types import PodSpec
 
 REQUEST_KEY = "yoda-tpu/request"
+AFFINITY_KEY = "yoda-tpu/affinity"
 
 
 @dataclass
@@ -52,6 +59,39 @@ def get_request(state: CycleState) -> TpuRequest:
     data = state.read(REQUEST_KEY)
     assert isinstance(data, RequestData)
     return data.request
+
+
+@dataclass
+class AffinityData:
+    """CycleState carrier for the per-cycle inter-pod affinity and
+    topology-spread evaluators (api.affinity). Built once in PreFilter;
+    ``None`` members mean the dimension cannot fire for this (pod, cycle),
+    so per-node checks are skipped entirely."""
+
+    inter: InterPodEvaluator | None = None
+    spread: SpreadEvaluator | None = None
+
+    def clone(self) -> "AffinityData":
+        return self
+
+    def feasible(self, node) -> tuple[bool, str]:
+        if self.inter is not None:
+            ok, why = self.inter.feasible(node)
+            if not ok:
+                return ok, why
+        if self.spread is not None:
+            ok, why = self.spread.feasible(node)
+            if not ok:
+                return ok, why
+        return True, ""
+
+
+def get_affinity(state: CycleState) -> AffinityData | None:
+    if not state.contains(AFFINITY_KEY):
+        return None
+    data = state.read(AFFINITY_KEY)
+    assert isinstance(data, AffinityData)
+    return data
 
 
 # --- pure predicates (reference filter.go parity) ---
@@ -189,9 +229,27 @@ def available_chips(
 class YodaPreFilter(PreFilterPlugin):
     """Parses the pod's tpu/* labels once per cycle into CycleState.
     Malformed labels are UnschedulableAndUnresolvable (retries cannot help),
-    unlike the reference's silent-zero (filter.go:60-74)."""
+    unlike the reference's silent-zero (filter.go:60-74).
+
+    Also builds the per-cycle inter-pod affinity / topology-spread
+    evaluators (api.affinity) when they could matter: the pod declares
+    terms, or some bound pod declares required anti-affinity (the symmetry
+    direction). Affinity-free fleets pay only a cached per-snapshot-version
+    flag check here — nothing per node."""
 
     name = "yoda-prefilter"
+
+    def __init__(self) -> None:
+        # (snapshot.version, any bound pod has required anti-affinity)
+        self._anti_cache: tuple[int, bool] = (0, False)
+
+    def _symmetry_possible(self, snapshot: Snapshot) -> bool:
+        if snapshot.version and self._anti_cache[0] == snapshot.version:
+            return self._anti_cache[1]
+        flag = fleet_has_anti_affinity(snapshot.infos())
+        if snapshot.version:
+            self._anti_cache = (snapshot.version, flag)
+        return flag
 
     def pre_filter(self, state: CycleState, pod: PodSpec, snapshot: Snapshot) -> Status:
         try:
@@ -199,6 +257,15 @@ class YodaPreFilter(PreFilterPlugin):
         except LabelParseError as e:
             return Status.unresolvable(f"invalid tpu/* labels: {e}")
         state.write(REQUEST_KEY, RequestData(req))
+        inter = spread = None
+        if pod_has_inter_pod_terms(pod) or self._symmetry_possible(snapshot):
+            inter = InterPodEvaluator.build(snapshot, pod)
+            if inter.trivial:
+                inter = None
+        if pod.topology_spread:
+            spread = SpreadEvaluator.build(snapshot, pod)
+        if inter is not None or spread is not None:
+            state.write(AFFINITY_KEY, AffinityData(inter, spread))
         return Status.ok()
 
 
@@ -233,6 +300,11 @@ class YodaFilter(FilterPlugin):
         admitted, why = pod_admits_on(node.node, pod)
         if not admitted:
             return Status.unschedulable(f"node {node.name}: {why}")
+        aff = get_affinity(state)
+        if aff is not None:
+            admitted, why = aff.feasible(node)
+            if not admitted:
+                return Status.unschedulable(f"node {node.name}: {why}")
         tpu = node.tpu
         if tpu is None:
             # Reference: SCV Get error -> Unschedulable (scheduler.go:72-74).

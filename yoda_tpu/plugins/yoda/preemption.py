@@ -56,8 +56,10 @@ from yoda_tpu.framework.interfaces import (
 )
 from yoda_tpu.plugins.yoda.filter_plugin import (
     REQUEST_KEY,
+    AffinityData,
     apparently_used_chips,
     available_chips,
+    get_affinity,
     get_request,
     qualifying_chips,
 )
@@ -145,7 +147,11 @@ class TpuPreemption(PostFilterPlugin):
         return out
 
     def _node_eligible(
-        self, ni: NodeInfo, req: TpuRequest, pod: PodSpec
+        self,
+        ni: NodeInfo,
+        req: TpuRequest,
+        pod: PodSpec,
+        aff: AffinityData | None = None,
     ) -> bool:
         """Eviction can only ever help on nodes the preemptor could pass
         Filter on once capacity frees up — generation is immutable
@@ -156,6 +162,11 @@ class TpuPreemption(PostFilterPlugin):
             ni.tpu is not None
             and ni.tpu.generation_rank >= req.min_generation_rank
             and pod_admits_on(ni.node, pod)[0]
+            and (
+                aff is None
+                or aff.inter is None
+                or aff.inter.required_affinity_feasible(ni)
+            )
         )
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
@@ -211,10 +222,11 @@ class TpuPreemption(PostFilterPlugin):
         needed: int,
         max_priority: int,
         pod: PodSpec,
+        aff: AffinityData | None = None,
     ) -> list[Victim] | None:
         """Smallest eviction-ordered victim prefix making ``needed`` member
         slots of ``req`` available on the node, or None."""
-        if not self._node_eligible(ni, req, pod):
+        if not self._node_eligible(ni, req, pod, aff):
             return None
         victims = self._victims_on(ni, max_priority)
         chosen: list[Victim] = []
@@ -241,17 +253,27 @@ class TpuPreemption(PostFilterPlugin):
             # Label parsing itself failed; eviction cannot help.
             return None, Status.unschedulable("no parsed request; cannot preempt")
         req = get_request(state)
+        # Required pod-affinity domains are immutable under eviction (it
+        # only removes matching pods, never adds them), so nodes failing
+        # that check are never worth evicting on — same class of guard as
+        # generation/cordon in _node_eligible. Anti-affinity/symmetry/
+        # spread conflicts CAN be cured by eviction and are not checked.
+        aff = get_affinity(state)
         if req.gang is not None:
-            return self._preempt_for_gang(pod, req, snapshot)
-        return self._preempt_for_pod(pod, req, snapshot)
+            return self._preempt_for_gang(pod, req, snapshot, aff)
+        return self._preempt_for_pod(pod, req, snapshot, aff)
 
     def _preempt_for_pod(
-        self, pod: PodSpec, req: TpuRequest, snapshot: Snapshot
+        self,
+        pod: PodSpec,
+        req: TpuRequest,
+        snapshot: Snapshot,
+        aff: AffinityData | None = None,
     ) -> tuple[str | None, Status]:
         best: tuple[tuple[int, int, int, str], list[Victim], str] | None = None
         for ni in snapshot.infos():
             victims = self._minimal_set(
-                ni, req, 1, req.priority, pod
+                ni, req, 1, req.priority, pod, aff
             )
             if victims is None or not victims:
                 continue
@@ -281,7 +303,11 @@ class TpuPreemption(PostFilterPlugin):
         )
 
     def _preempt_for_gang(
-        self, pod: PodSpec, req: TpuRequest, snapshot: Snapshot
+        self,
+        pod: PodSpec,
+        req: TpuRequest,
+        snapshot: Snapshot,
+        aff: AffinityData | None = None,
     ) -> tuple[str | None, Status]:
         gang = req.gang
         assert gang is not None
@@ -293,14 +319,14 @@ class TpuPreemption(PostFilterPlugin):
         remaining = max(gang.size - bound - waiting, 1)
         if gang.topology is not None:
             if waiting:
-                return self._preempt_on_planned_hosts(pod, req, snapshot)
-            return self._preempt_for_topology_gang(pod, req, snapshot)
+                return self._preempt_on_planned_hosts(pod, req, snapshot, aff)
+            return self._preempt_for_topology_gang(pod, req, snapshot, aff)
 
         # Plain gang: evict globally-cheapest victims until enough slots.
         per_node: dict[str, list[Victim]] = {}
         slots = 0
         for ni in snapshot.infos():
-            if not self._node_eligible(ni, req, pod):
+            if not self._node_eligible(ni, req, pod, aff):
                 continue
             slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
             per_node[ni.name] = self._victims_on(ni, req.priority)
@@ -322,13 +348,13 @@ class TpuPreemption(PostFilterPlugin):
                     continue
                 ni = snapshot.get(name)
                 freed = freed_by_node.get(name, 0)
-                base = self._member_slots_after(ni, req, freed, pod)
+                base = self._member_slots_after(ni, req, freed, pod, aff)
                 acc, prefix = 0, []
                 for v in vs:
                     prefix.append(v)
                     acc += v.chips
                     gained = (
-                        self._member_slots_after(ni, req, freed + acc, pod)
+                        self._member_slots_after(ni, req, freed + acc, pod, aff)
                         - base
                     )
                     if gained > 0:
@@ -373,13 +399,18 @@ class TpuPreemption(PostFilterPlugin):
         req: TpuRequest,
         freed: int,
         pod: PodSpec,
+        aff: AffinityData | None = None,
     ) -> int:
-        if not self._node_eligible(ni, req, pod):
+        if not self._node_eligible(ni, req, pod, aff):
             return 0
         return self._avail_after(ni, req, freed) // max(req.effective_chips, 1)
 
     def _preempt_on_planned_hosts(
-        self, pod: PodSpec, req: TpuRequest, snapshot: Snapshot
+        self,
+        pod: PodSpec,
+        req: TpuRequest,
+        snapshot: Snapshot,
+        aff: AffinityData | None = None,
     ) -> tuple[str | None, Status]:
         """Mid-flight topology gang: members wait at Permit, the plan is
         frozen — evict squatters from the plan's unreserved hosts only."""
@@ -397,7 +428,7 @@ class TpuPreemption(PostFilterPlugin):
             if h not in snapshot:
                 continue
             vs = self._minimal_set(
-                snapshot.get(h), req, 1, req.priority, pod
+                snapshot.get(h), req, 1, req.priority, pod, aff
             )
             if vs is None:
                 continue
@@ -423,7 +454,11 @@ class TpuPreemption(PostFilterPlugin):
         )
 
     def _preempt_for_topology_gang(
-        self, pod: PodSpec, req: TpuRequest, snapshot: Snapshot
+        self,
+        pod: PodSpec,
+        req: TpuRequest,
+        snapshot: Snapshot,
+        aff: AffinityData | None = None,
     ) -> tuple[str | None, Status]:
         gang = req.gang
         assert gang is not None and gang.topology is not None
@@ -441,7 +476,9 @@ class TpuPreemption(PostFilterPlugin):
 
         def host_ok(ni: NodeInfo) -> bool:
             if ni.name not in sets:
-                sets[ni.name] = self._minimal_set(ni, req, 1, req.priority, pod)
+                sets[ni.name] = self._minimal_set(
+                    ni, req, 1, req.priority, pod, aff
+                )
             return sets[ni.name] is not None
 
         plan = plan_multislice_placement(

@@ -35,7 +35,11 @@ from yoda_tpu.api.types import (
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import NodeInfo, ScorePlugin, Status
 from yoda_tpu.plugins.yoda.collection import MAX_KEY, MaxValueData
-from yoda_tpu.plugins.yoda.filter_plugin import get_request, qualifying_chips
+from yoda_tpu.plugins.yoda.filter_plugin import (
+    get_affinity,
+    get_request,
+    qualifying_chips,
+)
 
 
 def chip_score(value: MaxValueData, chip: TpuChip, w: Weights) -> int:
@@ -122,10 +126,13 @@ class YodaScore(ScorePlugin):
 
 class PreferredAffinityScore(ScorePlugin):
     """Soft steering and avoidance (upstream NodeAffinity scoring +
-    TaintToleration's scoring half): preferredDuringScheduling term-weight
-    satisfaction ([0,100] x weight) minus 100 x weight per untolerated
-    PreferNoSchedule taint. Already on the final scale — ``normalize`` is
-    the identity (same pattern as SliceProtectScore)."""
+    TaintToleration's scoring half + InterPodAffinity and PodTopologySpread
+    scoring): preferredDuringScheduling term-weight satisfaction
+    ([0,100] x weight) minus 100 x weight per untolerated PreferNoSchedule
+    taint, plus the signed preferred pod-(anti-)affinity sum and the
+    [0,100] spread-balance score (evaluators built by YodaPreFilter).
+    Already on the final scale — ``normalize`` is the identity (same
+    pattern as SliceProtectScore)."""
 
     name = "yoda-preferred-affinity"
 
@@ -134,11 +141,17 @@ class PreferredAffinityScore(ScorePlugin):
 
     def score(self, state: CycleState, pod: PodSpec, node: NodeInfo) -> tuple[int, Status]:
         w = self.weights
-        return (
+        total = (
             preferred_affinity_score(node.node, pod) * w.preferred_affinity
-            - 100 * w.taint_prefer * untolerated_soft_taints(node.node, pod),
-            Status.ok(),
+            - 100 * w.taint_prefer * untolerated_soft_taints(node.node, pod)
         )
+        aff = get_affinity(state)
+        if aff is not None:
+            if aff.inter is not None and w.pod_affinity:
+                total += aff.inter.preference(node) * w.pod_affinity
+            if aff.spread is not None and w.topology_spread:
+                total += aff.spread.score(node) * w.topology_spread
+        return total, Status.ok()
 
     def normalize(self, state: CycleState, pod: PodSpec, scores: dict[str, int]) -> Status:
         return Status.ok()
