@@ -530,3 +530,195 @@ class TestReviewRegressions:
         stack.scheduler.run_until_idle(max_wall_s=5)
         web = stack.cluster.get_pod("default/web")
         assert web.node_name in (None, "a1")
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestGangSiblingVisibility:
+    """Gang members parked at Permit are fed to the evaluators as pending
+    placements (GangPlugin.pending_placements), so inter-pod terms hold
+    BETWEEN the members of one gang, not just against bound pods."""
+
+    def _hosts(self, stack, agent, names, zone=None):
+        for n in names:
+            agent.add_host(n, generation="v5e", chips=8)
+            labels = {HOSTNAME: n}
+            if zone:
+                labels[ZONE] = zone[n]
+            stack.cluster.put_node(K8sNode(n, labels=labels))
+        agent.publish_all()
+
+    def _gang_pod(self, name, gang, size, **kw):
+        return PodSpec(
+            name,
+            labels={
+                "tpu/gang": gang,
+                "tpu/gang-size": str(size),
+                "tpu/chips": "1",
+                "app": gang,
+            },
+            **kw,
+        )
+
+    def test_anti_affinity_gang_spreads_across_hosts(self, mode):
+        # Capacity alone would stack all three members on one 8-chip host;
+        # the pending-placements feed makes each sibling avoid the hosts
+        # its predecessors reserved.
+        stack, agent = make_stack(mode)
+        self._hosts(stack, agent, ["h1", "h2", "h3"])
+        anti = (term(HOSTNAME, {"app": "g"}),)
+        for i in range(3):
+            stack.cluster.create_pod(
+                self._gang_pod(f"g-{i}", "g", 3, pod_anti_affinity=anti)
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = {
+            stack.cluster.get_pod(f"default/g-{i}").node_name
+            for i in range(3)
+        }
+        assert bound == {"h1", "h2", "h3"}
+
+    def test_oversized_anti_affinity_gang_parks_without_reserving(self, mode):
+        # Two hosts cannot hold three mutually-exclusive members: the
+        # admission domain cap must park the gang at PreFilter — no
+        # reservations held, no permit-timeout cascade.
+        stack, agent = make_stack(mode)
+        self._hosts(stack, agent, ["h1", "h2"])
+        anti = (term(HOSTNAME, {"app": "g"}),)
+        for i in range(3):
+            stack.cluster.create_pod(
+                self._gang_pod(f"g-{i}", "g", 3, pod_anti_affinity=anti)
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        for i in range(3):
+            assert stack.cluster.get_pod(f"default/g-{i}").node_name is None
+        assert stack.accountant.chips_in_use("h1") == 0
+        assert stack.accountant.chips_in_use("h2") == 0
+
+    def test_affinity_gang_co_locates_by_zone(self, mode):
+        # Member 1 bootstraps via the first-pod rule; member 2 must follow
+        # it into the same zone because the pending placement already
+        # populates the term's ok-domain set.
+        stack, agent = make_stack(mode)
+        zone = {"a1": "za", "a2": "za", "b1": "zb", "b2": "zb"}
+        self._hosts(stack, agent, list(zone), zone=zone)
+        aff = (term(ZONE, {"app": "g"}),)
+        for i in range(2):
+            stack.cluster.create_pod(
+                self._gang_pod(f"g-{i}", "g", 2, pod_affinity=aff)
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        zones = {
+            zone[stack.cluster.get_pod(f"default/g-{i}").node_name]
+            for i in range(2)
+        }
+        assert len(zones) == 1
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestSelfAffinityGang:
+    """Required self pod-AFFINITY gangs: every member must share one
+    domain, so admission caps at max-per-domain (not the fleet sum) and
+    the first member is steered into a domain that fits the remainder."""
+
+    def _zone_hosts(self, stack, agent, spec):
+        for name, (z, chips) in spec.items():
+            agent.add_host(name, generation="v5e", chips=chips)
+            stack.cluster.put_node(
+                K8sNode(name, labels={HOSTNAME: name, ZONE: z})
+            )
+        agent.publish_all()
+
+    def _gang_pod(self, name, gang, size):
+        return PodSpec(
+            name,
+            labels={
+                "tpu/gang": gang,
+                "tpu/gang-size": str(size),
+                "tpu/chips": "1",
+                "app": gang,
+            },
+            pod_affinity=(term(ZONE, {"app": gang}),),
+        )
+
+    def test_first_member_steered_into_domain_that_fits(self, mode):
+        # za has the roomiest single host (best score) but only 1 slot
+        # total; zb fits all 3. Without steering, member 0 binds in za and
+        # wedges the gang until the permit timeout.
+        stack, agent = make_stack(mode)
+        self._zone_hosts(
+            stack, agent,
+            {"a1": ("za", 1), "b1": ("zb", 2), "b2": ("zb", 1)},
+        )
+        for i in range(3):
+            stack.cluster.create_pod(self._gang_pod(f"g-{i}", "g", 3))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        zones = {
+            {"a1": "za", "b1": "zb", "b2": "zb"}[
+                stack.cluster.get_pod(f"default/g-{i}").node_name
+            ]
+            for i in range(3)
+        }
+        assert zones == {"zb"}
+
+    def test_no_single_domain_fits_parks_without_reserving(self, mode):
+        # Fleet sum (2) would admit a 2-member gang, but the members must
+        # co-locate and no zone holds 2 slots: park at admission, no
+        # reservations, no timeout cascade.
+        stack, agent = make_stack(mode)
+        self._zone_hosts(
+            stack, agent, {"a1": ("za", 1), "b1": ("zb", 1)}
+        )
+        for i in range(2):
+            stack.cluster.create_pod(self._gang_pod(f"g-{i}", "g", 2))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        for i in range(2):
+            assert stack.cluster.get_pod(f"default/g-{i}").node_name is None
+        assert stack.accountant.chips_in_use("a1") == 0
+        assert stack.accountant.chips_in_use("b1") == 0
+
+
+class TestPendingPlacementInternals:
+    def test_keyless_node_rejects_affinity_bootstrap(self):
+        # A group's first pod must not land on a node without the topology
+        # key: later members could never join it there (deliberate
+        # divergence from upstream's drop-the-term rule).
+        s = snap(("keyed", {ZONE: "a"}, []), ("bare", {}, []))
+        pod = PodSpec(
+            "g-0", labels={"app": "g"}, pod_affinity=(term(ZONE, {"app": "g"}),)
+        )
+        ev = InterPodEvaluator.build(s, pod)
+        assert ev.feasible(s.get("keyed"))[0]
+        ok, why = ev.feasible(s.get("bare"))
+        assert not ok and "topology key" in why
+        assert not ev.required_affinity_feasible(s.get("bare"))
+
+    def test_pending_placements_covers_bind_lag(self):
+        # A member released from Permit leaves `waiting` before its bind's
+        # watch event lands; it must STILL be reported (assigned-based) so
+        # an anti-affinity pod cannot sneak onto its host in that window.
+        from yoda_tpu.plugins.yoda.gang import GangPlugin, _GangState
+        from yoda_tpu.plugins.yoda.gang import GangSpec
+
+        g = GangPlugin()
+        member = PodSpec("m-0", labels={"app": "g"})
+        gs = _GangState(spec=GangSpec(name="g", size=2))
+        gs.bound = {member.key}          # released; bind in flight
+        gs.assigned = {member.key: "h1"}
+        gs.specs = {member.key: member}
+        g._gangs["g"] = gs
+        assert g.pending_placements() == [("h1", member)]
+
+    def test_evaluator_dedups_pending_already_in_snapshot(self):
+        # Once the bind's watch event lands the same uid is in the
+        # snapshot; the pending entry must not double-count.
+        member = PodSpec("m-0", labels={"app": "g"})
+        s = snap(("h1", {HOSTNAME: "h1"}, [member]), ("h2", {HOSTNAME: "h2"}, []))
+        pod = PodSpec(
+            "other",
+            labels={"app": "g"},
+            pod_anti_affinity=(term(HOSTNAME, {"app": "g"}),),
+        )
+        ev = InterPodEvaluator.build(s, pod, pending=[("h2", member)])
+        # Counted once, on h1 (snapshot) — NOT also on h2 (stale pending).
+        assert not ev.feasible(s.get("h1"))[0]
+        assert ev.feasible(s.get("h2"))[0]

@@ -253,8 +253,20 @@ class InterPodEvaluator:
 
     @classmethod
     def build(
-        cls, snapshot: "Snapshot", pod: PodSpec, *, check_symmetry: bool = True
+        cls,
+        snapshot: "Snapshot",
+        pod: PodSpec,
+        *,
+        check_symmetry: bool = True,
+        pending: Iterable[tuple[str, PodSpec]] = (),
     ) -> "InterPodEvaluator":
+        """``pending``: (node name, pod spec) pairs for pods RESERVED on a
+        node but not yet bound — gang members parked at Permit
+        (GangPlugin.pending_placements). They are folded in exactly like
+        bound pods (their domain comes from the assigned node's labels), so
+        sibling cycles see each other's in-flight placements; entries whose
+        uid already appears in the snapshot (bind raced the read) are
+        skipped."""
         ev = cls(pod)
         n_aff = len(pod.pod_affinity)
         ev._ok_values = [set() for _ in range(n_aff)]
@@ -270,34 +282,47 @@ class InterPodEvaluator:
             t for _, t in pod.preferred_pod_anti_affinity
         ]
         any_term_matched = [False] * n_aff
+
+        def _fold(labels: Mapping[str, str], other: PodSpec) -> None:
+            for i, term in enumerate(pod.pod_affinity):
+                if term.matches_pod(other, pod.namespace):
+                    any_term_matched[i] = True
+                    v = labels.get(term.topology_key)
+                    if v is not None:
+                        ev._ok_values[i].add(v)
+            for j, term in enumerate(pod.pod_anti_affinity):
+                if term.matches_pod(other, pod.namespace):
+                    v = labels.get(term.topology_key)
+                    if v is not None:
+                        ev._bad_values[j].add(v)
+            for k, term in enumerate(pref_terms):
+                if term.matches_pod(other, pod.namespace):
+                    v = labels.get(term.topology_key)
+                    if v is not None:
+                        ev._pref_values[k][2].add(v)
+            if check_symmetry and other.pod_anti_affinity:
+                for term in other.pod_anti_affinity:
+                    if term.matches_pod(pod, other.namespace):
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            ev._symmetry_bad.add((term.topology_key, v))
+
+        pending = tuple(pending)
+        seen_uids: set[str] = set()
         for ni in snapshot.infos():
             labels = _node_labels(ni)
             for other in ni.pods:
+                if pending:
+                    seen_uids.add(other.uid)
                 if other.uid == pod.uid:
                     continue  # a relisted copy of the pod itself never
                     # satisfies its own affinity (upstream parity)
-                for i, term in enumerate(pod.pod_affinity):
-                    if term.matches_pod(other, pod.namespace):
-                        any_term_matched[i] = True
-                        v = labels.get(term.topology_key)
-                        if v is not None:
-                            ev._ok_values[i].add(v)
-                for j, term in enumerate(pod.pod_anti_affinity):
-                    if term.matches_pod(other, pod.namespace):
-                        v = labels.get(term.topology_key)
-                        if v is not None:
-                            ev._bad_values[j].add(v)
-                for k, term in enumerate(pref_terms):
-                    if term.matches_pod(other, pod.namespace):
-                        v = labels.get(term.topology_key)
-                        if v is not None:
-                            ev._pref_values[k][2].add(v)
-                if check_symmetry and other.pod_anti_affinity:
-                    for term in other.pod_anti_affinity:
-                        if term.matches_pod(pod, other.namespace):
-                            v = labels.get(term.topology_key)
-                            if v is not None:
-                                ev._symmetry_bad.add((term.topology_key, v))
+                _fold(labels, other)
+        for host, other in pending:
+            if other.uid == pod.uid or other.uid in seen_uids:
+                continue
+            if host in snapshot:
+                _fold(_node_labels(snapshot.get(host)), other)
         # Upstream first-pod rule: a required-affinity term matching no
         # existing pod anywhere is satisfied iff the incoming pod matches
         # its own term — the group's first member bootstraps the domain.
@@ -333,9 +358,11 @@ class InterPodEvaluator:
         eviction can cure those)."""
         labels = _node_labels(ni)
         for i, term in enumerate(self.pod.pod_affinity):
-            if self._self_satisfied[i]:
-                continue
             v = labels.get(term.topology_key)
+            if self._self_satisfied[i]:
+                if v is None:  # keyless node: the group could never join
+                    return False
+                continue
             if v is None or v not in self._ok_values[i]:
                 return False
         return True
@@ -343,9 +370,19 @@ class InterPodEvaluator:
     def feasible(self, ni: "NodeInfo") -> tuple[bool, str]:
         labels = _node_labels(ni)
         for i, term in enumerate(self.pod.pod_affinity):
-            if self._self_satisfied[i]:
-                continue
             v = labels.get(term.topology_key)
+            if self._self_satisfied[i]:
+                # Deliberate divergence from upstream (which drops the term
+                # entirely): the bootstrapping pod must still land on a node
+                # that HAS the topology key — a keyless node belongs to no
+                # domain, so the group's later members could never join it
+                # (a gang bootstrapping onto a keyless host would wedge).
+                if v is None:
+                    return False, (
+                        f"node lacks topology key {term.topology_key!r} "
+                        "required by the pod's own affinity group"
+                    )
+                continue
             if v is None or v not in self._ok_values[i]:
                 return False, (
                     "no pod matching required pod affinity in the node's "
@@ -416,14 +453,23 @@ class SpreadEvaluator:
         return True
 
     @classmethod
-    def build(cls, snapshot: "Snapshot", pod: PodSpec) -> "SpreadEvaluator":
+    def build(
+        cls,
+        snapshot: "Snapshot",
+        pod: PodSpec,
+        *,
+        pending: Iterable[tuple[str, PodSpec]] = (),
+    ) -> "SpreadEvaluator":
+        """``pending`` as in :meth:`InterPodEvaluator.build`: reserved-but-
+        unbound pods counted in their assigned node's domains."""
         ev = cls(pod)
         if not pod.topology_spread:
             return ev
+        pending = tuple(pending)
         counted: list[dict[str, int]] = [{} for _ in pod.topology_spread]
-        for ni in snapshot.infos():
-            if not cls._domain_eligible(ni, pod):
-                continue
+        seen_uids: set[str] = set()
+
+        def _count(ni: "NodeInfo", others: Iterable[PodSpec]) -> None:
             labels = _node_labels(ni)
             for c_i, c in enumerate(pod.topology_spread):
                 v = labels.get(c.topology_key)
@@ -431,7 +477,7 @@ class SpreadEvaluator:
                     continue
                 counts = counted[c_i]
                 counts.setdefault(v, 0)
-                for other in ni.pods:
+                for other in others:
                     if other.uid == pod.uid:
                         continue
                     if other.namespace != pod.namespace:
@@ -440,6 +486,19 @@ class SpreadEvaluator:
                         other.labels
                     ):
                         counts[v] += 1
+
+        for ni in snapshot.infos():
+            if pending:
+                seen_uids.update(p.uid for p in ni.pods)
+            if not cls._domain_eligible(ni, pod):
+                continue
+            _count(ni, ni.pods)
+        for host, other in pending:
+            if other.uid in seen_uids or host not in snapshot:
+                continue
+            ni = snapshot.get(host)
+            if cls._domain_eligible(ni, pod):
+                _count(ni, (other,))
         ev._per = [
             (c, counts, min(counts.values()) if counts else 0)
             for c, counts in zip(pod.topology_spread, counted)

@@ -43,6 +43,7 @@ def default_plugins(
     kernel_platform: str = "auto",
     kernel_device_min_elems: int | None = None,
     mesh_devices: int | None = None,
+    pending_fn: Callable | None = None,
 ) -> list:
     """Assemble the standard plugin set.
 
@@ -53,7 +54,7 @@ def default_plugins(
     """
     from yoda_tpu.plugins.yoda.batch import AUTO_DEVICE_MIN_ELEMS
 
-    base: list = [YodaSort(), YodaPreFilter()]
+    base: list = [YodaSort(), YodaPreFilter(pending_fn=pending_fn)]
     if mode == "batch":
         base.append(
             YodaBatch(

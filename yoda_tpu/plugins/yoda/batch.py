@@ -33,6 +33,7 @@ import numpy as np
 
 log = logging.getLogger("yoda_tpu.batch")
 
+from yoda_tpu.api.affinity import pod_has_inter_pod_terms
 from yoda_tpu.api.types import (
     PodSpec,
     pod_admits_on,
@@ -443,21 +444,15 @@ class YodaBatch(BatchFilterScorePlugin):
             or not snapshot.version  # 0 = uncacheable snapshot
         ):
             return
-        # Required inter-pod terms / hard spread constraints are evaluated
-        # against BOUND pods only, so a plan placing k siblings at once
-        # cannot see the mutual exclusion between its own members (e.g.
-        # self-anti-affinity over hostname would stack all k on the
-        # top-ranked node). Refuse to plan; per-member dispatches keep the
-        # per-cycle evaluator semantics. Preferred-only terms are safe: they
-        # rank, never exclude, and are identical across plan-served siblings.
-        if (
-            pod.pod_affinity
-            or pod.pod_anti_affinity
-            or any(
-                c.when_unsatisfiable == "DoNotSchedule"
-                for c in pod.topology_spread
-            )
-        ):
+        # Inter-pod terms and spread constraints are evaluated per cycle
+        # against bound + pending pods, and each sibling's own placement
+        # CHANGES that input (self-anti-affinity over hostname must not
+        # stack all k members on the top-ranked node; spread counts move
+        # with every pick; preferred terms re-rank). A plan built from one
+        # dispatch cannot track any of that — refuse to plan and let
+        # per-member dispatches rebuild the evaluators each cycle (the
+        # pending-placements feed makes siblings visible between cycles).
+        if pod_has_inter_pod_terms(pod) or pod.topology_spread:
             return
         k = (
             state.read(GANG_REMAINING_KEY).count

@@ -233,13 +233,22 @@ class YodaPreFilter(PreFilterPlugin):
 
     Also builds the per-cycle inter-pod affinity / topology-spread
     evaluators (api.affinity) when they could matter: the pod declares
-    terms, or some bound pod declares required anti-affinity (the symmetry
+    terms, or some bound (or pending — gang members parked at Permit,
+    ``pending_fn``) pod declares required anti-affinity (the symmetry
     direction). Affinity-free fleets pay only a cached per-snapshot-version
     flag check here — nothing per node."""
 
     name = "yoda-prefilter"
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        *,
+        pending_fn: Callable[[], list[tuple[str, PodSpec]]] | None = None,
+    ) -> None:
+        # GangPlugin.pending_placements when gang scheduling is wired:
+        # reserved-but-unbound members, visible to the evaluators so gang
+        # siblings honor each other's inter-pod terms mid-flight.
+        self.pending_fn = pending_fn
         # (snapshot.version, any bound pod has required anti-affinity)
         self._anti_cache: tuple[int, bool] = (0, False)
 
@@ -258,12 +267,17 @@ class YodaPreFilter(PreFilterPlugin):
             return Status.unresolvable(f"invalid tpu/* labels: {e}")
         state.write(REQUEST_KEY, RequestData(req))
         inter = spread = None
-        if pod_has_inter_pod_terms(pod) or self._symmetry_possible(snapshot):
-            inter = InterPodEvaluator.build(snapshot, pod)
+        pending = self.pending_fn() if self.pending_fn is not None else ()
+        if (
+            pod_has_inter_pod_terms(pod)
+            or self._symmetry_possible(snapshot)
+            or any(p.pod_anti_affinity for _, p in pending)
+        ):
+            inter = InterPodEvaluator.build(snapshot, pod, pending=pending)
             if inter.trivial:
                 inter = None
         if pod.topology_spread:
-            spread = SpreadEvaluator.build(snapshot, pod)
+            spread = SpreadEvaluator.build(snapshot, pod, pending=pending)
         if inter is not None or spread is not None:
             state.write(AFFINITY_KEY, AffinityData(inter, spread))
         return Status.ok()

@@ -55,7 +55,11 @@ from yoda_tpu.framework.interfaces import (
     Snapshot,
     Status,
 )
-from yoda_tpu.plugins.yoda.filter_plugin import available_chips, get_request
+from yoda_tpu.plugins.yoda.filter_plugin import (
+    available_chips,
+    get_affinity,
+    get_request,
+)
 from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
 
 log = logging.getLogger("yoda_tpu.gang")
@@ -89,6 +93,11 @@ class _GangState:
     waiting: set[str] = field(default_factory=set)       # pod keys on waitlist
     bound: set[str] = field(default_factory=set)         # pod keys bound
     assigned: dict[str, str] = field(default_factory=dict)  # pod key -> host
+    # pod key -> the member's PodSpec, recorded at Permit so in-flight
+    # (reserved-but-unbound) members are visible to the inter-pod affinity
+    # evaluators (api.affinity ``pending`` support). Only keys currently in
+    # ``waiting`` are ever reported; entries are pruned with ``assigned``.
+    specs: dict[str, "PodSpec"] = field(default_factory=dict)
     plan: dict[str, tuple[int, int, int]] | None = None  # host -> coord
     failing: bool = False
     # Hosts that died (value: which kinds' deletion marked them — a Node
@@ -196,13 +205,104 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 # nodes instead of every one (the full count is still paid
                 # when the answer is "not enough", where it IS the answer).
                 deferred = []
+                aff = get_affinity(state)
+                # Gang members share labels, so a required term matching the
+                # pod's OWN labels constrains the gang against itself and
+                # caps admission — without a cap the surplus member holds
+                # its siblings' reservations until the permit timeout:
+                # - self ANTI-affinity: at most one member per domain of the
+                #   term's key (keyless nodes belong to no domain and keep
+                #   their full slot count — upstream semantics);
+                # - self AFFINITY: every member must land in ONE domain, so
+                #   the gang gets max-per-domain slots, not the fleet sum
+                #   (keyless nodes contribute nothing: api.affinity rejects
+                #   bootstrapping a group onto a keyless node).
+                anti_self = [
+                    t
+                    for t in pod.pod_anti_affinity
+                    if t.matches_pod(pod, pod.namespace)
+                ]
+                aff_self = [
+                    t
+                    for t in pod.pod_affinity
+                    if t.matches_pod(pod, pod.namespace)
+                ]
                 slots = 0
-                for ni in snapshot.infos():
-                    if not pod_admits_on(ni.node, pod)[0]:
-                        continue
-                    slots += self._member_slots(ni, req, exclude_hosts=set())
-                    if slots >= remaining:
-                        break
+                if not anti_self and not aff_self:
+                    # No domain cap possible: keep the short-circuit at
+                    # `remaining` even when an evaluator exists (it only
+                    # filters nodes, it cannot cap the sum).
+                    for ni in snapshot.infos():
+                        if not pod_admits_on(ni.node, pod)[0]:
+                            continue
+                        if aff is not None and not aff.feasible(ni)[0]:
+                            continue
+                        slots += self._member_slots(
+                            ni, req, exclude_hosts=set()
+                        )
+                        if slots >= remaining:
+                            break
+                else:
+                    # Domain caps need the whole feasible set: no
+                    # short-circuit (self-constrained gangs are rare).
+                    contributing: list[tuple[NodeInfo, int]] = []
+                    for ni in snapshot.infos():
+                        if not pod_admits_on(ni.node, pod)[0]:
+                            continue
+                        if aff is not None and not aff.feasible(ni)[0]:
+                            continue
+                        n = self._member_slots(ni, req, exclude_hosts=set())
+                        if n > 0:
+                            contributing.append((ni, n))
+                    slots = sum(n for _, n in contributing)
+                    for term in anti_self:
+                        keyed: set[str] = set()
+                        keyless = 0
+                        for ni, n in contributing:
+                            labels = (
+                                ni.node.labels if ni.node is not None else {}
+                            )
+                            v = labels.get(term.topology_key)
+                            if v is None:
+                                keyless += n
+                            else:
+                                keyed.add(v)
+                        slots = min(slots, len(keyed) + keyless)
+                    viable: set[str] | None = None
+                    for term in aff_self:
+                        per_domain: dict[str, int] = {}
+                        node_domain: dict[str, str] = {}
+                        for ni, n in contributing:
+                            labels = (
+                                ni.node.labels if ni.node is not None else {}
+                            )
+                            v = labels.get(term.topology_key)
+                            if v is not None:
+                                per_domain[v] = per_domain.get(v, 0) + n
+                                node_domain[ni.name] = v
+                        slots = min(
+                            slots,
+                            max(per_domain.values()) if per_domain else 0,
+                        )
+                        # Steer every member into a domain that can hold the
+                        # WHOLE remainder: without this the first member
+                        # binds to the best-scoring node even when its
+                        # domain is too small for the gang, wedging the
+                        # siblings until the permit timeout.
+                        fits = {
+                            name
+                            for name, v in node_domain.items()
+                            if per_domain[v] >= remaining
+                        }
+                        viable = fits if viable is None else (viable & fits)
+                    if aff_self and viable is not None:
+                        if not viable:
+                            slots = 0  # no single domain fits the remainder
+                        else:
+                            state.write(
+                                ALLOWED_HOSTS_KEY,
+                                _AllowedHosts(frozenset(viable)),
+                            )
                 if slots < remaining:
                     st = Status.unschedulable(
                         f"gang {req.gang.name}: {remaining} members still "
@@ -323,6 +423,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     sorted(gs.plan),
                 )
             gs.assigned = {k: v for k, v in gs.assigned.items() if k in gs.bound}
+            gs.specs = {k: v for k, v in gs.specs.items() if k in gs.bound}
             plan_hosts_free = (
                 set(gs.plan) - set(pinned) if gs.plan else set()
             )
@@ -364,6 +465,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 )
             gs.waiting.add(pod.key)
             gs.assigned[pod.key] = node_name
+            gs.specs[pod.key] = pod
         return Status.wait(f"waiting for gang {req.gang.name}"), self.timeout_s
 
     def on_pod_waiting(self, framework, wp) -> None:
@@ -415,9 +517,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     gs.assigned = {
                         k: v for k, v in gs.assigned.items() if k in gs.bound
                     }
+                    gs.specs = {
+                        k: v for k, v in gs.specs.items() if k in gs.bound
+                    }
                 return
             # Rejection: roll the rest of the gang back (once).
             gs.assigned.pop(wp.pod.key, None)
+            gs.specs.pop(wp.pod.key, None)
             if gs.failing:
                 if not gs.waiting:  # cascade finished
                     gs.failing = False
@@ -577,3 +683,24 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             if gs is None or gs.plan is None:
                 return None
             return sorted(set(gs.plan) - set(gs.assigned.values()))
+
+    def pending_placements(self) -> list[tuple[str, PodSpec]]:
+        """(assigned host, member spec) for every member with a live
+        assignment — parked at Permit (reserved but unbound) OR released
+        and binding, until the bind's watch event lands. Both are invisible
+        in the snapshot's per-node pod lists, so YodaPreFilter feeds these
+        to the inter-pod affinity / spread evaluators (api.affinity
+        ``pending``): a gang whose members carry e.g. self-anti-affinity
+        over hostname actually spreads instead of stacking, and the
+        permit-release -> watch-replay lag window cannot sneak a
+        conflicting pod onto a gang host. Entries whose uid already
+        appears in the snapshot are deduplicated by the evaluator builds,
+        so reporting bound members here is idempotent."""
+        with self._lock:
+            out: list[tuple[str, PodSpec]] = []
+            for gs in self._gangs.values():
+                for key, host in gs.assigned.items():
+                    spec = gs.specs.get(key)
+                    if host and spec is not None:
+                        out.append((host, spec))
+            return out

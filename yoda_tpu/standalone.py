@@ -62,6 +62,11 @@ def build_stack(
         else None
     )
 
+    gang = GangPlugin(
+        timeout_s=config.gang_permit_timeout_s,
+        reserved_fn=accountant.chips_in_use,
+        on_rollback=recorder.gang_rollback if recorder else None,
+    )
     plugins = default_plugins(
         mode=config.mode,
         weights=config.effective_weights(),
@@ -70,11 +75,9 @@ def build_stack(
         kernel_platform=config.kernel_platform,
         kernel_device_min_elems=config.kernel_device_min_elems,
         mesh_devices=config.mesh_devices,
-    )
-    gang = GangPlugin(
-        timeout_s=config.gang_permit_timeout_s,
-        reserved_fn=accountant.chips_in_use,
-        on_rollback=recorder.gang_rollback if recorder else None,
+        # Gang members parked at Permit stay visible to the inter-pod
+        # affinity/spread evaluators (api.affinity pending support).
+        pending_fn=gang.pending_placements,
     )
     plugins.append(gang)
     plugins.append(accountant)
