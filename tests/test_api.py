@@ -168,6 +168,53 @@ class TestParseRequest:
         with pytest.raises(LabelParseError):
             parse_request({"tpu/gang": "g"})
 
+    def test_coscheduling_pod_group_lite_labels_gang(self):
+        # sig-scheduling coscheduling compat: PodGroup lite labels map to a
+        # gang (min-available = all-or-nothing size).
+        r = parse_request(
+            {
+                "pod-group.scheduling.sigs.k8s.io/name": "pg-a",
+                "pod-group.scheduling.sigs.k8s.io/min-available": "3",
+                "tpu/chips": "2",
+            }
+        )
+        assert r.gang.name == "pg-a" and r.gang.size == 3
+
+    def test_coscheduling_x_k8s_pod_group_label(self):
+        r = parse_request(
+            {
+                "scheduling.x-k8s.io/pod-group": "pg-b",
+                "pod-group.scheduling.sigs.k8s.io/min-available": "2",
+            }
+        )
+        assert r.gang.name == "pg-b" and r.gang.size == 2
+
+    def test_explicit_tpu_gang_wins_over_alias(self):
+        r = parse_request(
+            {
+                "tpu/gang": "mine",
+                "tpu/gang-size": "4",
+                "pod-group.scheduling.sigs.k8s.io/name": "theirs",
+                "pod-group.scheduling.sigs.k8s.io/min-available": "9",
+            }
+        )
+        assert r.gang.name == "mine" and r.gang.size == 4
+
+    def test_pod_group_name_without_size_rejected(self):
+        with pytest.raises(LabelParseError):
+            parse_request({"pod-group.scheduling.sigs.k8s.io/name": "pg"})
+
+    def test_pod_group_topology_combines(self):
+        # Alias name + tpu/topology: the TPU-native topology machinery is
+        # available to coscheduling-labeled workloads.
+        r = parse_request(
+            {
+                "scheduling.x-k8s.io/pod-group": "pg-c",
+                "tpu/topology": "2x2",
+            }
+        )
+        assert r.gang.size == 4 and r.gang.topology == (2, 2)
+
     @pytest.mark.parametrize(
         "text,expected",
         [("2x2x2", (2, 2, 2)), ("4x4", (4, 4)), ("8", (8,)), ("2X2", (2, 2))],

@@ -909,3 +909,67 @@ class TestMultislice:
         assert all(placed.values()), placed
         assert len(set(placed.values())) == 8
         assert placed["rms-0"] == a_hosts[0]  # the pinned member stayed put
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestCoschedulingCompat:
+    def test_pod_group_labels_gang_binds_atomically(self, mode):
+        # Workloads written for the sig-scheduling coscheduling plugin
+        # (PodGroup lite labels) gang-schedule unmodified.
+        stack, agent = make_stack(mode)
+        agent.add_host("h1", chips=8)
+        agent.add_host("h2", chips=8)
+        agent.publish_all()
+        labels = {
+            "pod-group.scheduling.sigs.k8s.io/name": "pg",
+            "pod-group.scheduling.sigs.k8s.io/min-available": "3",
+            "tpu/chips": "4",
+        }
+        for i in range(2):
+            stack.cluster.create_pod(PodSpec(f"pg-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # Two of three members present: nothing binds (all-or-nothing).
+        assert all(
+            stack.cluster.get_pod(f"default/pg-{i}").node_name is None
+            for i in range(2)
+        )
+        stack.cluster.create_pod(PodSpec("pg-2", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        bound = [
+            stack.cluster.get_pod(f"default/pg-{i}").node_name
+            for i in range(3)
+        ]
+        assert all(bound), bound
+
+    def test_alias_only_member_deletion_cascades(self, mode):
+        # Regression: the watch handler resolved gang membership from the
+        # raw tpu/gang label, so deleting a member of an alias-only gang
+        # left a ghost in `waiting` that could satisfy the Permit barrier.
+        stack, agent = make_stack(mode)
+        agent.add_host("h1", chips=8)
+        agent.publish_all()
+        labels = {
+            "pod-group.scheduling.sigs.k8s.io/name": "pg-del",
+            "pod-group.scheduling.sigs.k8s.io/min-available": "3",
+            "tpu/chips": "1",
+        }
+        for i in range(2):
+            stack.cluster.create_pod(PodSpec(f"pgd-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # Two members parked at Permit, holding reservations.
+        assert stack.accountant.chips_in_use("h1") == 2
+        stack.cluster.delete_pod("default/pgd-0")
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # The deletion must be SEEN (alias-aware handler): the deleted
+        # member's reservation releases and no ghost remains in the gang's
+        # waiting set to satisfy the barrier early — same steady state as a
+        # tpu/gang-labeled gang (survivor re-parks with its own chip).
+        assert stack.accountant.chips_in_use("h1") == 1
+        gs = stack.gang._gangs.get("pg-del")
+        assert gs is not None and "default/pgd-0" not in gs.waiting
+        for name in ("pgd-0b", "pgd-2"):
+            stack.cluster.create_pod(PodSpec(name, labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        pods = [p for p in stack.cluster.list_pods()]
+        bound = [p for p in pods if p.node_name]
+        assert len(bound) == 3, [(p.name, p.node_name) for p in pods]

@@ -280,3 +280,141 @@ def test_serve_forever_with_node_constraints(seed=42):
         used = bound_by_node.get(m.name, 0)
         assert used <= m.chip_count, f"{m.name} oversubscribed"
         assert stack.accountant.chips_in_use(m.name) == used, m.name
+
+
+def test_serve_forever_with_anti_affinity_churn(seed=7):
+    """Chaos run for the inter-pod family: churn pods in five anti-affinity
+    groups (each group repels itself over hostname) racing an anti-affinity
+    gang, while agents republish. Invariants at quiescence: the scheduler
+    survives, NO two bound pods of one group share a host (the hard
+    inter-pod constraint holds under concurrency — including the
+    permit-release bind-lag window the pending-placements feed covers),
+    gang atomicity, no oversubscription, accounting converges."""
+    from yoda_tpu.api.affinity import LabelSelector, PodAffinityTerm
+    from yoda_tpu.api.types import K8sNode
+
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def anti(group: str) -> tuple:
+        return (
+            PodAffinityTerm(
+                topology_key=HOSTNAME,
+                selector=LabelSelector(match_labels=(("grp", group),)),
+            ),
+        )
+
+    rng = random.Random(seed)
+    stack = build_stack(config=SchedulerConfig(gang_permit_timeout_s=1.0))
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(8):
+        agent.add_host(f"h{i}", chips=8)
+        stack.cluster.put_node(K8sNode(f"h{i}", labels={HOSTNAME: f"h{i}"}))
+    agent.publish_all()
+
+    stack.cluster.create_pod(PodSpec("warmup", labels={"tpu/chips": "1"}))
+    stack.scheduler.run_until_idle(max_wall_s=60.0)
+    stack.cluster.delete_pod("default/warmup")
+
+    stop = threading.Event()
+    crashes: list[BaseException] = []
+
+    def serve():
+        try:
+            stack.scheduler.serve_forever(stop, poll_s=0.005)
+        except BaseException as e:  # noqa: BLE001
+            crashes.append(e)
+
+    server = threading.Thread(target=serve, daemon=True)
+    server.start()
+
+    def republish():
+        while not stop.is_set():
+            agent.publish_all()
+            time.sleep(0.002)
+
+    def churn():
+        for n in range(80):
+            if stop.is_set():
+                return
+            grp = f"g{n % 5}"
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"aa-{n}",
+                    labels={"tpu/chips": "1", "grp": grp},
+                    pod_anti_affinity=anti(grp),
+                )
+            )
+            if n % 4 == 3:
+                stack.cluster.delete_pod(f"default/aa-{rng.randrange(n)}")
+            time.sleep(0.001)
+
+    def gangs():
+        for g in range(3):
+            if stop.is_set():
+                return
+            for i in range(4):
+                stack.cluster.create_pod(
+                    PodSpec(
+                        f"ag{g}-{i}",
+                        labels={
+                            "tpu/gang": f"ag{g}",
+                            "tpu/gang-size": "4",
+                            "tpu/chips": "1",
+                            "grp": f"gang{g}",
+                        },
+                        pod_anti_affinity=anti(f"gang{g}"),
+                    )
+                )
+            time.sleep(0.05)
+
+    writers = [
+        threading.Thread(target=republish, daemon=True),
+        threading.Thread(target=churn, daemon=True),
+        threading.Thread(target=gangs, daemon=True),
+    ]
+    for w in writers:
+        w.start()
+    for w in writers[1:]:
+        w.join(timeout=30)
+        assert not w.is_alive(), "writer thread wedged"
+    deadline = time.monotonic() + 20.0
+    while stack.scheduler.stats.binds == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.5)
+    stop.set()
+    server.join(timeout=30)
+    assert not server.is_alive(), "serve_forever deadlocked"
+    writers[0].join(timeout=5)
+    assert not crashes, f"scheduler thread crashed: {crashes!r}"
+    stack.scheduler.run_until_idle(max_wall_s=20.0)
+
+    pods = stack.cluster.list_pods()
+    # THE invariant: one bound pod per (group, host), ever.
+    seen: dict[tuple[str, str], str] = {}
+    for p in pods:
+        if p.node_name and "grp" in p.labels:
+            key = (p.labels["grp"], p.node_name)
+            assert key not in seen, (
+                f"{p.name} and {seen[key]} of group {key[0]} share {key[1]}"
+            )
+            seen[key] = p.name
+    # Gang atomicity.
+    by_gang: dict[str, list[PodSpec]] = {}
+    for p in pods:
+        g = p.labels.get("tpu/gang")
+        if g:
+            by_gang.setdefault(g, []).append(p)
+    for g, members in by_gang.items():
+        bound = [p for p in members if p.node_name]
+        assert len(bound) in (0, 4), f"gang {g} partially bound: {len(bound)}"
+    # Oversubscription + accounting convergence.
+    bound_by_node: dict[str, int] = {}
+    for p in pods:
+        if p.node_name:
+            bound_by_node[p.node_name] = (
+                bound_by_node.get(p.node_name, 0) + pod_chips(p)
+            )
+    for m in stack.cluster.list_tpu_metrics():
+        used = bound_by_node.get(m.name, 0)
+        assert used <= m.chip_count, f"{m.name} oversubscribed"
+        assert stack.accountant.chips_in_use(m.name) == used, m.name

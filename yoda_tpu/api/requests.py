@@ -17,6 +17,9 @@ Net-new labels (no reference analog; mandated by BASELINE.json north star):
                      GENERATION_RANK)
     tpu/gang         gang name: all pods sharing it are placed atomically
     tpu/gang-size    number of pods in the gang
+                     (coscheduling compat: pod-group.scheduling.sigs.k8s.io/
+                     name + /min-available and scheduling.x-k8s.io/pod-group
+                     alias these two; explicit tpu/* labels win)
     tpu/topology     ICI slice shape "AxBxC" (hosts), e.g. "2x2x2"
     tpu/multislice   number of tpu/topology blocks the gang spans (the
                      Multislice pattern: ICI within each block, DCN
@@ -49,6 +52,12 @@ GENERATION = "tpu/generation"
 PRIORITY = "tpu/priority"
 GANG = "tpu/gang"
 GANG_SIZE = "tpu/gang-size"
+# Compat aliases for workloads written for the sig-scheduling coscheduling
+# plugin: its PodGroup label conventions map onto gangs (min-available =
+# all-or-nothing size). Explicit tpu/* labels win over aliases.
+PG_NAME_LITE = "pod-group.scheduling.sigs.k8s.io/name"
+PG_MIN_LITE = "pod-group.scheduling.sigs.k8s.io/min-available"
+PG_NAME = "scheduling.x-k8s.io/pod-group"
 TOPOLOGY = "tpu/topology"
 MULTISLICE = "tpu/multislice"
 
@@ -116,6 +125,28 @@ def parse_topology(text: str) -> tuple[int, ...]:
     return dims
 
 
+def gang_name_label(labels: Mapping[str, str]) -> tuple[str | None, str]:
+    """(gang name, the label key it came from) — the ONE place alias
+    resolution lives. Every reader of gang membership (parse_request, the
+    gang plugin's watch handler, preemption's bound-member pinning) must go
+    through this, or pods ganged only via the coscheduling alias labels
+    become invisible to that reader."""
+    if GANG in labels:
+        return labels[GANG], GANG
+    for alias in (PG_NAME_LITE, PG_NAME):
+        if alias in labels:
+            return labels[alias], alias
+    return None, GANG
+
+
+def gang_name_of(labels: Mapping[str, str]) -> str | None:
+    """The pod's gang name (alias-aware, stripped), or None."""
+    raw, _ = gang_name_label(labels)
+    if raw is None:
+        return None
+    return raw.strip() or None
+
+
 def parse_request(
     labels: Mapping[str, str], *, tpu_limit: int = 0, spec_priority: int = 0
 ) -> TpuRequest:
@@ -154,20 +185,35 @@ def parse_request(
         except QuantityError as e:
             raise LabelParseError(str(e)) from e
 
+    # Coscheduling-compat aliases resolve to the tpu/* fields; explicit
+    # tpu/* labels win (an unmodified PodGroup workload gangs correctly,
+    # a migrated one can override).
+    gang_raw, gang_key = gang_name_label(labels)
+    size_raw = labels.get(GANG_SIZE)
+    size_key = GANG_SIZE
+    if size_raw is None and PG_MIN_LITE in labels:
+        size_raw, size_key = labels[PG_MIN_LITE], PG_MIN_LITE
+
     gang = None
     if (
-        GANG in labels
-        or GANG_SIZE in labels
+        gang_raw is not None
+        or size_raw is not None
         or TOPOLOGY in labels
         or MULTISLICE in labels
     ):
-        if GANG not in labels:
+        if gang_raw is None:
+            present = [
+                k
+                for k in (size_key, TOPOLOGY, MULTISLICE)
+                if k in labels
+            ]
             raise LabelParseError(
-                f"{GANG_SIZE}/{TOPOLOGY}/{MULTISLICE} require {GANG}"
+                f"{'/'.join(present)} require {GANG} "
+                f"(or the {PG_NAME_LITE} / {PG_NAME} alias)"
             )
-        name = labels[GANG].strip()
+        name = gang_raw.strip()
         if not name:
-            raise LabelParseError(f"{GANG} must be non-empty")
+            raise LabelParseError(f"{gang_key} must be non-empty")
         topology = parse_topology(labels[TOPOLOGY]) if TOPOLOGY in labels else None
         n_slices = 1
         if MULTISLICE in labels:
@@ -179,17 +225,20 @@ def parse_request(
                 raise LabelParseError(str(e)) from e
             if n_slices < 1:
                 raise LabelParseError(f"{MULTISLICE} must be >= 1")
-        if GANG_SIZE in labels:
+        if size_raw is not None:
             try:
-                size = parse_int(labels[GANG_SIZE], field=GANG_SIZE)
+                size = parse_int(size_raw, field=size_key)
             except QuantityError as e:
                 raise LabelParseError(str(e)) from e
             if size < 1:
-                raise LabelParseError(f"{GANG_SIZE} must be >= 1")
+                raise LabelParseError(f"{size_key} must be >= 1")
         elif topology is not None:
             size = n_slices * math.prod(topology)
         else:
-            raise LabelParseError(f"{GANG} requires {GANG_SIZE} or {TOPOLOGY}")
+            raise LabelParseError(
+                f"{gang_key} requires {GANG_SIZE} (or {PG_MIN_LITE}) "
+                f"or {TOPOLOGY}"
+            )
         if topology is not None:
             expected = n_slices * math.prod(topology)
             if expected != size:

@@ -43,7 +43,7 @@ from typing import Callable
 
 import logging
 
-from yoda_tpu.api.requests import GangSpec
+from yoda_tpu.api.requests import GangSpec, gang_name_of
 from yoda_tpu.api.types import PodSpec, pod_admits_on
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.cyclestate import CycleState
@@ -586,7 +586,10 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         if event.kind != "Pod":
             return
         pod: PodSpec = event.obj  # type: ignore[assignment]
-        gang_name = pod.labels.get("tpu/gang")
+        # Alias-aware (coscheduling pod-group labels gang too): a raw
+        # "tpu/gang" read here would make alias-only gangs invisible to
+        # delete/replay handling — ghost members would satisfy the barrier.
+        gang_name = gang_name_of(pod.labels)
         if not gang_name:
             return
         with self._lock:

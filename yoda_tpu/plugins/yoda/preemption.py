@@ -45,7 +45,12 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Mapping
 
-from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
+from yoda_tpu.api.requests import (
+    LabelParseError,
+    TpuRequest,
+    gang_name_of,
+    pod_request,
+)
 from yoda_tpu.api.types import PodSpec, pod_admits_on
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
@@ -467,7 +472,10 @@ class TpuPreemption(PostFilterPlugin):
         pinned: dict[str, tuple[int, int, int]] = {}
         for ni in snapshot.infos():
             for p in ni.pods:
-                if p.labels.get("tpu/gang") == gang.name and ni.tpu is not None:
+                if (
+                    gang_name_of(p.labels) == gang.name
+                    and ni.tpu is not None
+                ):
                     pinned[ni.name] = ni.tpu.topology_coords
 
         # Memoize per-host victim sets: host_ok computes them during the
