@@ -1,0 +1,319 @@
+"""NodeResourcesFit analog: cpu / memory / pod-count requests vs Node
+status.allocatable.
+
+The reference inherited this from the upstream default plugins it ran
+alongside (reference deploy/yoda-scheduler.yaml:15-27); here it is
+first-party (plugins/yoda/filter_plugin.node_fits_resources), enforced
+only when both sides declare — pods without requests and nodes without
+status.allocatable are untouched, keeping TPU-label-only fixtures and
+fleets working unchanged.
+"""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.quantity import QuantityError, parse_cpu
+from yoda_tpu.api.types import K8sNode, PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework.interfaces import NodeInfo
+from yoda_tpu.plugins.yoda.filter_plugin import node_fits_resources
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+class TestParseCpu:
+    @pytest.mark.parametrize(
+        "text,milli",
+        [("500m", 500), ("2", 2000), ("1.5", 1500), ("0", 0), ("250m", 250)],
+    )
+    def test_valid(self, text, milli):
+        assert parse_cpu(text) == milli
+
+    @pytest.mark.parametrize("text", ["", "m", "1.5m", "two", "-1", "2 cores"])
+    def test_invalid(self, text):
+        with pytest.raises(QuantityError):
+            parse_cpu(text)
+
+
+class TestPodResourceParsing:
+    def test_requests_roundtrip(self):
+        pod = PodSpec("p", cpu_milli_request=1500, memory_request=2 << 30)
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.cpu_milli_request == 1500
+        assert back.memory_request == 2 << 30
+
+    def test_limits_fall_back_per_container(self):
+        obj = {
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"cpu": "500m"}}},
+                    {"resources": {"limits": {"cpu": "1", "memory": "1Gi"}}},
+                ]
+            },
+        }
+        pod = PodSpec.from_obj(obj)
+        assert pod.cpu_milli_request == 1500
+        assert pod.memory_request == 1 << 30
+
+    def test_init_containers_contribute_their_max(self):
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [{"resources": {"requests": {"cpu": "500m"}}}],
+                "initContainers": [
+                    {"resources": {"requests": {"cpu": "2"}}},
+                    {"resources": {"requests": {"cpu": "250m"}}},
+                ],
+            },
+        }
+        # init containers run sequentially BEFORE the regular set:
+        # effective = max(sum(regular)=500, max(init)=2000) = 2000.
+        assert PodSpec.from_obj(obj).cpu_milli_request == 2000
+
+    def test_unparseable_request_counts_zero(self):
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"cpu": "lots", "memory": "1Gi"}}}
+                ]
+            },
+        }
+        pod = PodSpec.from_obj(obj)
+        assert pod.cpu_milli_request == 0
+        assert pod.memory_request == 1 << 30
+
+    def test_node_allocatable_roundtrip(self):
+        n = K8sNode(
+            "n", alloc_cpu_milli=8000, alloc_memory=32 << 30, alloc_pods=110
+        )
+        back = K8sNode.from_obj(n.to_obj())
+        assert back.alloc_cpu_milli == 8000
+        assert back.alloc_memory == 32 << 30
+        assert back.alloc_pods == 110
+
+
+class TestNodeFitsResources:
+    def test_undeclared_sides_never_enforce(self):
+        # No Node object / no allocatable / no request: all pass.
+        assert node_fits_resources(NodeInfo("n"), PodSpec("p"))[0]
+        ni = NodeInfo("n", node=K8sNode("n"))
+        assert node_fits_resources(
+            ni, PodSpec("p", cpu_milli_request=99999)
+        )[0]
+        ni2 = NodeInfo("n", node=K8sNode("n", alloc_cpu_milli=100))
+        assert node_fits_resources(ni2, PodSpec("p"))[0]
+
+    def test_cpu_sum_enforced(self):
+        ni = NodeInfo(
+            "n",
+            node=K8sNode("n", alloc_cpu_milli=2000),
+            pods=[PodSpec("a", cpu_milli_request=1500)],
+        )
+        ok, why = node_fits_resources(
+            ni, PodSpec("p", cpu_milli_request=1000)
+        )
+        assert not ok and "cpu" in why
+        assert node_fits_resources(
+            ni, PodSpec("p", cpu_milli_request=500)
+        )[0]
+
+    def test_memory_sum_enforced(self):
+        ni = NodeInfo(
+            "n",
+            node=K8sNode("n", alloc_memory=4 << 30),
+            pods=[PodSpec("a", memory_request=3 << 30)],
+        )
+        assert not node_fits_resources(
+            ni, PodSpec("p", memory_request=2 << 30)
+        )[0]
+
+    def test_pod_count_enforced(self):
+        ni = NodeInfo(
+            "n",
+            node=K8sNode("n", alloc_pods=2),
+            pods=[PodSpec("a"), PodSpec("b")],
+        )
+        ok, why = node_fits_resources(ni, PodSpec("p"))
+        assert not ok and "pod capacity" in why
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestResourcesE2E:
+    def test_cpu_constrained_pod_avoids_full_node(self, mode):
+        stack, agent = make_stack(mode)
+        for n, cpu in (("small", 2000), ("big", 16000)):
+            agent.add_host(n, generation="v5e", chips=8)
+            stack.cluster.put_node(K8sNode(n, alloc_cpu_milli=cpu))
+        agent.publish_all()
+        # Fill `small`'s cpu with a bound pod.
+        stack.cluster.create_pod(
+            PodSpec(
+                "filler",
+                labels={"tpu/chips": "1"},
+                cpu_milli_request=1500,
+                node_name=None,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        filler = stack.cluster.get_pod("default/filler")
+        assert filler.node_name is not None
+        # A 1-cpu pod no longer fits wherever the filler landed if that
+        # node is `small`; either way it must land somewhere cpu-feasible.
+        stack.cluster.create_pod(
+            PodSpec(
+                "wanter", labels={"tpu/chips": "1"}, cpu_milli_request=1000
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        wanter = stack.cluster.get_pod("default/wanter")
+        assert wanter.node_name is not None
+        if filler.node_name == "small":
+            assert wanter.node_name == "big"
+
+    def test_cpu_infeasible_everywhere_pends(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("only", generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode("only", alloc_cpu_milli=1000))
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, cpu_milli_request=2000)
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/p").node_name is None
+
+    def test_request_free_pods_unaffected(self, mode):
+        # TPU-label-only pods on allocatable-declaring nodes: untouched.
+        stack, agent = make_stack(mode)
+        agent.add_host("n", generation="v5e", chips=4)
+        stack.cluster.put_node(
+            K8sNode("n", alloc_cpu_milli=100, alloc_memory=1 << 20)
+        )
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/p").node_name == "n"
+
+
+class TestReviewRegressions:
+    """Fixes from the medium-effort review of the resource-fit change."""
+
+    def test_per_resource_limits_fallback(self):
+        # requests {cpu} + limits {cpu, memory}: memory must fall back to
+        # its limit even though requests is non-empty (upstream
+        # per-resource defaulting, not per-dict).
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"cpu": "500m"},
+                            "limits": {"cpu": "1", "memory": "2Gi"},
+                        }
+                    }
+                ]
+            },
+        }
+        pod = PodSpec.from_obj(obj)
+        assert pod.cpu_milli_request == 500  # explicit request wins
+        assert pod.memory_request == 2 << 30  # falls back to its limit
+
+    def test_one_bad_allocatable_field_keeps_the_others(self):
+        obj = {
+            "metadata": {"name": "n"},
+            "spec": {},
+            "status": {
+                "allocatable": {"cpu": "4", "memory": "garbage", "pods": "110"}
+            },
+        }
+        n = K8sNode.from_obj(obj)
+        assert n.alloc_cpu_milli == 4000
+        assert n.alloc_memory == 0  # unenforced, loudly
+        assert n.alloc_pods == 110  # NOT dropped by memory's failure
+
+    def test_node_fits_resources_counts_pending(self):
+        ni = NodeInfo(
+            "n", node=K8sNode("n", alloc_cpu_milli=2000), pods=[]
+        )
+        pod = PodSpec("p", cpu_milli_request=800)
+        assert node_fits_resources(ni, pod)[0]
+        pending = {"n": (1500, 0, 1)}  # a gang sibling parked at Permit
+        ok, why = node_fits_resources(ni, pod, pending)
+        assert not ok and "cpu" in why
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_gang_siblings_respect_allocatable(self, mode):
+        # One 8-chip node with cpu for only two members; a third host with
+        # room. A 3-member gang each wanting 1 chip + 1000m cpu must not
+        # stack 3 members onto the cpu-capped node (plan caps + pending
+        # resource accounting).
+        stack, agent = make_stack(mode)
+        agent.add_host("capped", generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode("capped", alloc_cpu_milli=2000))
+        agent.add_host("roomy", generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode("roomy", alloc_cpu_milli=16000))
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{i}",
+                    labels={
+                        "tpu/gang": "g",
+                        "tpu/gang-size": "3",
+                        "tpu/chips": "1",
+                    },
+                    cpu_milli_request=1000,
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        placed = {
+            f"g-{i}": stack.cluster.get_pod(f"default/g-{i}").node_name
+            for i in range(3)
+        }
+        assert all(placed.values()), placed
+        on_capped = [n for n in placed.values() if n == "capped"]
+        assert len(on_capped) <= 2, placed
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_preemption_skips_resource_impossible_node(self, mode):
+        # The only victim-bearing node has its cpu held by a FOREIGN
+        # higher-priority pod; evicting the TPU victim frees chips but can
+        # never free cpu — preemption must not evict there.
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        stack.cluster.put_node(K8sNode("host", alloc_cpu_milli=2000))
+        agent.publish_all()
+        # Foreign pod (different scheduler, no TPU claim) holding the cpu.
+        foreign = PodSpec(
+            "foreign",
+            scheduler_name="default-scheduler",
+            cpu_milli_request=1800,
+            node_name="host",
+            phase="Running",
+        )
+        stack.cluster.create_pod(foreign)
+        stack.cluster.create_pod(
+            PodSpec(
+                "victim", labels={"tpu/chips": "2", "tpu/priority": "1"}
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/victim").node_name == "host"
+        stack.cluster.create_pod(
+            PodSpec(
+                "train",
+                labels={"tpu/chips": "2", "tpu/priority": "10"},
+                cpu_milli_request=500,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # The victim survives: eviction could never make the cpu fit.
+        assert stack.cluster.get_pod("default/victim") is not None
+        assert stack.cluster.get_pod("default/train").node_name is None

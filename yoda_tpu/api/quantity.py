@@ -67,6 +67,24 @@ def parse_quantity(text: str, *, default_unit: int = 1 << 20) -> int:
     return int(float(value) * scale)
 
 
+def parse_cpu(text: str) -> int:
+    """Parse a Kubernetes CPU quantity into millicores: ``"500m"`` -> 500,
+    ``"2"`` -> 2000, ``"1.5"`` -> 1500. Strict (QuantityError on anything
+    else) — callers that must tolerate wild pod specs wrap this."""
+    if not isinstance(text, str):
+        raise QuantityError(f"cpu must be a string, got {type(text).__name__}")
+    s = text.strip()
+    if s.endswith("m"):
+        body = s[:-1]
+        if not body.isdigit():
+            raise QuantityError(f"malformed cpu quantity {text!r}")
+        return int(body)
+    m = re.match(r"^(\d+(?:\.\d+)?)$", s)
+    if not m:
+        raise QuantityError(f"malformed cpu quantity {text!r}")
+    return int(float(m.group(1)) * 1000)
+
+
 def parse_int(text: str, *, field: str = "value") -> int:
     """Parse a non-negative integer strictly (no silent-zero, see module doc)."""
     if not isinstance(text, str):

@@ -92,6 +92,50 @@ def _tpu_limit_of(spec: "Mapping[str, Any]") -> int:
     return total
 
 
+def _resource_requests_of(spec: "Mapping[str, Any]") -> tuple[int, int]:
+    """(cpu millicores, memory bytes) the pod effectively requests —
+    upstream NodeResourcesFit accounting: per container, requests fall
+    back to that container's limits; the pod total is
+    max(sum(regular containers), max(init containers)) since init
+    containers run sequentially before the regular set. Unparseable values
+    are logged and counted as 0 (the API server validates quantities on
+    real clusters; our strictness budget is spent on tpu/* labels)."""
+    from yoda_tpu.api.quantity import QuantityError, parse_cpu, parse_quantity
+
+    def one(c: Mapping[str, Any]) -> tuple[int, int]:
+        res = c.get("resources") or {}
+        req = res.get("requests") or {}
+        lim = res.get("limits") or {}
+        # PER-RESOURCE fallback (upstream defaulting): a resource absent
+        # from requests takes that resource's limit — not the whole dict.
+        cpu_raw = req.get("cpu", lim.get("cpu"))
+        mem_raw = req.get("memory", lim.get("memory"))
+        cpu = mem = 0
+        log = logging.getLogger("yoda_tpu.api")
+        if cpu_raw is not None:
+            try:
+                cpu = parse_cpu(str(cpu_raw))
+            except QuantityError as e:
+                log.warning("ignoring unparseable cpu request: %s", e)
+        if mem_raw is not None:
+            try:
+                # k8s memory quantities: a bare number is BYTES.
+                mem = parse_quantity(str(mem_raw), default_unit=1)
+            except QuantityError as e:
+                log.warning("ignoring unparseable memory request: %s", e)
+        return cpu, mem
+
+    regular = [one(c) for c in spec.get("containers") or []]
+    init = [one(c) for c in spec.get("initContainers") or []]
+    cpu = max(
+        sum(c for c, _ in regular), max((c for c, _ in init), default=0)
+    )
+    mem = max(
+        sum(m for _, m in regular), max((m for _, m in init), default=0)
+    )
+    return cpu, mem
+
+
 @dataclass
 class TpuChip:
     """One TPU chip on a host — the analog of one SCV ``Card``."""
@@ -348,6 +392,12 @@ class K8sNode:
     unschedulable: bool = False
     taints: list[Taint] = field(default_factory=list)
     labels: dict[str, str] = field(default_factory=dict)
+    # status.allocatable, parsed (0 = undeclared -> that resource is not
+    # enforced): the upstream NodeResourcesFit inputs. TPU chips are NOT
+    # tracked here — the TpuNodeMetrics CR is the authority for those.
+    alloc_cpu_milli: int = 0
+    alloc_memory: int = 0
+    alloc_pods: int = 0
 
     def to_obj(self) -> dict[str, Any]:
         spec: dict[str, Any] = {}
@@ -358,16 +408,57 @@ class K8sNode:
                 {"key": t.key, "value": t.value, "effect": t.effect}
                 for t in self.taints
             ]
-        return {
+        out: dict[str, Any] = {
             "apiVersion": "v1",
             "kind": "Node",
             "metadata": {"name": self.name, "labels": dict(self.labels)},
             "spec": spec,
         }
+        alloc: dict[str, str] = {}
+        if self.alloc_cpu_milli:
+            alloc["cpu"] = f"{self.alloc_cpu_milli}m"
+        if self.alloc_memory:
+            alloc["memory"] = str(self.alloc_memory)
+        if self.alloc_pods:
+            alloc["pods"] = str(self.alloc_pods)
+        if alloc:
+            out["status"] = {"allocatable": alloc}
+        return out
 
     @classmethod
     def from_obj(cls, obj: Mapping[str, Any]) -> "K8sNode":
+        from yoda_tpu.api.quantity import QuantityError, parse_cpu, parse_quantity
+
         spec = obj.get("spec", {})
+        alloc = (obj.get("status") or {}).get("allocatable") or {}
+        cpu = mem = pods = 0
+        log = logging.getLogger("yoda_tpu.api")
+        # Per-field: one bad field must not drop the others (and the
+        # warning must be truthful about WHICH field is unenforced).
+        if "cpu" in alloc:
+            try:
+                cpu = parse_cpu(str(alloc["cpu"]))
+            except QuantityError:
+                log.warning(
+                    "node %s: unparseable allocatable cpu %r; not enforcing",
+                    obj["metadata"]["name"], alloc["cpu"],
+                )
+        if "memory" in alloc:
+            try:
+                mem = parse_quantity(str(alloc["memory"]), default_unit=1)
+            except QuantityError:
+                log.warning(
+                    "node %s: unparseable allocatable memory %r; not "
+                    "enforcing", obj["metadata"]["name"], alloc["memory"],
+                )
+        if "pods" in alloc:
+            try:
+                pods = int(str(alloc["pods"]).strip())
+            except ValueError:
+                log.warning(
+                    "node %s: unparseable allocatable pods %r; not enforcing",
+                    obj["metadata"]["name"], alloc["pods"],
+                )
         return cls(
             name=obj["metadata"]["name"],
             unschedulable=bool(spec.get("unschedulable", False)),
@@ -380,6 +471,9 @@ class K8sNode:
                 for t in spec.get("taints", [])
             ],
             labels=dict(obj.get("metadata", {}).get("labels", {})),
+            alloc_cpu_milli=cpu,
+            alloc_memory=mem,
+            alloc_pods=pods,
         )
 
 
@@ -523,6 +617,13 @@ class PodSpec:
     # unmodified GKE TPU workloads request chips (requests.pod_request uses
     # it as the chip count when no tpu/chips label is present).
     tpu_resource_limit: int = 0
+    # Effective cpu (millicores) / memory (bytes) requests across the
+    # pod's containers (_resource_requests_of: per-container requests fall
+    # back to limits; init containers contribute their max). Enforced
+    # against K8sNode allocatable by node_fits_resources — the upstream
+    # NodeResourcesFit half the reference inherited.
+    cpu_milli_request: int = 0
+    memory_request: int = 0
     # spec.priority — what the admission controller resolves from
     # priorityClassName; the fallback when no tpu/priority label is set
     # (upstream preemption orders by this field).
@@ -590,17 +691,20 @@ class PodSpec:
             ]
         if self.spec_priority:
             spec["priority"] = self.spec_priority
-        if self.tpu_resource_limit:
-            spec["containers"] = [
-                {
-                    "name": "main",
-                    "resources": {
-                        "limits": {
-                            TPU_RESOURCE: str(self.tpu_resource_limit)
-                        }
-                    },
+        if self.tpu_resource_limit or self.cpu_milli_request or self.memory_request:
+            resources: dict[str, Any] = {}
+            if self.tpu_resource_limit:
+                resources["limits"] = {
+                    TPU_RESOURCE: str(self.tpu_resource_limit)
                 }
-            ]
+            requests: dict[str, str] = {}
+            if self.cpu_milli_request:
+                requests["cpu"] = f"{self.cpu_milli_request}m"
+            if self.memory_request:
+                requests["memory"] = str(self.memory_request)
+            if requests:
+                resources["requests"] = requests
+            spec["containers"] = [{"name": "main", "resources": resources}]
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -648,6 +752,7 @@ class PodSpec:
         )
 
         pa, paa, ppa, ppaa = parse_pod_affinity(spec)
+        cpu_req, mem_req = _resource_requests_of(spec)
         return cls(
             name=md["name"],
             namespace=md.get("namespace", "default"),
@@ -685,6 +790,8 @@ class PodSpec:
                 or ()
             ),
             tpu_resource_limit=_tpu_limit_of(spec),
+            cpu_milli_request=cpu_req,
+            memory_request=mem_req,
             spec_priority=int(spec.get("priority") or 0),
             **kwargs,
         )

@@ -54,7 +54,9 @@ from yoda_tpu.config import Weights
 from yoda_tpu.plugins.yoda.filter_plugin import (
     AffinityData,
     get_affinity,
+    get_pending_resources,
     get_request,
+    node_fits_resources,
 )
 from yoda_tpu.plugins.yoda.gang import ALLOWED_HOSTS_KEY, GANG_REMAINING_KEY
 
@@ -92,6 +94,8 @@ def _pod_constraints(pod: PodSpec) -> tuple:
         pod.preferred_pod_affinity,
         pod.preferred_pod_anti_affinity,
         pod.topology_spread,
+        pod.cpu_milli_request,
+        pod.memory_request,
     )
 
 
@@ -100,6 +104,7 @@ def _host_admission(
     snapshot: Snapshot,
     pod: PodSpec,
     aff: "AffinityData | None" = None,
+    pending_res: dict | None = None,
 ) -> np.ndarray:
     """Per-pod Node-object admission vector: cordon + taints vs the pod's
     tolerations (semantics: api.types.node_admits_pod), plus inter-pod
@@ -114,6 +119,8 @@ def _host_admission(
             return True
         ni = snapshot.get(name)
         if not pod_admits_on(ni.node, pod)[0]:
+            return False
+        if not node_fits_resources(ni, pod, pending_res)[0]:
             return False
         return aff is None or aff.feasible(ni)[0]
 
@@ -303,15 +310,16 @@ class YodaBatch(BatchFilterScorePlugin):
                 return served
         static = self._refresh_static(snapshot)
         aff = get_affinity(state)
+        pending_res = get_pending_resources(state)
         # Reservations/claims/freshness change cycle-to-cycle without a
         # metrics bump, and Node-object admission (cordon + taints +
-        # inter-pod affinity/spread vs THIS pod) is per (pod, cycle): one
-        # packed upload.
+        # inter-pod affinity/spread + resource fit vs THIS pod) is per
+        # (pod, cycle): one packed upload.
         dyn = static.dyn_packed(
             self.reserved_fn,
             self.claimed_fn,
             max_metrics_age_s=self.max_metrics_age_s,
-            host_ok=_host_admission(static, snapshot, pod, aff),
+            host_ok=_host_admission(static, snapshot, pod, aff, pending_res),
         )
         result = self._kern.evaluate(dyn, reqk)
         self.dispatch_count += 1
@@ -473,6 +481,38 @@ class YodaBatch(BatchFilterScorePlugin):
             )
             one_per_host = True  # topology plans are one member per host
         avail = result.claimable[:n].astype(np.int64).copy()
+        pending_res = get_pending_resources(state)
+
+        def members_cap(name: str) -> int | None:
+            """How many ADDITIONAL identical members the node can take by
+            cpu/memory/pod-count allocatable (None = unconstrained). The
+            kernel's feasibility already proved room for one; stacking
+            multiple plan picks on a node must respect the rest — chips
+            alone are not the only capacity (review r3: a plan could
+            overcommit allocatable the way it once overcommitted
+            anti-affinity)."""
+            if name not in snapshot:
+                return None
+            ni = snapshot.get(name)
+            node = ni.node
+            if node is None:
+                return None
+            p_cpu, p_mem, p_n = (
+                pending_res.get(name, (0, 0, 0)) if pending_res else (0, 0, 0)
+            )
+            cap: int | None = None
+            if node.alloc_pods:
+                cap = node.alloc_pods - len(ni.pods) - p_n
+            if pod.cpu_milli_request and node.alloc_cpu_milli:
+                used = sum(p.cpu_milli_request for p in ni.pods) + p_cpu
+                c = (node.alloc_cpu_milli - used) // pod.cpu_milli_request
+                cap = c if cap is None else min(cap, c)
+            if pod.memory_request and node.alloc_memory:
+                used = sum(p.memory_request for p in ni.pods) + p_mem
+                c = (node.alloc_memory - used) // pod.memory_request
+                cap = c if cap is None else min(cap, c)
+            return cap
+
         # One vectorized descending (score, name) ranking, then a walk:
         # scores never change between picks, so the greedy argmax is always
         # the first still-eligible node in this order (equivalent to the
@@ -484,9 +524,16 @@ class YodaBatch(BatchFilterScorePlugin):
         for i in order:
             if not eligible[i]:
                 continue
-            while len(picks) < k and avail[i] >= chips:
+            cap = members_cap(names[i])
+            taken = 0
+            while (
+                len(picks) < k
+                and avail[i] >= chips
+                and (cap is None or taken < cap)
+            ):
                 picks.append(names[i])
                 avail[i] -= chips
+                taken += 1
                 if one_per_host:
                     break
             if len(picks) >= k:

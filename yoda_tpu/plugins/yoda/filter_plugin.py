@@ -94,6 +94,34 @@ def get_affinity(state: CycleState) -> AffinityData | None:
     return data
 
 
+PENDING_RES_KEY = "yoda-tpu/pending-resources"
+
+
+@dataclass
+class PendingResources:
+    """Per-node (cpu millicores, memory bytes, pod count) held by in-flight
+    placements — gang members reserved at Permit or binding, not yet in
+    the snapshot's pod lists (GangPlugin.pending_placements, deduped
+    against the snapshot by uid). Written by YodaPreFilter; consumed by
+    node_fits_resources so sibling cycles cannot overcommit allocatable
+    the way they cannot overcommit chips."""
+
+    by_node: dict[str, tuple[int, int, int]]
+
+    def clone(self) -> "PendingResources":
+        return self
+
+
+def get_pending_resources(
+    state: CycleState,
+) -> dict[str, tuple[int, int, int]] | None:
+    if not state.contains(PENDING_RES_KEY):
+        return None
+    data = state.read(PENDING_RES_KEY)
+    assert isinstance(data, PendingResources)
+    return data.by_node
+
+
 # --- pure predicates (reference filter.go parity) ---
 
 
@@ -223,6 +251,52 @@ def available_chips(
     return unused - invisible_reservations(node, reserved) + freed
 
 
+def node_fits_resources(
+    ni,
+    pod: PodSpec,
+    pending_by_node: dict[str, tuple[int, int, int]] | None = None,
+) -> tuple[bool, str]:
+    """Upstream NodeResourcesFit (cpu / memory / pod count) against the
+    Node's status.allocatable. Enforced only when BOTH sides declare:
+    the pod requests the resource AND the node declares an allocatable for
+    it (0 = undeclared — minimal test fixtures and clusters without Node
+    status stay unaffected). The already-bound pods' requests are summed
+    from the snapshot's per-node pod list — O(pods-on-node), paid only by
+    request-carrying pods, so the common TPU-label-only path costs two int
+    compares. ``pending_by_node`` adds in-flight placements (gang members
+    at Permit — get_pending_resources) so sibling cycles cannot
+    overcommit allocatable between Reserve and the bind's watch event."""
+    node = ni.node
+    if node is None:
+        return True, ""
+    p_cpu, p_mem, p_n = (
+        pending_by_node.get(ni.name, (0, 0, 0))
+        if pending_by_node
+        else (0, 0, 0)
+    )
+    if node.alloc_pods and len(ni.pods) + p_n + 1 > node.alloc_pods:
+        return False, (
+            f"node pod capacity {node.alloc_pods} exhausted"
+        )
+    if pod.cpu_milli_request and node.alloc_cpu_milli:
+        used = sum(p.cpu_milli_request for p in ni.pods) + p_cpu
+        if used + pod.cpu_milli_request > node.alloc_cpu_milli:
+            return False, (
+                f"insufficient cpu: {used}m used of "
+                f"{node.alloc_cpu_milli}m allocatable, pod wants "
+                f"{pod.cpu_milli_request}m"
+            )
+    if pod.memory_request and node.alloc_memory:
+        used = sum(p.memory_request for p in ni.pods) + p_mem
+        if used + pod.memory_request > node.alloc_memory:
+            return False, (
+                f"insufficient memory: {used} bytes used of "
+                f"{node.alloc_memory} allocatable, pod wants "
+                f"{pod.memory_request}"
+            )
+    return True, ""
+
+
 # --- plugins ---
 
 
@@ -280,6 +354,26 @@ class YodaPreFilter(PreFilterPlugin):
             spread = SpreadEvaluator.build(snapshot, pod, pending=pending)
         if inter is not None or spread is not None:
             state.write(AFFINITY_KEY, AffinityData(inter, spread))
+        if pending:
+            # In-flight resource claims, deduped against the snapshot by
+            # uid (bind events may have landed since the member was
+            # recorded) — the NodeResourcesFit companion of the affinity
+            # pending feed.
+            seen = {
+                p.uid for ni in snapshot.infos() for p in ni.pods
+            }
+            by_node: dict[str, tuple[int, int, int]] = {}
+            for host, p in pending:
+                if p.uid in seen:
+                    continue
+                c, m, n = by_node.get(host, (0, 0, 0))
+                by_node[host] = (
+                    c + p.cpu_milli_request,
+                    m + p.memory_request,
+                    n + 1,
+                )
+            if by_node:
+                state.write(PENDING_RES_KEY, PendingResources(by_node))
         return Status.ok()
 
 
@@ -319,6 +413,11 @@ class YodaFilter(FilterPlugin):
             admitted, why = aff.feasible(node)
             if not admitted:
                 return Status.unschedulable(f"node {node.name}: {why}")
+        admitted, why = node_fits_resources(
+            node, pod, get_pending_resources(state)
+        )
+        if not admitted:
+            return Status.unschedulable(f"node {node.name}: {why}")
         tpu = node.tpu
         if tpu is None:
             # Reference: SCV Get error -> Unschedulable (scheduler.go:72-74).

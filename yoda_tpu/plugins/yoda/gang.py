@@ -58,7 +58,9 @@ from yoda_tpu.framework.interfaces import (
 from yoda_tpu.plugins.yoda.filter_plugin import (
     available_chips,
     get_affinity,
+    get_pending_resources,
     get_request,
+    node_fits_resources,
 )
 from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
 
@@ -146,13 +148,20 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         return max(avail // max(req.effective_chips, 1), 0)
 
     def _host_fits_member(
-        self, ni: NodeInfo, req, assigned_hosts: set[str], pod: PodSpec
+        self,
+        ni: NodeInfo,
+        req,
+        assigned_hosts: set[str],
+        pod: PodSpec,
+        pending_res: dict | None = None,
     ) -> bool:
         # Node-object admission (cordon / untolerated taints / selector /
         # required affinity) gates planning the same way it gates Filter —
         # a planned block must never include a host the members cannot
         # bind to.
         if not pod_admits_on(ni.node, pod)[0]:
+            return False
+        if not node_fits_resources(ni, pod, pending_res)[0]:
             return False
         return self._member_slots(ni, req, exclude_hosts=assigned_hosts) >= 1
 
@@ -206,6 +215,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 # when the answer is "not enough", where it IS the answer).
                 deferred = []
                 aff = get_affinity(state)
+                pending_res = get_pending_resources(state)
                 # Gang members share labels, so a required term matching the
                 # pod's OWN labels constrains the gang against itself and
                 # caps admission — without a cap the surplus member holds
@@ -235,6 +245,10 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     for ni in snapshot.infos():
                         if not pod_admits_on(ni.node, pod)[0]:
                             continue
+                        if not node_fits_resources(
+                            ni, pod, pending_res
+                        )[0]:
+                            continue
                         if aff is not None and not aff.feasible(ni)[0]:
                             continue
                         slots += self._member_slots(
@@ -248,6 +262,10 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     contributing: list[tuple[NodeInfo, int]] = []
                     for ni in snapshot.infos():
                         if not pod_admits_on(ni.node, pod)[0]:
+                            continue
+                        if not node_fits_resources(
+                            ni, pod, pending_res
+                        )[0]:
                             continue
                         if aff is not None and not aff.feasible(ni)[0]:
                             continue
@@ -324,6 +342,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         self, state, pod, snapshot, gs: _GangState, req, deferred: list[str]
     ) -> Status:
         assigned_hosts = set(gs.assigned.values())
+        pending_res = get_pending_resources(state)
         plan_hosts_free = (
             set(gs.plan) - assigned_hosts if gs.plan is not None else set()
         )
@@ -367,7 +386,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             or not plan_hosts_free
             or not all(
                 self._host_fits_member(
-                    snapshot.get(h), req, assigned_hosts, pod
+                    snapshot.get(h), req, assigned_hosts, pod, pending_res
                 )
                 for h in plan_hosts_free
                 if h in snapshot
@@ -404,7 +423,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 want_dims=gs.spec.topology,
                 slices=gs.spec.slices,
                 host_ok=lambda ni: self._host_fits_member(
-                    ni, req, assigned_hosts, pod
+                    ni, req, assigned_hosts, pod, pending_res
                 ),
                 pinned=pinned,
             )

@@ -172,7 +172,83 @@ class TpuPreemption(PostFilterPlugin):
                 or aff.inter is None
                 or aff.inter.required_affinity_feasible(ni)
             )
+            and self._resources_possible(ni, req, pod)
         )
+
+    def _resources_possible(
+        self, ni: NodeInfo, req: TpuRequest, pod: PodSpec
+    ) -> bool:
+        """Could cpu/memory/pod-count allocatable fit the preemptor after
+        evicting EVERY eligible victim? Non-victim pods (foreign
+        higher-priority, or not ours and chip-free) keep their requests —
+        if that floor alone exceeds allocatable, eviction is pure waste on
+        this node (the generation/cordon class of guard, in the
+        NodeResourcesFit dimension). Gated so nodes/pods that declare
+        nothing pay nothing."""
+        node = ni.node
+        if node is None:
+            return True
+        relevant = (
+            node.alloc_pods
+            or (pod.cpu_milli_request and node.alloc_cpu_milli)
+            or (pod.memory_request and node.alloc_memory)
+        )
+        if not relevant:
+            return True
+        floor_cpu = floor_mem = floor_n = 0
+        for p in ni.pods:
+            v = self._victim_of(p, ni.name)
+            if v is not None and v.priority < req.priority:
+                continue  # evictable: its requests can be freed
+            floor_cpu += p.cpu_milli_request
+            floor_mem += p.memory_request
+            floor_n += 1
+        if node.alloc_pods and floor_n + 1 > node.alloc_pods:
+            return False
+        if (
+            pod.cpu_milli_request
+            and node.alloc_cpu_milli
+            and floor_cpu + pod.cpu_milli_request > node.alloc_cpu_milli
+        ):
+            return False
+        if (
+            pod.memory_request
+            and node.alloc_memory
+            and floor_mem + pod.memory_request > node.alloc_memory
+        ):
+            return False
+        return True
+
+    def _fits_resources_after(
+        self, ni: NodeInfo, pod: PodSpec, chosen: "list[Victim]"
+    ) -> bool:
+        """Does cpu/memory/pod-count allocatable fit the preemptor once
+        exactly ``chosen`` are evicted? _minimal_set must keep buying
+        victims until BOTH chips and resources fit, or the eviction frees
+        chips the filter still cannot use."""
+        node = ni.node
+        if node is None:
+            return True
+        relevant = (
+            node.alloc_pods
+            or (pod.cpu_milli_request and node.alloc_cpu_milli)
+            or (pod.memory_request and node.alloc_memory)
+        )
+        if not relevant:
+            return True
+        gone = {v.pod.uid for v in chosen}
+        live = [p for p in ni.pods if p.uid not in gone]
+        if node.alloc_pods and len(live) + 1 > node.alloc_pods:
+            return False
+        if pod.cpu_milli_request and node.alloc_cpu_milli:
+            used = sum(p.cpu_milli_request for p in live)
+            if used + pod.cpu_milli_request > node.alloc_cpu_milli:
+                return False
+        if pod.memory_request and node.alloc_memory:
+            used = sum(p.memory_request for p in live)
+            if used + pod.memory_request > node.alloc_memory:
+                return False
+        return True
 
     def _avail_after(self, ni: NodeInfo, req: TpuRequest, freed: int) -> int:
         """Qualifying chips claimable once victims freeing ``freed`` chips
@@ -241,7 +317,9 @@ class TpuPreemption(PostFilterPlugin):
             if v is not None:
                 chosen.append(v)
                 freed += v.chips
-            if self._avail_after(ni, req, freed) >= want:
+            if self._avail_after(
+                ni, req, freed
+            ) >= want and self._fits_resources_after(ni, pod, chosen):
                 return chosen
         return None
 
