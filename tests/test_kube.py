@@ -421,3 +421,50 @@ class TestEviction:
             assert server.get_object("Pod", "default/lowpri") is None
         finally:
             kc.stop()
+
+
+class TestNominationPatch:
+    def test_set_nominated_node_patches_status(self, server, cluster):
+        cluster.create_pod(PodSpec("p1"))
+        cluster.set_nominated_node("default/p1", "node-9")
+        obj = server.get_object("Pod", "default/p1")
+        assert obj["status"]["nominatedNodeName"] == "node-9"
+        # Clearing deletes the key (merge-patch None semantics).
+        cluster.set_nominated_node("default/p1", None)
+        obj = server.get_object("Pod", "default/p1")
+        assert "nominatedNodeName" not in obj["status"]
+
+    def test_missing_pod_is_a_noop(self, server, cluster):
+        cluster.set_nominated_node("default/ghost", "node-1")  # no raise
+
+    def test_patch_flows_back_through_the_watch(self, server, cluster):
+        cluster.create_pod(PodSpec("p2"))
+        cluster.set_nominated_node("default/p2", "node-3")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pod = cluster.get_pod("default/p2")
+            if pod is not None and pod.nominated_node_name == "node-3":
+                break
+            time.sleep(0.02)
+        assert cluster.get_pod("default/p2").nominated_node_name == "node-3"
+
+
+class TestNominationBestEffort:
+    def test_api_errors_degrade_to_warnings(self):
+        # The nomination patch is cosmetic status on the scheduling loop's
+        # callback path: a 403 (RBAC not yet applied), 500, or socket
+        # error must never propagate and kill serve_forever.
+        class _Api:
+            def __init__(self, exc):
+                self.exc = exc
+
+            def request(self, *a, **k):
+                raise self.exc
+
+        for exc in (
+            KubeApiError(403, "forbidden"),
+            KubeApiError(500, "boom"),
+            ConnectionRefusedError(),
+        ):
+            kc = KubeCluster(_Api(exc))
+            kc.set_nominated_node("default/p", "n1")  # must not raise

@@ -65,6 +65,9 @@ class TestSchedulerManifest:
         assert {"list", "watch"} <= rules[("", "pods")]
         assert "create" in rules[("", "pods/binding")]
         assert "create" in rules[("", "pods/eviction")]
+        # set_nominated_node PATCHes status.nominatedNodeName after
+        # preemption (cluster/kube.py).
+        assert "patch" in rules[("", "pods/status")]
         assert {"list", "watch"} <= rules[("", "nodes")]
         assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
         # write_event POSTs then PUTs (count aggregation) — cluster/events.py.

@@ -549,3 +549,46 @@ class TestMalformedLabelVictimRanking:
         )
         v = p._victim_of(pod, "h1")
         assert v is not None and v.priority == 100 and v.chips == 4
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestNominatedNodeName:
+    def test_nomination_surfaces_on_pod_status(self, mode):
+        # Upstream parity: after preemption evicts victims, the preemptor's
+        # status.nominatedNodeName names the earmarked node (kubectl's
+        # NOMINATED NODE column) until it binds.
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        train = stack.cluster.get_pod("default/train")
+        assert train.nominated_node_name == "host"
+        # The nomination survives serialization (the wire shape kubectl
+        # reads).
+        assert train.to_obj()["status"]["nominatedNodeName"] == "host"
+
+    def test_stale_nomination_cleared_on_bind_elsewhere(self, mode):
+        # Nominated on one node but bound to another (capacity freed
+        # elsewhere first): the stale status.nominatedNodeName must be
+        # cleared, or readers see phantom earmarked capacity.
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        pod = PodSpec("train", labels={"tpu/chips": "2"})
+        stack.cluster.create_pod(pod)
+        # Simulate a nomination recorded for a different node.
+        stack.cluster.set_nominated_node("default/train", "other-node")
+        live = stack.cluster.get_pod("default/train")
+        stack.scheduler._nominated[live.uid] = "other-node"
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        bound = stack.cluster.get_pod("default/train")
+        assert bound.node_name == "host"
+        assert bound.nominated_node_name is None
+        assert live.uid not in stack.scheduler._nominated

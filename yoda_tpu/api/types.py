@@ -628,6 +628,10 @@ class PodSpec:
     # priorityClassName; the fallback when no tpu/priority label is set
     # (upstream preemption orders by this field).
     spec_priority: int = 0
+    # status.nominatedNodeName — written by preemption when victims were
+    # evicted to make room (upstream parity: kubectl's NOMINATED NODE
+    # column; other components see the earmarked capacity).
+    nominated_node_name: str | None = None
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -721,7 +725,11 @@ class PodSpec:
                 "annotations": {SEQ_ANNOTATION: str(self.creation_seq)},
             },
             "spec": spec,
-            "status": {"phase": self.phase},
+            "status": (
+                {"phase": self.phase, "nominatedNodeName": self.nominated_node_name}
+                if self.nominated_node_name
+                else {"phase": self.phase}
+            ),
         }
 
     @classmethod
@@ -765,6 +773,7 @@ class PodSpec:
             scheduler_name=spec.get("schedulerName", "yoda-tpu"),
             node_name=spec.get("nodeName"),
             phase=obj.get("status", {}).get("phase", "Pending"),
+            nominated_node_name=obj.get("status", {}).get("nominatedNodeName"),
             uid=md.get("uid", ""),
             tolerations=[
                 Toleration.from_obj(t) for t in spec.get("tolerations", [])

@@ -83,6 +83,16 @@ class FakeCluster:
             if pod is not None:
                 self._emit(Event("deleted", "Pod", pod))
 
+    def set_nominated_node(self, pod_key: str, node_name: str | None) -> None:
+        """The pods/status nominatedNodeName patch, fake-side (no-op for
+        missing pods, mirroring KubeCluster)."""
+        with self._lock:
+            pod = self._pods.get(pod_key)
+            if pod is None:
+                return
+            pod.nominated_node_name = node_name
+            self._emit(Event("modified", "Pod", pod))
+
     def evict_pod(self, pod_key: str) -> bool:
         """The pods/eviction subresource, fake-side: deletes unless the test
         marked the pod PDB-protected via ``eviction_blocked`` (the 429 path

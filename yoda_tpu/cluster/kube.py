@@ -139,12 +139,14 @@ class KubeApiClient:
             )
         return http.client.HTTPConnection(self._netloc, timeout=timeout)
 
-    def _headers(self, has_body: bool) -> dict[str, str]:
+    def _headers(
+        self, has_body: bool, content_type: str | None = None
+    ) -> dict[str, str]:
         h = {"Accept": "application/json"}
         if self.config.token:
             h["Authorization"] = f"Bearer {self.config.token}"
         if has_body:
-            h["Content-Type"] = "application/json"
+            h["Content-Type"] = content_type or "application/json"
         return h
 
     @staticmethod
@@ -160,6 +162,7 @@ class KubeApiClient:
         *,
         body: dict | None = None,
         params: dict | None = None,
+        content_type: str | None = None,
     ) -> dict:
         conn = self._connect(self.config.request_timeout_s)
         try:
@@ -168,7 +171,7 @@ class KubeApiClient:
                 method,
                 self._url(path, params),
                 body=payload,
-                headers=self._headers(payload is not None),
+                headers=self._headers(payload is not None, content_type),
             )
             resp = conn.getresponse()
             data = resp.read()
@@ -491,6 +494,36 @@ class KubeCluster:
         except KubeApiError as e:
             if e.status != 404:
                 raise
+
+    def set_nominated_node(self, pod_key: str, node_name: str | None) -> None:
+        """PATCH status.nominatedNodeName (merge-patch on pods/status) —
+        upstream preemption's nomination write: kubectl's NOMINATED NODE
+        column, and other components see the earmarked capacity.
+
+        Best-effort BY DESIGN: this is cosmetic status, and it is the only
+        synchronous remote write on the scheduling loop's callback path
+        (binds/events go through their own error handling) — a 403 from
+        not-yet-applied RBAC, a transient 5xx, or a socket error must
+        degrade to a warning, never kill serve_forever."""
+        namespace, name = _split_key(pod_key)
+        try:
+            self.api.request(
+                "PATCH",
+                f"{_pod_path(namespace, name)}/status",
+                body={"status": {"nominatedNodeName": node_name}},
+                content_type="application/merge-patch+json",
+            )
+        except KubeApiError as e:
+            if e.status != 404:  # pod deleted while nominating: routine
+                log.warning(
+                    "nominatedNodeName patch for %s failed (%s); status "
+                    "not updated", pod_key, e,
+                )
+        except OSError as e:
+            log.warning(
+                "nominatedNodeName patch for %s failed (%s); status not "
+                "updated", pod_key, e,
+            )
 
     def write_event(self, obj: dict, update: bool = False) -> None:
         """Persist a scheduling Event (cluster.events.EventRecorder sink):

@@ -66,6 +66,7 @@ class Scheduler:
         clock: Callable[[], float] = time.monotonic,
         on_bound: Callable[[PodSpec, str], None] | None = None,
         on_unschedulable: Callable[[PodSpec, str], None] | None = None,
+        on_nominated: Callable[[PodSpec, "str | None"], None] | None = None,
         metrics: SchedulingMetrics | None = None,
         percentage_nodes_to_score: int = 100,
         pod_alive: Callable[[PodSpec], bool] | None = None,
@@ -77,10 +78,16 @@ class Scheduler:
         self.stats = SchedulerStats()
         self.on_bound = on_bound
         self.on_unschedulable = on_unschedulable
+        self.on_nominated = on_nominated
         self.metrics = metrics
         self.percentage_nodes_to_score = percentage_nodes_to_score
         self.pod_alive = pod_alive
         self._score_rotor = 0
+        # pod uid -> node nominated by preemption this session; consulted at
+        # bind time so a pod that ends up on a DIFFERENT node gets its
+        # stale status.nominatedNodeName cleared (phantom earmarked
+        # capacity otherwise). Entries drop on bind or deletion.
+        self._nominated: dict[str, str] = {}
         self._lock = threading.Lock()
 
     def _limit_scored_nodes(self, feasible: list[str]) -> list[str]:
@@ -117,6 +124,8 @@ class Scheduler:
         # deletion races around requeues).
         if self.pod_alive is not None and not self.pod_alive(pod):
             log.debug("pod %s deleted while queued; dropping", pod.key)
+            with self._lock:
+                self._nominated.pop(pod.uid, None)
             r = ScheduleResult(pod.key, "gone", latency_s=self.clock() - t0)
             with self._lock:
                 self.stats.results.append(r)
@@ -145,6 +154,16 @@ class Scheduler:
                     pod.key, node, feasible_count, len(snapshot),
                     r.latency_s * 1e3,
                 )
+                with self._lock:
+                    nominated = self._nominated.pop(pod.uid, None)
+                if (
+                    nominated is not None
+                    and nominated != node
+                    and self.on_nominated is not None
+                ):
+                    # Bound elsewhere: the nomination is stale — clear it so
+                    # nothing keeps reading phantom earmarked capacity.
+                    self.on_nominated(pod, None)
             elif outcome == "nominated":
                 log.info("nominated %s -> %s: %s", pod.key, node, message)
             elif outcome == "unschedulable":
@@ -179,10 +198,18 @@ class Scheduler:
                     self.on_unschedulable(pod, message)
             elif outcome == "nominated":
                 # Preemption made room; victims must terminate before the pod
-                # fits, so requeue and let the next cycle place it.
+                # fits, so requeue and let the next cycle place it. The
+                # nomination is also surfaced to the cluster
+                # (status.nominatedNodeName — kubectl's NOMINATED NODE
+                # column, upstream parity) via the backend's status patch.
                 self.queue.add_unschedulable(qpi, message)
                 with self._lock:
                     self.stats.preempt_nominations += 1
+                if node is not None:
+                    with self._lock:
+                        self._nominated[pod.uid] = node
+                    if self.on_nominated is not None:
+                        self.on_nominated(pod, node)
             return r
 
         with timer.span("prefilter"):

@@ -161,6 +161,13 @@ def build_stack(
         percentage_nodes_to_score=config.percentage_nodes_to_score,
         on_bound=recorder.scheduled if recorder else None,
         on_unschedulable=recorder.failed_scheduling if recorder else None,
+        # status.nominatedNodeName write (upstream preemption parity);
+        # backends without the status subresource simply skip it.
+        on_nominated=(
+            (lambda pod, node: cluster.set_nominated_node(pod.key, node))
+            if hasattr(cluster, "set_nominated_node")
+            else None
+        ),
         pod_alive=informer.pod_alive,
     )
     return Stack(

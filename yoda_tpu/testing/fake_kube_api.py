@@ -329,6 +329,35 @@ class _Handler(BaseHTTPRequestHandler):
             updated = self.state.objects[kind][key]
         self._send_json(200, updated)
 
+    def do_PATCH(self) -> None:
+        """Merge-patch on the pods/status subresource — the nomination
+        write (KubeCluster.set_nominated_node). Only the status field is
+        merged (None values delete keys, merge-patch semantics)."""
+        path, _params = self._route()
+        parsed = self._parse(path)
+        if parsed is None or parsed[2] is None:
+            return self._send_status(404, f"unknown path {path}")
+        kind, ns, name, sub = parsed
+        if kind != POD_KIND or sub != "status":
+            return self._send_status(405, f"PATCH unsupported on {path}")
+        body = self._body()
+        key = self._key(kind, ns, name)
+        with self.state.lock:
+            current = self.state.objects[kind].get(key)
+            if current is None:
+                return self._send_status(404, f"{kind} {key} not found")
+            status = dict(current.get("status") or {})
+            for k, v in (body.get("status") or {}).items():
+                if v is None:
+                    status.pop(k, None)
+                else:
+                    status[k] = v
+            merged = dict(current)
+            merged["status"] = status
+            _record(self.state, kind, key, merged, "MODIFIED")
+            updated = self.state.objects[kind][key]
+        self._send_json(200, updated)
+
     def do_DELETE(self) -> None:
         path, _params = self._route()
         parsed = self._parse(path)
