@@ -251,6 +251,75 @@ def _fragmentation_scenario() -> dict:
     return out
 
 
+def _constrained_scenario() -> dict:
+    """Scheduling latency with the inter-pod family engaged: 4-member
+    gangs whose members carry required self-anti-affinity over hostname
+    (per-member dispatch + evaluator builds + pending-placements feed —
+    the path that bypasses the single-dispatch gang plan). Reported as
+    affinity_gang_p99_ms so the constrained path has its own budget
+    evidence next to the headline unconstrained number."""
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.affinity import LabelSelector, PodAffinityTerm
+    from yoda_tpu.api.types import K8sNode, PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    HOSTNAME = "kubernetes.io/hostname"
+    stack = build_stack(config=SchedulerConfig(mode="batch"))
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(16):
+        name = f"v5e-{i}"
+        agent.add_host(name, generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode(name, labels={HOSTNAME: name}))
+    agent.publish_all()
+
+    def gang(tag: str) -> list[PodSpec]:
+        anti = (
+            PodAffinityTerm(
+                topology_key=HOSTNAME,
+                selector=LabelSelector(match_labels=(("app", tag),)),
+            ),
+        )
+        labels = {
+            "tpu/gang": tag, "tpu/gang-size": "4", "tpu/chips": "2",
+            "app": tag,
+        }
+        return [
+            PodSpec(f"{tag}-{i}", labels=dict(labels), pod_anti_affinity=anti)
+            for i in range(4)
+        ]
+
+    for pod in gang("cwarm"):
+        stack.cluster.create_pod(pod)
+    stack.scheduler.run_until_idle(max_wall_s=120)
+    for p in list(stack.cluster.list_pods()):
+        stack.cluster.delete_pod(p.key)
+    stack.scheduler.run_until_idle(max_wall_s=10)
+
+    lats: list[float] = []
+    for g in range(15):
+        tag = f"cg{g}"
+        t0 = time.monotonic()
+        for pod in gang(tag):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        lats.append((time.monotonic() - t0) * 1000.0)
+        placed = [
+            p for p in stack.cluster.list_pods() if p.name.startswith(tag)
+        ]
+        assert all(p.node_name for p in placed), f"{tag} did not bind"
+        assert len({p.node_name for p in placed}) == 4, "anti-affinity broken"
+        for p in placed:
+            stack.cluster.delete_pod(p.key)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+    lats.sort()
+    return {
+        "affinity_gang_p99_ms": round(
+            lats[min(int(len(lats) * 0.99), len(lats) - 1)], 2
+        )
+    }
+
+
 def _agent_hw_probe() -> dict:
     """What the node agent's runtime reader (agent/runtime.py) reads off
     THIS host's real TPU — recorded per round as evidence of which values
@@ -338,6 +407,8 @@ def run_bench() -> dict:
     print(f"fragmentation (whole-host pod after partial load): {frag}", file=sys.stderr)
     mixed = _mixed_fleet_scenario()
     print(f"mixed-fleet contention (config 5): {mixed}", file=sys.stderr)
+    constrained = _constrained_scenario()
+    print(f"anti-affinity gang latency: {constrained}", file=sys.stderr)
     probe = _device_probe()
     if probe:
         print(f"kernel device probe: {probe}", file=sys.stderr)
@@ -355,6 +426,7 @@ def run_bench() -> dict:
         "binpack_efficiency": round(efficiency, 4),
         **frag,
         **mixed,
+        **constrained,
         **probe,
     }
 

@@ -722,3 +722,81 @@ class TestPendingPlacementInternals:
         # Counted once, on h1 (snapshot) — NOT also on h2 (stale pending).
         assert not ev.feasible(s.get("h1"))[0]
         assert ev.feasible(s.get("h2"))[0]
+
+
+class TestMatchLabelKeys:
+    def test_match_label_keys_scope_counting_to_own_group(self):
+        # Two rollouts of one Deployment: matchLabelKeys on
+        # pod-template-hash makes each revision spread independently —
+        # the old revision's pods must not count against the new one.
+        HASH = "pod-template-hash"
+        old = [
+            PodSpec(f"old-{i}", labels={"app": "web", HASH: "v1"})
+            for i in range(3)
+        ]
+        s = snap(
+            ("a1", {ZONE: "a"}, old),
+            ("b1", {ZONE: "b"}, []),
+        )
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            match_label_keys=(HASH,),
+        )
+        new_pod = PodSpec(
+            "new-0",
+            labels={"app": "web", HASH: "v2"},
+            topology_spread=(c,),
+        )
+        ev = SpreadEvaluator.build(s, new_pod)
+        # v1 pods don't count: zone a is as empty as zone b for v2.
+        assert ev.feasible(s.get("a1"))[0]
+        assert ev.feasible(s.get("b1"))[0]
+        # Without matchLabelKeys the v1 pods WOULD skew zone a.
+        plain = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+        )
+        ev2 = SpreadEvaluator.build(
+            s, PodSpec("n", labels={"app": "web"}, topology_spread=(plain,))
+        )
+        assert not ev2.feasible(s.get("a1"))[0]
+
+    def test_absent_key_on_incoming_pod_is_ignored(self):
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            match_label_keys=("pod-template-hash",),
+        )
+        # Pod lacks the key: selector unchanged (upstream semantics).
+        assert c.effective_selector({"app": "web"}) == c.selector
+
+    def test_roundtrip(self):
+        c = TopologySpreadConstraint(
+            max_skew=2,
+            topology_key=ZONE,
+            when_unsatisfiable="ScheduleAnyway",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            match_label_keys=("pod-template-hash",),
+        )
+        pod = PodSpec("p", topology_spread=(c,))
+        assert PodSpec.from_obj(pod.to_obj()).topology_spread == (c,)
+
+    def test_collision_with_base_selector_ands_not_overrides(self):
+        # selector app=web + matchLabelKeys ["app"] on a pod labeled
+        # app=db: upstream APPENDS `app In [db]`, producing a selector
+        # that matches nothing — it must never override the base.
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            match_label_keys=("app",),
+        )
+        sel = c.effective_selector({"app": "db"})
+        assert not sel.matches({"app": "db"})
+        assert not sel.matches({"app": "web"})

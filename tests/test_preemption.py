@@ -592,3 +592,24 @@ class TestNominatedNodeName:
         assert bound.node_name == "host"
         assert bound.nominated_node_name is None
         assert live.uid not in stack.scheduler._nominated
+
+    def test_permit_path_clears_stale_nomination(self, mode):
+        # Gang members bind via the Permit-release callback, not the
+        # direct done("bound") path; the stale-nomination clear must fire
+        # there too (review r3).
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        pod = PodSpec(
+            "g-0",
+            labels={"tpu/gang": "solo", "tpu/gang-size": "1", "tpu/chips": "1"},
+        )
+        stack.cluster.create_pod(pod)
+        stack.cluster.set_nominated_node("default/g-0", "other-node")
+        live = stack.cluster.get_pod("default/g-0")
+        stack.scheduler._nominated[live.uid] = "other-node"
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        bound = stack.cluster.get_pod("default/g-0")
+        assert bound.node_name == "host"
+        assert bound.nominated_node_name is None
+        assert live.uid not in stack.scheduler._nominated

@@ -142,3 +142,52 @@ class TestStackAtScale:
         assert batch.dispatch_count == d0 + 1
         assert batch.plan_served == 7
         assert dt_ms < 8 * 200, f"gang burst took {dt_ms:.0f} ms"
+
+
+class TestConstrainedAtScale:
+    def test_anti_affinity_pods_bind_against_1024_nodes(self):
+        """Inter-pod evaluator cost at fleet scale: anti-affinity pods
+        against 1024 labeled nodes must stay within the per-pod budget —
+        the evaluator is O(bound pods) per cycle plus O(terms) per node,
+        never O(nodes x pods)."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.affinity import LabelSelector, PodAffinityTerm
+        from yoda_tpu.api.types import K8sNode, PodSpec
+        from yoda_tpu.standalone import build_stack
+
+        HOSTNAME = "kubernetes.io/hostname"
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(N_NODES):
+            name = f"h{i:04d}"
+            agent.add_host(name, chips=8)
+            stack.cluster.put_node(K8sNode(name, labels={HOSTNAME: name}))
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=120)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+
+        anti = (
+            PodAffinityTerm(
+                topology_key=HOSTNAME,
+                selector=LabelSelector(match_labels=(("app", "web"),)),
+            ),
+        )
+        t0 = time.monotonic()
+        for i in range(8):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"aa{i}",
+                    labels={"tpu/chips": "1", "app": "web"},
+                    pod_anti_affinity=anti,
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        pods = [
+            p for p in stack.cluster.list_pods() if p.name.startswith("aa")
+        ]
+        assert len(pods) == 8 and all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 8  # spread held
+        assert dt_ms < 8 * 200, f"burst took {dt_ms:.0f} ms"

@@ -128,12 +128,38 @@ class PodAffinityTerm:
 class TopologySpreadConstraint:
     """A v1.TopologySpreadConstraint (selector-scoped skew over topology
     domains). ``when_unsatisfiable`` is DoNotSchedule (hard) or
-    ScheduleAnyway (soft)."""
+    ScheduleAnyway (soft). ``match_label_keys`` narrows the selector to
+    pods sharing the incoming pod's values for those keys (upstream: a
+    Deployment sets pod-template-hash there so each rollout spreads
+    independently); keys absent from the incoming pod's labels are
+    ignored, matching upstream."""
 
     max_skew: int
     topology_key: str
     when_unsatisfiable: str = "DoNotSchedule"
     selector: LabelSelector | None = None
+    match_label_keys: tuple[str, ...] = ()
+
+    def effective_selector(
+        self, pod_labels: Mapping[str, str]
+    ) -> "LabelSelector | None":
+        """The selector with match_label_keys folded in, ANDed as
+        additional ``In`` requirements against the incoming pod's own
+        values (upstream appends requirements — on a collision with the
+        base selector the result matches NOTHING, it never overrides)."""
+        if not self.match_label_keys or self.selector is None:
+            return self.selector
+        extra = tuple(
+            NodeSelectorRequirement(key=k, operator="In", values=(pod_labels[k],))
+            for k in self.match_label_keys
+            if k in pod_labels
+        )
+        if not extra:
+            return self.selector
+        return LabelSelector(
+            match_labels=self.selector.match_labels,
+            match_expressions=self.selector.match_expressions + extra,
+        )
 
     def to_obj(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -143,6 +169,8 @@ class TopologySpreadConstraint:
         }
         if self.selector is not None:
             out["labelSelector"] = self.selector.to_obj()
+        if self.match_label_keys:
+            out["matchLabelKeys"] = list(self.match_label_keys)
         return out
 
     @classmethod
@@ -152,6 +180,7 @@ class TopologySpreadConstraint:
             topology_key=obj.get("topologyKey", ""),
             when_unsatisfiable=obj.get("whenUnsatisfiable", "DoNotSchedule"),
             selector=LabelSelector.from_obj(obj.get("labelSelector")),
+            match_label_keys=tuple(obj.get("matchLabelKeys") or ()),
         )
 
 
@@ -468,6 +497,11 @@ class SpreadEvaluator:
         pending = tuple(pending)
         counted: list[dict[str, int]] = [{} for _ in pod.topology_spread]
         seen_uids: set[str] = set()
+        # match_label_keys folded into each constraint's selector once
+        # (pins the count to pods sharing the incoming pod's values).
+        selectors = [
+            c.effective_selector(pod.labels) for c in pod.topology_spread
+        ]
 
         def _count(ni: "NodeInfo", others: Iterable[PodSpec]) -> None:
             labels = _node_labels(ni)
@@ -477,14 +511,13 @@ class SpreadEvaluator:
                     continue
                 counts = counted[c_i]
                 counts.setdefault(v, 0)
+                sel = selectors[c_i]
                 for other in others:
                     if other.uid == pod.uid:
                         continue
                     if other.namespace != pod.namespace:
                         continue
-                    if c.selector is not None and c.selector.matches(
-                        other.labels
-                    ):
+                    if sel is not None and sel.matches(other.labels):
                         counts[v] += 1
 
         for ni in snapshot.infos():

@@ -154,16 +154,7 @@ class Scheduler:
                     pod.key, node, feasible_count, len(snapshot),
                     r.latency_s * 1e3,
                 )
-                with self._lock:
-                    nominated = self._nominated.pop(pod.uid, None)
-                if (
-                    nominated is not None
-                    and nominated != node
-                    and self.on_nominated is not None
-                ):
-                    # Bound elsewhere: the nomination is stale — clear it so
-                    # nothing keeps reading phantom earmarked capacity.
-                    self.on_nominated(pod, None)
+                self._clear_stale_nomination(pod, node)
             elif outcome == "nominated":
                 log.info("nominated %s -> %s: %s", pod.key, node, message)
             elif outcome == "unschedulable":
@@ -207,8 +198,12 @@ class Scheduler:
                     self.stats.preempt_nominations += 1
                 if node is not None:
                     with self._lock:
+                        changed = self._nominated.get(pod.uid) != node
                         self._nominated[pod.uid] = node
-                    if self.on_nominated is not None:
+                    # Re-nomination to the same node happens every retry
+                    # cycle while victims drain gracefully: skip the
+                    # identical (synchronous) status PATCH.
+                    if changed and self.on_nominated is not None:
                         self.on_nominated(pod, node)
             return r
 
@@ -336,6 +331,20 @@ class Scheduler:
         self.queue.move_all_to_active()  # cluster changed: retry parked pods
         return done("bound", node=node_name)
 
+    def _clear_stale_nomination(self, pod: PodSpec, node: str) -> None:
+        """On ANY successful bind (direct or permit-released): drop the
+        nomination record, and clear status.nominatedNodeName when the pod
+        ended up on a DIFFERENT node — a stale nomination reads as phantom
+        earmarked capacity."""
+        with self._lock:
+            nominated = self._nominated.pop(pod.uid, None)
+        if (
+            nominated is not None
+            and nominated != node
+            and self.on_nominated is not None
+        ):
+            self.on_nominated(pod, None)
+
     def _on_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
         """Fires when a waiting pod is allowed (bind it) or rejected
         (roll back its reservation and requeue)."""
@@ -352,6 +361,7 @@ class Scheduler:
                     self.metrics.binds.inc()
                 if self.on_bound:
                     self.on_bound(pod, wp.node_name)
+                self._clear_stale_nomination(pod, wp.node_name)
                 self.queue.move_all_to_active()
                 return
             status = st
