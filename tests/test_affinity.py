@@ -800,3 +800,173 @@ class TestMatchLabelKeys:
         sel = c.effective_selector({"app": "db"})
         assert not sel.matches({"app": "db"})
         assert not sel.matches({"app": "web"})
+
+
+class TestNamespaceSelector:
+    def ns_snap(self, namespaces, *entries):
+        s = snap(*entries)
+        s.namespaces = dict(namespaces)
+        return s
+
+    def test_namespace_selector_unions_with_list(self):
+        t = PodAffinityTerm(
+            topology_key=ZONE,
+            selector=LabelSelector(match_labels=(("app", "db"),)),
+            namespaces=("explicit",),
+            namespace_selector=LabelSelector(match_labels=(("team", "ml"),)),
+        )
+        ns_labels = {"ml-prod": {"team": "ml"}, "other": {"team": "web"}}
+        db = lambda ns: PodSpec("db", namespace=ns, labels={"app": "db"})
+        assert t.matches_pod(db("explicit"), "default", ns_labels)
+        assert t.matches_pod(db("ml-prod"), "default", ns_labels)
+        assert not t.matches_pod(db("other"), "default", ns_labels)
+        # With neither list nor selector membership, not even the owner's
+        # namespace applies once scoping is explicit (upstream union rule).
+        assert not t.matches_pod(db("default"), "default", ns_labels)
+
+    def test_empty_selector_matches_all_namespaces_without_data(self):
+        t = PodAffinityTerm(
+            topology_key=ZONE,
+            selector=LabelSelector(),
+            namespace_selector=LabelSelector(),
+        )
+        assert t.matches_pod(
+            PodSpec("p", namespace="anywhere"), "default", None
+        )
+
+    def test_nonempty_selector_fails_closed_without_ns_data(self):
+        t = PodAffinityTerm(
+            topology_key=ZONE,
+            selector=LabelSelector(),
+            namespace_selector=LabelSelector(match_labels=(("team", "ml"),)),
+        )
+        assert not t.matches_pod(
+            PodSpec("p", namespace="ml-prod"), "default", None
+        )
+
+    def test_roundtrip(self):
+        t = PodAffinityTerm(
+            topology_key=ZONE,
+            selector=LabelSelector(match_labels=(("app", "db"),)),
+            namespace_selector=LabelSelector(match_labels=(("team", "ml"),)),
+        )
+        assert PodAffinityTerm.from_obj(t.to_obj()) == t
+
+    def test_evaluator_resolves_against_snapshot_namespaces(self):
+        db = PodSpec("db", namespace="ml-prod", labels={"app": "db"})
+        s = self.ns_snap(
+            {"ml-prod": {"team": "ml"}},
+            ("n1", {ZONE: "a"}, [db]),
+            ("n2", {ZONE: "b"}, []),
+        )
+        pod = PodSpec(
+            "web",
+            namespace="default",
+            pod_affinity=(
+                PodAffinityTerm(
+                    topology_key=ZONE,
+                    selector=LabelSelector(match_labels=(("app", "db"),)),
+                    namespace_selector=LabelSelector(
+                        match_labels=(("team", "ml"),)
+                    ),
+                ),
+            ),
+        )
+        ev = InterPodEvaluator.build(s, pod)
+        assert ev.feasible(s.get("n1"))[0]
+        assert not ev.feasible(s.get("n2"))[0]
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_cross_namespace_affinity_e2e(self, mode):
+        from yoda_tpu.api.types import K8sNamespace
+
+        stack, agent = make_stack(mode)
+        for n, z in (("a1", "za"), ("b1", "zb")):
+            agent.add_host(n, generation="v5e", chips=8)
+            stack.cluster.put_node(K8sNode(n, labels={ZONE: z}))
+        agent.publish_all()
+        stack.cluster.put_namespace(
+            K8sNamespace("ml-prod", labels={"team": "ml"})
+        )
+        stack.cluster.create_pod(
+            PodSpec(
+                "db", namespace="ml-prod",
+                labels={"app": "db", "tpu/chips": "1"},
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        db_node = stack.cluster.get_pod("ml-prod/db").node_name
+        db_zone = {"a1": "za", "b1": "zb"}[db_node]
+        stack.cluster.create_pod(
+            PodSpec(
+                "web", namespace="default",
+                labels={"tpu/chips": "1"},
+                pod_affinity=(
+                    PodAffinityTerm(
+                        topology_key=ZONE,
+                        selector=LabelSelector(
+                            match_labels=(("app", "db"),)
+                        ),
+                        namespace_selector=LabelSelector(
+                            match_labels=(("team", "ml"),)
+                        ),
+                    ),
+                ),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        web_node = stack.cluster.get_pod("default/web").node_name
+        assert {"a1": "za", "b1": "zb"}[web_node] == db_zone
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_ns_selector_self_term_still_caps_gang_admission(self, mode):
+        # A gang whose self-anti-affinity term scopes itself via
+        # namespaceSelector must still trigger the one-per-domain
+        # admission cap (the detection passes snapshot namespace labels).
+        from yoda_tpu.api.types import K8sNamespace
+
+        stack, agent = make_stack(mode)
+        for n in ("h1", "h2"):
+            agent.add_host(n, generation="v5e", chips=8)
+            stack.cluster.put_node(K8sNode(n, labels={HOSTNAME: n}))
+        agent.publish_all()
+        stack.cluster.put_namespace(
+            K8sNamespace("ml-prod", labels={"team": "ml"})
+        )
+        anti = (
+            PodAffinityTerm(
+                topology_key=HOSTNAME,
+                selector=LabelSelector(match_labels=(("grp", "g"),)),
+                namespace_selector=LabelSelector(
+                    match_labels=(("team", "ml"),)
+                ),
+            ),
+        )
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{i}", namespace="ml-prod",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "3",
+                        "tpu/chips": "1", "grp": "g",
+                    },
+                    pod_anti_affinity=anti,
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        for i in range(3):
+            assert (
+                stack.cluster.get_pod(f"ml-prod/g-{i}").node_name is None
+            )
+        assert stack.accountant.chips_in_use("h1") == 0
+        assert stack.accountant.chips_in_use("h2") == 0
+
+    def test_fake_cluster_replays_namespaces_to_late_stacks(self):
+        from yoda_tpu.api.types import K8sNamespace
+        from yoda_tpu.cluster import FakeCluster
+
+        cluster = FakeCluster()
+        cluster.put_namespace(K8sNamespace("pre", labels={"team": "ml"}))
+        stack = build_stack(cluster=cluster)
+        snap_ns = stack.informer.snapshot().namespaces
+        assert snap_ns == {"pre": {"team": "ml"}}

@@ -468,3 +468,82 @@ class TestNominationBestEffort:
         ):
             kc = KubeCluster(_Api(exc))
             kc.set_nominated_node("default/p", "n1")  # must not raise
+
+
+class TestNamespaceWatch:
+    def test_namespace_objects_flow_to_watchers(self, server, cluster):
+        from yoda_tpu.api.types import K8sNamespace
+
+        seen = []
+        cluster.add_watcher(
+            lambda e: seen.append(e) if e.kind == "Namespace" else None
+        )
+        server.put_object(
+            "Namespace", "ml-prod",
+            K8sNamespace("ml-prod", labels={"team": "ml"}).to_obj(),
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.02)
+        assert seen and seen[0].obj.labels == {"team": "ml"}
+
+    def test_namespace_get_over_http(self, server, cluster):
+        from yoda_tpu.api.types import K8sNamespace
+
+        server.put_object(
+            "Namespace", "x", K8sNamespace("x", labels={"a": "b"}).to_obj()
+        )
+        obj = cluster.api.request("GET", "/api/v1/namespaces/x")
+        assert obj["metadata"]["labels"] == {"a": "b"}
+
+    def test_preexisting_namespaces_replay_to_late_watchers(self, server):
+        # Real startup order: cluster lists (namespaces included) BEFORE
+        # build_stack attaches the informer; the replay must cover the
+        # Namespace store or pre-existing namespaces stay invisible and
+        # namespaceSelector terms fail closed forever (review r3).
+        from yoda_tpu.api.types import K8sNamespace
+
+        server.put_object(
+            "Namespace", "pre",
+            K8sNamespace("pre", labels={"team": "ml"}).to_obj(),
+        )
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05, backoff_max_s=0.2)
+        kc.start()
+        assert kc.wait_for_sync(10.0)
+        try:
+            seen = []
+            kc.add_watcher(
+                lambda e: seen.append(e) if e.kind == "Namespace" else None
+            )
+            assert seen and seen[0].obj.name == "pre"
+        finally:
+            kc.stop()
+
+    def test_namespace_403_degrades_instead_of_blocking_sync(self):
+        # RBAC skew (image upgraded before the ClusterRole): the Namespace
+        # list 403s; sync must complete with no namespace data instead of
+        # timing out and crash-looping the Deployment.
+        import threading as _threading
+
+        class _Api:
+            class config:
+                watch_timeout_s = 1
+
+            def request(self, method, path, **kw):
+                if path.startswith("/api/v1/namespaces"):
+                    raise KubeApiError(403, "forbidden")
+                return {"items": [], "metadata": {"resourceVersion": "1"}}
+
+            def watch(self, path, *, params=None):
+                _threading.Event().wait(0.05)
+                return iter(())
+
+        kc = KubeCluster(_Api(), backoff_initial_s=0.05, backoff_max_s=0.2)
+        kc.start()
+        try:
+            assert kc.wait_for_sync(10.0), "403 on namespaces blocked sync"
+        finally:
+            kc.stop()

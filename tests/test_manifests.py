@@ -69,6 +69,8 @@ class TestSchedulerManifest:
         # preemption (cluster/kube.py).
         assert "patch" in rules[("", "pods/status")]
         assert {"list", "watch"} <= rules[("", "nodes")]
+        # Namespace watch feeds pod-affinity namespaceSelector terms.
+        assert {"list", "watch"} <= rules[("", "namespaces")]
         assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
         # write_event POSTs then PUTs (count aggregation) — cluster/events.py.
         assert {"create", "update"} <= rules[("", "events")]

@@ -31,11 +31,14 @@ Scope notes (documented divergences from upstream):
 
 - Only pods on nodes the scheduler snapshots (TPU nodes) are visible; pods
   on non-TPU nodes neither satisfy affinity nor trigger anti-affinity.
-- In-flight (reserved-but-unbound) pods — e.g. gang siblings waiting in
-  Permit — are not yet "existing pods": enforcement is against bound pods,
-  the same visibility upstream has for unbound nominees.
-- ``namespaceSelector`` and ``minDomains`` are not supported (terms list
-  namespaces explicitly or default to the owner's).
+- In-flight (reserved-but-unbound) pods ARE visible when the caller feeds
+  them via the ``pending`` argument (gang members parked at Permit —
+  GangPlugin.pending_placements); without that feed, enforcement is
+  against bound pods only.
+- ``minDomains`` is not supported. ``namespaceSelector`` IS supported
+  (union with the explicit namespaces list, upstream semantics), resolved
+  against the Namespace watch; a non-empty selector over a namespace the
+  watch has not supplied fails closed.
 
 Evaluators are built once per (pod, scheduling cycle) — O(pods x terms)
 precomputation — and answer per-node queries from dict lookups, keeping
@@ -94,18 +97,50 @@ class LabelSelector:
 @dataclass(frozen=True)
 class PodAffinityTerm:
     """A v1.PodAffinityTerm: selector over pods + the topology key that
-    defines co-location. ``namespaces`` empty = the owner pod's namespace
-    (upstream default)."""
+    defines co-location. Namespace scoping (upstream semantics): the
+    explicit ``namespaces`` list and the namespaces selected by
+    ``namespace_selector`` (over Namespace LABELS) are UNIONED; when both
+    are unset, the owner pod's namespace applies. An EMPTY (no-requirement)
+    namespace_selector selects every namespace."""
 
     topology_key: str
     selector: LabelSelector | None = None
     namespaces: tuple[str, ...] = ()
+    namespace_selector: LabelSelector | None = None
 
-    def matches_pod(self, other: PodSpec, owner_namespace: str) -> bool:
+    def allows_namespace(
+        self,
+        other_ns: str,
+        owner_namespace: str,
+        ns_labels: Mapping[str, Mapping[str, str]] | None = None,
+    ) -> bool:
+        """Is ``other_ns`` within this term's namespace scope?
+        ``ns_labels`` maps namespace name -> labels (from the Namespace
+        watch); an empty selector needs no data, a non-empty one over an
+        unknown namespace fails closed."""
+        if not self.namespaces and self.namespace_selector is None:
+            return other_ns == owner_namespace
+        if other_ns in self.namespaces:
+            return True
+        sel = self.namespace_selector
+        if sel is None:
+            return False
+        if not sel.match_labels and not sel.match_expressions:
+            return True  # empty selector: all namespaces (upstream)
+        labels = (ns_labels or {}).get(other_ns)
+        return labels is not None and sel.matches(labels)
+
+    def matches_pod(
+        self,
+        other: PodSpec,
+        owner_namespace: str,
+        ns_labels: Mapping[str, Mapping[str, str]] | None = None,
+    ) -> bool:
         if self.selector is None:
             return False  # absent selector matches no objects (upstream)
-        ns = self.namespaces or (owner_namespace,)
-        return other.namespace in ns and self.selector.matches(other.labels)
+        return self.allows_namespace(
+            other.namespace, owner_namespace, ns_labels
+        ) and self.selector.matches(other.labels)
 
     def to_obj(self) -> dict[str, Any]:
         out: dict[str, Any] = {"topologyKey": self.topology_key}
@@ -113,6 +148,8 @@ class PodAffinityTerm:
             out["labelSelector"] = self.selector.to_obj()
         if self.namespaces:
             out["namespaces"] = list(self.namespaces)
+        if self.namespace_selector is not None:
+            out["namespaceSelector"] = self.namespace_selector.to_obj()
         return out
 
     @classmethod
@@ -121,6 +158,9 @@ class PodAffinityTerm:
             topology_key=obj.get("topologyKey", ""),
             selector=LabelSelector.from_obj(obj.get("labelSelector")),
             namespaces=tuple(obj.get("namespaces") or ()),
+            namespace_selector=LabelSelector.from_obj(
+                obj.get("namespaceSelector")
+            ),
         )
 
 
@@ -297,6 +337,7 @@ class InterPodEvaluator:
         uid already appears in the snapshot (bind raced the read) are
         skipped."""
         ev = cls(pod)
+        ns_labels = getattr(snapshot, "namespaces", None)
         n_aff = len(pod.pod_affinity)
         ev._ok_values = [set() for _ in range(n_aff)]
         ev._bad_values = [set() for _ in range(len(pod.pod_anti_affinity))]
@@ -314,24 +355,24 @@ class InterPodEvaluator:
 
         def _fold(labels: Mapping[str, str], other: PodSpec) -> None:
             for i, term in enumerate(pod.pod_affinity):
-                if term.matches_pod(other, pod.namespace):
+                if term.matches_pod(other, pod.namespace, ns_labels):
                     any_term_matched[i] = True
                     v = labels.get(term.topology_key)
                     if v is not None:
                         ev._ok_values[i].add(v)
             for j, term in enumerate(pod.pod_anti_affinity):
-                if term.matches_pod(other, pod.namespace):
+                if term.matches_pod(other, pod.namespace, ns_labels):
                     v = labels.get(term.topology_key)
                     if v is not None:
                         ev._bad_values[j].add(v)
             for k, term in enumerate(pref_terms):
-                if term.matches_pod(other, pod.namespace):
+                if term.matches_pod(other, pod.namespace, ns_labels):
                     v = labels.get(term.topology_key)
                     if v is not None:
                         ev._pref_values[k][2].add(v)
             if check_symmetry and other.pod_anti_affinity:
                 for term in other.pod_anti_affinity:
-                    if term.matches_pod(pod, other.namespace):
+                    if term.matches_pod(pod, other.namespace, ns_labels):
                         v = labels.get(term.topology_key)
                         if v is not None:
                             ev._symmetry_bad.add((term.topology_key, v))
@@ -356,7 +397,8 @@ class InterPodEvaluator:
         # existing pod anywhere is satisfied iff the incoming pod matches
         # its own term — the group's first member bootstraps the domain.
         ev._self_satisfied = [
-            (not any_term_matched[i]) and term.matches_pod(pod, pod.namespace)
+            (not any_term_matched[i])
+            and term.matches_pod(pod, pod.namespace, ns_labels)
             for i, term in enumerate(pod.pod_affinity)
         ]
         return ev

@@ -378,6 +378,31 @@ class NodeSelectorTerm:
 
 
 @dataclass
+class K8sNamespace:
+    """The scheduler-relevant slice of a v1.Namespace: its labels, which
+    pod-affinity ``namespaceSelector`` terms select over (api.affinity).
+    The reference's upstream scheduler watched namespaces for the same
+    reason."""
+
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def to_obj(self) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": self.name, "labels": dict(self.labels)},
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "K8sNamespace":
+        return cls(
+            name=obj["metadata"]["name"],
+            labels=dict(obj.get("metadata", {}).get("labels", {})),
+        )
+
+
+@dataclass
 class K8sNode:
     """The scheduler-relevant slice of a v1.Node.
 

@@ -11,7 +11,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Literal
 
-from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNamespace, K8sNode, PodSpec, TpuNodeMetrics
 
 EventType = Literal["added", "modified", "deleted"]
 
@@ -19,7 +19,7 @@ EventType = Literal["added", "modified", "deleted"]
 @dataclass(frozen=True)
 class Event:
     type: EventType
-    kind: str  # "Pod" | "TpuNodeMetrics" | "Node"
+    kind: str  # "Pod" | "TpuNodeMetrics" | "Node" | "Namespace"
     obj: object
 
 
@@ -29,6 +29,7 @@ class FakeCluster:
         self._pods: dict[str, PodSpec] = {}
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
+        self._namespaces: dict[str, K8sNamespace] = {}
         self._events: dict[str, dict] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
@@ -43,6 +44,8 @@ class FakeCluster:
         with self._lock:
             self._watchers.append(fn)
             if replay:
+                for ns in self._namespaces.values():
+                    fn(Event("added", "Namespace", ns))
                 for node in self._nodes.values():
                     fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():
@@ -144,6 +147,20 @@ class FakeCluster:
             return list(self._tpus.values())
 
     # --- Node objects (cordon / taints / lifecycle) ---
+
+    def put_namespace(self, ns: K8sNamespace) -> None:
+        with self._lock:
+            is_new = ns.name not in self._namespaces
+            self._namespaces[ns.name] = ns
+            self._emit(
+                Event("added" if is_new else "modified", "Namespace", ns)
+            )
+
+    def delete_namespace(self, name: str) -> None:
+        with self._lock:
+            ns = self._namespaces.pop(name, None)
+            if ns is not None:
+                self._emit(Event("deleted", "Namespace", ns))
 
     def put_node(self, node: K8sNode) -> None:
         with self._lock:

@@ -39,6 +39,7 @@ class InformerCache:
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
+        self._namespaces: dict[str, dict[str, str]] = {}
         # True once any Node event arrived: from then on a TPU CR without a
         # live Node object is excluded from snapshots (node deleted — the
         # reference's upstream snapshot drops such nodes for free, reference
@@ -68,8 +69,20 @@ class InformerCache:
             self._handle_pod(event)
         elif event.kind == "Node":
             self._handle_node(event)
+        elif event.kind == "Namespace":
+            self._handle_namespace(event)
         if self.on_change is not None:
             self.on_change(event)
+
+    def _handle_namespace(self, event: Event) -> None:
+        ns = event.obj
+        with self._lock:
+            if event.type == "deleted":
+                self._namespaces.pop(ns.name, None)
+            else:
+                self._namespaces[ns.name] = dict(ns.labels)
+            self._version += 1
+            self._snapshot_cache = None
 
     def _handle_node(self, event: Event) -> None:
         node: K8sNode = event.obj  # type: ignore[assignment]
@@ -177,7 +190,11 @@ class InformerCache:
                 # nodes on stale-but-fresh CRs).
                 if not self._node_informed or name in self._nodes
             }
-            snap = Snapshot(nodes, version=self._version)
+            snap = Snapshot(
+                nodes,
+                version=self._version,
+                namespaces=self._namespaces or None,
+            )
             snap.metrics_version = self._metrics_version
             self._snapshot_cache = snap
             return snap

@@ -36,21 +36,29 @@ from dataclasses import dataclass, field
 
 log = logging.getLogger("yoda_tpu.cluster")
 
-from yoda_tpu.api.types import GROUP, VERSION, K8sNode, PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import (
+    GROUP,
+    VERSION,
+    K8sNamespace,
+    K8sNode,
+    PodSpec,
+    TpuNodeMetrics,
+)
 from yoda_tpu.cluster.fake import Event
 
 PODS_PATH = "/api/v1/pods"
 NODES_PATH = "/api/v1/nodes"
+NAMESPACES_PATH = "/api/v1/namespaces"
 CR_PLURAL = "tpunodemetrics"
 CR_PATH = f"/apis/{GROUP}/{VERSION}/{CR_PLURAL}"
 
-# Kinds KubeCluster can list+watch. The scheduler needs all three; the node
+# Kinds KubeCluster can list+watch. The scheduler needs all of them; the node
 # agent passes kinds=("Pod",) — it reads pods (HBM attribution of bound
 # pods) but never list/watches TpuNodeMetrics or Nodes, so its RBAC needs
 # pod reads plus only the tpunodemetrics WRITE verbs (ADVICE round 1: the
 # unconditional three-kind watch 403-crash-looped the DaemonSet on a real
 # cluster).
-SCHEDULER_KINDS = ("Pod", "TpuNodeMetrics", "Node")
+SCHEDULER_KINDS = ("Pod", "TpuNodeMetrics", "Node", "Namespace")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -236,6 +244,10 @@ class _WatchTarget:
     decode: object            # Callable[[dict], object]
     key: object               # Callable[[obj], str]
     synced: threading.Event = field(default_factory=threading.Event)
+    # Optional kinds degrade on RBAC 403 instead of blocking wait_for_sync
+    # forever: the scheduler runs with no data for that kind (documented
+    # fail-closed behavior at the consumer) while the loop keeps retrying.
+    optional: bool = False
 
 
 class KubeCluster:
@@ -264,6 +276,7 @@ class KubeCluster:
         self._pods: dict[str, PodSpec] = {}
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
+        self._nss: dict[str, K8sNamespace] = {}
         self._rvs: dict[tuple[str, str], str] = {}  # (kind, key) -> resourceVersion
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -285,6 +298,17 @@ class KubeCluster:
                 NODES_PATH,
                 decode=K8sNode.from_obj,
                 key=lambda n: n.name,
+            ),
+            "Namespace": _WatchTarget(
+                "Namespace",
+                NAMESPACES_PATH,
+                decode=K8sNamespace.from_obj,
+                key=lambda n: n.name,
+                # An image upgraded before the ClusterRole gains the
+                # namespaces rule must not crash-loop on sync timeout:
+                # namespaceSelector terms fail closed without data, the
+                # rest of the scheduler is unaffected.
+                optional=True,
             ),
         }
         unknown = set(kinds) - set(all_targets)
@@ -324,6 +348,7 @@ class KubeCluster:
             "Pod": self._pods,
             "TpuNodeMetrics": self._tpus,
             "Node": self._nodes,
+            "Namespace": self._nss,
         }[kind]
 
     def _list_rv(self, target: _WatchTarget) -> str:
@@ -409,6 +434,19 @@ class KubeCluster:
             except Exception as e:
                 if self._stop.is_set():
                     return
+                if (
+                    target.optional
+                    and isinstance(e, KubeApiError)
+                    and e.status == 403
+                    and not target.synced.is_set()
+                ):
+                    log.warning(
+                        "watch %s forbidden (RBAC not applied?); scheduler "
+                        "proceeds WITHOUT %s data — namespaceSelector terms "
+                        "fail closed until access is granted",
+                        target.kind, target.kind,
+                    )
+                    target.synced.set()  # degrade: do not block cache sync
                 # Surface persistent failures (401/403/TLS would otherwise
                 # only show up as an opaque sync timeout — ADVICE round 1).
                 log.warning(
@@ -450,6 +488,8 @@ class KubeCluster:
         with self._lock:
             self._watchers.append(fn)
             if replay:
+                for ns in self._nss.values():
+                    fn(Event("added", "Namespace", ns))
                 for node in self._nodes.values():
                     fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():

@@ -98,13 +98,23 @@ class Snapshot:
     NO API-server reads happen during a cycle (the fix for the reference's
     per-node live Gets, scheduler.go:70,108 — SURVEY.md §3.2 hot-loop)."""
 
-    def __init__(self, nodes: Mapping[str, NodeInfo], *, version: int = 0) -> None:
+    def __init__(
+        self,
+        nodes: Mapping[str, NodeInfo],
+        *,
+        version: int = 0,
+        namespaces: "Mapping[str, Mapping[str, str]] | None" = None,
+    ) -> None:
         self._nodes = dict(nodes)
         self._order = sorted(self._nodes)
         # Monotonic cache key bumped by the informer on any node/pod/metrics
         # change; lets the batch plugin reuse lowered fleet arrays across
         # cycles (0 = uncacheable).
         self.version = version
+        # Namespace name -> labels (from the Namespace watch), consumed by
+        # pod-affinity namespaceSelector terms (api.affinity). None = no
+        # Namespace data available.
+        self.namespaces = dict(namespaces) if namespaces else None
 
     def get(self, name: str) -> NodeInfo:
         return self._nodes[name]

@@ -227,15 +227,16 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 #   the gang gets max-per-domain slots, not the fleet sum
                 #   (keyless nodes contribute nothing: api.affinity rejects
                 #   bootstrapping a group onto a keyless node).
+                ns_labels = getattr(snapshot, "namespaces", None)
                 anti_self = [
                     t
                     for t in pod.pod_anti_affinity
-                    if t.matches_pod(pod, pod.namespace)
+                    if t.matches_pod(pod, pod.namespace, ns_labels)
                 ]
                 aff_self = [
                     t
                     for t in pod.pod_affinity
-                    if t.matches_pod(pod, pod.namespace)
+                    if t.matches_pod(pod, pod.namespace, ns_labels)
                 ]
                 slots = 0
                 if not anti_self and not aff_self:

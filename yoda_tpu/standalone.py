@@ -110,7 +110,12 @@ def build_stack(
         # New/changed TPU metrics may make parked pods schedulable; pod
         # deletions free chips; Node changes (uncordon, taint removal, node
         # re-added) re-open hosts. Binds already reactivate via the scheduler.
-        if event.kind in ("TpuNodeMetrics", "Node") or event.type == "deleted":
+        # Namespace label changes can open pod-affinity namespaceSelector
+        # scopes, so they reactivate parked pods too.
+        if (
+            event.kind in ("TpuNodeMetrics", "Node", "Namespace")
+            or event.type == "deleted"
+        ):
             queue.move_all_to_active()
 
     informer = InformerCache(on_pod_pending=queue.add, on_change=on_change)

@@ -25,6 +25,7 @@ CR_KIND = "TpuNodeMetrics"
 LEASE_KIND = "Lease"
 NODE_KIND = "Node"
 EVENT_KIND = "Event"
+NAMESPACE_KIND = "Namespace"
 
 
 @dataclass
@@ -37,21 +38,21 @@ class _State:
     objects: dict[str, dict[str, dict]] = field(
         default_factory=lambda: {
             POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {},
-            EVENT_KIND: {}
+            EVENT_KIND: {}, NAMESPACE_KIND: {}
         }
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
         default_factory=lambda: {
             POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: [],
-            EVENT_KIND: []
+            EVENT_KIND: [], NAMESPACE_KIND: []
         }
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
         default_factory=lambda: {
             POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0,
-            EVENT_KIND: 0
+            EVENT_KIND: 0, NAMESPACE_KIND: 0
         }
     )
     uid_seq: int = 0
@@ -217,6 +218,10 @@ class _Handler(BaseHTTPRequestHandler):
                 ns = rest[1]
                 name = rest[3] if len(rest) > 3 else None
                 return EVENT_KIND, ns, name, None
+            if rest[:1] == ["namespaces"] and len(rest) <= 2:
+                # Cluster-scoped Namespace objects: /api/v1/namespaces[/name]
+                name = rest[1] if len(rest) > 1 else None
+                return NAMESPACE_KIND, None, name, None
             return None
         if len(parts) >= 3 and parts[0] == "apis":
             from yoda_tpu.api.types import GROUP, VERSION
