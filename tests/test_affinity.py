@@ -970,3 +970,38 @@ class TestNamespaceSelector:
         stack = build_stack(cluster=cluster)
         snap_ns = stack.informer.snapshot().namespaces
         assert snap_ns == {"pre": {"team": "ml"}}
+
+    def test_unknown_namespace_is_directional(self):
+        # No namespace data: an affinity term scoped by a non-empty
+        # namespaceSelector must NOT be satisfied (pod waits — safe), but
+        # an anti-affinity term must still REPEL (a hard separation
+        # constraint cannot silently fail open). Review r3.
+        sel = LabelSelector(match_labels=(("team", "ml"),))
+        db = PodSpec("db", namespace="mystery", labels={"app": "db"})
+        s = snap(("n1", {ZONE: "a"}, [db]), ("n2", {ZONE: "b"}, []))
+        assert s.namespaces is None  # no Namespace data at all
+        aff_pod = PodSpec(
+            "web",
+            pod_affinity=(
+                PodAffinityTerm(
+                    topology_key=ZONE,
+                    selector=LabelSelector(match_labels=(("app", "db"),)),
+                    namespace_selector=sel,
+                ),
+            ),
+        )
+        ev = InterPodEvaluator.build(s, aff_pod)
+        assert not ev.feasible(s.get("n1"))[0]  # cannot confirm scope
+        anti_pod = PodSpec(
+            "loner",
+            pod_anti_affinity=(
+                PodAffinityTerm(
+                    topology_key=ZONE,
+                    selector=LabelSelector(match_labels=(("app", "db"),)),
+                    namespace_selector=sel,
+                ),
+            ),
+        )
+        ev2 = InterPodEvaluator.build(s, anti_pod)
+        assert not ev2.feasible(s.get("n1"))[0]  # conservatively repelled
+        assert ev2.feasible(s.get("n2"))[0]

@@ -613,3 +613,31 @@ class TestNominatedNodeName:
         assert bound.node_name == "host"
         assert bound.nominated_node_name is None
         assert live.uid not in stack.scheduler._nominated
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestPreemptionPolicyNever:
+    def test_never_pod_does_not_evict(self, mode):
+        # Upstream PriorityClass preemptionPolicy=Never: high priority for
+        # QUEUE ordering, but it must not displace running pods.
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("infer", labels={"tpu/chips": "2", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec(
+                "polite",
+                labels={"tpu/chips": "2", "tpu/priority": "10"},
+                preemption_policy="Never",
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/infer") is not None  # survives
+        assert stack.cluster.get_pod("default/polite").node_name is None
+        assert stack.preemption.preempted_total == 0
+        # Round-trips the wire shape.
+        p = stack.cluster.get_pod("default/polite")
+        assert PodSpec.from_obj(p.to_obj()).preemption_policy == "Never"

@@ -37,8 +37,11 @@ Scope notes (documented divergences from upstream):
   against bound pods only.
 - ``minDomains`` is not supported. ``namespaceSelector`` IS supported
   (union with the explicit namespaces list, upstream semantics), resolved
-  against the Namespace watch; a non-empty selector over a namespace the
-  watch has not supplied fails closed.
+  against the Namespace watch. A non-empty selector over a namespace with
+  no data is treated DIRECTIONALLY: out of scope for affinity/preferred
+  terms (the pod just waits — safe), but IN scope for required
+  anti-affinity and its symmetry check (unknown namespaces still repel:
+  a hard separation constraint must not silently fail open).
 
 Evaluators are built once per (pod, scheduling cycle) — O(pods x terms)
 precomputation — and answer per-node queries from dict lookups, keeping
@@ -113,11 +116,18 @@ class PodAffinityTerm:
         other_ns: str,
         owner_namespace: str,
         ns_labels: Mapping[str, Mapping[str, str]] | None = None,
+        *,
+        assume_unknown: bool = False,
     ) -> bool:
         """Is ``other_ns`` within this term's namespace scope?
         ``ns_labels`` maps namespace name -> labels (from the Namespace
-        watch); an empty selector needs no data, a non-empty one over an
-        unknown namespace fails closed."""
+        watch); an empty selector needs no data. For a non-empty selector
+        over a namespace with no data, ``assume_unknown`` decides: False
+        (default) treats it as out of scope — the safe direction for
+        AFFINITY, where a false negative just holds the pod — while
+        anti-affinity callers pass True so unknown namespaces still REPEL
+        (a false negative there would co-locate workloads a hard
+        constraint separates)."""
         if not self.namespaces and self.namespace_selector is None:
             return other_ns == owner_namespace
         if other_ns in self.namespaces:
@@ -128,18 +138,25 @@ class PodAffinityTerm:
         if not sel.match_labels and not sel.match_expressions:
             return True  # empty selector: all namespaces (upstream)
         labels = (ns_labels or {}).get(other_ns)
-        return labels is not None and sel.matches(labels)
+        if labels is None:
+            return assume_unknown
+        return sel.matches(labels)
 
     def matches_pod(
         self,
         other: PodSpec,
         owner_namespace: str,
         ns_labels: Mapping[str, Mapping[str, str]] | None = None,
+        *,
+        assume_unknown: bool = False,
     ) -> bool:
         if self.selector is None:
             return False  # absent selector matches no objects (upstream)
         return self.allows_namespace(
-            other.namespace, owner_namespace, ns_labels
+            other.namespace,
+            owner_namespace,
+            ns_labels,
+            assume_unknown=assume_unknown,
         ) and self.selector.matches(other.labels)
 
     def to_obj(self) -> dict[str, Any]:
@@ -337,7 +354,7 @@ class InterPodEvaluator:
         uid already appears in the snapshot (bind raced the read) are
         skipped."""
         ev = cls(pod)
-        ns_labels = getattr(snapshot, "namespaces", None)
+        ns_labels = snapshot.namespaces
         n_aff = len(pod.pod_affinity)
         ev._ok_values = [set() for _ in range(n_aff)]
         ev._bad_values = [set() for _ in range(len(pod.pod_anti_affinity))]
@@ -361,7 +378,9 @@ class InterPodEvaluator:
                     if v is not None:
                         ev._ok_values[i].add(v)
             for j, term in enumerate(pod.pod_anti_affinity):
-                if term.matches_pod(other, pod.namespace, ns_labels):
+                if term.matches_pod(
+                    other, pod.namespace, ns_labels, assume_unknown=True
+                ):
                     v = labels.get(term.topology_key)
                     if v is not None:
                         ev._bad_values[j].add(v)
@@ -372,7 +391,9 @@ class InterPodEvaluator:
                         ev._pref_values[k][2].add(v)
             if check_symmetry and other.pod_anti_affinity:
                 for term in other.pod_anti_affinity:
-                    if term.matches_pod(pod, other.namespace, ns_labels):
+                    if term.matches_pod(
+                        pod, other.namespace, ns_labels, assume_unknown=True
+                    ):
                         v = labels.get(term.topology_key)
                         if v is not None:
                             ev._symmetry_bad.add((term.topology_key, v))

@@ -657,6 +657,9 @@ class PodSpec:
     # evicted to make room (upstream parity: kubectl's NOMINATED NODE
     # column; other components see the earmarked capacity).
     nominated_node_name: str | None = None
+    # spec.preemptionPolicy — "Never" pods queue at their priority but
+    # must not trigger evictions (upstream PriorityClass preemptionPolicy).
+    preemption_policy: str = "PreemptLowerPriority"
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -720,6 +723,8 @@ class PodSpec:
             ]
         if self.spec_priority:
             spec["priority"] = self.spec_priority
+        if self.preemption_policy != "PreemptLowerPriority":
+            spec["preemptionPolicy"] = self.preemption_policy
         if self.tpu_resource_limit or self.cpu_milli_request or self.memory_request:
             resources: dict[str, Any] = {}
             if self.tpu_resource_limit:
@@ -827,6 +832,9 @@ class PodSpec:
             cpu_milli_request=cpu_req,
             memory_request=mem_req,
             spec_priority=int(spec.get("priority") or 0),
+            preemption_policy=(
+                spec.get("preemptionPolicy") or "PreemptLowerPriority"
+            ),
             **kwargs,
         )
 

@@ -442,8 +442,10 @@ class KubeCluster:
                 ):
                     log.warning(
                         "watch %s forbidden (RBAC not applied?); scheduler "
-                        "proceeds WITHOUT %s data — namespaceSelector terms "
-                        "fail closed until access is granted",
+                        "proceeds WITHOUT %s data — namespaceSelector "
+                        "affinity terms match nothing (pods wait) and "
+                        "anti-affinity terms repel conservatively until "
+                        "access is granted",
                         target.kind, target.kind,
                     )
                     target.synced.set()  # degrade: do not block cache sync

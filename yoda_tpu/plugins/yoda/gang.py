@@ -227,7 +227,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 #   the gang gets max-per-domain slots, not the fleet sum
                 #   (keyless nodes contribute nothing: api.affinity rejects
                 #   bootstrapping a group onto a keyless node).
-                ns_labels = getattr(snapshot, "namespaces", None)
+                ns_labels = snapshot.namespaces
                 anti_self = [
                     t
                     for t in pod.pod_anti_affinity

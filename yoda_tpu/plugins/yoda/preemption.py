@@ -336,6 +336,12 @@ class TpuPreemption(PostFilterPlugin):
             # Label parsing itself failed; eviction cannot help.
             return None, Status.unschedulable("no parsed request; cannot preempt")
         req = get_request(state)
+        if pod.preemption_policy == "Never":
+            # Upstream PriorityClass preemptionPolicy=Never: the pod queues
+            # at its priority but must not displace anyone.
+            return None, Status.unschedulable(
+                f"{pod.key} has preemptionPolicy=Never; not evicting"
+            )
         # Required pod-affinity domains are immutable under eviction (it
         # only removes matching pods, never adds them), so nodes failing
         # that check are never worth evicting on — same class of guard as
