@@ -1005,3 +1005,72 @@ class TestNamespaceSelector:
         ev2 = InterPodEvaluator.build(s, anti_pod)
         assert not ev2.feasible(s.get("n1"))[0]  # conservatively repelled
         assert ev2.feasible(s.get("n2"))[0]
+
+
+class TestMinDomains:
+    def test_min_domains_forces_spreading_while_under_populated(self):
+        # Only 2 eligible zones but minDomains=3: the global min is
+        # treated as 0, so a second pod in any occupied zone exceeds
+        # maxSkew=1 and must wait for capacity in a new domain.
+        w = PodSpec("w0", labels={"app": "web"})
+        s = snap(("a1", {ZONE: "a"}, [w]), ("b1", {ZONE: "b"}, []))
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            min_domains=3,
+        )
+        pod = PodSpec("w1", labels={"app": "web"}, topology_spread=(c,))
+        ev = SpreadEvaluator.build(s, pod)
+        assert not ev.feasible(s.get("a1"))[0]  # a already holds one
+        assert ev.feasible(s.get("b1"))[0]      # b is empty: count+1-0 = 1
+
+    def test_min_domains_blocks_stacking_when_all_domains_populated(self):
+        # THE distinguishing case (mutation-tested: deleting the lo=0
+        # branch must fail this): a single populated zone, lo=1 without
+        # minDomains — stacking would pass maxSkew — but minDomains=2
+        # forces lo=0, so a second pod in zone a exceeds skew and waits.
+        w = PodSpec("w0", labels={"app": "web"})
+        s = snap(("a1", {ZONE: "a"}, [w]))
+        sel = LabelSelector(match_labels=(("app", "web"),))
+        blocked = TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, selector=sel, min_domains=2
+        )
+        allowed = TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, selector=sel
+        )
+        p = lambda c: PodSpec(
+            "w1", labels={"app": "web"}, topology_spread=(c,)
+        )
+        assert not SpreadEvaluator.build(s, p(blocked)).feasible(
+            s.get("a1")
+        )[0]
+        assert SpreadEvaluator.build(s, p(allowed)).feasible(s.get("a1"))[0]
+
+    def test_min_domains_satisfied_reverts_to_normal_skew(self):
+        w = lambda i, z: PodSpec(f"w{i}", labels={"app": "web"})
+        s = snap(
+            ("a1", {ZONE: "a"}, [w(0, "a")]),
+            ("b1", {ZONE: "b"}, [w(1, "b")]),
+            ("c1", {ZONE: "c"}, [w(2, "c")]),
+        )
+        c = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            min_domains=3,
+        )
+        pod = PodSpec("w3", labels={"app": "web"}, topology_spread=(c,))
+        ev = SpreadEvaluator.build(s, pod)
+        # 3 domains exist with min=1: placing anywhere keeps skew <= 1.
+        assert ev.feasible(s.get("a1"))[0]
+
+    def test_roundtrip(self):
+        c = TopologySpreadConstraint(
+            max_skew=1, topology_key=ZONE, min_domains=4,
+            selector=LabelSelector(),
+        )
+        pod = PodSpec("p", topology_spread=(c,))
+        assert PodSpec.from_obj(pod.to_obj()).topology_spread == (c,)

@@ -35,7 +35,8 @@ Scope notes (documented divergences from upstream):
   them via the ``pending`` argument (gang members parked at Permit —
   GangPlugin.pending_placements); without that feed, enforcement is
   against bound pods only.
-- ``minDomains`` is not supported. ``namespaceSelector`` IS supported
+- ``minDomains`` is supported (DoNotSchedule constraints: global min is
+  0 while fewer eligible domains exist). ``namespaceSelector`` IS supported
   (union with the explicit namespaces list, upstream semantics), resolved
   against the Namespace watch. A non-empty selector over a namespace with
   no data is treated DIRECTIONALLY: out of scope for affinity/preferred
@@ -196,6 +197,10 @@ class TopologySpreadConstraint:
     when_unsatisfiable: str = "DoNotSchedule"
     selector: LabelSelector | None = None
     match_label_keys: tuple[str, ...] = ()
+    # minDomains (DoNotSchedule only, upstream): while fewer eligible
+    # domains exist than this, the global minimum is treated as 0 so new
+    # pods keep spreading into new domains instead of stacking.
+    min_domains: int = 0
 
     def effective_selector(
         self, pod_labels: Mapping[str, str]
@@ -228,6 +233,8 @@ class TopologySpreadConstraint:
             out["labelSelector"] = self.selector.to_obj()
         if self.match_label_keys:
             out["matchLabelKeys"] = list(self.match_label_keys)
+        if self.min_domains:
+            out["minDomains"] = self.min_domains
         return out
 
     @classmethod
@@ -238,6 +245,7 @@ class TopologySpreadConstraint:
             when_unsatisfiable=obj.get("whenUnsatisfiable", "DoNotSchedule"),
             selector=LabelSelector.from_obj(obj.get("labelSelector")),
             match_label_keys=tuple(obj.get("matchLabelKeys") or ()),
+            min_domains=int(obj.get("minDomains") or 0),
         )
 
 
@@ -630,6 +638,8 @@ class SpreadEvaluator:
                     f"node lacks topology key {c.topology_key!r} required "
                     "by a DoNotSchedule spread constraint"
                 )
+            if c.min_domains and len(counts) < c.min_domains:
+                lo = 0  # upstream minDomains: under-populated domain set
             if counts.get(v, 0) + 1 - lo > c.max_skew:
                 return False, (
                     f"placing here would exceed maxSkew={c.max_skew} over "
