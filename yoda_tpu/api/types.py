@@ -660,6 +660,10 @@ class PodSpec:
     # spec.preemptionPolicy — "Never" pods queue at their priority but
     # must not trigger evictions (upstream PriorityClass preemptionPolicy).
     preemption_policy: str = "PreemptLowerPriority"
+    # spec.schedulingGates — gate names; while non-empty the pod must NOT
+    # be scheduled (upstream PodSchedulingReadiness: how Kueue and quota
+    # controllers hold pods until admission).
+    scheduling_gates: tuple[str, ...] = ()
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -725,6 +729,10 @@ class PodSpec:
             spec["priority"] = self.spec_priority
         if self.preemption_policy != "PreemptLowerPriority":
             spec["preemptionPolicy"] = self.preemption_policy
+        if self.scheduling_gates:
+            spec["schedulingGates"] = [
+                {"name": g} for g in self.scheduling_gates
+            ]
         if self.tpu_resource_limit or self.cpu_milli_request or self.memory_request:
             resources: dict[str, Any] = {}
             if self.tpu_resource_limit:
@@ -834,6 +842,9 @@ class PodSpec:
             spec_priority=int(spec.get("priority") or 0),
             preemption_policy=(
                 spec.get("preemptionPolicy") or "PreemptLowerPriority"
+            ),
+            scheduling_gates=tuple(
+                g.get("name", "") for g in spec.get("schedulingGates") or ()
             ),
             **kwargs,
         )

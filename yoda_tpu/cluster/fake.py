@@ -80,6 +80,21 @@ class FakeCluster:
             pod.phase = "Running"
             self._emit(Event("modified", "Pod", pod))
 
+    def update_pod(self, pod: PodSpec) -> None:
+        """Replace an existing pod's spec (e.g. a controller clearing
+        spec.schedulingGates) and emit the modified event. Object identity
+        (uid, arrival order) is preserved from the stored pod — a real API
+        server keeps metadata.uid across updates, and the informer's
+        gate-clear detection keys on it."""
+        with self._lock:
+            old = self._pods.get(pod.key)
+            if old is None:
+                raise KeyError(pod.key)
+            pod.uid = old.uid
+            pod.creation_seq = old.creation_seq
+            self._pods[pod.key] = pod
+            self._emit(Event("modified", "Pod", pod))
+
     def delete_pod(self, pod_key: str) -> None:
         with self._lock:
             pod = self._pods.pop(pod_key, None)

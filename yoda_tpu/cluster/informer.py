@@ -53,6 +53,10 @@ class InformerCache:
         # queued is dropped instead of retried forever (upstream removes
         # deleted pods from its scheduling queues).
         self._live_uids: set[str] = set()
+        # Unbound pods held by spec.schedulingGates (upstream
+        # PodSchedulingReadiness): kept OUT of the scheduling queue until a
+        # modified event shows the gates cleared.
+        self._gated_uids: set[str] = set()
         # pod uid -> (node counted on, claim MiB added) — the stored claim is
         # subtracted on uncount so later label mutations cannot skew totals.
         self._pod_nodes: dict[str, tuple[str, int]] = {}
@@ -126,11 +130,24 @@ class InformerCache:
                 counted = None
             if event.type != "deleted" and pod.node_name and counted is None:
                 self._count_pod(pod, pod.node_name)
-            if (
-                event.type == "added"
+            ours_unbound = (
+                event.type != "deleted"
                 and pod.node_name is None
                 and pod.scheduler_name == self.scheduler_name
+            )
+            if event.type == "deleted":
+                self._gated_uids.discard(pod.uid)
+            elif ours_unbound and pod.scheduling_gates:
+                self._gated_uids.add(pod.uid)  # held, not schedulable
+            elif event.type == "added" and ours_unbound:
+                pending = True
+            elif (
+                event.type == "modified"
+                and ours_unbound
+                and pod.uid in self._gated_uids
             ):
+                # Gates cleared: NOW the pod becomes schedulable.
+                self._gated_uids.discard(pod.uid)
                 pending = True
             self._version += 1
             self._snapshot_cache = None
@@ -169,6 +186,20 @@ class InformerCache:
         and re-created pod has a fresh uid and is unaffected)."""
         with self._lock:
             return pod.uid in self._live_uids
+
+    def pod_schedulable(self, pod: PodSpec) -> bool:
+        """Should a popped queue entry actually be scheduled? False for
+        deleted pods, pods the informer already counts as BOUND (a stale
+        duplicate queue entry must not double-bind), and pods currently
+        held by scheduling gates (a stale pre-gate-clear copy). The
+        scheduler drops such entries at cycle start; the fresh watch event
+        enqueued the current copy."""
+        with self._lock:
+            return (
+                pod.uid in self._live_uids
+                and pod.uid not in self._pod_nodes
+                and pod.uid not in self._gated_uids
+            )
 
     def snapshot(self) -> Snapshot:
         """Consistent view for one scheduling cycle. Cached until the next

@@ -123,7 +123,13 @@ class Scheduler:
         # its queues; here the check is at cycle start, which also covers
         # deletion races around requeues).
         if self.pod_alive is not None and not self.pod_alive(pod):
-            log.debug("pod %s deleted while queued; dropping", pod.key)
+            # The hook reports "should this queue entry still be scheduled"
+            # (informer.pod_schedulable): deleted, already bound via a
+            # fresher copy, or currently held by scheduling gates.
+            log.debug(
+                "pod %s no longer schedulable (deleted/bound/gated); "
+                "dropping queue entry", pod.key,
+            )
             with self._lock:
                 self._nominated.pop(pod.uid, None)
             r = ScheduleResult(pod.key, "gone", latency_s=self.clock() - t0)
@@ -206,6 +212,17 @@ class Scheduler:
                     if changed and self.on_nominated is not None:
                         self.on_nominated(pod, node)
             return r
+
+        if pod.scheduling_gates:
+            # Defensive (the informer keeps gated pods out of the queue): a
+            # gated copy that reaches a cycle anyway parks via the standard
+            # unresolvable path — full metrics/trace/Events bookkeeping —
+            # until the gate-clear watch event enqueues the current copy.
+            return done(
+                "unschedulable",
+                message="pod has scheduling gates; not ready to schedule",
+                unresolvable=True,
+            )
 
         with timer.span("prefilter"):
             st = self.framework.run_pre_filter(state, pod, snapshot)

@@ -173,7 +173,7 @@ def build_stack(
             if hasattr(cluster, "set_nominated_node")
             else None
         ),
-        pod_alive=informer.pod_alive,
+        pod_alive=informer.pod_schedulable,
     )
     return Stack(
         cluster,
