@@ -1,0 +1,221 @@
+"""Scheduler profiles: one process serving several schedulerNames with
+different plugin configs (upstream KubeSchedulerConfiguration profiles).
+Each profile's stack shares the cluster watch streams; pods route to the
+profile whose scheduler_name matches their spec.schedulerName."""
+
+import threading
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster import FakeCluster
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_profile_stacks
+
+
+class TestProfileConfig:
+    def test_profiles_inherit_base_and_override(self):
+        c = SchedulerConfig.from_dict(
+            {
+                "mode": "batch",
+                "max_metrics_age_s": 30.0,
+                "weights": {"hbm_free": 5},
+                "profiles": [
+                    {
+                        "scheduler_name": "yoda-tpu-batch",
+                        "scoring_strategy": "most-allocated",
+                    }
+                ],
+            }
+        )
+        (p,) = c.profiles
+        assert p.scheduler_name == "yoda-tpu-batch"
+        assert p.scoring_strategy == "most-allocated"
+        assert p.max_metrics_age_s == 30.0       # inherited
+        assert p.weights.hbm_free == 5           # inherited weights
+        assert c.scoring_strategy == "least-allocated"
+
+    def test_profile_requires_scheduler_name(self):
+        with pytest.raises(ValueError, match="scheduler_name"):
+            SchedulerConfig.from_dict(
+                {"profiles": [{"scoring_strategy": "most-allocated"}]}
+            )
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="distinct"):
+            SchedulerConfig.from_dict(
+                {"profiles": [{"scheduler_name": "yoda-tpu"}]}
+            )
+
+
+class TestProfilesE2E:
+    def test_pods_route_to_their_profile(self):
+        cluster = FakeCluster()
+        config = SchedulerConfig.from_dict(
+            {
+                "profiles": [
+                    {
+                        "scheduler_name": "yoda-tpu-batch",
+                        "scoring_strategy": "most-allocated",
+                    }
+                ]
+            }
+        )
+        stacks = build_profile_stacks(cluster, config)
+        agent = FakeTpuAgent(cluster)
+        agent.add_host("h1", chips=8)
+        agent.add_host("h2", chips=8)
+        agent.publish_all()
+        cluster.create_pod(PodSpec("base-pod", labels={"tpu/chips": "1"}))
+        cluster.create_pod(
+            PodSpec(
+                "batch-pod",
+                labels={"tpu/chips": "1"},
+                scheduler_name="yoda-tpu-batch",
+            )
+        )
+        cluster.create_pod(
+            PodSpec(
+                "foreign",
+                labels={"tpu/chips": "1"},
+                scheduler_name="default-scheduler",
+            )
+        )
+        for st in stacks:
+            st.scheduler.run_until_idle(max_wall_s=10)
+        assert cluster.get_pod("default/base-pod").node_name is not None
+        assert cluster.get_pod("default/batch-pod").node_name is not None
+        # Neither profile touches a foreign schedulerName.
+        assert cluster.get_pod("default/foreign").node_name is None
+        # Each profile scheduled exactly its own pod.
+        assert stacks[0].scheduler.stats.binds == 1
+        assert stacks[1].scheduler.stats.binds == 1
+
+    def test_profiles_see_each_others_reservations(self):
+        # Accounting counts every TPU-holding pod regardless of profile,
+        # so one profile cannot double-book chips the other placed.
+        cluster = FakeCluster()
+        config = SchedulerConfig.from_dict(
+            {"profiles": [{"scheduler_name": "yoda-tpu-b"}]}
+        )
+        stacks = build_profile_stacks(cluster, config)
+        agent = FakeTpuAgent(cluster)
+        agent.add_host("only", chips=2)
+        agent.publish_all()
+        cluster.create_pod(PodSpec("a", labels={"tpu/chips": "2"}))
+        stacks[0].scheduler.run_until_idle(max_wall_s=10)
+        assert cluster.get_pod("default/a").node_name == "only"
+        cluster.create_pod(
+            PodSpec(
+                "b", labels={"tpu/chips": "2"}, scheduler_name="yoda-tpu-b"
+            )
+        )
+        stacks[1].scheduler.run_until_idle(max_wall_s=10)
+        assert cluster.get_pod("default/b").node_name is None
+        assert stacks[1].accountant.chips_in_use("only") == 2
+
+    def test_concurrent_profile_loops(self):
+        # Both profiles serving concurrently against one fleet: no
+        # oversubscription, every pod lands with its own profile.
+        cluster = FakeCluster()
+        config = SchedulerConfig.from_dict(
+            {"profiles": [{"scheduler_name": "yoda-tpu-b"}]}
+        )
+        stacks = build_profile_stacks(cluster, config)
+        agent = FakeTpuAgent(cluster)
+        for i in range(4):
+            agent.add_host(f"h{i}", chips=4)
+        agent.publish_all()
+        stop = threading.Event()
+        threads = [
+            threading.Thread(
+                target=st.scheduler.serve_forever,
+                args=(stop,),
+                kwargs={"poll_s": 0.005},
+                daemon=True,
+            )
+            for st in stacks
+        ]
+        for t in threads:
+            t.start()
+        for i in range(8):
+            name = "yoda-tpu" if i % 2 == 0 else "yoda-tpu-b"
+            cluster.create_pod(
+                PodSpec(
+                    f"p{i}", labels={"tpu/chips": "2"}, scheduler_name=name
+                )
+            )
+        import time as _t
+
+        deadline = _t.monotonic() + 20
+        while _t.monotonic() < deadline:
+            if all(p.node_name for p in cluster.list_pods()):
+                break
+            _t.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        pods = cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        used = {}
+        for p in pods:
+            used[p.node_name] = used.get(p.node_name, 0) + 2
+        for m in cluster.list_tpu_metrics():
+            assert used.get(m.name, 0) <= m.chip_count
+
+
+class TestProfileWiring:
+    """Review regressions: shared accountant/metrics/victim-rules."""
+
+    def _stacks(self):
+        cluster = FakeCluster()
+        config = SchedulerConfig.from_dict(
+            {"profiles": [{"scheduler_name": "yoda-tpu-b"}]}
+        )
+        return build_profile_stacks(cluster, config)
+
+    def test_metrics_registry_is_shared_and_aggregates(self):
+        stacks = self._stacks()
+        assert stacks[0].metrics is stacks[1].metrics
+        rendered = stacks[0].metrics.registry.render_prometheus()
+        # One family, summed over BOTH profiles' batch plugins.
+        assert rendered.count("# TYPE yoda_kernel_dispatches_total") == 1
+        assert len(stacks[0].metrics._batch_plugins) == 2
+
+    def test_preemption_recognizes_all_profile_names(self):
+        stacks = self._stacks()
+        for st in stacks:
+            assert st.preemption.scheduler_names == {
+                "yoda-tpu", "yoda-tpu-b",
+            }
+        assert stacks[0].accountant is stacks[1].accountant
+        assert stacks[0].accountant.scheduler_names == {
+            "yoda-tpu", "yoda-tpu-b",
+        }
+
+    def test_cycle_lock_is_shared_and_released_during_permit_wait(self):
+        # A gang member parks at Permit (outcome "waiting"); the shared
+        # cycle lock must already be free or every other profile stalls
+        # behind the barrier.
+        stacks = self._stacks()
+        assert (
+            stacks[0].scheduler.cycle_lock is stacks[1].scheduler.cycle_lock
+        )
+        agent = FakeTpuAgent(stacks[0].cluster)
+        agent.add_host("h1", chips=8)
+        agent.publish_all()
+        stacks[0].cluster.create_pod(
+            PodSpec(
+                "g-0",
+                labels={
+                    "tpu/gang": "g", "tpu/gang-size": "2", "tpu/chips": "1"
+                },
+            )
+        )
+        # Drain member 0 into the Permit waitlist.
+        qpi = stacks[0].queue.pop(timeout=2)
+        r = stacks[0].scheduler.schedule_one(qpi)
+        assert r.outcome == "waiting"
+        assert stacks[0].scheduler.cycle_lock.acquire(timeout=0.5)
+        stacks[0].scheduler.cycle_lock.release()

@@ -86,12 +86,16 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     Deployment restarts the pod into standby (upstream kube-scheduler
     behavior, reference deploy/yoda-scheduler.yaml:11-14)."""
     from yoda_tpu.metrics_server import MetricsServer
-    from yoda_tpu.standalone import build_stack
+    from yoda_tpu.standalone import build_profile_stacks
 
     config = _load_config(args.config)
     _init_jax(args.jax_platform)
     cluster = _build_kube_cluster()
-    stack = build_stack(cluster=cluster, config=config)
+    # Upstream profiles: one process can serve several schedulerNames,
+    # each with its own plugin config (config `profiles:`). The base
+    # profile's stack owns the metrics endpoint and the leader gate.
+    stacks = build_profile_stacks(cluster, config)
+    stack = stacks[0]
 
     metrics_srv = None
     if args.metrics_port >= 0:
@@ -151,13 +155,33 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             if stop.is_set() and not became_leader.is_set():
                 return 0  # stopped while standby
 
+        names = [config.scheduler_name] + [
+            p.scheduler_name for p in config.profiles
+        ]
         print(
             f"yoda-tpu-scheduler: serving (mode={config.mode}, "
+            f"profiles={names}, "
             f"nodes={len(cluster.list_tpu_metrics())}, pods={len(cluster.list_pods())})",
             file=sys.stderr,
         )
+        extra_threads = [
+            threading.Thread(
+                target=st.scheduler.serve_forever,
+                args=(stop,),
+                name=f"scheduler-{st.informer.scheduler_name}",
+                daemon=True,
+            )
+            for st in stacks[1:]
+        ]
+        for t in extra_threads:
+            t.start()
         stack.scheduler.serve_forever(stop)
+        for t in extra_threads:
+            t.join(timeout=10)
     finally:
+        for st in stacks[1:]:
+            if st.events is not None:
+                st.events.close(timeout_s=5.0)
         if stack.events is not None:
             # Drain pending Scheduled/FailedScheduling/Preempted events so a
             # SIGTERM right after a decision doesn't lose its trail.

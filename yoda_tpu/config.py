@@ -73,6 +73,9 @@ class SchedulerConfig:
     analog, made real)."""
 
     mode: str = "batch"               # "batch" (fused kernel) | "loop"
+    # The spec.schedulerName this profile serves (upstream profiles: one
+    # binary, several schedulerNames with different plugin configs).
+    scheduler_name: str = "yoda-tpu"
     weights: Weights = field(default_factory=Weights)
     # Upstream NodeResourcesFit scoringStrategy analog:
     # "least-allocated" (default) prefers the freest qualifying node —
@@ -101,11 +104,39 @@ class SchedulerConfig:
     # kernel under the kernel_platform policy; when set, mesh devices come
     # from jax.devices() and kernel_platform is ignored.
     mesh_devices: int | None = None
+    # Additional profiles (upstream KubeSchedulerConfiguration profiles):
+    # each entry inherits every unspecified key from the base config and
+    # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
+    # base plus a bin-packing "yoda-tpu-batch" profile in one process.
+    profiles: tuple = ()              # tuple[SchedulerConfig, ...]
 
     @classmethod
     def from_dict(cls, d: dict) -> "SchedulerConfig":
         d = dict(d)
         w = d.pop("weights", None)
+        profile_dicts = d.pop("profiles", None) or ()
+        if profile_dicts:
+            base = dict(d)
+            base_w = dict(w or {})
+            resolved = []
+            for pd in profile_dicts:
+                pd = dict(pd)
+                if "scheduler_name" not in pd:
+                    raise ValueError(
+                        "each profile must set scheduler_name"
+                    )
+                merged = {**base, **pd}
+                merged["weights"] = {**base_w, **(pd.get("weights") or {})}
+                merged.pop("profiles", None)
+                resolved.append(cls.from_dict(merged))
+            d["profiles"] = tuple(resolved)
+            names = [d.get("scheduler_name", cls.scheduler_name)] + [
+                p.scheduler_name for p in resolved
+            ]
+            if len(set(names)) != len(names):
+                raise ValueError(
+                    f"profiles must have distinct scheduler_names: {names}"
+                )
         cfg = cls(**d, weights=Weights.from_dict(w) if w else Weights())
         if cfg.mode not in ("batch", "loop"):
             raise ValueError(f"mode must be 'batch' or 'loop', got {cfg.mode!r}")

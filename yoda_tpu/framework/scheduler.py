@@ -67,6 +67,7 @@ class Scheduler:
         on_bound: Callable[[PodSpec, str], None] | None = None,
         on_unschedulable: Callable[[PodSpec, str], None] | None = None,
         on_nominated: Callable[[PodSpec, "str | None"], None] | None = None,
+        cycle_lock: "threading.Lock | None" = None,
         metrics: SchedulingMetrics | None = None,
         percentage_nodes_to_score: int = 100,
         pod_alive: Callable[[PodSpec], bool] | None = None,
@@ -79,6 +80,12 @@ class Scheduler:
         self.on_bound = on_bound
         self.on_unschedulable = on_unschedulable
         self.on_nominated = on_nominated
+        # Shared across profile stacks (standalone.build_profile_stacks):
+        # serializes whole scheduling cycles so two profiles cannot both
+        # pass Filter against the same free chips before either Reserves —
+        # upstream profiles get this for free from their single scheduleOne
+        # loop. None = private lock (single-profile, no contention).
+        self.cycle_lock = cycle_lock or threading.Lock()
         self.metrics = metrics
         self.percentage_nodes_to_score = percentage_nodes_to_score
         self.pod_alive = pod_alive
@@ -116,6 +123,30 @@ class Scheduler:
     # --- one pod ---
 
     def schedule_one(self, qpi: QueuedPodInfo) -> ScheduleResult:
+        # The lock must cover snapshot -> Filter -> Reserve (two profiles
+        # must not both pass Filter on the same free chips before either
+        # Reserves); once Reserve has charged the shared accountant, other
+        # profiles' Filters see the claim, so the body releases the lock
+        # BEFORE Permit/Bind/PostFilter — a slow bind or PDB-aware
+        # eviction round-trip must not stall every other profile's queue.
+        self.cycle_lock.acquire()
+        released = [False]
+
+        def release_cycle_lock() -> None:
+            if not released[0]:
+                released[0] = True
+                self.cycle_lock.release()
+
+        try:
+            return self._schedule_one_locked(qpi, release_cycle_lock)
+        finally:
+            release_cycle_lock()
+
+    def _schedule_one_locked(
+        self,
+        qpi: QueuedPodInfo,
+        release_cycle_lock: Callable[[], None] = lambda: None,
+    ) -> ScheduleResult:
         pod = qpi.pod
         t0 = self.clock()
         # A pod deleted while queued must be dropped, not retried forever
@@ -321,6 +352,9 @@ class Scheduler:
             st = self.framework.run_reserve(state, pod, best)
         if not st.success:
             return done("unschedulable", node=best, message=st.message)
+
+        # Reservation charged: other profiles' cycles now see the claim.
+        release_cycle_lock()
 
         with timer.span("permit"):
             st = self.framework.run_permit(

@@ -38,7 +38,16 @@ class _Claim:
 class ChipAccountant(ReservePlugin):
     name = "yoda-accountant"
 
-    def __init__(self, *, scheduler_name: str = "yoda-tpu") -> None:
+    def __init__(
+        self,
+        *,
+        scheduler_name: str = "yoda-tpu",
+        scheduler_names: "tuple[str, ...] | None" = None,
+    ) -> None:
+        # All schedulerNames this process serves (profiles share ONE
+        # accountant — separate accountants would let two profiles
+        # double-book a node inside the reserve->bind-event window).
+        self.scheduler_names = frozenset(scheduler_names or (scheduler_name,))
         self.scheduler_name = scheduler_name
         self._lock = threading.Lock()
         self._claims: dict[str, _Claim] = {}  # pod uid -> claim
@@ -81,11 +90,11 @@ class ChipAccountant(ReservePlugin):
                         pod.uid, pod.node_name, pod.tpu_resource_limit
                     )
                     return
-                if pod.scheduler_name != self.scheduler_name:
+                if pod.scheduler_name not in self.scheduler_names:
                     return
                 req = None
             if req is not None and not req.wants_tpu and (
-                pod.scheduler_name != self.scheduler_name
+                pod.scheduler_name not in self.scheduler_names
             ):
                 return
             chips = req.effective_chips if req is not None else 1

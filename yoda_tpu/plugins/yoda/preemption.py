@@ -101,6 +101,7 @@ class TpuPreemption(PostFilterPlugin):
         on_evicted: Callable[[int], None] | None = None,
         on_victim: Callable[[Victim], None] | None = None,
         scheduler_name: str = "yoda-tpu",
+        scheduler_names: "tuple[str, ...] | None" = None,
     ) -> None:
         self.evict_fn = evict_fn
         self.reserved_fn = reserved_fn
@@ -109,6 +110,11 @@ class TpuPreemption(PostFilterPlugin):
         self.on_evicted = on_evicted
         self.on_victim = on_victim
         self.scheduler_name = scheduler_name
+        # All profile schedulerNames (multi-profile processes): the
+        # "ours" victim rules must match the shared accountant's occupancy
+        # rules, or chips charged for another profile's pods become
+        # invisible, never-evictable capacity.
+        self.scheduler_names = frozenset(scheduler_names or (scheduler_name,))
         self._lock = threading.Lock()
         self.preempted_total = 0  # pods evicted (metrics: preemptions_total)
 
@@ -133,12 +139,12 @@ class TpuPreemption(PostFilterPlugin):
             prio = pod_priority(pod)
             if pod.tpu_resource_limit > 0:
                 return Victim(pod, node, prio, pod.tpu_resource_limit)
-            if pod.scheduler_name != self.scheduler_name:
+            if pod.scheduler_name not in self.scheduler_names:
                 return None
             # Our own strict PreFilter never binds unparseable pods: a
             # replayed legacy pod, ranked by its spec priority alone.
             return Victim(pod, node, prio, 1)
-        if not req.wants_tpu and pod.scheduler_name != self.scheduler_name:
+        if not req.wants_tpu and pod.scheduler_name not in self.scheduler_names:
             return None
         return Victim(pod, node, req.priority, req.effective_chips)
 

@@ -43,6 +43,10 @@ def build_stack(
     config: SchedulerConfig | None = None,
     *,
     extra_plugins: list | None = None,
+    accountant: ChipAccountant | None = None,
+    cycle_lock=None,
+    metrics: SchedulingMetrics | None = None,
+    scheduler_names: "tuple[str, ...] | None" = None,
     clock=time.monotonic,
 ) -> Stack:
     """Build a fully-wired scheduler stack against ``cluster`` (a fresh
@@ -52,8 +56,18 @@ def build_stack(
     """
     cluster = cluster or FakeCluster()
     config = config or SchedulerConfig()
-    accountant = ChipAccountant()
-    metrics = SchedulingMetrics()
+    # A provided accountant is SHARED across profile stacks (its watcher is
+    # registered by the caller, once): reservations made by any profile are
+    # visible to every other before the bind's watch event lands.
+    own_accountant = accountant is None
+    if own_accountant:
+        accountant = ChipAccountant(scheduler_name=config.scheduler_name)
+    # A provided metrics registry is SHARED across profile stacks (one
+    # /metrics endpoint aggregates every profile — series would otherwise
+    # be created per stack and silently unreachable).
+    own_metrics = metrics is None
+    if own_metrics:
+        metrics = SchedulingMetrics()
     # Scheduling Events (kubectl describe pod): the reference got these from
     # the upstream scheduler's recorder; here the loop emits its own.
     recorder = (
@@ -88,6 +102,8 @@ def build_stack(
         evict = getattr(cluster, "evict_pod", cluster.delete_pod)
         preemption = TpuPreemption(
             evict,
+            scheduler_name=config.scheduler_name,
+            scheduler_names=scheduler_names,
             reserved_fn=accountant.chips_in_use,
             gang_status_fn=gang.gang_status,
             gang_plan_fn=gang.planned_unassigned_hosts,
@@ -118,7 +134,11 @@ def build_stack(
         ):
             queue.move_all_to_active()
 
-    informer = InformerCache(on_pod_pending=queue.add, on_change=on_change)
+    informer = InformerCache(
+        scheduler_name=config.scheduler_name,
+        on_pod_pending=queue.add,
+        on_change=on_change,
+    )
 
     # Wire claims into our batch plugin now the informer exists, and expose
     # the batched-gang placement counters (lazy, summed over plugins and
@@ -131,32 +151,43 @@ def build_stack(
         if p.claimed_fn is None:
             p.claimed_fn = informer.claimed_hbm_mib
     if batches:
-        metrics.registry.counter(
-            "yoda_kernel_dispatches_total",
-            "Real fused-kernel dispatches (gang siblings served from a "
-            "placement plan do not dispatch)",
-            lambda: sum(p.dispatch_count for p in batches),
-        )
-        metrics.registry.counter(
-            "yoda_gang_plan_served_total",
-            "Gang member cycles answered from a whole-gang placement plan",
-            lambda: sum(p.plan_served for p in batches),
-        )
-        metrics.registry.counter(
-            "yoda_gang_plan_invalidated_total",
-            "Live gang placement plans dropped before being fully served "
-            "(validation failure or concurrent-gang eviction)",
-            lambda: sum(p.plan_invalidated for p in batches),
-        )
+        # Accumulator pattern so a SHARED metrics registry (profiles)
+        # registers each family once and sums over every stack's plugins.
+        acc = getattr(metrics, "_batch_plugins", None)
+        if acc is None:
+            acc = metrics._batch_plugins = []
+            metrics.registry.counter(
+                "yoda_kernel_dispatches_total",
+                "Real fused-kernel dispatches (gang siblings served from a "
+                "placement plan do not dispatch)",
+                lambda: sum(p.dispatch_count for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_gang_plan_served_total",
+                "Gang member cycles answered from a whole-gang placement plan",
+                lambda: sum(p.plan_served for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_gang_plan_invalidated_total",
+                "Live gang placement plans dropped before being fully served "
+                "(validation failure or concurrent-gang eviction)",
+                lambda: sum(p.plan_invalidated for p in acc),
+            )
+        acc.extend(batches)
 
-    cluster.add_watcher(accountant.handle)
+    if own_accountant:
+        cluster.add_watcher(accountant.handle)
     cluster.add_watcher(gang.handle)
     cluster.add_watcher(informer.handle)
     if recorder is not None:
         # Prune aggregation state for deleted pods (ADVICE r2).
         cluster.add_watcher(recorder.handle)
 
-    metrics.attach_fleet(informer.snapshot, accountant.chips_in_use)
+    if not getattr(metrics, "_fleet_attached", False):
+        # Fleet gauges are profile-independent; attach once (the first
+        # stack built against a shared registry wins).
+        metrics.attach_fleet(informer.snapshot, accountant.chips_in_use)
+        metrics._fleet_attached = True
     scheduler = Scheduler(
         framework,
         informer.snapshot,
@@ -166,6 +197,7 @@ def build_stack(
         percentage_nodes_to_score=config.percentage_nodes_to_score,
         on_bound=recorder.scheduled if recorder else None,
         on_unschedulable=recorder.failed_scheduling if recorder else None,
+        cycle_lock=cycle_lock,
         # status.nominatedNodeName write (upstream preemption parity);
         # backends without the status subresource simply skip it.
         on_nominated=(
@@ -187,3 +219,58 @@ def build_stack(
         metrics,
         recorder,
     )
+
+
+def build_profile_stacks(
+    cluster,
+    config: SchedulerConfig,
+    *,
+    clock=time.monotonic,
+) -> "list[Stack]":
+    """One stack per scheduler profile (upstream KubeSchedulerConfiguration
+    profiles: one process, several schedulerNames with different plugin
+    configs), all sharing ``cluster``'s watch streams. The base config is
+    the first profile; ``config.profiles`` follow. Each stack schedules
+    only pods whose spec.schedulerName matches its profile (the informer
+    filters pending pods; accounting still tracks every TPU-holding pod,
+    so profiles see each other's reservations)."""
+    names = (config.scheduler_name,) + tuple(
+        p.scheduler_name for p in config.profiles
+    )
+    shared = ChipAccountant(
+        scheduler_name=config.scheduler_name, scheduler_names=names
+    )
+    # Registered once, before any stack's informer, so reservation releases
+    # precede the informer's view of the same event (build_stack's order).
+    cluster.add_watcher(shared.handle)
+    # One cycle at a time ACROSS profiles: without this, two profile loops
+    # can both pass Filter against the same free chips before either
+    # Reserves (upstream profiles share a single scheduleOne loop).
+    import threading
+
+    cycle_lock = threading.Lock()
+    shared_metrics = SchedulingMetrics()
+    stacks = [
+        build_stack(
+            cluster=cluster,
+            config=config,
+            accountant=shared,
+            cycle_lock=cycle_lock,
+            metrics=shared_metrics,
+            scheduler_names=names,
+            clock=clock,
+        )
+    ]
+    for prof in config.profiles:
+        stacks.append(
+            build_stack(
+                cluster=cluster,
+                config=prof,
+                accountant=shared,
+                cycle_lock=cycle_lock,
+                metrics=shared_metrics,
+                scheduler_names=names,
+                clock=clock,
+            )
+        )
+    return stacks
