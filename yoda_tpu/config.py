@@ -98,6 +98,11 @@ class SchedulerConfig:
     # None defers the threshold to plugins.yoda.batch.AUTO_DEVICE_MIN_ELEMS.
     kernel_platform: str = "auto"
     kernel_device_min_elems: int | None = None
+    # Fused-kernel implementation: "xla" (jnp, XLA-fused — default, runs
+    # anywhere) or "pallas" (hand-written Mosaic TPU kernel,
+    # ops/pallas_kernel.py — for locally-attached TPUs; interpret mode
+    # elsewhere). Bit-identical outputs either way (tests/test_pallas.py).
+    kernel_backend: str = "xla"
     # Shard the fused kernel's fleet row axis over an N-device
     # jax.sharding.Mesh (parallel.ShardedDeviceFleetKernel): the global
     # reductions become XLA-inserted ICI collectives. None = single-device
@@ -160,6 +165,16 @@ class SchedulerConfig:
             raise ValueError(
                 "kernel_platform must be 'auto', 'cpu' or 'device', "
                 f"got {cfg.kernel_platform!r}"
+            )
+        if cfg.kernel_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"kernel_backend must be 'xla' or 'pallas', got "
+                f"{cfg.kernel_backend!r}"
+            )
+        if cfg.kernel_backend == "pallas" and cfg.mesh_devices is not None:
+            raise ValueError(
+                "kernel_backend='pallas' does not support mesh_devices "
+                "(the mesh-sharded path is XLA-collective based)"
             )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)

@@ -1,0 +1,392 @@
+"""Pallas TPU implementation of the fused fleet-evaluation hot op.
+
+The XLA kernel (ops/kernel.py ``kernel_impl``) expresses the whole
+filter+collect+score computation in jnp and lets XLA fuse it; this module
+implements the same computation as a hand-written Pallas TPU kernel —
+the "pallas for the hot ops" path for locally-attached TPUs at large
+fleet scales, where owning the memory schedule matters:
+
+- chip grids are laid out **[metric, C, N]** (metrics x chips x nodes)
+  so the node axis rides the 128-wide lane dimension and the chip axis
+  the 8-deep sublane dimension — per-node chip reductions become single
+  sublane reductions on the VPU, and the fleet axis tiles cleanly;
+- one ``pallas_call`` runs a **two-phase sequential grid**
+  ``(phase, node-block)``: phase 0 walks the blocks accumulating the
+  cluster-wide collection maxima (reference collection.go:30-57) into
+  SMEM scalars — TPU grids execute sequentially, so scratch carries
+  state across steps — and phase 1 re-walks the blocks computing
+  feasibility, reasons, raw scores, and claimable chips against those
+  maxima, all in VMEM;
+- the cheap [N]-vector epilogue (min-max normalization, slice-protect
+  tier, deterministic argmax) runs in numpy on the host, byte-identical
+  to ``kernel_impl``'s tail.
+
+Parity: bit-identical outputs to ``kernel_impl`` for all int32 inputs
+(asserted by tests/test_pallas.py across randomized fleets). On non-TPU
+backends the kernel runs in interpret mode (tests); on TPU it compiles
+with Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from yoda_tpu.api.requests import TpuRequest
+from yoda_tpu.config import SLICE_PROTECT_TIER, Weights
+from yoda_tpu.ops.arrays import FleetArrays
+from yoda_tpu.ops.kernel import (
+    CHIP_KEYS,
+    KernelRequest,
+    KernelResult,
+    NODE_KEYS,
+    pack_request,
+)
+
+# Row order of the stacked [9, C, N] chip-grid input.
+_CHIP_ROWS = CHIP_KEYS  # (valid, healthy, used, free, total, clock, bw, tflops, power)
+# Row order of the stacked node-vector input (padded to 8 sublanes).
+_NODE_ROWS = NODE_KEYS  # (valid, in_slice, fresh, host_ok, gen, reserved, claimed)
+
+_LANES = 128     # last-dim tile
+_SUBLANES = 8    # int32 sublane tile
+
+try:  # pallas is an optional heavyweight import; fail soft at import time
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    HAVE_PALLAS = False
+
+
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
+    """One grid step. ``req`` is the scalar-prefetch (5,) request vector;
+    ``chips`` a (9, Cp, BN) VMEM block; ``nodes`` an (8, BN) VMEM block;
+    ``out`` an (8, BN) VMEM block; ``maxima`` a (8,) SMEM scratch holding
+    the six collection maxima across sequential grid steps."""
+    phase = pl.program_id(0)
+    j = pl.program_id(1)
+
+    number = req[0]
+    hbm_mib = req[1]
+    clock_mhz = req[2]
+    gen_rank = req[3]
+
+    valid = chips[0] > 0
+    healthy = valid & (chips[1] > 0)
+    used = chips[2] > 0
+    free = chips[3]
+    total = chips[4]
+    clock = chips[5]
+
+    node_valid = nodes[0] > 0
+    fresh = nodes[2] > 0
+    host_ok = nodes[3] > 0
+    node_gen = nodes[4]
+    reserved = nodes[5]
+    claimed = nodes[6]
+
+    hbm_ok = healthy & (free >= hbm_mib)
+    clock_ok = healthy & (clock >= clock_mhz)
+    qual = hbm_ok & clock_ok
+
+    def rows(x):  # chip-axis (sublane) reduction -> (BN,)
+        return jnp.sum(x.astype(jnp.int32), axis=0)
+
+    count_healthy = rows(healthy)
+    count_hbm = rows(hbm_ok)
+    count_clock = rows(clock_ok)
+    apparently_used = rows(healthy & used)
+    invisible = jnp.clip(reserved - apparently_used, 0)
+    stale_freed = jnp.clip(apparently_used - reserved, 0)
+    freed_candidates = rows(
+        healthy & used & (clock >= clock_mhz) & (total >= hbm_mib)
+    )
+    freed = jnp.minimum(stale_freed, jnp.clip(freed_candidates - reserved, 0))
+    count_avail = rows(qual & ~used)
+
+    fits_chips = count_healthy >= number
+    fits_hbm = (hbm_mib == 0) | ((count_hbm + freed) >= number)
+    fits_clock = (clock_mhz == 0) | (count_clock >= number)
+    fits_reserved = (count_avail + freed - invisible) >= number
+    fits_gen = node_gen >= gen_rank
+
+    feasible = (
+        node_valid
+        & host_ok
+        & fresh
+        & fits_gen
+        & fits_chips
+        & fits_hbm
+        & fits_clock
+        & fits_reserved
+    )
+
+    cmask = feasible[None, :] & qual
+
+    @pl.when(phase == 0)
+    def _collect():
+        @pl.when(j == 0)
+        def _init():
+            for k in range(6):
+                maxima[k] = 1  # masked_max clamps to >= 1 (kernel.py parity)
+
+        # (metric index in chips stack, maxima slot)
+        for slot, row in enumerate((6, 5, 7, 8, 3, 4)):  # bw, clock, tflops, power, free, total
+            bm = jnp.max(jnp.where(cmask, chips[row], 0))
+            maxima[slot] = jnp.maximum(maxima[slot], bm)
+
+    @pl.when(phase == 1)
+    def _score():
+        w = weights
+        max_bw = maxima[0]
+        max_clock = maxima[1]
+        max_tflops = maxima[2]
+        max_power = maxima[3]
+        max_free = maxima[4]
+        max_total = maxima[5]
+
+        def norm(x, mx):
+            return x * 100 // jnp.maximum(mx, 1)
+
+        chip_scores = (
+            norm(chips[6], max_bw) * w.hbm_bandwidth
+            + norm(clock, max_clock) * w.clock
+            + norm(chips[7], max_tflops) * w.tflops
+            + norm(chips[8], max_power) * w.power
+            + norm(free, max_free) * w.hbm_free
+            + norm(total, max_total) * w.hbm_total
+        )
+        basic = jnp.sum(jnp.where(qual, chip_scores, 0), axis=0)
+
+        free_sum = jnp.sum(jnp.where(valid, free, 0), axis=0)
+        total_sum = jnp.sum(jnp.where(valid, total, 0), axis=0)
+        safe_total = jnp.maximum(total_sum, 1)
+        actual = (
+            jnp.where(total_sum > 0, free_sum * 100 // safe_total, 0)
+            * w.actual
+        )
+        headroom = jnp.clip(total_sum - claimed, 0)
+        allocate = (
+            jnp.where(total_sum > 0, headroom * 100 // safe_total, 0)
+            * w.allocate
+        )
+        raw = jnp.where(feasible, basic + actual + allocate, 0).astype(
+            jnp.int32
+        )
+
+        # First failing predicate, reason codes from ops.kernel. A
+        # where-chain, not jnp.select: Mosaic's select lowering argmaxes
+        # over the condition stack, unimplemented for int32 lanes — the
+        # reversed chain gives the same first-match semantics.
+        reasons = jnp.zeros_like(raw)
+        for cond, code in reversed(
+            [
+                (~node_valid, 1),
+                (~host_ok, 8),
+                (~fresh, 2),
+                (~fits_gen, 3),
+                (~fits_chips, 4),
+                (~fits_hbm, 5),
+                (~fits_clock, 6),
+                (~fits_reserved, 7),
+            ]
+        ):
+            reasons = jnp.where(cond, code, reasons)
+        reasons = reasons.astype(jnp.int32)
+
+        claimable = jnp.clip(count_avail + freed - invisible, 0).astype(
+            jnp.int32
+        )
+        out[0] = feasible.astype(jnp.int32)
+        out[1] = reasons
+        out[2] = raw
+        out[3] = claimable
+        for r in range(4, 8):
+            out[r] = jnp.zeros_like(raw)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights", "block_n", "interpret")
+)
+def _pallas_eval(chips, nodes, reqv, *, weights: Weights, block_n: int, interpret: bool):
+    """chips [9, Cp, Np] int32, nodes [8, Np] int32, reqv (5,) int32 ->
+    out [8, Np] int32 (rows: feasible, reasons, raw, claimable)."""
+    _, cp, n_pad = chips.shape
+    nb = n_pad // block_n
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(2, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (9, cp, block_n), lambda p, j, req: (0, 0, j)
+            ),
+            pl.BlockSpec((8, block_n), lambda p, j, req: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((8, block_n), lambda p, j, req: (0, j)),
+        scratch_shapes=[pltpu.SMEM((8,), jnp.int32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_body, weights=weights),
+        out_shape=jax.ShapeDtypeStruct((8, n_pad), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+    )(reqv, chips, nodes)
+
+
+def _stack_inputs(a: dict, *, block_n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Lower the kernel input dict to the pallas layout: chips transposed
+    to [9, Cp, Np] (nodes on lanes), node vectors stacked to [8, Np]."""
+    n, c = a["chip_valid"].shape
+    n_pad = _pad_to(max(n, 1), block_n)
+    c_pad = _pad_to(max(c, 1), _SUBLANES)
+    chips = np.zeros((9, c_pad, n_pad), dtype=np.int32)
+    for i, k in enumerate(_CHIP_ROWS):
+        chips[i, :c, :n] = np.asarray(a[k], dtype=np.int32).T
+    nodes = np.zeros((8, n_pad), dtype=np.int32)
+    for i, k in enumerate(_NODE_ROWS):
+        nodes[i, :n] = np.asarray(a[k], dtype=np.int32)
+    return chips, nodes
+
+
+def _epilogue(
+    arrays: FleetArrays, out: np.ndarray, request: KernelRequest, weights: Weights
+) -> KernelResult:
+    """Host-side [N]-vector tail: min-max normalize, slice-protect tier,
+    deterministic (score, name-order) argmax — kernel_impl parity."""
+    n = arrays.n_nodes
+    feasible = out[0, :n].astype(bool)
+    reasons = out[1, :n]
+    raw = out[2, :n].astype(np.int64)
+    claimable = out[3, :n]
+
+    big = np.iinfo(np.int32).max
+    lowest = int(np.min(np.where(feasible, raw, big))) if n else 0
+    highest = int(np.max(np.where(feasible, raw, -big))) if n else 0
+    if highest == lowest:
+        lowest -= 1
+    span = max(highest - lowest, 1)
+    normalized = np.where(feasible, (raw - lowest) * 100 // span, 0)
+    in_slice = np.asarray(arrays.in_slice[:n], dtype=bool)
+    protect = np.where(
+        (request.wants_topology == 0) & ~in_slice,
+        SLICE_PROTECT_TIER * weights.slice_protect,
+        0,
+    )
+    final = np.where(feasible, normalized + protect, 0).astype(np.int32)
+
+    best = -1
+    if feasible.any():
+        masked = np.where(feasible, final, -1)
+        best = int(n - 1 - np.argmax(masked[::-1]))
+    return KernelResult(
+        feasible=feasible,
+        reasons=reasons,
+        raw_scores=raw.astype(np.int32),
+        scores=final,
+        best_index=best,
+        claimable=claimable,
+    )
+
+
+class PallasFleetKernel:
+    """FleetKernelLike backed by the Pallas TPU kernel.
+
+    ``put_static`` lowers and uploads the stacked chip grids once per
+    metrics version; ``evaluate`` merges the per-cycle dynamics rows into
+    the node stack, dispatches the two-phase kernel, and finishes with the
+    numpy epilogue. ``interpret=None`` auto-selects: compiled Mosaic on a
+    TPU default backend, interpret mode elsewhere (tests/CPU)."""
+
+    def __init__(
+        self,
+        weights: Weights,
+        *,
+        block_n: int = 512,
+        interpret: bool | None = None,
+    ) -> None:
+        if not HAVE_PALLAS:
+            raise RuntimeError("pallas is unavailable in this environment")
+        self.weights = weights
+        self.block_n = max(_LANES, _pad_to(block_n, _LANES))
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+        self._chips = None
+        self._nodes_static: np.ndarray | None = None
+        self._names: list[str] = []
+        self._arrays: FleetArrays | None = None
+
+    @property
+    def names(self) -> list[str]:
+        return self._names
+
+    def put_static(self, arrays: FleetArrays) -> None:
+        from yoda_tpu.ops.kernel import arrays_dict
+
+        a = arrays_dict(arrays)
+        chips, nodes = _stack_inputs(a, block_n=self.block_n)
+        self._chips = jax.device_put(chips)
+        self._nodes_static = nodes
+        self._names = list(arrays.names)
+        self._arrays = arrays
+
+    def evaluate(self, dyn: np.ndarray, request: KernelRequest) -> KernelResult:
+        if self._chips is None or self._arrays is None:
+            raise RuntimeError("put_static() must run before evaluate()")
+        n = len(self._names)
+        nodes = self._nodes_static.copy()
+        # DYN_KEYS rows -> node-stack rows (fresh, reserved, claimed, host_ok).
+        nodes[2, :n] = dyn[0, :n]
+        nodes[5, :n] = dyn[1, :n]
+        nodes[6, :n] = dyn[2, :n]
+        nodes[3, :n] = dyn[3, :n]
+        reqv = pack_request(request)  # single source of the scalar layout
+        out = _pallas_eval(
+            self._chips,
+            nodes,
+            reqv,
+            weights=self.weights,
+            block_n=self.block_n,
+            interpret=self.interpret,
+        )
+        return _epilogue(self._arrays, np.asarray(out), request, self.weights)
+
+
+def fused_filter_score_pallas(
+    arrays: FleetArrays,
+    request: KernelRequest | TpuRequest,
+    *,
+    weights: Weights | None = None,
+    block_n: int = 512,
+    interpret: bool | None = None,
+) -> KernelResult:
+    """One-shot wrapper (tests / parity checks): lower, dispatch, epilogue."""
+    if isinstance(request, TpuRequest):
+        request = KernelRequest.from_request(request)
+    weights = weights or Weights()
+    kern = PallasFleetKernel(weights, block_n=block_n, interpret=interpret)
+    kern.put_static(arrays)
+    # The arrays' OWN dynamic rows, verbatim (dyn_packed would recompute
+    # freshness and neutralize reservations — different semantics than
+    # evaluating the arrays as-is, which is what parity tests compare).
+    dyn = np.stack(
+        [
+            np.asarray(arrays.fresh, dtype=np.int32),
+            np.asarray(arrays.reserved_chips, dtype=np.int32),
+            np.asarray(arrays.claimed_hbm_mib, dtype=np.int32),
+            np.asarray(arrays.host_ok, dtype=np.int32),
+        ]
+    )
+    return kern.evaluate(dyn, request)

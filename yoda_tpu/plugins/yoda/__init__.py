@@ -43,6 +43,7 @@ def default_plugins(
     kernel_platform: str = "auto",
     kernel_device_min_elems: int | None = None,
     mesh_devices: int | None = None,
+    kernel_backend: str = "xla",
     pending_fn: Callable | None = None,
 ) -> list:
     """Assemble the standard plugin set.
@@ -68,6 +69,7 @@ def default_plugins(
                     else kernel_device_min_elems
                 ),
                 mesh_devices=mesh_devices,
+                kernel_backend=kernel_backend,
             )
         )
     elif mode == "loop":

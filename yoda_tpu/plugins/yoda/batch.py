@@ -175,9 +175,16 @@ class YodaBatch(BatchFilterScorePlugin):
         platform: str = "auto",
         device_min_elems: int = AUTO_DEVICE_MIN_ELEMS,
         mesh_devices: int | None = None,
+        kernel_backend: str = "xla",
     ) -> None:
         if platform not in ("auto", "cpu", "device"):
             raise ValueError(f"platform must be auto|cpu|device, got {platform!r}")
+        if kernel_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"kernel_backend must be xla|pallas, got {kernel_backend!r}"
+            )
+        if kernel_backend == "pallas" and mesh_devices:
+            raise ValueError("kernel_backend='pallas' excludes mesh_devices")
         if mesh_devices is not None and mesh_devices < 1:
             raise ValueError(f"mesh_devices must be >= 1, got {mesh_devices}")
         self.reserved_fn = reserved_fn
@@ -187,6 +194,7 @@ class YodaBatch(BatchFilterScorePlugin):
         self.platform = platform
         self.device_min_elems = device_min_elems
         self.mesh_devices = mesh_devices
+        self.kernel_backend = kernel_backend
         self._cache_version: int | None = None
         self._static: FleetArrays | None = None
         self._kern: FleetKernelLike | None = None
@@ -213,6 +221,13 @@ class YodaBatch(BatchFilterScorePlugin):
             self._kern = ShardedDeviceFleetKernel(
                 self.weights, mesh=default_mesh(mesh_devices)
             )
+        elif kernel_backend == "pallas":
+            # Hand-written Mosaic TPU kernel (ops/pallas_kernel.py). Fixed
+            # for the plugin's lifetime; the platform policy does not apply
+            # (on non-TPU backends it runs in interpret mode — tests).
+            from yoda_tpu.ops.pallas_kernel import PallasFleetKernel
+
+            self._kern = PallasFleetKernel(self.weights)
 
     def _device_for(self, arrays: FleetArrays):
         """None = process default device (the accelerator in production)."""
@@ -285,7 +300,7 @@ class YodaBatch(BatchFilterScorePlugin):
                 else None
             ),
         )
-        if not self.mesh_devices:
+        if not self.mesh_devices and self.kernel_backend != "pallas":
             device = self._device_for(static)
             if self._kern is None or device != self._kern_device:
                 self._kern = DeviceFleetKernel(self.weights, device=device)

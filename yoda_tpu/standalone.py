@@ -89,6 +89,7 @@ def build_stack(
         kernel_platform=config.kernel_platform,
         kernel_device_min_elems=config.kernel_device_min_elems,
         mesh_devices=config.mesh_devices,
+        kernel_backend=config.kernel_backend,
         # Gang members parked at Permit stay visible to the inter-pod
         # affinity/spread evaluators (api.affinity pending support).
         pending_fn=gang.pending_placements,
