@@ -320,6 +320,46 @@ def _constrained_scenario() -> dict:
     }
 
 
+def _pallas_probe() -> dict:
+    """Compile the Pallas/Mosaic kernel on the default device and assert
+    bit-parity with the XLA kernel on a random fleet. Records that the
+    hand-written TPU kernel path compiles and matches on this chip (skipped
+    quietly when pallas or the backend is unavailable)."""
+    try:
+        import jax
+        import numpy as np
+
+        from yoda_tpu.ops.kernel import KernelRequest, fused_filter_score
+        from yoda_tpu.ops.pallas_kernel import (
+            HAVE_PALLAS,
+            fused_filter_score_pallas,
+        )
+
+        if not HAVE_PALLAS:
+            return {}
+        arrays = _synthetic_arrays(256)
+        req = KernelRequest(2, 8 * 1024, 800, 0, 0)
+        interpret = jax.default_backend() != "tpu"
+        t0 = time.monotonic()
+        got = fused_filter_score_pallas(
+            arrays, req, interpret=interpret, block_n=128
+        )
+        compile_s = time.monotonic() - t0
+        want = fused_filter_score(arrays, req)
+        ok = bool(
+            np.array_equal(got.scores, want.scores)
+            and got.best_index == want.best_index
+        )
+        return {
+            "pallas_parity": ok,
+            "pallas_backend": "mosaic" if not interpret else "interpret",
+            "pallas_compile_s": round(compile_s, 2),
+        }
+    except Exception as e:  # pragma: no cover - probe must never kill bench
+        print(f"pallas probe failed: {e}", file=sys.stderr)
+        return {}
+
+
 def _agent_hw_probe() -> dict:
     """What the node agent's runtime reader (agent/runtime.py) reads off
     THIS host's real TPU — recorded per round as evidence of which values
@@ -415,6 +455,9 @@ def run_bench() -> dict:
     hw = _agent_hw_probe()
     if hw:
         print(f"agent runtime hardware read: {hw}", file=sys.stderr)
+    pallas = _pallas_probe()
+    if pallas:
+        print(f"pallas kernel probe: {pallas}", file=sys.stderr)
 
     return {
         **hw,
@@ -428,6 +471,7 @@ def run_bench() -> dict:
         **mixed,
         **constrained,
         **probe,
+        **pallas,
     }
 
 

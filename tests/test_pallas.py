@@ -153,3 +153,11 @@ class TestPallasBackendE2E:
         np.testing.assert_array_equal(got.raw_scores, want.raw_scores)
         np.testing.assert_array_equal(got.scores, want.scores)
         assert got.best_index == want.best_index
+
+    def test_pallas_excludes_explicit_platform(self):
+        from yoda_tpu.config import SchedulerConfig
+
+        with pytest.raises(ValueError, match="kernel_platform"):
+            SchedulerConfig.from_dict(
+                {"kernel_backend": "pallas", "kernel_platform": "cpu"}
+            )

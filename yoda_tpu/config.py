@@ -176,6 +176,11 @@ class SchedulerConfig:
                 "kernel_backend='pallas' does not support mesh_devices "
                 "(the mesh-sharded path is XLA-collective based)"
             )
+        if cfg.kernel_backend == "pallas" and cfg.kernel_platform != "auto":
+            raise ValueError(
+                "kernel_backend='pallas' ignores kernel_platform; leave it "
+                "'auto' (the Mosaic kernel runs on the default device)"
+            )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)
             or not isinstance(cfg.mesh_devices, int)

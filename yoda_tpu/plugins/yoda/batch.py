@@ -185,6 +185,10 @@ class YodaBatch(BatchFilterScorePlugin):
             )
         if kernel_backend == "pallas" and mesh_devices:
             raise ValueError("kernel_backend='pallas' excludes mesh_devices")
+        if kernel_backend == "pallas" and platform != "auto":
+            raise ValueError(
+                "kernel_backend='pallas' ignores platform; leave it 'auto'"
+            )
         if mesh_devices is not None and mesh_devices < 1:
             raise ValueError(f"mesh_devices must be >= 1, got {mesh_devices}")
         self.reserved_fn = reserved_fn
