@@ -219,3 +219,82 @@ class TestProfileWiring:
         assert r.outcome == "waiting"
         assert stacks[0].scheduler.cycle_lock.acquire(timeout=0.5)
         stacks[0].scheduler.cycle_lock.release()
+
+    def test_pending_visibility_spans_profiles(self):
+        # A gang member of profile B parked at Permit must repel an
+        # anti-affinity pod scheduled by profile A (the pending feed is
+        # aggregated over every profile's gang plugin).
+        from yoda_tpu.api.affinity import LabelSelector, PodAffinityTerm
+        from yoda_tpu.api.types import K8sNode
+
+        HOSTNAME = "kubernetes.io/hostname"
+        cluster = FakeCluster()
+        config = SchedulerConfig.from_dict(
+            {"profiles": [{"scheduler_name": "yoda-tpu-b"}]}
+        )
+        stacks = build_profile_stacks(cluster, config)
+        agent = FakeTpuAgent(cluster)
+        for n in ("h1", "h2"):
+            agent.add_host(n, chips=8)
+            cluster.put_node(K8sNode(n, labels={HOSTNAME: n}))
+        agent.publish_all()
+        # Profile B: a 2-member gang; member 0 parks at Permit.
+        cluster.create_pod(
+            PodSpec(
+                "g-0",
+                labels={
+                    "tpu/gang": "g", "tpu/gang-size": "2",
+                    "tpu/chips": "1", "app": "g",
+                },
+                scheduler_name="yoda-tpu-b",
+            )
+        )
+        stacks[1].scheduler.run_until_idle(max_wall_s=5)
+        pending = stacks[1].gang.pending_placements()
+        assert len(pending) == 1
+        parked_host = pending[0][0]
+        # Profile A: an anti-affinity pod against app=g must avoid the
+        # parked member's host.
+        cluster.create_pod(
+            PodSpec(
+                "loner",
+                labels={"tpu/chips": "1"},
+                pod_anti_affinity=(
+                    PodAffinityTerm(
+                        topology_key=HOSTNAME,
+                        selector=LabelSelector(match_labels=(("app", "g"),)),
+                    ),
+                ),
+            )
+        )
+        stacks[0].scheduler.run_until_idle(max_wall_s=5)
+        loner = cluster.get_pod("default/loner")
+        assert loner.node_name is not None
+        assert loner.node_name != parked_host
+
+    def test_pallas_profile_ignores_inherited_platform_pin(self):
+        # Base pins kernel_platform: cpu; a pallas profile that never set
+        # the knob must validate (the inherited pin does not apply), while
+        # an EXPLICIT pin on the pallas profile still rejects.
+        c = SchedulerConfig.from_dict(
+            {
+                "kernel_platform": "cpu",
+                "profiles": [
+                    {"scheduler_name": "yoda-tpu-p", "kernel_backend": "pallas"}
+                ],
+            }
+        )
+        assert c.profiles[0].kernel_backend == "pallas"
+        assert c.profiles[0].kernel_platform == "auto"
+        with pytest.raises(ValueError, match="kernel_platform"):
+            SchedulerConfig.from_dict(
+                {
+                    "profiles": [
+                        {
+                            "scheduler_name": "yoda-tpu-p",
+                            "kernel_backend": "pallas",
+                            "kernel_platform": "cpu",
+                        }
+                    ]
+                }
+            )

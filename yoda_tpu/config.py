@@ -133,6 +133,14 @@ class SchedulerConfig:
                 merged = {**base, **pd}
                 merged["weights"] = {**base_w, **(pd.get("weights") or {})}
                 merged.pop("profiles", None)
+                # A pallas profile ignores kernel_platform; an INHERITED
+                # platform pin must not fail its validation (the operator
+                # never set it on this profile) — only an explicit one.
+                if (
+                    merged.get("kernel_backend") == "pallas"
+                    and "kernel_platform" not in pd
+                ):
+                    merged.pop("kernel_platform", None)
                 resolved.append(cls.from_dict(merged))
             d["profiles"] = tuple(resolved)
             names = [d.get("scheduler_name", cls.scheduler_name)] + [

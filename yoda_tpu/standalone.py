@@ -274,4 +274,22 @@ def build_profile_stacks(
                 clock=clock,
             )
         )
+    # Pending-placement visibility must span profiles: a gang member of
+    # ANY profile parked at Permit is invisible in snapshots, and the
+    # inter-pod / pending-resource evaluators of every other profile need
+    # to see it (the same reason the accountant is shared).
+    from yoda_tpu.plugins.yoda.filter_plugin import YodaPreFilter
+
+    gangs = [st.gang for st in stacks]
+
+    def all_pending() -> list:
+        out: list = []
+        for g in gangs:
+            out.extend(g.pending_placements())
+        return out
+
+    for st in stacks:
+        for p in st.framework.pre_filter_plugins:
+            if isinstance(p, YodaPreFilter):
+                p.pending_fn = all_pending
     return stacks
