@@ -298,3 +298,45 @@ class TestProfileWiring:
                     ]
                 }
             )
+
+    def test_cross_profile_preemption(self):
+        # Profile A's high-priority pod evicts profile B's low-priority
+        # victim: the victim rules recognize every profile's schedulerName
+        # (a single-name rule would make B's pods invisible, never-evictable
+        # capacity).
+        cluster = FakeCluster()
+        config = SchedulerConfig.from_dict(
+            {"profiles": [{"scheduler_name": "yoda-tpu-b"}]}
+        )
+        stacks = build_profile_stacks(cluster, config)
+        agent = FakeTpuAgent(cluster)
+        agent.add_host("only", chips=2)
+        agent.publish_all()
+        cluster.create_pod(
+            PodSpec(
+                "infer",
+                labels={"tpu/chips": "2", "tpu/priority": "1"},
+                scheduler_name="yoda-tpu-b",
+            )
+        )
+        stacks[1].scheduler.run_until_idle(max_wall_s=5)
+        assert cluster.get_pod("default/infer").node_name == "only"
+        cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "10"})
+        )
+        stacks[0].scheduler.run_until_idle(max_wall_s=5)
+        assert cluster.get_pod("default/infer") is None  # evicted
+        stacks[0].scheduler.run_until_idle(max_wall_s=5)
+        assert cluster.get_pod("default/train").node_name == "only"
+
+    def test_pallas_profile_ignores_inherited_mesh(self):
+        c = SchedulerConfig.from_dict(
+            {
+                "mesh_devices": 4,
+                "profiles": [
+                    {"scheduler_name": "yoda-tpu-p", "kernel_backend": "pallas"}
+                ],
+            }
+        )
+        assert c.profiles[0].mesh_devices is None
+        assert c.mesh_devices == 4  # base keeps its mesh

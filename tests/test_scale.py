@@ -14,6 +14,8 @@ from __future__ import annotations
 import random
 import time
 
+import pytest
+
 from yoda_tpu.api.requests import parse_request
 from yoda_tpu.api.types import make_node
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
@@ -78,17 +80,21 @@ class TestKernelAtScale:
 
 
 class TestStackAtScale:
-    def test_pods_bind_against_1024_nodes(self):
+    @pytest.mark.parametrize("n_nodes", [N_NODES, 4096])
+    def test_pods_bind_at_scale(self, n_nodes):
+        """Fleet-size independence at the headline scale and one size up:
+        the burst must stay well under the 200 ms-per-pod BASELINE budget
+        either way."""
         from yoda_tpu.agent import FakeTpuAgent
         from yoda_tpu.api.types import PodSpec
         from yoda_tpu.standalone import build_stack
 
         stack = build_stack()
         agent = FakeTpuAgent(stack.cluster)
-        for i in range(N_NODES):
+        for i in range(n_nodes):
             agent.add_host(f"h{i:04d}", chips=8)
         agent.publish_all()
-        # Warmup compile at the 1024-row bucket.
+        # Warmup compile at this fleet bucket.
         stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
         stack.scheduler.run_until_idle(max_wall_s=120)
         stack.cluster.delete_pod("default/warm")
@@ -103,9 +109,7 @@ class TestStackAtScale:
         dt_ms = (time.monotonic() - t0) * 1e3
         pods = [p for p in stack.cluster.list_pods() if p.name.startswith("p")]
         assert len(pods) == 8 and all(p.node_name for p in pods)
-        # 8 pods against 1024 nodes: the whole burst must stay well under
-        # the 200 ms-per-pod BASELINE budget.
-        assert dt_ms < 8 * 200, f"burst took {dt_ms:.0f} ms"
+        assert dt_ms < 8 * 200, f"burst took {dt_ms:.0f} ms at {n_nodes} nodes"
 
     def test_gang_at_scale_is_one_dispatch(self):
         """An 8-member gang against 1024 nodes: one kernel dispatch places
@@ -191,34 +195,3 @@ class TestConstrainedAtScale:
         assert len(pods) == 8 and all(p.node_name for p in pods)
         assert len({p.node_name for p in pods}) == 8  # spread held
         assert dt_ms < 8 * 200, f"burst took {dt_ms:.0f} ms"
-
-
-class TestFourThousandNodes:
-    def test_burst_at_4096_nodes(self):
-        """One size up from the headline scale test: the kernel bucket
-        covers 4096 rows and the burst stays inside the per-pod budget
-        (fleet-size independence holds past the 1024 mark)."""
-        from yoda_tpu.agent import FakeTpuAgent
-        from yoda_tpu.api.types import PodSpec
-        from yoda_tpu.standalone import build_stack
-
-        stack = build_stack()
-        agent = FakeTpuAgent(stack.cluster)
-        for i in range(4096):
-            agent.add_host(f"h{i:04d}", chips=8)
-        agent.publish_all()
-        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
-        stack.scheduler.run_until_idle(max_wall_s=120)
-        stack.cluster.delete_pod("default/warm")
-        stack.scheduler.run_until_idle(max_wall_s=10)
-
-        t0 = time.monotonic()
-        for i in range(8):
-            stack.cluster.create_pod(
-                PodSpec(f"p{i}", labels={"tpu/chips": "4", "tpu/hbm": "2Gi"})
-            )
-        stack.scheduler.run_until_idle(max_wall_s=60)
-        dt_ms = (time.monotonic() - t0) * 1e3
-        pods = [p for p in stack.cluster.list_pods() if p.name.startswith("p")]
-        assert len(pods) == 8 and all(p.node_name for p in pods)
-        assert dt_ms < 8 * 200, f"burst took {dt_ms:.0f} ms at 4096 nodes"

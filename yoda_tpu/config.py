@@ -133,14 +133,14 @@ class SchedulerConfig:
                 merged = {**base, **pd}
                 merged["weights"] = {**base_w, **(pd.get("weights") or {})}
                 merged.pop("profiles", None)
-                # A pallas profile ignores kernel_platform; an INHERITED
-                # platform pin must not fail its validation (the operator
-                # never set it on this profile) — only an explicit one.
-                if (
-                    merged.get("kernel_backend") == "pallas"
-                    and "kernel_platform" not in pd
-                ):
-                    merged.pop("kernel_platform", None)
+                # A pallas profile is incompatible with kernel_platform
+                # and mesh_devices; INHERITED values must not fail its
+                # validation (the operator never set them on this profile)
+                # — only explicit ones do.
+                if merged.get("kernel_backend") == "pallas":
+                    for knob in ("kernel_platform", "mesh_devices"):
+                        if knob not in pd:
+                            merged.pop(knob, None)
                 resolved.append(cls.from_dict(merged))
             d["profiles"] = tuple(resolved)
             names = [d.get("scheduler_name", cls.scheduler_name)] + [
