@@ -398,3 +398,88 @@ class TestDeletedQueuedPod:
         stack.scheduler.run_until_idle()
         assert len(stack.queue) == 0
         assert stack.scheduler.stats.results[-1].outcome == "gone"
+
+
+class TestSearchTruncation:
+    """Upstream percentageOfNodesToScore caps the FILTER search too: the
+    scan stops once the window's worth of feasible nodes is found."""
+
+    class CountingFilter(FilterPlugin):
+        name = "counting-filter"
+
+        def __init__(self):
+            self.calls_per_cycle = []
+            self._calls = 0
+
+        def filter(self, state, pod, node):
+            self._calls += 1
+            return Status.ok()
+
+        def flush(self):
+            self.calls_per_cycle.append(self._calls)
+            self._calls = 0
+
+    def test_filter_scan_stops_at_the_window(self):
+        counter = self.CountingFilter()
+        fw = Framework([counter, RecordingBinder()])
+        snapshot = make_snapshot([f"n{i:02d}" for i in range(24)])
+        q = SchedulingQueue(fw.queue_sort)
+        sched = Scheduler(
+            fw, lambda: snapshot, q, percentage_nodes_to_score=50
+        )
+        for i in range(3):
+            q.add(PodSpec(f"p{i}"))
+            r = sched.schedule_one(q.pop(timeout=0))
+            assert r.outcome == "bound"
+            counter.flush()
+        # cap = max(ceil(24 * 50%), 8) = 12 filter calls per cycle, not 24.
+        assert counter.calls_per_cycle == [12, 12, 12]
+
+    def test_full_percentage_scans_everything(self):
+        counter = self.CountingFilter()
+        fw = Framework([counter, RecordingBinder()])
+        snapshot = make_snapshot([f"n{i:02d}" for i in range(24)])
+        q = SchedulingQueue(fw.queue_sort)
+        sched = Scheduler(fw, lambda: snapshot, q)
+        q.add(PodSpec("p"))
+        sched.schedule_one(q.pop(timeout=0))
+        counter.flush()
+        assert counter.calls_per_cycle == [24]
+
+    def test_rotor_skips_long_infeasible_runs(self):
+        # Upstream advances nextStartNodeIndex by nodes PROCESSED: after a
+        # scan that waded through an infeasible prefix, the next cycle
+        # starts past it instead of re-filtering the same run.
+        class HalfFeasible(FilterPlugin):
+            name = "half"
+
+            def __init__(self):
+                self.calls_per_cycle = []
+                self._calls = 0
+
+            def filter(self, state, pod, node):
+                self._calls += 1
+                if int(node.name[1:]) < 50:
+                    return Status.unschedulable("no")
+                return Status.ok()
+
+            def flush(self):
+                self.calls_per_cycle.append(self._calls)
+                self._calls = 0
+
+        counter = HalfFeasible()
+        fw = Framework([counter, RecordingBinder()])
+        snapshot = make_snapshot([f"n{i:02d}" for i in range(100)])
+        q = SchedulingQueue(fw.queue_sort)
+        sched = Scheduler(
+            fw, lambda: snapshot, q, percentage_nodes_to_score=10
+        )
+        for i in range(2):
+            q.add(PodSpec(f"p{i}"))
+            assert sched.schedule_one(q.pop(timeout=0)).outcome == "bound"
+            counter.flush()
+        # Cycle 1 wades through n00-n49 then finds 10 feasible (60 calls);
+        # cycle 2 starts PAST the infeasible run (rotor advanced by 60) and
+        # finds its 10 immediately.
+        assert counter.calls_per_cycle[0] == 60
+        assert counter.calls_per_cycle[1] == 10

@@ -118,16 +118,38 @@ class Framework:
         return Status.ok()
 
     def run_filters(
-        self, state: CycleState, pod: PodSpec, snapshot: Snapshot
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        snapshot: Snapshot,
+        *,
+        stop_after_feasible: int = 0,
+        start_index: int = 0,
     ) -> dict[str, Status]:
+        """Run the FilterPlugin chain per node. ``stop_after_feasible > 0``
+        truncates the SEARCH once that many feasible nodes are found
+        (upstream percentageOfNodesToScore semantics: Filter work is
+        capped too, not just score fan-out), scanning from the rotating
+        ``start_index`` so the cap does not always favor the same
+        name-ordered prefix. Unscanned nodes are simply absent from the
+        returned map — preemption walks the snapshot itself, so PostFilter
+        is unaffected."""
         statuses: dict[str, Status] = {}
-        for node in snapshot.infos():
+        infos = snapshot.infos()
+        n = len(infos)
+        feasible = 0
+        for i in range(n):
+            node = infos[(start_index + i) % n]
             st = Status.ok()
             for p in self.filter_plugins:
                 st = p.filter(state, pod, node)
                 if not st.success:
                     break
             statuses[node.name] = st
+            if st.success:
+                feasible += 1
+                if stop_after_feasible and feasible >= stop_after_feasible:
+                    break
         return statuses
 
     def run_batch_filter_score(

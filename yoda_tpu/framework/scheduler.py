@@ -89,7 +89,7 @@ class Scheduler:
         self.metrics = metrics
         self.percentage_nodes_to_score = percentage_nodes_to_score
         self.pod_alive = pod_alive
-        self._score_rotor = 0
+        self._search_rotor = 0
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
         # stale status.nominatedNodeName cleared (phantom earmarked
@@ -97,28 +97,30 @@ class Scheduler:
         self._nominated: dict[str, str] = {}
         self._lock = threading.Lock()
 
-    def _limit_scored_nodes(self, feasible: list[str]) -> list[str]:
-        """Cap how many feasible nodes the per-node score plugins run over
-        (upstream percentageOfNodesToScore). The window rotates between
-        cycles so the cap spreads load instead of always favoring the same
-        name-ordered prefix. Only the per-node ("loop") path calls this: the
-        fused kernel scores the whole fleet in one dispatch, so capping
-        there would cost placement quality and save nothing. Deliberate
-        divergence from upstream (docs/OPERATIONS.md): upstream truncates
-        the feasible-node SEARCH (capping Filter work too); here Filter
-        always runs fleet-wide so PostFilter/preemption sees every node's
-        status, and only score fan-out is capped."""
+    def _search_limit(self, n_nodes: int) -> int:
+        """Upstream percentageOfNodesToScore, the SEARCH half: how many
+        feasible nodes the filter scan needs before it may stop. 0 = no
+        cap (the default 100%%, tiny fleets, or batch mode — the fused
+        kernel filters the fleet in one dispatch where a cap would cost
+        placement quality and save nothing)."""
         pct = self.percentage_nodes_to_score
-        if pct >= 100 or len(feasible) <= MIN_FEASIBLE_TO_SCORE:
-            return feasible
-        k = max(-(-(len(feasible) * pct) // 100), MIN_FEASIBLE_TO_SCORE)
-        if k >= len(feasible):
-            return feasible
+        if pct >= 100 or n_nodes <= MIN_FEASIBLE_TO_SCORE:
+            return 0
+        return max(-(-(n_nodes * pct) // 100), MIN_FEASIBLE_TO_SCORE)
+
+    def _search_start(self, n_nodes: int) -> int:
+        """Rotating scan origin (upstream nextStartNodeIndex). The rotor is
+        advanced AFTER the scan by the number of nodes actually visited
+        (:meth:`_advance_search`): a long infeasible run is skipped by the
+        next cycle instead of being re-filtered window-width at a time."""
+        if n_nodes <= 0 or self.percentage_nodes_to_score >= 100:
+            return 0
         with self._lock:
-            start = self._score_rotor % len(feasible)
-            self._score_rotor += k
-        rotated = feasible[start:] + feasible[:start]
-        return sorted(rotated[:k])
+            return self._search_rotor % n_nodes
+
+    def _advance_search(self, visited: int) -> None:
+        with self._lock:
+            self._search_rotor += max(visited, 1)
 
     # --- one pod ---
 
@@ -283,19 +285,29 @@ class Scheduler:
                 statuses, batch_scores = batch
                 feasible = sorted(batch_scores)
             else:
-                statuses = self.framework.run_filters(state, pod, snapshot)
-                batch_scores = {}
-                feasible = self._limit_scored_nodes(
-                    sorted(n for n, s in statuses.items() if s.success)
+                limit = self._search_limit(len(snapshot))
+                statuses = self.framework.run_filters(
+                    state, pod, snapshot,
+                    stop_after_feasible=limit,
+                    start_index=self._search_start(len(snapshot)),
                 )
-        # The true filter-pass count — NOT len(feasible), which
-        # _limit_scored_nodes may have capped to the scoring window.
-        feasible_count = sum(1 for s in statuses.values() if s.success)
+                if limit:
+                    # run_filters records a status per node VISITED, so the
+                    # map's size is the processed count (upstream advances
+                    # nextStartNodeIndex the same way).
+                    self._advance_search(len(statuses))
+                batch_scores = {}
+                feasible = sorted(
+                    n for n, s in statuses.items() if s.success
+                )
+        feasible_count = len(feasible)
         # The reference's V(3) per-node decision detail (scheduler.go:67).
+        # Under search truncation, statuses covers only the scanned window
+        # — say so, or 12/1000 reads as 988 infeasible nodes.
         if log.isEnabledFor(logging.DEBUG):
             log.debug(
-                "pod %s: %d/%d nodes feasible", pod.key, feasible_count,
-                len(snapshot),
+                "pod %s: %d/%d scanned nodes feasible (fleet %d)",
+                pod.key, feasible_count, len(statuses), len(snapshot),
             )
             for n in sorted(statuses):
                 s = statuses[n]
