@@ -483,3 +483,32 @@ class TestSearchTruncation:
         # finds its 10 immediately.
         assert counter.calls_per_cycle[0] == 60
         assert counter.calls_per_cycle[1] == 10
+
+    def test_topology_gang_binds_under_truncated_search(self):
+        # A gang's allowed-hosts filter rejects nodes outside the planned
+        # block; rejections do not count toward the feasible cap, so the
+        # truncated scan keeps going until it reaches the planned hosts —
+        # constrained pods must not starve under percentage_nodes_to_score.
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(
+            config=SchedulerConfig(mode="loop", percentage_nodes_to_score=25)
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(24):
+            agent.add_host(f"v5e-{i:02d}", generation="v5e", chips=8)
+        agent.add_slice("s", host_topology=(2, 2, 1))
+        agent.publish_all()
+        labels = {"tpu/gang": "tg", "tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(4):
+            stack.cluster.create_pod(PodSpec(f"tg-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        placed = [
+            stack.cluster.get_pod(f"default/tg-{i}").node_name
+            for i in range(4)
+        ]
+        assert all(placed), placed
+        assert len(set(placed)) == 4
+        assert all(h.startswith("s-") for h in placed), placed

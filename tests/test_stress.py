@@ -282,6 +282,99 @@ def test_serve_forever_with_node_constraints(seed=42):
         assert stack.accountant.chips_in_use(m.name) == used, m.name
 
 
+def test_serve_forever_loop_mode_truncated_search(seed=11):
+    """Chaos run for loop mode with the upstream search cap engaged:
+    single-chip churn + a topology gang against a 32-host fleet at
+    percentage_nodes_to_score=25. Invariants: scheduler survives, no
+    oversubscription, gang atomicity, accounting converges — the
+    truncated rotating scan must not break any of them."""
+    rng = random.Random(seed)
+    stack = build_stack(
+        config=SchedulerConfig(
+            mode="loop",
+            percentage_nodes_to_score=25,
+            gang_permit_timeout_s=1.0,
+        )
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(28):
+        agent.add_host(f"h{i:02d}", chips=8)
+    agent.add_slice("sl", host_topology=(2, 2, 1))
+    agent.publish_all()
+
+    stop = threading.Event()
+    crashes: list[BaseException] = []
+
+    def serve():
+        try:
+            stack.scheduler.serve_forever(stop, poll_s=0.005)
+        except BaseException as e:  # noqa: BLE001
+            crashes.append(e)
+
+    server = threading.Thread(target=serve, daemon=True)
+    server.start()
+
+    def republish():
+        while not stop.is_set():
+            agent.publish_all()
+            time.sleep(0.002)
+
+    def churn():
+        for n in range(80):
+            if stop.is_set():
+                return
+            stack.cluster.create_pod(
+                PodSpec(f"c-{n}", labels={"tpu/chips": "1"})
+            )
+            if n % 4 == 3:
+                stack.cluster.delete_pod(f"default/c-{rng.randrange(n)}")
+            time.sleep(0.001)
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"tg-{i}",
+                    labels={
+                        "tpu/gang": "tg", "tpu/topology": "2x2x1",
+                        "tpu/chips": "4",
+                    },
+                )
+            )
+
+    writers = [
+        threading.Thread(target=republish, daemon=True),
+        threading.Thread(target=churn, daemon=True),
+    ]
+    for w in writers:
+        w.start()
+    writers[1].join(timeout=30)
+    assert not writers[1].is_alive(), "churn thread wedged"
+    deadline = time.monotonic() + 20.0
+    while stack.scheduler.stats.binds == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.5)
+    stop.set()
+    server.join(timeout=30)
+    assert not server.is_alive(), "serve_forever deadlocked"
+    writers[0].join(timeout=5)
+    assert not crashes, f"scheduler thread crashed: {crashes!r}"
+    stack.scheduler.run_until_idle(max_wall_s=30.0)
+
+    pods = stack.cluster.list_pods()
+    gang = [p for p in pods if p.name.startswith("tg-")]
+    bound_gang = [p for p in gang if p.node_name]
+    assert len(bound_gang) in (0, 4), f"gang partially bound: {len(bound_gang)}"
+    bound_by_node: dict[str, int] = {}
+    for p in pods:
+        if p.node_name:
+            bound_by_node[p.node_name] = (
+                bound_by_node.get(p.node_name, 0) + pod_chips(p)
+            )
+    for m in stack.cluster.list_tpu_metrics():
+        used = bound_by_node.get(m.name, 0)
+        assert used <= m.chip_count, f"{m.name} oversubscribed"
+        assert stack.accountant.chips_in_use(m.name) == used, m.name
+
+
 def test_serve_forever_with_anti_affinity_churn(seed=7):
     """Chaos run for the inter-pod family: churn pods in five anti-affinity
     groups (each group repels itself over hostname) racing an anti-affinity
