@@ -189,6 +189,47 @@ class TestInterPodEvaluator:
         assert ev.preference(s.get("n2")) == -7
         assert ev.preference(s.get("n3")) == 0
 
+    def test_symmetric_preferred_terms_score_incoming_pod(self):
+        # Upstream InterPodAffinity scores BOTH directions (ADVICE r3):
+        # existing pods' preferred terms matching the incoming pod add or
+        # subtract weight in the existing pod's domain — even when the
+        # incoming pod declares no terms of its own.
+        wants_web = PodSpec(
+            "cache",
+            labels={"tier": "cache"},
+            preferred_pod_affinity=((20, term(ZONE, {"app": "web"})),),
+        )
+        hates_web = PodSpec(
+            "quiet",
+            labels={"quiet": "yes"},
+            preferred_pod_anti_affinity=((8, term(ZONE, {"app": "web"})),),
+        )
+        s = snap(
+            ("n1", {ZONE: "a"}, [wants_web]),
+            ("n2", {ZONE: "b"}, [hates_web]),
+            ("n3", {ZONE: "c"}, []),
+        )
+        pod = PodSpec("web", labels={"app": "web"})
+        ev = InterPodEvaluator.build(s, pod)
+        assert not ev.trivial
+        assert ev.has_preferences
+        assert ev.preference(s.get("n1")) == 20
+        assert ev.preference(s.get("n2")) == -8
+        assert ev.preference(s.get("n3")) == 0
+
+    def test_symmetric_preferred_respects_namespace_scope(self):
+        # The existing pod's term scopes to ITS namespace by default: an
+        # incoming pod in another namespace gets no symmetric credit.
+        other_ns = PodSpec(
+            "cache",
+            namespace="prod",
+            preferred_pod_affinity=((20, term(ZONE, {"app": "web"})),),
+        )
+        s = snap(("n1", {ZONE: "a"}, [other_ns]))
+        pod = PodSpec("web", namespace="default", labels={"app": "web"})
+        ev = InterPodEvaluator.build(s, pod)
+        assert ev.preference(s.get("n1")) == 0
+
     def test_trivial_when_no_terms_anywhere(self):
         s = snap(("n1", {}, [PodSpec("p")]))
         ev = InterPodEvaluator.build(s, PodSpec("q"))

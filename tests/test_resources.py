@@ -29,12 +29,38 @@ def make_stack(mode="batch", **cfg):
 class TestParseCpu:
     @pytest.mark.parametrize(
         "text,milli",
-        [("500m", 500), ("2", 2000), ("1.5", 1500), ("0", 0), ("250m", 250)],
+        [
+            ("500m", 500),
+            ("2", 2000),
+            ("1.5", 1500),
+            ("0", 0),
+            ("250m", 250),
+            # Fractional milli rounds UP (upstream resource.Quantity) and
+            # exponent notation is accepted (ADVICE r3).
+            ("100.5m", 101),
+            ("1.5m", 2),
+            ("1.1", 1100),
+            ("1e3", 1_000_000),
+            ("2E2", 200_000),
+            ("100e-3", 100),
+            ("1e+3", 1_000_000),
+            ("1e-6", 1),  # sub-milli rounds up to 1m, as upstream
+            ("1e-19", 1),  # negative exponents are cheap: no cap
+        ],
     )
     def test_valid(self, text, milli):
         assert parse_cpu(text) == milli
 
-    @pytest.mark.parametrize("text", ["", "m", "1.5m", "two", "-1", "2 cores"])
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "", "m", "two", "-1", "2 cores", "1e", ".5m", "1e2.5",
+            # Exponent cap: Decimal parses huge exponents lazily but
+            # ceil() would materialize a billion-digit int (DoS via one
+            # pod spec) — bounded like upstream resource.Quantity.
+            "9e999999999", "1e19",
+        ],
+    )
     def test_invalid(self, text):
         with pytest.raises(QuantityError):
             parse_cpu(text)
@@ -75,6 +101,64 @@ class TestPodResourceParsing:
         # init containers run sequentially BEFORE the regular set:
         # effective = max(sum(regular)=500, max(init)=2000) = 2000.
         assert PodSpec.from_obj(obj).cpu_milli_request == 2000
+
+    def test_sidecar_init_containers_join_the_concurrent_sum(self):
+        # restartPolicy: Always init containers (sidecars) keep running
+        # alongside the regular set AND alongside every one-shot init
+        # declared after them — upstream's ordered scan (ADVICE r3).
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [{"resources": {"requests": {"cpu": "500m"}}}],
+                "initContainers": [
+                    {
+                        "restartPolicy": "Always",
+                        "resources": {"requests": {"cpu": "300m"}},
+                    },
+                    {"resources": {"requests": {"cpu": "700m"}}},
+                ],
+            },
+        }
+        # init phase peak = sidecar 300 + one-shot 700 = 1000;
+        # steady state = 500 + 300 = 800; effective = 1000.
+        assert PodSpec.from_obj(obj).cpu_milli_request == 1000
+
+    def test_sidecar_after_one_shot_does_not_inflate_it(self):
+        # Declaration order matters: a sidecar starting AFTER a one-shot
+        # init does not run concurrently with it.
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [{"resources": {"requests": {"cpu": "100m"}}}],
+                "initContainers": [
+                    {"resources": {"requests": {"cpu": "700m"}}},
+                    {
+                        "restartPolicy": "Always",
+                        "resources": {"requests": {"cpu": "300m"}},
+                    },
+                ],
+            },
+        }
+        # one-shot ran with no sidecars yet (700); steady = 100+300 = 400.
+        assert PodSpec.from_obj(obj).cpu_milli_request == 700
+
+    def test_pod_overhead_added_on_top(self):
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "overhead": {"cpu": "250m", "memory": "120Mi"},
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"cpu": "1", "memory": "1Gi"}
+                        }
+                    }
+                ],
+            },
+        }
+        pod = PodSpec.from_obj(obj)
+        assert pod.cpu_milli_request == 1250
+        assert pod.memory_request == (1 << 30) + (120 << 20)
 
     def test_unparseable_request_counts_zero(self):
         obj = {

@@ -22,7 +22,11 @@ first-party, on the same evaluation model upstream uses:
   terms also repel the incoming pod (upstream checks both directions;
   without this, "spread me" pods are only protected against later
   arrivals, not earlier ones).
-- **Preferred terms** contribute a signed weight sum for scoring.
+- **Preferred terms** contribute a signed weight sum for scoring — in
+  BOTH directions, as upstream InterPodAffinity scores: the incoming
+  pod's own preferred terms over existing pods, and existing pods'
+  preferred (anti-)affinity terms matching the incoming pod, each
+  credited/debited in the existing pod's topology domain.
 - **Topology spread**: ``maxSkew``/``topologyKey``/``whenUnsatisfiable``
   over the pods matching the constraint's selector in the incoming pod's
   namespace. ``DoNotSchedule`` filters; ``ScheduleAnyway`` scores.
@@ -313,12 +317,18 @@ def pod_has_inter_pod_terms(pod: PodSpec) -> bool:
     )
 
 
-def fleet_has_anti_affinity(infos: Iterable["NodeInfo"]) -> bool:
-    """Any bound pod anywhere declaring required anti-affinity — the
-    trigger for the symmetry check (callers cache this per snapshot
-    version so affinity-free fleets pay nothing per cycle)."""
+def fleet_has_inter_pod_terms(infos: Iterable["NodeInfo"]) -> bool:
+    """Any bound pod anywhere declaring required anti-affinity OR preferred
+    (anti-)affinity terms — the trigger for building an evaluator even when
+    the incoming pod has no terms of its own (required-anti symmetry filter
+    + symmetric preferred scoring). Callers cache this per snapshot
+    version so term-free fleets pay nothing per cycle."""
     return any(
-        p.pod_anti_affinity for ni in infos for p in ni.pods
+        p.pod_anti_affinity
+        or p.preferred_pod_affinity
+        or p.preferred_pod_anti_affinity
+        for ni in infos
+        for p in ni.pods
     )
 
 
@@ -333,7 +343,10 @@ class InterPodEvaluator:
     - per required-anti-affinity term: the set of forbidden values;
     - symmetry: (key, value) domains forbidden by EXISTING pods'
       anti-affinity terms that match the incoming pod;
-    - per preferred term: value sets for the signed score.
+    - per preferred term: value sets for the signed score;
+    - symmetric preferences: signed weight per (key, value) domain from
+      EXISTING pods' preferred (anti-)affinity terms matching the
+      incoming pod (upstream scores both directions).
 
     Per-node queries are then O(terms) dict lookups.
     """
@@ -344,6 +357,7 @@ class InterPodEvaluator:
     _bad_values: list[set[str]] = field(default_factory=list)
     _symmetry_bad: set[tuple[str, str]] = field(default_factory=set)
     _pref_values: list[tuple[int, str, set[str]]] = field(default_factory=list)
+    _sym_pref: dict[tuple[str, str], int] = field(default_factory=dict)
 
     @classmethod
     def build(
@@ -405,6 +419,21 @@ class InterPodEvaluator:
                         v = labels.get(term.topology_key)
                         if v is not None:
                             ev._symmetry_bad.add((term.topology_key, v))
+            # Symmetric preferred scoring (upstream InterPodAffinity): an
+            # existing pod's preferred terms matching THIS pod add or
+            # subtract weight in the existing pod's domain.
+            for sign, terms in (
+                (1, other.preferred_pod_affinity),
+                (-1, other.preferred_pod_anti_affinity),
+            ):
+                for w, term in terms:
+                    if term.matches_pod(pod, other.namespace, ns_labels):
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            dom = (term.topology_key, v)
+                            ev._sym_pref[dom] = (
+                                ev._sym_pref.get(dom, 0) + sign * w
+                            )
 
         pending = tuple(pending)
         seen_uids: set[str] = set()
@@ -440,6 +469,7 @@ class InterPodEvaluator:
             and not self.pod.pod_anti_affinity
             and not self._symmetry_bad
             and not self._pref_values
+            and not self._sym_pref
         )
 
     @property
@@ -447,7 +477,7 @@ class InterPodEvaluator:
         """True when some node could receive a nonzero preference() —
         scoring fast-paths gate on this, not on evaluator existence (an
         evaluator built only for the symmetry check has no preferences)."""
-        return bool(self._pref_values)
+        return bool(self._pref_values) or bool(self._sym_pref)
 
     def required_affinity_feasible(self, ni: "NodeInfo") -> bool:
         """Just the required-AFFINITY half of :meth:`feasible`. Within a
@@ -504,14 +534,19 @@ class InterPodEvaluator:
         return True, ""
 
     def preference(self, ni: "NodeInfo") -> int:
-        """Signed sum of preferred term weights this node satisfies."""
-        if not self._pref_values:
+        """Signed sum of preferred term weights this node satisfies: the
+        pod's own terms plus the symmetric contribution from existing
+        pods' preferred terms (both directions, upstream parity)."""
+        if not self._pref_values and not self._sym_pref:
             return 0
         labels = _node_labels(ni)
         total = 0
         for w, key, values in self._pref_values:
             v = labels.get(key)
             if v is not None and v in values:
+                total += w
+        for (key, value), w in self._sym_pref.items():
+            if labels.get(key) == value:
                 total += w
         return total
 

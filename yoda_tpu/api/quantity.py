@@ -16,7 +16,9 @@ HBM sizes. A bare number is mebibytes, for parity with the reference's
 
 from __future__ import annotations
 
+import math
 import re
+from decimal import Decimal
 
 _BINARY = {
     "Ki": 1 << 10,
@@ -69,20 +71,29 @@ def parse_quantity(text: str, *, default_unit: int = 1 << 20) -> int:
 
 def parse_cpu(text: str) -> int:
     """Parse a Kubernetes CPU quantity into millicores: ``"500m"`` -> 500,
-    ``"2"`` -> 2000, ``"1.5"`` -> 1500. Strict (QuantityError on anything
-    else) — callers that must tolerate wild pod specs wrap this."""
+    ``"2"`` -> 2000, ``"1.5"`` -> 1500, ``"100.5m"`` -> 101 (fractional
+    milli rounds UP, as upstream resource.Quantity does), ``"1e3"`` -> 10^6,
+    ``"100e-3"`` -> 100. Negative results are rejected. Strict
+    (QuantityError on anything else) — callers that must tolerate wild pod
+    specs wrap this."""
     if not isinstance(text, str):
         raise QuantityError(f"cpu must be a string, got {type(text).__name__}")
     s = text.strip()
     if s.endswith("m"):
         body = s[:-1]
-        if not body.isdigit():
+        if not re.match(r"^\d+(?:\.\d+)?$", body):
             raise QuantityError(f"malformed cpu quantity {text!r}")
-        return int(body)
-    m = re.match(r"^(\d+(?:\.\d+)?)$", s)
+        return math.ceil(Decimal(body))
+    m = re.match(r"^\d+(?:\.\d+)?(?:[eE]([+-]?)(\d+))?$", s)
     if not m:
         raise QuantityError(f"malformed cpu quantity {text!r}")
-    return int(float(m.group(1)) * 1000)
+    # Bounded POSITIVE exponent: Decimal parses "9e999999999" lazily but
+    # ceil() materializes a billion-digit int — a one-pod-spec DoS.
+    # Upstream resource.Quantity likewise caps magnitude (int64 + scale
+    # limits). Negative exponents are cheap (they just round up to 1m).
+    if m.group(2) is not None and m.group(1) != "-" and int(m.group(2)) > 18:
+        raise QuantityError(f"cpu quantity exponent out of range in {text!r}")
+    return math.ceil(Decimal(s) * 1000)
 
 
 def parse_int(text: str, *, field: str = "value") -> int:

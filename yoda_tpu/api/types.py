@@ -96,10 +96,16 @@ def _resource_requests_of(spec: "Mapping[str, Any]") -> tuple[int, int]:
     """(cpu millicores, memory bytes) the pod effectively requests —
     upstream NodeResourcesFit accounting: per container, requests fall
     back to that container's limits; the pod total is
-    max(sum(regular containers), max(init containers)) since init
-    containers run sequentially before the regular set. Unparseable values
-    are logged and counted as 0 (the API server validates quantities on
-    real clusters; our strictness budget is spent on tpu/* labels)."""
+    max(sum(regular + restartable-init containers), peak of the ordered
+    init phase) — sidecar init containers (restartPolicy: Always) keep
+    running alongside the regular set so they join the concurrent sum,
+    while each one-shot init container runs WITH the sidecars started
+    before it (upstream's ordered scan: a one-shot is charged its own
+    request plus the sidecar requests accumulated so far). Pod
+    ``spec.overhead`` (RuntimeClass) is added on top, as upstream does.
+    Unparseable values are logged and counted as 0 (the API server
+    validates quantities on real clusters; our strictness budget is spent
+    on tpu/* labels)."""
     from yoda_tpu.api.quantity import QuantityError, parse_cpu, parse_quantity
 
     def one(c: Mapping[str, Any]) -> tuple[int, int]:
@@ -126,14 +132,23 @@ def _resource_requests_of(spec: "Mapping[str, Any]") -> tuple[int, int]:
         return cpu, mem
 
     regular = [one(c) for c in spec.get("containers") or []]
-    init = [one(c) for c in spec.get("initContainers") or []]
-    cpu = max(
-        sum(c for c, _ in regular), max((c for c, _ in init), default=0)
-    )
-    mem = max(
-        sum(m for _, m in regular), max((m for _, m in init), default=0)
-    )
-    return cpu, mem
+    # Ordered init-phase scan (upstream): sidecars accumulate as they
+    # start; each one-shot init runs concurrently with the sidecars
+    # declared BEFORE it, so its charge is request + accumulated sidecars.
+    side_cpu = side_mem = 0        # sidecars started so far
+    init_cpu = init_mem = 0        # peak of the init phase
+    for c in spec.get("initContainers") or []:
+        ccpu, cmem = one(c)
+        if c.get("restartPolicy") == "Always":
+            side_cpu += ccpu
+            side_mem += cmem
+        else:
+            init_cpu = max(init_cpu, side_cpu + ccpu)
+            init_mem = max(init_mem, side_mem + cmem)
+    cpu = max(sum(c for c, _ in regular) + side_cpu, init_cpu)
+    mem = max(sum(m for _, m in regular) + side_mem, init_mem)
+    o_cpu, o_mem = one({"resources": {"requests": spec.get("overhead") or {}}})
+    return cpu + o_cpu, mem + o_mem
 
 
 @dataclass

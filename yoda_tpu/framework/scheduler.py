@@ -68,6 +68,7 @@ class Scheduler:
         on_unschedulable: Callable[[PodSpec, str], None] | None = None,
         on_nominated: Callable[[PodSpec, "str | None"], None] | None = None,
         cycle_lock: "threading.Lock | None" = None,
+        post_filter_lock: "threading.Lock | None" = None,
         metrics: SchedulingMetrics | None = None,
         percentage_nodes_to_score: int = 100,
         pod_alive: Callable[[PodSpec], bool] | None = None,
@@ -86,6 +87,14 @@ class Scheduler:
         # upstream profiles get this for free from their single scheduleOne
         # loop. None = private lock (single-profile, no contention).
         self.cycle_lock = cycle_lock or threading.Lock()
+        # Also shared across profile stacks, but narrower: serializes
+        # PostFilter preemption only. Victim selection reads snapshot
+        # occupancy and issues evictions; two profiles preempting
+        # concurrently would pick overlapping victim sets for the same
+        # chips (over-eviction). Separate from cycle_lock so the eviction
+        # API round-trips never stall Filter->Reserve of other profiles
+        # (lock order: cycle_lock is RELEASED before this is taken).
+        self.post_filter_lock = post_filter_lock or threading.Lock()
         self.metrics = metrics
         self.percentage_nodes_to_score = percentage_nodes_to_score
         self.pod_alive = pod_alive
@@ -183,6 +192,12 @@ class Scheduler:
             *,
             unresolvable: bool = False,
         ) -> ScheduleResult:
+            # Nothing below needs the cross-profile cycle lock (stats,
+            # queue ops, Event/status callbacks — including the synchronous
+            # on_nominated PATCH, an API round-trip that must not stall
+            # other profiles' queues). Filter->Reserve is already past or
+            # never happened on this path. No-op when already released.
+            release_cycle_lock()
             r = ScheduleResult(pod.key, outcome, node, message, self.clock() - t0)
             # One line per outcome at INFO (the reference's operational klog
             # trail, reference pkg/yoda/scheduler.go:143); waiting members
@@ -265,8 +280,12 @@ class Scheduler:
                 # for the whole gang) reach PostFilter too, as upstream —
                 # preemption is how a training gang displaces inference pods
                 # (BASELINE config 5). Unresolvable (bad labels) cannot be
-                # helped by eviction.
-                with timer.span("postfilter"):
+                # helped by eviction. No Reserve happens on this path, so
+                # the cycle lock is released BEFORE the eviction round-trips
+                # (pods/eviction + PDB 429 handling must not stall other
+                # profiles' queues — ADVICE r3).
+                release_cycle_lock()
+                with timer.span("postfilter"), self.post_filter_lock:
                     nominated, pf_st = self.framework.run_post_filter(
                         state, pod, snapshot, {}
                     )
@@ -315,7 +334,10 @@ class Scheduler:
                     log.debug("pod %s: node %s rejected: %s", pod.key, n, s.message)
 
         if not feasible:
-            with timer.span("postfilter"):
+            # As above: no Reserve on the infeasible path — release before
+            # the preemption API round-trips.
+            release_cycle_lock()
+            with timer.span("postfilter"), self.post_filter_lock:
                 nominated, pf_st = self.framework.run_post_filter(
                     state, pod, snapshot, statuses
                 )

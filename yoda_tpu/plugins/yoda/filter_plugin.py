@@ -26,7 +26,7 @@ from typing import Callable
 from yoda_tpu.api.affinity import (
     InterPodEvaluator,
     SpreadEvaluator,
-    fleet_has_anti_affinity,
+    fleet_has_inter_pod_terms,
     pod_has_inter_pod_terms,
 )
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
@@ -323,15 +323,18 @@ class YodaPreFilter(PreFilterPlugin):
         # reserved-but-unbound members, visible to the evaluators so gang
         # siblings honor each other's inter-pod terms mid-flight.
         self.pending_fn = pending_fn
-        # (snapshot.version, any bound pod has required anti-affinity)
-        self._anti_cache: tuple[int, bool] = (0, False)
+        # (snapshot.version, any bound pod declares required anti-affinity
+        #  or preferred inter-pod terms)
+        self._inter_cache: tuple[int, bool] = (0, False)
 
-    def _symmetry_possible(self, snapshot: Snapshot) -> bool:
-        if snapshot.version and self._anti_cache[0] == snapshot.version:
-            return self._anti_cache[1]
-        flag = fleet_has_anti_affinity(snapshot.infos())
+    def _fleet_has_terms(self, snapshot: Snapshot) -> bool:
+        """Required-anti symmetry or symmetric preferred scoring possible,
+        cached per snapshot version."""
+        if snapshot.version and self._inter_cache[0] == snapshot.version:
+            return self._inter_cache[1]
+        flag = fleet_has_inter_pod_terms(snapshot.infos())
         if snapshot.version:
-            self._anti_cache = (snapshot.version, flag)
+            self._inter_cache = (snapshot.version, flag)
         return flag
 
     def pre_filter(self, state: CycleState, pod: PodSpec, snapshot: Snapshot) -> Status:
@@ -344,8 +347,11 @@ class YodaPreFilter(PreFilterPlugin):
         pending = self.pending_fn() if self.pending_fn is not None else ()
         if (
             pod_has_inter_pod_terms(pod)
-            or self._symmetry_possible(snapshot)
-            or any(p.pod_anti_affinity for _, p in pending)
+            or self._fleet_has_terms(snapshot)
+            # Pending (reserved-but-unbound) siblings count like bound
+            # pods: their required anti-affinity repels and their
+            # preferred terms score symmetrically.
+            or any(pod_has_inter_pod_terms(p) for _, p in pending)
         ):
             inter = InterPodEvaluator.build(snapshot, pod, pending=pending)
             if inter.trivial:

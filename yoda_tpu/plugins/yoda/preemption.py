@@ -102,8 +102,17 @@ class TpuPreemption(PostFilterPlugin):
         on_victim: Callable[[Victim], None] | None = None,
         scheduler_name: str = "yoda-tpu",
         scheduler_names: "tuple[str, ...] | None" = None,
+        select_lock: "threading.Lock | None" = None,
     ) -> None:
         self.evict_fn = evict_fn
+        # Held during victim SELECTION (pure snapshot/reserved_fn reads) —
+        # pass the scheduler's shared cycle lock so selection cannot race
+        # another profile's Filter->Reserve (a Reserve landing between the
+        # reserved read and the evictions would invalidate the capacity
+        # math). Evictions themselves (API round-trips, PDB retries) run
+        # OUTSIDE it; the capacity race during eviction is inherent (other
+        # pods grab freed chips anyway) and cured by the retry cycle.
+        self.select_lock = select_lock or threading.Lock()
         self.reserved_fn = reserved_fn
         self.gang_status_fn = gang_status_fn
         self.gang_plan_fn = gang_plan_fn
@@ -366,20 +375,21 @@ class TpuPreemption(PostFilterPlugin):
         aff: AffinityData | None = None,
     ) -> tuple[str | None, Status]:
         best: tuple[tuple[int, int, int, str], list[Victim], str] | None = None
-        for ni in snapshot.infos():
-            victims = self._minimal_set(
-                ni, req, 1, req.priority, pod, aff
-            )
-            if victims is None or not victims:
-                continue
-            cost = (
-                max(v.priority for v in victims),
-                len(victims),
-                sum(v.chips for v in victims),
-                ni.name,
-            )
-            if best is None or cost < best[0]:
-                best = (cost, victims, ni.name)
+        with self.select_lock:
+            for ni in snapshot.infos():
+                victims = self._minimal_set(
+                    ni, req, 1, req.priority, pod, aff
+                )
+                if victims is None or not victims:
+                    continue
+                cost = (
+                    max(v.priority for v in victims),
+                    len(victims),
+                    sum(v.chips for v in victims),
+                    ni.name,
+                )
+                if best is None or cost < best[0]:
+                    best = (cost, victims, ni.name)
         if best is None:
             return None, Status.unschedulable(
                 f"no node can host {pod.key} even after preempting "
@@ -418,62 +428,67 @@ class TpuPreemption(PostFilterPlugin):
             return self._preempt_for_topology_gang(pod, req, snapshot, aff)
 
         # Plain gang: evict globally-cheapest victims until enough slots.
-        per_node: dict[str, list[Victim]] = {}
-        slots = 0
-        for ni in snapshot.infos():
-            if not self._node_eligible(ni, req, pod, aff):
-                continue
-            slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
-            per_node[ni.name] = self._victims_on(ni, req.priority)
-        if slots >= remaining:
-            # Capacity exists now (e.g. freed since Filter ran): retry, no
-            # eviction needed.
-            return None, Status.unschedulable("capacity already free; retry")
-        # Repeatedly buy one member slot from whichever node sells it
-        # cheapest (lowest max victim priority, then fewest victims) — a
-        # per-node minimal set, NOT a flat global order: when a member needs
-        # a whole host, spreading evictions across hosts frees nothing.
-        chosen: list[Victim] = []
-        freed_by_node: dict[str, int] = {}
-        victims_left = dict(per_node)
-        while slots < remaining:
-            best: tuple[tuple[int, int, int, str], str, list[Victim], int] | None = None
-            for name, vs in victims_left.items():
-                if not vs:
+        # Selection (everything up to the evictions) runs under the shared
+        # select lock so another profile's Reserve cannot invalidate the
+        # slot math mid-walk.
+        with self.select_lock:
+            per_node: dict[str, list[Victim]] = {}
+            slots = 0
+            for ni in snapshot.infos():
+                if not self._node_eligible(ni, req, pod, aff):
                     continue
-                ni = snapshot.get(name)
-                freed = freed_by_node.get(name, 0)
-                base = self._member_slots_after(ni, req, freed, pod, aff)
-                acc, prefix = 0, []
-                for v in vs:
-                    prefix.append(v)
-                    acc += v.chips
-                    gained = (
-                        self._member_slots_after(ni, req, freed + acc, pod, aff)
-                        - base
-                    )
-                    if gained > 0:
-                        cost = (
-                            max(x.priority for x in prefix),
-                            len(prefix),
-                            acc,
-                            name,
+                slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
+                per_node[ni.name] = self._victims_on(ni, req.priority)
+            if slots >= remaining:
+                # Capacity exists now (e.g. freed since Filter ran): retry,
+                # no eviction needed.
+                return None, Status.unschedulable("capacity already free; retry")
+            # Repeatedly buy one member slot from whichever node sells it
+            # cheapest (lowest max victim priority, then fewest victims) — a
+            # per-node minimal set, NOT a flat global order: when a member
+            # needs a whole host, spreading evictions across hosts frees
+            # nothing.
+            chosen: list[Victim] = []
+            freed_by_node: dict[str, int] = {}
+            victims_left = dict(per_node)
+            while slots < remaining:
+                best: tuple[tuple[int, int, int, str], str, list[Victim], int] | None = None
+                for name, vs in victims_left.items():
+                    if not vs:
+                        continue
+                    ni = snapshot.get(name)
+                    freed = freed_by_node.get(name, 0)
+                    base = self._member_slots_after(ni, req, freed, pod, aff)
+                    acc, prefix = 0, []
+                    for v in vs:
+                        prefix.append(v)
+                        acc += v.chips
+                        gained = (
+                            self._member_slots_after(ni, req, freed + acc, pod, aff)
+                            - base
                         )
-                        if best is None or cost < best[0]:
-                            best = (cost, name, list(prefix), gained)
-                        break
-            if best is None:
-                return None, Status.unschedulable(
-                    f"gang {gang.name}: evicting every lower-priority pod "
-                    f"still yields {slots} slots < {remaining} members"
+                        if gained > 0:
+                            cost = (
+                                max(x.priority for x in prefix),
+                                len(prefix),
+                                acc,
+                                name,
+                            )
+                            if best is None or cost < best[0]:
+                                best = (cost, name, list(prefix), gained)
+                            break
+                if best is None:
+                    return None, Status.unschedulable(
+                        f"gang {gang.name}: evicting every lower-priority pod "
+                        f"still yields {slots} slots < {remaining} members"
+                    )
+                _, name, prefix, gained = best
+                chosen.extend(prefix)
+                freed_by_node[name] = freed_by_node.get(name, 0) + sum(
+                    v.chips for v in prefix
                 )
-            _, name, prefix, gained = best
-            chosen.extend(prefix)
-            freed_by_node[name] = freed_by_node.get(name, 0) + sum(
-                v.chips for v in prefix
-            )
-            victims_left[name] = victims_left[name][len(prefix):]
-            slots += gained
+                victims_left[name] = victims_left[name][len(prefix):]
+                slots += gained
         evicted, refused = self._evict_or_refused(
             chosen,
             f"gang {gang.name}: every victim eviction was refused "
@@ -519,16 +534,17 @@ class TpuPreemption(PostFilterPlugin):
             )
         victims: list[Victim] = []
         clear: list[str] = []
-        for h in hosts:
-            if h not in snapshot:
-                continue
-            vs = self._minimal_set(
-                snapshot.get(h), req, 1, req.priority, pod, aff
-            )
-            if vs is None:
-                continue
-            clear.append(h)
-            victims.extend(vs)
+        with self.select_lock:
+            for h in hosts:
+                if h not in snapshot:
+                    continue
+                vs = self._minimal_set(
+                    snapshot.get(h), req, 1, req.priority, pod, aff
+                )
+                if vs is None:
+                    continue
+                clear.append(h)
+                victims.extend(vs)
         if not victims or len(clear) < len(hosts):
             return None, Status.unschedulable(
                 f"gang {gang.name}: planned hosts cannot all be cleared by "
@@ -579,13 +595,14 @@ class TpuPreemption(PostFilterPlugin):
                 )
             return sets[ni.name] is not None
 
-        plan = plan_multislice_placement(
-            snapshot,
-            want_dims=gang.topology,
-            slices=gang.slices,
-            host_ok=host_ok,
-            pinned=pinned,
-        )
+        with self.select_lock:
+            plan = plan_multislice_placement(
+                snapshot,
+                want_dims=gang.topology,
+                slices=gang.slices,
+                host_ok=host_ok,
+                pinned=pinned,
+            )
         if plan is None:
             return None, Status.unschedulable(
                 f"gang {gang.name}: no slice forms a "

@@ -9,6 +9,7 @@ assembled here from ``SchedulerConfig``.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 
@@ -45,6 +46,7 @@ def build_stack(
     extra_plugins: list | None = None,
     accountant: ChipAccountant | None = None,
     cycle_lock=None,
+    post_filter_lock=None,
     metrics: SchedulingMetrics | None = None,
     scheduler_names: "tuple[str, ...] | None" = None,
     clock=time.monotonic,
@@ -96,6 +98,10 @@ def build_stack(
     )
     plugins.append(gang)
     plugins.append(accountant)
+    # Normalized here (not in Scheduler) so preemption's victim-selection
+    # lock is THE SAME object as the scheduler's cycle lock — selection must
+    # be consistent with Filter->Reserve, across profiles and within one.
+    cycle_lock = cycle_lock or threading.Lock()
     preemption = None
     if config.enable_preemption:
         # Prefer the pods/eviction subresource (PDB- and grace-aware,
@@ -105,6 +111,7 @@ def build_stack(
             evict,
             scheduler_name=config.scheduler_name,
             scheduler_names=scheduler_names,
+            select_lock=cycle_lock,
             reserved_fn=accountant.chips_in_use,
             gang_status_fn=gang.gang_status,
             gang_plan_fn=gang.planned_unassigned_hosts,
@@ -199,6 +206,7 @@ def build_stack(
         on_bound=recorder.scheduled if recorder else None,
         on_unschedulable=recorder.failed_scheduling if recorder else None,
         cycle_lock=cycle_lock,
+        post_filter_lock=post_filter_lock,
         # status.nominatedNodeName write (upstream preemption parity);
         # backends without the status subresource simply skip it.
         on_nominated=(
@@ -247,9 +255,13 @@ def build_profile_stacks(
     # One cycle at a time ACROSS profiles: without this, two profile loops
     # can both pass Filter against the same free chips before either
     # Reserves (upstream profiles share a single scheduleOne loop).
-    import threading
-
     cycle_lock = threading.Lock()
+    # PostFilter preemption is serialized separately: two profiles must not
+    # both select victim sets before either evicts (overlapping victims =
+    # double intent). Victim selection additionally re-takes the cycle lock
+    # inside TpuPreemption so it is consistent with Reserve; only the
+    # eviction round-trips run lock-free (ADVICE r3).
+    post_filter_lock = threading.Lock()
     shared_metrics = SchedulingMetrics()
     stacks = [
         build_stack(
@@ -257,6 +269,7 @@ def build_profile_stacks(
             config=config,
             accountant=shared,
             cycle_lock=cycle_lock,
+            post_filter_lock=post_filter_lock,
             metrics=shared_metrics,
             scheduler_names=names,
             clock=clock,
@@ -269,6 +282,7 @@ def build_profile_stacks(
                 config=prof,
                 accountant=shared,
                 cycle_lock=cycle_lock,
+                post_filter_lock=post_filter_lock,
                 metrics=shared_metrics,
                 scheduler_names=names,
                 clock=clock,
