@@ -1,0 +1,230 @@
+"""Multi-pod fused dispatch (config ``batch_requests``, VERDICT r3 #1).
+
+The scheduler pops up to K pending pods per loop turn and YodaBatch
+evaluates them against ONE snapshot in ONE kernel call
+(ops.kernel.kernel_packed_burst); each pod's cycle is then served from the
+cached row with host-side conflict resolution (sibling chip/resource
+consumption subtracted, accountant spot-checked on the chosen node). The
+reference paid O(nodes) API round trips per pod (reference
+pkg/yoda/scheduler.go:70,108); the single-dispatch kernel amortized the
+fleet scan per pod; the burst amortizes it per K pods.
+"""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import K8sNode, PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(batch_requests=8, **cfg):
+    stack = build_stack(
+        config=SchedulerConfig(
+            mode="batch", batch_requests=batch_requests, **cfg
+        )
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+def fleet(agent, hosts=4, chips=8):
+    for i in range(hosts):
+        agent.add_host(f"v5e-{i}", generation="v5e", chips=chips)
+    agent.publish_all()
+
+
+def batch_plugin(stack):
+    return stack.framework.batch_plugins[0]
+
+
+class TestBurstDispatch:
+    def test_k_pods_one_dispatch(self):
+        stack, agent = make_stack(batch_requests=8)
+        fleet(agent, hosts=4)
+        yb = batch_plugin(stack)
+        for i in range(8):
+            stack.cluster.create_pod(
+                PodSpec(f"p-{i}", labels={"tpu/chips": "2"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == 8
+        # ONE kernel dispatch placed all eight pods.
+        assert yb.burst_dispatches == 1
+        assert yb.dispatch_count == 1
+        assert yb.burst_served == 8
+        assert yb.burst_invalidated == 0
+
+    def test_no_oversubscription_under_burst(self):
+        # 16 x 2-chip pods exactly fill 4 x 8-chip hosts: sibling
+        # consumption must spill pods across hosts, never over-pack.
+        stack, agent = make_stack(batch_requests=16)
+        fleet(agent, hosts=4)
+        for i in range(16):
+            stack.cluster.create_pod(
+                PodSpec(f"p-{i}", labels={"tpu/chips": "2"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        per_node: dict[str, int] = {}
+        for p in stack.cluster.list_pods():
+            assert p.node_name, f"{p.name} did not bind"
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 2
+        assert all(v <= 8 for v in per_node.values()), per_node
+        assert sum(per_node.values()) == 32
+
+    def test_excess_demand_parks_cleanly(self):
+        # 6 x 4-chip pods onto 4 x 8-chip hosts: 2 fit per host at most 8
+        # slots... only 8 slots of 4 chips exist, so all 6 fit; then 3
+        # more must park unschedulable without wedging the burst path.
+        stack, agent = make_stack(batch_requests=8, enable_preemption=False)
+        fleet(agent, hosts=2)  # 16 chips -> four 4-chip slots
+        for i in range(7):
+            stack.cluster.create_pod(
+                PodSpec(f"p-{i}", labels={"tpu/chips": "4"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == 4  # 16 chips / 4
+        assert stack.accountant.chips_in_use("v5e-0") == 8
+        assert stack.accountant.chips_in_use("v5e-1") == 8
+
+    def test_burst_pods_respect_allocatable(self):
+        # Burst siblings stacking onto one node must respect Node
+        # allocatable cpu like the per-dispatch path does.
+        stack, agent = make_stack(batch_requests=8, enable_preemption=False)
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_node(K8sNode("v5e-0", alloc_cpu_milli=2500))
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p-{i}",
+                    labels={"tpu/chips": "1"},
+                    cpu_milli_request=1000,
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        # 2500m allocatable / 1000m per pod -> exactly 2 fit.
+        assert len(bound) == 2
+
+    def test_gang_members_not_bursted(self):
+        stack, agent = make_stack(batch_requests=8)
+        fleet(agent, hosts=4)
+        yb = batch_plugin(stack)
+        for m in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{m}",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "4",
+                        "tpu/chips": "2",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == 4
+        # Gang members go through the gang-plan machinery, not the burst.
+        assert yb.burst_served == 0
+        assert yb.plan_served >= 1
+
+    def test_mixed_burst_and_gang(self):
+        stack, agent = make_stack(batch_requests=8)
+        fleet(agent, hosts=8)
+        yb = batch_plugin(stack)
+        for i in range(6):
+            stack.cluster.create_pod(
+                PodSpec(f"plain-{i}", labels={"tpu/chips": "1"})
+            )
+        for m in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{m}",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "4",
+                        "tpu/chips": "2",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == 10
+        assert yb.burst_served >= 4  # the plain pods rode bursts
+
+    def test_foreign_reservation_invalidates_burst(self):
+        # A reservation landing between prepare and a serve (another
+        # profile, a permit-released gang) must invalidate the stale rows
+        # — the pod re-dispatches fresh instead of double-booking.
+        stack, agent = make_stack(batch_requests=8)
+        fleet(agent, hosts=1)  # one host: any foreign claim collides
+        yb = batch_plugin(stack)
+        pods = [
+            PodSpec(f"p-{i}", labels={"tpu/chips": "2"}) for i in range(2)
+        ]
+        for p in pods:
+            stack.cluster.create_pod(p)
+        snap = stack.informer.snapshot()
+        stack.framework.prepare_burst(pods, snap)
+        assert yb._burst is not None
+        # Foreign claim: charge the accountant outside the burst's view
+        # (what a concurrent profile's Reserve or a permit-released gang
+        # member does).
+        stack.accountant._claim("foreign-uid", "v5e-0", 2)
+        # Drive the popped entries directly (run_until_idle would replace
+        # the staged burst with a fresh prepare that already sees the
+        # claim, hiding the race this test creates).
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        bound = [
+            p for p in stack.cluster.list_pods()
+            if p.node_name and p.name.startswith("p-")
+        ]
+        assert len(bound) == 2
+        assert yb.burst_invalidated >= 1
+        # 2 burst pods + 1 foreign claim = 6 chips on the 8-chip host.
+        assert stack.accountant.chips_in_use("v5e-0") == 6
+
+    def test_metrics_republish_invalidates_burst(self):
+        stack, agent = make_stack(batch_requests=8)
+        fleet(agent, hosts=2)
+        yb = batch_plugin(stack)
+        pods = [
+            PodSpec(f"p-{i}", labels={"tpu/chips": "1"}) for i in range(2)
+        ]
+        for p in pods:
+            stack.cluster.create_pod(p)
+        stack.framework.prepare_burst(pods, stack.informer.snapshot())
+        assert yb._burst is not None
+        agent.publish_all()  # metrics version bump
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        assert all(p.node_name for p in stack.cluster.list_pods())
+        assert yb.burst_invalidated >= 1
+
+
+class TestBurstConfig:
+    def test_batch_requests_requires_batch_mode(self):
+        with pytest.raises(ValueError, match="batch_requests"):
+            SchedulerConfig.from_dict({"mode": "loop", "batch_requests": 4})
+
+    def test_batch_requests_bounds(self):
+        with pytest.raises(ValueError, match="batch_requests"):
+            SchedulerConfig.from_dict({"batch_requests": 0})
+        with pytest.raises(ValueError, match="batch_requests"):
+            SchedulerConfig.from_dict({"batch_requests": 129})
+        assert SchedulerConfig.from_dict({"batch_requests": 16}).batch_requests == 16
+
+    def test_default_is_single_dispatch(self):
+        stack, agent = make_stack(batch_requests=1)
+        fleet(agent, hosts=2)
+        yb = batch_plugin(stack)
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(f"p-{i}", labels={"tpu/chips": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert all(p.node_name for p in stack.cluster.list_pods())
+        assert yb.burst_dispatches == 0
+        assert yb.dispatch_count == 4

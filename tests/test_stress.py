@@ -48,13 +48,17 @@ def topo_gang(name: str, topology: str = "2x2") -> list[PodSpec]:
 
 
 @pytest.mark.parametrize(
-    "seed,mesh",
-    [(s, None) for s in range(5)] + [(0, 8)],  # +1 run in mesh-sharded mode
+    "seed,mesh,burst",
+    [(s, None, 1) for s in range(5)]
+    + [(0, 8, 1)]          # +1 run in mesh-sharded mode
+    + [(1, None, 16), (3, None, 16)],  # +2 with multi-pod burst dispatch
 )
-def test_serve_forever_under_churn_and_gang_contention(seed, mesh):
+def test_serve_forever_under_churn_and_gang_contention(seed, mesh, burst):
     rng = random.Random(seed)
     stack = build_stack(
-        config=SchedulerConfig(gang_permit_timeout_s=1.0, mesh_devices=mesh)
+        config=SchedulerConfig(
+            gang_permit_timeout_s=1.0, mesh_devices=mesh, batch_requests=burst
+        )
     )
     agent = FakeTpuAgent(stack.cluster)
     agent.add_slice("slice-a", host_topology=(2, 2, 1))

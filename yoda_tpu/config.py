@@ -109,6 +109,11 @@ class SchedulerConfig:
     # kernel under the kernel_platform policy; when set, mesh devices come
     # from jax.devices() and kernel_platform is ignored.
     mesh_devices: int | None = None
+    # Multi-pod fused dispatch: pop up to this many pending pods per loop
+    # turn and evaluate them in ONE kernel call (YodaBatch.prepare_burst),
+    # amortizing the fleet scan and the dispatch floor across pods. 1 =
+    # one dispatch per pod (the pre-r4 behavior). Batch mode only.
+    batch_requests: int = 1
     # Additional profiles (upstream KubeSchedulerConfiguration profiles):
     # each entry inherits every unspecified key from the base config and
     # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
@@ -188,6 +193,20 @@ class SchedulerConfig:
             raise ValueError(
                 "kernel_backend='pallas' ignores kernel_platform; leave it "
                 "'auto' (the Mosaic kernel runs on the default device)"
+            )
+        if (
+            isinstance(cfg.batch_requests, bool)
+            or not isinstance(cfg.batch_requests, int)
+            or not 1 <= cfg.batch_requests <= 128
+        ):
+            raise ValueError(
+                "batch_requests must be an int in [1, 128], got "
+                f"{cfg.batch_requests!r}"
+            )
+        if cfg.batch_requests > 1 and cfg.mode != "batch":
+            raise ValueError(
+                "batch_requests > 1 requires mode='batch' (the fused kernel "
+                "is what a burst amortizes)"
             )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)

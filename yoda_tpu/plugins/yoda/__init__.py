@@ -44,6 +44,7 @@ def default_plugins(
     kernel_device_min_elems: int | None = None,
     mesh_devices: int | None = None,
     kernel_backend: str = "xla",
+    batch_requests: int = 1,
     pending_fn: Callable | None = None,
 ) -> list:
     """Assemble the standard plugin set.
@@ -70,6 +71,8 @@ def default_plugins(
                 ),
                 mesh_devices=mesh_devices,
                 kernel_backend=kernel_backend,
+                batch_requests=batch_requests,
+                pending_fn=pending_fn,
             )
         )
     elif mode == "loop":

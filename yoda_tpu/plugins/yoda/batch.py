@@ -26,8 +26,8 @@ from __future__ import annotations
 
 import logging
 from collections import Counter
-from dataclasses import dataclass
-from typing import Callable
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
 
 import numpy as np
 
@@ -132,6 +132,47 @@ def _host_admission(
 
 
 @dataclass
+class _BurstEntry:
+    """One pod's pre-evaluated row of a K-pod burst dispatch."""
+
+    request: KernelRequest
+    constraints: tuple            # _pod_constraints at prepare time
+    result: KernelResult
+    pref_bonus: np.ndarray        # [n_nodes] int64 soft-score term
+
+
+@dataclass
+class _BurstSet:
+    """One multi-pod dispatch's results (VERDICT r3 #1): K pending pods
+    evaluated against ONE snapshot in ONE kernel call
+    (ops.kernel.kernel_packed_burst), then served to their scheduling
+    cycles with host-side conflict resolution — each serve subtracts the
+    chips/resources consumed by earlier burst picks from the candidate's
+    claimable before ranking, and spot-checks the accountant on the chosen
+    node (reserved must equal the dispatch baseline plus exactly the burst
+    consumption; any foreign reservation invalidates the burst and falls
+    back to a fresh dispatch). The _GangPlan mechanism generalized to
+    heterogeneous requests."""
+
+    # The fleet-arrays cache key at dispatch (metrics version in the wired
+    # stack) — NOT snapshot.version: the burst's own binds bump the
+    # snapshot version by design (each served pod binds before the next
+    # cycle), while metrics stay put. Accounting drift is caught by the
+    # per-serve reserved spot-check; Node-object drift (cordon, taints) by
+    # the per-serve admission re-check on the chosen node.
+    fleet_version: int
+    names: list[str]
+    index: dict[str, int]              # node name -> row index
+    base_reserved: np.ndarray          # dyn[1] at dispatch time, [N]
+    entries: dict[str, _BurstEntry]    # pod uid -> row
+    consumed: dict[str, int] = field(default_factory=dict)   # node -> chips
+    # node -> [(pod uid, cpu milli, memory bytes)] taken by burst picks;
+    # per-pod so serves can skip entries already bound into the live
+    # snapshot (no double-count against NodeInfo.pods).
+    res: dict[str, list[tuple[str, int, int]]] = field(default_factory=dict)
+
+
+@dataclass
 class _GangPlan:
     """One dispatch's placement plan for a whole gang (VERDICT r2 #5).
 
@@ -176,7 +217,11 @@ class YodaBatch(BatchFilterScorePlugin):
         device_min_elems: int = AUTO_DEVICE_MIN_ELEMS,
         mesh_devices: int | None = None,
         kernel_backend: str = "xla",
+        batch_requests: int = 1,
+        pending_fn: Callable[[], list] | None = None,
     ) -> None:
+        if batch_requests < 1:
+            raise ValueError(f"batch_requests must be >= 1, got {batch_requests}")
         if platform not in ("auto", "cpu", "device"):
             raise ValueError(f"platform must be auto|cpu|device, got {platform!r}")
         if kernel_backend not in ("xla", "pallas"):
@@ -211,6 +256,18 @@ class YodaBatch(BatchFilterScorePlugin):
         self.dispatch_count = 0    # real kernel dispatches
         self.plan_served = 0       # sibling cycles answered from a gang plan
         self.plan_invalidated = 0  # plans dropped by a failed validation
+        # Multi-pod burst dispatch (VERDICT r3 #1): prepare_burst evaluates
+        # up to batch_requests pending pods in one kernel call; their
+        # cycles are then served from _burst.
+        self.batch_requests = batch_requests
+        self.pending_fn = pending_fn
+        self._burst: _BurstSet | None = None
+        self.burst_dispatches = 0   # multi-pod kernel dispatches
+        self.burst_served = 0       # cycles answered from a burst
+        self.burst_invalidated = 0  # burst rows dropped by failed validation
+        # (snapshot.version, fleet has inter-pod terms) — bursting is
+        # refused on fleets where evaluators would be needed per pod.
+        self._fleet_terms: tuple[int, bool] = (0, False)
         self._floor_ms: float | None = None  # lazy dispatch-floor probe
         # (snapshot.version, fleet has PreferNoSchedule taints) — lets the
         # soft-score loop be skipped entirely on taint-free fleets.
@@ -284,15 +341,19 @@ class YodaBatch(BatchFilterScorePlugin):
             )
         return self._floor_ms
 
-    def _refresh_static(self, snapshot: Snapshot) -> FleetArrays:
-        # Static [N, C] chip metrics are keyed on the metrics version when the
-        # informer provides one AND claims are supplied dynamically (pod binds
-        # then cost O(N), not O(N x C)); otherwise the static build also bakes
-        # in per-pod claims, so key on the full snapshot version.
+    def _fleet_version(self, snapshot: Snapshot) -> int:
+        """The cache key for fleet-static state: the metrics version when
+        the informer provides one AND claims are supplied dynamically (pod
+        binds then cost O(N), not O(N x C)); otherwise the full snapshot
+        version. Shared by the static-array cache and the burst set."""
         if self.claimed_fn is not None:
-            version = getattr(snapshot, "metrics_version", None) or snapshot.version
-        else:
-            version = snapshot.version
+            return (
+                getattr(snapshot, "metrics_version", None) or snapshot.version
+            )
+        return snapshot.version
+
+    def _refresh_static(self, snapshot: Snapshot) -> FleetArrays:
+        version = self._fleet_version(snapshot)
         if version and self._cache_version == version and self._static is not None:
             return self._static
         static = FleetArrays.from_snapshot(
@@ -325,6 +386,10 @@ class YodaBatch(BatchFilterScorePlugin):
         gang_name = req.gang.name if req.gang is not None else None
         if gang_name is not None:
             served = self._serve_gang_plan(state, pod, gang_name, snapshot, reqk)
+            if served is not None:
+                return served
+        elif self._burst is not None:
+            served = self._serve_burst(state, pod, snapshot, reqk)
             if served is not None:
                 return served
         static = self._refresh_static(snapshot)
@@ -442,6 +507,219 @@ class YodaBatch(BatchFilterScorePlugin):
         if snapshot.version:
             self._soft_taints = (snapshot.version, flag)
         return flag
+
+    # --- multi-pod burst dispatch (VERDICT r3 #1) ---
+
+    def _fleet_has_terms(self, snapshot: Snapshot) -> bool:
+        """Any bound pod with inter-pod terms (required anti-affinity or
+        preferred terms): then per-pod evaluators would be needed and
+        bursting is refused. Cached per snapshot version."""
+        from yoda_tpu.api.affinity import fleet_has_inter_pod_terms
+
+        if snapshot.version and self._fleet_terms[0] == snapshot.version:
+            return self._fleet_terms[1]
+        flag = fleet_has_inter_pod_terms(snapshot.infos())
+        if snapshot.version:
+            self._fleet_terms = (snapshot.version, flag)
+        return flag
+
+    def prepare_burst(self, pods: Sequence[PodSpec], snapshot: Snapshot) -> None:
+        """Evaluate up to ``batch_requests`` pending pods against ONE
+        snapshot in ONE kernel dispatch; their scheduling cycles are then
+        served from the cached per-pod rows (:meth:`_serve_burst`) with
+        host-side conflict resolution. Amortizes both the fleet scan and
+        the (remote or local) dispatch floor across pods — the analog for
+        heterogeneous pods of what ``_GangPlan`` does for gang siblings.
+
+        Refused (silently — cycles just dispatch individually) when the
+        preconditions for cheap, safe serving don't hold: no accounting
+        (spot-checks impossible), uncacheable snapshot, in-flight gang
+        placements or fleet-wide inter-pod terms (per-pod evaluators would
+        be required), or a kernel without a burst path (mesh/pallas)."""
+        self._burst = None
+        if (
+            self.batch_requests <= 1
+            or len(pods) < 2
+            or len(snapshot) == 0
+            or not snapshot.version
+            or self.reserved_fn is None
+            or (self.pending_fn is not None and self.pending_fn())
+            or self._fleet_has_terms(snapshot)
+        ):
+            return
+        from yoda_tpu.api.requests import LabelParseError, pod_request
+
+        candidates: list[tuple[PodSpec, KernelRequest]] = []
+        for pod in pods:
+            if len(candidates) >= self.batch_requests:
+                break
+            try:
+                req = pod_request(pod)
+            except LabelParseError:
+                continue  # the pod's own cycle reports the parse error
+            if (
+                req.gang is not None  # gang members have their own plans
+                or pod_has_inter_pod_terms(pod)
+                or pod.topology_spread
+            ):
+                continue
+            candidates.append((pod, KernelRequest.from_request(req)))
+        if len(candidates) < 2:
+            return  # nothing to amortize
+        static = self._refresh_static(snapshot)
+        if not hasattr(self._kern, "evaluate_burst"):
+            return
+        dyn = static.dyn_packed(
+            self.reserved_fn,
+            self.claimed_fn,
+            max_metrics_age_s=self.max_metrics_age_s,
+        )
+        k = self.batch_requests
+        n_pad = static.node_valid.shape[0]
+        host_ok_k = np.zeros((k, n_pad), dtype=np.int32)
+        requests: list[KernelRequest] = []
+        for i, (pod, reqk) in enumerate(candidates):
+            host_ok_k[i] = _host_admission(static, snapshot, pod)
+            requests.append(reqk)
+        # Pad to the fixed compile bucket: all-False host_ok rows are
+        # infeasible everywhere and their results are never read.
+        pad = KernelRequest(1, 0, 0, 0, 0)
+        while len(requests) < k:
+            requests.append(pad)
+        results = self._kern.evaluate_burst(dyn, host_ok_k, requests)
+        self.dispatch_count += 1
+        self.burst_dispatches += 1
+        entries = {
+            pod.uid: _BurstEntry(
+                request=reqk,
+                constraints=_pod_constraints(pod),
+                result=results[i],
+                pref_bonus=self._preference_bonus(static, snapshot, pod),
+            )
+            for i, (pod, reqk) in enumerate(candidates)
+        }
+        self._burst = _BurstSet(
+            fleet_version=self._fleet_version(snapshot),
+            names=list(static.names),
+            index={nm: i for i, nm in enumerate(static.names)},
+            base_reserved=np.asarray(dyn[1]).copy(),
+            entries=entries,
+        )
+
+    def _drop_burst(self) -> None:
+        if self._burst is not None:
+            self.burst_invalidated += len(self._burst.entries)
+            self._burst = None
+
+    def _serve_burst(
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        snapshot: Snapshot,
+        reqk: KernelRequest,
+    ) -> tuple[dict[str, Status], dict[str, int]] | None:
+        """Serve this pod's cycle from the burst dispatch — after adjusting
+        for sibling consumption and validating the accountant still matches
+        the dispatch baseline on the chosen node. None = dispatch fresh."""
+        b = self._burst
+        if b is None:
+            return None
+        if self._fleet_version(snapshot) != b.fleet_version:
+            self._drop_burst()  # fleet metrics changed: every row is stale
+            return None
+        entry = b.entries.get(pod.uid)
+        if entry is None:
+            return None
+        if reqk != entry.request or _pod_constraints(pod) != entry.constraints:
+            # The pod changed between prepare and its cycle (watch update).
+            del b.entries[pod.uid]
+            self.burst_invalidated += 1
+            return None
+        chips = max(reqk.number, 1)
+        result = entry.result
+        statuses: dict[str, Status] = {}
+        scores: dict[str, int] = {}
+        sibling = Status.unschedulable("chips consumed by a burst sibling")
+        for i, name in enumerate(b.names):
+            if result.feasible[i]:
+                used = b.consumed.get(name, 0)
+                if used and result.claimable[i] - used < chips:
+                    statuses[name] = sibling
+                    continue
+                statuses[name] = Status.ok()
+                scores[name] = int(result.scores[i]) + int(entry.pref_bonus[i])
+            else:
+                reason = REASON_MESSAGES.get(int(result.reasons[i]), "infeasible")
+                statuses[name] = Status.unschedulable(reason)
+        del b.entries[pod.uid]
+        if not b.entries:
+            self._burst = None
+        if not scores:
+            # Never park a pod off a stale row: the row's reserved vector
+            # is frozen at prepare time and reservation RELEASES don't
+            # bump the metrics version (review r4 — a pod freed between
+            # prepare and this cycle would leave the pod parked despite
+            # free chips). Fall back to a fresh dispatch, which rebuilds
+            # dyn from the live accountant; the row is dropped either way.
+            return None
+        best = max(scores, key=lambda nm: (scores[nm], nm))
+        # Spot-check the accountant on the chosen node: it must hold
+        # exactly the dispatch baseline plus the burst's own consumption —
+        # a foreign reservation (another profile, a permit-released gang)
+        # means the row's capacity math is stale.
+        idx = b.index[best]
+        if self.reserved_fn(best) != int(b.base_reserved[idx]) + b.consumed.get(
+            best, 0
+        ):
+            self._drop_burst()
+            self.burst_invalidated += 1  # this row, beyond the set drop
+            return None
+        # Live Node-object + allocatable spot-checks on the chosen node:
+        # the fleet_version key deliberately ignores Node/pod churn (the
+        # burst's own binds), so cordon/taint drift and burst siblings
+        # stacking cpu/memory/pod count are re-validated here (the gang
+        # plan's members_cap, per-serve). Siblings already BOUND and
+        # visible in the live snapshot must not be charged again from the
+        # burst's pending ledger (review r4: double-counting spuriously
+        # invalidated every co-located resource-requesting burst).
+        if best in snapshot:
+            ni = snapshot.get(best)
+            on_node = {p.uid for p in ni.pods}
+            p_cpu = p_mem = p_cnt = 0
+            for uid, c, m in b.res.get(best, ()):
+                if uid not in on_node:
+                    p_cpu += c
+                    p_mem += m
+                    p_cnt += 1
+            if (
+                not pod_admits_on(ni.node, pod)[0]
+                or not node_fits_resources(
+                    ni, pod, {best: (p_cpu, p_mem, p_cnt)}
+                )[0]
+            ):
+                self._drop_burst()
+                self.burst_invalidated += 1
+                return None
+        b.consumed[best] = b.consumed.get(best, 0) + chips
+        b.res.setdefault(best, []).append(
+            (pod.uid, pod.cpu_milli_request, pod.memory_request)
+        )
+        self.burst_served += 1
+        # Steer the driver to the ONE spot-checked node (the gang plan's
+        # single-choice contract): an extra Filter/Score plugin may
+        # otherwise redirect the bind to a node whose burst row is stale
+        # and whose accountant state was never validated (review r4 —
+        # chip overcommit). A redirect now just yields "no feasible node"
+        # and a clean fresh-dispatch retry.
+        held = Status.unschedulable(
+            "feasible, but a burst sibling was steered here first "
+            "(single-choice serving)"
+        )
+        statuses = {
+            nm: (st if not st.success else (Status.ok() if nm == best else held))
+            for nm, st in statuses.items()
+        }
+        return statuses, {best: scores[best]}
 
     # --- whole-gang batched placement (VERDICT r2 #5) ---
 

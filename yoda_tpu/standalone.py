@@ -92,6 +92,7 @@ def build_stack(
         kernel_device_min_elems=config.kernel_device_min_elems,
         mesh_devices=config.mesh_devices,
         kernel_backend=config.kernel_backend,
+        batch_requests=config.batch_requests,
         # Gang members parked at Permit stay visible to the inter-pod
         # affinity/spread evaluators (api.affinity pending support).
         pending_fn=gang.pending_placements,
@@ -181,6 +182,44 @@ def build_stack(
                 "(validation failure or concurrent-gang eviction)",
                 lambda: sum(p.plan_invalidated for p in acc),
             )
+            metrics.registry.counter(
+                "yoda_burst_dispatches_total",
+                "Multi-pod burst kernel dispatches (config batch_requests: "
+                "one dispatch pre-evaluates up to K pending pods)",
+                lambda: sum(p.burst_dispatches for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_burst_served_total",
+                "Scheduling cycles answered from a multi-pod burst dispatch",
+                lambda: sum(p.burst_served for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_burst_invalidated_total",
+                "Burst rows dropped by a failed validation (metrics "
+                "republish, foreign reservation, allocatable conflict) — a "
+                "high rate means the amortization is being lost to churn",
+                lambda: sum(p.burst_invalidated for p in acc),
+            )
+            metrics.registry.gauge(
+                "yoda_kernel_dispatch_floor_ms",
+                "Measured default-device per-dispatch floor (0 until the "
+                "auto platform policy probes it; ~0.1 locally-attached, "
+                "~100 over a tunnel/RPC transport)",
+                lambda: max((p._floor_ms or 0.0 for p in acc), default=0.0),
+            )
+            metrics.registry.gauge(
+                "yoda_kernel_on_accelerator",
+                "1 when some fused kernel currently targets the process "
+                "default accelerator device (0 = pinned to host CPU by the "
+                "platform policy or config)",
+                lambda: int(
+                    any(
+                        p._kern is not None and p._kern_device is None
+                        and p.platform != "cpu"
+                        for p in acc
+                    )
+                ),
+            )
         acc.extend(batches)
 
     if own_accountant:
@@ -215,6 +254,7 @@ def build_stack(
             else None
         ),
         pod_alive=informer.pod_schedulable,
+        burst_size=config.batch_requests,
     )
     return Stack(
         cluster,
@@ -302,8 +342,18 @@ def build_profile_stacks(
             out.extend(g.pending_placements())
         return out
 
+    from yoda_tpu.plugins.yoda import YodaBatch
+
     for st in stacks:
         for p in st.framework.pre_filter_plugins:
             if isinstance(p, YodaPreFilter):
+                p.pending_fn = all_pending
+        for p in st.framework.batch_plugins:
+            if isinstance(p, YodaBatch):
+                # The burst guard must see EVERY profile's Permit-parked
+                # members, not just this stack's: a foreign member's
+                # cpu/memory claim is invisible in snapshots, and a burst
+                # prepared without it could overcommit allocatable
+                # (review r4).
                 p.pending_fn = all_pending
     return stacks
