@@ -1,0 +1,253 @@
+"""hostPort conflicts and minimal volume awareness (VERDICT r3 missing
+#1/#2).
+
+The reference ran the FULL upstream v1.17 default plugin set alongside yoda
+(reference pkg/register/register.go:10; deploy/yoda-scheduler.yaml:15-27
+adds yoda to the defaults), which includes the NodePorts and
+VolumeBinding/volume-zone filters. Here:
+
+- hostPort: two pods claiming a conflicting (protocol, port, hostIP)
+  cannot share a node (api.types.host_ports_conflict,
+  filter_plugin.node_fits_host_ports), in-flight gang members included.
+- volumes: pods mounting a PersistentVolumeClaim honor the claim's
+  ``volume.kubernetes.io/selected-node`` annotation and
+  ``topology.kubernetes.io/zone`` label (K8sPvc, PVC watch,
+  filter_plugin.resolve_volumes/node_fits_volumes); a missing claim parks
+  the pod until the PVC's watch event arrives.
+"""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import (
+    K8sNode,
+    K8sPvc,
+    PodSpec,
+    host_ports_conflict,
+)
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_stack
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+class TestHostPortsConflict:
+    def test_same_port_same_proto_conflicts(self):
+        assert host_ports_conflict((80, "TCP", "0.0.0.0"), (80, "TCP", "0.0.0.0"))
+
+    def test_different_proto_ok(self):
+        assert not host_ports_conflict((80, "TCP", "0.0.0.0"), (80, "UDP", "0.0.0.0"))
+
+    def test_different_port_ok(self):
+        assert not host_ports_conflict((80, "TCP", "0.0.0.0"), (81, "TCP", "0.0.0.0"))
+
+    def test_wildcard_ip_overlaps_specific(self):
+        assert host_ports_conflict((80, "TCP", "0.0.0.0"), (80, "TCP", "10.0.0.1"))
+
+    def test_distinct_specific_ips_ok(self):
+        assert not host_ports_conflict((80, "TCP", "10.0.0.1"), (80, "TCP", "10.0.0.2"))
+
+
+class TestHostPortParsing:
+    def test_parsed_from_containers_and_roundtrip(self):
+        obj = {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [
+                    {
+                        "ports": [
+                            {"hostPort": 8080},
+                            {"containerPort": 9090},  # no hostPort: ignored
+                        ]
+                    }
+                ],
+                "initContainers": [
+                    {"ports": [{"hostPort": 53, "protocol": "UDP"}]}
+                ],
+            },
+        }
+        pod = PodSpec.from_obj(obj)
+        assert pod.host_ports == (
+            (8080, "TCP", "0.0.0.0"),
+            (53, "UDP", "0.0.0.0"),
+        )
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.host_ports == pod.host_ports
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestHostPortScheduling:
+    def test_conflicting_pods_spread_across_nodes(self, mode):
+        stack, agent = make_stack(mode=mode)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        ports = ((8471, "TCP", "0.0.0.0"),)
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(f"p-{i}", labels={"tpu/chips": "1"}, host_ports=ports)
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 2, "hostPort conflict ignored"
+
+    def test_third_conflicting_pod_parks(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        ports = ((8471, "TCP", "0.0.0.0"),)
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(f"p-{i}", labels={"tpu/chips": "1"}, host_ports=ports)
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == 2
+
+    def test_hostport_gang_one_member_per_host(self, mode):
+        # Identical gang siblings claiming a hostPort always conflict with
+        # each other: the gang plan (and the per-member path via the
+        # pending-ports feed) must place one member per host.
+        stack, agent = make_stack(mode=mode)
+        for i in range(4):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        ports = ((9999, "TCP", "0.0.0.0"),)
+        for m in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{m}",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "4",
+                        "tpu/chips": "1",
+                    },
+                    host_ports=ports,
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 4
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestVolumeAwareness:
+    def test_selected_node_pins_placement(self, mode):
+        stack, agent = make_stack(mode=mode)
+        for i in range(4):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(K8sPvc("data", selected_node="v5e-2"))
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("data",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-2"
+
+    def test_zone_conflict_rejects(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i, z in enumerate(["a", "b"]):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+            stack.cluster.put_node(K8sNode(f"v5e-{i}", labels={ZONE: z}))
+        agent.publish_all()
+        stack.cluster.put_pvc(K8sPvc("zoned", zone="b"))
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("zoned",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-1"
+
+    def test_missing_claim_parks_until_pvc_appears(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("late",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.cluster.get_pod("default/p").node_name is None
+        # The claim appearing reactivates the parked pod (PVC watch event).
+        stack.cluster.put_pvc(K8sPvc("late"))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-0"
+
+    def test_namespace_scoped_claims(self, mode):
+        # A claim in another namespace must not satisfy the pod's mount.
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(K8sPvc("data", namespace="prod"))
+        stack.cluster.create_pod(
+            PodSpec(
+                "p", namespace="default",
+                labels={"tpu/chips": "1"}, pvc_names=("data",),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.cluster.get_pod("default/p").node_name is None
+
+    def test_preemption_skips_volume_pinned_ineligible_nodes(self, mode):
+        # A pod pinned to v5e-0 must evict there — never on other nodes it
+        # can't use (eviction cannot cure a selected-node pin).
+        stack, agent = make_stack(mode=mode)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=4)
+        agent.publish_all()
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"low-{i}",
+                    labels={"tpu/chips": "4", "tpu/priority": "1"},
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        stack.cluster.put_pvc(K8sPvc("pin", selected_node="v5e-0"))
+        stack.cluster.create_pod(
+            PodSpec(
+                "high",
+                labels={"tpu/chips": "4", "tpu/priority": "9"},
+                pvc_names=("pin",),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        high = stack.cluster.get_pod("default/high")
+        assert high is not None and high.node_name == "v5e-0"
+        # Exactly one eviction: the low-priority squatter on the pinned
+        # node; the one on the other node survives.
+        assert stack.preemption.preempted_total == 1
+        survivors = [
+            p for p in stack.cluster.list_pods() if p.name.startswith("low-")
+        ]
+        assert len(survivors) == 1
+        assert survivors[0].node_name != "v5e-0"
+
+
+class TestVolumeRoundtrip:
+    def test_pvc_obj_roundtrip(self):
+        pvc = K8sPvc("d", namespace="ns", selected_node="n1", zone="z")
+        back = K8sPvc.from_obj(pvc.to_obj())
+        assert back == pvc
+
+    def test_pod_pvc_names_roundtrip(self):
+        pod = PodSpec("p", pvc_names=("a", "b"))
+        back = PodSpec.from_obj(pod.to_obj())
+        assert back.pvc_names == ("a", "b")
+
+    def test_no_pvc_watch_means_no_enforcement(self):
+        # Snapshots without PVC data (backends lacking the watch) keep the
+        # pre-r4 behavior: volume constraints are not enforced.
+        from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+        from yoda_tpu.plugins.yoda.filter_plugin import resolve_volumes
+
+        snap = Snapshot({"n": NodeInfo("n")})
+        pod = PodSpec("p", pvc_names=("data",))
+        pvcs, missing = resolve_volumes(snap, pod)
+        assert pvcs == () and missing is None

@@ -547,3 +547,61 @@ class TestNamespaceWatch:
             assert kc.wait_for_sync(10.0), "403 on namespaces blocked sync"
         finally:
             kc.stop()
+
+
+class TestPvcWatch:
+    def test_pvc_flows_and_sentinel_upgrades_informer(self, server, cluster):
+        # The informer registered after sync must still learn the PVC
+        # watch is live (replayed "synced" sentinel) and see claims.
+        from yoda_tpu.api.types import K8sPvc
+        from yoda_tpu.cluster.informer import InformerCache
+
+        server.put_object(
+            "PersistentVolumeClaim", "default/data",
+            K8sPvc("data", selected_node="n1").to_obj(),
+        )
+        informer = InformerCache()
+        assert informer.watches_pvcs is False
+        cluster.add_watcher(informer.handle)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = informer.snapshot()
+            if informer.watches_pvcs and snap.pvcs and "default/data" in snap.pvcs:
+                break
+            time.sleep(0.02)
+        assert informer.watches_pvcs is True
+        assert informer.snapshot().pvcs["default/data"].selected_node == "n1"
+
+    def test_pvc_403_degrades_to_not_enforced(self):
+        # RBAC skew: the PVC list 403s forever — sync completes, the
+        # liveness sentinel never fires, and the informer keeps volume
+        # constraints NOT enforced (snapshot.pvcs is None) instead of
+        # parking every PVC-referencing pod on "claim not found".
+        import threading as _threading
+
+        from yoda_tpu.cluster.informer import InformerCache
+
+        class _Api:
+            class config:
+                watch_timeout_s = 1
+
+            def request(self, method, path, **kw):
+                if path.startswith("/api/v1/persistentvolumeclaims"):
+                    raise KubeApiError(403, "forbidden")
+                return {"items": [], "metadata": {"resourceVersion": "1"}}
+
+            def watch(self, path, *, params=None):
+                _threading.Event().wait(0.05)
+                return iter(())
+
+        kc = KubeCluster(_Api(), backoff_initial_s=0.05, backoff_max_s=0.2)
+        informer = InformerCache()
+        kc.add_watcher(informer.handle)
+        kc.start()
+        try:
+            assert kc.wait_for_sync(10.0), "403 on PVCs blocked sync"
+            time.sleep(0.3)
+            assert informer.watches_pvcs is False
+            assert informer.snapshot().pvcs is None
+        finally:
+            kc.stop()

@@ -71,6 +71,11 @@ class TestSchedulerManifest:
         assert {"list", "watch"} <= rules[("", "nodes")]
         # Namespace watch feeds pod-affinity namespaceSelector terms.
         assert {"list", "watch"} <= rules[("", "namespaces")]
+        # PVC watch feeds the minimal volume filter (selected-node/zone).
+        assert {"list", "watch"} <= rules[("", "persistentvolumeclaims")]
+        assert not {"create", "update", "delete"} & rules[
+            ("", "persistentvolumeclaims")
+        ]
         assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
         # write_event POSTs then PUTs (count aggregation) — cluster/events.py.
         assert {"create", "update"} <= rules[("", "events")]

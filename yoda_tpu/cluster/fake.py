@@ -11,7 +11,13 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Literal
 
-from yoda_tpu.api.types import K8sNamespace, K8sNode, PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import (
+    K8sNamespace,
+    K8sNode,
+    K8sPvc,
+    PodSpec,
+    TpuNodeMetrics,
+)
 
 EventType = Literal["added", "modified", "deleted"]
 
@@ -30,6 +36,7 @@ class FakeCluster:
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
         self._namespaces: dict[str, K8sNamespace] = {}
+        self._pvcs: dict[str, K8sPvc] = {}  # "namespace/name" -> claim
         self._events: dict[str, dict] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
@@ -46,6 +53,8 @@ class FakeCluster:
             if replay:
                 for ns in self._namespaces.values():
                     fn(Event("added", "Namespace", ns))
+                for pvc in self._pvcs.values():
+                    fn(Event("added", "PersistentVolumeClaim", pvc))
                 for node in self._nodes.values():
                     fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():
@@ -176,6 +185,24 @@ class FakeCluster:
             ns = self._namespaces.pop(name, None)
             if ns is not None:
                 self._emit(Event("deleted", "Namespace", ns))
+
+    def put_pvc(self, pvc: K8sPvc) -> None:
+        with self._lock:
+            is_new = pvc.key not in self._pvcs
+            self._pvcs[pvc.key] = pvc
+            self._emit(
+                Event(
+                    "added" if is_new else "modified",
+                    "PersistentVolumeClaim",
+                    pvc,
+                )
+            )
+
+    def delete_pvc(self, key: str) -> None:
+        with self._lock:
+            pvc = self._pvcs.pop(key, None)
+            if pvc is not None:
+                self._emit(Event("deleted", "PersistentVolumeClaim", pvc))
 
     def put_node(self, node: K8sNode) -> None:
         with self._lock:

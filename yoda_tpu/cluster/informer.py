@@ -18,7 +18,7 @@ import threading
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
-from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNode, K8sPvc, PodSpec, TpuNodeMetrics
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
 
@@ -32,14 +32,23 @@ class InformerCache:
         scheduler_name: str = "yoda-tpu",
         on_pod_pending: Callable[[PodSpec], None] | None = None,
         on_change: Callable[[Event], None] | None = None,
+        watches_pvcs: bool = False,
     ) -> None:
         self.scheduler_name = scheduler_name
         self.on_pod_pending = on_pod_pending
         self.on_change = on_change
+        # True when the backend streams PersistentVolumeClaim events: then
+        # an empty PVC store means "no claims exist" (pods referencing one
+        # wait), while False means "no PVC data" (volume constraints are
+        # not enforced — snapshot.pvcs stays None).
+        self.watches_pvcs = watches_pvcs
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
         self._namespaces: dict[str, dict[str, str]] = {}
+        # "namespace/name" -> K8sPvc (minimal volume awareness: the
+        # selected-node annotation and zone label the filter honors).
+        self._pvcs: dict[str, K8sPvc] = {}
         # True once any Node event arrived: from then on a TPU CR without a
         # live Node object is excluded from snapshots (node deleted — the
         # reference's upstream snapshot drops such nodes for free, reference
@@ -75,8 +84,31 @@ class InformerCache:
             self._handle_node(event)
         elif event.kind == "Namespace":
             self._handle_namespace(event)
+        elif event.kind == "PersistentVolumeClaim":
+            self._handle_pvc(event)
         if self.on_change is not None:
             self.on_change(event)
+
+    def _handle_pvc(self, event: Event) -> None:
+        with self._lock:
+            if event.type == "synced":
+                # KubeCluster emits this after a successful PVC LIST: the
+                # watch is genuinely live (RBAC granted), so an empty
+                # store now means "no claims exist" and enforcement is on.
+                # Without it (403: missing ClusterRole rule) volume
+                # constraints degrade to not-enforced instead of parking
+                # every PVC-referencing pod on "claim not found".
+                self.watches_pvcs = True
+                self._version += 1
+                self._snapshot_cache = None
+                return
+            pvc: K8sPvc = event.obj  # type: ignore[assignment]
+            if event.type == "deleted":
+                self._pvcs.pop(pvc.key, None)
+            else:
+                self._pvcs[pvc.key] = pvc
+            self._version += 1
+            self._snapshot_cache = None
 
     def _handle_namespace(self, event: Event) -> None:
         ns = event.obj
@@ -225,6 +257,11 @@ class InformerCache:
                 nodes,
                 version=self._version,
                 namespaces=self._namespaces or None,
+                pvcs=(
+                    self._pvcs
+                    if (self.watches_pvcs or self._pvcs)
+                    else None
+                ),
             )
             snap.metrics_version = self._metrics_version
             self._snapshot_cache = snap

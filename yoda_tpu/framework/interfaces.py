@@ -104,6 +104,7 @@ class Snapshot:
         *,
         version: int = 0,
         namespaces: "Mapping[str, Mapping[str, str]] | None" = None,
+        pvcs: "Mapping[str, object] | None" = None,
     ) -> None:
         self._nodes = dict(nodes)
         self._order = sorted(self._nodes)
@@ -115,6 +116,13 @@ class Snapshot:
         # pod-affinity namespaceSelector terms (api.affinity). None = no
         # Namespace data available.
         self.namespaces = dict(namespaces) if namespaces else None
+        # "namespace/name" -> K8sPvc (from the PVC watch), consumed by the
+        # minimal volume filter (filter_plugin.node_fits_volumes). None =
+        # no PVC data available (backends without the watch: volume
+        # constraints are not enforced, as in the round-3 state). An EMPTY
+        # dict is meaningful — the watch is live and no claims exist —
+        # so only a true None collapses to None.
+        self.pvcs = dict(pvcs) if pvcs is not None else None
 
     def get(self, name: str) -> NodeInfo:
         return self._nodes[name]

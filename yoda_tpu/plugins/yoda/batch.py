@@ -56,6 +56,7 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
     get_affinity,
     get_pending_resources,
     get_request,
+    node_fits_host_ports,
     node_fits_resources,
 )
 from yoda_tpu.plugins.yoda.gang import ALLOWED_HOSTS_KEY, GANG_REMAINING_KEY
@@ -96,6 +97,8 @@ def _pod_constraints(pod: PodSpec) -> tuple:
         pod.topology_spread,
         pod.cpu_milli_request,
         pod.memory_request,
+        pod.host_ports,
+        pod.pvc_names,
     )
 
 
@@ -107,9 +110,10 @@ def _host_admission(
     pending_res: dict | None = None,
 ) -> np.ndarray:
     """Per-pod Node-object admission vector: cordon + taints vs the pod's
-    tolerations (semantics: api.types.node_admits_pod), plus inter-pod
-    affinity / topology-spread feasibility when the PreFilter built
-    evaluators (api.affinity — absent for the vast majority of pods, so
+    tolerations (semantics: api.types.node_admits_pod), plus hostPort
+    conflicts, and — when the PreFilter built an AffinityData — volume
+    (selected-node/zone) constraints and inter-pod affinity /
+    topology-spread feasibility (absent for the vast majority of pods, so
     the common path stays one pod_admits_on call per node). Padding rows
     are masked by node_valid in the kernel, so their value is
     irrelevant."""
@@ -121,6 +125,10 @@ def _host_admission(
         if not pod_admits_on(ni.node, pod)[0]:
             return False
         if not node_fits_resources(ni, pod, pending_res)[0]:
+            return False
+        if pod.host_ports and not node_fits_host_ports(
+            ni, pod, aff.pending_ports if aff is not None else None
+        )[0]:
             return False
         return aff is None or aff.feasible(ni)[0]
 
@@ -397,8 +405,8 @@ class YodaBatch(BatchFilterScorePlugin):
         pending_res = get_pending_resources(state)
         # Reservations/claims/freshness change cycle-to-cycle without a
         # metrics bump, and Node-object admission (cordon + taints +
-        # inter-pod affinity/spread + resource fit vs THIS pod) is per
-        # (pod, cycle): one packed upload.
+        # inter-pod affinity/spread + resource fit + host ports + volume
+        # pins vs THIS pod) is per (pod, cycle): one packed upload.
         dyn = static.dyn_packed(
             self.reserved_fn,
             self.claimed_fn,
@@ -561,6 +569,11 @@ class YodaBatch(BatchFilterScorePlugin):
                 req.gang is not None  # gang members have their own plans
                 or pod_has_inter_pod_terms(pod)
                 or pod.topology_spread
+                # hostPort/volume pods need per-cycle conflict state the
+                # serve-time spot-checks don't re-validate: dispatch
+                # individually (rare pods; correctness over amortization).
+                or pod.host_ports
+                or pod.pvc_names
             ):
                 continue
             candidates.append((pod, KernelRequest.from_request(req)))
@@ -788,6 +801,10 @@ class YodaBatch(BatchFilterScorePlugin):
             alone are not the only capacity (review r3: a plan could
             overcommit allocatable the way it once overcommitted
             anti-affinity)."""
+            if pod.host_ports:
+                # Identical gang siblings claiming a hostPort always
+                # conflict with each other: one member per node.
+                return 1
             if name not in snapshot:
                 return None
             ni = snapshot.get(name)

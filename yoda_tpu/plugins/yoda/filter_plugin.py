@@ -30,7 +30,12 @@ from yoda_tpu.api.affinity import (
     pod_has_inter_pod_terms,
 )
 from yoda_tpu.api.requests import LabelParseError, TpuRequest, pod_request
-from yoda_tpu.api.types import TpuChip, TpuNodeMetrics, pod_admits_on
+from yoda_tpu.api.types import (
+    TpuChip,
+    TpuNodeMetrics,
+    host_ports_conflict,
+    pod_admits_on,
+)
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
     FilterPlugin,
@@ -63,18 +68,37 @@ def get_request(state: CycleState) -> TpuRequest:
 
 @dataclass
 class AffinityData:
-    """CycleState carrier for the per-cycle inter-pod affinity and
-    topology-spread evaluators (api.affinity). Built once in PreFilter;
-    ``None`` members mean the dimension cannot fire for this (pod, cycle),
-    so per-node checks are skipped entirely."""
+    """CycleState carrier for the per-cycle admission evaluators: inter-pod
+    affinity, topology spread (api.affinity), and the pod's resolved
+    constraint-carrying volume claims. Built once in PreFilter; ``None`` /
+    empty members mean the dimension cannot fire for this (pod, cycle), so
+    per-node checks are skipped entirely."""
 
     inter: InterPodEvaluator | None = None
     spread: SpreadEvaluator | None = None
+    # Resolved K8sPvc objects with a selected_node or zone constraint
+    # (resolve_volumes) — the minimal VolumeBinding/volume-zone parity.
+    pvcs: tuple = ()
+    # node -> hostPort triples held by in-flight placements (gang members
+    # reserved at Permit — invisible in NodeInfo.pods until bound). None
+    # when no pending pod claims ports (the overwhelming norm).
+    pending_ports: "dict[str, tuple] | None" = None
 
     def clone(self) -> "AffinityData":
         return self
 
+    def volumes_feasible(self, node) -> tuple[bool, str]:
+        """The volume half alone — preemption's node-eligibility guard
+        (eviction can never cure a selected-node or zone pin, unlike
+        anti-affinity/spread conflicts)."""
+        if self.pvcs:
+            return node_fits_volumes(self.pvcs, node)
+        return True, ""
+
     def feasible(self, node) -> tuple[bool, str]:
+        ok, why = self.volumes_feasible(node)
+        if not ok:
+            return ok, why
         if self.inter is not None:
             ok, why = self.inter.feasible(node)
             if not ok:
@@ -251,6 +275,78 @@ def available_chips(
     return unused - invisible_reservations(node, reserved) + freed
 
 
+def node_fits_host_ports(
+    ni, pod: PodSpec, pending_ports: dict[str, tuple] | None = None
+) -> tuple[bool, str]:
+    """Upstream NodePorts: the pod's hostPort claims must not conflict with
+    any pod already on the node (same protocol+port with overlapping
+    hostIPs), nor with in-flight placements (``pending_ports``). Port-free
+    pods (the overwhelming majority) cost one tuple check."""
+    if not pod.host_ports:
+        return True, ""
+    claimed = [
+        (theirs, other.key) for other in ni.pods for theirs in other.host_ports
+    ]
+    if pending_ports:
+        claimed += [
+            (theirs, "an in-flight placement")
+            for theirs in pending_ports.get(ni.name, ())
+        ]
+    for theirs, who in claimed:
+        for ours in pod.host_ports:
+            if host_ports_conflict(ours, theirs):
+                return False, (
+                    f"host port {ours[0]}/{ours[1]} already in use by {who}"
+                )
+    return True, ""
+
+
+def resolve_volumes(snapshot, pod: PodSpec):
+    """Minimal volume awareness (upstream VolumeBinding / volume-zone
+    parity — the reference ran the full upstream default filter set,
+    reference pkg/register/register.go:10). Returns (constraining claims,
+    missing-claim error message | None). Enforced only when the backend
+    supplies PVC data (snapshot.pvcs is not None); volume-free pods cost
+    one tuple check."""
+    if not pod.pvc_names or snapshot.pvcs is None:
+        return (), None
+    resolved = []
+    for claim in pod.pvc_names:
+        pvc = snapshot.pvcs.get(f"{pod.namespace}/{claim}")
+        if pvc is None:
+            # Upstream VolumeBinding: the pod waits for the claim (a PVC
+            # watch event reactivates it) rather than scheduling blind.
+            return (), (
+                f"persistentvolumeclaim {pod.namespace}/{claim} not found"
+            )
+        if pvc.selected_node or pvc.zone:
+            resolved.append(pvc)
+    return tuple(resolved), None
+
+
+def node_fits_volumes(pvcs, ni) -> tuple[bool, str]:
+    """Per-node half of the volume filter: the node must (a) be the one the
+    volume binder pinned via ``volume.kubernetes.io/selected-node``, and
+    (b) sit in each zoned claim's ``topology.kubernetes.io/zone``."""
+    for pvc in pvcs:
+        if pvc.selected_node and pvc.selected_node != ni.name:
+            return False, (
+                f"claim {pvc.name} is bound to node {pvc.selected_node}"
+            )
+        if pvc.zone:
+            node_zone = (
+                ni.node.labels.get("topology.kubernetes.io/zone")
+                if ni.node is not None
+                else None
+            )
+            if node_zone != pvc.zone:
+                return False, (
+                    f"claim {pvc.name} is in zone {pvc.zone}; node is in "
+                    f"{node_zone or 'no zone'}"
+                )
+    return True, ""
+
+
 def node_fits_resources(
     ni,
     pod: PodSpec,
@@ -343,6 +439,13 @@ class YodaPreFilter(PreFilterPlugin):
         except LabelParseError as e:
             return Status.unresolvable(f"invalid tpu/* labels: {e}")
         state.write(REQUEST_KEY, RequestData(req))
+        pvcs, missing = resolve_volumes(snapshot, pod)
+        if missing is not None:
+            # Unresolvable in the upstream sense — no amount of retrying or
+            # EVICTING helps until the claim exists — but NOT permanent:
+            # the parked pool returns to active on any cluster event, so
+            # the PVC's watch event reactivates the pod.
+            return Status.unresolvable(missing)
         inter = spread = None
         pending = self.pending_fn() if self.pending_fn is not None else ()
         if (
@@ -358,13 +461,13 @@ class YodaPreFilter(PreFilterPlugin):
                 inter = None
         if pod.topology_spread:
             spread = SpreadEvaluator.build(snapshot, pod, pending=pending)
-        if inter is not None or spread is not None:
-            state.write(AFFINITY_KEY, AffinityData(inter, spread))
+        ports_by_node: dict[str, tuple] = {}
         if pending:
             # In-flight resource claims, deduped against the snapshot by
             # uid (bind events may have landed since the member was
             # recorded) — the NodeResourcesFit companion of the affinity
-            # pending feed.
+            # pending feed. hostPort claims ride along for the NodePorts
+            # check.
             seen = {
                 p.uid for ni in snapshot.infos() for p in ni.pods
             }
@@ -378,8 +481,17 @@ class YodaPreFilter(PreFilterPlugin):
                     m + p.memory_request,
                     n + 1,
                 )
+                if p.host_ports:
+                    ports_by_node[host] = (
+                        ports_by_node.get(host, ()) + p.host_ports
+                    )
             if by_node:
                 state.write(PENDING_RES_KEY, PendingResources(by_node))
+        if inter is not None or spread is not None or pvcs or ports_by_node:
+            state.write(
+                AFFINITY_KEY,
+                AffinityData(inter, spread, pvcs, ports_by_node or None),
+            )
         return Status.ok()
 
 
@@ -421,6 +533,11 @@ class YodaFilter(FilterPlugin):
                 return Status.unschedulable(f"node {node.name}: {why}")
         admitted, why = node_fits_resources(
             node, pod, get_pending_resources(state)
+        )
+        if not admitted:
+            return Status.unschedulable(f"node {node.name}: {why}")
+        admitted, why = node_fits_host_ports(
+            node, pod, aff.pending_ports if aff is not None else None
         )
         if not admitted:
             return Status.unschedulable(f"node {node.name}: {why}")

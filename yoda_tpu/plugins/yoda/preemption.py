@@ -66,6 +66,7 @@ from yoda_tpu.plugins.yoda.filter_plugin import (
     available_chips,
     get_affinity,
     get_request,
+    node_fits_host_ports,
     qualifying_chips,
 )
 from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
@@ -175,13 +176,25 @@ class TpuPreemption(PostFilterPlugin):
     ) -> bool:
         """Eviction can only ever help on nodes the preemptor could pass
         Filter on once capacity frees up — generation is immutable
-        (YodaFilter checks it before capacity, plugins/yoda/filter_plugin.py)
-        and so are cordon/taints within this cycle; without this guard
-        preemption would evict victims on nodes the pod can never land on."""
+        (YodaFilter checks it before capacity, plugins/yoda/filter_plugin.py),
+        so are cordon/taints within this cycle, and so are volume pins
+        (a claim's selected-node/zone never changes by evicting pods);
+        without this guard preemption would evict victims on nodes the pod
+        can never land on."""
         return (
             ni.tpu is not None
             and ni.tpu.generation_rank >= req.min_generation_rank
             and pod_admits_on(ni.node, pod)[0]
+            and (aff is None or aff.volumes_feasible(ni)[0])
+            # Conservative divergence from upstream: a hostPort conflict is
+            # NOT treated as curable — victim selection buys chips, and
+            # nothing guarantees the port holder joins the victim set, so
+            # attempting it risks an evict/retry loop that never clears the
+            # port. Such nodes are simply skipped (PARITY.md), in-flight
+            # Permit-parked port holders included.
+            and node_fits_host_ports(
+                ni, pod, aff.pending_ports if aff is not None else None
+            )[0]
             and (
                 aff is None
                 or aff.inter is None

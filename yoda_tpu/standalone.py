@@ -137,8 +137,11 @@ def build_stack(
         # re-added) re-open hosts. Binds already reactivate via the scheduler.
         # Namespace label changes can open pod-affinity namespaceSelector
         # scopes, so they reactivate parked pods too.
+        # PVC events too: a claim appearing (or its selected-node landing)
+        # reactivates pods parked on "persistentvolumeclaim not found".
         if (
-            event.kind in ("TpuNodeMetrics", "Node", "Namespace")
+            event.kind
+            in ("TpuNodeMetrics", "Node", "Namespace", "PersistentVolumeClaim")
             or event.type == "deleted"
         ):
             queue.move_all_to_active()
@@ -147,6 +150,13 @@ def build_stack(
         scheduler_name=config.scheduler_name,
         on_pod_pending=queue.add,
         on_change=on_change,
+        # In-process backends with a PVC surface (FakeCluster.put_pvc)
+        # always enforce the minimal volume filter. KubeCluster upgrades
+        # the flag at runtime via the "synced" sentinel its PVC watch
+        # emits after a successful LIST — so a cluster whose ClusterRole
+        # lacks the persistentvolumeclaims rule degrades to not-enforced
+        # instead of parking every PVC-referencing pod.
+        watches_pvcs=hasattr(cluster, "put_pvc"),
     )
 
     # Wire claims into our batch plugin now the informer exists, and expose

@@ -26,6 +26,7 @@ LEASE_KIND = "Lease"
 NODE_KIND = "Node"
 EVENT_KIND = "Event"
 NAMESPACE_KIND = "Namespace"
+PVC_KIND = "PersistentVolumeClaim"
 
 
 @dataclass
@@ -38,21 +39,21 @@ class _State:
     objects: dict[str, dict[str, dict]] = field(
         default_factory=lambda: {
             POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {},
-            EVENT_KIND: {}, NAMESPACE_KIND: {}
+            EVENT_KIND: {}, NAMESPACE_KIND: {}, PVC_KIND: {}
         }
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
         default_factory=lambda: {
             POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: [],
-            EVENT_KIND: [], NAMESPACE_KIND: []
+            EVENT_KIND: [], NAMESPACE_KIND: [], PVC_KIND: []
         }
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
         default_factory=lambda: {
             POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0,
-            EVENT_KIND: 0, NAMESPACE_KIND: 0
+            EVENT_KIND: 0, NAMESPACE_KIND: 0, PVC_KIND: 0
         }
     )
     uid_seq: int = 0
@@ -222,6 +223,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # Cluster-scoped Namespace objects: /api/v1/namespaces[/name]
                 name = rest[1] if len(rest) > 1 else None
                 return NAMESPACE_KIND, None, name, None
+            if rest[:1] == ["persistentvolumeclaims"]:
+                # Cluster-scoped LIST/WATCH (the scheduler's read path);
+                # claims themselves carry their namespace in metadata.
+                name = rest[1] if len(rest) > 1 else None
+                return PVC_KIND, None, name, None
             return None
         if len(parts) >= 3 and parts[0] == "apis":
             from yoda_tpu.api.types import GROUP, VERSION
@@ -245,7 +251,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _key(kind: str, namespace: str | None, obj_or_name) -> str:
-        if kind in (POD_KIND, LEASE_KIND, EVENT_KIND):  # namespaced kinds
+        if kind in (POD_KIND, LEASE_KIND, EVENT_KIND, PVC_KIND):  # namespaced
             if isinstance(obj_or_name, dict):
                 md = obj_or_name.get("metadata", {})
                 return f"{md.get('namespace', namespace or 'default')}/{md['name']}"
