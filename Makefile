@@ -28,6 +28,12 @@ demo:
 soak:
 	$(PY) tools/soak.py $(SOAK_ROUNDS)
 
+# Real-cluster smoke test: kind + docker + kubectl required (optional in
+# CI — runs where Docker exists). tools/kind-e2e.sh --keep to retain the
+# cluster for inspection.
+kind-e2e:
+	tools/kind-e2e.sh
+
 image:
 	docker build -t $(IMAGE):$(TAG) .
 

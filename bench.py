@@ -168,8 +168,9 @@ def _http_gang_scenario() -> dict:
     with resourceVersion resume — instead of the in-process FakeCluster.
     The p99 therefore includes every API round-trip a real cluster adds:
     pod-created watch delivery, pods/binding POSTs, and the bind events
-    flowing back. 51 gangs on an 8-slice v5p fleet; one member per host,
-    same assertions as the in-process scenario."""
+    flowing back. Same sampling convention as the headline scenario (101
+    gangs — below that the p99 index degenerates to the max) on an
+    8-slice v5p fleet; one member per host, same assertions."""
     import threading
 
     from yoda_tpu.agent import FakeTpuAgent
@@ -189,7 +190,7 @@ def _http_gang_scenario() -> dict:
     assert kc.wait_for_sync(30.0), "kube watch sync failed"
     stack = build_stack(cluster=kc, config=SchedulerConfig(mode="batch"))
     agent = FakeTpuAgent(kc)  # publishes CRs over HTTP
-    for s in range(4):
+    for s in range(FLEET_SLICES):
         agent.add_slice(f"v5p-{s}", generation="v5p", host_topology=(2, 2, 1))
     agent.publish_all()
 
@@ -240,7 +241,7 @@ def _http_gang_scenario() -> dict:
 
     try:
         run_gang("http-warmup", timeout_s=180.0)  # includes kernel compile
-        lats = sorted(run_gang(f"hg-{g}") for g in range(51))
+        lats = sorted(run_gang(f"hg-{g}") for g in range(GANGS))
         p99 = lats[min(int(len(lats) * 0.99), len(lats) - 1)]
         return {
             "gang_http_p99_ms": round(p99, 2),
@@ -539,16 +540,20 @@ def _pallas_probe() -> dict:
 def _agent_hw_probe() -> dict:
     """What the node agent's runtime reader (agent/runtime.py) reads off
     THIS host's real TPU — recorded per round as evidence of which values
-    are hardware-read vs spec-table (VERDICT r2 #4). Empty off-TPU."""
+    are hardware-read vs spec-table (VERDICT r2 #4). ``hbm_sources``
+    enumerates every HBM-counter source tried and what each returned
+    (VERDICT r3 #5) — on a TPU VM the first source yields real counters;
+    over a remote transport the enumeration IS the evidence. Empty
+    off-TPU."""
     try:
-        from yoda_tpu.agent.runtime import read_runtime
+        from yoda_tpu.agent.runtime import probe_hbm_sources, read_runtime
 
         r = read_runtime()
     except Exception:
         return {}
     if r is None:
         return {}
-    return {
+    out = {
         "agent_hw": {
             "device_kind": r.device_kind,
             "generation": r.generation,
@@ -558,6 +563,11 @@ def _agent_hw_probe() -> dict:
             "source": r.source,
         }
     }
+    try:
+        out["agent_hw"]["hbm_sources"] = probe_hbm_sources()
+    except Exception as e:  # pragma: no cover — probe must not kill bench
+        out["agent_hw"]["hbm_sources"] = [{"source": "probe", "status": str(e)}]
+    return out
 
 
 def run_bench() -> dict:

