@@ -34,9 +34,22 @@ echo "== apply CRD + RBAC + scheduler + agent"
 kubectl apply -f deploy/crd.yaml
 kubectl apply -f deploy/yoda-tpu-scheduler.yaml
 # kind nodes have no TPUs: the agent publishes spec-table CRs via
-# --allow-fake so the scheduling path is exercised end to end.
-sed 's/- --interval-s=10/- --interval-s=10\n            - --allow-fake/' \
-  deploy/yoda-tpu-agent.yaml | kubectl apply -f -
+# --allow-fake so the scheduling path is exercised end to end. Verify the
+# injection actually took (a renamed arg line must fail HERE, not 120 s
+# later as "no TpuNodeMetrics appeared").
+patched=$(sed 's/- --interval-s=10/- --interval-s=10\n            - --allow-fake/' \
+  deploy/yoda-tpu-agent.yaml)
+echo "$patched" | grep -q -- '--allow-fake' \
+  || { echo "failed to inject --allow-fake into the agent manifest" >&2; exit 1; }
+echo "$patched" | kubectl apply -f -
+
+# kind never pulls: the loaded node-local image must be used (":latest"
+# defaults imagePullPolicy to Always, which pulls from docker.io and
+# fails for this local-only image).
+kubectl -n kube-system patch deploy/yoda-tpu-scheduler --type=json -p \
+  '[{"op":"add","path":"/spec/template/spec/containers/0/imagePullPolicy","value":"IfNotPresent"}]'
+kubectl -n kube-system patch ds/yoda-tpu-agent --type=json -p \
+  '[{"op":"add","path":"/spec/template/spec/containers/0/imagePullPolicy","value":"IfNotPresent"}]'
 
 echo "== wait for scheduler + agent"
 kubectl -n kube-system rollout status deploy/yoda-tpu-scheduler --timeout=180s
@@ -64,13 +77,34 @@ until node=$(kubectl get pod tpu-test-pod -o jsonpath='{.spec.nodeName}') \
 done
 echo "== OK: tpu-test-pod bound to $node"
 
-echo "== schedule the gang example"
-kubectl apply -f example/test-gang.yaml
+echo "== schedule a plain 2-member gang"
+# NOT example/test-gang.yaml: that is a 2x2x1 TOPOLOGY gang needing four
+# ICI-grid hosts, and --allow-fake publishes standalone hosts (no slice)
+# — on kind it could never place. A plain gang exercises admission, the
+# Permit barrier, and atomic release on the fake hosts that DO exist.
+for i in 0 1; do
+  kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: e2e-gang-$i
+  labels:
+    tpu/gang: e2e
+    tpu/gang-size: "2"
+    tpu/chips: "1"
+spec:
+  schedulerName: yoda-tpu
+  containers:
+    - name: main
+      image: registry.k8s.io/pause:3.9
+EOF
+done
 deadline=$((SECONDS + 180))
-until [ "$(kubectl get pods -l tpu/gang -o jsonpath='{range .items[*]}{.spec.nodeName}{"\n"}{end}' | grep -c .)" -ge 4 ]; do
+until [ "$(kubectl get pods -l tpu/gang=e2e -o jsonpath='{range .items[*]}{.spec.nodeName}{"\n"}{end}' | grep -c .)" -ge 2 ]; do
   [ $SECONDS -lt $deadline ] || {
     echo "gang never fully bound" >&2
-    kubectl get pods -l tpu/gang -o wide >&2
+    kubectl get pods -l tpu/gang=e2e -o wide >&2
+    kubectl -n kube-system logs deploy/yoda-tpu-scheduler --tail=50 >&2
     exit 1
   }
   sleep 2

@@ -133,17 +133,17 @@ def probe_hbm_sources(devices_fn=probe_devices) -> list[dict]:
         )
     else:
         got = none = err = 0
-        sample = None
+        ok_sample = err_sample = None
         for d in devs:
             try:
                 stats = d.memory_stats()
             except Exception as e:  # noqa: BLE001 — transport-dependent
                 err += 1
-                sample = sample or f"{type(e).__name__}: {e}"
+                err_sample = err_sample or f"{type(e).__name__}: {e}"
                 continue
             if stats and stats.get("bytes_limit"):
                 got += 1
-                sample = sample or f"bytes_limit={stats['bytes_limit']}"
+                ok_sample = ok_sample or f"bytes_limit={stats['bytes_limit']}"
             else:
                 none += 1
         report.append(
@@ -151,9 +151,9 @@ def probe_hbm_sources(devices_fn=probe_devices) -> list[dict]:
                 "source": "pjrt.memory_stats",
                 "status": (
                     f"{got}/{len(devs)} devices exposed counters"
-                    f" ({sample})" if got
+                    f" ({ok_sample})" if got
                     else f"returned None on {none} device(s), raised on "
-                    f"{err} ({sample or 'transport exposes no stats'})"
+                    f"{err} ({err_sample or 'transport exposes no stats'})"
                 ),
             }
         )
