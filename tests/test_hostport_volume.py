@@ -251,3 +251,137 @@ class TestVolumeRoundtrip:
         pod = PodSpec("p", pvc_names=("data",))
         pvcs, missing = resolve_volumes(snap, pod)
         assert pvcs == () and missing is None
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestVolumeRestrictions:
+    """Upstream VolumeRestrictions parity: RWO single-node attachment and
+    ReadWriteOncePod exclusivity."""
+
+    def test_rwo_claim_forces_co_location(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(3):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(
+            K8sPvc("shared", access_modes=("ReadWriteOnce",))
+        )
+        stack.cluster.create_pod(
+            PodSpec("first", labels={"tpu/chips": "2"}, pvc_names=("shared",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        first = stack.cluster.get_pod("default/first")
+        assert first.node_name
+        stack.cluster.create_pod(
+            PodSpec("second", labels={"tpu/chips": "2"}, pvc_names=("shared",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        second = stack.cluster.get_pod("default/second")
+        assert second.node_name == first.node_name, (
+            "RWO claim must co-locate its users on the attachment node"
+        )
+
+    def test_rwop_claim_excludes_second_pod(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(
+            K8sPvc("solo", access_modes=("ReadWriteOncePod",))
+        )
+        stack.cluster.create_pod(
+            PodSpec("first", labels={"tpu/chips": "1"}, pvc_names=("solo",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/first").node_name
+        stack.cluster.create_pod(
+            PodSpec("second", labels={"tpu/chips": "1"}, pvc_names=("solo",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.cluster.get_pod("default/second").node_name is None
+        # The holder leaving reactivates the parked pod.
+        stack.cluster.delete_pod("default/first")
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/second").node_name
+
+    def test_rwx_claim_unconstrained(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(
+            K8sPvc("many", access_modes=("ReadWriteMany",))
+        )
+        # 2 x 8-chip pods: must SPREAD (one per host) — RWX never pins.
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p-{i}", labels={"tpu/chips": "8"}, pvc_names=("many",)
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 2
+
+    def test_access_modes_roundtrip(self, mode):
+        pvc = K8sPvc("d", access_modes=("ReadWriteOnce",))
+        assert K8sPvc.from_obj(pvc.to_obj()) == pvc
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestVolumeRestrictionsEdge:
+    def test_multi_mode_claim_with_shared_mode_unconstrained(self, mode):
+        # [RWO, RWX]: the bound PV may allow cross-node sharing — forcing
+        # co-location would park schedulable pods (review r4).
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(
+            K8sPvc(
+                "multi",
+                access_modes=("ReadWriteOnce", "ReadWriteMany"),
+            )
+        )
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p-{i}", labels={"tpu/chips": "8"}, pvc_names=("multi",)
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 2
+
+    def test_rwop_sees_permit_parked_gang_sibling(self, mode):
+        # A gang member reserved at Permit (invisible in NodeInfo.pods)
+        # already uses the RWOP claim: a foreign pod must NOT be admitted
+        # against it (review r4 — the pending feed covers volumes too).
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.put_pvc(
+            K8sPvc("solo", access_modes=("ReadWriteOncePod",))
+        )
+        # A 2-member gang whose FIRST member mounts the claim; the second
+        # member never arrives, so member 1 parks at Permit holding its
+        # reservation (and its claim use).
+        stack.cluster.create_pod(
+            PodSpec(
+                "g-0",
+                labels={
+                    "tpu/gang": "g", "tpu/gang-size": "2", "tpu/chips": "1",
+                },
+                pvc_names=("solo",),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.framework.waiting_pods(), "member should park at Permit"
+        stack.cluster.create_pod(
+            PodSpec("foreign", labels={"tpu/chips": "1"}, pvc_names=("solo",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.cluster.get_pod("default/foreign").node_name is None

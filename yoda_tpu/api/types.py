@@ -437,12 +437,18 @@ class K8sPvc:
     - ``zone``: the claim's ``topology.kubernetes.io/zone`` label (the
       minimal stand-in for the bound PV's node-affinity zone): nodes
       labeled with a DIFFERENT zone are rejected.
+    - ``access_modes``: ``spec.accessModes`` — the upstream
+      VolumeRestrictions inputs: a ``ReadWriteOnce`` claim already mounted
+      by pods on some node forces co-location there (single-node
+      attachment); ``ReadWriteOncePod`` additionally forbids any second
+      pod at all.
     """
 
     name: str
     namespace: str = "default"
     selected_node: str | None = None
     zone: str | None = None
+    access_modes: tuple[str, ...] = ()
 
     @property
     def key(self) -> str:
@@ -456,11 +462,14 @@ class K8sPvc:
             }
         if self.zone:
             md["labels"] = {"topology.kubernetes.io/zone": self.zone}
-        return {
+        out: dict[str, Any] = {
             "apiVersion": "v1",
             "kind": "PersistentVolumeClaim",
             "metadata": md,
         }
+        if self.access_modes:
+            out["spec"] = {"accessModes": list(self.access_modes)}
+        return out
 
     @classmethod
     def from_obj(cls, obj: Mapping[str, Any]) -> "K8sPvc":
@@ -472,6 +481,9 @@ class K8sPvc:
                 "volume.kubernetes.io/selected-node"
             ),
             zone=(md.get("labels") or {}).get("topology.kubernetes.io/zone"),
+            access_modes=tuple(
+                (obj.get("spec") or {}).get("accessModes") or ()
+            ),
         )
 
 

@@ -213,6 +213,12 @@ class InformerCache:
         with self._lock:
             return self._claimed_mib.get(node_name, 0)
 
+    def claimed_hbm_mib_map(self) -> dict[str, int]:
+        """One consistent copy under a single lock acquisition (see
+        ChipAccountant.chips_by_node — same per-dispatch N-call cost)."""
+        with self._lock:
+            return dict(self._claimed_mib)
+
     def pod_alive(self, pod: PodSpec) -> bool:
         """False once the watch saw the pod's deletion (by uid — a deleted
         and re-created pod has a fresh uid and is unaffected)."""

@@ -236,8 +236,15 @@ class FleetArrays:
         :meth:`with_dynamic`, packed so a scheduling cycle uploads a single
         array. ``host_ok`` carries the per-pod Node-object admission
         (cordon + taints vs THIS pod's tolerations); default: the static
-        cordon-only view."""
+        cordon-only view.
+
+        ``reserved_fn`` / ``claimed_fn`` may each be a per-node callable OR
+        a ``{node: value}`` Mapping — the mapping form lets callers take
+        ONE consistent snapshot of the accountant under one lock
+        (ChipAccountant.chips_by_node) instead of N locked calls per
+        dispatch, which dominates the kernel itself at large fleets."""
         import time as _time
+        from typing import Mapping as _Mapping
 
         n = self.node_valid.shape[0]
         dyn = np.zeros((4, n), dtype=np.int32)
@@ -247,15 +254,26 @@ class FleetArrays:
         else:
             dyn[0] = self.fresh
         if reserved_fn is not None:
-            for i, name in enumerate(self.names):
-                dyn[1, i] = reserved_fn(name)
+            if isinstance(reserved_fn, _Mapping):
+                get = reserved_fn.get
+                for i, name in enumerate(self.names):
+                    dyn[1, i] = get(name, 0)
+            else:
+                for i, name in enumerate(self.names):
+                    dyn[1, i] = reserved_fn(name)
         else:
             # No accounting: neutralize both reservation corrections (see
             # with_dynamic).
             dyn[1] = self._apparently_used()
+        cap = np.iinfo(np.int32).max
         if claimed_fn is not None:
-            for i, name in enumerate(self.names):
-                dyn[2, i] = min(claimed_fn(name), np.iinfo(np.int32).max)
+            if isinstance(claimed_fn, _Mapping):
+                get = claimed_fn.get
+                for i, name in enumerate(self.names):
+                    dyn[2, i] = min(get(name, 0), cap)
+            else:
+                for i, name in enumerate(self.names):
+                    dyn[2, i] = min(claimed_fn(name), cap)
         else:
             dyn[2] = self.claimed_hbm_mib
         dyn[3] = self.host_ok if host_ok is None else host_ok

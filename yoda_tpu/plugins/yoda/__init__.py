@@ -46,6 +46,7 @@ def default_plugins(
     kernel_backend: str = "xla",
     batch_requests: int = 1,
     pending_fn: Callable | None = None,
+    reserved_map_fn: Callable | None = None,
 ) -> list:
     """Assemble the standard plugin set.
 
@@ -73,6 +74,7 @@ def default_plugins(
                 kernel_backend=kernel_backend,
                 batch_requests=batch_requests,
                 pending_fn=pending_fn,
+                reserved_map_fn=reserved_map_fn,
             )
         )
     elif mode == "loop":

@@ -123,3 +123,11 @@ class ChipAccountant(ReservePlugin):
     def chips_in_use(self, node_name: str) -> int:
         with self._lock:
             return self._in_use.get(node_name, 0)
+
+    def chips_by_node(self) -> dict[str, int]:
+        """One consistent copy of the whole reservation map under a single
+        lock acquisition — the fleet-kernel dynamics build reads every
+        node per dispatch, and N locked ``chips_in_use`` calls would cost
+        more than the kernel itself at large fleets."""
+        with self._lock:
+            return dict(self._in_use)

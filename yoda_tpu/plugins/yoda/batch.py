@@ -227,6 +227,8 @@ class YodaBatch(BatchFilterScorePlugin):
         kernel_backend: str = "xla",
         batch_requests: int = 1,
         pending_fn: Callable[[], list] | None = None,
+        reserved_map_fn: "Callable[[], dict] | None" = None,
+        claimed_map_fn: "Callable[[], dict] | None" = None,
     ) -> None:
         if batch_requests < 1:
             raise ValueError(f"batch_requests must be >= 1, got {batch_requests}")
@@ -244,8 +246,22 @@ class YodaBatch(BatchFilterScorePlugin):
             )
         if mesh_devices is not None and mesh_devices < 1:
             raise ValueError(f"mesh_devices must be >= 1, got {mesh_devices}")
+        # Bulk-map sources are an OPTIONAL acceleration of the per-node
+        # fns (one lock acquisition per dispatch instead of N locked calls
+        # — ChipAccountant.chips_by_node / InformerCache.
+        # claimed_hbm_mib_map), used only for the dynamics build
+        # (_dyn_sources). Every OTHER consumer — static-cache keying,
+        # burst gating, gang-plan and burst spot-checks (O(1) single-node
+        # reads) — keys off the per-node fns, so a map without its fn
+        # would silently disable those paths: refuse it.
+        if reserved_map_fn is not None and reserved_fn is None:
+            raise ValueError("reserved_map_fn requires reserved_fn")
+        if claimed_map_fn is not None and claimed_fn is None:
+            raise ValueError("claimed_map_fn requires claimed_fn")
         self.reserved_fn = reserved_fn
         self.claimed_fn = claimed_fn
+        self.reserved_map_fn = reserved_map_fn
+        self.claimed_map_fn = claimed_map_fn
         self.weights = weights or Weights()
         self.max_metrics_age_s = max_metrics_age_s
         self.platform = platform
@@ -349,6 +365,14 @@ class YodaBatch(BatchFilterScorePlugin):
             )
         return self._floor_ms
 
+    def _dyn_sources(self) -> tuple:
+        """(reserved, claimed) inputs for FleetArrays.dyn_packed: the bulk
+        map snapshot when wired, else the per-node callable."""
+        return (
+            self.reserved_map_fn() if self.reserved_map_fn else self.reserved_fn,
+            self.claimed_map_fn() if self.claimed_map_fn else self.claimed_fn,
+        )
+
     def _fleet_version(self, snapshot: Snapshot) -> int:
         """The cache key for fleet-static state: the metrics version when
         the informer provides one AND claims are supplied dynamically (pod
@@ -407,9 +431,10 @@ class YodaBatch(BatchFilterScorePlugin):
         # metrics bump, and Node-object admission (cordon + taints +
         # inter-pod affinity/spread + resource fit + host ports + volume
         # pins vs THIS pod) is per (pod, cycle): one packed upload.
+        reserved_src, claimed_src = self._dyn_sources()
         dyn = static.dyn_packed(
-            self.reserved_fn,
-            self.claimed_fn,
+            reserved_src,
+            claimed_src,
             max_metrics_age_s=self.max_metrics_age_s,
             host_ok=_host_admission(static, snapshot, pod, aff, pending_res),
         )
@@ -582,9 +607,10 @@ class YodaBatch(BatchFilterScorePlugin):
         static = self._refresh_static(snapshot)
         if not hasattr(self._kern, "evaluate_burst"):
             return
+        reserved_src, claimed_src = self._dyn_sources()
         dyn = static.dyn_packed(
-            self.reserved_fn,
-            self.claimed_fn,
+            reserved_src,
+            claimed_src,
             max_metrics_age_s=self.max_metrics_age_s,
         )
         k = self.batch_requests

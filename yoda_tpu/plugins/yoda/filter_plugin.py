@@ -76,8 +76,10 @@ class AffinityData:
 
     inter: InterPodEvaluator | None = None
     spread: SpreadEvaluator | None = None
-    # Resolved K8sPvc objects with a selected_node or zone constraint
-    # (resolve_volumes) — the minimal VolumeBinding/volume-zone parity.
+    # ResolvedClaim tuples (resolve_volumes): each carries the claim's
+    # static pins (selected-node annotation, zone label) plus the dynamic
+    # RWO attachment constraint (allowed_nodes) — the minimal
+    # VolumeBinding / volume-zone / VolumeRestrictions parity.
     pvcs: tuple = ()
     # node -> hostPort triples held by in-flight placements (gang members
     # reserved at Permit — invisible in NodeInfo.pods until bound). None
@@ -301,16 +303,45 @@ def node_fits_host_ports(
     return True, ""
 
 
-def resolve_volumes(snapshot, pod: PodSpec):
-    """Minimal volume awareness (upstream VolumeBinding / volume-zone
-    parity — the reference ran the full upstream default filter set,
-    reference pkg/register/register.go:10). Returns (constraining claims,
-    missing-claim error message | None). Enforced only when the backend
-    supplies PVC data (snapshot.pvcs is not None); volume-free pods cost
-    one tuple check."""
+@dataclass(frozen=True)
+class ResolvedClaim:
+    """One constraint-carrying claim after per-cycle resolution: the PVC's
+    static pins (selected-node annotation, zone label) plus the dynamic
+    attachment constraint from upstream VolumeRestrictions — a
+    ``ReadWriteOnce`` claim mounted by running pods attaches to one node,
+    so a new pod using it must co-locate (``allowed_nodes``)."""
+
+    pvc: object                              # K8sPvc
+    allowed_nodes: frozenset | None = None   # None = unconstrained
+
+
+def _claim_restricts(modes: tuple) -> bool:
+    """Does this claim's accessModes set force single-node attachment?
+    RWOP always; RWO only when no shared mode is also offered — a
+    multi-mode claim ([RWO, RWX]) may be bound to an RWX-capable PV, and
+    forcing co-location there would park schedulable pods (review r4)."""
+    if "ReadWriteOncePod" in modes:
+        return True
+    return "ReadWriteOnce" in modes and not (
+        {"ReadWriteMany", "ReadOnlyMany"} & set(modes)
+    )
+
+
+def resolve_volumes(snapshot, pod: PodSpec, pending=()):
+    """Minimal volume awareness (upstream VolumeBinding / volume-zone /
+    VolumeRestrictions parity — the reference ran the full upstream
+    default filter set, reference pkg/register/register.go:10). Returns
+    (constraining ResolvedClaims, error message | None): the error is a
+    missing claim (wait for the PVC event) or a ReadWriteOncePod claim
+    already in use (wait for the holder to go away). ``pending`` — the
+    (host, pod) placements parked at Permit — counts like bound pods, so
+    an in-flight sibling's claim use is visible before its bind event
+    lands. Enforced only when the backend supplies PVC data
+    (snapshot.pvcs is not None); volume-free pods cost one tuple check."""
     if not pod.pvc_names or snapshot.pvcs is None:
         return (), None
     resolved = []
+    users_by_claim: dict[str, set] | None = None
     for claim in pod.pvc_names:
         pvc = snapshot.pvcs.get(f"{pod.namespace}/{claim}")
         if pvc is None:
@@ -319,16 +350,55 @@ def resolve_volumes(snapshot, pod: PodSpec):
             return (), (
                 f"persistentvolumeclaim {pod.namespace}/{claim} not found"
             )
-        if pvc.selected_node or pvc.zone:
-            resolved.append(pvc)
+        allowed = None
+        if _claim_restricts(pvc.access_modes):
+            if users_by_claim is None:
+                # One walk for ALL of the pod's claims: which nodes
+                # currently mount each of them — bound pods plus
+                # reserved-but-unbound placements, deduped by uid
+                # (upstream VolumeRestrictions reads the same attachment
+                # state).
+                users_by_claim = {c: set() for c in pod.pvc_names}
+                seen_uids: set[str] = set()
+                for ni in snapshot.infos():
+                    for p in ni.pods:
+                        seen_uids.add(p.uid)
+                        if p.namespace != pod.namespace or p.uid == pod.uid:
+                            continue
+                        for c in p.pvc_names:
+                            if c in users_by_claim:
+                                users_by_claim[c].add(ni.name)
+                for host, p in pending:
+                    if (
+                        p.uid in seen_uids
+                        or p.uid == pod.uid
+                        or p.namespace != pod.namespace
+                    ):
+                        continue
+                    for c in p.pvc_names:
+                        if c in users_by_claim:
+                            users_by_claim[c].add(host)
+            mounted_on = users_by_claim[claim]
+            if mounted_on:
+                if "ReadWriteOncePod" in pvc.access_modes:
+                    return (), (
+                        f"claim {claim} is ReadWriteOncePod and already "
+                        "in use by another pod"
+                    )
+                # RWO: single-node attachment — must co-locate.
+                allowed = frozenset(mounted_on)
+        if pvc.selected_node or pvc.zone or allowed is not None:
+            resolved.append(ResolvedClaim(pvc, allowed))
     return tuple(resolved), None
 
 
 def node_fits_volumes(pvcs, ni) -> tuple[bool, str]:
     """Per-node half of the volume filter: the node must (a) be the one the
-    volume binder pinned via ``volume.kubernetes.io/selected-node``, and
-    (b) sit in each zoned claim's ``topology.kubernetes.io/zone``."""
-    for pvc in pvcs:
+    volume binder pinned via ``volume.kubernetes.io/selected-node``,
+    (b) sit in each zoned claim's ``topology.kubernetes.io/zone``, and
+    (c) for an attached ReadWriteOnce claim, be where it is mounted."""
+    for rc in pvcs:
+        pvc = rc.pvc
         if pvc.selected_node and pvc.selected_node != ni.name:
             return False, (
                 f"claim {pvc.name} is bound to node {pvc.selected_node}"
@@ -344,6 +414,11 @@ def node_fits_volumes(pvcs, ni) -> tuple[bool, str]:
                     f"claim {pvc.name} is in zone {pvc.zone}; node is in "
                     f"{node_zone or 'no zone'}"
                 )
+        if rc.allowed_nodes is not None and ni.name not in rc.allowed_nodes:
+            return False, (
+                f"ReadWriteOnce claim {pvc.name} is attached to "
+                f"{sorted(rc.allowed_nodes)}; pod must co-locate"
+            )
     return True, ""
 
 
@@ -439,7 +514,8 @@ class YodaPreFilter(PreFilterPlugin):
         except LabelParseError as e:
             return Status.unresolvable(f"invalid tpu/* labels: {e}")
         state.write(REQUEST_KEY, RequestData(req))
-        pvcs, missing = resolve_volumes(snapshot, pod)
+        pending = self.pending_fn() if self.pending_fn is not None else ()
+        pvcs, missing = resolve_volumes(snapshot, pod, pending)
         if missing is not None:
             # Unresolvable in the upstream sense — no amount of retrying or
             # EVICTING helps until the claim exists — but NOT permanent:
@@ -447,7 +523,6 @@ class YodaPreFilter(PreFilterPlugin):
             # the PVC's watch event reactivates the pod.
             return Status.unresolvable(missing)
         inter = spread = None
-        pending = self.pending_fn() if self.pending_fn is not None else ()
         if (
             pod_has_inter_pod_terms(pod)
             or self._fleet_has_terms(snapshot)

@@ -96,6 +96,8 @@ def build_stack(
         # Gang members parked at Permit stay visible to the inter-pod
         # affinity/spread evaluators (api.affinity pending support).
         pending_fn=gang.pending_placements,
+        # Bulk accountant read: one lock per dispatch, not N.
+        reserved_map_fn=accountant.chips_by_node,
     )
     plugins.append(gang)
     plugins.append(accountant)
@@ -169,6 +171,7 @@ def build_stack(
     for p in batches:
         if p.claimed_fn is None:
             p.claimed_fn = informer.claimed_hbm_mib
+            p.claimed_map_fn = informer.claimed_hbm_mib_map
     if batches:
         # Accumulator pattern so a SHARED metrics registry (profiles)
         # registers each family once and sums over every stack's plugins.
