@@ -41,14 +41,19 @@ def main(rounds: int = 1) -> int:
     for r in range(rounds):
         seeds = rng.sample(range(100, 1_000_000), 5)
         for s in seeds[:3]:
-            mod.test_serve_forever_under_churn_and_gang_contention(s, None)
+            mod.test_serve_forever_under_churn_and_gang_contention(s, None, 1)
             print(f"round {r}: gang-contention seed {s}: OK", flush=True)
         for s in seeds[3:]:
             mod.test_serve_forever_with_node_constraints(seed=s)
             print(f"round {r}: constraint-fleet seed {s}: OK", flush=True)
         mesh_seed = rng.randrange(100, 1_000_000)
-        mod.test_serve_forever_under_churn_and_gang_contention(mesh_seed, 8)
+        mod.test_serve_forever_under_churn_and_gang_contention(mesh_seed, 8, 1)
         print(f"round {r}: mesh-sharded seed {mesh_seed}: OK", flush=True)
+        burst_seed = rng.randrange(100, 1_000_000)
+        mod.test_serve_forever_under_churn_and_gang_contention(
+            burst_seed, None, 16
+        )
+        print(f"round {r}: burst-dispatch seed {burst_seed}: OK", flush=True)
     print("SOAK_PASS")
     return 0
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Iterable, Mapping, Protocol, Sequence
+from typing import TYPE_CHECKING, Mapping, Protocol, Sequence
 
 from yoda_tpu.api.types import K8sNode, PodSpec, TpuNodeMetrics
 
@@ -249,10 +249,6 @@ class BindPlugin(Plugin):
         raise NotImplementedError
 
 
-def feasible_nodes(statuses: Mapping[str, Status]) -> list[str]:
-    return sorted(n for n, s in statuses.items() if s.success)
-
-
 def summarize_failure(statuses: Mapping[str, Status]) -> str:
     """Aggregate per-node failure messages like the upstream fitError text."""
     counts: dict[str, int] = {}
@@ -261,7 +257,3 @@ def summarize_failure(statuses: Mapping[str, Status]) -> str:
             counts[s.message or s.code.value] = counts.get(s.message or s.code.value, 0) + 1
     parts = [f"{n} node(s): {msg}" for msg, n in sorted(counts.items(), key=lambda kv: -kv[1])]
     return "; ".join(parts) if parts else "no nodes available"
-
-
-def iter_plugins(plugins: Iterable[Plugin], cls: type) -> list[Plugin]:
-    return [p for p in plugins if isinstance(p, cls)]
