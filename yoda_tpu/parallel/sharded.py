@@ -28,6 +28,7 @@ from yoda_tpu.ops.kernel import (
     arrays_dict,
     kernel_impl,
     kernel_packed,
+    kernel_packed_burst,
     pack_request,
     result_from_outputs,
     result_from_packed,
@@ -144,6 +145,20 @@ class ShardedDeviceFleetKernel:
             in_shardings=(self._static_shardings, packed, rep),
             out_shardings=packed,
         )
+        # K-request burst (ops/kernel.kernel_packed_burst): the request
+        # axis is vmapped and REPLICATED; the node axis stays sharded, so
+        # each device evaluates all K requests over its row shard and the
+        # same ICI collectives close the global reductions per request.
+        self._jitted_burst = jax.jit(
+            functools.partial(kernel_packed_burst, weights=self.weights),
+            in_shardings=(
+                self._static_shardings,
+                packed,                                    # dyn [4, N]
+                NamedSharding(self.mesh, P(None, FLEET_AXIS)),  # host_ok [K, N]
+                rep,                                       # reqs [K, 5]
+            ),
+            out_shardings=NamedSharding(self.mesh, P(None, None, FLEET_AXIS)),
+        )
         self._static: dict | None = None
         self._names: list[str] = []
 
@@ -169,6 +184,33 @@ class ShardedDeviceFleetKernel:
         reqv = jax.device_put(pack_request(request), self._rep)
         packed = self._jitted(self._static, dyn_d, reqv)
         return result_from_packed(self._names, np.asarray(packed))
+
+    def evaluate_burst(
+        self,
+        dyn: np.ndarray,            # [4, N] int32 (row 3 unused)
+        host_ok_k: np.ndarray,      # [K, N] per-pod admission
+        requests: "list[KernelRequest]",
+    ) -> list[KernelResult]:
+        """K requests in one sharded dispatch — the multi-pod burst
+        (plugins/yoda/batch.py prepare_burst) composed with the mesh:
+        ``mesh_devices`` and ``batch_requests`` work together."""
+        if self._static is None:
+            raise RuntimeError("put_static() must run before evaluate_burst()")
+        dyn_d = jax.device_put(dyn, self._dyn_sharding)
+        host_d = jax.device_put(
+            host_ok_k.astype(np.int32),
+            NamedSharding(self.mesh, P(None, FLEET_AXIS)),
+        )
+        reqs_d = jax.device_put(
+            np.stack([pack_request(r) for r in requests]), self._rep
+        )
+        packed = np.asarray(
+            self._jitted_burst(self._static, dyn_d, host_d, reqs_d)
+        )
+        return [
+            result_from_packed(self._names, packed[k])
+            for k in range(len(requests))
+        ]
 
 
 def sharded_filter_score(

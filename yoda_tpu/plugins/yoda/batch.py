@@ -568,7 +568,8 @@ class YodaBatch(BatchFilterScorePlugin):
         preconditions for cheap, safe serving don't hold: no accounting
         (spot-checks impossible), uncacheable snapshot, in-flight gang
         placements or fleet-wide inter-pod terms (per-pod evaluators would
-        be required), or a kernel without a burst path (mesh/pallas)."""
+        be required), or a kernel without a burst path (pallas; the
+        mesh-sharded kernel HAS one — parallel.sharded.evaluate_burst)."""
         self._burst = None
         if (
             self.batch_requests <= 1
