@@ -186,7 +186,7 @@ class TestBurstDispatch:
         # 2 burst pods + 1 foreign claim = 6 chips on the 8-chip host.
         assert stack.accountant.chips_in_use("v5e-0") == 6
 
-    def test_metrics_republish_invalidates_burst(self):
+    def test_metrics_value_change_invalidates_burst(self):
         stack, agent = make_stack(batch_requests=8)
         fleet(agent, hosts=2)
         yb = batch_plugin(stack)
@@ -197,11 +197,38 @@ class TestBurstDispatch:
             stack.cluster.create_pod(p)
         stack.framework.prepare_burst(pods, stack.informer.snapshot())
         assert yb._burst is not None
-        agent.publish_all()  # metrics version bump
+        # A VALUE change (chip health flip) bumps the metrics version:
+        # every cached row is stale and must re-dispatch.
+        agent.set_chip_health("v5e-0", 0, False)
+        agent.publish_all()
         while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
             stack.scheduler.schedule_one(q)
         assert all(p.node_name for p in stack.cluster.list_pods())
         assert yb.burst_invalidated >= 1
+
+    def test_heartbeat_republish_keeps_burst(self):
+        # A timestamp-only republish (the agents' steady-state heartbeat)
+        # must NOT invalidate the burst — the whole point of the
+        # no-op-event elision (the churn storm: every heartbeat used to
+        # drop every cached row and re-dispatch the full queue).
+        stack, agent = make_stack(batch_requests=8)
+        fleet(agent, hosts=2)
+        yb = batch_plugin(stack)
+        pods = [
+            PodSpec(f"p-{i}", labels={"tpu/chips": "1"}) for i in range(2)
+        ]
+        for p in pods:
+            stack.cluster.create_pod(p)
+        stack.framework.prepare_burst(pods, stack.informer.snapshot())
+        assert yb._burst is not None
+        mv0 = stack.informer.metrics_version
+        agent.publish_all()  # unchanged values: heartbeat
+        assert stack.informer.metrics_version == mv0
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        assert all(p.node_name for p in stack.cluster.list_pods())
+        assert yb.burst_invalidated == 0
+        assert yb.burst_served == 2
 
 
 class TestBurstConfig:
@@ -228,3 +255,29 @@ class TestBurstConfig:
         assert all(p.node_name for p in stack.cluster.list_pods())
         assert yb.burst_dispatches == 0
         assert yb.dispatch_count == 4
+
+
+class TestBurstFreshness:
+    def test_stale_node_not_served_from_burst(self):
+        # Heartbeat elision means a dead agent no longer invalidates the
+        # burst incidentally — the serve-time freshness spot-check must
+        # catch it instead (review r4).
+        import time as _time
+
+        stack, agent = make_stack(batch_requests=8, max_metrics_age_s=0.2)
+        fleet(agent, hosts=1)
+        yb = batch_plugin(stack)
+        pods = [
+            PodSpec(f"p-{i}", labels={"tpu/chips": "1"}) for i in range(2)
+        ]
+        for p in pods:
+            stack.cluster.create_pod(p)
+        stack.framework.prepare_burst(pods, stack.informer.snapshot())
+        assert yb._burst is not None
+        _time.sleep(0.3)  # the only agent dies; metrics now stale
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        assert all(
+            p.node_name is None for p in stack.cluster.list_pods()
+        ), "pod bound via a stale burst row"
+        assert yb.burst_invalidated >= 1

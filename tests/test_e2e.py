@@ -148,6 +148,38 @@ class TestAccountingMetricsHandoff:
         stack.cluster.create_pod(PodSpec("late-pod", labels={"tpu/chips": "1"}))
         stack.scheduler.run_until_idle(max_wall_s=1)
         assert stack.cluster.get_pod("default/late-pod").node_name is None
+        # The stale node's refresh is a RELEVANT heartbeat (its publish
+        # gap exceeded the threshold): it reactivates the parked pod,
+        # which now binds against the fresh timestamp.
+        agent.publish_all()
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/late-pod").node_name == "host"
+
+    def test_heartbeats_keep_node_fresh_without_version_bumps(self, mode):
+        # Timestamp-only heartbeats don't bump the metrics version (no
+        # array rebuilds, no burst drops, no reactivation storms) — but
+        # freshness must still be read LIVE, or the cached arrays' baked
+        # timestamps would age a healthy, on-time node into staleness.
+        import time as _time
+
+        stack, agent = make_stack(mode, max_metrics_age_s=0.4)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        agent.publish_all()  # reflects warm's usage: a real value change
+        mv0 = stack.informer.metrics_version
+        for _ in range(4):
+            _time.sleep(0.15)
+            agent.publish_all()  # on-time heartbeats, values unchanged
+        # 0.6 s elapsed > max age: only the live timestamps kept the node
+        # fresh — a probe pod binds, with zero metrics-version bumps
+        # across the heartbeat window (no array rebuilds, no burst drops,
+        # no reactivation storms).
+        assert stack.informer.metrics_version == mv0
+        stack.cluster.create_pod(PodSpec("probe", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/probe").node_name == "host"
 
 
 class TestForeignPods:

@@ -166,6 +166,49 @@ class TestQueue:
         qpi.attempts = 50
         assert qpi.backoff_seconds() == 10.0  # capped
 
+    def test_chronic_pods_respect_backoff_on_events(self):
+        # Beyond IMMEDIATE_RETRY_ATTEMPTS, cluster events must not
+        # hot-loop a chronically unschedulable pod: its backoff timer
+        # holds no matter how many events fire (upstream
+        # moveAllToActiveOrBackoffQueue semantics; the r4 churn storm).
+        from yoda_tpu.framework.queue import IMMEDIATE_RETRY_ATTEMPTS
+
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0])
+        q.add(PodSpec("a"))
+        qpi = q.pop(timeout=0)
+        qpi.attempts = IMMEDIATE_RETRY_ATTEMPTS + 1
+        q.add_unschedulable(qpi, "nope")
+        for _ in range(50):  # an event storm
+            q.move_all_to_active()
+        assert q.pop(timeout=0) is None, "chronic pod hot-looped"
+        now[0] += qpi.backoff_seconds() + 0.01
+        assert q.pop(timeout=0).pod.name == "a"  # timer still honored
+
+    def test_chronic_unresolvable_pod_throttles_but_retries(self):
+        from yoda_tpu.framework.queue import IMMEDIATE_RETRY_ATTEMPTS
+
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0])
+        q.add(PodSpec("a"))
+        qpi = q.pop(timeout=0)
+        qpi.attempts = IMMEDIATE_RETRY_ATTEMPTS + 1
+        q.park_unresolvable(qpi, "no claim")
+        q.move_all_to_active()          # leaves the pool -> backoff heap
+        q.move_all_to_active()          # a later event must NOT reset it
+        assert q.pop(timeout=0) is None
+        now[0] += qpi.backoff_seconds() + 0.01
+        assert q.pop(timeout=0).pod.name == "a"
+
+    def test_young_pods_still_reactivate_immediately(self):
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0])
+        q.add(PodSpec("a"))
+        qpi = q.pop(timeout=0)  # attempts = 1
+        q.add_unschedulable(qpi, "nope")
+        q.move_all_to_active()
+        assert q.pop(timeout=0).pod.name == "a"
+
 
 def build(plugins, nodes):
     fw = Framework(plugins)

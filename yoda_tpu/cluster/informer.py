@@ -33,6 +33,7 @@ class InformerCache:
         on_pod_pending: Callable[[PodSpec], None] | None = None,
         on_change: Callable[[Event], None] | None = None,
         watches_pvcs: bool = False,
+        staleness_s: float = 0.0,
     ) -> None:
         self.scheduler_name = scheduler_name
         self.on_pod_pending = on_pod_pending
@@ -42,6 +43,11 @@ class InformerCache:
         # wait), while False means "no PVC data" (volume constraints are
         # not enforced — snapshot.pvcs stays None).
         self.watches_pvcs = watches_pvcs
+        # The scheduler's max_metrics_age_s, used ONLY to classify
+        # timestamp-only republishes: a node whose publish GAP exceeded
+        # this had gone stale, so its refresh changes schedulability and
+        # must reactivate parked pods; an on-time heartbeat does not.
+        self.staleness_s = staleness_s
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
@@ -76,8 +82,9 @@ class InformerCache:
     # --- watch sink ---
 
     def handle(self, event: Event) -> None:
+        relevant = True
         if event.kind == "TpuNodeMetrics":
-            self._handle_tpu(event)
+            relevant = self._handle_tpu(event)
         elif event.kind == "Pod":
             self._handle_pod(event)
         elif event.kind == "Node":
@@ -86,7 +93,12 @@ class InformerCache:
             self._handle_namespace(event)
         elif event.kind == "PersistentVolumeClaim":
             self._handle_pvc(event)
-        if self.on_change is not None:
+        # Timestamp-only heartbeats are NOT propagated as cluster changes
+        # (upstream's queueing-hint discipline): on a fleet of agents
+        # republishing unchanged metrics every few seconds, reactivating
+        # every parked pod per heartbeat is a retry storm that burns a
+        # full-queue dispatch sweep per event for zero new information.
+        if relevant and self.on_change is not None:
             self.on_change(event)
 
     def _handle_pvc(self, event: Event) -> None:
@@ -137,16 +149,33 @@ class InformerCache:
                 self._metrics_version += 1
             self._snapshot_cache = None
 
-    def _handle_tpu(self, event: Event) -> None:
+    def _handle_tpu(self, event: Event) -> bool:
+        """Returns whether the event carries schedulability-relevant change.
+        A value-identical republish (the agents' steady-state heartbeat)
+        refreshes the stored timestamp and the snapshot, but does NOT bump
+        ``metrics_version`` — the fleet arrays, burst sets, and parked-pod
+        reactivation all key off that, and rebuilding them per heartbeat
+        is pure waste (freshness flows live via :meth:`last_updated_map`).
+        Exception: a node whose publish gap exceeded ``staleness_s`` had
+        gone STALE — its refresh changes feasibility and counts as a real
+        change."""
         tpu: TpuNodeMetrics = event.obj  # type: ignore[assignment]
         with self._lock:
             if event.type == "deleted":
                 self._tpus.pop(tpu.name, None)
+                relevant = True
             else:
+                prev = self._tpus.get(tpu.name)
                 self._tpus[tpu.name] = tpu
+                relevant = prev is None or not _tpu_values_equal(prev, tpu)
+                if not relevant and self.staleness_s > 0:
+                    gap = tpu.last_updated_unix - prev.last_updated_unix
+                    relevant = gap > self.staleness_s  # was stale: now fresh
             self._version += 1
-            self._metrics_version += 1
+            if relevant:
+                self._metrics_version += 1
             self._snapshot_cache = None
+        return relevant
 
     def _handle_pod(self, event: Event) -> None:
         pod: PodSpec = event.obj  # type: ignore[assignment]
@@ -219,6 +248,17 @@ class InformerCache:
         with self._lock:
             return dict(self._claimed_mib)
 
+    def last_updated_map(self) -> dict[str, float]:
+        """Live per-node metric timestamps — the freshness source for the
+        fused kernel's dynamics row. Must be read per dispatch (not baked
+        into the metrics-version-cached arrays): timestamp-only heartbeats
+        deliberately do NOT bump the metrics version, so cached arrays
+        carry stale timestamps while these stay current."""
+        with self._lock:
+            return {
+                name: t.last_updated_unix for name, t in self._tpus.items()
+            }
+
     def pod_alive(self, pod: PodSpec) -> bool:
         """False once the watch saw the pod's deletion (by uid — a deleted
         and re-created pod has a fresh uid and is unaffected)."""
@@ -272,6 +312,19 @@ class InformerCache:
             snap.metrics_version = self._metrics_version
             self._snapshot_cache = snap
             return snap
+
+
+def _tpu_values_equal(a: TpuNodeMetrics, b: TpuNodeMetrics) -> bool:
+    """Value equality on every schedulability-relevant field — everything
+    except the publish timestamp and resource version. Derived from the
+    dataclass itself so a FUTURE TpuNodeMetrics field defaults to
+    RELEVANT (a hand-kept field list would silently classify its changes
+    as heartbeats and never rebuild anything)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        a, last_updated_unix=0.0, resource_version=0
+    ) == dataclasses.replace(b, last_updated_unix=0.0, resource_version=0)
 
 
 def _pod_claim_mib(pod: PodSpec) -> int:

@@ -229,6 +229,7 @@ class FleetArrays:
         max_metrics_age_s: float = 0.0,
         now: float | None = None,
         host_ok: np.ndarray | None = None,
+        last_updated: "Mapping[str, float] | None" = None,
     ) -> np.ndarray:
         """The per-cycle node vectors as ONE [4, N] int32 array (rows =
         ops.kernel.DYN_KEYS: fresh, reserved_chips, claimed_hbm_mib,
@@ -250,7 +251,21 @@ class FleetArrays:
         dyn = np.zeros((4, n), dtype=np.int32)
         if max_metrics_age_s > 0:
             now = _time.time() if now is None else now
-            dyn[0] = (now - self.last_updated) <= max_metrics_age_s
+            if last_updated is not None:
+                # Live timestamps (InformerCache.last_updated_map): the
+                # baked self.last_updated goes stale when heartbeat
+                # republishes deliberately skip the metrics-version bump.
+                # One vectorized compare — no per-node scalar stores.
+                get = last_updated.get
+                n_real = len(self.names)
+                ts = np.fromiter(
+                    (get(name, 0.0) for name in self.names),
+                    np.float64,
+                    n_real,
+                )
+                dyn[0, :n_real] = (now - ts) <= max_metrics_age_s
+            else:
+                dyn[0] = (now - self.last_updated) <= max_metrics_age_s
         else:
             dyn[0] = self.fresh
         if reserved_fn is not None:

@@ -229,6 +229,7 @@ class YodaBatch(BatchFilterScorePlugin):
         pending_fn: Callable[[], list] | None = None,
         reserved_map_fn: "Callable[[], dict] | None" = None,
         claimed_map_fn: "Callable[[], dict] | None" = None,
+        last_updated_map_fn: "Callable[[], dict] | None" = None,
     ) -> None:
         if batch_requests < 1:
             raise ValueError(f"batch_requests must be >= 1, got {batch_requests}")
@@ -262,6 +263,11 @@ class YodaBatch(BatchFilterScorePlugin):
         self.claimed_fn = claimed_fn
         self.reserved_map_fn = reserved_map_fn
         self.claimed_map_fn = claimed_map_fn
+        # Live metric timestamps for the freshness row: REQUIRED when the
+        # informer elides metrics-version bumps for heartbeat republishes
+        # (InformerCache.last_updated_map) — the cached arrays' baked
+        # timestamps then age while the real metrics stay fresh.
+        self.last_updated_map_fn = last_updated_map_fn
         self.weights = weights or Weights()
         self.max_metrics_age_s = max_metrics_age_s
         self.platform = platform
@@ -373,6 +379,13 @@ class YodaBatch(BatchFilterScorePlugin):
             self.claimed_map_fn() if self.claimed_map_fn else self.claimed_fn,
         )
 
+    def _live_timestamps(self) -> "dict | None":
+        """Per-dispatch metric timestamps for the freshness row, when a
+        staleness gate is active and the informer provides them."""
+        if self.max_metrics_age_s > 0 and self.last_updated_map_fn is not None:
+            return self.last_updated_map_fn()
+        return None
+
     def _fleet_version(self, snapshot: Snapshot) -> int:
         """The cache key for fleet-static state: the metrics version when
         the informer provides one AND claims are supplied dynamically (pod
@@ -437,6 +450,7 @@ class YodaBatch(BatchFilterScorePlugin):
             claimed_src,
             max_metrics_age_s=self.max_metrics_age_s,
             host_ok=_host_admission(static, snapshot, pod, aff, pending_res),
+            last_updated=self._live_timestamps(),
         )
         result = self._kern.evaluate(dyn, reqk)
         self.dispatch_count += 1
@@ -613,6 +627,7 @@ class YodaBatch(BatchFilterScorePlugin):
             reserved_src,
             claimed_src,
             max_metrics_age_s=self.max_metrics_age_s,
+            last_updated=self._live_timestamps(),
         )
         k = self.batch_requests
         n_pad = static.node_valid.shape[0]
@@ -714,9 +729,12 @@ class YodaBatch(BatchFilterScorePlugin):
             self._drop_burst()
             self.burst_invalidated += 1  # this row, beyond the set drop
             return None
-        # Live Node-object + allocatable spot-checks on the chosen node:
-        # the fleet_version key deliberately ignores Node/pod churn (the
-        # burst's own binds), so cordon/taint drift and burst siblings
+        # Live Node-object + freshness + allocatable spot-checks on the
+        # chosen node: the fleet_version key deliberately ignores Node/pod
+        # churn (the burst's own binds) AND heartbeat republishes, so
+        # cordon/taint drift, metric staleness (an agent that died after
+        # prepare — heartbeat elision removed the incidental invalidation
+        # that used to bound this window, review r4), and burst siblings
         # stacking cpu/memory/pod count are re-validated here (the gang
         # plan's members_cap, per-serve). Siblings already BOUND and
         # visible in the live snapshot must not be charged again from the
@@ -724,6 +742,13 @@ class YodaBatch(BatchFilterScorePlugin):
         # invalidated every co-located resource-requesting burst).
         if best in snapshot:
             ni = snapshot.get(best)
+            if self.max_metrics_age_s > 0 and (
+                ni.tpu is None
+                or not ni.tpu.fresh(max_age_s=self.max_metrics_age_s)
+            ):
+                self._drop_burst()
+                self.burst_invalidated += 1
+                return None
             on_node = {p.uid for p in ni.pods}
             p_cpu = p_mem = p_cnt = 0
             for uid, c, m in b.res.get(best, ()):

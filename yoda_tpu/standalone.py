@@ -159,6 +159,10 @@ def build_stack(
         # lacks the persistentvolumeclaims rule degrades to not-enforced
         # instead of parking every PVC-referencing pod.
         watches_pvcs=hasattr(cluster, "put_pvc"),
+        # Lets the informer classify timestamp-only heartbeats: on-time
+        # republishes of unchanged metrics do not bump the metrics
+        # version or reactivate parked pods; a stale node's refresh does.
+        staleness_s=config.max_metrics_age_s,
     )
 
     # Wire claims into our batch plugin now the informer exists, and expose
@@ -172,6 +176,8 @@ def build_stack(
         if p.claimed_fn is None:
             p.claimed_fn = informer.claimed_hbm_mib
             p.claimed_map_fn = informer.claimed_hbm_mib_map
+        if p.last_updated_map_fn is None:
+            p.last_updated_map_fn = informer.last_updated_map
     if batches:
         # Accumulator pattern so a SHARED metrics registry (profiles)
         # registers each family once and sums over every stack's plugins.
