@@ -209,6 +209,35 @@ class TestQueue:
         q.move_all_to_active()
         assert q.pop(timeout=0).pod.name == "a"
 
+    def test_forced_move_bypasses_chronic_cutoff(self):
+        # run_until_idle's settlement move: even chronic pods retry so a
+        # fixed-point check never concludes idle over freed capacity.
+        from yoda_tpu.framework.queue import IMMEDIATE_RETRY_ATTEMPTS
+
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0])
+        q.add(PodSpec("a"))
+        qpi = q.pop(timeout=0)
+        qpi.attempts = IMMEDIATE_RETRY_ATTEMPTS + 10
+        q.add_unschedulable(qpi, "nope")
+        q.move_all_to_active()
+        assert q.pop(timeout=0) is None  # throttled
+        q.move_all_to_active(force=True)
+        assert q.pop(timeout=0).pod.name == "a"
+
+    def test_immediate_retry_attempts_zero_is_strict_upstream(self):
+        # 0 = every event-driven move respects the backoff timer, even for
+        # a first-attempt pod (config immediate_retry_attempts).
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0], immediate_retry_attempts=0)
+        q.add(PodSpec("a"))
+        qpi = q.pop(timeout=0)
+        q.add_unschedulable(qpi, "nope")
+        q.move_all_to_active()
+        assert q.pop(timeout=0) is None  # backoff holds
+        now[0] += qpi.backoff_seconds() + 0.01
+        assert q.pop(timeout=0).pod.name == "a"
+
 
 def build(plugins, nodes):
     fw = Framework(plugins)

@@ -169,8 +169,15 @@ class InformerCache:
                 self._tpus[tpu.name] = tpu
                 relevant = prev is None or not _tpu_values_equal(prev, tpu)
                 if not relevant and self.staleness_s > 0:
-                    gap = tpu.last_updated_unix - prev.last_updated_unix
-                    relevant = gap > self.staleness_s  # was stale: now fresh
+                    # Observed AGE at arrival, not the publish gap: watch
+                    # delivery latency can push a node past the staleness
+                    # threshold even when the agent published on time, and
+                    # its refresh must still reactivate parked pods
+                    # (arrival age >= publish gap, so this test dominates).
+                    import time as _time
+
+                    age = _time.time() - prev.last_updated_unix
+                    relevant = age > self.staleness_s  # was stale: now fresh
             self._version += 1
             if relevant:
                 self._metrics_version += 1

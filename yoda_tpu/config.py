@@ -114,6 +114,12 @@ class SchedulerConfig:
     # amortizing the fleet scan and the dispatch floor across pods. 1 =
     # one dispatch per pod (the pre-r4 behavior). Batch mode only.
     batch_requests: int = 1
+    # Cluster events retry a parked pod immediately through this many
+    # scheduling attempts; beyond it the pod's exponential backoff timer
+    # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue
+    # semantics — bounds retry storms over chronically unschedulable pods).
+    # 0 = strict upstream behavior (every event move respects backoff).
+    immediate_retry_attempts: int = 5
     # Additional profiles (upstream KubeSchedulerConfiguration profiles):
     # each entry inherits every unspecified key from the base config and
     # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
@@ -207,6 +213,15 @@ class SchedulerConfig:
             raise ValueError(
                 "batch_requests > 1 requires mode='batch' (the fused kernel "
                 "is what a burst amortizes)"
+            )
+        if (
+            isinstance(cfg.immediate_retry_attempts, bool)
+            or not isinstance(cfg.immediate_retry_attempts, int)
+            or not 0 <= cfg.immediate_retry_attempts <= 1000
+        ):
+            raise ValueError(
+                "immediate_retry_attempts must be an int in [0, 1000], got "
+                f"{cfg.immediate_retry_attempts!r}"
             )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)

@@ -70,12 +70,17 @@ class SchedulingQueue:
         sort_plugin: QueueSortPlugin | None = None,
         *,
         clock: Callable[[], float] = time.monotonic,
+        immediate_retry_attempts: int = IMMEDIATE_RETRY_ATTEMPTS,
     ) -> None:
         if sort_plugin is not None:
             self._less = sort_plugin.less
         else:
             self._less = lambda a, b: a.pod.creation_seq < b.pod.creation_seq
         self._clock = clock
+        # Config immediate_retry_attempts: 0 = strict upstream semantics
+        # (every event move respects backoff); higher trades retry-storm
+        # exposure for lower latency on late-resolving pods.
+        self.immediate_retry_attempts = immediate_retry_attempts
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._seq = itertools.count()
@@ -152,18 +157,25 @@ class SchedulingQueue:
         with self._lock:
             self._unschedulable[qpi.pod.key] = qpi
 
-    def move_all_to_active(self) -> None:
+    def move_all_to_active(self, *, force: bool = False) -> None:
         """Cluster changed (node/metrics/pod event): retry parked pods —
-        immediately through ``IMMEDIATE_RETRY_ATTEMPTS``, after that only
+        immediately through ``immediate_retry_attempts``, after that only
         when the pod's own backoff timer has expired (chronic
         unschedulables keep their ready_at and flush on time via
         :meth:`pop`, bounding the per-pod retry rate at ~1/MAX_BACKOFF_S
-        no matter how fast events arrive)."""
+        no matter how fast events arrive). ``force`` bypasses the cutoff —
+        the deterministic-settlement driver (Scheduler.run_until_idle)
+        uses it after a bind so its fixed-point check never concludes
+        "idle" while a chronic pod could still fit freed capacity;
+        production event paths never force."""
         with self._cond:
             now = self._clock()
+            cutoff = (
+                float("inf") if force else self.immediate_retry_attempts
+            )
             still: list[tuple[float, int, QueuedPodInfo]] = []
             for ready_at, seq, qpi in self._backoff:
-                if qpi.attempts <= IMMEDIATE_RETRY_ATTEMPTS or ready_at <= now:
+                if qpi.attempts <= cutoff or ready_at <= now:
                     self._push_active(qpi)
                 else:
                     still.append((ready_at, seq, qpi))
@@ -173,7 +185,7 @@ class SchedulingQueue:
                 # Unresolvable-parked pods leave the pool on their first
                 # event either way; chronic ones re-enter via the backoff
                 # heap (fixed ready_at — later events cannot reset it).
-                if qpi.attempts <= IMMEDIATE_RETRY_ATTEMPTS:
+                if qpi.attempts <= cutoff:
                     self._push_active(qpi)
                 else:
                     heapq.heappush(

@@ -513,10 +513,14 @@ class Scheduler:
             # Only backoff pods remain. Retrying them is useful only if the
             # cluster changed (a bind) since their last attempt; otherwise
             # this is a fixed point — leave them to the event-driven path.
+            # Forced: the settlement driver must not conclude "idle" while
+            # a CHRONIC pod (beyond the event-retry cutoff) could fit the
+            # freed capacity — bounded, since it only fires when binds
+            # advanced since the last drain.
             if self.stats.binds == binds_at_drain:
                 return
             binds_at_drain = self.stats.binds
-            self.queue.move_all_to_active()
+            self.queue.move_all_to_active(force=True)
 
     def serve_forever(self, stop: threading.Event, *, poll_s: float = 0.5) -> None:
         while not stop.is_set():

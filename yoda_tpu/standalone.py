@@ -131,7 +131,11 @@ def build_stack(
     plugins.append(ClusterBinder(cluster))
     framework = Framework(plugins)
     gang.attach_framework(framework)
-    queue = SchedulingQueue(framework.queue_sort, clock=clock)
+    queue = SchedulingQueue(
+        framework.queue_sort,
+        clock=clock,
+        immediate_retry_attempts=config.immediate_retry_attempts,
+    )
 
     def on_change(event: Event) -> None:
         # New/changed TPU metrics may make parked pods schedulable; pod
