@@ -159,3 +159,47 @@ class TestMetricsServer:
             assert "default/p: bound -> host" in trace
         finally:
             server.stop()
+
+
+class TestQueueDepthGauges:
+    def test_depths_flow_to_metrics(self):
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(
+            config=SchedulerConfig(mode="batch", enable_preemption=False)
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("h0", generation="v5e", chips=2)
+        agent.publish_all()
+        # One pod binds; one parks (no capacity); one is unresolvable.
+        stack.cluster.create_pod(PodSpec("ok", labels={"tpu/chips": "2"}))
+        stack.cluster.create_pod(PodSpec("big", labels={"tpu/chips": "64"}))
+        stack.cluster.create_pod(PodSpec("bad", labels={"tpu/chips": "x"}))
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_queue_active_pods 0" in text
+        # big retries via backoff; bad parks unresolvable.
+        assert "yoda_queue_backoff_pods 1" in text
+        assert "yoda_queue_parked_pods 1" in text
+
+    def test_profiles_sum_into_one_family(self):
+        from yoda_tpu.cluster import FakeCluster
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_profile_stacks
+
+        cluster = FakeCluster()
+        stacks = build_profile_stacks(
+            cluster,
+            SchedulerConfig(
+                mode="batch",
+                profiles=(
+                    SchedulerConfig(mode="batch", scheduler_name="alt"),
+                ),
+            ),
+        )
+        text = stacks[0].metrics.registry.render_prometheus()
+        # One family, not a duplicate-registration crash; zero depth.
+        assert text.count("yoda_queue_active_pods 0") == 1

@@ -15,6 +15,7 @@ scheduler reads ONLY this cache during a cycle. The cache maintains:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
@@ -34,6 +35,7 @@ class InformerCache:
         on_change: Callable[[Event], None] | None = None,
         watches_pvcs: bool = False,
         staleness_s: float = 0.0,
+        now_fn: Callable[[], float] = time.time,
     ) -> None:
         self.scheduler_name = scheduler_name
         self.on_pod_pending = on_pod_pending
@@ -47,7 +49,12 @@ class InformerCache:
         # timestamp-only republishes: a node whose publish GAP exceeded
         # this had gone stale, so its refresh changes schedulability and
         # must reactivate parked pods; an on-time heartbeat does not.
+        # ``now_fn`` must be the SAME clock domain the agents stamp
+        # last_updated_unix with (wall clock in production; inject the
+        # simulated clock in virtual-time setups or every heartbeat
+        # misclassifies as a stale-node refresh).
         self.staleness_s = staleness_s
+        self.now_fn = now_fn
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
@@ -174,9 +181,7 @@ class InformerCache:
                     # threshold even when the agent published on time, and
                     # its refresh must still reactivate parked pods
                     # (arrival age >= publish gap, so this test dominates).
-                    import time as _time
-
-                    age = _time.time() - prev.last_updated_unix
+                    age = self.now_fn() - prev.last_updated_unix
                     relevant = age > self.staleness_s  # was stale: now fresh
             self._version += 1
             if relevant:

@@ -93,6 +93,18 @@ class SchedulingQueue:
         with self._lock:
             return len(self._active) + len(self._backoff) + len(self._unschedulable)
 
+    def depths(self) -> tuple[int, int, int]:
+        """(active, backoff, parked-unresolvable) pool sizes — the
+        /metrics gauges operators read to tell a healthy queue from a
+        retry backlog (deep backoff = chronic unschedulables throttled;
+        deep parked = pods waiting on cluster events)."""
+        with self._lock:
+            return (
+                len(self._active),
+                len(self._backoff),
+                len(self._unschedulable),
+            )
+
     def pending_retry_count(self) -> int:
         """Pods that will re-enter the active queue without an external
         event (active + backoff); excludes the parked-unresolvable pool."""

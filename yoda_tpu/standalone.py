@@ -136,6 +136,29 @@ def build_stack(
         clock=clock,
         immediate_retry_attempts=config.immediate_retry_attempts,
     )
+    # Queue-depth gauges (accumulator pattern, as for the batch counters:
+    # one family registered on the shared registry, summed over profiles).
+    qacc = getattr(metrics, "_queues", None)
+    if qacc is None:
+        qacc = metrics._queues = []
+        metrics.registry.gauge(
+            "yoda_queue_active_pods",
+            "Pods ready to be scheduled right now, across profiles",
+            lambda: sum(q.depths()[0] for q in qacc),
+        )
+        metrics.registry.gauge(
+            "yoda_queue_backoff_pods",
+            "Pods waiting out their retry backoff (deep = chronic "
+            "unschedulables throttled past immediate_retry_attempts)",
+            lambda: sum(q.depths()[1] for q in qacc),
+        )
+        metrics.registry.gauge(
+            "yoda_queue_parked_pods",
+            "Pods parked unresolvable until a cluster event (bad labels, "
+            "missing claims, gang capacity)",
+            lambda: sum(q.depths()[2] for q in qacc),
+        )
+    qacc.append(queue)
 
     def on_change(event: Event) -> None:
         # New/changed TPU metrics may make parked pods schedulable; pod
