@@ -281,3 +281,55 @@ class TestBurstFreshness:
             p.node_name is None for p in stack.cluster.list_pods()
         ), "pod bound via a stale burst row"
         assert yb.burst_invalidated >= 1
+
+
+class TestIncrementalStatic:
+    def test_single_node_change_updates_in_place(self, monkeypatch):
+        # One agent refresh on a 16-host fleet must NOT pay the full
+        # O(N x C) rebuild — only the changed row refills (and produces
+        # exactly the same scheduling outcome).
+        from yoda_tpu.ops import arrays as arrays_mod
+
+        stack, agent = make_stack(batch_requests=1)
+        fleet(agent, hosts=16)
+        yb = batch_plugin(stack)
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert yb._static is not None
+
+        calls = {"n": 0}
+        real = arrays_mod.FleetArrays.from_snapshot.__func__
+
+        def counting(cls, *a, **kw):
+            calls["n"] += 1
+            return real(cls, *a, **kw)
+
+        monkeypatch.setattr(
+            arrays_mod.FleetArrays, "from_snapshot", classmethod(counting)
+        )
+        # Break every chip on one node (a real value change) and demand a
+        # full healthy host: the sick node must be rejected from the
+        # incrementally-updated row.
+        for c in range(8):
+            agent.set_chip_health("v5e-3", c, False)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "8"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        p = stack.cluster.get_pod("default/p")
+        assert p.node_name and p.node_name != "v5e-3"
+        assert calls["n"] == 0, "single-node change paid a full rebuild"
+
+    def test_node_set_change_rebuilds(self):
+        stack, agent = make_stack(batch_requests=1)
+        fleet(agent, hosts=4)
+        yb = batch_plugin(stack)
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        agent.add_host("v5e-99", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name
+        assert "v5e-99" in yb._static.names

@@ -212,6 +212,19 @@ class TpuNodeMetrics:
         now = time.time() if now is None else now
         return (now - self.last_updated_unix) <= max_age_s
 
+    def values_equal(self, other: "TpuNodeMetrics") -> bool:
+        """Equality on every schedulability-relevant field — everything
+        except the publish timestamp and resource version. Derived from
+        the dataclass so a FUTURE field defaults to RELEVANT (consumers:
+        the informer's heartbeat classification and the fleet-array
+        incremental diff — a hand-kept field list would silently classify
+        real changes as heartbeats)."""
+        import dataclasses
+
+        return dataclasses.replace(
+            self, last_updated_unix=0.0, resource_version=0
+        ) == dataclasses.replace(other, last_updated_unix=0.0, resource_version=0)
+
     # --- CR (de)serialization, used by the fake/real API server paths ---
 
     def to_obj(self) -> dict[str, Any]:

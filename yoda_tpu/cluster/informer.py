@@ -174,7 +174,7 @@ class InformerCache:
             else:
                 prev = self._tpus.get(tpu.name)
                 self._tpus[tpu.name] = tpu
-                relevant = prev is None or not _tpu_values_equal(prev, tpu)
+                relevant = prev is None or not prev.values_equal(tpu)
                 if not relevant and self.staleness_s > 0:
                     # Observed AGE at arrival, not the publish gap: watch
                     # delivery latency can push a node past the staleness
@@ -324,19 +324,6 @@ class InformerCache:
             snap.metrics_version = self._metrics_version
             self._snapshot_cache = snap
             return snap
-
-
-def _tpu_values_equal(a: TpuNodeMetrics, b: TpuNodeMetrics) -> bool:
-    """Value equality on every schedulability-relevant field — everything
-    except the publish timestamp and resource version. Derived from the
-    dataclass itself so a FUTURE TpuNodeMetrics field defaults to
-    RELEVANT (a hand-kept field list would silently classify its changes
-    as heartbeats and never rebuild anything)."""
-    import dataclasses
-
-    return dataclasses.replace(
-        a, last_updated_unix=0.0, resource_version=0
-    ) == dataclasses.replace(b, last_updated_unix=0.0, resource_version=0)
 
 
 def _pod_claim_mib(pod: PodSpec) -> int:

@@ -124,42 +124,7 @@ class FleetArrays:
         power = np.zeros((n_pad, c_pad), dtype=np.int32)
 
         now = _time.time() if now is None else now
-        for i, ni in enumerate(infos):
-            tpu = ni.tpu
-            if tpu is None:
-                continue  # row stays invalid -> never feasible
-            node_valid[i] = True
-            # No-pod-context default: cordon only. Taint/toleration admission
-            # is per pod and arrives via the dyn vector (dyn_packed host_ok).
-            host_ok[i] = ni.node is None or not ni.node.unschedulable
-            gen[i] = tpu.generation_rank
-            in_slice[i] = bool(tpu.slice_id)
-            last_updated[i] = tpu.last_updated_unix
-            fresh[i] = (
-                True
-                if max_metrics_age_s <= 0
-                else tpu.fresh(max_age_s=max_metrics_age_s, now=now)
-            )
-            claimed[i] = min(_claimed_hbm_mib(ni), np.iinfo(np.int32).max)
-            for j, chip in enumerate(tpu.chips[:c_pad]):
-                chip_valid[i, j] = True
-                healthy[i, j] = chip.healthy
-                chip_used[i, j] = chip.hbm_free < chip.hbm_total
-                hbm_free[i, j] = chip.hbm_free // MIB
-                hbm_total[i, j] = chip.hbm_total // MIB
-                clock[i, j] = chip.clock_mhz
-                bw[i, j] = chip.hbm_bandwidth_gbps
-                tflops[i, j] = chip.tflops_bf16
-                power[i, j] = chip.power_w
-            if reserved_fn is not None:
-                reserved[i] = reserved_fn(ni.name)
-            else:
-                # No accounting: pin reserved to metrics-visible usage so
-                # the kernel's invisible-reservation and stale-freed
-                # corrections both vanish (kernel_impl comment).
-                reserved[i] = int(np.sum(healthy[i] & chip_used[i]))
-
-        return cls(
+        arrays = cls(
             names=names,
             node_valid=node_valid,
             generation_rank=gen,
@@ -179,6 +144,79 @@ class FleetArrays:
             tflops=tflops,
             power_w=power,
         )
+        for i, ni in enumerate(infos):
+            arrays.fill_row(
+                i,
+                ni,
+                max_metrics_age_s=max_metrics_age_s,
+                now=now,
+                reserved_fn=reserved_fn,
+            )
+        return arrays
+
+    def fill_row(
+        self,
+        i: int,
+        ni,
+        *,
+        max_metrics_age_s: float = 0.0,
+        now: float | None = None,
+        reserved_fn: Callable[[str], int] | None = None,
+    ) -> None:
+        """(Re)compute row ``i`` from a NodeInfo in place — the per-node
+        half of :meth:`from_snapshot`, also used for INCREMENTAL updates
+        when one node's CR changed (a single agent refresh must not cost a
+        full O(N x C) fleet rebuild; plugins/yoda/batch.py
+        ``_refresh_static``). Chip columns are zeroed first so a CR that
+        SHRANK (fewer chips) leaves no stale columns behind."""
+        import time as _time
+
+        c_pad = self.chip_valid.shape[1]
+        for grid in (
+            self.chip_valid, self.chip_healthy, self.chip_used,
+            self.hbm_free_mib, self.hbm_total_mib, self.clock_mhz,
+            self.hbm_bandwidth, self.tflops, self.power_w,
+        ):
+            grid[i] = 0
+        tpu = ni.tpu
+        if tpu is None:
+            self.node_valid[i] = False  # never feasible
+            return
+        now = _time.time() if now is None else now
+        self.node_valid[i] = True
+        # No-pod-context default: cordon only. Taint/toleration admission
+        # is per pod and arrives via the dyn vector (dyn_packed host_ok).
+        self.host_ok[i] = ni.node is None or not ni.node.unschedulable
+        self.generation_rank[i] = tpu.generation_rank
+        self.in_slice[i] = bool(tpu.slice_id)
+        self.last_updated[i] = tpu.last_updated_unix
+        self.fresh[i] = (
+            True
+            if max_metrics_age_s <= 0
+            else tpu.fresh(max_age_s=max_metrics_age_s, now=now)
+        )
+        self.claimed_hbm_mib[i] = min(
+            _claimed_hbm_mib(ni), np.iinfo(np.int32).max
+        )
+        for j, chip in enumerate(tpu.chips[:c_pad]):
+            self.chip_valid[i, j] = True
+            self.chip_healthy[i, j] = chip.healthy
+            self.chip_used[i, j] = chip.hbm_free < chip.hbm_total
+            self.hbm_free_mib[i, j] = chip.hbm_free // MIB
+            self.hbm_total_mib[i, j] = chip.hbm_total // MIB
+            self.clock_mhz[i, j] = chip.clock_mhz
+            self.hbm_bandwidth[i, j] = chip.hbm_bandwidth_gbps
+            self.tflops[i, j] = chip.tflops_bf16
+            self.power_w[i, j] = chip.power_w
+        if reserved_fn is not None:
+            self.reserved_chips[i] = reserved_fn(ni.name)
+        else:
+            # No accounting: pin reserved to metrics-visible usage so
+            # the kernel's invisible-reservation and stale-freed
+            # corrections both vanish (kernel_impl comment).
+            self.reserved_chips[i] = int(
+                np.sum(self.chip_healthy[i] & self.chip_used[i])
+            )
 
     def with_dynamic(
         self,

@@ -276,6 +276,9 @@ class YodaBatch(BatchFilterScorePlugin):
         self.kernel_backend = kernel_backend
         self._cache_version: int | None = None
         self._static: FleetArrays | None = None
+        # Per-row CR object tags for incremental static updates
+        # (_incremental_update): row i was built from _row_src[i].
+        self._row_src: "list | None" = None
         self._kern: FleetKernelLike | None = None
         self._kern_device = None
         # Whole-gang placement plans: gang name -> _GangPlan. One kernel
@@ -401,7 +404,7 @@ class YodaBatch(BatchFilterScorePlugin):
         version = self._fleet_version(snapshot)
         if version and self._cache_version == version and self._static is not None:
             return self._static
-        static = FleetArrays.from_snapshot(
+        static = self._incremental_update(snapshot) or FleetArrays.from_snapshot(
             snapshot,
             max_metrics_age_s=self.max_metrics_age_s,
             node_bucket=(
@@ -419,6 +422,69 @@ class YodaBatch(BatchFilterScorePlugin):
         if version:
             self._cache_version = version
             self._static = static
+            # Per-row CR identity tags for the next incremental diff. The
+            # informer replaces a node's CR object on every stored event,
+            # so identity inequality is a safe over-approximation of
+            # "this row may have changed".
+            self._row_src = [
+                snapshot.get(nm).tpu if nm in snapshot else None
+                for nm in static.names
+            ]
+        else:
+            self._row_src = None
+        return static
+
+    def _incremental_update(self, snapshot: Snapshot) -> "FleetArrays | None":
+        """Update only the rows whose CR object changed, in place, instead
+        of a full O(N x C) rebuild (65 ms at 4096 nodes, paid per agent
+        refresh on a busy fleet). Applicable when the node set, order, and
+        buckets are unchanged; None = do the full rebuild."""
+        static = self._static
+        if static is None or self._row_src is None:
+            return None
+        if self.claimed_fn is None:
+            # Without dynamic claims, the baked claimed_hbm_mib row is
+            # recomputed from ni.pods only on rebuild — and pod binds
+            # change ni.pods WITHOUT touching the TPU CR this diff keys
+            # on, so an incremental path would let claims go permanently
+            # stale (review r4: HBM double-booking). Bare constructions
+            # take the full rebuild; the wired stack always has claimed_fn.
+            return None
+        names = snapshot.names()
+        if names != static.names:
+            return None  # node set/order changed: full rebuild
+        changed = []
+        for i, nm in enumerate(names):
+            tpu = snapshot.get(nm).tpu
+            src = self._row_src[i]
+            if tpu is src:
+                continue  # identity fast path: same stored CR object
+            # Heartbeat republishes replace the stored object with equal
+            # VALUES (agents publish whole fleets at once) — only a real
+            # value difference dirties the row; the baked timestamp still
+            # refreshes so constructions without a live timestamp map
+            # (last_updated_map_fn) don't age on-time nodes into
+            # staleness (review r4).
+            if tpu is not None and src is not None and src.values_equal(tpu):
+                static.last_updated[i] = tpu.last_updated_unix
+                if self.max_metrics_age_s > 0:
+                    static.fresh[i] = tpu.fresh(
+                        max_age_s=self.max_metrics_age_s
+                    )
+                continue
+            changed.append(i)
+            if tpu is not None and tpu.chip_count > static.padded_shape[1]:
+                return None  # chip bucket outgrown: full rebuild
+        # Beyond ~a quarter of the fleet the row loop costs what the
+        # vectorized rebuild does — rebuild instead.
+        if len(changed) > max(len(names) // 4, 8):
+            return None
+        for i in changed:
+            static.fill_row(
+                i,
+                snapshot.get(names[i]),
+                max_metrics_age_s=self.max_metrics_age_s,
+            )
         return static
 
     def filter_and_score_batch(
