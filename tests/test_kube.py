@@ -605,3 +605,72 @@ class TestPvcWatch:
             assert informer.snapshot().pvcs is None
         finally:
             kc.stop()
+
+
+class TestPvcRelist:
+    def test_pvc_deletion_during_disconnect_surfaces_via_relist(self, server):
+        """A PVC deleted while the client is disconnected must surface as a
+        'deleted' event from the relist diff — the informer drops the claim
+        and pods mounting it park instead of scheduling against a ghost."""
+        from yoda_tpu.api.types import K8sPvc
+
+        server.put_object(
+            "PersistentVolumeClaim", "default/data",
+            K8sPvc("data", selected_node="n1").to_obj(),
+        )
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=1)
+        )
+        # Count PVC LISTs (the client uses api.request for LIST and
+        # api.watch for watching): >1 proves the 410 -> relist actually
+        # ran — without this, a live-stream delivery of the delete would
+        # keep the test green while the relist path never executes.
+        pvc_lists = {"n": 0}
+        real_request = api.request
+
+        def counting_request(method, path, **kw):
+            if method == "GET" and path == "/api/v1/persistentvolumeclaims":
+                pvc_lists["n"] += 1
+            return real_request(method, path, **kw)
+
+        api.request = counting_request
+        kc = KubeCluster(api, backoff_initial_s=0.05)
+        kc.start()
+        assert kc.wait_for_sync(10.0)
+        from yoda_tpu.cluster.informer import InformerCache
+
+        informer = InformerCache()
+        kc.add_watcher(informer.handle)
+        try:
+            wait_until(
+                lambda: informer.snapshot().pvcs is not None
+                and "default/data" in informer.snapshot().pvcs,
+                timeout_s=10.0,
+                msg="claim visible",
+            )
+            lists_after_sync = pvc_lists["n"]
+            # Make the PVC watch cursor genuinely stale before compacting:
+            # bump the GLOBAL resourceVersion on another kind, so after
+            # compact() the PVC stream's cursor < window_start and its next
+            # (re)watch gets 410 -> LIST -> diff (review r4: without this,
+            # the delete rides the still-open watch and the relist path
+            # this test exists for never runs).
+            server.put_object("Pod", "default/bump", PodSpec("bump").to_obj())
+            server.compact()
+            server.delete_object("PersistentVolumeClaim", "default/data")
+            wait_until(
+                lambda: "default/data" not in (informer.snapshot().pvcs or {}),
+                timeout_s=15.0,
+                msg="claim dropped via relist diff",
+            )
+            # The compacted-away cursor forced a real RELIST (not a live
+            # stream delivery): the diff path emitted the deletion.
+            wait_until(
+                lambda: pvc_lists["n"] > lists_after_sync,
+                timeout_s=15.0,
+                msg="410 triggered a PVC relist",
+            )
+            # The watch stayed live through the relist: enforcement stays on.
+            assert informer.watches_pvcs is True
+        finally:
+            kc.stop()

@@ -145,6 +145,20 @@ class FakeKubeApiServer:
                 self.state.eviction_blocked.discard(pod_key)
 
 
+def _expired_event(rv: int) -> dict:
+    """The watch-stream 410 Status event — one shape for both the
+    fresh-watch rejection and the mid-stream compaction kill."""
+    return {
+        "type": "ERROR",
+        "object": {
+            "kind": "Status",
+            "code": 410,
+            "reason": "Expired",
+            "message": f"too old resource version: {rv}",
+        },
+    }
+
+
 def _record(state: _State, kind: str, key: str, obj: dict, etype: str) -> None:
     """Must hold state.lock. Bumps rv, stores, appends the watch event."""
     state.rv += 1
@@ -439,16 +453,7 @@ class _Handler(BaseHTTPRequestHandler):
         if expired:
             # Resume window compacted away: the client must relist. Sent as
             # a one-event watch stream (newline-framed), like the real API.
-            event = {
-                "type": "ERROR",
-                "object": {
-                    "kind": "Status",
-                    "code": 410,
-                    "reason": "Expired",
-                    "message": f"too old resource version: {since}",
-                },
-            }
-            data = json.dumps(event).encode() + b"\n"
+            data = json.dumps(_expired_event(since)).encode() + b"\n"
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
@@ -464,18 +469,35 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             while True:
                 batch: list[dict] = []
+                expired_mid = False
                 with state.lock:
-                    for rv, event in state.events[kind]:
-                        if rv > cursor:
-                            batch.append(event)
-                            cursor = rv
-                    if not batch:
-                        if state.stopping or time.monotonic() >= deadline:
-                            break
-                        state.lock.wait(
-                            min(0.25, max(deadline - time.monotonic(), 0.01))
-                        )
-                        continue
+                    if cursor and cursor < state.window_start[kind]:
+                        # compact() overtook this OPEN stream's cursor:
+                        # events between cursor and window_start are gone,
+                        # so the stream must die with an in-band 410 and
+                        # force a relist — real API servers terminate
+                        # long-running watches at compaction the same way
+                        # (without this, open watches silently survive
+                        # compaction and the relist tests go
+                        # nondeterministic, review r4).
+                        expired_mid = True
+                    else:
+                        for rv, event in state.events[kind]:
+                            if rv > cursor:
+                                batch.append(event)
+                                cursor = rv
+                        if not batch:
+                            if state.stopping or time.monotonic() >= deadline:
+                                break
+                            state.lock.wait(
+                                min(0.25, max(deadline - time.monotonic(), 0.01))
+                            )
+                            continue
+                if expired_mid:
+                    self._write_chunk(
+                        json.dumps(_expired_event(cursor)).encode() + b"\n"
+                    )
+                    break
                 for event in batch:
                     self._write_chunk(json.dumps(event).encode() + b"\n")
             self._write_chunk(b"")  # terminating chunk: orderly stream end
