@@ -9,8 +9,10 @@ COPY native/ /src/native/
 RUN make -C /src/native
 
 FROM python:3.12-slim
-# The scheduler's fused scoring kernel runs JAX on CPU inside the pod.
-RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml
+# The scheduler's fused scoring kernel runs JAX on CPU inside the pod;
+# grpcio is the agent's transport for the libtpu metrics service
+# (--libtpu-metrics, on by default in the DaemonSet).
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml grpcio
 COPY yoda_tpu/ /app/yoda_tpu/
 COPY --from=builder /src/native/libyoda_tpuinfo.so /usr/local/lib/yoda_tpu/
 ENV PYTHONPATH=/app

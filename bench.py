@@ -150,6 +150,7 @@ def _synthetic_arrays(n_nodes: int, chips: int = 8):
         last_updated=np.zeros(n, dtype=np.float64),
         reserved_chips=np.zeros(n, dtype=np.int32),
         claimed_hbm_mib=np.zeros(n, dtype=np.int32),
+        ext_chips=np.zeros(n, dtype=np.int32),
         chip_valid=np.broadcast_to(valid[:, None], grid).copy(),
         chip_healthy=np.broadcast_to(valid[:, None], grid).copy(),
         chip_used=free < total,
@@ -582,7 +583,11 @@ def _agent_hw_probe() -> dict:
         }
     }
     try:
-        out["agent_hw"]["hbm_sources"] = probe_hbm_sources()
+        # Evidence probe targets the same address the agent would
+        # (--libtpu-metrics-addr analog for the bench host).
+        out["agent_hw"]["hbm_sources"] = probe_hbm_sources(
+            libtpu_addr=os.environ.get("YODA_LIBTPU_METRICS_ADDR")
+        )
     except Exception as e:  # pragma: no cover — probe must not kill bench
         out["agent_hw"]["hbm_sources"] = [{"source": "probe", "status": str(e)}]
     return out

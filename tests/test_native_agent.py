@@ -295,7 +295,12 @@ class TestHbmSourceProbe:
         report = probe_hbm_sources(lambda: devs)
         by_source = {r["source"]: r["status"] for r in report}
         assert "1/1 devices exposed counters" in by_source["pjrt.memory_stats"]
-        assert "libtpu-metrics-grpc:8431" in by_source
+        grpc_rows = [s for s in by_source if s.startswith("libtpu-metrics-grpc:")]
+        assert len(grpc_rows) == 1
+        # The gRPC source is now a real typed query, not a connect-probe:
+        # the status always names GetRuntimeMetric, with values or the
+        # typed failure (VERDICT r4 #1).
+        assert "GetRuntimeMetric" in by_source[grpc_rows[0]]
         assert "device-files" in by_source
 
     def test_no_counters_enumerates_every_source(self):
@@ -313,3 +318,252 @@ class TestHbmSourceProbe:
 
         report = probe_hbm_sources(lambda: [])
         assert report[0]["status"] == "no TPU devices enumerate"
+
+
+class TestLibtpuMetricsClient:
+    """agent/tpu_metrics.py: the typed GetRuntimeMetric client (VERDICT r4
+    #1 — the reference's metrics source read live hardware counters,
+    reference readme.md:9-15 consumed at pkg/yoda/filter/filter.go:22-58;
+    this is the TPU-native equivalent over the libtpu metrics service)."""
+
+    def test_wire_codec_round_trip(self):
+        from yoda_tpu.agent import tpu_metrics as tm
+
+        req = tm.encode_metric_request(tm.METRIC_HBM_TOTAL)
+        assert tm.decode_metric_request(req) == tm.METRIC_HBM_TOTAL
+        wire = tm.encode_metric_response(
+            tm.METRIC_HBM_USAGE, {0: 4 * GIB, 1: 6 * GIB, 7: 0}
+        )
+        assert tm.decode_metric_response(wire) == {
+            0: float(4 * GIB),
+            1: float(6 * GIB),
+            7: 0.0,
+        }
+
+    def test_wire_codec_double_gauge(self):
+        from yoda_tpu.agent import tpu_metrics as tm
+
+        wire = tm.encode_metric_response(tm.METRIC_DUTY_CYCLE, {0: 37.5})
+        assert tm.decode_metric_response(wire) == {0: 37.5}
+
+    def test_decoder_tolerates_garbage(self):
+        from yoda_tpu.agent import tpu_metrics as tm
+
+        # A truncated buffer raises ValueError (query_hbm maps it to
+        # LibtpuMetricsUnavailable); an empty one decodes to no devices.
+        with pytest.raises(ValueError):
+            tm.decode_metric_response(b"\x0a\xff")
+        assert tm.decode_metric_response(b"") == {}
+
+    def test_query_against_fake_server(self):
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        with FakeLibtpuMetricsServer(
+            {0: (16 * GIB, 4 * GIB), 1: (16 * GIB, 0)},
+            duty_cycle_pct={0: 81.0, 1: 0.0},
+        ) as srv:
+            hbm = tm.query_hbm(srv.address, timeout_s=5.0, duty_cycle=True)
+        assert hbm.per_chip == {0: (16 * GIB, 4 * GIB), 1: (16 * GIB, 0)}
+        assert hbm.free(0) == 12 * GIB
+        assert hbm.free(1) == 16 * GIB
+        assert hbm.free(9) is None
+        assert hbm.duty_cycle_pct == {0: 81.0, 1: 0.0}
+        # The client asked for exactly the three runtime metrics (duty
+        # cycle only because this call opted in — the agent's per-cycle
+        # reads skip it).
+        assert srv.requests_seen == [
+            tm.METRIC_HBM_TOTAL,
+            tm.METRIC_HBM_USAGE,
+            tm.METRIC_DUTY_CYCLE,
+        ]
+
+    def test_closed_port_raises_unavailable(self):
+        import socket
+
+        from yoda_tpu.agent import tpu_metrics as tm
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing listens here now
+        with pytest.raises(tm.LibtpuMetricsUnavailable) as ei:
+            tm.query_hbm(f"127.0.0.1:{port}", timeout_s=1.0)
+        assert "GetRuntimeMetric failed" in str(ei.value)
+
+    def test_usage_gap_drops_device_not_zero_fills(self):
+        """A device reported in totals but missing from the usage response
+        must be DROPPED (falls back to spec+accounting), never defaulted to
+        used=0 — that would publish an occupied chip as fully free with
+        hardware-read authority."""
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        with FakeLibtpuMetricsServer(
+            {0: (16 * GIB, 4 * GIB), 1: (16 * GIB, 12 * GIB)},
+            omit_usage_for={1},
+        ) as srv:
+            hbm = tm.query_hbm(srv.address, timeout_s=5.0)
+        assert hbm.per_chip == {0: (16 * GIB, 4 * GIB)}
+        # Usage covers nothing at all: the whole read is unavailable.
+        with FakeLibtpuMetricsServer(
+            {0: (16 * GIB, 4 * GIB)}, omit_usage_for={0}
+        ) as srv:
+            with pytest.raises(tm.LibtpuMetricsUnavailable) as ei:
+                tm.query_hbm(srv.address, timeout_s=5.0)
+        assert "covered none" in str(ei.value)
+
+    def test_empty_fleet_raises_unavailable(self):
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        with FakeLibtpuMetricsServer({}) as srv:
+            with pytest.raises(tm.LibtpuMetricsUnavailable) as ei:
+                tm.query_hbm(srv.address, timeout_s=5.0)
+        assert "no HBM devices" in str(ei.value)
+
+
+class TestAgentLibtpuOverlay:
+    """NativeTpuAgent + the libtpu metrics service: hardware-read occupancy
+    flows into the published CR, label attribution is skipped for covered
+    chips, and the agent degrades to spec values when the service dies."""
+
+    def _agent(self, lib, cluster, query_fn):
+        return NativeTpuAgent(
+            cluster, "real-node", lib=lib, libtpu_query_fn=query_fn
+        )
+
+    def test_overlay_is_authoritative_and_skips_attribution(self, lib, env_spec):
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        env_spec("generation=v5e;chips=2")
+        cluster = FakeCluster()
+        pod = PodSpec("occupant", labels={"tpu/chips": "1", "tpu/hbm": "4Gi"})
+        cluster.create_pod(pod)
+        cluster.bind_pod(pod.key, "real-node")
+        with FakeLibtpuMetricsServer(
+            {0: (16 * GIB, 10 * GIB), 1: (16 * GIB, 2 * GIB)}
+        ) as srv:
+            agent = self._agent(
+                lib, cluster, lambda: tm.query_hbm(srv.address, timeout_s=5.0)
+            )
+            tpu = agent.run_once()
+        assert tpu.source == "env+libtpu-grpc"
+        by_idx = {c.index: c for c in tpu.chips}
+        # Hardware says 10 GiB / 2 GiB used; the bound pod's 4 Gi label is
+        # NOT charged on top (the counters already include any real usage).
+        assert by_idx[0].hbm_free == 6 * GIB
+        assert by_idx[1].hbm_free == 14 * GIB
+
+    def test_partial_coverage_attributes_uncovered_chips(self, lib, env_spec):
+        """Service reports chip 0 only: chip 1 keeps spec HBM and still
+        gets label attribution (the per-chip real_idx rule)."""
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        env_spec("generation=v5e;chips=2")
+        cluster = FakeCluster()
+        pod = PodSpec("occupant", labels={"tpu/chips": "1", "tpu/hbm": "4Gi"})
+        cluster.create_pod(pod)
+        cluster.bind_pod(pod.key, "real-node")
+        with FakeLibtpuMetricsServer({0: (16 * GIB, 8 * GIB)}) as srv:
+            agent = self._agent(
+                lib, cluster, lambda: tm.query_hbm(srv.address, timeout_s=5.0)
+            )
+            tpu = agent.run_once()
+        by_idx = {c.index: c for c in tpu.chips}
+        assert by_idx[0].hbm_free == 8 * GIB  # hardware-read
+        # Greedy attribution skips the covered chip: the label charge lands
+        # on chip 1 even though chip 0 is (nominally) less free.
+        assert by_idx[1].hbm_free == 16 * GIB - 4 * GIB
+
+    def test_service_death_falls_back_to_spec(self, lib, env_spec):
+        from yoda_tpu.agent import tpu_metrics as tm
+
+        env_spec("generation=v5e;chips=1")
+        cluster = FakeCluster()
+
+        def dead_query():
+            raise tm.LibtpuMetricsUnavailable("GetRuntimeMetric failed: dead")
+
+        agent = self._agent(lib, cluster, dead_query)
+        tpu = agent.run_once()
+        assert tpu is not None
+        assert tpu.source == "env"  # no overlay recorded
+        assert tpu.chips[0].hbm_free == 16 * GIB
+
+    def test_external_used_chips_attribution(self, lib, env_spec):
+        """The agent classifies hardware-read used chips: usage explained
+        by RUNNING pods' chip claims is ours; the surplus is an external
+        tenant (api/types.py external_used_chips). Pending pods haven't
+        attached the TPU, so they explain nothing."""
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        env_spec("generation=v5e;chips=4")
+        cluster = FakeCluster()
+        running = PodSpec("mine", labels={"tpu/chips": "1"})
+        cluster.create_pod(running)
+        cluster.bind_pod(running.key, "real-node")  # FakeCluster: -> Running
+        pending = PodSpec("starting", labels={"tpu/chips": "1"})
+        cluster.create_pod(pending)
+        cluster.bind_pod(pending.key, "real-node")
+        pending.phase = "Pending"  # bound but not started: no usage yet
+        with FakeLibtpuMetricsServer(
+            {
+                0: (16 * GIB, 2 * GIB),   # external tenant
+                1: (16 * GIB, 3 * GIB),   # pod "mine"
+                2: (16 * GIB, 0),
+                3: (16 * GIB, 0),
+            }
+        ) as srv:
+            agent = self._agent(
+                lib, cluster, lambda: tm.query_hbm(srv.address, timeout_s=5.0)
+            )
+            tpu = agent.run_once()
+        # 2 hw-read used chips - 1 running claim = 1 external.
+        assert tpu.external_used_chips == 1
+        # Survives the CR round trip the scheduler reads it through.
+        from yoda_tpu.api.types import TpuNodeMetrics
+
+        assert TpuNodeMetrics.from_obj(tpu.to_obj()).external_used_chips == 1
+
+    def test_partial_coverage_does_not_double_spend_claims(self, lib, env_spec):
+        """A Running pod that was already label-charged onto an UNCOVERED
+        chip must not ALSO absorb a covered chip's hardware usage — that
+        would hide a real external tenant (2-chip node, libtpu covers
+        only chip0 which a foreign tenant holds, our pod attributed onto
+        chip1: externalUsedChips must be 1, not 0)."""
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        env_spec("generation=v5e;chips=2")
+        cluster = FakeCluster()
+        pod = PodSpec("mine", labels={"tpu/chips": "1", "tpu/hbm": "4Gi"})
+        cluster.create_pod(pod)
+        cluster.bind_pod(pod.key, "real-node")  # -> Running
+        with FakeLibtpuMetricsServer({0: (16 * GIB, 8 * GIB)}) as srv:
+            agent = self._agent(
+                lib, cluster, lambda: tm.query_hbm(srv.address, timeout_s=5.0)
+            )
+            tpu = agent.run_once()
+        by_idx = {c.index: c for c in tpu.chips}
+        assert by_idx[1].hbm_free == 16 * GIB - 4 * GIB  # claim attributed here
+        assert tpu.external_used_chips == 1  # chip0's tenant stays visible
+
+    def test_occupancy_changes_flow_between_publishes(self, lib, env_spec):
+        """The DaemonSet loop picks up live occupancy movement — the
+        behavior the reference's sniffer existed for."""
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        env_spec("generation=v5e;chips=1")
+        cluster = FakeCluster()
+        with FakeLibtpuMetricsServer({0: (16 * GIB, 0)}) as srv:
+            agent = self._agent(
+                lib, cluster, lambda: tm.query_hbm(srv.address, timeout_s=5.0)
+            )
+            assert agent.run_once().chips[0].hbm_free == 16 * GIB
+            srv.per_chip[0] = (16 * GIB, 12 * GIB)
+            assert agent.run_once().chips[0].hbm_free == 4 * GIB

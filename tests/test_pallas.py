@@ -35,6 +35,7 @@ def random_arrays(n_nodes: int, chips: int = 8, seed: int = 0) -> FleetArrays:
         last_updated=np.zeros(n, dtype=np.float64),
         reserved_chips=rng.integers(0, 4, size=n).astype(np.int32),
         claimed_hbm_mib=rng.integers(0, 64 * 1024, size=n).astype(np.int32),
+        ext_chips=rng.integers(0, 3, size=n).astype(np.int32),
         chip_valid=np.broadcast_to(valid[:, None], grid).copy(),
         chip_healthy=np.broadcast_to(valid[:, None], grid) & healthy,
         chip_used=free < total,

@@ -354,6 +354,173 @@ class TestStaleFreedChips:
         result = fused_filter_score(arrays, req)
         assert not result.feasible[0]
 
+    def test_external_tenant_chips_earn_no_credit(self):
+        """External-tenant occupancy (TpuNodeMetrics.external_used_chips —
+        hardware-read usage the agent could attribute to no running pod)
+        is live truth owned by a foreign process: it must never be
+        credited back as stale-freed capacity, in the Python predicate and
+        in the fused kernel (found live: a pod bound onto a chip the
+        hardware reported full)."""
+        from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+        from yoda_tpu.ops.arrays import FleetArrays
+        from yoda_tpu.ops.kernel import fused_filter_score
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            stale_freed_chips,
+        )
+
+        node = make_node("n", chips=4, hbm_free_per_chip=1 * GIB)
+        for c in node.chips:
+            c.hw_read = True
+        node.external_used_chips = 4
+        req = req_of(**{"tpu/chips": 2, "tpu/hbm": "8Gi"})
+        # Same shape as test_freed_chips_count_as_available, but the usage
+        # belongs to external tenants: zero credit at every level.
+        assert stale_freed_chips(node, req, reserved=0) == 0
+        assert available_chips(node, req, reserved=0) == 0
+
+        snapshot = Snapshot({"n": NodeInfo("n", tpu=node)})
+        arrays = FleetArrays.from_snapshot(snapshot, reserved_fn=lambda _: 0)
+        result = fused_filter_score(arrays, req)
+        assert not result.feasible[0]
+
+        # Mixed: 2 external chips, 2 deleted-pod chips — only the latter
+        # are creditable.
+        node.external_used_chips = 2
+        assert stale_freed_chips(node, req, reserved=0) == 2
+
+    def test_hardware_read_deleted_pod_chips_stay_creditable(self):
+        """A deleted pod's HBM lingers in the hardware counters until the
+        process exits and the agent re-scrapes — the SAME stale-data class
+        as label attribution. hw_read alone (external_used_chips == 0)
+        must NOT disable the credit: preemption's post-eviction simulation
+        (preemption.py _avail_after) depends on it, and a blanket hw_read
+        exclusion would make preemption permanently inert on every
+        --libtpu-metrics node."""
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            stale_freed_chips,
+        )
+
+        node = make_node("n", chips=4, hbm_free_per_chip=1 * GIB)
+        for c in node.chips:
+            c.hw_read = True
+        # All 4 used chips were held by OUR pods (agent attributed them:
+        # ext=0); pods are gone (reserved=0): fully creditable.
+        req = req_of(**{"tpu/chips": 2, "tpu/hbm": "8Gi"})
+        assert stale_freed_chips(node, req, reserved=0) == 4
+        assert available_chips(node, req, reserved=0) == 4
+        # Post-eviction simulation shape: evicting 2 of 4 live claims.
+        assert available_chips(node, req, reserved=2) == 2
+
+    def test_preemption_works_on_hardware_read_node(self):
+        """End to end: a hardware-read node fully held by low-priority
+        pods must still be preemptible by a high-priority pod."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(config=SchedulerConfig(mode="batch"))
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=4)
+        agent.publish_all()
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(f"low-{i}", labels={"tpu/chips": "1", "tpu/priority": "1"})
+            )
+        stack.scheduler.run_until_idle()
+        # Agent republish, hardware-read flavor: all chips show our pods'
+        # real usage, fully attributed (ext=0).
+        agent.publish_all()
+        (tpu,) = [
+            t for t in stack.cluster.list_tpu_metrics() if t.name == "host-1"
+        ]
+        for c in tpu.chips:
+            c.hw_read = True
+        assert tpu.external_used_chips == 0
+        stack.cluster.put_tpu_metrics(tpu)
+        stack.cluster.create_pod(
+            PodSpec("high", labels={"tpu/chips": "2", "tpu/priority": "9"})
+        )
+        stack.scheduler.run_until_idle()
+        assert stack.cluster.get_pod("default/high").node_name == "host-1"
+        assert stack.preemption.preempted_total >= 2
+
+    def test_external_tenant_chips_absorb_no_reservation(self):
+        """The debit-direction mirror of the stale-freed fix: a foreign
+        tenant's hardware-read used chip must not cancel an accountant
+        reservation that actually sits on a still-free chip — else the
+        node overcommits (4 chips, 1 external, pod A reserved, and a
+        3-chip pod would still see 3 available)."""
+        from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
+        from yoda_tpu.ops.arrays import FleetArrays
+        from yoda_tpu.ops.kernel import fused_filter_score
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            invisible_reservations,
+        )
+
+        node = make_node("n", chips=4)
+        node.chips[0].hw_read = True
+        node.chips[0].hbm_free = node.chips[0].hbm_total - 2 * GIB
+        node.external_used_chips = 1
+        req = req_of(**{"tpu/chips": 3})
+        # Pod A bound (reserved=1), not yet visible: the external chip
+        # must NOT absorb A's reservation.
+        assert invisible_reservations(node, reserved=1) == 1
+        assert available_chips(node, req, reserved=1) == 2  # 3 unused - A
+
+        snapshot = Snapshot({"n": NodeInfo("n", tpu=node)})
+        arrays = FleetArrays.from_snapshot(snapshot, reserved_fn=lambda _: 1)
+        result = fused_filter_score(arrays, req)
+        assert not result.feasible[0]  # 3-chip ask overcommits
+        assert result.claimable[0] == 2
+
+    def test_external_tenant_handoff_after_pod_visible(self):
+        """Once pod A's own usage appears in the hardware counters, its
+        chip absorbs the reservation and availability is exact — no
+        permanent undercommit from the external-tenant debit."""
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            available_chips,
+            invisible_reservations,
+        )
+
+        node = make_node("n", chips=4)
+        for idx in (0, 1):  # chip0 external, chip1 = pod A's usage
+            node.chips[idx].hw_read = True
+            node.chips[idx].hbm_free = node.chips[idx].hbm_total - 2 * GIB
+        node.external_used_chips = 1  # agent attributed chip1 to Running A
+        req = req_of(**{"tpu/chips": 2})
+        assert invisible_reservations(node, reserved=1) == 0
+        assert available_chips(node, req, reserved=1) == 2  # exactly right
+
+    def test_external_tenant_usage_never_credited_e2e(self):
+        """Full stack: a node whose hardware-read chips show external
+        consumption must reject a pod even though no accounting claims
+        those chips — the scenario the stale-freed credit would have
+        wrongly admitted."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(config=SchedulerConfig(mode="batch"))
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-1", chips=2)
+        agent.publish_all()
+        # Simulate a hardware-read agent: both chips carry live external
+        # usage (another tenant attached them); no pod accounts for it.
+        (tpu,) = [
+            t for t in stack.cluster.list_tpu_metrics() if t.name == "host-1"
+        ]
+        for c in tpu.chips:
+            c.hw_read = True
+            c.hbm_free = c.hbm_total - 2 * GIB
+        tpu.external_used_chips = 2  # the agent attributes: no running pods
+        stack.cluster.put_tpu_metrics(tpu)
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle()
+        assert stack.cluster.get_pod("default/p").node_name is None
+
     @pytest.mark.parametrize("mode", ["batch", "loop"])
     def test_deleted_pods_chips_rebind_without_republish(self, mode):
         """A full host whose pod is deleted must accept a replacement pod

@@ -12,6 +12,9 @@ hardware state and writes the node's CR. Two implementations:
   HBM counters where exposed) overlaid onto the native inventory
   (``--runtime-probe``; see the libtpu-exclusivity caveat in
   docs/OPERATIONS.md).
+- ``tpu_metrics``: typed gRPC client for the libtpu runtime-metrics
+  service (``--libtpu-metrics``) — per-chip HBM occupancy read from
+  whichever process owns the chips, no runtime init required.
 """
 
 from yoda_tpu.agent.fake_publisher import CHIP_SPECS, ChipSpec, FakeTpuAgent
@@ -26,16 +29,24 @@ from yoda_tpu.agent.runtime import (
     metrics_from_runtime,
     read_runtime,
 )
+from yoda_tpu.agent.tpu_metrics import (
+    LibtpuHbm,
+    LibtpuMetricsUnavailable,
+    query_hbm,
+)
 
 __all__ = [
     "CHIP_SPECS",
     "ChipSpec",
     "FakeTpuAgent",
+    "LibtpuHbm",
+    "LibtpuMetricsUnavailable",
     "NativeTpuAgent",
     "RuntimeReading",
     "collect_host_metrics",
     "collection_source",
     "load_library",
     "metrics_from_runtime",
+    "query_hbm",
     "read_runtime",
 ]

@@ -146,6 +146,7 @@ class NativeTpuAgent:
         lib=None,
         now_fn=time.time,
         runtime_devices_fn=None,
+        libtpu_query_fn=None,
     ):
         self.cluster = cluster  # needs put_tpu_metrics / list_pods
         self.node_name = node_name
@@ -154,6 +155,9 @@ class NativeTpuAgent:
         # None = runtime probing disabled (--runtime-probe wires
         # agent.runtime.probe_devices, tests inject fakes).
         self.runtime_devices_fn = runtime_devices_fn
+        # None = libtpu metrics service disabled (--libtpu-metrics wires
+        # agent.tpu_metrics.query_hbm against --libtpu-metrics-addr).
+        self.libtpu_query_fn = libtpu_query_fn
 
     def run_once(self) -> TpuNodeMetrics | None:
         from yoda_tpu.agent import runtime as rt
@@ -185,12 +189,80 @@ class NativeTpuAgent:
         real_idx = (
             {rc.index for rc in reading.chips if rc.hbm_total is not None}
             if reading is not None
-            else frozenset()
+            else set()
         )
-        if any(c.index not in real_idx for c in tpu.chips):
+        if self.libtpu_query_fn is not None:
+            from yoda_tpu.agent.tpu_metrics import LibtpuMetricsUnavailable
+
+            try:
+                hbm = self.libtpu_query_fn()
+            except LibtpuMetricsUnavailable:
+                hbm = None  # fall back to PJRT/spec values already in place
+            if hbm is not None:
+                real_idx |= rt.overlay_libtpu(tpu, hbm)
+        attributed = any(c.index not in real_idx for c in tpu.chips)
+        if attributed:
             self._attribute_bound_pods(tpu, skip=real_idx)
+        tpu.external_used_chips = self._external_used(
+            tpu, claims_attributed=attributed
+        )
         self.cluster.put_tpu_metrics(tpu)
         return tpu
+
+    def _external_used(self, tpu: TpuNodeMetrics, *, claims_attributed: bool) -> int:
+        """Hardware-read used chips NOT attributable to any Running pod on
+        this node: an external tenant / foreign process. The scheduler
+        treats these as occupied-by-nobody — they absorb no accountant
+        reservation and earn no stale-freed credit (api/types.py
+        ``external_used_chips``).
+
+        Attribution rules, all in the conservative direction (an
+        under-counted claim inflates ``external`` and at worst withholds a
+        chip; an over-counted claim hides a real external tenant and
+        overcommits the node):
+
+        - only RUNNING pods count — a Pending pod has not attached the
+          TPU, so its chips cannot be behind this scrape's counters;
+        - only pods that actually express a TPU attachment count
+          (``wants_tpu`` labels or a ``google.com/tpu`` resource limit) —
+          the same rule the scheduler's accountant applies
+          (plugins/yoda/accounting.py: "Foreign non-TPU pods hold no
+          chips"). Counting every Running pod would let kube-proxy,
+          log collectors, and this agent itself absorb the external
+          tenant's chips one-for-one;
+        - ``claims_attributed=True`` (partial libtpu coverage: bound pods
+          were already label-charged onto the UNCOVERED chips by
+          ``_attribute_bound_pods``) absorbs nothing — the same claim must
+          not both occupy an uncovered chip and explain a covered chip's
+          hardware usage (it would hide a real external tenant). The cost
+          when the pod actually runs on a covered chip is one chip of
+          double-withholding — undercommit, never overcommit."""
+        from yoda_tpu.api.requests import LabelParseError, pod_request
+
+        hw_used = sum(
+            1 for c in tpu.chips if c.hw_read and c.hbm_free < c.hbm_total
+        )
+        if hw_used == 0:
+            return 0
+        if claims_attributed:
+            return hw_used
+        running_claims = 0
+        for pod in self.cluster.list_pods():
+            if pod.node_name != self.node_name or pod.phase != "Running":
+                continue
+            try:
+                req = pod_request(pod)
+            except LabelParseError:
+                # Malformed labels with a real device-plugin limit still
+                # attach chips (accounting.py parity).
+                if pod.tpu_resource_limit > 0:
+                    running_claims += pod.tpu_resource_limit
+                continue
+            if req.wants_tpu:
+                # pod_request folds google.com/tpu limits into chips, so
+                # wants_tpu covers resource-limit pods too (requests.py).
+                running_claims += req.effective_chips
+        return max(hw_used - running_claims, 0)
 
     def _attribute_bound_pods(self, tpu: TpuNodeMetrics, skip=frozenset()) -> None:
         """HBM attribution via the one shared occupancy model

@@ -163,6 +163,13 @@ class TpuChip:
     hbm_bandwidth_gbps: int = 0
     tflops_bf16: int = 0
     power_w: int = 0
+    # True when hbm_free/hbm_total were read from live hardware counters
+    # (PJRT memory_stats or the libtpu metrics service) rather than derived
+    # from the spec table + label accounting. Provenance for operators, and
+    # the agent's input for classifying unattributable usage into the
+    # node-level ``external_used_chips`` count the scheduler's reservation
+    # corrections key on (NativeTpuAgent._external_used).
+    hw_read: bool = False
 
     @property
     def healthy(self) -> bool:
@@ -187,6 +194,14 @@ class TpuNodeMetrics:
     # "env", "device-files", "jax-runtime+memstats") — lets operators tell
     # hardware-read metrics from spec-table fallbacks (VERDICT r2 #4).
     source: str = ""
+    # Hardware-read used chips whose consumption the agent could NOT
+    # attribute to any running pod on the node at scrape time — an
+    # external tenant / foreign process. The scheduler must treat these
+    # as occupied-by-nobody: they absorb no accountant reservation
+    # (filter_plugin.invisible_reservations) and earn no stale-freed
+    # credit. Always 0 for spec-table agents (their usage is label
+    # attribution by construction, so every used chip is pod-backed).
+    external_used_chips: int = 0
 
     @property
     def chip_count(self) -> int:
@@ -245,6 +260,7 @@ class TpuNodeMetrics:
                 "chipCount": self.chip_count,
                 "hbmFreeSum": self.hbm_free_sum,
                 "hbmTotalSum": self.hbm_total_sum,
+                "externalUsedChips": self.external_used_chips,
                 "chips": [asdict(c) for c in self.chips],
             },
         }
@@ -262,6 +278,7 @@ class TpuNodeMetrics:
             last_updated_unix=st.get("lastUpdatedUnix", 0.0),
             resource_version=int(obj["metadata"].get("resourceVersion", "0")),
             source=st.get("source", ""),
+            external_used_chips=st.get("externalUsedChips", 0),
         )
 
 

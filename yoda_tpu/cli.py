@@ -232,8 +232,18 @@ def _run_agent(args, stop: threading.Event) -> int:
             from yoda_tpu.agent.runtime import probe_devices
 
             runtime_fn = probe_devices
+        libtpu_fn = None
+        if args.libtpu_metrics:
+            from yoda_tpu.agent.tpu_metrics import query_hbm
+
+            addr = args.libtpu_metrics_addr
+            libtpu_fn = lambda: query_hbm(addr)  # noqa: E731
         agent = NativeTpuAgent(
-            cluster, node_name, lib=lib, runtime_devices_fn=runtime_fn
+            cluster,
+            node_name,
+            lib=lib,
+            runtime_devices_fn=runtime_fn,
+            libtpu_query_fn=libtpu_fn,
         )
         # Synthetic fallback, used per-iteration only when neither the
         # native library nor the runtime probe yields anything — real data
@@ -252,6 +262,7 @@ def _run_agent(args, stop: threading.Event) -> int:
             f"yoda-tpu-agent: publishing {node_name} every {args.interval_s}s "
             f"(native={collection_source(lib) if lib else 'unavailable'}"
             f" runtime-probe={'on' if runtime_fn else 'off'}"
+            f" libtpu-metrics={args.libtpu_metrics_addr if libtpu_fn else 'off'}"
             f" fake-fallback={'on' if fake else 'off'})",
             file=sys.stderr,
         )
@@ -333,6 +344,22 @@ def main(
         "the agent process — on configurations where libtpu acquires "
         "chips exclusively this locks out workload pods; enable only "
         "where multi-process access is configured (docs/OPERATIONS.md)",
+    )
+    agent.add_argument(
+        "--libtpu-metrics",
+        action="store_true",
+        help="read per-chip HBM total/usage with a typed GetRuntimeMetric "
+        "query against the libtpu runtime-metrics gRPC service (the "
+        "tpu-info endpoint) and overlay it onto the CR. Unlike "
+        "--runtime-probe this does NOT initialize the TPU runtime: the "
+        "service is served by whichever process owns the chips, so it is "
+        "the safe default on shared hosts; falls back silently when the "
+        "service is unreachable",
+    )
+    agent.add_argument(
+        "--libtpu-metrics-addr",
+        default="127.0.0.1:8431",
+        help="address of the libtpu runtime-metrics gRPC service",
     )
     agent.add_argument("--fake-generation", default="v5e")
     agent.add_argument("--fake-chips", type=int, default=4)

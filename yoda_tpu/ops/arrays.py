@@ -54,6 +54,10 @@ class FleetArrays:
     last_updated: np.ndarray      # float64 unix (for dynamic re-freshness)
     reserved_chips: np.ndarray    # int32 (chips held by in-flight pods)
     claimed_hbm_mib: np.ndarray   # int32 (HBM claimed by placed pods' labels)
+    ext_chips: np.ndarray         # int32 (hardware-read used chips with no
+                                  # running pod behind them — external
+                                  # tenants; absorb no reservation, earn no
+                                  # stale-freed credit)
     # [N, C] chip-level
     chip_valid: np.ndarray        # bool (false for padding columns)
     chip_healthy: np.ndarray      # bool
@@ -73,6 +77,24 @@ class FleetArrays:
         """Per-node count of healthy chips whose metrics show consumption
         (kernel_impl's apparently_used, host-side)."""
         return np.sum(self.chip_healthy & self.chip_used, axis=1).astype(np.int32)
+
+    def _neutral_reserved(self) -> np.ndarray:
+        """The reserved_chips pin that makes BOTH reservation corrections
+        vanish when no accounting source exists: metrics-visible usage
+        minus the external-tenant chips (kernel_impl: absorbable usage).
+        Pinning to raw apparently_used would leave invisible == ext_chips
+        and double-subtract externally-used chips (already outside
+        ``unused``). :meth:`_neutral_reserved_row` is the per-row form
+        (incremental updates) — one formula, two shapes."""
+        return np.clip(self._apparently_used() - self.ext_chips, 0, None).astype(
+            np.int32
+        )
+
+    def _neutral_reserved_row(self, i: int) -> int:
+        """Row form of :meth:`_neutral_reserved` for fill_row's O(C)
+        incremental path."""
+        used = int(np.sum(self.chip_healthy[i] & self.chip_used[i]))
+        return max(used - int(self.ext_chips[i]), 0)
 
     @property
     def padded_shape(self) -> tuple[int, int]:
@@ -113,6 +135,7 @@ class FleetArrays:
         last_updated = np.zeros(n_pad, dtype=np.float64)
         reserved = np.zeros(n_pad, dtype=np.int32)
         claimed = np.zeros(n_pad, dtype=np.int32)
+        ext_chips = np.zeros(n_pad, dtype=np.int32)
         chip_valid = np.zeros((n_pad, c_pad), dtype=bool)
         healthy = np.zeros((n_pad, c_pad), dtype=bool)
         chip_used = np.zeros((n_pad, c_pad), dtype=bool)
@@ -134,6 +157,7 @@ class FleetArrays:
             last_updated=last_updated,
             reserved_chips=reserved,
             claimed_hbm_mib=claimed,
+            ext_chips=ext_chips,
             chip_valid=chip_valid,
             chip_healthy=healthy,
             chip_used=chip_used,
@@ -198,6 +222,7 @@ class FleetArrays:
         self.claimed_hbm_mib[i] = min(
             _claimed_hbm_mib(ni), np.iinfo(np.int32).max
         )
+        self.ext_chips[i] = max(int(tpu.external_used_chips), 0)
         for j, chip in enumerate(tpu.chips[:c_pad]):
             self.chip_valid[i, j] = True
             self.chip_healthy[i, j] = chip.healthy
@@ -211,12 +236,10 @@ class FleetArrays:
         if reserved_fn is not None:
             self.reserved_chips[i] = reserved_fn(ni.name)
         else:
-            # No accounting: pin reserved to metrics-visible usage so
-            # the kernel's invisible-reservation and stale-freed
-            # corrections both vanish (kernel_impl comment).
-            self.reserved_chips[i] = int(
-                np.sum(self.chip_healthy[i] & self.chip_used[i])
-            )
+            # No accounting: pin reserved to the absorbable usage so the
+            # kernel's invisible-reservation and stale-freed corrections
+            # both vanish (kernel_impl comment).
+            self.reserved_chips[i] = self._neutral_reserved_row(i)
 
     def with_dynamic(
         self,
@@ -243,11 +266,11 @@ class FleetArrays:
             for i, name in enumerate(self.names):
                 reserved[i] = reserved_fn(name)
         else:
-            # No accounting source: pin reserved to the metrics-visible
-            # usage so the kernel's invisible-reservation AND stale-freed
+            # No accounting source: pin reserved to the absorbable usage
+            # so the kernel's invisible-reservation AND stale-freed
             # corrections both vanish (a fully-occupied node must not look
             # free just because nothing claims it — kernel_impl comment).
-            reserved = self._apparently_used()
+            reserved = self._neutral_reserved()
         out["reserved_chips"] = reserved
         if claimed_fn is not None:
             claimed = np.zeros_like(self.claimed_hbm_mib)
@@ -317,7 +340,7 @@ class FleetArrays:
         else:
             # No accounting: neutralize both reservation corrections (see
             # with_dynamic).
-            dyn[1] = self._apparently_used()
+            dyn[1] = self._neutral_reserved()
         cap = np.iinfo(np.int32).max
         if claimed_fn is not None:
             if isinstance(claimed_fn, _Mapping):

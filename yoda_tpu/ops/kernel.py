@@ -69,6 +69,7 @@ NODE_KEYS = (
     "generation_rank",
     "reserved_chips",
     "claimed_hbm_mib",
+    "ext_chips",
 )
 CHIP_KEYS = (
     "chip_valid",
@@ -85,7 +86,7 @@ CHIP_KEYS = (
 # Split of NODE_KEYS for the device-resident path (DeviceFleetKernel):
 # static per metrics version vs changing every scheduling cycle. DYN_KEYS
 # order defines the rows of the packed [4, N] dynamics array.
-STATIC_NODE_KEYS = ("node_valid", "in_slice", "generation_rank")
+STATIC_NODE_KEYS = ("node_valid", "in_slice", "generation_rank", "ext_chips")
 DYN_KEYS = ("fresh", "reserved_chips", "claimed_hbm_mib", "host_ok")
 
 
@@ -175,13 +176,25 @@ def kernel_impl(
     # hasn't re-scraped — filter_plugin.stale_freed_chips) are added back
     # at full HBM, gated on qualifying-when-full.
     apparently_used = jnp.sum(healthy & a["chip_used"], axis=1)
-    invisible = jnp.clip(a["reserved_chips"] - apparently_used, 0)
-    stale_freed = jnp.clip(apparently_used - a["reserved_chips"], 0)
-    # WHICH used chips are free is unknown: worst case, the remaining live
-    # claims sit on qualifying used chips first (filter_plugin.
-    # stale_freed_chips parity). No-accounting callers neutralize both
-    # corrections by passing reserved_chips == apparently_used
-    # (ops.arrays.dyn_packed / with_dynamic).
+    # External-tenant chips (hardware-read usage no running pod explains —
+    # api/types.py external_used_chips) are occupied-by-nobody: they absorb
+    # no reservation (else a reservation on a genuinely-free chip would be
+    # cancelled by a foreign tenant's usage and the node overcommits) and
+    # they are never stale-freed (their usage is live truth, not a
+    # deletion awaiting re-scrape).
+    absorbable = jnp.clip(apparently_used - a["ext_chips"], 0)
+    invisible = jnp.clip(a["reserved_chips"] - absorbable, 0)
+    stale_freed = jnp.clip(absorbable - a["reserved_chips"], 0)
+    # WHICH used chips are free is unknown: worst case, the external
+    # chips and remaining live claims sit on qualifying used chips first
+    # (filter_plugin.stale_freed_chips parity). External-tenant chips are
+    # excluded from both the stale count and the candidates; hardware-read
+    # chips whose usage was OURS stay creditable (a deleted pod's HBM
+    # lingers in the counters until re-scrape — the same stale class, and
+    # preemption's post-eviction simulation depends on the credit).
+    # No-accounting callers neutralize both corrections by passing
+    # reserved_chips == absorbable, i.e. apparently_used - ext_chips
+    # (ops.arrays._neutral_reserved, used by dyn_packed / with_dynamic).
     freed_candidates = jnp.sum(
         healthy
         & a["chip_used"]
@@ -189,6 +202,7 @@ def kernel_impl(
         & (a["hbm_total_mib"] >= hbm_mib),
         axis=1,
     )
+    freed_candidates = jnp.clip(freed_candidates - a["ext_chips"], 0)
     freed = jnp.minimum(
         stale_freed, jnp.clip(freed_candidates - a["reserved_chips"], 0)
     )

@@ -48,8 +48,9 @@ from yoda_tpu.ops.kernel import (
 
 # Row order of the stacked [9, C, N] chip-grid input.
 _CHIP_ROWS = CHIP_KEYS  # (valid, healthy, used, free, total, clock, bw, tflops, power)
-# Row order of the stacked node-vector input (padded to 8 sublanes).
-_NODE_ROWS = NODE_KEYS  # (valid, in_slice, fresh, host_ok, gen, reserved, claimed)
+_N_CHIP_ROWS = len(_CHIP_ROWS)
+# Row order of the stacked node-vector input (exactly the 8 sublanes).
+_NODE_ROWS = NODE_KEYS  # (valid, in_slice, fresh, host_ok, gen, reserved, claimed, ext)
 
 _LANES = 128     # last-dim tile
 _SUBLANES = 8    # int32 sublane tile
@@ -93,6 +94,7 @@ def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
     node_gen = nodes[4]
     reserved = nodes[5]
     claimed = nodes[6]
+    ext_chips = nodes[7]
 
     hbm_ok = healthy & (free >= hbm_mib)
     clock_ok = healthy & (clock >= clock_mhz)
@@ -105,10 +107,15 @@ def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
     count_hbm = rows(hbm_ok)
     count_clock = rows(clock_ok)
     apparently_used = rows(healthy & used)
-    invisible = jnp.clip(reserved - apparently_used, 0)
-    stale_freed = jnp.clip(apparently_used - reserved, 0)
-    freed_candidates = rows(
-        healthy & used & (clock >= clock_mhz) & (total >= hbm_mib)
+    # kernel_impl parity: external-tenant chips absorb no reservation and
+    # earn no stale-freed credit.
+    absorbable = jnp.clip(apparently_used - ext_chips, 0)
+    invisible = jnp.clip(reserved - absorbable, 0)
+    stale_freed = jnp.clip(absorbable - reserved, 0)
+    freed_candidates = jnp.clip(
+        rows(healthy & used & (clock >= clock_mhz) & (total >= hbm_mib))
+        - ext_chips,
+        0,
     )
     freed = jnp.minimum(stale_freed, jnp.clip(freed_candidates - reserved, 0))
     count_avail = rows(qual & ~used)
@@ -220,14 +227,14 @@ def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
 def _pallas_eval(chips, nodes, reqv, *, weights: Weights, block_n: int, interpret: bool):
     """chips [9, Cp, Np] int32, nodes [8, Np] int32, reqv (5,) int32 ->
     out [8, Np] int32 (rows: feasible, reasons, raw, claimable)."""
-    _, cp, n_pad = chips.shape
+    n_rows, cp, n_pad = chips.shape
     nb = n_pad // block_n
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(2, nb),
         in_specs=[
             pl.BlockSpec(
-                (9, cp, block_n), lambda p, j, req: (0, 0, j)
+                (n_rows, cp, block_n), lambda p, j, req: (0, 0, j)
             ),
             pl.BlockSpec((8, block_n), lambda p, j, req: (0, j)),
         ],
@@ -251,7 +258,7 @@ def _stack_inputs(a: dict, *, block_n: int) -> tuple[np.ndarray, np.ndarray]:
     n, c = a["chip_valid"].shape
     n_pad = _pad_to(max(n, 1), block_n)
     c_pad = _pad_to(max(c, 1), _SUBLANES)
-    chips = np.zeros((9, c_pad, n_pad), dtype=np.int32)
+    chips = np.zeros((_N_CHIP_ROWS, c_pad, n_pad), dtype=np.int32)
     for i, k in enumerate(_CHIP_ROWS):
         chips[i, :c, :n] = np.asarray(a[k], dtype=np.int32).T
     nodes = np.zeros((8, n_pad), dtype=np.int32)

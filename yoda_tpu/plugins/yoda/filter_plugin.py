@@ -206,9 +206,18 @@ def apparently_used_chips(node: TpuNodeMetrics) -> int:
     return sum(1 for c in node.chips if c.healthy and c.hbm_free < c.hbm_total)
 
 
+def absorbable_used_chips(node: TpuNodeMetrics) -> int:
+    """Used chips that can stand in for an accountant reservation: visible
+    usage minus the agent-reported external-tenant chips
+    (``TpuNodeMetrics.external_used_chips`` — hardware-read usage no
+    running pod explains). A foreign tenant's chip must not cancel a
+    reservation that actually sits on a different, still-free chip."""
+    return max(apparently_used_chips(node) - node.external_used_chips, 0)
+
+
 def invisible_reservations(node: TpuNodeMetrics, reserved: int) -> int:
     """Reservations not yet reflected in the node's published metrics."""
-    return max(reserved - apparently_used_chips(node), 0)
+    return max(reserved - absorbable_used_chips(node), 0)
 
 
 def stale_freed_chips(
@@ -230,12 +239,21 @@ def stale_freed_chips(
     A freed chip returns to full HBM (exclusive-chip model), so it counts
     only if it would qualify when full (healthy, clock ok, total HBM >= the
     per-chip ask) — and WHICH used chips are free is unknown, so the worst
-    case is assumed: the remaining live claims sit on the qualifying used
-    chips first, leaving only the surplus beyond ``reserved`` creditable."""
+    case is assumed: the external-tenant chips
+    (``TpuNodeMetrics.external_used_chips``) and the remaining live claims
+    sit on the qualifying used chips first, leaving only the surplus
+    creditable. External chips are excluded from BOTH the stale count
+    (via :func:`absorbable_used_chips`) and the candidates: their usage is
+    live truth owned by a foreign process, not a deletion awaiting a
+    re-scrape. Hardware-read chips whose usage WAS ours stay creditable —
+    a deleted pod's HBM lingers in the hardware counters until the
+    process exits and the agent re-scrapes, the same stale-data class as
+    label attribution, and preemption's post-eviction simulation
+    (preemption.py ``_avail_after``) depends on that credit."""
     if reserved is None:
         return 0
     reserved = max(reserved, 0)
-    stale = apparently_used_chips(node) - reserved
+    stale = absorbable_used_chips(node) - reserved
     if stale <= 0:
         return 0
     candidates = sum(
@@ -246,6 +264,7 @@ def stale_freed_chips(
         and c.clock_mhz >= req.min_clock_mhz
         and c.hbm_total >= req.hbm_per_chip
     )
+    candidates = max(candidates - node.external_used_chips, 0)
     return min(stale, max(candidates - reserved, 0))
 
 
