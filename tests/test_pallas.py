@@ -108,6 +108,76 @@ class TestParity:
         np.testing.assert_array_equal(got.feasible, want2.feasible)
 
 
+class TestBurstParity:
+    """evaluate_burst on the Pallas kernel (VERDICT r4 #2): K requests in
+    one Mosaic dispatch, bit-identical to the XLA burst path and to K
+    independent single-request evaluations."""
+
+    def _dyn(self, arrays):
+        return np.stack(
+            [
+                np.asarray(arrays.fresh, dtype=np.int32),
+                np.asarray(arrays.reserved_chips, dtype=np.int32),
+                np.asarray(arrays.claimed_hbm_mib, dtype=np.int32),
+                np.asarray(arrays.host_ok, dtype=np.int32),
+            ]
+        )
+
+    def test_matches_xla_burst(self):
+        from yoda_tpu.ops.kernel import DeviceFleetKernel
+
+        arrays = random_arrays(37, seed=7)
+        dyn = self._dyn(arrays)
+        n_pad = arrays.node_valid.shape[0]
+        rng = np.random.default_rng(11)
+        # Per-request admission rows, incl. an all-False padding row (the
+        # batcher's bucket-padding convention).
+        host_ok_k = (rng.random((4, n_pad)) > 0.3).astype(np.int32)
+        host_ok_k[3] = 0
+        requests = list(REQUESTS)
+
+        want_kern = DeviceFleetKernel(Weights())
+        want_kern.put_static(arrays)
+        want = want_kern.evaluate_burst(dyn, host_ok_k, requests)
+
+        got_kern = PallasFleetKernel(Weights(), interpret=True)
+        got_kern.put_static(arrays)
+        got = got_kern.evaluate_burst(dyn, host_ok_k, requests)
+
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g.feasible, w.feasible)
+            np.testing.assert_array_equal(g.reasons, w.reasons)
+            np.testing.assert_array_equal(g.raw_scores, w.raw_scores)
+            np.testing.assert_array_equal(g.scores, w.scores)
+            np.testing.assert_array_equal(g.claimable, w.claimable)
+            assert g.best_index == w.best_index
+
+    def test_burst_matches_single_requests(self):
+        """Each burst slot must equal the single-request kernel fed the
+        same admission row — the per-request SMEM maxima re-init is what
+        this asserts (a stale maximum from slot k-1 would skew slot k's
+        normalization)."""
+        arrays = random_arrays(150, seed=8)
+        dyn = self._dyn(arrays)
+        n_pad = arrays.node_valid.shape[0]
+        rng = np.random.default_rng(12)
+        host_ok_k = (rng.random((3, n_pad)) > 0.2).astype(np.int32)
+        requests = [
+            KernelRequest(1, 0, 0, 0, 0),
+            KernelRequest(4, 8 * 1024, 900, 0, 0),
+            KernelRequest(2, 1024, 0, 5, 1),
+        ]
+        kern = PallasFleetKernel(Weights(), interpret=True, block_n=128)
+        kern.put_static(arrays)
+        burst = kern.evaluate_burst(dyn, host_ok_k, requests)
+        for i, req in enumerate(requests):
+            one = np.stack([dyn[0], dyn[1], dyn[2], host_ok_k[i]])
+            single = kern.evaluate(one, req)
+            np.testing.assert_array_equal(burst[i].scores, single.scores)
+            np.testing.assert_array_equal(burst[i].reasons, single.reasons)
+            assert burst[i].best_index == single.best_index
+
+
 class TestPallasBackendE2E:
     def test_stack_schedules_with_pallas_kernel(self):
         # kernel_backend="pallas" drives the whole scheduling stack through
@@ -131,6 +201,35 @@ class TestPallasBackendE2E:
         stack.scheduler.run_until_idle(max_wall_s=30)
         for i in range(3):
             assert stack.cluster.get_pod(f"default/p{i}").node_name
+
+    def test_pallas_composes_with_burst(self):
+        """kernel_backend=pallas + batch_requests: K pods ride ONE Mosaic
+        dispatch (pre-r5 the batcher silently declined and dispatched
+        per pod — VERDICT r4 #2/weak-3)."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(
+            config=SchedulerConfig(
+                mode="batch", kernel_backend="pallas", batch_requests=8
+            )
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for h in range(4):
+            agent.add_host(f"h{h}", chips=8)
+        agent.publish_all()
+        for i in range(8):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", labels={"tpu/chips": "2", "tpu/hbm": "2Gi"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        for i in range(8):
+            assert stack.cluster.get_pod(f"default/p{i}").node_name
+        batch = stack.framework.batch_plugins[0]
+        assert batch.burst_dispatches >= 1
+        assert batch.burst_served >= 6  # K pods amortized one dispatch
 
     def test_pallas_excludes_mesh(self):
         from yoda_tpu.config import SchedulerConfig

@@ -68,14 +68,15 @@ def _pad_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
-    """One grid step. ``req`` is the scalar-prefetch (5,) request vector;
-    ``chips`` a (9, Cp, BN) VMEM block; ``nodes`` an (8, BN) VMEM block;
-    ``out`` an (8, BN) VMEM block; ``maxima`` a (8,) SMEM scratch holding
-    the six collection maxima across sequential grid steps."""
-    phase = pl.program_id(0)
-    j = pl.program_id(1)
-
+def _eval_block(
+    req, chips, nodes, host_ok, store, maxima, phase, j, *, weights: Weights
+):
+    """The shared per-block computation. ``req`` is this request's (5,)
+    scalar vector; ``chips`` a (9, Cp, BN) VMEM block; ``nodes`` an
+    (8, BN) VMEM block (its host_ok row is superseded by the ``host_ok``
+    (BN,) mask — per-request in the burst variant); ``store(row, value)``
+    writes one output row; ``maxima`` an (8,) SMEM scratch holding the six
+    collection maxima across sequential grid steps of one request."""
     number = req[0]
     hbm_mib = req[1]
     clock_mhz = req[2]
@@ -90,7 +91,6 @@ def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
 
     node_valid = nodes[0] > 0
     fresh = nodes[2] > 0
-    host_ok = nodes[3] > 0
     node_gen = nodes[4]
     reserved = nodes[5]
     claimed = nodes[6]
@@ -213,12 +213,46 @@ def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
         claimable = jnp.clip(count_avail + freed - invisible, 0).astype(
             jnp.int32
         )
-        out[0] = feasible.astype(jnp.int32)
-        out[1] = reasons
-        out[2] = raw
-        out[3] = claimable
+        store(0, feasible.astype(jnp.int32))
+        store(1, reasons)
+        store(2, raw)
+        store(3, claimable)
         for r in range(4, 8):
-            out[r] = jnp.zeros_like(raw)
+            store(r, jnp.zeros_like(raw))
+
+
+def _kernel_body(req, chips, nodes, out, maxima, *, weights: Weights):
+    """Single-request body: grid (phase, node-block)."""
+    phase = pl.program_id(0)
+    j = pl.program_id(1)
+
+    def store(r, v):
+        out[r] = v
+
+    _eval_block(
+        req, chips, nodes, nodes[3] > 0, store, maxima, phase, j,
+        weights=weights,
+    )
+
+
+def _kernel_body_burst(reqs, chips, nodes, host_ok, out, maxima, *, weights: Weights):
+    """K-request body: grid (request, phase, node-block). The chip grids
+    and shared node rows are revisited per request (they stay VMEM-resident
+    across the sequential TPU grid); ``host_ok`` carries each request's own
+    admission row, and the SMEM maxima re-initialize at each request's
+    phase-0 first block, so every request gets its own collection pass —
+    bit-identical to K independent single-request dispatches."""
+    k = pl.program_id(0)
+    phase = pl.program_id(1)
+    j = pl.program_id(2)
+
+    def store(r, v):
+        out[0, r] = v
+
+    _eval_block(
+        reqs[k], chips, nodes, host_ok[0] > 0, store, maxima, phase, j,
+        weights=weights,
+    )
 
 
 @functools.partial(
@@ -250,6 +284,48 @@ def _pallas_eval(chips, nodes, reqv, *, weights: Weights, block_n: int, interpre
             dimension_semantics=("arbitrary", "arbitrary")
         ),
     )(reqv, chips, nodes)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weights", "block_n", "interpret")
+)
+def _pallas_eval_burst(
+    chips, nodes, host_ok_k, reqs_k, *, weights: Weights, block_n: int, interpret: bool
+):
+    """K requests against one fleet in ONE Mosaic dispatch (VERDICT r4 #2):
+    chips [9, Cp, Np] int32, nodes [8, Np] int32 (shared rows; its host_ok
+    row is ignored), host_ok_k [K, Np] int32 per-request admission, reqs_k
+    [K, 5] int32 -> out [K, 8, Np] int32. The request axis is an OUTER
+    grid dimension, so the two-phase collection runs per request over the
+    same VMEM-resident fleet blocks — the kernel_packed_burst analog with
+    an explicit grid instead of vmap."""
+    n_rows, cp, n_pad = chips.shape
+    k_pad = reqs_k.shape[0]
+    nb = n_pad // block_n
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k_pad, 2, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (n_rows, cp, block_n), lambda k, p, j, reqs: (0, 0, j)
+            ),
+            pl.BlockSpec((8, block_n), lambda k, p, j, reqs: (0, j)),
+            pl.BlockSpec((1, block_n), lambda k, p, j, reqs: (k, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, block_n), lambda k, p, j, reqs: (k, 0, j)
+        ),
+        scratch_shapes=[pltpu.SMEM((8,), jnp.int32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_body_burst, weights=weights),
+        out_shape=jax.ShapeDtypeStruct((k_pad, 8, n_pad), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")
+        ),
+    )(reqs_k, chips, nodes, host_ok_k)
 
 
 def _stack_inputs(a: dict, *, block_n: int) -> tuple[np.ndarray, np.ndarray]:
@@ -369,6 +445,47 @@ class PallasFleetKernel:
             interpret=self.interpret,
         )
         return _epilogue(self._arrays, np.asarray(out), request, self.weights)
+
+    def evaluate_burst(
+        self,
+        dyn: np.ndarray,            # [4, N] int32 (row 3, host_ok, unused)
+        host_ok_k: np.ndarray,      # [K, N] per-request admission
+        requests: "list[KernelRequest]",
+    ) -> "list[KernelResult]":
+        """K requests in ONE Mosaic dispatch — the Pallas analog of
+        DeviceFleetKernel.evaluate_burst (same contract: K is the caller's
+        compile bucket, padding rows carry all-False host_ok). Closes the
+        kernel_backend=pallas + batch_requests composition gap (pre-r5 the
+        batcher silently fell back to per-pod dispatch)."""
+        if self._chips is None or self._arrays is None:
+            raise RuntimeError("put_static() must run before evaluate_burst()")
+        n = len(self._names)
+        n_pad = self._nodes_static.shape[1]
+        nodes = self._nodes_static.copy()
+        nodes[2, :n] = dyn[0, :n]
+        nodes[5, :n] = dyn[1, :n]
+        nodes[6, :n] = dyn[2, :n]
+        k = len(requests)
+        hk = np.zeros((k, n_pad), dtype=np.int32)
+        hk[:, : host_ok_k.shape[1]] = np.asarray(host_ok_k, dtype=np.int32)[
+            :, :n_pad
+        ]
+        reqs_k = np.stack([pack_request(r) for r in requests])
+        out = np.asarray(
+            _pallas_eval_burst(
+                self._chips,
+                nodes,
+                hk,
+                reqs_k,
+                weights=self.weights,
+                block_n=self.block_n,
+                interpret=self.interpret,
+            )
+        )
+        return [
+            _epilogue(self._arrays, out[i], requests[i], self.weights)
+            for i in range(k)
+        ]
 
 
 def fused_filter_score_pallas(

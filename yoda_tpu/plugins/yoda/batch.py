@@ -648,8 +648,10 @@ class YodaBatch(BatchFilterScorePlugin):
         preconditions for cheap, safe serving don't hold: no accounting
         (spot-checks impossible), uncacheable snapshot, in-flight gang
         placements or fleet-wide inter-pod terms (per-pod evaluators would
-        be required), or a kernel without a burst path (pallas; the
-        mesh-sharded kernel HAS one — parallel.sharded.evaluate_burst)."""
+        be required). Every kernel backend has a burst path: XLA
+        (kernel_packed_burst), mesh-sharded (parallel.sharded), and
+        Pallas/Mosaic (ops.pallas_kernel evaluate_burst); the hasattr
+        gate below guards only future kernels that lack one."""
         self._burst = None
         if (
             self.batch_requests <= 1
