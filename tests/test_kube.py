@@ -674,3 +674,81 @@ class TestPvcRelist:
             assert informer.watches_pvcs is True
         finally:
             kc.stop()
+
+
+class TestPdbWatch:
+    """PodDisruptionBudget watch (VERDICT r4 #3): budgets flow to the
+    informer over the wire, and RBAC skew degrades the violation
+    preference to off instead of blocking sync."""
+
+    def test_pdb_flows_and_sentinel_upgrades_informer(self, server, cluster):
+        from yoda_tpu.api.affinity import LabelSelector
+        from yoda_tpu.api.types import K8sPdb
+        from yoda_tpu.cluster.informer import InformerCache
+
+        server.put_object(
+            "PodDisruptionBudget", "default/db",
+            K8sPdb(
+                "db",
+                selector=LabelSelector(match_labels=(("app", "db"),)),
+                min_available=1,
+            ).to_obj(),
+        )
+        informer = InformerCache()
+        assert informer.watches_pdbs is False
+        assert informer.list_pdbs() is None
+        cluster.add_watcher(informer.handle)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pdbs = informer.list_pdbs()
+            if informer.watches_pdbs and pdbs:
+                break
+            time.sleep(0.02)
+        assert informer.watches_pdbs is True
+        (pdb,) = informer.list_pdbs()
+        assert pdb.key == "default/db"
+        assert pdb.min_available == 1
+        assert pdb.matches(PodSpec("p", labels={"app": "db"}))
+
+    def test_pdb_403_degrades_to_no_preference(self):
+        import threading as _threading
+
+        from yoda_tpu.cluster.informer import InformerCache
+
+        class _Api:
+            class config:
+                watch_timeout_s = 1
+
+            def request(self, method, path, **kw):
+                if path.startswith(
+                    ("/apis/policy/v1/poddisruptionbudgets",
+                     "/api/v1/persistentvolumeclaims")
+                ):
+                    raise KubeApiError(403, "forbidden")
+                return {"items": [], "metadata": {"resourceVersion": "1"}}
+
+            def watch(self, path, *, params=None):
+                _threading.Event().wait(0.05)
+                return iter(())
+
+        kc = KubeCluster(_Api(), backoff_initial_s=0.05, backoff_max_s=0.2)
+        informer = InformerCache()
+        kc.add_watcher(informer.handle)
+        kc.start()
+        try:
+            assert kc.wait_for_sync(10.0), "403 on PDBs blocked sync"
+            time.sleep(0.3)
+            assert informer.watches_pdbs is False
+            assert informer.list_pdbs() is None
+            # PRODUCTION ordering (cli.py): the informer registers AFTER
+            # start()+wait_for_sync(). The degraded target set `synced`
+            # to unblock sync — the late-watcher replay must NOT turn
+            # that into a liveness sentinel (enforcement over no data).
+            late = InformerCache()
+            kc.add_watcher(late.handle)
+            assert late.watches_pdbs is False
+            assert late.list_pdbs() is None
+            assert late.watches_pvcs is False
+            assert late.snapshot().pvcs is None
+        finally:
+            kc.stop()

@@ -76,6 +76,11 @@ class TestSchedulerManifest:
         assert not {"create", "update", "delete"} & rules[
             ("", "persistentvolumeclaims")
         ]
+        # PDB watch feeds preemption's victim-violation preference.
+        assert {"list", "watch"} <= rules[("policy", "poddisruptionbudgets")]
+        assert not {"create", "update", "delete"} & rules[
+            ("policy", "poddisruptionbudgets")
+        ]
         assert {"list", "watch"} <= rules[(GROUP, "tpunodemetrics")]
         # write_event POSTs then PUTs (count aggregation) — cluster/events.py.
         assert {"create", "update"} <= rules[("", "events")]

@@ -641,3 +641,235 @@ class TestPreemptionPolicyNever:
         # Round-trips the wire shape.
         p = stack.cluster.get_pod("default/polite")
         assert PodSpec.from_obj(p.to_obj()).preemption_policy == "Never"
+
+
+class TestPdbAwarePreemption:
+    """Upstream DefaultPreemption's PDB-violation preference (inherited by
+    the reference via pkg/register/register.go:10; VERDICT r4 #3): victim
+    sets that violate no PodDisruptionBudget win, both across nodes and
+    within one node's eviction ordering."""
+
+    @staticmethod
+    def _pdb(name, match, **kw):
+        from yoda_tpu.api.affinity import LabelSelector
+        from yoda_tpu.api.types import K8sPdb
+
+        return K8sPdb(
+            name,
+            selector=LabelSelector(match_labels=tuple(sorted(match.items()))),
+            **kw,
+        )
+
+    def test_allowed_disruptions_math(self):
+        from yoda_tpu.api.types import K8sPdb
+
+        assert K8sPdb("a", disruptions_allowed=2).allowed_disruptions(9) == 2
+        assert K8sPdb("b", min_available=3).allowed_disruptions(5) == 2
+        assert K8sPdb("c", min_available=5).allowed_disruptions(5) == 0
+        # minAvailable % rounds UP (conservative): 50% of 5 -> 3 must stay.
+        assert K8sPdb("d", min_available="50%").allowed_disruptions(5) == 2
+        # maxUnavailable % rounds DOWN: 50% of 5 -> 2 may go.
+        assert K8sPdb("e", max_unavailable="50%").allowed_disruptions(5) == 2
+        assert K8sPdb("f", max_unavailable=1).allowed_disruptions(4) == 1
+        # Published status dominates any spec derivation.
+        assert (
+            K8sPdb("g", min_available=1, disruptions_allowed=0)
+            .allowed_disruptions(10) == 0
+        )
+
+    def test_selector_semantics(self):
+        from yoda_tpu.api.affinity import LabelSelector
+        from yoda_tpu.api.types import K8sPdb
+
+        pod = PodSpec("p", labels={"app": "db"})
+        assert self._pdb("m", {"app": "db"}).matches(pod)
+        assert not self._pdb("m", {"app": "web"}).matches(pod)
+        # Empty selector ({}) matches all pods in the namespace (policy/v1);
+        # absent selector matches none.
+        assert K8sPdb("all", selector=LabelSelector()).matches(pod)
+        assert not K8sPdb("none", selector=None).matches(pod)
+        other_ns = PodSpec("q", namespace="prod", labels={"app": "db"})
+        assert not self._pdb("m", {"app": "db"}).matches(other_ns)
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_routes_around_pdb_protected_cheapest_victim(self, mode):
+        """The cheapest victim (lowest priority) is PDB-protected: the
+        plan must pick the other node instead of looping on eviction
+        refusals (pre-r5: no PDB watch, the 429 retry path was the only
+        signal)."""
+        stack, agent = make_stack(mode)
+        agent.add_host("host-a", generation="v5e", chips=2)
+        agent.add_host("host-b", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "cheap",
+                labels={"tpu/chips": "2", "tpu/priority": "1"},
+                node_selector={"kubernetes.io/hostname": "host-a"},
+            )
+        )
+        stack.cluster.create_pod(
+            PodSpec(
+                "pricey",
+                labels={"tpu/chips": "2", "tpu/priority": "3"},
+                node_selector={"kubernetes.io/hostname": "host-b"},
+            )
+        )
+        from yoda_tpu.api.types import K8sNode
+
+        stack.cluster.put_node(
+            K8sNode("host-a", labels={"kubernetes.io/hostname": "host-a"})
+        )
+        stack.cluster.put_node(
+            K8sNode("host-b", labels={"kubernetes.io/hostname": "host-b"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/cheap").node_name == "host-a"
+        assert stack.cluster.get_pod("default/pricey").node_name == "host-b"
+        # "cheap" is protected: one matching pod, all must stay available.
+        stack.cluster.put_pdb(self._pdb("protect-cheap", {"tpu/priority": "1"},
+                                        min_available=1))
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "9"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/train").node_name == "host-b"
+        assert stack.cluster.get_pod("default/cheap") is not None  # survived
+        assert stack.cluster.get_pod("default/pricey") is None     # evicted
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_defers_protected_victim_within_node(self, mode):
+        """Within one node, a PDB-protected victim is deferred behind a
+        non-protected one even when the protected pod is lower priority
+        (upstream's reprieve preference)."""
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("guarded", labels={"tpu/chips": "2", "tpu/priority": "1",
+                                       "app": "db"})
+        )
+        stack.cluster.create_pod(
+            PodSpec("plain", labels={"tpu/chips": "2", "tpu/priority": "2"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.put_pdb(self._pdb("db", {"app": "db"}, min_available=1))
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "9"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/train").node_name == "host"
+        assert stack.cluster.get_pod("default/guarded") is not None
+        assert stack.cluster.get_pod("default/plain") is None
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_exhausted_budget_still_attempted_as_last_resort(self, mode):
+        """When ONLY protected victims exist the plan still goes to the
+        eviction API (upstream evicts violating victims when nothing else
+        frees capacity) — and the API's refusal leaves the preemptor
+        pending, not crashed."""
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("guarded", labels={"tpu/chips": "2", "tpu/priority": "1",
+                                       "app": "db"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.put_pdb(self._pdb("db", {"app": "db"}, min_available=1))
+        stack.cluster.create_pod(
+            PodSpec("train", labels={"tpu/chips": "2", "tpu/priority": "9"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # FakeCluster.evict_pod enforces the budget: refusal, no eviction.
+        assert stack.cluster.get_pod("default/guarded") is not None
+        assert stack.cluster.get_pod("default/train").node_name is None
+
+
+class TestHostPortPreemption:
+    """Upstream parity (VERDICT r4 #3b / weak-4): a hostPort conflict IS
+    curable — the conflicting holder joins the victim set instead of the
+    node being skipped (the pre-r5 conservative divergence)."""
+
+    PORTS = ((8471, "TCP", "0.0.0.0"),)
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_port_holder_joins_victim_set(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "holder",
+                labels={"tpu/chips": "1", "tpu/priority": "1"},
+                host_ports=self.PORTS,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/holder").node_name == "host"
+        # Chips are FREE (3 remain) — only the port blocks the preemptor.
+        stack.cluster.create_pod(
+            PodSpec(
+                "train",
+                labels={"tpu/chips": "1", "tpu/priority": "9"},
+                host_ports=self.PORTS,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/holder") is None      # evicted
+        assert stack.cluster.get_pod("default/train").node_name == "host"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_higher_priority_port_holder_is_incurable(self, mode):
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "holder",
+                labels={"tpu/chips": "1", "tpu/priority": "9"},
+                host_ports=self.PORTS,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec(
+                "late",
+                labels={"tpu/chips": "1", "tpu/priority": "5"},
+                host_ports=self.PORTS,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/holder").node_name == "host"
+        assert stack.cluster.get_pod("default/late").node_name is None
+        assert stack.preemption.preempted_total == 0
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_port_cure_also_buys_chips_when_needed(self, mode):
+        """Port holder + a full node: the blocker AND enough chip victims
+        are evicted in one plan."""
+        stack, agent = make_stack(mode)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec(
+                "holder",
+                labels={"tpu/chips": "1", "tpu/priority": "2"},
+                host_ports=self.PORTS,
+            )
+        )
+        stack.cluster.create_pod(
+            PodSpec("filler", labels={"tpu/chips": "1", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        stack.cluster.create_pod(
+            PodSpec(
+                "train",
+                labels={"tpu/chips": "2", "tpu/priority": "9"},
+                host_ports=self.PORTS,
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/train").node_name == "host"
+        assert stack.cluster.get_pod("default/holder") is None
+        assert stack.cluster.get_pod("default/filler") is None

@@ -518,6 +518,102 @@ class K8sPvc:
 
 
 @dataclass
+class K8sPdb:
+    """The scheduler-relevant slice of a policy/v1 PodDisruptionBudget.
+
+    Upstream DefaultPreemption (inherited by the reference via
+    pkg/register/register.go:10) prefers candidate victim sets that
+    violate no PDB; this type carries what that check needs: the pod
+    selector and the disruption allowance. ``disruptions_allowed`` is
+    ``status.disruptionsAllowed`` when the disruption controller has
+    published it — the authoritative number; otherwise the allowance is
+    derived from spec against the CURRENT matching-pod count (an
+    approximation of the controller's expectedPods, adequate for victim
+    *preference* — the eviction API remains the enforcement point).
+
+    policy/v1 selector semantics: an empty selector ({}) matches every
+    pod in the namespace; an absent selector matches none (modeled as
+    ``selector=None``)."""
+
+    name: str
+    namespace: str = "default"
+    selector: "Any | None" = None            # affinity.LabelSelector | None
+    min_available: "int | str | None" = None      # int or "N%"
+    max_unavailable: "int | str | None" = None    # int or "N%"
+    disruptions_allowed: int | None = None        # status, when published
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def matches(self, pod: "PodSpec") -> bool:
+        if pod.namespace != self.namespace or self.selector is None:
+            return False
+        return self.selector.matches(pod.labels)
+
+    def allowed_disruptions(self, matching_running: int) -> int:
+        """How many matching pods may be evicted right now. Percentage
+        fields scale against ``matching_running`` (minAvailable rounds
+        up, maxUnavailable rounds down — upstream's conservative
+        directions)."""
+        if self.disruptions_allowed is not None:
+            return max(int(self.disruptions_allowed), 0)
+
+        def scaled(v, *, round_up: bool) -> int:
+            if isinstance(v, str) and v.endswith("%"):
+                pct = int(v[:-1])
+                exact = matching_running * pct / 100.0
+                return int(-(-exact // 1)) if round_up else int(exact)
+            return int(v)
+
+        if self.max_unavailable is not None:
+            return max(
+                min(scaled(self.max_unavailable, round_up=False), matching_running),
+                0,
+            )
+        if self.min_available is not None:
+            return max(
+                matching_running - scaled(self.min_available, round_up=True), 0
+            )
+        return matching_running  # no constraint declared
+
+    def to_obj(self) -> dict[str, Any]:
+        spec: dict[str, Any] = {}
+        if self.selector is not None:
+            spec["selector"] = self.selector.to_obj()
+        if self.min_available is not None:
+            spec["minAvailable"] = self.min_available
+        if self.max_unavailable is not None:
+            spec["maxUnavailable"] = self.max_unavailable
+        out: dict[str, Any] = {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": spec,
+        }
+        if self.disruptions_allowed is not None:
+            out["status"] = {"disruptionsAllowed": self.disruptions_allowed}
+        return out
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "K8sPdb":
+        # Deferred import: affinity builds on this module's selector types.
+        from yoda_tpu.api.affinity import LabelSelector
+
+        md = obj.get("metadata", {})
+        spec = obj.get("spec") or {}
+        status = obj.get("status") or {}
+        return cls(
+            name=md["name"],
+            namespace=md.get("namespace", "default"),
+            selector=LabelSelector.from_obj(spec.get("selector")),
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
+            disruptions_allowed=status.get("disruptionsAllowed"),
+        )
+
+
+@dataclass
 class K8sNamespace:
     """The scheduler-relevant slice of a v1.Namespace: its labels, which
     pod-affinity ``namespaceSelector`` terms select over (api.affinity).

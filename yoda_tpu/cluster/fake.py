@@ -14,18 +14,23 @@ from typing import Callable, Literal
 from yoda_tpu.api.types import (
     K8sNamespace,
     K8sNode,
+    K8sPdb,
     K8sPvc,
     PodSpec,
     TpuNodeMetrics,
 )
 
-EventType = Literal["added", "modified", "deleted"]
+# "synced" is a per-kind liveness sentinel (KubeCluster emits it once a
+# kind's LIST succeeded — the informer's RBAC-degradation gates key on it).
+EventType = Literal["added", "modified", "deleted", "synced"]
 
 
 @dataclass(frozen=True)
 class Event:
     type: EventType
-    kind: str  # "Pod" | "TpuNodeMetrics" | "Node" | "Namespace"
+    kind: str  # "Pod" | "TpuNodeMetrics" | "Node" | "Namespace" | ...
+    # The object; None for "synced" sentinel events, which carry no
+    # payload (watchers filtering by kind first never see a None obj).
     obj: object
 
 
@@ -37,6 +42,7 @@ class FakeCluster:
         self._nodes: dict[str, K8sNode] = {}
         self._namespaces: dict[str, K8sNamespace] = {}
         self._pvcs: dict[str, K8sPvc] = {}  # "namespace/name" -> claim
+        self._pdbs: dict[str, K8sPdb] = {}  # "namespace/name" -> budget
         self._events: dict[str, dict] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
@@ -55,6 +61,8 @@ class FakeCluster:
                     fn(Event("added", "Namespace", ns))
                 for pvc in self._pvcs.values():
                     fn(Event("added", "PersistentVolumeClaim", pvc))
+                for pdb in self._pdbs.values():
+                    fn(Event("added", "PodDisruptionBudget", pdb))
                 for node in self._nodes.values():
                     fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():
@@ -121,12 +129,29 @@ class FakeCluster:
             self._emit(Event("modified", "Pod", pod))
 
     def evict_pod(self, pod_key: str) -> bool:
-        """The pods/eviction subresource, fake-side: deletes unless the test
-        marked the pod PDB-protected via ``eviction_blocked`` (the 429 path
-        of KubeCluster.evict_pod)."""
+        """The pods/eviction subresource, fake-side: deletes unless the
+        eviction would violate a stored PodDisruptionBudget (the real API
+        server's enforcement, 429 path of KubeCluster.evict_pod) or the
+        test marked the pod protected via ``eviction_blocked``."""
         with self._lock:
             if pod_key in self.eviction_blocked:
                 return False
+            pod = self._pods.get(pod_key)
+            if pod is not None:
+                for pdb in self._pdbs.values():
+                    if not pdb.matches(pod):
+                        continue
+                    # Only BOUND pods count toward the budget (the real
+                    # API derives disruptionsAllowed from currentHealthy,
+                    # i.e. running pods — a pending replica protects
+                    # nothing), matching preemption's _PdbLedger view.
+                    matching = sum(
+                        1
+                        for p in self._pods.values()
+                        if p.node_name and pdb.matches(p)
+                    )
+                    if pdb.allowed_disruptions(matching) < 1:
+                        return False
         self.delete_pod(pod_key)
         return True
 
@@ -203,6 +228,24 @@ class FakeCluster:
             pvc = self._pvcs.pop(key, None)
             if pvc is not None:
                 self._emit(Event("deleted", "PersistentVolumeClaim", pvc))
+
+    def put_pdb(self, pdb: K8sPdb) -> None:
+        with self._lock:
+            is_new = pdb.key not in self._pdbs
+            self._pdbs[pdb.key] = pdb
+            self._emit(
+                Event("added" if is_new else "modified", "PodDisruptionBudget", pdb)
+            )
+
+    def delete_pdb(self, key: str) -> None:
+        with self._lock:
+            pdb = self._pdbs.pop(key, None)
+            if pdb is not None:
+                self._emit(Event("deleted", "PodDisruptionBudget", pdb))
+
+    def list_pdbs(self) -> list[K8sPdb]:
+        with self._lock:
+            return list(self._pdbs.values())
 
     def put_node(self, node: K8sNode) -> None:
         with self._lock:

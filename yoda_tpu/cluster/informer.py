@@ -19,7 +19,7 @@ import time
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
-from yoda_tpu.api.types import K8sNode, K8sPvc, PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNode, K8sPdb, K8sPvc, PodSpec, TpuNodeMetrics
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
 
@@ -34,6 +34,7 @@ class InformerCache:
         on_pod_pending: Callable[[PodSpec], None] | None = None,
         on_change: Callable[[Event], None] | None = None,
         watches_pvcs: bool = False,
+        watches_pdbs: bool = False,
         staleness_s: float = 0.0,
         now_fn: Callable[[], float] = time.time,
     ) -> None:
@@ -45,6 +46,11 @@ class InformerCache:
         # wait), while False means "no PVC data" (volume constraints are
         # not enforced — snapshot.pvcs stays None).
         self.watches_pvcs = watches_pvcs
+        # Same contract for PodDisruptionBudgets: True = the PDB watch is
+        # live, preemption's victim preference may trust the (possibly
+        # empty) store; False = no PDB data, the preference is skipped and
+        # violations surface only as per-eviction 429 refusals.
+        self.watches_pdbs = watches_pdbs
         # The scheduler's max_metrics_age_s, used ONLY to classify
         # timestamp-only republishes: a node whose publish GAP exceeded
         # this had gone stale, so its refresh changes schedulability and
@@ -62,6 +68,7 @@ class InformerCache:
         # "namespace/name" -> K8sPvc (minimal volume awareness: the
         # selected-node annotation and zone label the filter honors).
         self._pvcs: dict[str, K8sPvc] = {}
+        self._pdbs: dict[str, K8sPdb] = {}
         # True once any Node event arrived: from then on a TPU CR without a
         # live Node object is excluded from snapshots (node deleted — the
         # reference's upstream snapshot drops such nodes for free, reference
@@ -100,6 +107,8 @@ class InformerCache:
             self._handle_namespace(event)
         elif event.kind == "PersistentVolumeClaim":
             self._handle_pvc(event)
+        elif event.kind == "PodDisruptionBudget":
+            self._handle_pdb(event)
         # Timestamp-only heartbeats are NOT propagated as cluster changes
         # (upstream's queueing-hint discipline): on a fleet of agents
         # republishing unchanged metrics every few seconds, reactivating
@@ -128,6 +137,22 @@ class InformerCache:
                 self._pvcs[pvc.key] = pvc
             self._version += 1
             self._snapshot_cache = None
+
+    def _handle_pdb(self, event: Event) -> None:
+        with self._lock:
+            if event.type == "synced":
+                # PDB LIST succeeded (RBAC granted): enforcement on, as
+                # for _handle_pvc's sentinel.
+                self.watches_pdbs = True
+                return
+            pdb: K8sPdb = event.obj  # type: ignore[assignment]
+            if event.type == "deleted":
+                self._pdbs.pop(pdb.key, None)
+            else:
+                self._pdbs[pdb.key] = pdb
+            # No version bump: budgets gate victim PREFERENCE inside
+            # preemption, not filtering/scoring — snapshots and fleet
+            # arrays are unaffected.
 
     def _handle_namespace(self, event: Event) -> None:
         ns = event.obj
@@ -270,6 +295,16 @@ class InformerCache:
             return {
                 name: t.last_updated_unix for name, t in self._tpus.items()
             }
+
+    def list_pdbs(self) -> "list[K8sPdb] | None":
+        """The cached PodDisruptionBudgets, or None when no PDB watch is
+        live (preemption then skips the violation preference entirely —
+        distinct from an empty list, which means budgets verifiably do
+        not exist)."""
+        with self._lock:
+            if not (self.watches_pdbs or self._pdbs):
+                return None
+            return list(self._pdbs.values())
 
     def pod_alive(self, pod: PodSpec) -> bool:
         """False once the watch saw the pod's deletion (by uid — a deleted

@@ -40,6 +40,7 @@ from yoda_tpu.api.types import (
     GROUP,
     VERSION,
     K8sNamespace,
+    K8sPdb,
     K8sPvc,
     K8sNode,
     PodSpec,
@@ -51,6 +52,7 @@ PODS_PATH = "/api/v1/pods"
 NODES_PATH = "/api/v1/nodes"
 NAMESPACES_PATH = "/api/v1/namespaces"
 PVCS_PATH = "/api/v1/persistentvolumeclaims"
+PDBS_PATH = "/apis/policy/v1/poddisruptionbudgets"
 CR_PLURAL = "tpunodemetrics"
 CR_PATH = f"/apis/{GROUP}/{VERSION}/{CR_PLURAL}"
 
@@ -66,6 +68,7 @@ SCHEDULER_KINDS = (
     "Node",
     "Namespace",
     "PersistentVolumeClaim",
+    "PodDisruptionBudget",
 )
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -252,6 +255,14 @@ class _WatchTarget:
     decode: object            # Callable[[dict], object]
     key: object               # Callable[[obj], str]
     synced: threading.Event = field(default_factory=threading.Event)
+    # True only after a LIST genuinely succeeded — distinct from `synced`,
+    # which a 403-degraded optional target also sets (to unblock
+    # wait_for_sync). The "synced" liveness sentinel (watch-loop emit and
+    # late-watcher replay) must key on THIS flag: replaying the sentinel
+    # for a degraded target would flip the informer's enforcement on over
+    # an empty store — for PVCs that parks every claim-referencing pod on
+    # "claim not found", the exact failure the sentinel exists to prevent.
+    listed: threading.Event = field(default_factory=threading.Event)
     # Optional kinds degrade on RBAC 403 instead of blocking wait_for_sync
     # forever: the scheduler runs with no data for that kind (documented
     # fail-closed behavior at the consumer) while the loop keeps retrying.
@@ -286,6 +297,7 @@ class KubeCluster:
         self._nodes: dict[str, K8sNode] = {}
         self._nss: dict[str, K8sNamespace] = {}
         self._pvcs: dict[str, K8sPvc] = {}
+        self._pdbs: dict[str, K8sPdb] = {}
         self._rvs: dict[tuple[str, str], str] = {}  # (kind, key) -> resourceVersion
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -332,6 +344,19 @@ class KubeCluster:
                 # pods on "claim not found".
                 optional=True,
             ),
+            "PodDisruptionBudget": _WatchTarget(
+                "PodDisruptionBudget",
+                PDBS_PATH,
+                decode=K8sPdb.from_obj,
+                key=lambda b: b.key,
+                # Same degradation contract as PersistentVolumeClaim:
+                # without the RBAC rule the LIST 403s forever, the
+                # "synced" sentinel never fires, the informer's
+                # watches_pdbs stays False, and preemption's victim
+                # preference simply ignores budgets (pre-r5 behavior:
+                # violations surface as per-eviction 429 refusals).
+                optional=True,
+            ),
         }
         unknown = set(kinds) - set(all_targets)
         if unknown:
@@ -373,6 +398,7 @@ class KubeCluster:
             "Node": self._nodes,
             "Namespace": self._nss,
             "PersistentVolumeClaim": self._pvcs,
+            "PodDisruptionBudget": self._pdbs,
         }[kind]
 
     def _list_rv(self, target: _WatchTarget) -> str:
@@ -426,16 +452,16 @@ class KubeCluster:
         while not self._stop.is_set():
             try:
                 rv = self._list_rv(target)
+                target.listed.set()
                 target.synced.set()
-                if target.kind == "PersistentVolumeClaim":
+                if target.kind in ("PersistentVolumeClaim", "PodDisruptionBudget"):
                     # Prove the watch is genuinely live (RBAC granted) to
-                    # downstream informers: only then does an empty PVC
-                    # store mean "no claims exist" rather than "no data"
-                    # (InformerCache._handle_pvc). Without this sentinel a
-                    # cluster missing the persistentvolumeclaims rule
-                    # would park every PVC-referencing pod instead of
-                    # degrading to not-enforced.
-                    self._emit(Event("synced", "PersistentVolumeClaim", None))
+                    # downstream informers: only then does an empty store
+                    # mean "no objects exist" rather than "no data"
+                    # (InformerCache._handle_pvc / _handle_pdb). Without
+                    # this sentinel a cluster missing the RBAC rule would
+                    # enforce against missing data instead of degrading.
+                    self._emit(Event("synced", target.kind, None))
                 backoff = self._backoff_initial_s
                 while not self._stop.is_set():
                     params = {"resourceVersion": rv} if rv else {}
@@ -528,10 +554,19 @@ class KubeCluster:
                 for t in self._targets:
                     # Late watchers must not miss the liveness sentinel
                     # (the informer may register after the first LIST).
-                    if t.kind == "PersistentVolumeClaim" and t.synced.is_set():
-                        fn(Event("synced", "PersistentVolumeClaim", None))
+                    # Key on `listed`, NOT `synced`: a 403-degraded
+                    # optional target sets synced without ever listing,
+                    # and replaying the sentinel for it would turn the
+                    # degradation into enforcement-over-no-data.
+                    if (
+                        t.kind in ("PersistentVolumeClaim", "PodDisruptionBudget")
+                        and t.listed.is_set()
+                    ):
+                        fn(Event("synced", t.kind, None))
                 for pvc in self._pvcs.values():
                     fn(Event("added", "PersistentVolumeClaim", pvc))
+                for pdb in self._pdbs.values():
+                    fn(Event("added", "PodDisruptionBudget", pdb))
                 for node in self._nodes.values():
                     fn(Event("added", "Node", node))
                 for tpu in self._tpus.values():

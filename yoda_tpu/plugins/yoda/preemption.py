@@ -51,7 +51,7 @@ from yoda_tpu.api.requests import (
     gang_name_of,
     pod_request,
 )
-from yoda_tpu.api.types import PodSpec, pod_admits_on
+from yoda_tpu.api.types import PodSpec, host_ports_conflict, pod_admits_on
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
     NodeInfo,
@@ -87,6 +87,39 @@ class Victim:
         return (self.priority, -self.pod.creation_seq)
 
 
+class _PdbLedger:
+    """Disruption allowances for one victim-selection pass (upstream
+    DefaultPreemption's PDB-violation preference, inherited by the
+    reference via pkg/register/register.go:10).
+
+    Built once per post_filter from the informer's budget cache and the
+    snapshot's bound pods: each budget's allowance comes from
+    ``status.disruptionsAllowed`` when published, else is derived from
+    spec against the current matching count (api/types.py
+    ``K8sPdb.allowed_disruptions``). ``would_violate`` is stateful via the
+    caller's ``consumed`` map so a second victim under a one-disruption
+    budget counts as the violation it is. A ledger only steers victim
+    PREFERENCE — the eviction API remains the enforcement point, so a
+    stale cache can cost a retry, never a wrongful eviction."""
+
+    def __init__(self, pdbs, pods) -> None:
+        self._pdbs = []
+        for pdb in pdbs:
+            matching = sum(1 for p in pods if pdb.matches(p))
+            self._pdbs.append((pdb, pdb.allowed_disruptions(matching)))
+
+    def would_violate(self, pod: PodSpec, consumed: dict[str, int]) -> bool:
+        for pdb, allowed in self._pdbs:
+            if pdb.matches(pod) and consumed.get(pdb.key, 0) + 1 > allowed:
+                return True
+        return False
+
+    def consume(self, pod: PodSpec, consumed: dict[str, int]) -> None:
+        for pdb, _ in self._pdbs:
+            if pdb.matches(pod):
+                consumed[pdb.key] = consumed.get(pdb.key, 0) + 1
+
+
 class TpuPreemption(PostFilterPlugin):
     name = "yoda-preemption"
 
@@ -96,6 +129,12 @@ class TpuPreemption(PostFilterPlugin):
         # PodDisruptionBudget, KubeCluster.evict_pod); None/True = accepted.
         evict_fn: Callable[[str], "bool | None"],
         *,
+        # Returns the cluster's PodDisruptionBudgets, or None when no PDB
+        # watch is live (InformerCache.list_pdbs): then the violation
+        # preference is skipped entirely and budgets act only through
+        # per-eviction refusals. Assigned post-construction by
+        # standalone.build_stack (the informer exists later).
+        pdbs_fn: "Callable[[], list | None] | None" = None,
         reserved_fn: Callable[[str], int] | None = None,
         gang_status_fn: Callable[[str], tuple[int, int, int] | None] | None = None,
         gang_plan_fn: Callable[[str], list[str] | None] | None = None,
@@ -106,6 +145,7 @@ class TpuPreemption(PostFilterPlugin):
         select_lock: "threading.Lock | None" = None,
     ) -> None:
         self.evict_fn = evict_fn
+        self.pdbs_fn = pdbs_fn
         # Held during victim SELECTION (pure snapshot/reserved_fn reads) —
         # pass the scheduler's shared cycle lock so selection cannot race
         # another profile's Filter->Reserve (a Reserve landing between the
@@ -180,21 +220,15 @@ class TpuPreemption(PostFilterPlugin):
         so are cordon/taints within this cycle, and so are volume pins
         (a claim's selected-node/zone never changes by evicting pods);
         without this guard preemption would evict victims on nodes the pod
-        can never land on."""
+        can never land on. hostPort conflicts are NOT checked here: they
+        ARE curable by eviction — :meth:`_port_blockers` forces the
+        conflicting holders into the victim set (upstream semantics), and
+        the plain-gang slot loop applies its own conservative port skip."""
         return (
             ni.tpu is not None
             and ni.tpu.generation_rank >= req.min_generation_rank
             and pod_admits_on(ni.node, pod)[0]
             and (aff is None or aff.volumes_feasible(ni)[0])
-            # Conservative divergence from upstream: a hostPort conflict is
-            # NOT treated as curable — victim selection buys chips, and
-            # nothing guarantees the port holder joins the victim set, so
-            # attempting it risks an evict/retry loop that never clears the
-            # port. Such nodes are simply skipped (PARITY.md), in-flight
-            # Permit-parked port holders included.
-            and node_fits_host_ports(
-                ni, pod, aff.pending_ports if aff is not None else None
-            )[0]
             and (
                 aff is None
                 or aff.inter is None
@@ -202,6 +236,48 @@ class TpuPreemption(PostFilterPlugin):
             )
             and self._resources_possible(ni, req, pod)
         )
+
+    def _port_blockers(
+        self,
+        ni: NodeInfo,
+        pod: PodSpec,
+        max_priority: int,
+        aff: AffinityData | None = None,
+    ) -> "list[Victim] | None":
+        """The victims whose eviction cures the preemptor's hostPort
+        conflicts on this node (upstream includes the conflicting pod in
+        the victim set; pre-r5 this repo skipped such nodes — PARITY.md).
+        [] = no conflict; None = incurable: a holder is not evictable
+        (priority too high / not a victim at all) or the conflict is with
+        an in-flight Permit-parked placement, which cannot be evicted."""
+        if not pod.host_ports:
+            return []
+        if aff is not None and aff.pending_ports:
+            for theirs in aff.pending_ports.get(ni.name, ()):
+                if any(
+                    host_ports_conflict(ours, theirs) for ours in pod.host_ports
+                ):
+                    return None
+        blockers: list[Victim] = []
+        for other in ni.pods:
+            if not any(
+                host_ports_conflict(ours, theirs)
+                for theirs in other.host_ports
+                for ours in pod.host_ports
+            ):
+                continue
+            v = self._victim_of(other, ni.name)
+            if v is None:
+                # Chip-free foreign pod holding the port: _victim_of
+                # excludes it from chip accounting, but the port makes it
+                # a mandatory victim — evictable iff below the preemptor.
+                from yoda_tpu.plugins.yoda.sort import pod_priority
+
+                v = Victim(other, ni.name, pod_priority(other), 0)
+            if v.priority >= max_priority:
+                return None
+            blockers.append(v)
+        return blockers
 
     def _resources_possible(
         self, ni: NodeInfo, req: TpuRequest, pod: PodSpec
@@ -332,14 +408,46 @@ class TpuPreemption(PostFilterPlugin):
         max_priority: int,
         pod: PodSpec,
         aff: AffinityData | None = None,
+        ledger: "_PdbLedger | None" = None,
     ) -> list[Victim] | None:
-        """Smallest eviction-ordered victim prefix making ``needed`` member
-        slots of ``req`` available on the node, or None."""
+        """Smallest victim set making ``needed`` member slots of ``req``
+        available on the node, or None. hostPort-conflicting holders are
+        mandatory members (their eviction is what makes the node usable at
+        all); the rest are bought in eviction order, except that victims
+        whose eviction would violate a PodDisruptionBudget are deferred
+        behind every non-violating one (upstream DefaultPreemption's
+        reprieve preference) — still evictable when nothing else frees
+        enough, where the eviction API adjudicates."""
         if not self._node_eligible(ni, req, pod, aff):
             return None
-        victims = self._victims_on(ni, max_priority)
-        chosen: list[Victim] = []
-        freed = 0
+        blockers = self._port_blockers(ni, pod, max_priority, aff)
+        if blockers is None:
+            return None
+        forced = {b.pod.uid for b in blockers}
+        victims = [
+            v for v in self._victims_on(ni, max_priority)
+            if v.pod.uid not in forced
+        ]
+        if ledger is not None and victims:
+            consumed: dict[str, int] = {}
+            for b in blockers:
+                ledger.consume(b.pod, consumed)
+            ordered: list[Victim] = []
+            remaining = list(victims)
+            while remaining:
+                pick = next(
+                    (
+                        v for v in remaining
+                        if not ledger.would_violate(v.pod, consumed)
+                    ),
+                    remaining[0],
+                )
+                remaining.remove(pick)
+                ledger.consume(pick.pod, consumed)
+                ordered.append(pick)
+            victims = ordered
+        chosen: list[Victim] = list(blockers)
+        freed = sum(b.chips for b in blockers)
         want = needed * max(req.effective_chips, 1)
         for v in [None, *victims]:
             if v is not None:
@@ -350,6 +458,31 @@ class TpuPreemption(PostFilterPlugin):
             ) >= want and self._fits_resources_after(ni, pod, chosen):
                 return chosen
         return None
+
+    def _ledger(self, snapshot: Snapshot) -> "_PdbLedger | None":
+        """Build the disruption-allowance ledger for one selection pass;
+        None when no PDB data is live or no budgets exist (the preference
+        then costs nothing)."""
+        if self.pdbs_fn is None:
+            return None
+        pdbs = self.pdbs_fn()
+        if not pdbs:
+            return None
+        pods = [p for ni in snapshot.infos() for p in ni.pods]
+        return _PdbLedger(pdbs, pods)
+
+    def _violation_count(
+        self, victims: "list[Victim]", ledger: "_PdbLedger | None"
+    ) -> int:
+        if ledger is None:
+            return 0
+        consumed: dict[str, int] = {}
+        n = 0
+        for v in victims:
+            if ledger.would_violate(v.pod, consumed):
+                n += 1
+            ledger.consume(v.pod, consumed)
+        return n
 
     # --- PostFilter ---
 
@@ -387,15 +520,19 @@ class TpuPreemption(PostFilterPlugin):
         snapshot: Snapshot,
         aff: AffinityData | None = None,
     ) -> tuple[str | None, Status]:
-        best: tuple[tuple[int, int, int, str], list[Victim], str] | None = None
+        best: tuple[tuple[int, int, int, int, str], list[Victim], str] | None = None
         with self.select_lock:
+            ledger = self._ledger(snapshot)
             for ni in snapshot.infos():
                 victims = self._minimal_set(
-                    ni, req, 1, req.priority, pod, aff
+                    ni, req, 1, req.priority, pod, aff, ledger
                 )
                 if victims is None or not victims:
                     continue
+                # Fewest PDB violations dominate (upstream candidate
+                # ordering), then the pre-existing cheapness key.
                 cost = (
+                    self._violation_count(victims, ledger),
                     max(v.priority for v in victims),
                     len(victims),
                     sum(v.chips for v in victims),
@@ -445,10 +582,21 @@ class TpuPreemption(PostFilterPlugin):
         # select lock so another profile's Reserve cannot invalidate the
         # slot math mid-walk.
         with self.select_lock:
+            ledger = self._ledger(snapshot)
             per_node: dict[str, list[Victim]] = {}
             slots = 0
             for ni in snapshot.infos():
                 if not self._node_eligible(ni, req, pod, aff):
+                    continue
+                # Conservative port rule for PLAIN gangs only: members
+                # share host_ports, so multiple members can never co-land
+                # on one node anyway and the slot math below doesn't model
+                # forced port victims — skip conflicted nodes (the
+                # single-pod and topology paths DO evict port holders via
+                # _minimal_set's _port_blockers).
+                if not node_fits_host_ports(
+                    ni, pod, aff.pending_ports if aff is not None else None
+                )[0]:
                     continue
                 slots += self._avail_after(ni, req, 0) // max(req.effective_chips, 1)
                 per_node[ni.name] = self._victims_on(ni, req.priority)
@@ -465,7 +613,7 @@ class TpuPreemption(PostFilterPlugin):
             freed_by_node: dict[str, int] = {}
             victims_left = dict(per_node)
             while slots < remaining:
-                best: tuple[tuple[int, int, int, str], str, list[Victim], int] | None = None
+                best: tuple[tuple[int, int, int, int, str], str, list[Victim], int] | None = None
                 for name, vs in victims_left.items():
                     if not vs:
                         continue
@@ -481,7 +629,15 @@ class TpuPreemption(PostFilterPlugin):
                             - base
                         )
                         if gained > 0:
+                            # PDB violations dominate the slot price
+                            # (per-prefix against the already-chosen set,
+                            # so a shared budget spent by an earlier slot
+                            # purchase is seen as exhausted here).
                             cost = (
+                                self._violation_count(
+                                    [*chosen, *prefix], ledger
+                                )
+                                - self._violation_count(chosen, ledger),
                                 max(x.priority for x in prefix),
                                 len(prefix),
                                 acc,
@@ -548,11 +704,12 @@ class TpuPreemption(PostFilterPlugin):
         victims: list[Victim] = []
         clear: list[str] = []
         with self.select_lock:
+            ledger = self._ledger(snapshot)
             for h in hosts:
                 if h not in snapshot:
                     continue
                 vs = self._minimal_set(
-                    snapshot.get(h), req, 1, req.priority, pod, aff
+                    snapshot.get(h), req, 1, req.priority, pod, aff, ledger
                 )
                 if vs is None:
                     continue
@@ -600,11 +757,12 @@ class TpuPreemption(PostFilterPlugin):
         # Memoize per-host victim sets: host_ok computes them during the
         # block search; the chosen block reuses them below.
         sets: dict[str, list[Victim] | None] = {}
+        ledger = self._ledger(snapshot)
 
         def host_ok(ni: NodeInfo) -> bool:
             if ni.name not in sets:
                 sets[ni.name] = self._minimal_set(
-                    ni, req, 1, req.priority, pod, aff
+                    ni, req, 1, req.priority, pod, aff, ledger
                 )
             return sets[ni.name] is not None
 

@@ -186,11 +186,22 @@ def build_stack(
         # lacks the persistentvolumeclaims rule degrades to not-enforced
         # instead of parking every PVC-referencing pod.
         watches_pvcs=hasattr(cluster, "put_pvc"),
+        # Same contract for PodDisruptionBudgets (preemption's victim
+        # preference); KubeCluster upgrades at runtime via its sentinel.
+        watches_pdbs=hasattr(cluster, "put_pdb"),
         # Lets the informer classify timestamp-only heartbeats: on-time
         # republishes of unchanged metrics do not bump the metrics
         # version or reactivate parked pods; a stale node's refresh does.
         staleness_s=config.max_metrics_age_s,
     )
+
+    # Wire the PDB source now the informer exists: preemption's victim
+    # preference reads the informer's budget cache (None until a PDB watch
+    # is live — KubeCluster's "synced" sentinel, or any FakeCluster
+    # put_pdb — in which case the preference is skipped and violations
+    # surface only as per-eviction refusals, the pre-r5 behavior).
+    if preemption is not None:
+        preemption.pdbs_fn = informer.list_pdbs
 
     # Wire claims into our batch plugin now the informer exists, and expose
     # the batched-gang placement counters (lazy, summed over plugins and

@@ -27,6 +27,7 @@ NODE_KIND = "Node"
 EVENT_KIND = "Event"
 NAMESPACE_KIND = "Namespace"
 PVC_KIND = "PersistentVolumeClaim"
+PDB_KIND = "PodDisruptionBudget"
 
 
 @dataclass
@@ -39,21 +40,21 @@ class _State:
     objects: dict[str, dict[str, dict]] = field(
         default_factory=lambda: {
             POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {},
-            EVENT_KIND: {}, NAMESPACE_KIND: {}, PVC_KIND: {}
+            EVENT_KIND: {}, NAMESPACE_KIND: {}, PVC_KIND: {}, PDB_KIND: {}
         }
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
         default_factory=lambda: {
             POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: [],
-            EVENT_KIND: [], NAMESPACE_KIND: [], PVC_KIND: []
+            EVENT_KIND: [], NAMESPACE_KIND: [], PVC_KIND: [], PDB_KIND: []
         }
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
         default_factory=lambda: {
             POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0,
-            EVENT_KIND: 0, NAMESPACE_KIND: 0, PVC_KIND: 0
+            EVENT_KIND: 0, NAMESPACE_KIND: 0, PVC_KIND: 0, PDB_KIND: 0
         }
     )
     uid_seq: int = 0
@@ -251,6 +252,13 @@ class _Handler(BaseHTTPRequestHandler):
             ]:
                 name = parts[4] if len(parts) > 4 else None
                 return CR_KIND, None, name, None
+            if parts[1] == "policy" and parts[2] == "v1" and parts[3:4] == [
+                "poddisruptionbudgets"
+            ]:
+                # Cluster-scoped LIST/WATCH (the scheduler's read path);
+                # budgets carry their namespace in metadata, as for PVCs.
+                name = parts[4] if len(parts) > 4 else None
+                return PDB_KIND, None, name, None
             if (
                 parts[1] == "coordination.k8s.io"
                 and parts[2] == "v1"
@@ -265,7 +273,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _key(kind: str, namespace: str | None, obj_or_name) -> str:
-        if kind in (POD_KIND, LEASE_KIND, EVENT_KIND, PVC_KIND):  # namespaced
+        if kind in (POD_KIND, LEASE_KIND, EVENT_KIND, PVC_KIND, PDB_KIND):  # namespaced
             if isinstance(obj_or_name, dict):
                 md = obj_or_name.get("metadata", {})
                 return f"{md.get('namespace', namespace or 'default')}/{md['name']}"
