@@ -385,3 +385,159 @@ class TestVolumeRestrictionsEdge:
         )
         stack.scheduler.run_until_idle(max_wall_s=10)
         assert stack.cluster.get_pod("default/foreign").node_name is None
+
+
+class TestPvNodeAffinity:
+    """Bound claims resolve to the PV's REAL spec.nodeAffinity (VERDICT r4
+    #5 / PARITY's admitted gap: "the zone is read off the claim, not the
+    bound PV"). The reference inherited full upstream VolumeBinding
+    (pkg/register/register.go:10); this is its hard predicate."""
+
+    @staticmethod
+    def _pv(name, *, zone=None, hostname=None, claim=None):
+        from yoda_tpu.api.types import (
+            K8sPv,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        exprs = []
+        if zone is not None:
+            exprs.append(NodeSelectorRequirement(ZONE, "In", (zone,)))
+        if hostname is not None:
+            exprs.append(
+                NodeSelectorRequirement(
+                    "kubernetes.io/hostname", "In", (hostname,)
+                )
+            )
+        return K8sPv(
+            name,
+            node_affinity=(
+                (NodeSelectorTerm(match_expressions=tuple(exprs)),)
+                if exprs
+                else ()
+            ),
+            claim_ref=claim,
+        )
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_pv_affinity_is_a_hard_filter(self, mode):
+        """A local-volume PV pinned to one hostname: the pod lands there
+        even though the claim itself carries no pins."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i in range(3):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+            stack.cluster.put_node(
+                K8sNode(f"v5e-{i}", labels={"kubernetes.io/hostname": f"v5e-{i}"})
+            )
+        agent.publish_all()
+        stack.cluster.put_pv(self._pv("local-ssd", hostname="v5e-2"))
+        stack.cluster.put_pvc(K8sPvc("data", volume_name="local-ssd"))
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("data",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-2"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_pv_affinity_supersedes_contradicting_claim_zone(self, mode):
+        """The claim's zone label says zone a, the bound PV's REAL
+        affinity says zone b: the PV wins (the zone label was only ever a
+        stand-in for the unresolved PV)."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i, z in enumerate(["a", "b"]):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+            stack.cluster.put_node(K8sNode(f"v5e-{i}", labels={ZONE: z}))
+        agent.publish_all()
+        stack.cluster.put_pv(self._pv("disk", zone="b"))
+        stack.cluster.put_pvc(
+            K8sPvc("mislabeled", zone="a", volume_name="disk")
+        )
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("mislabeled",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-1"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_unconstrained_pv_supersedes_stale_claim_zone(self, mode):
+        """A resolved PV with EMPTY nodeAffinity (network volume,
+        mountable anywhere) must supersede a stale/mislabeled claim zone
+        with 'no constraint' — not leave the zone stand-in filtering
+        nodes the real volume can serve."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode("v5e-0", labels={ZONE: "a"}))
+        agent.publish_all()
+        stack.cluster.put_pv(self._pv("nfs"))  # no affinity at all
+        stack.cluster.put_pvc(
+            K8sPvc("stale-zone", zone="z", volume_name="nfs")
+        )
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("stale-zone",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        # Zone z exists nowhere; only the resolved-PV supersession allows
+        # this bind.
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-0"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_unresolved_pv_falls_back_to_claim_zone(self, mode):
+        """volumeName names a PV the watch has not seen: the claim-level
+        zone stand-in still applies (no blind scheduling, no parking);
+        the PV arriving later re-resolves."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        for i, z in enumerate(["a", "b"]):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+            stack.cluster.put_node(K8sNode(f"v5e-{i}", labels={ZONE: z}))
+        agent.publish_all()
+        stack.cluster.put_pvc(K8sPvc("zoned", zone="b", volume_name="ghost"))
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("zoned",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-1"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_pv_appearing_reactivates_parked_pod(self, mode):
+        """An unsatisfiable PV affinity parks the pod; the PV being
+        updated (re-provisioned elsewhere) reactivates it via the PV
+        watch event."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode("v5e-0", labels={ZONE: "a"}))
+        agent.publish_all()
+        stack.cluster.put_pv(self._pv("disk", zone="z"))
+        stack.cluster.put_pvc(K8sPvc("data", volume_name="disk"))
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, pvc_names=("data",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.cluster.get_pod("default/p").node_name is None
+        stack.cluster.put_pv(self._pv("disk", zone="a"))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "v5e-0"
+
+    def test_pv_affinity_fails_closed_without_node_object(self):
+        """A constraining PV + no Node object for the candidate: reject
+        (scheduling next to an unknowable node strands the workload) —
+        the pod_admits_on convention."""
+        from yoda_tpu.framework.interfaces import NodeInfo
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            ResolvedClaim,
+            node_fits_volumes,
+        )
+
+        pv = self._pv("disk", zone="a")
+        rc = ResolvedClaim(K8sPvc("data", volume_name="disk"), None, pv)
+        ni = NodeInfo("n1", tpu=None, node=None)
+        ok, why = node_fits_volumes((rc,), ni)
+        assert not ok and "node object is unknown" in why
+
+    def test_pv_roundtrip(self):
+        pv = self._pv("disk", zone="b", hostname="h1", claim="default/data")
+        from yoda_tpu.api.types import K8sPv
+
+        restored = K8sPv.from_obj(pv.to_obj())
+        assert restored == pv
+        assert restored.claim_ref == "default/data"

@@ -1,0 +1,154 @@
+"""ImageLocality scoring (upstream parity — the reference inherited it via
+pkg/register/register.go:10; VERDICT r4 #6 removed the scope-out): nodes
+already holding the pod's container images score higher, size-weighted and
+spread-damped, in BOTH scheduling modes."""
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import K8sNode, PodSpec
+from yoda_tpu.config import SchedulerConfig, Weights
+from yoda_tpu.standalone import build_stack
+
+GB = 1024 * 1024 * 1024
+IMG = "gcr.io/models/llm-server:v3"
+
+
+def make_stack(mode="batch", **cfg):
+    stack = build_stack(config=SchedulerConfig(mode=mode, **cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+class TestFormula:
+    def _spread(self, counts, total):
+        from yoda_tpu.plugins.yoda.image_locality import ImageSpreadData
+
+        return ImageSpreadData(counts, total)
+
+    def _ni(self, images):
+        from yoda_tpu.framework.interfaces import NodeInfo
+
+        return NodeInfo("n", tpu=None, node=K8sNode("n", images=images))
+
+    def test_upstream_shape(self):
+        from yoda_tpu.plugins.yoda.image_locality import image_locality_score
+
+        pod = PodSpec("p", container_images=(IMG,))
+        # 1 GB image on 1 of 2 nodes: sum = 1 GB * 1/2 = 512 MB;
+        # thresholds 23..1000 MB -> (512-23)/977 = ~50.
+        score = image_locality_score(
+            pod, self._ni({IMG: 1 * GB}), self._spread({IMG: 1}, 2)
+        )
+        assert score == 50
+        # Absent image -> below minThreshold -> 0.
+        assert (
+            image_locality_score(
+                pod, self._ni({"other:latest": 1 * GB}),
+                self._spread({IMG: 0}, 2),
+            )
+            == 0
+        )
+
+    def test_threshold_clamps(self):
+        from yoda_tpu.plugins.yoda.image_locality import image_locality_score
+
+        pod = PodSpec("p", container_images=(IMG,))
+        # Tiny image (below 23 MB floor) scores 0 even when local.
+        assert (
+            image_locality_score(
+                pod, self._ni({IMG: 1024}), self._spread({IMG: 1}, 1)
+            )
+            == 0
+        )
+        # Huge ubiquitous image clamps at 100.
+        assert (
+            image_locality_score(
+                pod, self._ni({IMG: 10 * GB}), self._spread({IMG: 1}, 1)
+            )
+            == 100
+        )
+
+    def test_spread_factor_follows_upstream_direction(self):
+        """Upstream's spread factor (nodes-with-image / total) REWARDS
+        widely-present images — its anti-node-heating heuristic: steering
+        hard toward the one node holding a rare image concentrates load,
+        so a rare image earns less locality credit than a common one."""
+        from yoda_tpu.plugins.yoda.image_locality import image_locality_score
+
+        pod = PodSpec("p", container_images=(IMG,))
+        everywhere = image_locality_score(
+            pod, self._ni({IMG: 1 * GB}), self._spread({IMG: 10}, 10)
+        )
+        rare = image_locality_score(
+            pod, self._ni({IMG: 1 * GB}), self._spread({IMG: 1}, 10)
+        )
+        assert rare < everywhere
+
+    def test_untagged_pod_image_matches_latest(self):
+        from yoda_tpu.plugins.yoda.image_locality import image_size_on
+
+        images = {"gcr.io/app/server:latest": 1 * GB,
+                  "host:5000/app:v2": 2 * GB}
+        assert image_size_on(images, "gcr.io/app/server") == 1 * GB
+        assert image_size_on(images, "gcr.io/app/server:latest") == 1 * GB
+        # A registry-port colon is not a tag; the name still normalizes.
+        assert image_size_on(images, "host:5000/app:v2") == 2 * GB
+        assert image_size_on(images, "host:5000/app") is None  # :latest absent
+        assert image_size_on(images, "gcr.io/app/other") is None
+
+    def test_node_images_roundtrip(self):
+        node = K8sNode("n", images={IMG: 2 * GB, "busybox:1": 5 * 1024 * 1024})
+        assert K8sNode.from_obj(node.to_obj()) == node
+        pod = PodSpec("p", container_images=(IMG, "busybox:1"))
+        assert PodSpec.from_obj(pod.to_obj()).container_images == (
+            IMG, "busybox:1"
+        )
+
+
+@pytest.mark.parametrize("mode", ["batch", "loop"])
+class TestEndToEnd:
+    def _fleet(self, stack, agent, with_image):
+        # The image holder is named to LOSE the deterministic tie-break
+        # (ties resolve to the lexicographically greatest name), so a bind
+        # to it proves the locality bonus acted — and the zero-weight test
+        # can assert the tie-break winner instead.
+        for name in ("a-warm", "z-cold"):
+            agent.add_host(name, generation="v5e", chips=8)
+            stack.cluster.put_node(
+                K8sNode(
+                    name,
+                    images={IMG: 4 * GB} if name == with_image else {},
+                )
+            )
+        agent.publish_all()
+
+    def test_prefers_node_with_image(self, mode):
+        # Metric scores tie (identical hosts): the image tips the choice.
+        stack, agent = make_stack(mode=mode)
+        self._fleet(stack, agent, with_image="a-warm")
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, container_images=(IMG,))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name == "a-warm"
+
+    def test_zero_weight_disables(self, mode):
+        stack, agent = make_stack(
+            mode=mode, weights=Weights(image_locality=0)
+        )
+        self._fleet(stack, agent, with_image="a-warm")
+        stack.cluster.create_pod(
+            PodSpec("p", labels={"tpu/chips": "1"}, container_images=(IMG,))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        # Knob off: the tie resolves by the deterministic name order
+        # (greatest name), NOT toward the image holder.
+        assert stack.cluster.get_pod("default/p").node_name == "z-cold"
+
+    def test_image_free_pod_unaffected(self, mode):
+        stack, agent = make_stack(mode=mode)
+        self._fleet(stack, agent, with_image="a-warm")
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p").node_name is not None

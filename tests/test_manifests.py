@@ -76,6 +76,11 @@ class TestSchedulerManifest:
         assert not {"create", "update", "delete"} & rules[
             ("", "persistentvolumeclaims")
         ]
+        # PV watch resolves bound claims' real node affinity.
+        assert {"list", "watch"} <= rules[("", "persistentvolumes")]
+        assert not {"create", "update", "delete"} & rules[
+            ("", "persistentvolumes")
+        ]
         # PDB watch feeds preemption's victim-violation preference.
         assert {"list", "watch"} <= rules[("policy", "poddisruptionbudgets")]
         assert not {"create", "update", "delete"} & rules[

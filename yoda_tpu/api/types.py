@@ -479,6 +479,10 @@ class K8sPvc:
     selected_node: str | None = None
     zone: str | None = None
     access_modes: tuple[str, ...] = ()
+    # spec.volumeName — the bound PersistentVolume. When the PV watch is
+    # live, the filter resolves this to the PV's REAL spec.nodeAffinity
+    # (superseding the zone-label stand-in above).
+    volume_name: str | None = None
 
     @property
     def key(self) -> str:
@@ -497,13 +501,19 @@ class K8sPvc:
             "kind": "PersistentVolumeClaim",
             "metadata": md,
         }
+        spec: dict[str, Any] = {}
         if self.access_modes:
-            out["spec"] = {"accessModes": list(self.access_modes)}
+            spec["accessModes"] = list(self.access_modes)
+        if self.volume_name:
+            spec["volumeName"] = self.volume_name
+        if spec:
+            out["spec"] = spec
         return out
 
     @classmethod
     def from_obj(cls, obj: Mapping[str, Any]) -> "K8sPvc":
         md = obj.get("metadata", {})
+        spec = obj.get("spec") or {}
         return cls(
             name=md["name"],
             namespace=md.get("namespace", "default"),
@@ -511,8 +521,80 @@ class K8sPvc:
                 "volume.kubernetes.io/selected-node"
             ),
             zone=(md.get("labels") or {}).get("topology.kubernetes.io/zone"),
-            access_modes=tuple(
-                (obj.get("spec") or {}).get("accessModes") or ()
+            access_modes=tuple(spec.get("accessModes") or ()),
+            volume_name=spec.get("volumeName") or None,
+        )
+
+
+@dataclass
+class K8sPv:
+    """The scheduler-relevant slice of a v1.PersistentVolume: its REAL
+    ``spec.nodeAffinity`` (a local volume's node pin, a regional disk's
+    zone set) and the claim it is bound to. Closes the admitted r4 gap
+    ("the zone is read off the claim, not the bound PV" — PARITY.md): the
+    reference inherited the full upstream VolumeBinding filter
+    (pkg/register/register.go:10), whose hard predicate is exactly the
+    bound PV's node affinity."""
+
+    name: str  # cluster-scoped
+    # spec.nodeAffinity.required.nodeSelectorTerms — terms OR, a term's
+    # expressions AND (the NodeSelectorTerm type used by pod nodeAffinity).
+    node_affinity: tuple["NodeSelectorTerm", ...] = ()
+    claim_ref: str | None = None  # "namespace/name" of the bound claim
+
+    def allows_node(self, node: "K8sNode | None") -> tuple[bool, str]:
+        """Hard VolumeBinding predicate. Fail-closed when the PV
+        constrains but the Node object is unknown (the pod_admits_on
+        convention: scheduling onto an unlabeled mystery node would
+        strand the workload next to a volume it cannot mount)."""
+        if not self.node_affinity:
+            return True, ""
+        if node is None:
+            return False, (
+                f"pv {self.name} has node affinity but the node object "
+                "is unknown"
+            )
+        if any(
+            term.matches(node.labels, node.name) for term in self.node_affinity
+        ):
+            return True, ""
+        return False, f"node fails pv {self.name}'s node affinity"
+
+    def to_obj(self) -> dict[str, Any]:
+        spec: dict[str, Any] = {}
+        if self.node_affinity:
+            spec["nodeAffinity"] = {
+                "required": {
+                    "nodeSelectorTerms": [
+                        t.to_obj() for t in self.node_affinity
+                    ]
+                }
+            }
+        if self.claim_ref:
+            ns, _, name = self.claim_ref.partition("/")
+            spec["claimRef"] = {"namespace": ns, "name": name}
+        return {
+            "apiVersion": "v1",
+            "kind": "PersistentVolume",
+            "metadata": {"name": self.name},
+            "spec": spec,
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Mapping[str, Any]) -> "K8sPv":
+        spec = obj.get("spec") or {}
+        terms = (
+            ((spec.get("nodeAffinity") or {}).get("required") or {})
+            .get("nodeSelectorTerms") or ()
+        )
+        ref = spec.get("claimRef") or None
+        return cls(
+            name=obj["metadata"]["name"],
+            node_affinity=tuple(NodeSelectorTerm.from_obj(t) for t in terms),
+            claim_ref=(
+                f"{ref.get('namespace', 'default')}/{ref['name']}"
+                if ref and ref.get("name")
+                else None
             ),
         )
 
@@ -659,6 +741,10 @@ class K8sNode:
     alloc_cpu_milli: int = 0
     alloc_memory: int = 0
     alloc_pods: int = 0
+    # status.images flattened to image-name -> sizeBytes (every name/tag
+    # of an image maps to its size) — the ImageLocality scoring input
+    # (plugins/yoda/image_locality.py). Empty = kubelet reports none.
+    images: dict[str, int] = field(default_factory=dict)
 
     def to_obj(self) -> dict[str, Any]:
         spec: dict[str, Any] = {}
@@ -682,8 +768,16 @@ class K8sNode:
             alloc["memory"] = str(self.alloc_memory)
         if self.alloc_pods:
             alloc["pods"] = str(self.alloc_pods)
+        status: dict[str, Any] = {}
         if alloc:
-            out["status"] = {"allocatable": alloc}
+            status["allocatable"] = alloc
+        if self.images:
+            status["images"] = [
+                {"names": [name], "sizeBytes": size}
+                for name, size in sorted(self.images.items())
+            ]
+        if status:
+            out["status"] = status
         return out
 
     @classmethod
@@ -720,6 +814,11 @@ class K8sNode:
                     "node %s: unparseable allocatable pods %r; not enforcing",
                     obj["metadata"]["name"], alloc["pods"],
                 )
+        images: dict[str, int] = {}
+        for img in (obj.get("status") or {}).get("images") or ():
+            size = int(img.get("sizeBytes") or 0)
+            for name in img.get("names") or ():
+                images[name] = size
         return cls(
             name=obj["metadata"]["name"],
             unschedulable=bool(spec.get("unschedulable", False)),
@@ -735,6 +834,7 @@ class K8sNode:
             alloc_cpu_milli=cpu,
             alloc_memory=mem,
             alloc_pods=pods,
+            images=images,
         )
 
 
@@ -911,6 +1011,9 @@ class PodSpec:
     # pod placement honors the claim's selected-node annotation and zone
     # label (filter_plugin.node_fits_volumes against the PVC watch).
     pvc_names: tuple[str, ...] = ()
+    # spec.containers[].image (regular containers, upstream ImageLocality's
+    # scoring inputs — init containers run once and are not scored).
+    container_images: tuple[str, ...] = ()
     creation_seq: int = field(default_factory=lambda: next(_pod_seq))
 
     def __post_init__(self) -> None:
@@ -990,6 +1093,7 @@ class PodSpec:
             or self.cpu_milli_request
             or self.memory_request
             or self.host_ports
+            or self.container_images
         ):
             resources: dict[str, Any] = {}
             if self.tpu_resource_limit:
@@ -1004,12 +1108,17 @@ class PodSpec:
             if requests:
                 resources["requests"] = requests
             container: dict[str, Any] = {"name": "main", "resources": resources}
+            if self.container_images:
+                container["image"] = self.container_images[0]
             if self.host_ports:
                 container["ports"] = [
                     {"hostPort": p, "protocol": proto, "hostIP": ip}
                     for p, proto, ip in self.host_ports
                 ]
-            spec["containers"] = [container]
+            containers = [container]
+            for i, image in enumerate(self.container_images[1:]):
+                containers.append({"name": f"c{i + 1}", "image": image})
+            spec["containers"] = containers
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -1114,6 +1223,11 @@ class PodSpec:
                 v["persistentVolumeClaim"]["claimName"]
                 for v in spec.get("volumes") or ()
                 if v.get("persistentVolumeClaim", {}).get("claimName")
+            ),
+            container_images=tuple(
+                c["image"]
+                for c in spec.get("containers") or ()
+                if c.get("image")
             ),
             **kwargs,
         )

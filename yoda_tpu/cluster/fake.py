@@ -15,6 +15,7 @@ from yoda_tpu.api.types import (
     K8sNamespace,
     K8sNode,
     K8sPdb,
+    K8sPv,
     K8sPvc,
     PodSpec,
     TpuNodeMetrics,
@@ -43,6 +44,7 @@ class FakeCluster:
         self._namespaces: dict[str, K8sNamespace] = {}
         self._pvcs: dict[str, K8sPvc] = {}  # "namespace/name" -> claim
         self._pdbs: dict[str, K8sPdb] = {}  # "namespace/name" -> budget
+        self._pvs: dict[str, K8sPv] = {}    # name -> persistent volume
         self._events: dict[str, dict] = {}
         self._watchers: list[Callable[[Event], None]] = []
         self._rv = 0
@@ -61,6 +63,8 @@ class FakeCluster:
                     fn(Event("added", "Namespace", ns))
                 for pvc in self._pvcs.values():
                     fn(Event("added", "PersistentVolumeClaim", pvc))
+                for pv in self._pvs.values():
+                    fn(Event("added", "PersistentVolume", pv))
                 for pdb in self._pdbs.values():
                     fn(Event("added", "PodDisruptionBudget", pdb))
                 for node in self._nodes.values():
@@ -228,6 +232,20 @@ class FakeCluster:
             pvc = self._pvcs.pop(key, None)
             if pvc is not None:
                 self._emit(Event("deleted", "PersistentVolumeClaim", pvc))
+
+    def put_pv(self, pv: K8sPv) -> None:
+        with self._lock:
+            is_new = pv.name not in self._pvs
+            self._pvs[pv.name] = pv
+            self._emit(
+                Event("added" if is_new else "modified", "PersistentVolume", pv)
+            )
+
+    def delete_pv(self, name: str) -> None:
+        with self._lock:
+            pv = self._pvs.pop(name, None)
+            if pv is not None:
+                self._emit(Event("deleted", "PersistentVolume", pv))
 
     def put_pdb(self, pdb: K8sPdb) -> None:
         with self._lock:

@@ -19,7 +19,7 @@ import time
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
-from yoda_tpu.api.types import K8sNode, K8sPdb, K8sPvc, PodSpec, TpuNodeMetrics
+from yoda_tpu.api.types import K8sNode, K8sPdb, K8sPv, K8sPvc, PodSpec, TpuNodeMetrics
 from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
 
@@ -34,6 +34,7 @@ class InformerCache:
         on_pod_pending: Callable[[PodSpec], None] | None = None,
         on_change: Callable[[Event], None] | None = None,
         watches_pvcs: bool = False,
+        watches_pvs: bool = False,
         watches_pdbs: bool = False,
         staleness_s: float = 0.0,
         now_fn: Callable[[], float] = time.time,
@@ -51,6 +52,10 @@ class InformerCache:
         # empty) store; False = no PDB data, the preference is skipped and
         # violations surface only as per-eviction 429 refusals.
         self.watches_pdbs = watches_pdbs
+        # And for PersistentVolumes: True = bound claims resolve to their
+        # PV's real nodeAffinity; False = the claim's zone-label stand-in
+        # applies (snapshot.pvs stays None).
+        self.watches_pvs = watches_pvs
         # The scheduler's max_metrics_age_s, used ONLY to classify
         # timestamp-only republishes: a node whose publish GAP exceeded
         # this had gone stale, so its refresh changes schedulability and
@@ -69,6 +74,7 @@ class InformerCache:
         # selected-node annotation and zone label the filter honors).
         self._pvcs: dict[str, K8sPvc] = {}
         self._pdbs: dict[str, K8sPdb] = {}
+        self._pvs: dict[str, K8sPv] = {}
         # True once any Node event arrived: from then on a TPU CR without a
         # live Node object is excluded from snapshots (node deleted — the
         # reference's upstream snapshot drops such nodes for free, reference
@@ -107,6 +113,8 @@ class InformerCache:
             self._handle_namespace(event)
         elif event.kind == "PersistentVolumeClaim":
             self._handle_pvc(event)
+        elif event.kind == "PersistentVolume":
+            self._handle_pv(event)
         elif event.kind == "PodDisruptionBudget":
             self._handle_pdb(event)
         # Timestamp-only heartbeats are NOT propagated as cluster changes
@@ -135,6 +143,21 @@ class InformerCache:
                 self._pvcs.pop(pvc.key, None)
             else:
                 self._pvcs[pvc.key] = pvc
+            self._version += 1
+            self._snapshot_cache = None
+
+    def _handle_pv(self, event: Event) -> None:
+        with self._lock:
+            if event.type == "synced":
+                self.watches_pvs = True
+                self._version += 1
+                self._snapshot_cache = None
+                return
+            pv: K8sPv = event.obj  # type: ignore[assignment]
+            if event.type == "deleted":
+                self._pvs.pop(pv.name, None)
+            else:
+                self._pvs[pv.name] = pv
             self._version += 1
             self._snapshot_cache = None
 
@@ -353,6 +376,11 @@ class InformerCache:
                 pvcs=(
                     self._pvcs
                     if (self.watches_pvcs or self._pvcs)
+                    else None
+                ),
+                pvs=(
+                    self._pvs
+                    if (self.watches_pvs or self._pvs)
                     else None
                 ),
             )

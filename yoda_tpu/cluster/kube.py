@@ -41,6 +41,7 @@ from yoda_tpu.api.types import (
     VERSION,
     K8sNamespace,
     K8sPdb,
+    K8sPv,
     K8sPvc,
     K8sNode,
     PodSpec,
@@ -53,6 +54,7 @@ NODES_PATH = "/api/v1/nodes"
 NAMESPACES_PATH = "/api/v1/namespaces"
 PVCS_PATH = "/api/v1/persistentvolumeclaims"
 PDBS_PATH = "/apis/policy/v1/poddisruptionbudgets"
+PVS_PATH = "/api/v1/persistentvolumes"
 CR_PLURAL = "tpunodemetrics"
 CR_PATH = f"/apis/{GROUP}/{VERSION}/{CR_PLURAL}"
 
@@ -68,6 +70,7 @@ SCHEDULER_KINDS = (
     "Node",
     "Namespace",
     "PersistentVolumeClaim",
+    "PersistentVolume",
     "PodDisruptionBudget",
 )
 
@@ -298,6 +301,7 @@ class KubeCluster:
         self._nss: dict[str, K8sNamespace] = {}
         self._pvcs: dict[str, K8sPvc] = {}
         self._pdbs: dict[str, K8sPdb] = {}
+        self._pvs: dict[str, K8sPv] = {}
         self._rvs: dict[tuple[str, str], str] = {}  # (kind, key) -> resourceVersion
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -342,6 +346,16 @@ class KubeCluster:
                 # False, and volume constraints are simply not enforced
                 # (pre-r4 behavior) instead of parking PVC-referencing
                 # pods on "claim not found".
+                optional=True,
+            ),
+            "PersistentVolume": _WatchTarget(
+                "PersistentVolume",
+                PVS_PATH,
+                decode=K8sPv.from_obj,
+                key=lambda v: v.name,
+                # Same degradation contract: no RBAC rule -> sentinel
+                # never fires -> PV affinity not enforced (the claim's
+                # zone-label stand-in still applies).
                 optional=True,
             ),
             "PodDisruptionBudget": _WatchTarget(
@@ -398,6 +412,7 @@ class KubeCluster:
             "Node": self._nodes,
             "Namespace": self._nss,
             "PersistentVolumeClaim": self._pvcs,
+            "PersistentVolume": self._pvs,
             "PodDisruptionBudget": self._pdbs,
         }[kind]
 
@@ -454,7 +469,11 @@ class KubeCluster:
                 rv = self._list_rv(target)
                 target.listed.set()
                 target.synced.set()
-                if target.kind in ("PersistentVolumeClaim", "PodDisruptionBudget"):
+                if target.kind in (
+                    "PersistentVolumeClaim",
+                    "PersistentVolume",
+                    "PodDisruptionBudget",
+                ):
                     # Prove the watch is genuinely live (RBAC granted) to
                     # downstream informers: only then does an empty store
                     # mean "no objects exist" rather than "no data"
@@ -559,12 +578,19 @@ class KubeCluster:
                     # and replaying the sentinel for it would turn the
                     # degradation into enforcement-over-no-data.
                     if (
-                        t.kind in ("PersistentVolumeClaim", "PodDisruptionBudget")
+                        t.kind
+                        in (
+                            "PersistentVolumeClaim",
+                            "PersistentVolume",
+                            "PodDisruptionBudget",
+                        )
                         and t.listed.is_set()
                     ):
                         fn(Event("synced", t.kind, None))
                 for pvc in self._pvcs.values():
                     fn(Event("added", "PersistentVolumeClaim", pvc))
+                for pv in self._pvs.values():
+                    fn(Event("added", "PersistentVolume", pv))
                 for pdb in self._pdbs.values():
                     fn(Event("added", "PodDisruptionBudget", pdb))
                 for node in self._nodes.values():

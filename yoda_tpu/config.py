@@ -48,6 +48,12 @@ class Weights:
     # (api.affinity.SpreadEvaluator.score) x this weight; 0 disables
     # (upstream PodTopologySpread's scoring half).
     topology_spread: int = 1
+    # Upstream ImageLocality: [0,100] size-and-spread-scaled presence of
+    # the pod's container images on the node (needs Node.status.images
+    # from the Node watch) x this weight; 0 disables. Deliberately small
+    # by default — for TPU jobs image pulls are dwarfed by checkpoint
+    # restore (plugins/yoda/image_locality.py).
+    image_locality: int = 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "Weights":

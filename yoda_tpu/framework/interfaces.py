@@ -105,6 +105,7 @@ class Snapshot:
         version: int = 0,
         namespaces: "Mapping[str, Mapping[str, str]] | None" = None,
         pvcs: "Mapping[str, object] | None" = None,
+        pvs: "Mapping[str, object] | None" = None,
     ) -> None:
         self._nodes = dict(nodes)
         self._order = sorted(self._nodes)
@@ -123,6 +124,11 @@ class Snapshot:
         # dict is meaningful — the watch is live and no claims exist —
         # so only a true None collapses to None.
         self.pvcs = dict(pvcs) if pvcs is not None else None
+        # PV name -> K8sPv (from the PersistentVolume watch): lets the
+        # volume filter enforce a bound claim's REAL PV nodeAffinity
+        # instead of the claim's zone-label stand-in. Same None-vs-empty
+        # contract as pvcs.
+        self.pvs = dict(pvs) if pvs is not None else None
 
     def get(self, name: str) -> NodeInfo:
         return self._nodes[name]

@@ -30,6 +30,7 @@ from yoda_tpu.plugins.yoda.score import (
     YodaScore,
     Weights,
 )
+from yoda_tpu.plugins.yoda.image_locality import ImageLocalityScore
 from yoda_tpu.plugins.yoda.batch import YodaBatch
 from yoda_tpu.plugins.yoda.preemption import TpuPreemption
 
@@ -57,7 +58,13 @@ def default_plugins(
     """
     from yoda_tpu.plugins.yoda.batch import AUTO_DEVICE_MIN_ELEMS
 
-    base: list = [YodaSort(), YodaPreFilter(pending_fn=pending_fn)]
+    base: list = [
+        YodaSort(),
+        YodaPreFilter(
+            pending_fn=pending_fn,
+            image_locality_weight=(weights or Weights()).image_locality,
+        ),
+    ]
     if mode == "batch":
         base.append(
             YodaBatch(
@@ -85,6 +92,7 @@ def default_plugins(
                 YodaScore(weights),
                 SliceProtectScore(weights),
                 PreferredAffinityScore(weights),
+                ImageLocalityScore(weights),
             ]
         )
     else:
@@ -103,6 +111,7 @@ __all__ = [
     "YodaScore",
     "SliceProtectScore",
     "PreferredAffinityScore",
+    "ImageLocalityScore",
     "MaxValueData",
     "Weights",
     "REQUEST_KEY",

@@ -586,11 +586,27 @@ class YodaBatch(BatchFilterScorePlugin):
                 and aff.spread.has_soft)
             else None
         )
+        # ImageLocality (upstream scoring parity): only for pods that name
+        # images on fleets whose nodes report image state.
+        w_image = self.weights.image_locality
+        image_spread = None
+        if w_image and pod.container_images:
+            from yoda_tpu.plugins.yoda.image_locality import build_image_spread
+
+            image_spread = build_image_spread(snapshot, pod)
         want_pref = w_pref and pod.preferred_node_affinity
-        if not want_pref and not w_taint and inter is None and spread is None:
+        if (
+            not want_pref
+            and not w_taint
+            and inter is None
+            and spread is None
+            and image_spread is None
+        ):
             # The common case (no preferences, taint-free fleet) pays no
             # O(N) Python loop — the batch path's whole point.
             return out
+        from yoda_tpu.plugins.yoda.image_locality import image_locality_score
+
         for i, name in enumerate(static.names):
             ni = snapshot.get(name) if name in snapshot else None
             node = ni.node if ni else None
@@ -604,6 +620,8 @@ class YodaBatch(BatchFilterScorePlugin):
                     v += inter.preference(ni) * w_pod
                 if spread is not None:
                     v += spread.score(ni) * w_spread
+                if image_spread is not None:
+                    v += image_locality_score(pod, ni, image_spread) * w_image
             out[i] = v
         return out
 

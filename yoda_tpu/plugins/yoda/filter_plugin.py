@@ -325,13 +325,17 @@ def node_fits_host_ports(
 @dataclass(frozen=True)
 class ResolvedClaim:
     """One constraint-carrying claim after per-cycle resolution: the PVC's
-    static pins (selected-node annotation, zone label) plus the dynamic
-    attachment constraint from upstream VolumeRestrictions — a
-    ``ReadWriteOnce`` claim mounted by running pods attaches to one node,
-    so a new pod using it must co-locate (``allowed_nodes``)."""
+    static pins (selected-node annotation, zone label), the bound PV's
+    REAL ``spec.nodeAffinity`` when the PV watch resolved it (``pv`` —
+    upstream VolumeBinding's hard predicate; it supersedes the zone-label
+    stand-in), plus the dynamic attachment constraint from upstream
+    VolumeRestrictions — a ``ReadWriteOnce`` claim mounted by running pods
+    attaches to one node, so a new pod using it must co-locate
+    (``allowed_nodes``)."""
 
     pvc: object                              # K8sPvc
     allowed_nodes: frozenset | None = None   # None = unconstrained
+    pv: object | None = None                 # K8sPv | None (unresolved)
 
 
 def _claim_restricts(modes: tuple) -> bool:
@@ -406,15 +410,28 @@ def resolve_volumes(snapshot, pod: PodSpec, pending=()):
                     )
                 # RWO: single-node attachment — must co-locate.
                 allowed = frozenset(mounted_on)
-        if pvc.selected_node or pvc.zone or allowed is not None:
-            resolved.append(ResolvedClaim(pvc, allowed))
+        # Bound claim -> its PV's real nodeAffinity, when the PV watch is
+        # live (upstream VolumeBinding). An unresolvable volumeName (PV
+        # object not yet seen) falls back to the claim-level stand-ins
+        # rather than parking the pod: the PV watch event re-resolves.
+        pv = (
+            snapshot.pvs.get(pvc.volume_name)
+            if pvc.volume_name and snapshot.pvs is not None
+            else None
+        )
+        if pvc.selected_node or pvc.zone or allowed is not None or (
+            pv is not None and pv.node_affinity
+        ):
+            resolved.append(ResolvedClaim(pvc, allowed, pv))
     return tuple(resolved), None
 
 
 def node_fits_volumes(pvcs, ni) -> tuple[bool, str]:
     """Per-node half of the volume filter: the node must (a) be the one the
     volume binder pinned via ``volume.kubernetes.io/selected-node``,
-    (b) sit in each zoned claim's ``topology.kubernetes.io/zone``, and
+    (b) satisfy the bound PV's REAL ``spec.nodeAffinity`` when resolved
+    (upstream VolumeBinding; it supersedes the claim's zone-label
+    stand-in, which applies only while the PV is unresolved), and
     (c) for an attached ReadWriteOnce claim, be where it is mounted."""
     for rc in pvcs:
         pvc = rc.pvc
@@ -422,7 +439,15 @@ def node_fits_volumes(pvcs, ni) -> tuple[bool, str]:
             return False, (
                 f"claim {pvc.name} is bound to node {pvc.selected_node}"
             )
-        if pvc.zone:
+        if rc.pv is not None and rc.pv.node_affinity:
+            ok, why = rc.pv.allows_node(ni.node)
+            if not ok:
+                return False, f"claim {pvc.name}: {why}"
+        elif rc.pv is None and pvc.zone:
+            # Zone stand-in ONLY while the PV is unresolved: a resolved PV
+            # with EMPTY nodeAffinity (network volume, mountable anywhere)
+            # supersedes a stale/mislabeled claim zone with "no
+            # constraint", upstream semantics.
             node_zone = (
                 ni.node.labels.get("topology.kubernetes.io/zone")
                 if ni.node is not None
@@ -508,7 +533,12 @@ class YodaPreFilter(PreFilterPlugin):
         self,
         *,
         pending_fn: Callable[[], list[tuple[str, PodSpec]]] | None = None,
+        image_locality_weight: int = 1,
     ) -> None:
+        # Weights.image_locality, threaded in so a zero weight skips the
+        # ImageLocality fleet walk entirely (the batch path gates the
+        # same way in _preference_bonus).
+        self.image_locality_weight = image_locality_weight
         # GangPlugin.pending_placements when gang scheduling is wired:
         # reserved-but-unbound members, visible to the evaluators so gang
         # siblings honor each other's inter-pod terms mid-flight.
@@ -586,6 +616,18 @@ class YodaPreFilter(PreFilterPlugin):
                 AFFINITY_KEY,
                 AffinityData(inter, spread, pvcs, ports_by_node or None),
             )
+        if pod.container_images and self.image_locality_weight:
+            # ImageLocality's fleet view (plugins/yoda/image_locality.py):
+            # one walk, only for image-naming pods on image-reporting
+            # fleets with the knob enabled.
+            from yoda_tpu.plugins.yoda.image_locality import (
+                IMAGE_SPREAD_KEY,
+                build_image_spread,
+            )
+
+            image_spread = build_image_spread(snapshot, pod)
+            if image_spread is not None:
+                state.write(IMAGE_SPREAD_KEY, image_spread)
         return Status.ok()
 
 

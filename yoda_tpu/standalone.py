@@ -170,7 +170,15 @@ def build_stack(
         # reactivates pods parked on "persistentvolumeclaim not found".
         if (
             event.kind
-            in ("TpuNodeMetrics", "Node", "Namespace", "PersistentVolumeClaim")
+            in (
+                "TpuNodeMetrics",
+                "Node",
+                "Namespace",
+                "PersistentVolumeClaim",
+                # A PV appearing (or its affinity changing) re-resolves
+                # bound claims that parked pods on volume constraints.
+                "PersistentVolume",
+            )
             or event.type == "deleted"
         ):
             queue.move_all_to_active()
@@ -186,6 +194,8 @@ def build_stack(
         # lacks the persistentvolumeclaims rule degrades to not-enforced
         # instead of parking every PVC-referencing pod.
         watches_pvcs=hasattr(cluster, "put_pvc"),
+        # PV watch: bound claims resolve to the PV's real nodeAffinity.
+        watches_pvs=hasattr(cluster, "put_pv"),
         # Same contract for PodDisruptionBudgets (preemption's victim
         # preference); KubeCluster upgrades at runtime via its sentinel.
         watches_pdbs=hasattr(cluster, "put_pdb"),

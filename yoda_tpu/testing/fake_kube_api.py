@@ -28,6 +28,7 @@ EVENT_KIND = "Event"
 NAMESPACE_KIND = "Namespace"
 PVC_KIND = "PersistentVolumeClaim"
 PDB_KIND = "PodDisruptionBudget"
+PV_KIND = "PersistentVolume"
 
 
 @dataclass
@@ -40,21 +41,21 @@ class _State:
     objects: dict[str, dict[str, dict]] = field(
         default_factory=lambda: {
             POD_KIND: {}, CR_KIND: {}, LEASE_KIND: {}, NODE_KIND: {},
-            EVENT_KIND: {}, NAMESPACE_KIND: {}, PVC_KIND: {}, PDB_KIND: {}
+            EVENT_KIND: {}, NAMESPACE_KIND: {}, PVC_KIND: {}, PDB_KIND: {}, PV_KIND: {}
         }
     )
     # kind -> list of (rv:int, watch-event dict); pruned by compact()
     events: dict[str, list[tuple[int, dict]]] = field(
         default_factory=lambda: {
             POD_KIND: [], CR_KIND: [], LEASE_KIND: [], NODE_KIND: [],
-            EVENT_KIND: [], NAMESPACE_KIND: [], PVC_KIND: [], PDB_KIND: []
+            EVENT_KIND: [], NAMESPACE_KIND: [], PVC_KIND: [], PDB_KIND: [], PV_KIND: []
         }
     )
     # kind -> oldest rv still replayable (for 410 Gone)
     window_start: dict[str, int] = field(
         default_factory=lambda: {
             POD_KIND: 0, CR_KIND: 0, LEASE_KIND: 0, NODE_KIND: 0,
-            EVENT_KIND: 0, NAMESPACE_KIND: 0, PVC_KIND: 0, PDB_KIND: 0
+            EVENT_KIND: 0, NAMESPACE_KIND: 0, PVC_KIND: 0, PDB_KIND: 0, PV_KIND: 0
         }
     )
     uid_seq: int = 0
@@ -238,6 +239,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # Cluster-scoped Namespace objects: /api/v1/namespaces[/name]
                 name = rest[1] if len(rest) > 1 else None
                 return NAMESPACE_KIND, None, name, None
+            if rest[:1] == ["persistentvolumes"]:
+                name = rest[1] if len(rest) > 1 else None
+                return PV_KIND, None, name, None
             if rest[:1] == ["persistentvolumeclaims"]:
                 # Cluster-scoped LIST/WATCH (the scheduler's read path);
                 # claims themselves carry their namespace in metadata.
