@@ -304,3 +304,86 @@ class TestScoringStrategy:
         counts = Counter(placements)
         assert max(counts.values()) == 2  # one host filled (2x2 chips)...
         assert len(counts) == 2           # ...then spillover, no overcommit
+
+
+class TestClockDomainMismatch:
+    """The cluster/informer.py now_fn contract (VERDICT r4 #8): ``now_fn``
+    must share the agents' clock domain. These tests turn the docstring
+    warning into a regression guard by asserting the OBSERVABLE failure
+    under a mismatch — every on-time heartbeat misclassifies as a
+    stale-node refresh, bumping the metrics version (array rebuilds,
+    burst drops) and firing the reactivation path per heartbeat."""
+
+    @staticmethod
+    def _informer(now_fn, events):
+        from yoda_tpu.cluster.informer import InformerCache
+
+        return InformerCache(
+            staleness_s=60.0,
+            now_fn=now_fn,
+            on_change=events.append,
+        )
+
+    @staticmethod
+    def _heartbeats(informer, *, stamp_fn, count=3):
+        from yoda_tpu.api.types import make_node
+        from yoda_tpu.cluster.fake import Event
+
+        for i in range(count):
+            tpu = make_node("host", chips=2)
+            tpu.last_updated_unix = stamp_fn()  # value-identical republish
+            tpu.resource_version = i + 1
+            informer.handle(Event("added" if i == 0 else "modified",
+                                  "TpuNodeMetrics", tpu))
+
+    def test_matched_clock_elides_heartbeats(self):
+        import time as _time
+
+        events = []
+        informer = self._informer(_time.time, events)
+        self._heartbeats(informer, stamp_fn=_time.time)
+        # First add is a real change; the two republishes are elided.
+        assert informer.metrics_version == 2
+        assert len(events) == 1
+
+    def test_mismatched_clock_misclassifies_every_heartbeat(self):
+        import time as _time
+
+        # Scheduler reads a MONOTONIC-domain clock (~hours since boot)
+        # while agents stamp wall-clock seconds: every arrival age is
+        # ~55 years > staleness, so each on-time heartbeat looks like a
+        # stale node refreshing.
+        events = []
+        informer = self._informer(lambda: _time.time() + 10_000.0, events)
+        self._heartbeats(informer, stamp_fn=_time.time)
+        # The observable failure the warning describes: a version bump +
+        # a reactivation-triggering change event PER heartbeat. If this
+        # test ever fails with matched-clock numbers, the interlock
+        # changed — re-read the now_fn contract before "fixing" it.
+        assert informer.metrics_version == 4  # base + add + 2 "refreshes"
+        assert len(events) == 3  # add + both misclassified heartbeats
+
+    def test_reversed_mismatch_never_detects_real_staleness(self):
+        """The opposite skew (scheduler clock BEHIND the agents') makes
+        arrival ages negative: a genuinely stale node's refresh is elided
+        like a heartbeat and parked pods are never reactivated — the
+        quieter half of the same misconfiguration."""
+        import time as _time
+
+        events = []
+        informer = self._informer(lambda: _time.time() - 10_000.0, events)
+        # First publish, then a LONG gap (stamped 120 s apart, staleness
+        # 60 s), then the refresh: with a correct clock the refresh is
+        # relevant; with the skew it is elided.
+        from yoda_tpu.api.types import make_node
+        from yoda_tpu.cluster.fake import Event
+
+        t0 = _time.time() - 120.0
+        tpu = make_node("host", chips=2)
+        tpu.last_updated_unix = t0
+        informer.handle(Event("added", "TpuNodeMetrics", tpu))
+        refresh = make_node("host", chips=2)
+        refresh.last_updated_unix = _time.time()
+        informer.handle(Event("modified", "TpuNodeMetrics", refresh))
+        assert informer.metrics_version == 2  # add only; refresh ELIDED
+        assert len(events) == 1

@@ -250,3 +250,65 @@ class TestBurstAtScale:
         assert batch.dispatch_count - d0 <= 6
         assert batch.burst_served >= 26
         assert dt_ms < 32 * 200, f"{dt_ms:.0f} ms for 32 pods at {N_NODES} nodes"
+
+
+class TestIncrementalAtScale:
+    def test_16k_rolling_refreshes_stay_incremental(self):
+        """16384 nodes (VERDICT r4 #9): rolling per-node agent refreshes
+        must ride the incremental row update (plugins/yoda/batch.py
+        ``_incremental_update`` — the SAME FleetArrays object, one O(C)
+        row refill) instead of the O(N x C) full rebuild, and per-pod
+        scheduling latency must hold the BASELINE budget at 4x the
+        previous largest scale point."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec
+        from yoda_tpu.plugins.yoda import YodaBatch
+        from yoda_tpu.standalone import build_stack
+
+        n = 16384
+        stack = build_stack()
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(n):
+            agent.add_host(f"h{i:05d}", chips=8)
+        agent.publish_all()
+
+        # Warmup: pay the kernel compile at the 16384-row bucket.
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=300)
+        stack.cluster.delete_pod("default/warm")
+        stack.scheduler.run_until_idle(max_wall_s=30)
+
+        batch = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        static0 = batch._static
+        assert static0 is not None
+
+        # Rolling refreshes: one node's values change per round (the
+        # steady-state shape of a real fleet — one agent publishing at a
+        # time), each followed by a pod needing a dispatch.
+        t0 = time.monotonic()
+        rounds = 8
+        for k in range(rounds):
+            agent.set_chip_health(f"h{k:05d}", chip_index=0, healthy=False)
+            agent.refresh(f"h{k:05d}")  # single-CR value change
+            stack.cluster.create_pod(
+                PodSpec(f"p{k}", labels={"tpu/chips": "4", "tpu/hbm": "2Gi"})
+            )
+            stack.scheduler.run_until_idle(max_wall_s=60)
+        dt_ms = (time.monotonic() - t0) * 1e3
+
+        pods = [p for p in stack.cluster.list_pods() if p.name.startswith("p")]
+        assert len(pods) == rounds and all(p.node_name for p in pods)
+        # The refreshes were absorbed in place: same arrays object (a full
+        # rebuild would have replaced it), with the dirtied rows refilled.
+        assert batch._static is static0
+        assert not static0.chip_healthy[0, 0]  # h00000's flipped chip
+        # Queue latency stays flat vs the 4096 point: same per-pod budget
+        # (BASELINE 200 ms) with a per-round single-node refresh in the
+        # loop. A regression to full rebuilds costs ~250 ms extra per
+        # round at this scale and blows the bound.
+        assert dt_ms < rounds * 200, (
+            f"rolling refresh+bind took {dt_ms:.0f} ms over {rounds} rounds "
+            f"at {n} nodes"
+        )

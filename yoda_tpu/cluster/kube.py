@@ -28,6 +28,7 @@ import http.client
 import json
 import logging
 import os
+import socket
 import ssl
 import threading
 import time
@@ -135,9 +136,16 @@ class KubeApiConfig:
 
 
 class KubeApiClient:
-    """Minimal JSON-over-HTTP client with a streaming watch. One connection
-    per call: scheduler traffic is a handful of requests per second at most,
-    and per-call connections keep retry/backoff logic trivial."""
+    """Minimal JSON-over-HTTP client with a streaming watch.
+
+    Unary requests reuse ONE keep-alive connection per thread (the wire
+    decomposition in BENCH r4/r5 showed per-call TCP setup dominating the
+    scheduler's share of gang latency — binding POSTs and status PATCHes
+    ride the scheduler thread, so per-thread reuse removes the handshakes
+    without any locking). A send/receive failure on a REUSED connection is
+    the normal keep-alive staleness race and is retried once on a fresh
+    connection; a fresh connection's failure propagates. Watches manage
+    their own long-lived streaming connection as before."""
 
     def __init__(self, config: KubeApiConfig) -> None:
         self.config = config
@@ -146,6 +154,7 @@ class KubeApiClient:
             raise ValueError(f"unsupported scheme in {config.base_url!r}")
         self._scheme = parsed.scheme
         self._netloc = parsed.netloc
+        self._local = threading.local()
         self._ssl_ctx: ssl.SSLContext | None = None
         if self._scheme == "https":
             ctx = ssl.create_default_context(cafile=config.ca_file)
@@ -177,6 +186,35 @@ class KubeApiClient:
             return f"{path}?{urllib.parse.urlencode(params)}"
         return path
 
+    def _pooled(self) -> tuple[http.client.HTTPConnection, bool]:
+        """This thread's keep-alive connection (reused=True), or a fresh
+        one (reused=False). TCP_NODELAY is set on the fresh socket:
+        without it, back-to-back request/response pairs on a persistent
+        connection serialize on Nagle + delayed-ACK (observed: ~40 ms
+        quanta per POST, 10x worse than per-call connections)."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            return conn, True
+        conn = self._connect(self.config.request_timeout_s)
+        try:
+            conn.connect()
+            if conn.sock is not None:
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+        except OSError:
+            pass  # connect errors surface on the actual request
+        self._local.conn = conn
+        return conn, False
+
+    def _discard(self, conn: http.client.HTTPConnection) -> None:
+        if getattr(self._local, "conn", None) is conn:
+            self._local.conn = None
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001 — already broken
+            pass
+
     def request(
         self,
         method: str,
@@ -186,22 +224,44 @@ class KubeApiClient:
         params: dict | None = None,
         content_type: str | None = None,
     ) -> dict:
-        conn = self._connect(self.config.request_timeout_s)
-        try:
-            payload = json.dumps(body) if body is not None else None
-            conn.request(
-                method,
-                self._url(path, params),
-                body=payload,
-                headers=self._headers(payload is not None, content_type),
-            )
-            resp = conn.getresponse()
-            data = resp.read()
+        payload = json.dumps(body) if body is not None else None
+        url = self._url(path, params)
+        headers = self._headers(payload is not None, content_type)
+        # Retry safety: a SEND-phase failure means the socket broke while
+        # writing — the server saw at most a truncated request and will
+        # not process it, so any method may retry. A RECEIVE-phase
+        # failure is ambiguous (the server may have processed the request
+        # and died before the response): only idempotent methods retry;
+        # re-sending a POST could double-apply (a binding re-POST would
+        # 409 and make a SUCCESSFUL bind look failed). Timeouts are
+        # receive-ambiguous by definition and never retried.
+        idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
+        for attempt in (0, 1):
+            conn, reused = self._pooled()
+            try:
+                conn.request(method, url, body=payload, headers=headers)
+            except (http.client.HTTPException, OSError):
+                self._discard(conn)
+                if reused and attempt == 0:
+                    continue  # stale keep-alive caught at send: safe retry
+                raise
+            try:
+                resp = conn.getresponse()
+                data = resp.read()
+            except socket.timeout:
+                self._discard(conn)
+                raise
+            except (http.client.HTTPException, OSError):
+                self._discard(conn)
+                if reused and attempt == 0 and idempotent:
+                    continue
+                raise
+            if resp.will_close:
+                self._discard(conn)
             if resp.status >= 400:
                 raise KubeApiError(resp.status, data.decode(errors="replace")[:512])
             return json.loads(data) if data else {}
-        finally:
-            conn.close()
+        raise AssertionError("unreachable")
 
     def watch(self, path: str, *, params: dict | None = None):
         """Generator of decoded watch-event dicts ({"type","object"}).
@@ -270,6 +330,10 @@ class _WatchTarget:
     # forever: the scheduler runs with no data for that kind (documented
     # fail-closed behavior at the consumer) while the loop keeps retrying.
     optional: bool = False
+    # Kinds whose consumers distinguish "no data" from "verifiably empty"
+    # get a per-kind "synced" liveness sentinel after a successful LIST
+    # (and on late-watcher replay, keyed on `listed`).
+    sentinel: bool = False
 
 
 class KubeCluster:
@@ -347,6 +411,7 @@ class KubeCluster:
                 # (pre-r4 behavior) instead of parking PVC-referencing
                 # pods on "claim not found".
                 optional=True,
+                sentinel=True,
             ),
             "PersistentVolume": _WatchTarget(
                 "PersistentVolume",
@@ -357,6 +422,7 @@ class KubeCluster:
                 # never fires -> PV affinity not enforced (the claim's
                 # zone-label stand-in still applies).
                 optional=True,
+                sentinel=True,
             ),
             "PodDisruptionBudget": _WatchTarget(
                 "PodDisruptionBudget",
@@ -370,6 +436,7 @@ class KubeCluster:
                 # preference simply ignores budgets (pre-r5 behavior:
                 # violations surface as per-eviction 429 refusals).
                 optional=True,
+                sentinel=True,
             ),
         }
         unknown = set(kinds) - set(all_targets)
@@ -469,11 +536,7 @@ class KubeCluster:
                 rv = self._list_rv(target)
                 target.listed.set()
                 target.synced.set()
-                if target.kind in (
-                    "PersistentVolumeClaim",
-                    "PersistentVolume",
-                    "PodDisruptionBudget",
-                ):
+                if target.sentinel:
                     # Prove the watch is genuinely live (RBAC granted) to
                     # downstream informers: only then does an empty store
                     # mean "no objects exist" rather than "no data"
@@ -577,15 +640,7 @@ class KubeCluster:
                     # optional target sets synced without ever listing,
                     # and replaying the sentinel for it would turn the
                     # degradation into enforcement-over-no-data.
-                    if (
-                        t.kind
-                        in (
-                            "PersistentVolumeClaim",
-                            "PersistentVolume",
-                            "PodDisruptionBudget",
-                        )
-                        and t.listed.is_set()
-                    ):
+                    if t.sentinel and t.listed.is_set():
                         fn(Event("synced", t.kind, None))
                 for pvc in self._pvcs.values():
                     fn(Event("added", "PersistentVolumeClaim", pvc))

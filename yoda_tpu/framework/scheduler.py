@@ -38,6 +38,11 @@ class ScheduleResult:
     node: str | None = None
     message: str = ""
     latency_s: float = 0.0
+    # Cycle-completion instant on the scheduler's clock (monotonic by
+    # default) — lets external harnesses decompose end-to-end latency
+    # into pre-cycle (watch delivery + queue wait), in-cycle, and
+    # post-cycle shares (bench.py _http_gang_scenario).
+    completed_at: float = 0.0
 
 
 @dataclass
@@ -183,7 +188,10 @@ class Scheduler:
             )
             with self._lock:
                 self._nominated.pop(pod.uid, None)
-            r = ScheduleResult(pod.key, "gone", latency_s=self.clock() - t0)
+            now = self.clock()
+            r = ScheduleResult(
+                pod.key, "gone", latency_s=now - t0, completed_at=now
+            )
             with self._lock:
                 self.stats.results.append(r)
             if self.metrics is not None:
@@ -207,7 +215,10 @@ class Scheduler:
             # other profiles' queues). Filter->Reserve is already past or
             # never happened on this path. No-op when already released.
             release_cycle_lock()
-            r = ScheduleResult(pod.key, outcome, node, message, self.clock() - t0)
+            now = self.clock()
+            r = ScheduleResult(
+                pod.key, outcome, node, message, now - t0, completed_at=now
+            )
             # One line per outcome at INFO (the reference's operational klog
             # trail, reference pkg/yoda/scheduler.go:143); waiting members
             # are routine gang mechanics -> DEBUG.

@@ -268,6 +268,25 @@ class YodaBatch(BatchFilterScorePlugin):
         # (InformerCache.last_updated_map) — the cached arrays' baked
         # timestamps then age while the real metrics stay fresh.
         self.last_updated_map_fn = last_updated_map_fn
+        if (
+            max_metrics_age_s > 0
+            and claimed_fn is not None
+            and last_updated_map_fn is None
+        ):
+            # ADVICE r4: this combination, fed by an informer whose
+            # heartbeat elision skips metrics_version bumps, ages on-time
+            # nodes into staleness (the baked timestamps never refresh).
+            # build_stack always wires the map; a direct construction
+            # gets a loud warning instead of a silent wedge. Not an
+            # error: backends without elision (bare FakeCluster feeds)
+            # remain correct.
+            log.warning(
+                "YodaBatch: max_metrics_age_s > 0 with claimed_fn but no "
+                "last_updated_map_fn — with a heartbeat-eliding informer "
+                "the cached fleet arrays' timestamps never refresh and "
+                "on-time nodes will age into staleness; wire "
+                "InformerCache.last_updated_map (see standalone.build_stack)"
+            )
         self.weights = weights or Weights()
         self.max_metrics_age_s = max_metrics_age_s
         self.platform = platform
@@ -826,31 +845,40 @@ class YodaBatch(BatchFilterScorePlugin):
         # visible in the live snapshot must not be charged again from the
         # burst's pending ledger (review r4: double-counting spuriously
         # invalidated every co-located resource-requesting burst).
-        if best in snapshot:
-            ni = snapshot.get(best)
-            if self.max_metrics_age_s > 0 and (
-                ni.tpu is None
-                or not ni.tpu.fresh(max_age_s=self.max_metrics_age_s)
-            ):
-                self._drop_burst()
-                self.burst_invalidated += 1
-                return None
-            on_node = {p.uid for p in ni.pods}
-            p_cpu = p_mem = p_cnt = 0
-            for uid, c, m in b.res.get(best, ()):
-                if uid not in on_node:
-                    p_cpu += c
-                    p_mem += m
-                    p_cnt += 1
-            if (
-                not pod_admits_on(ni.node, pod)[0]
-                or not node_fits_resources(
-                    ni, pod, {best: (p_cpu, p_mem, p_cnt)}
-                )[0]
-            ):
-                self._drop_burst()
-                self.burst_invalidated += 1
-                return None
+        if best not in snapshot:
+            # The chosen node left the snapshot since the dispatch. Today
+            # node add/delete bumps metrics_version, so the fleet_version
+            # gate above drops the burst first — but this guard must be a
+            # real safety net, not silently-permissive dead code (ADVICE
+            # r4): steering a pod at a vanished node with no live
+            # validation is never right. Drop and re-dispatch fresh.
+            self._drop_burst()
+            self.burst_invalidated += 1
+            return None
+        ni = snapshot.get(best)
+        if self.max_metrics_age_s > 0 and (
+            ni.tpu is None
+            or not ni.tpu.fresh(max_age_s=self.max_metrics_age_s)
+        ):
+            self._drop_burst()
+            self.burst_invalidated += 1
+            return None
+        on_node = {p.uid for p in ni.pods}
+        p_cpu = p_mem = p_cnt = 0
+        for uid, c, m in b.res.get(best, ()):
+            if uid not in on_node:
+                p_cpu += c
+                p_mem += m
+                p_cnt += 1
+        if (
+            not pod_admits_on(ni.node, pod)[0]
+            or not node_fits_resources(
+                ni, pod, {best: (p_cpu, p_mem, p_cnt)}
+            )[0]
+        ):
+            self._drop_burst()
+            self.burst_invalidated += 1
+            return None
         b.consumed[best] = b.consumed.get(best, 0) + chips
         b.res.setdefault(best, []).append(
             (pod.uid, pod.cpu_milli_request, pod.memory_request)

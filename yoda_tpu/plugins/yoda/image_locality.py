@@ -77,7 +77,10 @@ def build_image_spread(snapshot, pod: PodSpec) -> ImageSpreadData | None:
         for image in wanted:
             if image_size_on(node.images, image) is not None:
                 counts[image] += 1
-    if not any_images:
+    if not any_images or not any(counts.values()):
+        # No node holds ANY of the pod's images: every node scores 0, so
+        # returning a spread object would only defeat the batch path's
+        # O(N)-loop early exit (YodaBatch._preference_bonus).
         return None
     return ImageSpreadData(counts, len(snapshot))
 

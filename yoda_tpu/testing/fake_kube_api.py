@@ -181,6 +181,10 @@ def _append_event(state: _State, kind: str, etype: str, obj: dict) -> None:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Real API servers run TCP_NODELAY; without it, keep-alive clients
+    # (KubeCluster's per-thread pooled connections) serialize on Nagle +
+    # delayed-ACK — observed ~40 ms per request/response pair.
+    disable_nagle_algorithm = True
     state: _State  # injected per server
 
     # Silence per-request logging (tests drive thousands of requests).
