@@ -541,3 +541,188 @@ class TestPvNodeAffinity:
         restored = K8sPv.from_obj(pv.to_obj())
         assert restored == pv
         assert restored.claim_ref == "default/data"
+
+
+class TestAttachLimits:
+    """CSI/node volume-attachment limits (upstream NodeVolumeLimits,
+    inherited by the reference via pkg/register/register.go:10) — the
+    last PARITY scope-out, closed now that PVs are modeled: unique
+    CSI volumes per driver on a node, bound pods' plus the candidate's,
+    must fit the node's attachable-volumes-* allocatable."""
+
+    DRIVER = "pd.csi.storage.gke.io"
+
+    def _pv(self, name):
+        from yoda_tpu.api.types import K8sPv
+
+        return K8sPv(name, driver=self.DRIVER)
+
+    def _fleet(self, stack, agent, *, limit):
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        stack.cluster.put_node(
+            K8sNode("v5e-0", attach_limits={f"csi-{self.DRIVER}": limit})
+        )
+        agent.publish_all()
+
+    def _claim(self, stack, claim, pv):
+        stack.cluster.put_pv(self._pv(pv))
+        stack.cluster.put_pvc(K8sPvc(claim, volume_name=pv))
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_limit_blocks_overattachment(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        self._fleet(stack, agent, limit=2)
+        for i in range(3):
+            self._claim(stack, f"data-{i}", f"vol-{i}")
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p{i}",
+                    labels={"tpu/chips": "1"},
+                    pvc_names=(f"data-{i}",),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p0").node_name == "v5e-0"
+        assert stack.cluster.get_pod("default/p1").node_name == "v5e-0"
+        # Third volume would exceed the 2-volume limit: pod stays pending.
+        stack.cluster.create_pod(
+            PodSpec("p2", labels={"tpu/chips": "1"}, pvc_names=("data-2",))
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.cluster.get_pod("default/p2").node_name is None
+        # A volume user leaving frees the attachment: the pod binds.
+        stack.cluster.delete_pod("default/p0")
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p2").node_name == "v5e-0"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_shared_volume_counts_once(self, mode):
+        """Two pods mounting the SAME volume attach it once — unique
+        volumes, not claim references, consume the limit."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        self._fleet(stack, agent, limit=1)
+        self._claim(stack, "shared", "vol-x")
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p{i}", labels={"tpu/chips": "1"}, pvc_names=("shared",)
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/p0").node_name == "v5e-0"
+        assert stack.cluster.get_pod("default/p1").node_name == "v5e-0"
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_undeclared_limit_unenforced(self, mode):
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        agent.add_host("v5e-0", generation="v5e", chips=8)
+        stack.cluster.put_node(K8sNode("v5e-0"))  # no attach limits
+        agent.publish_all()
+        for i in range(4):
+            self._claim(stack, f"data-{i}", f"vol-{i}")
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p{i}",
+                    labels={"tpu/chips": "1"},
+                    pvc_names=(f"data-{i}",),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        for i in range(4):
+            assert stack.cluster.get_pod(f"default/p{i}").node_name == "v5e-0"
+
+    def test_node_attach_limits_roundtrip(self):
+        node = K8sNode(
+            "n", attach_limits={f"csi-{self.DRIVER}": 127, "gce-pd": 16}
+        )
+        assert K8sNode.from_obj(node.to_obj()) == node
+        from yoda_tpu.api.types import K8sPv
+
+        pv = K8sPv("v", driver=self.DRIVER)
+        assert K8sPv.from_obj(pv.to_obj()) == pv
+
+
+class TestAttachLimitsEdge:
+    DRIVER = "pd.csi.storage.gke.io"
+
+    def _setup(self, stack, agent, *, limit, hosts=1):
+        from yoda_tpu.api.types import K8sPv
+
+        for i in range(hosts):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+            stack.cluster.put_node(
+                K8sNode(
+                    f"v5e-{i}", attach_limits={f"csi-{self.DRIVER}": limit}
+                )
+            )
+        agent.publish_all()
+        return lambda claim, pv: (
+            stack.cluster.put_pv(K8sPv(pv, driver=self.DRIVER)),
+            stack.cluster.put_pvc(K8sPvc(claim, volume_name=pv)),
+        )
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_gang_siblings_cannot_overcommit_attachments(self, mode):
+        """A Permit-parked sibling's volume must count against the limit
+        (the pending_ports race in the attach dimension): a 2-member gang
+        with distinct volumes against one 1-slot node must NOT bind."""
+        stack, agent = make_stack(mode=mode, enable_preemption=False)
+        claim = self._setup(stack, agent, limit=1)
+        claim("d0", "vol-0")
+        claim("d1", "vol-1")
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g{i}",
+                    labels={
+                        "tpu/gang": "vg", "tpu/gang-size": "2",
+                        "tpu/chips": "1",
+                    },
+                    pvc_names=(f"d{i}",),
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert bound == [], (
+            f"gang overcommitted the attach limit: {[(p.name, p.node_name) for p in bound]}"
+        )
+
+    @pytest.mark.parametrize("mode", ["batch", "loop"])
+    def test_preemption_evicts_volume_holder_not_chip_pods(self, mode):
+        """Attach-limit pressure is curable only by evicting attachment
+        HOLDERS: with the limit saturated by a non-evictable holder,
+        preemption must refuse the node (no wasted chip-pod evictions);
+        with an evictable holder, the plan must include it."""
+        stack, agent = make_stack(mode=mode)
+        claim = self._setup(stack, agent, limit=1)
+        claim("held", "vol-h")
+        claim("mine", "vol-m")
+        # Non-evictable holder (higher priority than the preemptor).
+        stack.cluster.create_pod(
+            PodSpec(
+                "holder",
+                labels={"tpu/chips": "1", "tpu/priority": "9"},
+                pvc_names=("held",),
+            )
+        )
+        stack.cluster.create_pod(
+            PodSpec("chips", labels={"tpu/chips": "1", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        stack.cluster.create_pod(
+            PodSpec(
+                "wants-vol",
+                labels={"tpu/chips": "1", "tpu/priority": "5"},
+                pvc_names=("mine",),
+            )
+        )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        # The chip pod must NOT have been sacrificed for an incurable node.
+        assert stack.cluster.get_pod("default/chips") is not None
+        assert stack.cluster.get_pod("default/wants-vol").node_name is None
+        assert stack.preemption.preempted_total == 0
+        # Now the holder becomes evictable: re-created at low priority.
+        stack.cluster.delete_pod("default/holder")
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.cluster.get_pod("default/wants-vol").node_name == "v5e-0"

@@ -541,6 +541,10 @@ class K8sPv:
     # expressions AND (the NodeSelectorTerm type used by pod nodeAffinity).
     node_affinity: tuple["NodeSelectorTerm", ...] = ()
     claim_ref: str | None = None  # "namespace/name" of the bound claim
+    # spec.csi.driver — which attach limit (K8sNode.attach_limits) this
+    # volume counts against (upstream NodeVolumeLimits). None: not a
+    # CSI volume, exempt from attach counting.
+    driver: str | None = None
 
     def allows_node(self, node: "K8sNode | None") -> tuple[bool, str]:
         """Hard VolumeBinding predicate. Fail-closed when the PV
@@ -573,6 +577,8 @@ class K8sPv:
         if self.claim_ref:
             ns, _, name = self.claim_ref.partition("/")
             spec["claimRef"] = {"namespace": ns, "name": name}
+        if self.driver:
+            spec["csi"] = {"driver": self.driver}
         return {
             "apiVersion": "v1",
             "kind": "PersistentVolume",
@@ -596,6 +602,7 @@ class K8sPv:
                 if ref and ref.get("name")
                 else None
             ),
+            driver=(spec.get("csi") or {}).get("driver") or None,
         )
 
 
@@ -745,6 +752,12 @@ class K8sNode:
     # of an image maps to its size) — the ImageLocality scoring input
     # (plugins/yoda/image_locality.py). Empty = kubelet reports none.
     images: dict[str, int] = field(default_factory=dict)
+    # status.allocatable "attachable-volumes-*" keys: limit-key suffix ->
+    # max attachable volumes (upstream NodeVolumeLimits inputs, e.g.
+    # "csi-pd.csi.storage.gke.io" -> 127). A K8sPv's driver counts
+    # against the "csi-<driver>" (or bare "<driver>") key. Empty = no
+    # declared limits, the filter is not enforced.
+    attach_limits: dict[str, int] = field(default_factory=dict)
 
     def to_obj(self) -> dict[str, Any]:
         spec: dict[str, Any] = {}
@@ -768,6 +781,8 @@ class K8sNode:
             alloc["memory"] = str(self.alloc_memory)
         if self.alloc_pods:
             alloc["pods"] = str(self.alloc_pods)
+        for suffix, limit in sorted(self.attach_limits.items()):
+            alloc[f"attachable-volumes-{suffix}"] = str(limit)
         status: dict[str, Any] = {}
         if alloc:
             status["allocatable"] = alloc
@@ -819,6 +834,19 @@ class K8sNode:
             size = int(img.get("sizeBytes") or 0)
             for name in img.get("names") or ():
                 images[name] = size
+        attach_limits: dict[str, int] = {}
+        for key, value in alloc.items():
+            if not key.startswith("attachable-volumes-"):
+                continue
+            try:
+                attach_limits[key[len("attachable-volumes-"):]] = int(
+                    str(value).strip()
+                )
+            except ValueError:
+                log.warning(
+                    "node %s: unparseable %s %r; not enforcing",
+                    obj["metadata"]["name"], key, value,
+                )
         return cls(
             name=obj["metadata"]["name"],
             unschedulable=bool(spec.get("unschedulable", False)),
@@ -835,6 +863,7 @@ class K8sNode:
             alloc_memory=mem,
             alloc_pods=pods,
             images=images,
+            attach_limits=attach_limits,
         )
 
 

@@ -142,10 +142,25 @@ class KubeApiClient:
     decomposition in BENCH r4/r5 showed per-call TCP setup dominating the
     scheduler's share of gang latency — binding POSTs and status PATCHes
     ride the scheduler thread, so per-thread reuse removes the handshakes
-    without any locking). A send/receive failure on a REUSED connection is
-    the normal keep-alive staleness race and is retried once on a fresh
-    connection; a fresh connection's failure propagates. Watches manage
-    their own long-lived streaming connection as before."""
+    without any locking). Retry rules for failures on a REUSED connection
+    (fresh-connection failures always propagate — a real outage, the
+    caller's backoff): send-phase failures retry for any method (the
+    server saw at most a truncated request); ``RemoteDisconnected`` —
+    the server closed with ZERO response bytes, the signature of the
+    keep-alive idle-close race — retries for any method (the Go
+    net/http convention for replayable requests); other receive-phase
+    failures retry only for idempotent methods, and timeouts never
+    (both are response-possibly-processed ambiguous, and re-POSTing a
+    committed binding turns a successful bind into a 409 failure).
+    Connections idle past the server's plausible keep-alive window are
+    proactively discarded, so the race window is the exception, not the
+    steady state. Watches manage their own long-lived streaming
+    connection as before."""
+
+    # Discard a pooled connection idle longer than this (servers commonly
+    # close keep-alive sockets after 60-300 s; reconnecting beats racing
+    # the close).
+    POOL_IDLE_MAX_S = 30.0
 
     def __init__(self, config: KubeApiConfig) -> None:
         self.config = config
@@ -194,7 +209,12 @@ class KubeApiClient:
         quanta per POST, 10x worse than per-call connections)."""
         conn = getattr(self._local, "conn", None)
         if conn is not None:
-            return conn, True
+            if (
+                time.monotonic() - getattr(self._local, "last_used", 0.0)
+                <= self.POOL_IDLE_MAX_S
+            ):
+                return conn, True
+            self._discard(conn)  # likely server-closed while idle
         conn = self._connect(self.config.request_timeout_s)
         try:
             conn.connect()
@@ -251,11 +271,19 @@ class KubeApiClient:
             except socket.timeout:
                 self._discard(conn)
                 raise
+            except http.client.RemoteDisconnected:
+                self._discard(conn)
+                if reused and attempt == 0:
+                    # Zero response bytes on a reused connection: the
+                    # keep-alive idle-close race — safe for any method.
+                    continue
+                raise
             except (http.client.HTTPException, OSError):
                 self._discard(conn)
                 if reused and attempt == 0 and idempotent:
                     continue
                 raise
+            self._local.last_used = time.monotonic()
             if resp.will_close:
                 self._discard(conn)
             if resp.status >= 400:

@@ -85,6 +85,15 @@ class AffinityData:
     # reserved at Permit — invisible in NodeInfo.pods until bound). None
     # when no pending pod claims ports (the overwhelming norm).
     pending_ports: "dict[str, tuple] | None" = None
+    # (pv_name, csi driver) of the pod's CSI-backed bound claims, plus
+    # the snapshot's claim/volume maps for per-node attach counting —
+    # upstream NodeVolumeLimits (resolve_attach_volumes). Empty/None for
+    # the overwhelming majority of pods.
+    pv_volumes: tuple = ()
+    claim_maps: "tuple | None" = None  # (pvcs map, pvs map)
+    # node -> (pv_name, driver) tuples held by in-flight placements (the
+    # attach-limit analog of pending_ports). None in the common case.
+    pending_volumes: "dict[str, tuple] | None" = None
 
     def clone(self) -> "AffinityData":
         return self
@@ -92,7 +101,10 @@ class AffinityData:
     def volumes_feasible(self, node) -> tuple[bool, str]:
         """The volume half alone — preemption's node-eligibility guard
         (eviction can never cure a selected-node or zone pin, unlike
-        anti-affinity/spread conflicts)."""
+        anti-affinity/spread conflicts). Attach limits are NOT here:
+        evicting a volume-using pod detaches its volumes, so attach
+        pressure IS curable and must not make a node preemption-
+        ineligible."""
         if self.pvcs:
             return node_fits_volumes(self.pvcs, node)
         return True, ""
@@ -101,6 +113,17 @@ class AffinityData:
         ok, why = self.volumes_feasible(node)
         if not ok:
             return ok, why
+        if self.pv_volumes and self.claim_maps is not None:
+            pend = (
+                self.pending_volumes.get(node.name, ())
+                if self.pending_volumes
+                else ()
+            )
+            ok, why = node_fits_attach_limits(
+                self.pv_volumes + tuple(pend), node, *self.claim_maps
+            )
+            if not ok:
+                return ok, why
         if self.inter is not None:
             ok, why = self.inter.feasible(node)
             if not ok:
@@ -426,6 +449,71 @@ def resolve_volumes(snapshot, pod: PodSpec, pending=()):
     return tuple(resolved), None
 
 
+def resolve_attach_volumes(snapshot, pod: PodSpec) -> tuple:
+    """(pv_name, csi driver) for each of the pod's claims bound to a CSI
+    PersistentVolume — upstream NodeVolumeLimits' pod-side input
+    (inherited by the reference via pkg/register/register.go:10; the
+    last PARITY scope-out, closed once PVs were modeled in r5). Empty
+    without PV data or for non-CSI volumes."""
+    if not pod.pvc_names or snapshot.pvcs is None or snapshot.pvs is None:
+        return ()
+    out = []
+    for claim in pod.pvc_names:
+        pvc = snapshot.pvcs.get(f"{pod.namespace}/{claim}")
+        if pvc is None or not pvc.volume_name:
+            continue
+        pv = snapshot.pvs.get(pvc.volume_name)
+        if pv is not None and pv.driver:
+            out.append((pv.name, pv.driver))
+    return tuple(out)
+
+
+def node_fits_attach_limits(
+    pv_volumes, ni, pvcs_map, pvs_map
+) -> tuple[bool, str]:
+    """Upstream NodeVolumeLimits: for each CSI driver the pod's volumes
+    use, UNIQUE volumes already attached to the node (bound pods' bound
+    claims) plus the pod's new ones must fit the node's declared
+    ``attachable-volumes-*`` allocatable. Enforced only when the node
+    declares a limit for a driver the pod uses; a volume already attached
+    (shared RWX) is not double-counted."""
+    node = ni.node
+    if node is None or not node.attach_limits:
+        return True, ""
+    wanted_drivers = {driver for _, driver in pv_volumes}
+    limits = {
+        driver: limit
+        for driver in wanted_drivers
+        if (
+            limit := node.attach_limits.get(
+                f"csi-{driver}", node.attach_limits.get(driver)
+            )
+        )
+        is not None
+    }
+    if not limits:
+        return True, ""
+    attached: dict[str, set[str]] = {d: set() for d in limits}
+    for p in ni.pods:
+        for claim in p.pvc_names:
+            pvc = pvcs_map.get(f"{p.namespace}/{claim}")
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = pvs_map.get(pvc.volume_name)
+            if pv is not None and pv.driver in attached:
+                attached[pv.driver].add(pv.name)
+    for name, driver in pv_volumes:
+        if driver in attached:
+            attached[driver].add(name)
+    for driver, vols in attached.items():
+        if len(vols) > limits[driver]:
+            return False, (
+                f"node's {limits[driver]}-volume attach limit for driver "
+                f"{driver} would be exceeded ({len(vols)} volumes)"
+            )
+    return True, ""
+
+
 def node_fits_volumes(pvcs, ni) -> tuple[bool, str]:
     """Per-node half of the volume filter: the node must (a) be the one the
     volume binder pinned via ``volume.kubernetes.io/selected-node``,
@@ -586,12 +674,14 @@ class YodaPreFilter(PreFilterPlugin):
         if pod.topology_spread:
             spread = SpreadEvaluator.build(snapshot, pod, pending=pending)
         ports_by_node: dict[str, tuple] = {}
+        pending_vols_by_node: dict[str, tuple] = {}
         if pending:
             # In-flight resource claims, deduped against the snapshot by
             # uid (bind events may have landed since the member was
             # recorded) — the NodeResourcesFit companion of the affinity
             # pending feed. hostPort claims ride along for the NodePorts
-            # check.
+            # check, and pending siblings' CSI volumes for the attach
+            # limit (the same Permit-window race in every dimension).
             seen = {
                 p.uid for ni in snapshot.infos() for p in ni.pods
             }
@@ -609,12 +699,33 @@ class YodaPreFilter(PreFilterPlugin):
                     ports_by_node[host] = (
                         ports_by_node.get(host, ()) + p.host_ports
                     )
+                if p.pvc_names:
+                    vols = resolve_attach_volumes(snapshot, p)
+                    if vols:
+                        pending_vols_by_node[host] = (
+                            pending_vols_by_node.get(host, ()) + vols
+                        )
             if by_node:
                 state.write(PENDING_RES_KEY, PendingResources(by_node))
-        if inter is not None or spread is not None or pvcs or ports_by_node:
+        pv_volumes = resolve_attach_volumes(snapshot, pod)
+        if (
+            inter is not None
+            or spread is not None
+            or pvcs
+            or ports_by_node
+            or pv_volumes
+        ):
             state.write(
                 AFFINITY_KEY,
-                AffinityData(inter, spread, pvcs, ports_by_node or None),
+                AffinityData(
+                    inter,
+                    spread,
+                    pvcs,
+                    ports_by_node or None,
+                    pv_volumes,
+                    (snapshot.pvcs, snapshot.pvs) if pv_volumes else None,
+                    pending_vols_by_node or None,
+                ),
             )
         if pod.container_images and self.image_locality_weight:
             # ImageLocality's fleet view (plugins/yoda/image_locality.py):

@@ -235,6 +235,7 @@ class TpuPreemption(PostFilterPlugin):
                 or aff.inter.required_affinity_feasible(ni)
             )
             and self._resources_possible(ni, req, pod)
+            and self._attach_possible(ni, req, aff)
         )
 
     def _port_blockers(
@@ -322,6 +323,49 @@ class TpuPreemption(PostFilterPlugin):
         ):
             return False
         return True
+
+    def _attach_fits(self, ni: NodeInfo, pods, aff: AffinityData) -> bool:
+        """node_fits_attach_limits against a hypothetical pod set (the
+        node with some victims removed)."""
+        from yoda_tpu.plugins.yoda.filter_plugin import node_fits_attach_limits
+
+        view = NodeInfo(ni.name, tpu=ni.tpu, pods=list(pods), node=ni.node)
+        return node_fits_attach_limits(
+            aff.pv_volumes, view, *aff.claim_maps
+        )[0]
+
+    def _attach_possible(
+        self, ni: NodeInfo, req: TpuRequest, aff: AffinityData | None
+    ) -> bool:
+        """Could the preemptor's CSI attach limits be satisfied after
+        evicting EVERY eligible victim? Non-victim volume holders (foreign
+        higher-priority pods) keep their attachments — if that floor alone
+        saturates the limit, eviction is pure waste on this node (the
+        _resources_possible pattern in the NodeVolumeLimits dimension;
+        without it preemption would evict chip victims forever on a node
+        the pod's volumes can never attach to)."""
+        if aff is None or not aff.pv_volumes or aff.claim_maps is None:
+            return True
+        keep = []
+        for p in ni.pods:
+            v = self._victim_of(p, ni.name)
+            if v is not None and v.priority < req.priority:
+                continue  # evictable: its attachments can be freed
+            keep.append(p)
+        return self._attach_fits(ni, keep, aff)
+
+    def _fits_attach_after(
+        self, ni: NodeInfo, chosen: "list[Victim]", aff: AffinityData | None
+    ) -> bool:
+        """Do the attach limits fit once exactly ``chosen`` are evicted?
+        _minimal_set keeps buying victims until chips AND resources AND
+        attachments fit (a victim's eviction detaches its volumes)."""
+        if aff is None or not aff.pv_volumes or aff.claim_maps is None:
+            return True
+        gone = {v.pod.uid for v in chosen}
+        return self._attach_fits(
+            ni, [p for p in ni.pods if p.uid not in gone], aff
+        )
 
     def _fits_resources_after(
         self, ni: NodeInfo, pod: PodSpec, chosen: "list[Victim]"
@@ -453,9 +497,11 @@ class TpuPreemption(PostFilterPlugin):
             if v is not None:
                 chosen.append(v)
                 freed += v.chips
-            if self._avail_after(
-                ni, req, freed
-            ) >= want and self._fits_resources_after(ni, pod, chosen):
+            if (
+                self._avail_after(ni, req, freed) >= want
+                and self._fits_resources_after(ni, pod, chosen)
+                and self._fits_attach_after(ni, chosen, aff)
+            ):
                 return chosen
         return None
 
