@@ -370,8 +370,7 @@ class TestLibtpuMetricsClient:
         assert hbm.free(9) is None
         assert hbm.duty_cycle_pct == {0: 81.0, 1: 0.0}
         # The client asked for exactly the three runtime metrics (duty
-        # cycle only because this call opted in — the agent's per-cycle
-        # reads skip it).
+        # cycle because this call opted in, as the CLI agent does).
         assert srv.requests_seen == [
             tm.METRIC_HBM_TOTAL,
             tm.METRIC_HBM_USAGE,
@@ -551,6 +550,37 @@ class TestAgentLibtpuOverlay:
         by_idx = {c.index: c for c in tpu.chips}
         assert by_idx[1].hbm_free == 16 * GIB - 4 * GIB  # claim attributed here
         assert tpu.external_used_chips == 1  # chip0's tenant stays visible
+
+    def test_duty_cycle_flows_to_cr_without_breaking_heartbeats(self, lib, env_spec):
+        """Duty cycle (opt-in third query) lands per chip in the CR — and
+        a duty-ONLY wiggle between publishes is classified as a heartbeat
+        (values_equal excludes it), or every scrape would rebuild the
+        fleet arrays."""
+        from yoda_tpu.agent import tpu_metrics as tm
+        from yoda_tpu.api.types import TpuNodeMetrics
+        from yoda_tpu.testing.fake_libtpu import FakeLibtpuMetricsServer
+
+        env_spec("generation=v5e;chips=1")
+        cluster = FakeCluster()
+        with FakeLibtpuMetricsServer(
+            {0: (16 * GIB, 2 * GIB)}, duty_cycle_pct={0: 37.5}
+        ) as srv:
+            agent = self._agent(
+                lib,
+                cluster,
+                lambda: tm.query_hbm(srv.address, timeout_s=5.0, duty_cycle=True),
+            )
+            first = agent.run_once()
+            assert first.chips[0].duty_cycle_pct == 37.5
+            # Round trip (the scheduler reads the CR over the wire).
+            assert (
+                TpuNodeMetrics.from_obj(first.to_obj())
+                .chips[0].duty_cycle_pct == 37.5
+            )
+            srv.duty_cycle_pct[0] = 91.0  # utilization moved; HBM did not
+            second = agent.run_once()
+        assert second.chips[0].duty_cycle_pct == 91.0
+        assert first.values_equal(second)  # heartbeat, not a real change
 
     def test_occupancy_changes_flow_between_publishes(self, lib, env_spec):
         """The DaemonSet loop picks up live occupancy movement — the

@@ -282,6 +282,9 @@ def overlay_libtpu(tpu: TpuNodeMetrics, hbm) -> frozenset[int]:
         chip.hbm_total = total
         chip.hbm_free = max(total - used, 0)
         chip.hw_read = True
+        duty = hbm.duty_cycle_pct.get(chip.index)
+        if duty is not None:
+            chip.duty_cycle_pct = float(duty)
         covered.add(chip.index)
     if covered:
         tpu.source = (

@@ -240,11 +240,13 @@ def query_hbm(
 ) -> LibtpuHbm:
     """One typed read of per-chip HBM total/usage from the libtpu metrics
     service. ``duty_cycle=True`` adds a best-effort third query for the
-    tensorcore duty cycle (diagnostics — nothing in the scheduling path
-    consumes it, so the agent's per-cycle reads skip the extra RPC).
-    Raises :class:`LibtpuMetricsUnavailable` with the typed reason on any
-    failure — callers treat that as "fall back to the next HBM source",
-    never as an agent error."""
+    tensorcore duty cycle — observational only (the CR's per-chip
+    ``duty_cycle_pct`` and the /metrics fleet gauge; the scheduling path
+    never consumes it). The CLI agent opts in (cli.py --libtpu-metrics);
+    callers that want only the scheduling inputs leave it off and save
+    the RPC. Raises :class:`LibtpuMetricsUnavailable` with the typed
+    reason on any failure — callers treat that as "fall back to the next
+    HBM source", never as an agent error."""
     try:
         import grpc
     except Exception as e:  # noqa: BLE001 — keep the agent import-safe

@@ -170,6 +170,14 @@ class TpuChip:
     # node-level ``external_used_chips`` count the scheduler's reservation
     # corrections key on (NativeTpuAgent._external_used).
     hw_read: bool = False
+    # Tensorcore duty cycle [0, 100] from the libtpu metrics service
+    # (agent --libtpu-metrics; tpu-info's utilization column). Purely
+    # observational — aggregated on /metrics as the fleet-mean gauge
+    # yoda_tpu_duty_cycle_avg_pct (per-chip values live in the CR) for
+    # operators chasing underutilized fleets; the scheduler never filters
+    # or scores on it (a busy chip is already excluded by its HBM usage
+    # under the exclusive-chip model). None = source not available.
+    duty_cycle_pct: float | None = None
 
     @property
     def healthy(self) -> bool:
@@ -229,16 +237,29 @@ class TpuNodeMetrics:
 
     def values_equal(self, other: "TpuNodeMetrics") -> bool:
         """Equality on every schedulability-relevant field — everything
-        except the publish timestamp and resource version. Derived from
-        the dataclass so a FUTURE field defaults to RELEVANT (consumers:
-        the informer's heartbeat classification and the fleet-array
-        incremental diff — a hand-kept field list would silently classify
-        real changes as heartbeats)."""
+        except the publish timestamp, resource version, and the purely
+        observational per-chip duty cycle (a continuously fluctuating
+        telemetry value the scheduler never filters or scores on: leaving
+        it relevant would classify EVERY heartbeat as a real change and
+        reintroduce the per-heartbeat rebuild storm the elision exists to
+        prevent). Otherwise derived from the dataclass so a FUTURE field
+        defaults to RELEVANT (consumers: the informer's heartbeat
+        classification and the fleet-array incremental diff — a hand-kept
+        field list would silently classify real changes as heartbeats)."""
         import dataclasses
 
-        return dataclasses.replace(
-            self, last_updated_unix=0.0, resource_version=0
-        ) == dataclasses.replace(other, last_updated_unix=0.0, resource_version=0)
+        def neutral(t: "TpuNodeMetrics") -> "TpuNodeMetrics":
+            return dataclasses.replace(
+                t,
+                last_updated_unix=0.0,
+                resource_version=0,
+                chips=[
+                    dataclasses.replace(c, duty_cycle_pct=None)
+                    for c in t.chips
+                ],
+            )
+
+        return neutral(self) == neutral(other)
 
     # --- CR (de)serialization, used by the fake/real API server paths ---
 

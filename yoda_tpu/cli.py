@@ -237,7 +237,9 @@ def _run_agent(args, stop: threading.Event) -> int:
             from yoda_tpu.agent.tpu_metrics import query_hbm
 
             addr = args.libtpu_metrics_addr
-            libtpu_fn = lambda: query_hbm(addr)  # noqa: E731
+            # duty_cycle: one extra unary RPC per scrape, consumed as the
+            # per-chip duty_cycle_pct CR field -> /metrics fleet gauge.
+            libtpu_fn = lambda: query_hbm(addr, duty_cycle=True)  # noqa: E731
         agent = NativeTpuAgent(
             cluster,
             node_name,

@@ -341,6 +341,28 @@ class SchedulingMetrics:
             binpack_efficiency,
         )
 
+        def duty_cycle_avg() -> float:
+            # Tensorcore utilization across chips that report it (agents
+            # running --libtpu-metrics). Observational: pairs with
+            # binpack_efficiency to separate "chips handed out" from
+            # "chips actually computing". 0 when no chip reports.
+            total = n = 0.0
+            for ni in snapshot_fn().infos():
+                if ni.tpu is None:
+                    continue
+                for c in ni.tpu.chips:
+                    if c.duty_cycle_pct is not None:
+                        total += c.duty_cycle_pct
+                        n += 1
+            return total / n if n else 0.0
+
+        self.registry.gauge(
+            "yoda_tpu_duty_cycle_avg_pct",
+            "Mean tensorcore duty cycle over chips reporting it "
+            "(libtpu metrics service; 0 = no reporting chips)",
+            duty_cycle_avg,
+        )
+
     # --- trace ---
 
     def trace(self, entry: TraceEntry) -> None:
