@@ -873,3 +873,29 @@ class TestHostPortPreemption:
         assert stack.cluster.get_pod("default/train").node_name == "host"
         assert stack.cluster.get_pod("default/holder") is None
         assert stack.cluster.get_pod("default/filler") is None
+
+
+class TestPdbFakeEnforcement:
+    def test_published_status_decrements_across_evictions(self):
+        """FakeCluster models the real API: a published
+        status.disruptionsAllowed=1 admits ONE eviction and refuses the
+        second until the (fake) controller republishes."""
+        from yoda_tpu.api.affinity import LabelSelector
+        from yoda_tpu.api.types import K8sPdb
+        from yoda_tpu.cluster import FakeCluster
+
+        cluster = FakeCluster()
+        for i in range(2):
+            pod = PodSpec(f"db-{i}", labels={"app": "db"})
+            cluster.create_pod(pod)
+            cluster.bind_pod(pod.key, "n1")
+        pdb = K8sPdb(
+            "db",
+            selector=LabelSelector(match_labels=(("app", "db"),)),
+            disruptions_allowed=1,
+        )
+        cluster.put_pdb(pdb)
+        assert cluster.evict_pod("default/db-0") is True
+        assert cluster.evict_pod("default/db-1") is False  # budget spent
+        cluster.put_pdb(pdb)  # controller republishes status
+        assert cluster.evict_pod("default/db-1") is True

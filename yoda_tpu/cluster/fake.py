@@ -44,6 +44,12 @@ class FakeCluster:
         self._namespaces: dict[str, K8sNamespace] = {}
         self._pvcs: dict[str, K8sPvc] = {}  # "namespace/name" -> claim
         self._pdbs: dict[str, K8sPdb] = {}  # "namespace/name" -> budget
+        # Evictions consumed against a PUBLISHED status.disruptionsAllowed
+        # since the budget object last changed — the real API decrements
+        # the status as it admits evictions; a static fake value would
+        # under-enforce sequential evictions. Reset by put_pdb (the
+        # disruption controller republishing).
+        self._pdb_used: dict[str, int] = {}
         self._pvs: dict[str, K8sPv] = {}    # name -> persistent volume
         self._events: dict[str, dict] = {}
         self._watchers: list[Callable[[Event], None]] = []
@@ -141,6 +147,7 @@ class FakeCluster:
             if pod_key in self.eviction_blocked:
                 return False
             pod = self._pods.get(pod_key)
+            consumed: list[str] = []
             if pod is not None:
                 for pdb in self._pdbs.values():
                     if not pdb.matches(pod):
@@ -154,8 +161,17 @@ class FakeCluster:
                         for p in self._pods.values()
                         if p.node_name and pdb.matches(p)
                     )
-                    if pdb.allowed_disruptions(matching) < 1:
+                    allowed = pdb.allowed_disruptions(
+                        matching
+                    ) - self._pdb_used.get(pdb.key, 0)
+                    if allowed < 1:
                         return False
+                    consumed.append(pdb.key)
+                for key in consumed:
+                    # Decrement only budgets with a PUBLISHED status (the
+                    # derived path self-corrects via the matching count).
+                    if self._pdbs[key].disruptions_allowed is not None:
+                        self._pdb_used[key] = self._pdb_used.get(key, 0) + 1
         self.delete_pod(pod_key)
         return True
 
@@ -251,6 +267,7 @@ class FakeCluster:
         with self._lock:
             is_new = pdb.key not in self._pdbs
             self._pdbs[pdb.key] = pdb
+            self._pdb_used.pop(pdb.key, None)  # controller republished
             self._emit(
                 Event("added" if is_new else "modified", "PodDisruptionBudget", pdb)
             )

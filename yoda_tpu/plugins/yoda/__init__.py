@@ -63,6 +63,7 @@ def default_plugins(
         YodaPreFilter(
             pending_fn=pending_fn,
             image_locality_weight=(weights or Weights()).image_locality,
+            write_image_spread=(mode == "loop"),
         ),
     ]
     if mode == "batch":

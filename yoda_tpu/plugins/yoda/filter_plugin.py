@@ -622,11 +622,17 @@ class YodaPreFilter(PreFilterPlugin):
         *,
         pending_fn: Callable[[], list[tuple[str, PodSpec]]] | None = None,
         image_locality_weight: int = 1,
+        write_image_spread: bool = True,
     ) -> None:
         # Weights.image_locality, threaded in so a zero weight skips the
         # ImageLocality fleet walk entirely (the batch path gates the
         # same way in _preference_bonus).
         self.image_locality_weight = image_locality_weight
+        # False in batch mode: only loop mode's ImageLocalityScore reads
+        # the CycleState spread; the batch path computes its own inside
+        # _preference_bonus (bursts prepare pods before any cycle exists),
+        # so writing it here would be a duplicated O(fleet) walk.
+        self.write_image_spread = write_image_spread
         # GangPlugin.pending_placements when gang scheduling is wired:
         # reserved-but-unbound members, visible to the evaluators so gang
         # siblings honor each other's inter-pod terms mid-flight.
@@ -727,7 +733,11 @@ class YodaPreFilter(PreFilterPlugin):
                     pending_vols_by_node or None,
                 ),
             )
-        if pod.container_images and self.image_locality_weight:
+        if (
+            pod.container_images
+            and self.image_locality_weight
+            and self.write_image_spread
+        ):
             # ImageLocality's fleet view (plugins/yoda/image_locality.py):
             # one walk, only for image-naming pods on image-reporting
             # fleets with the knob enabled.

@@ -326,12 +326,21 @@ class TpuPreemption(PostFilterPlugin):
 
     def _attach_fits(self, ni: NodeInfo, pods, aff: AffinityData) -> bool:
         """node_fits_attach_limits against a hypothetical pod set (the
-        node with some victims removed)."""
+        node with some victims removed). Permit-parked siblings' pending
+        volumes count exactly as the Filter path counts them
+        (AffinityData.feasible) — a simulation that ignored them would
+        bless victim sets the subsequent Filter still rejects, evicting
+        pods that cannot help."""
         from yoda_tpu.plugins.yoda.filter_plugin import node_fits_attach_limits
 
+        pend = (
+            aff.pending_volumes.get(ni.name, ())
+            if aff.pending_volumes
+            else ()
+        )
         view = NodeInfo(ni.name, tpu=ni.tpu, pods=list(pods), node=ni.node)
         return node_fits_attach_limits(
-            aff.pv_volumes, view, *aff.claim_maps
+            aff.pv_volumes + tuple(pend), view, *aff.claim_maps
         )[0]
 
     def _attach_possible(
