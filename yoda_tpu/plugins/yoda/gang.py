@@ -129,6 +129,11 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # recorder's GangRollback reason here (VERDICT r2 #6).
         self.on_rollback = on_rollback
         self._lock = threading.RLock()
+        # Concurrent waitlist release (on_pod_waiting): created lazily on
+        # the first multi-member release (gang-free stacks and tests never
+        # pay the threads) and persistent from then on, so the workers'
+        # per-thread pooled API connections amortize across gangs.
+        self._release_pool = None
         self._gangs: dict[str, _GangState] = {}
         self._framework = None
 
@@ -516,10 +521,50 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 "gang %s complete: releasing %d waiting member(s)",
                 gang_name, len(targets),
             )
-        for key in targets:
-            w = framework.get_waiting_pod(key)
-            if w is not None:
+        waiters = [
+            w
+            for key in targets
+            if (w := framework.get_waiting_pod(key)) is not None
+        ]
+        if len(waiters) <= 1:
+            for w in waiters:
                 w.allow(self.name)
+            return
+        # Release members CONCURRENTLY: each allow() runs the member's
+        # bind synchronously (an API round-trip on real clusters), and a
+        # gang of N pays N-1 of them here — sequentially that is the
+        # dominant share of wire gang latency (BENCH r5 decomposition:
+        # the `visible` phase). Upstream binds from a goroutine per pod;
+        # waiting on a bounded PERSISTENT executor keeps this framework's
+        # cycle-returns-after-release semantics while the round trips
+        # overlap — persistent so the workers' per-thread keep-alive
+        # connections (KubeApiClient._pooled) amortize across gangs
+        # instead of paying a TCP handshake per release. Each WaitingPod
+        # resolves exactly once under its own lock, so a concurrent
+        # cascade reject (one member's bind failing) degrades exactly as
+        # the sequential order did. EVERY future is observed before any
+        # failure re-raises: an unobserved worker exception would vanish
+        # silently, unlike the old sequential loop.
+        if self._release_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                if self._release_pool is None:
+                    self._release_pool = ThreadPoolExecutor(
+                        max_workers=8, thread_name_prefix="gang-release"
+                    )
+        futures = [
+            self._release_pool.submit(w.allow, self.name) for w in waiters
+        ]
+        first_error = None
+        for w, f in zip(waiters, futures):
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 — observe every worker
+                log.exception("releasing gang member %s failed", w.pod.key)
+                first_error = first_error or e
+        if first_error is not None:
+            raise first_error
 
     def on_pod_resolved(self, framework, wp, status: Status) -> None:
         """Framework hook on waitlist resolution: success moves the member to
