@@ -178,11 +178,14 @@ def _http_gang_scenario() -> dict:
     binding POSTs by the scheduler — one in-cycle, three from the Permit
     resolution path) at ~1 ms each against the in-process GIL-shared
     server; watch delivery itself measures 0 ms (condition-notified).
-    Keep-alive connection pooling + TCP_NODELAY (KubeApiClient._pooled,
-    FakeKubeApiServer disable_nagle_algorithm) cut the r4 numbers
-    (23.8/16.6 p99/p50) to ~15/10 with the scheduler's own in-cycle
-    share ~4.5 ms p50 — the remaining floor is transport round trips,
-    not scheduling."""
+    Two r5 cuts: keep-alive pooling + TCP_NODELAY (KubeApiClient._pooled,
+    FakeKubeApiServer disable_nagle_algorithm) removed the per-call TCP
+    handshakes, and the gang waitlist now releases CONCURRENTLY
+    (plugins/yoda/gang.py on_pod_waiting) so the three post-cycle binds
+    overlap. r4's 23.8/16.6 p99/p50 measured ~11.9/8.8 after both, with
+    the scheduler's in-cycle share ~4.5-5 ms p50 — the remaining floor
+    is client-side creation POSTs plus one round of transport, not
+    scheduling."""
     import threading
 
     from yoda_tpu.agent import FakeTpuAgent
