@@ -973,3 +973,88 @@ class TestCoschedulingCompat:
         pods = [p for p in stack.cluster.list_pods()]
         bound = [p for p in pods if p.node_name]
         assert len(bound) == 3, [(p.name, p.node_name) for p in pods]
+
+
+class TestParallelRelease:
+    """The concurrent waitlist-release path (gang.py parallel_release —
+    wired for remote-bind backends, forced on here so the pool branch
+    keeps test coverage): lazy executor creation, every member released,
+    and the flaky-bind self-heal through overlapping releases."""
+
+    def _stack(self):
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(config=SchedulerConfig(mode="batch"))
+        assert stack.gang.parallel_release is False  # in-process default
+        stack.gang.parallel_release = True
+        return stack
+
+    def test_gang_binds_through_the_pool(self):
+        stack = self._stack()
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"host-{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        assert stack.gang._release_pool is None  # lazy until first release
+        for pod in gang_pods("par", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        assert len({p.node_name for p in pods}) == 4
+        assert stack.gang.gang_status("par") == (4, 0, 4)
+        assert stack.gang._release_pool is not None  # pool path engaged
+
+    def test_two_gangs_reuse_the_pool(self):
+        stack = self._stack()
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"host-{i}", generation="v5p", chips=8)
+        agent.publish_all()
+        for tag in ("g1", "g2"):
+            for pod in gang_pods(tag, 4, chips=4):
+                stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=20)
+        pool = stack.gang._release_pool
+        assert pool is not None
+        assert all(p.node_name for p in stack.cluster.list_pods())
+        assert stack.gang.gang_status("g1") == (4, 0, 4)
+        assert stack.gang.gang_status("g2") == (4, 0, 4)
+
+    def test_flaky_bind_self_heals_through_the_pool(self):
+        """A bind failing DURING a concurrent release must roll that
+        member back and retry while its siblings bind — the every-future-
+        observed contract."""
+        from yoda_tpu.framework.interfaces import BindPlugin, Code, Status
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        class FlakyBinder(BindPlugin):
+            name = "flaky-binder"
+
+            def __init__(self):
+                self.tripped = False
+
+            def bind(self, state, pod, node_name):
+                if not self.tripped and pod.name == "pf-1":
+                    self.tripped = True
+                    return Status.error("transient bind failure")
+                return Status(code=Code.SKIP)
+
+        flaky = FlakyBinder()
+        stack = build_stack(
+            config=SchedulerConfig(mode="batch"), extra_plugins=[flaky]
+        )
+        stack.gang.parallel_release = True
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"host-{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("pf", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert flaky.tripped
+        bound = {p.name: p.node_name for p in stack.cluster.list_pods()}
+        assert all(v is not None for v in bound.values()), bound
+        assert stack.gang.gang_status("pf") == (4, 0, 4)

@@ -374,6 +374,12 @@ class KubeCluster:
     store first (list-then-watch), matching ``FakeCluster.add_watcher``.
     """
 
+    # Binds are real API round-trips: gang waitlist releases overlap them
+    # on a thread pool (standalone.build_stack -> GangPlugin
+    # parallel_release). In-process backends leave this False — their
+    # binds are microseconds and the thread handoff costs more.
+    remote_binds = True
+
     def __init__(
         self,
         api: KubeApiClient,

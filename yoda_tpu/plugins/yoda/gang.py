@@ -122,8 +122,17 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         timeout_s: float = 120.0,
         reserved_fn: Callable[[str], int] | None = None,
         on_rollback: Callable[[PodSpec, str, str], None] | None = None,
+        parallel_release: bool = False,
     ) -> None:
         self.timeout_s = timeout_s
+        # Overlap the waitlist-release binds on a thread pool. ONLY worth
+        # it when a bind is an API round-trip (KubeCluster: ~1 ms+ each;
+        # standalone.build_stack wires True for backends with a real HTTP
+        # client): against an in-process FakeCluster a bind is
+        # microseconds and the thread handoff itself costs more than it
+        # saves (measured: in-process gang p99 1.9 -> 5.3 ms when always
+        # on).
+        self.parallel_release = parallel_release
         self.reserved_fn = reserved_fn
         # (member pod, gang name, why) — standalone wires the Event
         # recorder's GangRollback reason here (VERDICT r2 #6).
@@ -526,9 +535,21 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             for key in targets
             if (w := framework.get_waiting_pod(key)) is not None
         ]
-        if len(waiters) <= 1:
+        if len(waiters) <= 1 or not self.parallel_release:
+            # Same every-member-observed invariant as the pool branch: a
+            # raising resolution chain must not abandon the remaining
+            # members to the permit timeout.
+            first_error = None
             for w in waiters:
-                w.allow(self.name)
+                try:
+                    w.allow(self.name)
+                except Exception as e:  # noqa: BLE001
+                    log.exception(
+                        "releasing gang member %s failed", w.pod.key
+                    )
+                    first_error = first_error or e
+            if first_error is not None:
+                raise first_error
             return
         # Release members CONCURRENTLY: each allow() runs the member's
         # bind synchronously (an API round-trip on real clusters), and a

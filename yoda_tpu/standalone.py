@@ -82,6 +82,11 @@ def build_stack(
         timeout_s=config.gang_permit_timeout_s,
         reserved_fn=accountant.chips_in_use,
         on_rollback=recorder.gang_rollback if recorder else None,
+        # Overlap waitlist-release binds only when each bind is a real
+        # API round-trip (KubeCluster declares remote_binds = True);
+        # in-process binds are microseconds and the thread handoff would
+        # cost more than it saves (gang.py parallel_release).
+        parallel_release=getattr(cluster, "remote_binds", False),
     )
     plugins = default_plugins(
         mode=config.mode,
