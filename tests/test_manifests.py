@@ -164,6 +164,27 @@ class TestExamples:
         assert req.chips == 2
         assert req.priority == 1
 
+    def test_example_disruption_volumes_parses(self):
+        """The r5 example (PDB-protected serving + PV-pinned loader) must
+        stay consistent with the strict label parser, the PDB model, and
+        the pod's claim extraction."""
+        from yoda_tpu.api.types import K8sPdb, PodSpec
+
+        docs = load_all("example/test-disruption-volumes.yaml")
+        kinds = [d["kind"] for d in docs]
+        assert kinds == ["PodDisruptionBudget", "Deployment", "Pod"]
+        pdb = K8sPdb.from_obj(docs[0])
+        assert pdb.min_available == 2
+        assert pdb.matches(PodSpec("x", labels={"app": "llm-serving"}))
+        tmpl = docs[1]["spec"]["template"]["metadata"]["labels"]
+        req = parse_request(
+            {k: v for k, v in tmpl.items() if k.startswith("tpu/")}
+        )
+        assert req.priority == 2
+        pod = PodSpec.from_obj(docs[2])
+        assert pod.pvc_names == ("checkpoint-ssd",)
+        assert parse_request(pod.labels).effective_chips == 4
+
     def test_example_multislice_pod_parses(self):
         (obj,) = load_all("example/test-multislice.yaml")
         req = parse_request(obj["metadata"]["labels"])

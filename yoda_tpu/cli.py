@@ -179,6 +179,10 @@ def _run_scheduler(args, stop: threading.Event) -> int:
         for t in extra_threads:
             t.join(timeout=10)
     finally:
+        for st in stacks:
+            # Release the gang concurrent-release executor without waiting
+            # on a possibly stalled bind round-trip (GangPlugin.close).
+            st.gang.close()
         for st in stacks[1:]:
             if st.events is not None:
                 st.events.close(timeout_s=5.0)

@@ -536,20 +536,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             if (w := framework.get_waiting_pod(key)) is not None
         ]
         if len(waiters) <= 1 or not self.parallel_release:
-            # Same every-member-observed invariant as the pool branch: a
-            # raising resolution chain must not abandon the remaining
-            # members to the permit timeout.
-            first_error = None
-            for w in waiters:
-                try:
-                    w.allow(self.name)
-                except Exception as e:  # noqa: BLE001
-                    log.exception(
-                        "releasing gang member %s failed", w.pod.key
-                    )
-                    first_error = first_error or e
-            if first_error is not None:
-                raise first_error
+            self._observed_release(waiters, lambda w: w.allow(self.name))
             return
         # Release members CONCURRENTLY: each allow() runs the member's
         # bind synchronously (an API round-trip on real clusters), and a
@@ -563,9 +550,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # instead of paying a TCP handshake per release. Each WaitingPod
         # resolves exactly once under its own lock, so a concurrent
         # cascade reject (one member's bind failing) degrades exactly as
-        # the sequential order did. EVERY future is observed before any
-        # failure re-raises: an unobserved worker exception would vanish
-        # silently, unlike the old sequential loop.
+        # the sequential order did.
         if self._release_pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -577,15 +562,36 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         futures = [
             self._release_pool.submit(w.allow, self.name) for w in waiters
         ]
+        self._observed_release(
+            list(zip(waiters, futures)), lambda pair: pair[1].result()
+        )
+
+    @staticmethod
+    def _observed_release(items, invoke) -> None:
+        """Run ``invoke`` over every item, observing EVERY member before
+        any failure re-raises (both release branches share this: a
+        raising resolution chain — or an unobserved worker future — must
+        not abandon the remaining members to the permit timeout)."""
         first_error = None
-        for w, f in zip(waiters, futures):
+        for item in items:
+            w = item[0] if isinstance(item, tuple) else item
             try:
-                f.result()
-            except Exception as e:  # noqa: BLE001 — observe every worker
+                invoke(item)
+            except Exception as e:  # noqa: BLE001
                 log.exception("releasing gang member %s failed", w.pod.key)
                 first_error = first_error or e
         if first_error is not None:
             raise first_error
+
+    def close(self) -> None:
+        """Release the concurrent-release executor (cli.py's drain path).
+        ``wait=False`` so a SIGTERM during a stalled bind round-trip does
+        not block the drain on the worker; the in-flight HTTP call is
+        bounded by KubeApiConfig.request_timeout_s either way (the
+        atexit join observes that cap at worst)."""
+        pool, self._release_pool = self._release_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def on_pod_resolved(self, framework, wp, status: Status) -> None:
         """Framework hook on waitlist resolution: success moves the member to
