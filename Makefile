@@ -8,7 +8,7 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test native bench demo soak image push format clean
+.PHONY: all test native bench smoke demo soak image push format clean
 
 all: native test
 
@@ -20,6 +20,11 @@ native:
 
 bench: native
 	$(PY) bench.py
+
+# Seconds-scale contended-gang check (CPU-pinned, small fleet): guards the
+# burst+gang hot-path rate without the full bench's minutes of scenarios.
+smoke:
+	$(PY) bench.py --smoke
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

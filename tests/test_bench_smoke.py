@@ -1,0 +1,34 @@
+"""Contended burst+gang scenario invariants (bench.py, ISSUE 1).
+
+Slow-marked: runs the full-size contended scenario (60 singletons + one
+4-member topology gang, the BENCH_r05 cliff shape) through bench.py's own
+code so the invariants the bench asserts inline — every pod bound, gang
+one-member-per-host, no chip oversubscription — are also guarded by the
+test suite. `bench.py --smoke` / `make smoke` guards the RATE on a reduced
+fleet; this guards correctness at the measured shape.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_contended_scenario_invariants():
+    import bench
+
+    # The scenario raises AssertionError itself if any invariant (64/64
+    # bound, gang one-per-host, chips_in_use <= capacity) is violated.
+    out = bench._burst_with_gang_scenario()
+    assert out["burst_with_gang_pods_per_s"] > 0
+    # The gang-fused pass actually engaged: the whole gang from one
+    # dispatch, and far fewer dispatches than pods (r05 paid 49/64).
+    assert out["burst_with_gang_fused_served"] == 4
+    assert out["burst_with_gang_dispatches"] <= 16
+
+
+def test_smoke_mode_runs_reduced_fleet():
+    import bench
+
+    out = bench.run_smoke()
+    assert out["metric"] == "smoke_burst_with_gang_pods_per_s"
+    assert out["burst_with_gang_fused_served"] == 4

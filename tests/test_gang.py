@@ -348,18 +348,21 @@ class TestGangBatchedDispatch:
         assert len(hosts) == 4
         assert len({h.rsplit("-", 1)[0] for h in hosts}) == 1
         assert batch.dispatch_count == d0 + 1
-        assert batch.plan_served == 3  # members 2-4 answered from the plan
-        assert not batch._gang_plans  # fully-served plan released
+        # All four members (gathered co-queued) answered from the single
+        # gang-fused dispatch; the lazy plan path never needed to build.
+        assert batch.gang_burst_served == 4
+        assert not batch._gang_bursts  # fully-served set released
+        assert not batch._gang_plans
         # The counters are scraped via /metrics as counter-typed series.
         rendered = stack.metrics.registry.render_prometheus()
-        assert "# TYPE yoda_gang_plan_served_total counter" in rendered
+        assert "# TYPE yoda_gang_fused_served_total counter" in rendered
         assert "# TYPE yoda_kernel_dispatches_total counter" in rendered
         served = next(
             m
             for m in stack.metrics.registry._metrics
-            if m.name == "yoda_gang_plan_served_total"
+            if m.name == "yoda_gang_fused_served_total"
         )
-        assert served.value() == 3
+        assert served.value() == 4
 
     def test_one_dispatch_per_plain_gang_sharing_hosts(self):
         stack, agent = make_stack()
@@ -873,7 +876,9 @@ class TestMultislice:
         }
         assert all(placed.values()), placed
         assert len(set(placed.values())) == 8
-        assert batch.plan_served == 7  # siblings served, one dispatch total
+        # All members served from the single gang-fused dispatch (the
+        # sharded kernel's evaluate_burst feeds the same claimable rows).
+        assert batch.gang_burst_served == 8
 
     def test_multislice_restart_reconstruction(self):
         """Bound members replayed after a restart pin their blocks; the

@@ -1,0 +1,285 @@
+"""The gang-fused scheduling pass (ISSUE 1).
+
+When a popped pod is a gang member, the scheduler gathers its co-queued
+siblings (SchedulingQueue.pop_matching), pre-evaluates the whole gang in
+ONE kernel dispatch (YodaBatch.prepare_gang_burst — per-member rows,
+inter-member capacity deduction), and drives reserve -> permit -> bind for
+every member back-to-back in one loop turn, so the Permit barrier resolves
+inside the last member's cycle instead of parking each member across later
+turns. Late members reactivate parked siblings through the queue's
+gang-arrival signal instead of the backoff-sleep ladder.
+"""
+
+import threading
+import time
+from collections import Counter
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.standalone import build_stack
+
+
+def make_stack(**cfg):
+    cfg.setdefault("mode", "batch")
+    stack = build_stack(config=SchedulerConfig(**cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+def gang_pod(gang, i, size=4, chips="2", **labels):
+    return PodSpec(
+        f"{gang}-{i}",
+        labels={
+            "tpu/gang": gang,
+            "tpu/gang-size": str(size),
+            "tpu/chips": chips,
+            **labels,
+        },
+    )
+
+
+class TestGatheredGang:
+    def test_scattered_members_fuse_into_one_dispatch(self):
+        """Members split around a block of singletons (the BENCH_r05
+        contended shape): the first member's pop gathers the tail members
+        past the singletons, the gang places from ONE dispatch, and the
+        singletons burst behind it instead of dispatching individually
+        against a parked gang."""
+        stack, agent = make_stack(batch_requests=8)
+        for s in range(2):
+            agent.add_slice(f"v5p-{s}", generation="v5p", host_topology=(2, 2, 1))
+        for i in range(4):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        yb = stack.framework.batch_plugins[0]
+        topo = {"tpu/gang": "g", "tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(2):
+            stack.cluster.create_pod(PodSpec(f"g-{i}", labels=dict(topo)))
+        for i in range(16):
+            stack.cluster.create_pod(
+                PodSpec(f"s-{i}", labels={"tpu/chips": "1"})
+            )
+        for i in range(2, 4):
+            stack.cluster.create_pod(PodSpec(f"g-{i}", labels=dict(topo)))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        gang_hosts = {p.node_name for p in pods if p.name.startswith("g-")}
+        assert len(gang_hosts) == 4  # one member per host
+        assert len({h.rsplit("-", 1)[0] for h in gang_hosts}) == 1
+        assert yb.gang_burst_dispatches == 1
+        assert yb.gang_burst_served == 4
+        # The singletons rode bursts — the parked-gang refusal is gone.
+        assert yb.burst_served >= 8
+        for i in range(4):
+            assert stack.accountant.chips_in_use(f"v5e-{i}") <= 8
+
+    def test_heterogeneous_members_fuse(self):
+        """Members with DIFFERENT chip requests share one fused dispatch —
+        the identical-request restriction of the lazy gang plan does not
+        apply to per-member burst rows."""
+        stack, agent = make_stack()
+        for i in range(2):
+            agent.add_host(f"h{i}", generation="v5p", chips=8)
+        agent.publish_all()
+        yb = stack.framework.batch_plugins[0]
+        for i, chips in enumerate(("2", "3", "2", "3")):
+            stack.cluster.create_pod(gang_pod("het", i, chips=chips))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        assert yb.gang_burst_dispatches == 1
+        assert yb.gang_burst_served == 4
+        # 2+3+2+3 = 10 chips over two 8-chip hosts: the inter-member
+        # deduction must never stack past capacity.
+        for i in range(2):
+            assert stack.accountant.chips_in_use(f"h{i}") <= 8
+
+    def test_priority_inversion_bounded_by_gang_size(self):
+        """A higher-priority singleton arriving after a gang member was
+        popped waits at most gang_size - 1 member cycles (the burst_size -
+        1 window promise extended to the gang gather), then pops next."""
+        stack, agent = make_stack()
+        for i in range(4):
+            agent.add_host(f"h{i}", generation="v5p", chips=8)
+        agent.publish_all()
+        for i in range(4):
+            stack.cluster.create_pod(gang_pod("pg", i))
+        first = stack.queue.pop(timeout=0)
+        assert first.pod.name.startswith("pg-")
+        # Arrives mid-turn, AFTER the gang member was already popped.
+        stack.cluster.create_pod(
+            PodSpec("hp", labels={"tpu/chips": "1", "tpu/priority": "9"})
+        )
+        batch = stack.scheduler._pop_batch(first)
+        # The gather takes exactly the co-queued members — never the
+        # higher-priority singleton, and never more than the gang.
+        assert [q.pod.name for q in batch] == ["pg-0", "pg-1", "pg-2", "pg-3"]
+        for q in batch:
+            stack.scheduler.schedule_one(q)
+        # The inversion window is over: the singleton pops immediately.
+        nxt = stack.queue.pop(timeout=0)
+        assert nxt is not None and nxt.pod.name == "hp"
+        stack.scheduler.schedule_one(nxt)
+        assert stack.cluster.get_pod("default/hp").node_name is not None
+
+    def test_partial_gang_does_not_starve_singletons(self):
+        """Two of four members queued with 16 singletons: the members
+        reserve and park at Permit (all-or-nothing preserved), while every
+        singleton still binds in the same drain — a partial gang must
+        never wedge the queue."""
+        stack, agent = make_stack(
+            batch_requests=8, gang_permit_timeout_s=300.0
+        )
+        for i in range(6):
+            agent.add_host(f"h{i}", generation="v5p", chips=8)
+        agent.publish_all()
+        for i in range(2):
+            stack.cluster.create_pod(gang_pod("part", i))
+        for i in range(16):
+            stack.cluster.create_pod(
+                PodSpec(f"s-{i}", labels={"tpu/chips": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        singles = [
+            p for p in stack.cluster.list_pods() if p.name.startswith("s-")
+        ]
+        assert all(p.node_name for p in singles), "singletons starved"
+        # The gang is still incomplete: members wait, nothing bound.
+        assert stack.gang.gang_status("part") == (4, 2, 0)
+
+    def test_late_member_promotes_parked_siblings(self):
+        """Members bounced into timed backoff (permit timeout cascade)
+        must be reactivated IMMEDIATELY when a late member arrives — one
+        event-driven retry instead of waiting out the backoff ladder.
+        immediate_retry_attempts=0 removes the event-move fast path, so
+        only the gang-arrival signal can beat the backoff timer."""
+        stack, agent = make_stack(
+            gang_permit_timeout_s=0.15, immediate_retry_attempts=0
+        )
+        for i in range(4):
+            agent.add_host(f"h{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(gang_pod("late", i, chips="4"))
+        # Members reserve, park, expire, cascade into backoff (>= 1 s).
+        stack.scheduler.run_until_idle(max_wall_s=3)
+        assert all(p.node_name is None for p in stack.cluster.list_pods())
+        assert stack.queue.pending_retry_count() >= 3
+        t0 = time.monotonic()
+        stack.cluster.create_pod(gang_pod("late", 3, chips="4"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        elapsed = time.monotonic() - t0
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods), "gang did not complete"
+        # Well under the >= 1 s backoff the siblings were parked with:
+        # the arrival signal, not the timer, retried them.
+        assert elapsed < 0.9, f"took {elapsed:.2f}s — backoff ladder, not signal"
+
+
+class TestServeForeverExpiry:
+    def test_permit_expiry_fires_under_production_loop(self):
+        """serve_forever's single expire_waiting sweep per iteration must
+        still time out abandoned Permit waits (the duplicate sweep it
+        replaced was pure overhead, not extra coverage): member A reserves
+        and parks, member B cannot ever fit, so only the deadline can
+        resolve A — the cascade must roll A's chips back under the
+        production loop."""
+        stack, agent = make_stack(gang_permit_timeout_s=0.2)
+        agent.add_host("h0", generation="v5p", chips=8)
+        agent.add_host("h1", generation="v5p", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(gang_pod("ex", 0, size=2, chips="2"))
+        # B needs more chips than any host has: unschedulable every cycle.
+        stack.cluster.create_pod(gang_pod("ex", 1, size=2, chips="16"))
+        stop = threading.Event()
+        t = threading.Thread(
+            target=stack.scheduler.serve_forever,
+            args=(stop,),
+            kwargs={"poll_s": 0.02},
+            daemon=True,
+        )
+        t.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                status = stack.gang.gang_status("ex")
+                if (
+                    status is not None
+                    and status[1] == 0
+                    and stack.accountant.chips_in_use("h0") == 0
+                    and stack.accountant.chips_in_use("h1") == 0
+                ):
+                    break
+                time.sleep(0.01)
+            status = stack.gang.gang_status("ex")
+            assert status is not None and status[1] == 0, (
+                f"waiting member never expired: {status}"
+            )
+            assert stack.accountant.chips_in_use("h0") == 0
+            assert stack.accountant.chips_in_use("h1") == 0
+            expired = [
+                r
+                for r in stack.scheduler.stats.results
+                if r.pod_key == "default/ex-0" and r.outcome == "waiting"
+            ]
+            assert expired, "member A never parked at Permit"
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert not t.is_alive()
+
+
+class TestQueueGangPrimitives:
+    def test_pop_matching_takes_only_matching_in_order(self):
+        from yoda_tpu.framework.queue import SchedulingQueue
+
+        q = SchedulingQueue()
+        q.add(PodSpec("a", labels={"tpu/gang": "g", "tpu/gang-size": "3"}))
+        q.add(PodSpec("x", labels={"tpu/chips": "1"}))
+        q.add(PodSpec("b", labels={"tpu/gang": "g", "tpu/gang-size": "3"}))
+        q.add(PodSpec("y", labels={"tpu/chips": "1"}))
+        from yoda_tpu.api.requests import gang_name_of
+
+        got = q.pop_matching(lambda p: gang_name_of(p.labels) == "g")
+        assert [i.pod.name for i in got] == ["a", "b"]
+        assert all(i.attempts == 1 for i in got)
+        # Non-members keep their order.
+        assert q.pop(timeout=0).pod.name == "x"
+        assert q.pop(timeout=0).pod.name == "y"
+        assert q.pop(timeout=0) is None
+
+    def test_restore_reverts_attempt_and_requeues(self):
+        from yoda_tpu.framework.queue import SchedulingQueue
+
+        q = SchedulingQueue()
+        q.add(PodSpec("a", labels={}))
+        qpi = q.pop(timeout=0)
+        assert qpi.attempts == 1
+        q.restore(qpi)
+        again = q.pop(timeout=0)
+        assert again is qpi and again.attempts == 1  # not double-counted
+
+    def test_add_promotes_gang_members_past_backoff(self):
+        from yoda_tpu.framework.queue import QueuedPodInfo, SchedulingQueue
+
+        now = [0.0]
+        q = SchedulingQueue(
+            clock=lambda: now[0], immediate_retry_attempts=0
+        )
+        member = QueuedPodInfo(
+            pod=PodSpec(
+                "m0", labels={"tpu/gang": "g", "tpu/gang-size": "2"}
+            ),
+            attempts=3,  # backoff 4s — far beyond this test's horizon
+        )
+        q.add_unschedulable(member, "gang incomplete")
+        other = QueuedPodInfo(pod=PodSpec("o", labels={}), attempts=3)
+        q.add_unschedulable(other, "no capacity")
+        assert q.pop(timeout=0) is None  # both in timed backoff
+        # The late member arrives: its siblings move NOW; strangers wait.
+        q.add(PodSpec("m1", labels={"tpu/gang": "g", "tpu/gang-size": "2"}))
+        popped = {q.pop(timeout=0).pod.name, q.pop(timeout=0).pod.name}
+        assert popped == {"m0", "m1"}
+        assert q.pop(timeout=0) is None  # "o" still backing off

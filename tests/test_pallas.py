@@ -177,6 +177,54 @@ class TestBurstParity:
             np.testing.assert_array_equal(burst[i].reasons, single.reasons)
             assert burst[i].best_index == single.best_index
 
+    @pytest.mark.parametrize(
+        "n_nodes,block_n,k",
+        [(256, 128, 4), (65536, 8192, 2)],
+        ids=["fleet256", "fleet65536"],
+    )
+    def test_burst_block_shapes_at_sweep_scales(self, n_nodes, block_n, k):
+        """Regression for BENCH_r05's ``pallas_burst_error``: the burst's
+        per-request admission input was lowered as (1, block_n) blocks of
+        a [K, N] array, violating Mosaic's last-two-dims (8, 128) tiling
+        rule — the single-request path never hit it because its node
+        stack is 8 sublanes deep. The fix stacks host_ok to
+        [K, 8, Np] (real row in sublane 0) so every block tiles. Run at
+        the kernel-sweep fleet sizes that exposed it (256 and 65536),
+        asserting both the Mosaic divisibility invariant on the lowered
+        input and burst-vs-single parity on real rows."""
+        from yoda_tpu.ops.pallas_kernel import _LANES, _SUBLANES
+
+        arrays = random_arrays(n_nodes, seed=13)
+        # The lowered admission stack's block is (1, _SUBLANES, block_n):
+        # the last two dims must tile (8, 128) for Mosaic.
+        assert _SUBLANES % 8 == 0 and block_n % _LANES == 0
+        dyn = np.stack(
+            [
+                np.asarray(arrays.fresh, dtype=np.int32),
+                np.asarray(arrays.reserved_chips, dtype=np.int32),
+                np.asarray(arrays.claimed_hbm_mib, dtype=np.int32),
+                np.asarray(arrays.host_ok, dtype=np.int32),
+            ]
+        )
+        rng = np.random.default_rng(14)
+        host_ok_k = (
+            rng.random((k, arrays.node_valid.shape[0])) > 0.2
+        ).astype(np.int32)
+        requests = [
+            KernelRequest(1 + i, 1024 * (i % 2), 0, 0, 0) for i in range(k)
+        ]
+        kern = PallasFleetKernel(Weights(), interpret=True, block_n=block_n)
+        kern.put_static(arrays)
+        burst = kern.evaluate_burst(dyn, host_ok_k, requests)
+        assert len(burst) == k
+        # Spot parity on the first and last slots (full parity at these
+        # scales is covered by test_matches_xla_burst on a smaller fleet).
+        for i in (0, k - 1):
+            one = np.stack([dyn[0], dyn[1], dyn[2], host_ok_k[i]])
+            single = kern.evaluate(one, requests[i])
+            np.testing.assert_array_equal(burst[i].scores, single.scores)
+            assert burst[i].best_index == single.best_index
+
 
 class TestPallasBackendE2E:
     def test_stack_schedules_with_pallas_kernel(self):

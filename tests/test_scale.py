@@ -113,7 +113,7 @@ class TestStackAtScale:
 
     def test_gang_at_scale_is_one_dispatch(self):
         """An 8-member gang against 1024 nodes: one kernel dispatch places
-        the whole gang (the batched-plan path must not degrade with fleet
+        the whole gang (the gang-fused pass must not degrade with fleet
         size), and the burst stays within the per-pod budget."""
         from yoda_tpu.agent import FakeTpuAgent
         from yoda_tpu.api.types import PodSpec
@@ -144,7 +144,10 @@ class TestStackAtScale:
         assert len(pods) == 8 and all(p.node_name for p in pods)
         assert len({p.node_name for p in pods}) == 8  # 8 chips each: 1/host
         assert batch.dispatch_count == d0 + 1
-        assert batch.plan_served == 7
+        # Co-queued members are gathered and served from the one fused
+        # dispatch; none fall back to the lazy per-gang plan.
+        assert batch.gang_burst_served == 8
+        assert batch.plan_served == 0
         assert dt_ms < 8 * 200, f"gang burst took {dt_ms:.0f} ms"
 
 

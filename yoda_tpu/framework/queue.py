@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from yoda_tpu.api.requests import gang_name_of
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.framework.interfaces import QueueSortPlugin
 
@@ -88,6 +89,11 @@ class SchedulingQueue:
         self._backoff: list[tuple[float, int, QueuedPodInfo]] = []  # (ready_at, seq, qpi)
         self._unschedulable: dict[str, QueuedPodInfo] = {}  # pod key -> qpi
         self._closed = False
+        # Optional listener fired (outside the queue lock) whenever work
+        # arrives or parked pods are reactivated — the scheduler's
+        # event-bound drain (Scheduler.run_until_idle) waits on it instead
+        # of polling.
+        self.on_activity: Callable[[], None] | None = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -114,7 +120,41 @@ class SchedulingQueue:
     def add(self, pod: PodSpec) -> None:
         with self._cond:
             self._push_active(QueuedPodInfo(pod=pod, added_unix=self._clock()))
+            # Gang-arrival signal: a new member of gang G reactivates every
+            # parked/backoff member of G IMMEDIATELY (bypassing their
+            # backoff timers) — the late member triggers exactly one retry
+            # of its siblings instead of leaving them to walk the
+            # backoff-sleep ladder while the gang could now complete.
+            gang = gang_name_of(pod.labels)
+            if gang:
+                self._promote_gang_locked(gang)
             self._cond.notify()
+        self._fire_activity()
+
+    def _fire_activity(self) -> None:
+        cb = self.on_activity
+        if cb is not None:
+            cb()
+
+    def _promote_gang_locked(self, gang: str) -> None:
+        """Move every parked member of ``gang`` to the active queue now."""
+        still: list[tuple[float, int, QueuedPodInfo]] = []
+        moved = False
+        for ready_at, seq, qpi in self._backoff:
+            if gang_name_of(qpi.pod.labels) == gang:
+                self._push_active(qpi)
+                moved = True
+            else:
+                still.append((ready_at, seq, qpi))
+        if moved:
+            heapq.heapify(still)
+            self._backoff = still
+        for key in [
+            k
+            for k, q in self._unschedulable.items()
+            if gang_name_of(q.pod.labels) == gang
+        ]:
+            self._push_active(self._unschedulable.pop(key))
 
     def _push_active(self, qpi: QueuedPodInfo) -> None:
         heapq.heappush(self._active, _HeapItem(qpi, next(self._seq), self._less))
@@ -149,6 +189,47 @@ class SchedulingQueue:
                         return None
                     waits.append(remaining)
                 self._cond.wait(timeout=min(waits) if waits else None)
+
+    def pop_matching(
+        self,
+        pred: Callable[[PodSpec], bool],
+        limit: int | None = None,
+    ) -> list[QueuedPodInfo]:
+        """Pop every ACTIVE entry whose pod satisfies ``pred``, in queue
+        (priority, FIFO) order — the gang-aware gather next to the
+        scheduler's ``_pop_burst``: when a popped pod is a gang member, its
+        co-queued siblings are pulled out so the whole gang runs
+        back-to-back in one fused pass instead of one cycle per loop turn.
+        Non-blocking; expired backoff entries are flushed first so a
+        sibling whose retry timer just lapsed is gathered too."""
+        with self._cond:
+            self._flush_backoff_locked()
+            taken: list[_HeapItem] = []
+            keep: list[_HeapItem] = []
+            for item in self._active:
+                if (limit is None or len(taken) < limit) and pred(
+                    item.qpi.pod
+                ):
+                    taken.append(item)
+                else:
+                    keep.append(item)
+            if taken:
+                heapq.heapify(keep)
+                self._active = keep
+        taken.sort()  # heap-internal order -> queue order
+        for item in taken:
+            item.qpi.attempts += 1
+        return [item.qpi for item in taken]
+
+    def restore(self, qpi: QueuedPodInfo) -> None:
+        """Return a popped-but-unscheduled entry to the active queue (the
+        burst pop un-pops gang members it encounters so their own pop runs
+        the gang gather). The pop's attempt increment is reverted — no
+        scheduling cycle ran."""
+        qpi.attempts = max(qpi.attempts - 1, 0)
+        with self._cond:
+            self._push_active(qpi)
+            self._cond.notify()
 
     def add_unschedulable(self, qpi: QueuedPodInfo, message: str = "") -> None:
         """Park a pod that failed a cycle. It re-enters the active queue
@@ -206,6 +287,7 @@ class SchedulingQueue:
                     )
             self._unschedulable.clear()
             self._cond.notify_all()
+        self._fire_activity()
 
     def close(self) -> None:
         with self._cond:

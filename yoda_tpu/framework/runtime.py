@@ -170,6 +170,18 @@ class Framework:
             if prepare is not None:
                 prepare(pods, snapshot)
 
+    def prepare_gang(self, pods: Sequence[PodSpec], snapshot: Snapshot) -> None:
+        """Hand a gathered gang (every co-queued member, one gang) to
+        gang-burst-capable batch plugins: ONE kernel dispatch evaluates all
+        members, and each member's cycle is served from its own row with
+        the chips claimed by earlier members deducted
+        (YodaBatch.prepare_gang_burst). Advisory, like prepare_burst —
+        member cycles fall back to per-cycle dispatches / the gang plan."""
+        for p in self.batch_plugins:
+            prepare = getattr(p, "prepare_gang_burst", None)
+            if prepare is not None:
+                prepare(pods, snapshot)
+
     def run_batch_filter_score(
         self, state: CycleState, pod: PodSpec, snapshot: Snapshot
     ) -> tuple[dict[str, Status], dict[str, int]] | None:

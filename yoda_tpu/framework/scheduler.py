@@ -17,6 +17,7 @@ from typing import Callable
 
 log = logging.getLogger("yoda_tpu.scheduler")
 
+from yoda_tpu.api.requests import gang_name_of
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import (
@@ -110,8 +111,18 @@ class Scheduler:
         # full scheduling cycle, served from the burst cache. Bounded
         # priority inversion: a higher-priority pod arriving mid-burst
         # waits at most burst_size - 1 cycles (upstream pops one at a
-        # time; the amortization is worth the K-deep window).
+        # time; the amortization is worth the K-deep window). The gang
+        # gather (_gather_gang) extends the same promise: popping one gang
+        # member pulls its co-queued siblings, so a higher-priority
+        # singleton waits at most gang_size - 1 member cycles — bounded by
+        # the gang's own size, never by queue depth.
         self.burst_size = max(burst_size, 1)
+        # Event-bound drain (run_until_idle): permit resolutions and queue
+        # activity bump _activity_seq and wake the waiter, so drain latency
+        # tracks the event, not a poll interval.
+        self._activity = threading.Condition()
+        self._activity_seq = 0
+        queue.on_activity = self._signal_activity
         self._search_rotor = 0
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
@@ -450,9 +461,22 @@ class Scheduler:
         ):
             self.on_nominated(pod, None)
 
+    def _signal_activity(self) -> None:
+        with self._activity:
+            self._activity_seq += 1
+            self._activity.notify_all()
+
     def _on_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
         """Fires when a waiting pod is allowed (bind it) or rejected
-        (roll back its reservation and requeue)."""
+        (roll back its reservation and requeue). Signals the drain
+        condition on exit — AFTER the bind or requeue landed, so a woken
+        ``run_until_idle`` never observes the half-resolved state."""
+        try:
+            self._do_permit_resolved(wp, status)
+        finally:
+            self._signal_activity()
+
+    def _do_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
         pod = wp.pod
         if self.metrics is not None and wp.parked_at is not None:
             self.metrics.gang_wait.observe(max(self.clock() - wp.parked_at, 0.0))
@@ -480,6 +504,46 @@ class Scheduler:
 
     # --- the loop ---
 
+    def _pop_batch(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:
+        """Expand one popped entry into the batch this loop turn schedules:
+        a gang member gathers its co-queued siblings (gang-fused pass), any
+        other pod gathers a multi-pod burst."""
+        if gang_name_of(first.pod.labels):
+            return self._gather_gang(first)
+        return self._pop_burst(first)
+
+    def _gather_gang(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:
+        """Gang-fused scheduling pass: pull every co-queued member of
+        ``first``'s gang out of the queue and schedule the whole gang
+        back-to-back in this loop turn. With all members in one pass, the
+        Permit barrier resolves inside the LAST member's cycle — no
+        park/release round trips through later loop turns — and
+        ``Framework.prepare_gang`` pre-evaluates every member against the
+        fleet in ONE kernel dispatch (YodaBatch.prepare_gang_burst), each
+        sibling cycle served from its own row with the chips claimed by
+        members 0..k-1 already deducted."""
+        name = gang_name_of(first.pod.labels)
+        batch = [first] + self.queue.pop_matching(
+            lambda p: gang_name_of(p.labels) == name
+        )
+        if len(batch) > 1:
+            log.debug(
+                "gang %s: gathered %d co-queued member(s) for a fused pass",
+                name, len(batch),
+            )
+            try:
+                self.framework.prepare_gang(
+                    [q.pod for q in batch], self.snapshot_fn()
+                )
+            except Exception:
+                # Advisory only: members still schedule back-to-back below,
+                # falling to per-cycle dispatches / the gang plan.
+                log.exception(
+                    "gang pre-evaluation failed; scheduling members "
+                    "individually"
+                )
+        return batch
+
     def _pop_burst(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:
         """Pop up to burst_size - 1 further entries and pre-evaluate the
         whole batch in one kernel dispatch. Always returns at least
@@ -490,6 +554,13 @@ class Scheduler:
         while len(batch) < self.burst_size:
             nxt = self.queue.pop(timeout=0.0)
             if nxt is None:
+                break
+            if gang_name_of(nxt.pod.labels):
+                # A gang member must enter through the gang gather, not
+                # ride a singleton burst one cycle at a time: un-pop it and
+                # stop here — its own pop next loop turn runs the fused
+                # gang pass.
+                self.queue.restore(nxt)
                 break
             batch.append(nxt)
         if len(batch) > 1:
@@ -503,21 +574,53 @@ class Scheduler:
                 log.exception("burst pre-evaluation failed; scheduling individually")
         return batch
 
-    def run_until_idle(self, *, max_wall_s: float = 30.0, settle_s: float = 0.002) -> None:
+    # Ceiling on one event-bound drain wait: signals wake the waiter
+    # immediately, so this bounds only the unsignaled cases (fake clocks
+    # skewing permit deadlines, resolutions on paths that cannot signal) —
+    # 25x coarser than the old fixed 2 ms poll, and never the latency of
+    # the common path.
+    DRAIN_WAIT_CAP_S = 0.05
+
+    def run_until_idle(self, *, max_wall_s: float = 30.0) -> None:
         """Drain the queue, resolving Permit waits and expirations, until no
         active work remains or ``max_wall_s`` passes. Test/demo driver; the
-        production loop is ``serve_forever``."""
+        production loop is ``serve_forever``.
+
+        Event-bound: while Permit waiters exist the loop sleeps on the
+        activity condition — woken by permit resolutions (allow/reject from
+        any thread) and queue activity (adds, event reactivations) — with a
+        timeout no later than the earliest permit deadline, so expiry still
+        fires on time. The old fixed 2 ms settle poll made every gang's
+        drain latency a multiple of the poll interval; now it tracks the
+        resolving event itself."""
         deadline = time.monotonic() + max_wall_s
         binds_at_drain = -1  # binds count when the queue last went inactive
         while time.monotonic() < deadline:
+            with self._activity:
+                seq = self._activity_seq  # pre-check capture: a resolution
+                # landing between the checks below and the wait bumps the
+                # seq and turns the wait into a no-op (no lost wakeup).
             qpi = self.queue.pop(timeout=0.0)
             if qpi is not None:
-                for q in self._pop_burst(qpi):
+                for q in self._pop_batch(qpi):
                     self.schedule_one(q)
                 continue
             self.framework.expire_waiting(now=self.clock())
-            if self.framework.waiting_pods():
-                time.sleep(settle_s)
+            waiters = self.framework.waiting_pods()
+            if waiters:
+                now = self.clock()
+                next_deadline = min(w.deadline for w in waiters)
+                timeout = max(
+                    min(
+                        next_deadline - now,
+                        deadline - time.monotonic(),
+                        self.DRAIN_WAIT_CAP_S,
+                    ),
+                    0.0,
+                )
+                with self._activity:
+                    if self._activity_seq == seq:
+                        self._activity.wait(timeout)
                 continue
             if self.queue.pending_retry_count() == 0:
                 return
@@ -534,13 +637,17 @@ class Scheduler:
             self.queue.move_all_to_active(force=True)
 
     def serve_forever(self, stop: threading.Event, *, poll_s: float = 0.5) -> None:
+        """The production loop: block on the queue, schedule the popped
+        batch, then sweep permit expirations ONCE per iteration (the sweep
+        ran twice per iteration before — once after the pop and once per
+        scheduled entry — pure overhead, since expiry resolution only needs
+        to be poll_s-grained and each sweep walks the whole waitlist)."""
         while not stop.is_set():
             qpi = self.queue.pop(timeout=poll_s)
-            self.framework.expire_waiting(now=self.clock())
             if qpi is not None:
-                for q in self._pop_burst(qpi):
+                for q in self._pop_batch(qpi):
                     self.schedule_one(q)
-                    self.framework.expire_waiting(now=self.clock())
+            self.framework.expire_waiting(now=self.clock())
 
 
 def _normalize(scores: dict[str, int]) -> dict[str, int]:

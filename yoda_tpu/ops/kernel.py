@@ -404,6 +404,17 @@ _kernel_packed_burst = functools.partial(jax.jit, static_argnames=("weights",))(
 )
 
 
+def burst_bucket(k: int, minimum: int = 1) -> int:
+    """Compile bucket for a K-request burst dispatch: the configured burst
+    width while K fits (so singleton bursts and gang-fused dispatches share
+    ONE compiled executable per fleet bucket), else the next power of two
+    (a gang larger than batch_requests pays one extra compile per new
+    bucket, amortized across every later gang of that scale)."""
+    if k <= minimum:
+        return max(minimum, 1)
+    return 1 << max(k - 1, 1).bit_length()
+
+
 def pack_request(request: "KernelRequest") -> np.ndarray:
     return np.array(
         [

@@ -60,6 +60,11 @@ try:  # pallas is an optional heavyweight import; fail soft at import time
     from jax.experimental.pallas import tpu as pltpu
 
     HAVE_PALLAS = True
+    # jax renamed TPUCompilerParams -> CompilerParams across releases;
+    # support both so the kernel builds on either side of the rename.
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
 except Exception:  # pragma: no cover - environment without pallas
     HAVE_PALLAS = False
 
@@ -239,9 +244,11 @@ def _kernel_body_burst(reqs, chips, nodes, host_ok, out, maxima, *, weights: Wei
     """K-request body: grid (request, phase, node-block). The chip grids
     and shared node rows are revisited per request (they stay VMEM-resident
     across the sequential TPU grid); ``host_ok`` carries each request's own
-    admission row, and the SMEM maxima re-initialize at each request's
-    phase-0 first block, so every request gets its own collection pass —
-    bit-identical to K independent single-request dispatches."""
+    admission row (in sublane 0 of its (1, 8, BN) block — the sublane axis
+    exists only to satisfy Mosaic's (8, 128) tiling, see
+    ``_pallas_eval_burst``), and the SMEM maxima re-initialize at each
+    request's phase-0 first block, so every request gets its own collection
+    pass — bit-identical to K independent single-request dispatches."""
     k = pl.program_id(0)
     phase = pl.program_id(1)
     j = pl.program_id(2)
@@ -250,7 +257,7 @@ def _kernel_body_burst(reqs, chips, nodes, host_ok, out, maxima, *, weights: Wei
         out[0, r] = v
 
     _eval_block(
-        reqs[k], chips, nodes, host_ok[0] > 0, store, maxima, phase, j,
+        reqs[k], chips, nodes, host_ok[0, 0] > 0, store, maxima, phase, j,
         weights=weights,
     )
 
@@ -280,7 +287,7 @@ def _pallas_eval(chips, nodes, reqv, *, weights: Weights, block_n: int, interpre
         out_shape=jax.ShapeDtypeStruct((8, n_pad), jnp.int32),
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
     )(reqv, chips, nodes)
@@ -298,10 +305,22 @@ def _pallas_eval_burst(
     [K, 5] int32 -> out [K, 8, Np] int32. The request axis is an OUTER
     grid dimension, so the two-phase collection runs per request over the
     same VMEM-resident fleet blocks — the kernel_packed_burst analog with
-    an explicit grid instead of vmap."""
+    an explicit grid instead of vmap.
+
+    The per-request admission rows are lowered as [K, 8, Np] with the real
+    row in sublane 0: Mosaic requires every block's LAST TWO dims to tile
+    (8, 128) (or equal the array's), and the natural (1, block_n) slice of
+    a [K, Np] array violates the sublane half — the exact lowering failure
+    BENCH_r05 recorded as ``pallas_burst_error``. The single-request path
+    never hit it because its node stack is already 8 sublanes deep; this
+    pads the burst's admission input the same way (7 dead sublanes per
+    request, ~0.1% of the chip-grid bytes)."""
     n_rows, cp, n_pad = chips.shape
     k_pad = reqs_k.shape[0]
     nb = n_pad // block_n
+    host_ok_3d = jnp.zeros(
+        (k_pad, _SUBLANES, n_pad), host_ok_k.dtype
+    ).at[:, 0, :].set(host_ok_k)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(k_pad, 2, nb),
@@ -310,7 +329,9 @@ def _pallas_eval_burst(
                 (n_rows, cp, block_n), lambda k, p, j, reqs: (0, 0, j)
             ),
             pl.BlockSpec((8, block_n), lambda k, p, j, reqs: (0, j)),
-            pl.BlockSpec((1, block_n), lambda k, p, j, reqs: (k, j)),
+            pl.BlockSpec(
+                (1, _SUBLANES, block_n), lambda k, p, j, reqs: (k, 0, j)
+            ),
         ],
         out_specs=pl.BlockSpec(
             (1, 8, block_n), lambda k, p, j, reqs: (k, 0, j)
@@ -322,10 +343,10 @@ def _pallas_eval_burst(
         out_shape=jax.ShapeDtypeStruct((k_pad, 8, n_pad), jnp.int32),
         grid_spec=grid_spec,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")
         ),
-    )(reqs_k, chips, nodes, host_ok_k)
+    )(reqs_k, chips, nodes, host_ok_3d)
 
 
 def _stack_inputs(a: dict, *, block_n: int) -> tuple[np.ndarray, np.ndarray]:

@@ -34,6 +34,7 @@ import numpy as np
 log = logging.getLogger("yoda_tpu.batch")
 
 from yoda_tpu.api.affinity import pod_has_inter_pod_terms
+from yoda_tpu.api.requests import gang_name_of
 from yoda_tpu.api.types import (
     PodSpec,
     pod_admits_on,
@@ -49,6 +50,7 @@ from yoda_tpu.ops.kernel import (
     KernelRequest,
     KernelResult,
     REASON_MESSAGES,
+    burst_bucket,
 )
 from yoda_tpu.config import Weights
 from yoda_tpu.plugins.yoda.filter_plugin import (
@@ -317,6 +319,16 @@ class YodaBatch(BatchFilterScorePlugin):
         self.burst_dispatches = 0   # multi-pod kernel dispatches
         self.burst_served = 0       # cycles answered from a burst
         self.burst_invalidated = 0  # burst rows dropped by failed validation
+        # Gang-fused dispatch (ISSUE 1): prepare_gang_burst evaluates a
+        # gathered gang's members — heterogeneous requests included — in
+        # ONE kernel call; each member's cycle is served from its own row
+        # with siblings' claims deducted (_serve_gang_burst). The identical
+        # -request _GangPlan remains the fallback for members that arrive
+        # outside a gather.
+        self._gang_bursts: dict[str, _BurstSet] = {}
+        self.gang_burst_dispatches = 0   # whole-gang kernel dispatches
+        self.gang_burst_served = 0       # member cycles answered from one
+        self.gang_burst_invalidated = 0  # rows dropped by failed validation
         # (snapshot.version, fleet has inter-pod terms) — bursting is
         # refused on fleets where evaluators would be needed per pod.
         self._fleet_terms: tuple[int, bool] = (0, False)
@@ -515,7 +527,11 @@ class YodaBatch(BatchFilterScorePlugin):
         reqk = KernelRequest.from_request(req)
         gang_name = req.gang.name if req.gang is not None else None
         if gang_name is not None:
-            served = self._serve_gang_plan(state, pod, gang_name, snapshot, reqk)
+            served = self._serve_gang_burst(state, pod, gang_name, snapshot, reqk)
+            if served is None:
+                served = self._serve_gang_plan(
+                    state, pod, gang_name, snapshot, reqk
+                )
             if served is not None:
                 return served
         elif self._burst is not None:
@@ -696,7 +712,7 @@ class YodaBatch(BatchFilterScorePlugin):
             or len(snapshot) == 0
             or not snapshot.version
             or self.reserved_fn is None
-            or (self.pending_fn is not None and self.pending_fn())
+            or self._pending_blocking(snapshot)
             or self._fleet_has_terms(snapshot)
         ):
             return
@@ -766,6 +782,64 @@ class YodaBatch(BatchFilterScorePlugin):
             entries=entries,
         )
 
+    def _pending_blocking(self, snapshot: Snapshot) -> bool:
+        """True when some Permit-parked placement's pod is NOT yet visible
+        in the snapshot — its cpu/memory claims are then invisible to a
+        burst dispatch and serving from one could overcommit allocatable.
+        Entries already visible (released members whose bind watch event
+        landed — the gang plugin keeps them in pending_placements until
+        deletion) carry their claims in ``NodeInfo.pods`` and must NOT
+        refuse the burst: a completed gang would otherwise disable burst
+        amortization for every later singleton on the fleet (the 25-60x
+        contended-throughput cliff, BENCH_r05)."""
+        if self.pending_fn is None:
+            return False
+        for host, spec in self.pending_fn():
+            if host not in snapshot:
+                return True
+            if all(p.uid != spec.uid for p in snapshot.get(host).pods):
+                return True
+        return False
+
+    def _pick_checks(
+        self, b: _BurstSet, pod: PodSpec, best: str, snapshot: Snapshot
+    ) -> bool:
+        """Serve-time validation of a burst/gang-burst pick on the chosen
+        node: the accountant must hold exactly the dispatch baseline plus
+        the set's own consumption (a foreign reservation — another profile,
+        a permit-released gang — means the row's capacity math is stale),
+        the node must still be in the snapshot with fresh metrics, and the
+        live Node object must still admit the pod with allocatable room for
+        it on top of the set's own pending siblings (those not yet visible
+        in ``NodeInfo.pods``)."""
+        idx = b.index[best]
+        if self.reserved_fn(best) != int(b.base_reserved[idx]) + b.consumed.get(
+            best, 0
+        ):
+            return False
+        if best not in snapshot:
+            # Today node add/delete bumps metrics_version, so the
+            # fleet_version gate drops the set first — but a vanished node
+            # must never be served from a cached row (ADVICE r4).
+            return False
+        ni = snapshot.get(best)
+        if self.max_metrics_age_s > 0 and (
+            ni.tpu is None
+            or not ni.tpu.fresh(max_age_s=self.max_metrics_age_s)
+        ):
+            return False
+        on_node = {p.uid for p in ni.pods}
+        p_cpu = p_mem = p_cnt = 0
+        for uid, c, m in b.res.get(best, ()):
+            if uid not in on_node:
+                p_cpu += c
+                p_mem += m
+                p_cnt += 1
+        return (
+            pod_admits_on(ni.node, pod)[0]
+            and node_fits_resources(ni, pod, {best: (p_cpu, p_mem, p_cnt)})[0]
+        )
+
     def _drop_burst(self) -> None:
         if self._burst is not None:
             self.burst_invalidated += len(self._burst.entries)
@@ -823,61 +897,21 @@ class YodaBatch(BatchFilterScorePlugin):
             # dyn from the live accountant; the row is dropped either way.
             return None
         best = max(scores, key=lambda nm: (scores[nm], nm))
-        # Spot-check the accountant on the chosen node: it must hold
-        # exactly the dispatch baseline plus the burst's own consumption —
-        # a foreign reservation (another profile, a permit-released gang)
-        # means the row's capacity math is stale.
-        idx = b.index[best]
-        if self.reserved_fn(best) != int(b.base_reserved[idx]) + b.consumed.get(
-            best, 0
-        ):
+        # Serve-time validation on the chosen node (_pick_checks): the
+        # fleet_version key deliberately ignores Node/pod churn (the
+        # burst's own binds) AND heartbeat republishes, so accountant
+        # drift, cordon/taint drift, metric staleness (an agent that died
+        # after prepare — heartbeat elision removed the incidental
+        # invalidation that used to bound this window, review r4), and
+        # burst siblings stacking cpu/memory/pod count are re-validated
+        # here (the gang plan's members_cap, per-serve). Siblings already
+        # BOUND and visible in the live snapshot must not be charged again
+        # from the burst's pending ledger (review r4: double-counting
+        # spuriously invalidated every co-located resource-requesting
+        # burst).
+        if not self._pick_checks(b, pod, best, snapshot):
             self._drop_burst()
             self.burst_invalidated += 1  # this row, beyond the set drop
-            return None
-        # Live Node-object + freshness + allocatable spot-checks on the
-        # chosen node: the fleet_version key deliberately ignores Node/pod
-        # churn (the burst's own binds) AND heartbeat republishes, so
-        # cordon/taint drift, metric staleness (an agent that died after
-        # prepare — heartbeat elision removed the incidental invalidation
-        # that used to bound this window, review r4), and burst siblings
-        # stacking cpu/memory/pod count are re-validated here (the gang
-        # plan's members_cap, per-serve). Siblings already BOUND and
-        # visible in the live snapshot must not be charged again from the
-        # burst's pending ledger (review r4: double-counting spuriously
-        # invalidated every co-located resource-requesting burst).
-        if best not in snapshot:
-            # The chosen node left the snapshot since the dispatch. Today
-            # node add/delete bumps metrics_version, so the fleet_version
-            # gate above drops the burst first — but this guard must be a
-            # real safety net, not silently-permissive dead code (ADVICE
-            # r4): steering a pod at a vanished node with no live
-            # validation is never right. Drop and re-dispatch fresh.
-            self._drop_burst()
-            self.burst_invalidated += 1
-            return None
-        ni = snapshot.get(best)
-        if self.max_metrics_age_s > 0 and (
-            ni.tpu is None
-            or not ni.tpu.fresh(max_age_s=self.max_metrics_age_s)
-        ):
-            self._drop_burst()
-            self.burst_invalidated += 1
-            return None
-        on_node = {p.uid for p in ni.pods}
-        p_cpu = p_mem = p_cnt = 0
-        for uid, c, m in b.res.get(best, ()):
-            if uid not in on_node:
-                p_cpu += c
-                p_mem += m
-                p_cnt += 1
-        if (
-            not pod_admits_on(ni.node, pod)[0]
-            or not node_fits_resources(
-                ni, pod, {best: (p_cpu, p_mem, p_cnt)}
-            )[0]
-        ):
-            self._drop_burst()
-            self.burst_invalidated += 1
             return None
         b.consumed[best] = b.consumed.get(best, 0) + chips
         b.res.setdefault(best, []).append(
@@ -893,6 +927,201 @@ class YodaBatch(BatchFilterScorePlugin):
         held = Status.unschedulable(
             "feasible, but a burst sibling was steered here first "
             "(single-choice serving)"
+        )
+        statuses = {
+            nm: (st if not st.success else (Status.ok() if nm == best else held))
+            for nm, st in statuses.items()
+        }
+        return statuses, {best: scores[best]}
+
+    # --- gang-fused dispatch (ISSUE 1) ---
+
+    def prepare_gang_burst(
+        self, pods: Sequence[PodSpec], snapshot: Snapshot
+    ) -> None:
+        """Evaluate a gathered gang — every co-queued member, handed over
+        by the scheduler's gang gather — against ONE snapshot in ONE
+        kernel dispatch (the burst kernel, per-member admission rows and
+        request vectors), so the whole gang places in a single pass.
+        Member cycles are served from their own rows by
+        :meth:`_serve_gang_burst` with inter-member capacity deduction:
+        member k's candidate set sees the chips members 0..k-1 claimed.
+        Unlike ``_GangPlan`` (identical requests, built lazily at the
+        first member's dispatch) this covers heterogeneous members and
+        dispatches before any cycle runs.
+
+        Refused silently — members fall back to the plan / per-cycle
+        dispatches — under the same preconditions as ``prepare_burst``
+        (no accounting, uncacheable snapshot, snapshot-invisible pending
+        placements, inter-pod terms in the fleet or on a member,
+        hostPort/PVC members)."""
+        gang = None
+        for pod in pods:
+            name = gang_name_of(pod.labels)
+            if name is None or (gang is not None and name != gang):
+                return  # not a single gang: caller bug or alias mismatch
+            gang = name
+        if gang is None:
+            return
+        self._drop_gang_burst(gang)
+        if (
+            len(pods) < 2
+            or len(snapshot) == 0
+            or not snapshot.version
+            or self.reserved_fn is None
+            or self._pending_blocking(snapshot)
+            or self._fleet_has_terms(snapshot)
+        ):
+            return
+        from yoda_tpu.api.requests import LabelParseError, pod_request
+
+        candidates: list[tuple[PodSpec, KernelRequest]] = []
+        for pod in pods:
+            try:
+                req = pod_request(pod)
+            except LabelParseError:
+                return  # the member's own cycle reports the parse error
+            if (
+                req.gang is None
+                or pod_has_inter_pod_terms(pod)
+                or pod.topology_spread
+                or pod.host_ports
+                or pod.pvc_names
+            ):
+                # One ineligible member refuses the whole gang: a fused
+                # pass that skips members would reintroduce the very
+                # inter-member window it exists to close.
+                return
+            candidates.append((pod, KernelRequest.from_request(req)))
+        static = self._refresh_static(snapshot)
+        if not hasattr(self._kern, "evaluate_burst"):
+            return  # future kernels without a burst path: plan fallback
+        reserved_src, claimed_src = self._dyn_sources()
+        dyn = static.dyn_packed(
+            reserved_src,
+            claimed_src,
+            max_metrics_age_s=self.max_metrics_age_s,
+            last_updated=self._live_timestamps(),
+        )
+        k = burst_bucket(len(candidates), self.batch_requests)
+        n_pad = static.node_valid.shape[0]
+        host_ok_k = np.zeros((k, n_pad), dtype=np.int32)
+        requests: list[KernelRequest] = []
+        for i, (pod, reqk) in enumerate(candidates):
+            host_ok_k[i] = _host_admission(static, snapshot, pod)
+            requests.append(reqk)
+        pad = KernelRequest(1, 0, 0, 0, 0)
+        while len(requests) < k:
+            requests.append(pad)
+        results = self._kern.evaluate_burst(dyn, host_ok_k, requests)
+        self.dispatch_count += 1
+        self.gang_burst_dispatches += 1
+        self._gang_bursts[gang] = _BurstSet(
+            fleet_version=self._fleet_version(snapshot),
+            names=list(static.names),
+            index={nm: i for i, nm in enumerate(static.names)},
+            base_reserved=np.asarray(dyn[1]).copy(),
+            entries={
+                pod.uid: _BurstEntry(
+                    request=reqk,
+                    constraints=_pod_constraints(pod),
+                    result=results[i],
+                    pref_bonus=self._preference_bonus(static, snapshot, pod),
+                )
+                for i, (pod, reqk) in enumerate(candidates)
+            },
+        )
+        if len(self._gang_bursts) > 8:
+            # Bounded, like the gang plans: evict the oldest live set.
+            self._drop_gang_burst(next(iter(self._gang_bursts)))
+
+    def _drop_gang_burst(self, gang: str) -> None:
+        b = self._gang_bursts.pop(gang, None)
+        if b is not None:
+            self.gang_burst_invalidated += len(b.entries)
+            log.debug("gang %s: fused dispatch rows invalidated", gang)
+
+    def _serve_gang_burst(
+        self,
+        state: CycleState,
+        pod: PodSpec,
+        gang: str,
+        snapshot: Snapshot,
+        reqk: KernelRequest,
+    ) -> tuple[dict[str, Status], dict[str, int]] | None:
+        """Serve a gang member's cycle from the gang-fused dispatch — its
+        own row, minus what earlier members claimed (``consumed``), pinned
+        to the gang's planned hosts when the PreFilter wrote them (the
+        allowed set already excludes hosts assigned to parked siblings, so
+        topology gangs stay one-member-per-host), and spot-checked against
+        the live accountant/Node state exactly like a burst serve. None =
+        dispatch fresh (a stale row must never park a pod)."""
+        b = self._gang_bursts.get(gang)
+        if b is None:
+            return None
+        if self._fleet_version(snapshot) != b.fleet_version:
+            self._drop_gang_burst(gang)  # fleet metrics changed
+            return None
+        entry = b.entries.get(pod.uid)
+        if entry is None:
+            return None
+        if reqk != entry.request or _pod_constraints(pod) != entry.constraints:
+            # The pod changed between gather and its cycle (watch update).
+            del b.entries[pod.uid]
+            self.gang_burst_invalidated += 1
+            if not b.entries:
+                self._gang_bursts.pop(gang, None)
+            return None
+        allowed = (
+            state.read(ALLOWED_HOSTS_KEY).hosts
+            if state.contains(ALLOWED_HOSTS_KEY)
+            else None
+        )
+        chips = max(reqk.number, 1)
+        result = entry.result
+        statuses: dict[str, Status] = {}
+        scores: dict[str, int] = {}
+        sibling = Status.unschedulable(
+            "chips claimed by a gang sibling (gang-fused pass)"
+        )
+        outside = Status.unschedulable("host not in gang's planned ICI block")
+        for i, name in enumerate(b.names):
+            if result.feasible[i]:
+                if allowed is not None and name not in allowed:
+                    statuses[name] = outside
+                    continue
+                used = b.consumed.get(name, 0)
+                if used and result.claimable[i] - used < chips:
+                    statuses[name] = sibling
+                    continue
+                statuses[name] = Status.ok()
+                scores[name] = int(result.scores[i]) + int(entry.pref_bonus[i])
+            else:
+                reason = REASON_MESSAGES.get(int(result.reasons[i]), "infeasible")
+                statuses[name] = Status.unschedulable(reason)
+        del b.entries[pod.uid]
+        if not b.entries:
+            self._gang_bursts.pop(gang, None)
+        if not scores:
+            # Stale rows (a release between gather and this cycle frees
+            # chips without a metrics bump) must fall back to a fresh
+            # dispatch, never park the member.
+            return None
+        best = max(scores, key=lambda nm: (scores[nm], nm))
+        if not self._pick_checks(b, pod, best, snapshot):
+            self._drop_gang_burst(gang)
+            self.gang_burst_invalidated += 1  # this row, beyond the set
+            return None
+        b.consumed[best] = b.consumed.get(best, 0) + chips
+        b.res.setdefault(best, []).append(
+            (pod.uid, pod.cpu_milli_request, pod.memory_request)
+        )
+        self.gang_burst_served += 1
+        # Single-choice serving, as for bursts and the gang plan: only the
+        # spot-checked node is offered, so a downstream plugin cannot
+        # redirect the bind onto an unvalidated row.
+        held = Status.unschedulable(
+            "chips held for gang siblings (gang-fused pass)"
         )
         statuses = {
             nm: (st if not st.success else (Status.ok() if nm == best else held))

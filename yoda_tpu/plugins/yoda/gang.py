@@ -20,7 +20,20 @@ Mechanism (SURVEY.md §7 step 4):
 - **Rollback.** If any member is rejected or times out, every other waiting
   member of the gang is rejected too (cascade), all reservations roll back
   (framework unreserve path), the topology plan is dropped, and members
-  retry via queue backoff.
+  retry via queue backoff — and a late member's arrival reactivates them
+  IMMEDIATELY through the queue's gang-arrival signal
+  (SchedulingQueue.add promotes parked siblings past their backoff
+  timers), so completion latency tracks the arrival, not the ladder.
+
+Hot path (the gang-fused pass, ISSUE 1): when a member pops with its
+siblings co-queued, the scheduler gathers them (queue.pop_matching), the
+batch plugin evaluates the whole gang in ONE kernel dispatch
+(YodaBatch.prepare_gang_burst, member k's candidates minus members
+0..k-1's claims), and the member cycles run back-to-back in one loop turn
+— the barrier above then resolves inside the LAST member's own Permit
+call, binding the gang without ever leaving the pass. The waitlist
+machinery below is the general case (scattered arrivals, restarts,
+rollbacks); the fused pass is the fast traversal of it.
 
 Deadlock/livelock analysis (SURVEY.md §7 hard part 1): two gangs can still
 interleave reservations in the window between admission checks. Progress is

@@ -255,6 +255,25 @@ def build_stack(
                 lambda: sum(p.plan_invalidated for p in acc),
             )
             metrics.registry.counter(
+                "yoda_gang_fused_dispatches_total",
+                "Whole-gang kernel dispatches (the gang-fused pass: every "
+                "gathered member evaluated in one burst-kernel call)",
+                lambda: sum(p.gang_burst_dispatches for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_gang_fused_served_total",
+                "Gang member cycles answered from a gang-fused dispatch "
+                "(sibling claims deducted host-side)",
+                lambda: sum(p.gang_burst_served for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_gang_fused_invalidated_total",
+                "Gang-fused dispatch rows dropped by a failed serve-time "
+                "validation (foreign reservation, metrics republish, "
+                "allocatable conflict)",
+                lambda: sum(p.gang_burst_invalidated for p in acc),
+            )
+            metrics.registry.counter(
                 "yoda_burst_dispatches_total",
                 "Multi-pod burst kernel dispatches (config batch_requests: "
                 "one dispatch pre-evaluates up to K pending pods)",
