@@ -510,6 +510,112 @@ def _burst_with_gang_scenario(
     }
 
 
+def _multi_gang_contended_scenario(
+    *, slices: int = 4, gangs: int = 3
+) -> dict:
+    """Cross-gang joint placement (ISSUE 2): ``gangs`` 4-member topology
+    gangs co-created on a ``slices``-slice v5p fleet, all racing for the
+    same best-scoring slice. Pre-joint, two gangs contending resolved by
+    admission-window ordering plus cascade/backoff — one dispatch per gang
+    per retry, losers re-parked. The joint pass gathers every co-queued
+    gang on the first member's pop, evaluates ALL members in ONE kernel
+    dispatch, and serves gang g's members net of gangs 0..g-1's claims, so
+    the gangs bind disjoint ICI blocks in a single pass.
+
+    The compile is warmed OUTSIDE the measured window by a throwaway gang
+    (its fused dispatch shares the joint dispatch's burst_bucket compile
+    bucket at batch_requests=16, so the measured drain pays zero compiles).
+
+    Reported fields:
+      multi_gang_contended_pods_per_s  end-to-end contended throughput over
+                                       all gang members (the acceptance
+                                       metric; within ~2x of the
+                                       uncontended burst_with_gang path)
+      multi_gang_count                 gangs racing (x4 members each)
+      multi_gang_dispatches            REAL kernel dispatches in the drain
+                                       (joint resolution = 1 per pass; the
+                                       slow test asserts the count)
+      multi_gang_joint_dispatches      multi-gang joint dispatches among
+                                       them (1 = the whole race resolved
+                                       in one device round-trip)
+      multi_gang_joint_gangs           gangs served from a joint dispatch
+      multi_gang_joint_parked          gangs the joint fit gate parked
+                                       whole (restored untouched; 0 when
+                                       every gang fits)
+
+    ``bench.py --smoke`` / ``make smoke`` runs this at slices=2, gangs=2
+    next to the burst+gang smoke scenario."""
+    import time as _time
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    assert gangs <= slices, "every gang must be placeable (fit gate covered by tests)"
+    stack = build_stack(
+        config=SchedulerConfig(mode="batch", batch_requests=16)
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for s in range(slices):
+        agent.add_slice(f"v5p-{s}", generation="v5p", host_topology=(2, 2, 1))
+    agent.publish_all()
+
+    def gang_pods(tag):
+        labels = {"tpu/gang": tag, "tpu/topology": "2x2x1", "tpu/chips": "4"}
+        return [PodSpec(f"{tag}-{i}", labels=dict(labels)) for i in range(4)]
+
+    # Warm the single AND burst kernels at this fleet bucket outside the
+    # measurement (one 4-member gang compiles the K=16 burst bucket the
+    # joint dispatch reuses).
+    for pod in gang_pods("mg-warm"):
+        stack.cluster.create_pod(pod)
+    stack.scheduler.run_until_idle(max_wall_s=120)
+    for pod in gang_pods("mg-warm"):
+        stack.cluster.delete_pod(pod.key)
+    stack.scheduler.run_until_idle(max_wall_s=10)
+
+    yb = stack.framework.batch_plugins[0]
+    d0 = yb.dispatch_count
+    j0 = yb.joint_dispatches
+    n_total = gangs * 4
+    t0 = _time.monotonic()
+    # Interleave members across gangs so the gather, not arrival order,
+    # does the grouping.
+    for i in range(4):
+        for g in range(gangs):
+            stack.cluster.create_pod(gang_pods(f"mg-{g}")[i])
+    stack.scheduler.run_until_idle(max_wall_s=120)
+    dt = _time.monotonic() - t0
+
+    pods = stack.cluster.list_pods()
+    assert len([p for p in pods if p.node_name]) == n_total, "not all bound"
+    used_hosts: set = set()
+    for g in range(gangs):
+        hosts = {p.node_name for p in pods if p.name.startswith(f"mg-{g}-")}
+        assert len(hosts) == 4 and None not in hosts, (
+            f"gang mg-{g} not one-per-host: {hosts}"
+        )
+        assert len({h.rsplit("-", 1)[0] for h in hosts}) == 1, (
+            f"gang mg-{g} spans slices: {hosts}"
+        )
+        assert not (hosts & used_hosts), (
+            f"gang mg-{g} overlaps another gang: {hosts & used_hosts}"
+        )
+        used_hosts |= hosts
+    # No host oversubscription: one 4-chip member per 4-chip v5p host.
+    for h in used_hosts:
+        assert stack.accountant.chips_in_use(h) <= 4
+    return {
+        "multi_gang_contended_pods_per_s": round(n_total / dt, 1),
+        "multi_gang_count": gangs,
+        "multi_gang_dispatches": yb.dispatch_count - d0,
+        "multi_gang_joint_dispatches": yb.joint_dispatches - j0,
+        "multi_gang_joint_gangs": yb.joint_gangs,
+        "multi_gang_joint_parked": yb.joint_parked,
+    }
+
+
 def _device_probe() -> dict:
     """Sweep the device-resident kernel's per-eval latency, accelerator vs
     host CPU, across fleet buckets — the measured curve behind the 'auto'
@@ -908,6 +1014,8 @@ def run_bench() -> dict:
     print(f"anti-affinity gang latency: {constrained}", file=sys.stderr)
     burst = _burst_scenario()
     print(f"multi-pod burst throughput: {burst}", file=sys.stderr)
+    multi = _multi_gang_contended_scenario()
+    print(f"multi-gang contended joint placement: {multi}", file=sys.stderr)
     http = _http_gang_scenario()
     print(f"gang over real HTTP wire path: {http}", file=sys.stderr)
     probe = _device_probe()
@@ -932,6 +1040,7 @@ def run_bench() -> dict:
         **mixed,
         **constrained,
         **burst,
+        **multi,
         **http,
         **probe,
         **pallas,
@@ -939,18 +1048,20 @@ def run_bench() -> dict:
 
 
 def run_smoke() -> dict:
-    """CI-sized contended-gang check (``bench.py --smoke``, `make smoke`):
-    ONLY the burst+gang scenario, on a reduced fleet (2 v5p slices + 4
-    v5e hosts, 24 singletons + one 4-member topology gang), pinned to
-    host CPU so no tunnel/compile variance leaks in. Runs in seconds and
-    guards the contended-hot-path RATE; the scenario's own assertions
-    (all bound, gang one-per-host, no oversubscription) guard
-    correctness, mirrored by the slow-marked pytest in
-    tests/test_bench_smoke.py."""
+    """CI-sized contended-gang checks (``bench.py --smoke``, `make smoke`):
+    the burst+gang scenario on a reduced fleet (2 v5p slices + 4 v5e
+    hosts, 24 singletons + one 4-member topology gang) PLUS the
+    multi-gang joint-placement scenario (2 gangs racing for 2 slices),
+    pinned to host CPU so no tunnel/compile variance leaks in. Runs in
+    seconds and guards the contended-hot-path RATES; the scenarios' own
+    assertions (all bound, gangs one-per-host on disjoint blocks, no
+    oversubscription) guard correctness, mirrored by the slow-marked
+    pytests in tests/test_bench_smoke.py."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     out = _burst_with_gang_scenario(slices=2, singles=4, burst_pods=24)
+    out.update(_multi_gang_contended_scenario(slices=2, gangs=2))
     return {"metric": "smoke_burst_with_gang_pods_per_s", **out}
 
 

@@ -26,9 +26,28 @@ def test_contended_scenario_invariants():
     assert out["burst_with_gang_dispatches"] <= 16
 
 
+def test_multi_gang_contended_invariants():
+    import bench
+
+    # The scenario asserts its own invariants inline (all bound, each gang
+    # one-per-host within one slice, gangs on DISJOINT blocks, no chip
+    # oversubscription); here we additionally pin the dispatch economics:
+    # the whole multi-gang race resolves in a SINGLE joint dispatch per
+    # pass — no per-gang dispatch serialization, no retry re-dispatches.
+    out = bench._multi_gang_contended_scenario()
+    assert out["multi_gang_contended_pods_per_s"] > 0
+    assert out["multi_gang_joint_dispatches"] == 1
+    assert out["multi_gang_dispatches"] == 1
+    assert out["multi_gang_joint_gangs"] == out["multi_gang_count"]
+    assert out["multi_gang_joint_parked"] == 0
+
+
 def test_smoke_mode_runs_reduced_fleet():
     import bench
 
     out = bench.run_smoke()
     assert out["metric"] == "smoke_burst_with_gang_pods_per_s"
     assert out["burst_with_gang_fused_served"] == 4
+    # The multi-gang joint scenario rides the same smoke run.
+    assert out["multi_gang_joint_dispatches"] == 1
+    assert out["multi_gang_contended_pods_per_s"] > 0

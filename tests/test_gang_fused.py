@@ -178,6 +178,194 @@ class TestGatheredGang:
         assert elapsed < 0.9, f"took {elapsed:.2f}s — backoff ladder, not signal"
 
 
+class TestCrossGangJoint:
+    """Cross-gang joint placement (ISSUE 2): one pop gathers ALL co-queued
+    gangs, one kernel dispatch evaluates every member, fully-placed gangs
+    drive reserve -> permit -> bind in the same loop turn with later gangs
+    seeing earlier gangs' claims, and a gang that cannot fit whole is
+    restored to the queue untouched."""
+
+    def test_two_gangs_one_dispatch_disjoint_blocks(self):
+        """Two topology gangs racing for the same fleet bind disjoint ICI
+        blocks from ONE kernel dispatch — no per-gang dispatch serialization,
+        no cascade/backoff round trips."""
+        stack, agent = make_stack(batch_requests=16)
+        for s in range(2):
+            agent.add_slice(f"v5p-{s}", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        yb = stack.framework.batch_plugins[0]
+        topo = {"tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(4):  # interleave arrivals across the two gangs
+            for tag in ("ga", "gb"):
+                stack.cluster.create_pod(
+                    PodSpec(f"{tag}-{i}", labels={"tpu/gang": tag, **topo})
+                )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods)
+        hosts = {}
+        for tag in ("ga", "gb"):
+            hs = {p.node_name for p in pods if p.name.startswith(tag)}
+            assert len(hs) == 4  # one member per host
+            assert len({h.rsplit("-", 1)[0] for h in hs}) == 1  # one slice
+            hosts[tag] = hs
+        assert not (hosts["ga"] & hosts["gb"])  # disjoint blocks
+        # The whole race resolved in ONE joint dispatch: all 8 member
+        # cycles served from it, zero per-gang dispatches.
+        assert yb.joint_dispatches == 1
+        assert yb.dispatch_count == 1
+        assert yb.joint_gangs == 2
+        assert yb.gang_burst_served == 8
+        for hs in hosts.values():
+            for h in hs:
+                assert stack.accountant.chips_in_use(h) <= 4
+
+    def test_unfit_gang_restored_untouched(self):
+        """Two topology gangs, ONE slice: the joint fit gate parks the
+        loser whole — its members go back to the queue with no attempt
+        charged and NO reservations (all-or-nothing), while the winner
+        binds from the same dispatch."""
+        stack, agent = make_stack(batch_requests=16)
+        agent.add_slice("v5p-0", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        yb = stack.framework.batch_plugins[0]
+        topo = {"tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for tag in ("win", "lose"):
+            for i in range(4):
+                stack.cluster.create_pod(
+                    PodSpec(f"{tag}-{i}", labels={"tpu/gang": tag, **topo})
+                )
+        first = stack.queue.pop(timeout=0)
+        batch = stack.scheduler._pop_batch(first)
+        # Only the winner's members are driven this turn; the loser was
+        # restored untouched: zero attempts, zero reservations.
+        assert [q.pod.name for q in batch] == [f"win-{i}" for i in range(4)]
+        assert yb.joint_parked == 1
+        restored = [stack.queue.pop(timeout=0) for _ in range(4)]
+        assert {q.pod.name for q in restored} == {f"lose-{i}" for i in range(4)}
+        assert all(q.attempts == 1 for q in restored)  # this pop, nothing prior
+        for q in restored:
+            stack.queue.restore(q)
+        for i in range(4):
+            assert stack.accountant.chips_in_use(f"v5p-0-{i}") == 0
+        for q in batch:
+            stack.scheduler.schedule_one(q)
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods if p.name.startswith("win"))
+        assert all(p.node_name is None for p in pods if p.name.startswith("lose"))
+        # No partial reservations ever landed for the loser.
+        assert stack.gang.gang_status("lose") in (None, (4, 0, 0))
+        total = sum(stack.accountant.chips_in_use(f"v5p-0-{i}") for i in range(4))
+        assert total == 16  # the winner's chips, nothing else
+
+    def test_plain_gangs_no_oversubscription(self):
+        """Plain (non-topology) gangs through the joint pass: inter-gang
+        claimable deduction never stacks chips past host capacity, and the
+        gang that cannot fit whole takes nothing."""
+        stack, agent = make_stack(batch_requests=16)
+        for i in range(2):
+            agent.add_host(f"h{i}", generation="v5p", chips=8)
+        agent.publish_all()
+        for i in range(4):
+            stack.cluster.create_pod(gang_pod("big", i, chips="3"))
+        for i in range(4):
+            stack.cluster.create_pod(gang_pod("small", i, chips="2"))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        big = [p for p in pods if p.name.startswith("big") and p.node_name]
+        small = [p for p in pods if p.name.startswith("small") and p.node_name]
+        # 4x3 = 12 chips fit; 4x2 = 8 more would need 20 > 16: all-or-nothing.
+        assert len(big) == 4
+        assert len(small) == 0
+        for i in range(2):
+            assert stack.accountant.chips_in_use(f"h{i}") <= 8
+        assert sum(stack.accountant.chips_in_use(f"h{i}") for i in range(2)) == 12
+
+    def test_priority_order_between_gangs(self):
+        """A higher-priority gang arriving AFTER a lower-priority one still
+        wins the contended slice in the joint pass — the gather preserves
+        queue (priority) order across gangs, so joint placement introduces
+        no priority inversion."""
+        stack, agent = make_stack(batch_requests=16)
+        agent.add_slice("v5p-0", generation="v5p", host_topology=(2, 2, 1))
+        agent.publish_all()
+        topo = {"tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"lo-{i}",
+                    labels={"tpu/gang": "lo", "tpu/priority": "1", **topo},
+                )
+            )
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"hi-{i}",
+                    labels={"tpu/gang": "hi", "tpu/priority": "9", **topo},
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pods = stack.cluster.list_pods()
+        assert all(p.node_name for p in pods if p.name.startswith("hi"))
+        assert all(p.node_name is None for p in pods if p.name.startswith("lo"))
+
+    def test_gather_pulls_still_ticking_backoff_siblings(self):
+        """pop_matching(include_backoff=True) gathers siblings whose retry
+        timer is still ticking, so a fuse happens one retry earlier."""
+        from yoda_tpu.api.requests import gang_name_of
+        from yoda_tpu.framework.queue import QueuedPodInfo, SchedulingQueue
+
+        now = [0.0]
+        q = SchedulingQueue(clock=lambda: now[0], immediate_retry_attempts=0)
+        parked = QueuedPodInfo(
+            pod=PodSpec("m0", labels={"tpu/gang": "g", "tpu/gang-size": "2"}),
+            attempts=3,  # ~4 s backoff, far beyond this test
+        )
+        q.add_unschedulable(parked, "no capacity")
+        stranger = QueuedPodInfo(pod=PodSpec("o", labels={}), attempts=3)
+        q.add_unschedulable(stranger, "no capacity")
+        got = q.pop_matching(
+            lambda p: gang_name_of(p.labels) == "g", include_backoff=True
+        )
+        assert [i.pod.name for i in got] == ["m0"]
+        assert got[0].attempts == 4
+        assert q.pop(timeout=0) is None  # the stranger stays backing off
+
+    def test_bursts_proceed_past_chip_only_parked_members(self):
+        """A partial gang parked at Permit whose members are chip-accounted
+        only (no cpu/memory/hostPort/PVC requests) no longer refuses
+        singleton bursts — their chip claims are live through the
+        accountant, so the amortization survives the wait (ROADMAP
+        deferred item)."""
+        stack, agent = make_stack(
+            batch_requests=8, gang_permit_timeout_s=300.0
+        )
+        for i in range(6):
+            agent.add_host(f"h{i}", generation="v5p", chips=8)
+        agent.publish_all()
+        for i in range(2):  # 2 of 4: the gang parks at Permit
+            stack.cluster.create_pod(gang_pod("part", i))
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        assert stack.gang.gang_status("part") == (4, 2, 0)
+        yb = stack.framework.batch_plugins[0]
+        for i in range(16):
+            stack.cluster.create_pod(
+                PodSpec(f"s-{i}", labels={"tpu/chips": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        singles = [
+            p for p in stack.cluster.list_pods() if p.name.startswith("s-")
+        ]
+        assert all(p.node_name for p in singles)
+        # The bursts actually engaged while the gang waited (pre-change
+        # every one was refused: 0 burst dispatches, 16 solo dispatches).
+        assert yb.burst_dispatches >= 1
+        assert yb.burst_served >= 8
+        for i in range(6):
+            assert stack.accountant.chips_in_use(f"h{i}") <= 8
+
+
 class TestServeForeverExpiry:
     def test_permit_expiry_fires_under_production_loop(self):
         """serve_forever's single expire_waiting sweep per iteration must

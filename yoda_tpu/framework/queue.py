@@ -194,6 +194,8 @@ class SchedulingQueue:
         self,
         pred: Callable[[PodSpec], bool],
         limit: int | None = None,
+        *,
+        include_backoff: bool = False,
     ) -> list[QueuedPodInfo]:
         """Pop every ACTIVE entry whose pod satisfies ``pred``, in queue
         (priority, FIFO) order — the gang-aware gather next to the
@@ -201,7 +203,13 @@ class SchedulingQueue:
         co-queued siblings are pulled out so the whole gang runs
         back-to-back in one fused pass instead of one cycle per loop turn.
         Non-blocking; expired backoff entries are flushed first so a
-        sibling whose retry timer just lapsed is gathered too."""
+        sibling whose retry timer just lapsed is gathered too.
+
+        ``include_backoff`` additionally pulls matching entries whose
+        backoff timer is STILL TICKING (appended after the active matches,
+        ready-time order): a gang member's pop can fuse siblings that
+        bounced into timed backoff one retry earlier, instead of leaving
+        them to the gang-arrival signal or the backoff ladder."""
         with self._cond:
             self._flush_backoff_locked()
             taken: list[_HeapItem] = []
@@ -216,10 +224,24 @@ class SchedulingQueue:
             if taken:
                 heapq.heapify(keep)
                 self._active = keep
+            back_taken: list[QueuedPodInfo] = []
+            if include_backoff:
+                still: list[tuple[float, int, QueuedPodInfo]] = []
+                for entry in sorted(self._backoff):
+                    if (
+                        limit is None or len(taken) + len(back_taken) < limit
+                    ) and pred(entry[2].pod):
+                        back_taken.append(entry[2])
+                    else:
+                        still.append(entry)
+                if back_taken:
+                    heapq.heapify(still)
+                    self._backoff = still
         taken.sort()  # heap-internal order -> queue order
-        for item in taken:
-            item.qpi.attempts += 1
-        return [item.qpi for item in taken]
+        out = [item.qpi for item in taken] + back_taken
+        for qpi in out:
+            qpi.attempts += 1
+        return out
 
     def restore(self, qpi: QueuedPodInfo) -> None:
         """Return a popped-but-unscheduled entry to the active queue (the

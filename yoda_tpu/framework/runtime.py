@@ -182,6 +182,26 @@ class Framework:
             if prepare is not None:
                 prepare(pods, snapshot)
 
+    def prepare_joint(
+        self,
+        groups: "Sequence[Sequence[PodSpec]]",
+        snapshot: Snapshot,
+    ) -> "list[str] | None":
+        """Hand SEVERAL gathered gangs (one group per gang, priority
+        order) to joint-capable batch plugins: ONE kernel dispatch
+        evaluates every member of every gang, and each gang's cycles are
+        served net of the claims of higher-priority gangs in the same
+        dispatch (YodaBatch.prepare_joint_burst). Returns the first
+        capable plugin's per-group verdicts — "fused" (drive the members
+        this turn), "solo" (schedule per-cycle), "park" (cannot fit
+        whole; restore untouched) — or None when no plugin can run a
+        joint pass (the scheduler then falls back to per-gang passes)."""
+        for p in self.batch_plugins:
+            prepare = getattr(p, "prepare_joint_burst", None)
+            if prepare is not None:
+                return prepare(groups, snapshot)
+        return None
+
     def run_batch_filter_score(
         self, state: CycleState, pod: PodSpec, snapshot: Snapshot
     ) -> tuple[dict[str, Status], dict[str, int]] | None:

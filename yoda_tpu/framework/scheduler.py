@@ -506,42 +506,101 @@ class Scheduler:
 
     def _pop_batch(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:
         """Expand one popped entry into the batch this loop turn schedules:
-        a gang member gathers its co-queued siblings (gang-fused pass), any
-        other pod gathers a multi-pod burst."""
+        a gang member gathers every co-queued gang (cross-gang joint
+        pass), any other pod gathers a multi-pod burst."""
         if gang_name_of(first.pod.labels):
-            return self._gather_gang(first)
+            return self._gather_gangs(first)
         return self._pop_burst(first)
 
-    def _gather_gang(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:
-        """Gang-fused scheduling pass: pull every co-queued member of
-        ``first``'s gang out of the queue and schedule the whole gang
-        back-to-back in this loop turn. With all members in one pass, the
-        Permit barrier resolves inside the LAST member's cycle — no
-        park/release round trips through later loop turns — and
-        ``Framework.prepare_gang`` pre-evaluates every member against the
-        fleet in ONE kernel dispatch (YodaBatch.prepare_gang_burst), each
-        sibling cycle served from its own row with the chips claimed by
-        members 0..k-1 already deducted."""
-        name = gang_name_of(first.pod.labels)
-        batch = [first] + self.queue.pop_matching(
-            lambda p: gang_name_of(p.labels) == name
+    def _gather_gangs(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:
+        """Cross-gang joint scheduling pass (the gang-fused pass of ISSUE 1
+        extended across gangs, ISSUE 2): pull EVERY co-queued gang member
+        — ``first``'s own siblings and members of other gangs — out of the
+        queue (still-ticking backoff siblings of the gathered gangs
+        included, so a fuse happens one retry earlier), group them by gang
+        in priority order, and evaluate all groups in ONE kernel dispatch
+        (``Framework.prepare_joint`` -> YodaBatch.prepare_joint_burst).
+        Every fully-placed gang then drives reserve -> permit -> bind
+        back-to-back in this same loop turn — the Permit barrier resolves
+        inside each gang's last member's cycle, and a later gang's members
+        are served net of the earlier gangs' claims, so contending gangs
+        bind disjoint blocks in one pass instead of serializing dispatches
+        through admission-window ordering and cascade/backoff. A gang the
+        joint plan cannot fit WHOLE is restored to the queue untouched
+        (all-or-nothing: no reservations, no attempt charged); its own
+        later pop runs the normal admission path. Priority order is
+        preserved across gangs — a lower-priority gang never takes
+        capacity a gathered higher-priority gang could use — and the
+        inversion window for a higher-priority singleton stays bounded by
+        the gathered gangs' total size (the burst-window promise)."""
+        first_name = gang_name_of(first.pod.labels)
+        groups: "dict[str, list[QueuedPodInfo]]" = {first_name: [first]}
+        for q in self.queue.pop_matching(
+            lambda p: gang_name_of(p.labels) is not None
+        ):
+            groups.setdefault(gang_name_of(q.pod.labels), []).append(q)
+        # Satellite gather: siblings of the gathered gangs still ticking
+        # down backoff fuse now instead of one retry later.
+        names = set(groups)
+        for q in self.queue.pop_matching(
+            lambda p: gang_name_of(p.labels) in names, include_backoff=True
+        ):
+            groups[gang_name_of(q.pod.labels)].append(q)
+        snapshot = self.snapshot_fn()
+        if len(groups) == 1:
+            batch = groups[first_name]
+            if len(batch) > 1:
+                log.debug(
+                    "gang %s: gathered %d co-queued member(s) for a fused "
+                    "pass", first_name, len(batch),
+                )
+                try:
+                    self.framework.prepare_gang(
+                        [q.pod for q in batch], snapshot
+                    )
+                except Exception:
+                    # Advisory only: members still schedule back-to-back
+                    # below, falling to per-cycle dispatches / the gang plan.
+                    log.exception(
+                        "gang pre-evaluation failed; scheduling members "
+                        "individually"
+                    )
+            return batch
+        ordered = list(groups.items())
+        log.debug(
+            "joint pass: gathered %d gang(s) (%s) for one dispatch",
+            len(ordered), ", ".join(n for n, _ in ordered),
         )
-        if len(batch) > 1:
-            log.debug(
-                "gang %s: gathered %d co-queued member(s) for a fused pass",
-                name, len(batch),
+        verdicts = None
+        try:
+            verdicts = self.framework.prepare_joint(
+                [[q.pod for q in g] for _, g in ordered], snapshot
             )
-            try:
-                self.framework.prepare_gang(
-                    [q.pod for q in batch], self.snapshot_fn()
+        except Exception:
+            # Advisory only: every gang still schedules back-to-back below
+            # through the per-gang machinery (plans / fresh dispatches).
+            log.exception(
+                "joint gang pre-evaluation failed; scheduling gangs "
+                "per-gang"
+            )
+        if verdicts is None:
+            return [q for _, g in ordered for q in g]
+        batch: list[QueuedPodInfo] = []
+        for i, ((name, g), verdict) in enumerate(zip(ordered, verdicts)):
+            if verdict == "park" and i > 0:
+                # All-or-nothing without churn: the joint plan proved the
+                # gang cannot place whole net of the gangs ahead of it —
+                # back to the queue untouched. Never the FIRST group: its
+                # pop must always progress (to a bind or an admission
+                # park), or a re-pop would loop on the same verdict.
+                log.debug(
+                    "gang %s: does not fit the joint plan; restored "
+                    "untouched (%d member(s))", name, len(g),
                 )
-            except Exception:
-                # Advisory only: members still schedule back-to-back below,
-                # falling to per-cycle dispatches / the gang plan.
-                log.exception(
-                    "gang pre-evaluation failed; scheduling members "
-                    "individually"
-                )
+                for q in g:
+                    self.queue.restore(q)
+            else:
+                batch.extend(g)
         return batch
 
     def _pop_burst(self, first: QueuedPodInfo) -> list[QueuedPodInfo]:

@@ -415,6 +415,61 @@ def burst_bucket(k: int, minimum: int = 1) -> int:
     return 1 << max(k - 1, 1).bit_length()
 
 
+def stack_joint_burst(
+    host_ok_groups: "list[np.ndarray]",
+    request_groups: "list[list[KernelRequest]]",
+    minimum: int = 1,
+) -> "tuple[np.ndarray, list[KernelRequest], list[int]]":
+    """Stack G gangs' per-member rows into ONE padded burst (the cross-gang
+    joint dispatch, ISSUE 2): group g's members occupy flat rows
+    ``offsets[g]:offsets[g+1]`` of the returned [K, N] admission matrix and
+    K-long request list, padded to :func:`burst_bucket` so joint,
+    single-gang fused, and singleton-burst dispatches all share compiled
+    executables per fleet bucket (padding rows carry all-False host_ok and
+    are infeasible everywhere). ``host_ok_groups[g]`` is that gang's
+    [k_g, N] admission rows. Returns (host_ok_k, requests, offsets) with
+    ``len(offsets) == G + 1``."""
+    flat_req: list[KernelRequest] = []
+    offsets = [0]
+    for reqs in request_groups:
+        flat_req.extend(reqs)
+        offsets.append(len(flat_req))
+    if not flat_req:
+        raise ValueError("stack_joint_burst needs at least one member row")
+    k = burst_bucket(len(flat_req), minimum)
+    n = int(host_ok_groups[0].shape[-1])
+    host_ok_k = np.zeros((k, n), dtype=np.int32)
+    row = 0
+    for ok_rows in host_ok_groups:
+        for r in np.asarray(ok_rows, dtype=np.int32).reshape(-1, n):
+            host_ok_k[row] = r
+            row += 1
+    pad = KernelRequest(1, 0, 0, 0, 0)
+    requests = flat_req + [pad] * (k - len(flat_req))
+    return host_ok_k, requests, offsets
+
+
+def evaluate_joint_via_burst(
+    kern,
+    dyn: np.ndarray,
+    host_ok_groups: "list[np.ndarray]",
+    request_groups: "list[list[KernelRequest]]",
+    minimum: int = 1,
+) -> "list[list[KernelResult]]":
+    """Evaluate G gangs' members in ONE device round-trip through a
+    kernel's ``evaluate_burst``: the per-gang rows are stacked into one
+    padded burst (:func:`stack_joint_burst`) and the flat results are
+    regrouped per gang. Shared by every burst-capable backend's
+    ``evaluate_joint`` (XLA, mesh-sharded, Pallas/Mosaic)."""
+    host_ok_k, requests, offsets = stack_joint_burst(
+        host_ok_groups, request_groups, minimum
+    )
+    flat = kern.evaluate_burst(dyn, host_ok_k, requests)
+    return [
+        flat[offsets[g] : offsets[g + 1]] for g in range(len(request_groups))
+    ]
+
+
 def pack_request(request: "KernelRequest") -> np.ndarray:
     return np.array(
         [
@@ -535,6 +590,20 @@ class DeviceFleetKernel:
             result_from_packed(self._names, packed[k])
             for k in range(len(requests))
         ]
+
+    def evaluate_joint(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "list[list[KernelResult]]":
+        """G gangs' member rows in ONE dispatch (cross-gang joint
+        placement): stacked into one padded burst and regrouped per gang
+        (:func:`evaluate_joint_via_burst`)."""
+        return evaluate_joint_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
 
 
 def fused_filter_score(

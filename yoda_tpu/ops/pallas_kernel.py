@@ -508,6 +508,24 @@ class PallasFleetKernel:
             for i in range(k)
         ]
 
+    def evaluate_joint(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "list[list[KernelResult]]":
+        """G gangs' member rows in ONE Mosaic dispatch (cross-gang joint
+        placement): the per-gang admission rows stack into one padded
+        burst — reusing ``evaluate_burst``'s [K, 8, Np] sublane padding,
+        the BENCH_r05 lowering fix — and the flat results regroup per
+        gang (ops.kernel.evaluate_joint_via_burst)."""
+        from yoda_tpu.ops.kernel import evaluate_joint_via_burst
+
+        return evaluate_joint_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
+
 
 def fused_filter_score_pallas(
     arrays: FleetArrays,

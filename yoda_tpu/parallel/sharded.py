@@ -212,6 +212,22 @@ class ShardedDeviceFleetKernel:
             for k in range(len(requests))
         ]
 
+    def evaluate_joint(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "list[list[KernelResult]]":
+        """G gangs' member rows in ONE sharded dispatch (cross-gang joint
+        placement) — stacked per ops.kernel.stack_joint_burst and
+        regrouped per gang, so mesh mode joins the joint pass too."""
+        from yoda_tpu.ops.kernel import evaluate_joint_via_burst
+
+        return evaluate_joint_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
+
 
 def sharded_filter_score(
     arrays: FleetArrays,

@@ -50,7 +50,6 @@ from yoda_tpu.ops.kernel import (
     KernelRequest,
     KernelResult,
     REASON_MESSAGES,
-    burst_bucket,
 )
 from yoda_tpu.config import Weights
 from yoda_tpu.plugins.yoda.filter_plugin import (
@@ -180,6 +179,12 @@ class _BurstSet:
     # per-pod so serves can skip entries already bound into the live
     # snapshot (no double-count against NodeInfo.pods).
     res: dict[str, list[tuple[str, int, int]]] = field(default_factory=dict)
+    # Gang names sharing this set's dispatch baseline (cross-gang joint
+    # placement, ISSUE 2): the per-gang sets of one joint dispatch share
+    # the SAME consumed/res ledgers — gang g's members see capacity net
+    # of gangs 0..g-1's claims — so a validation failure on any one set
+    # means the common baseline is stale and the whole group drops.
+    group: tuple[str, ...] | None = None
 
 
 @dataclass
@@ -322,13 +327,20 @@ class YodaBatch(BatchFilterScorePlugin):
         # Gang-fused dispatch (ISSUE 1): prepare_gang_burst evaluates a
         # gathered gang's members — heterogeneous requests included — in
         # ONE kernel call; each member's cycle is served from its own row
-        # with siblings' claims deducted (_serve_gang_burst). The identical
+        # with siblings' claims deducted (_serve_joint_burst). The identical
         # -request _GangPlan remains the fallback for members that arrive
         # outside a gather.
         self._gang_bursts: dict[str, _BurstSet] = {}
         self.gang_burst_dispatches = 0   # whole-gang kernel dispatches
         self.gang_burst_served = 0       # member cycles answered from one
         self.gang_burst_invalidated = 0  # rows dropped by failed validation
+        # Cross-gang joint dispatch (ISSUE 2): prepare_joint_burst
+        # evaluates SEVERAL co-queued gangs in one kernel call; gang g's
+        # members are served net of gangs 0..g-1's claims (shared ledger),
+        # and a gang the joint plan cannot fit whole is parked untouched.
+        self.joint_dispatches = 0   # multi-gang kernel dispatches
+        self.joint_gangs = 0        # gangs whose rows came from a joint one
+        self.joint_parked = 0       # gangs parked whole by the joint fit gate
         # (snapshot.version, fleet has inter-pod terms) — bursting is
         # refused on fleets where evaluators would be needed per pod.
         self._fleet_terms: tuple[int, bool] = (0, False)
@@ -527,7 +539,7 @@ class YodaBatch(BatchFilterScorePlugin):
         reqk = KernelRequest.from_request(req)
         gang_name = req.gang.name if req.gang is not None else None
         if gang_name is not None:
-            served = self._serve_gang_burst(state, pod, gang_name, snapshot, reqk)
+            served = self._serve_joint_burst(state, pod, gang_name, snapshot, reqk)
             if served is None:
                 served = self._serve_gang_plan(
                     state, pod, gang_name, snapshot, reqk
@@ -784,17 +796,29 @@ class YodaBatch(BatchFilterScorePlugin):
 
     def _pending_blocking(self, snapshot: Snapshot) -> bool:
         """True when some Permit-parked placement's pod is NOT yet visible
-        in the snapshot — its cpu/memory claims are then invisible to a
-        burst dispatch and serving from one could overcommit allocatable.
-        Entries already visible (released members whose bind watch event
-        landed — the gang plugin keeps them in pending_placements until
-        deletion) carry their claims in ``NodeInfo.pods`` and must NOT
-        refuse the burst: a completed gang would otherwise disable burst
-        amortization for every later singleton on the fleet (the 25-60x
-        contended-throughput cliff, BENCH_r05)."""
+        in the snapshot — its cpu/memory/hostPort/volume claims are then
+        invisible to a burst dispatch and serving from one could overcommit
+        allocatable. Entries already visible (released members whose bind
+        watch event landed — the gang plugin keeps them in
+        pending_placements until deletion) carry their claims in
+        ``NodeInfo.pods`` and must NOT refuse the burst: a completed gang
+        would otherwise disable burst amortization for every later
+        singleton on the fleet (the 25-60x contended-throughput cliff,
+        BENCH_r05). Members that are CHIP-ACCOUNTED ONLY — no cpu/memory/
+        hostPort/PVC requests — never refuse a burst either (ROADMAP
+        deferred item): their only claim is chips, which every dispatch
+        reads live through ``reserved_fn``, so bursts proceed past them
+        and keep their amortization while a partial gang waits at Permit."""
         if self.pending_fn is None:
             return False
         for host, spec in self.pending_fn():
+            if not (
+                spec.cpu_milli_request
+                or spec.memory_request
+                or spec.host_ports
+                or spec.pvc_names
+            ):
+                continue
             if host not in snapshot:
                 return True
             if all(p.uid != spec.uid for p in snapshot.get(host).pods):
@@ -934,7 +958,7 @@ class YodaBatch(BatchFilterScorePlugin):
         }
         return statuses, {best: scores[best]}
 
-    # --- gang-fused dispatch (ISSUE 1) ---
+    # --- gang-fused / cross-gang joint dispatch (ISSUEs 1-2) ---
 
     def prepare_gang_burst(
         self, pods: Sequence[PodSpec], snapshot: Snapshot
@@ -944,58 +968,91 @@ class YodaBatch(BatchFilterScorePlugin):
         kernel dispatch (the burst kernel, per-member admission rows and
         request vectors), so the whole gang places in a single pass.
         Member cycles are served from their own rows by
-        :meth:`_serve_gang_burst` with inter-member capacity deduction:
+        :meth:`_serve_joint_burst` with inter-member capacity deduction:
         member k's candidate set sees the chips members 0..k-1 claimed.
         Unlike ``_GangPlan`` (identical requests, built lazily at the
         first member's dispatch) this covers heterogeneous members and
-        dispatches before any cycle runs.
+        dispatches before any cycle runs. The single-group case of
+        :meth:`prepare_joint_burst` — no fit gate: a gang that cannot
+        complete parks through the normal admission path.
 
         Refused silently — members fall back to the plan / per-cycle
         dispatches — under the same preconditions as ``prepare_burst``
         (no accounting, uncacheable snapshot, snapshot-invisible pending
         placements, inter-pod terms in the fleet or on a member,
         hostPort/PVC members)."""
-        gang = None
-        for pod in pods:
-            name = gang_name_of(pod.labels)
-            if name is None or (gang is not None and name != gang):
-                return  # not a single gang: caller bug or alias mismatch
-            gang = name
-        if gang is None:
+        if len(pods) < 2:
             return
-        self._drop_gang_burst(gang)
+        self._prepare_groups([list(pods)], snapshot, fit_gate=False)
+
+    def prepare_joint_burst(
+        self, groups: "Sequence[Sequence[PodSpec]]", snapshot: Snapshot
+    ) -> "list[str] | None":
+        """Cross-gang joint placement (ISSUE 2): evaluate SEVERAL co-queued
+        gangs (distinct names, priority order) in ONE kernel dispatch and
+        build per-gang row sets that share one consumption ledger, so gang
+        g's member cycles transparently see capacity net of gangs 0..g-1's
+        claims and bind non-overlapping host blocks — the ~110 ms
+        accelerator dispatch floor amortizes across the gangs instead of
+        being paid per gang per retry. A host-side fit simulation walks
+        the groups in priority order — the real block planner for
+        topology gangs, greedy per-row claimable deduction for plain
+        gangs — and a gang that cannot place WHOLE net of the earlier
+        gangs' claims has its rows dropped before any cycle runs, so the
+        scheduler restores it untouched (all-or-nothing with no
+        reserve->cascade->backoff churn). An unfit gang consumes nothing
+        in the simulation: gangs below it still see its capacity.
+
+        Returns one verdict per group, in order:
+
+        - ``"fused"`` — rows built; drive the members this loop turn
+        - ``"solo"``  — ineligible for a fused dispatch (inter-pod terms,
+          spread, hostPorts, PVCs, parse errors); schedule the members
+          per-cycle, where the evaluators and the lazy gang plan apply
+        - ``"park"``  — cannot fit whole; restore the members untouched
+
+        None = the joint pass is refused entirely (same preconditions as
+        ``prepare_burst``, or fewer than two member rows to fuse) and
+        every gang falls back to the per-gang path."""
+        return self._prepare_groups(
+            [list(g) for g in groups], snapshot, fit_gate=True
+        )
+
+    def _prepare_groups(
+        self,
+        groups: "list[list[PodSpec]]",
+        snapshot: Snapshot,
+        *,
+        fit_gate: bool,
+    ) -> "list[str] | None":
+        gang_names: list[str] = []
+        for pods in groups:
+            gang = None
+            for pod in pods:
+                name = gang_name_of(pod.labels)
+                if name is None or (gang is not None and name != gang):
+                    return None  # not one gang per group: caller bug
+                gang = name
+            if gang is None or gang in gang_names:
+                return None  # empty group or duplicate gang: caller bug
+            gang_names.append(gang)
+        for name in gang_names:
+            self._drop_gang_burst(name)
         if (
-            len(pods) < 2
-            or len(snapshot) == 0
+            len(snapshot) == 0
             or not snapshot.version
             or self.reserved_fn is None
             or self._pending_blocking(snapshot)
             or self._fleet_has_terms(snapshot)
         ):
-            return
-        from yoda_tpu.api.requests import LabelParseError, pod_request
-
-        candidates: list[tuple[PodSpec, KernelRequest]] = []
-        for pod in pods:
-            try:
-                req = pod_request(pod)
-            except LabelParseError:
-                return  # the member's own cycle reports the parse error
-            if (
-                req.gang is None
-                or pod_has_inter_pod_terms(pod)
-                or pod.topology_spread
-                or pod.host_ports
-                or pod.pvc_names
-            ):
-                # One ineligible member refuses the whole gang: a fused
-                # pass that skips members would reintroduce the very
-                # inter-member window it exists to close.
-                return
-            candidates.append((pod, KernelRequest.from_request(req)))
+            return None
+        cands = [self._gang_candidates(pods) for pods in groups]
+        eligible = [i for i, c in enumerate(cands) if c]
+        if sum(len(cands[i]) for i in eligible) < 2:
+            return None  # nothing to amortize or deduct across
         static = self._refresh_static(snapshot)
         if not hasattr(self._kern, "evaluate_burst"):
-            return  # future kernels without a burst path: plan fallback
+            return None  # future kernels without a burst path: plan fallback
         reserved_src, claimed_src = self._dyn_sources()
         dyn = static.dyn_packed(
             reserved_src,
@@ -1003,45 +1060,206 @@ class YodaBatch(BatchFilterScorePlugin):
             max_metrics_age_s=self.max_metrics_age_s,
             last_updated=self._live_timestamps(),
         )
-        k = burst_bucket(len(candidates), self.batch_requests)
         n_pad = static.node_valid.shape[0]
-        host_ok_k = np.zeros((k, n_pad), dtype=np.int32)
-        requests: list[KernelRequest] = []
-        for i, (pod, reqk) in enumerate(candidates):
-            host_ok_k[i] = _host_admission(static, snapshot, pod)
-            requests.append(reqk)
-        pad = KernelRequest(1, 0, 0, 0, 0)
-        while len(requests) < k:
-            requests.append(pad)
-        results = self._kern.evaluate_burst(dyn, host_ok_k, requests)
+        host_ok_groups: list[np.ndarray] = []
+        request_groups: list[list[KernelRequest]] = []
+        for i in eligible:
+            ok = np.zeros((len(cands[i]), n_pad), dtype=np.int32)
+            for m, (pod, _req, _reqk) in enumerate(cands[i]):
+                ok[m] = _host_admission(static, snapshot, pod)
+            host_ok_groups.append(ok)
+            request_groups.append([reqk for _, _, reqk in cands[i]])
+        if hasattr(self._kern, "evaluate_joint"):
+            grouped = self._kern.evaluate_joint(
+                dyn, host_ok_groups, request_groups, self.batch_requests
+            )
+        else:
+            # Burst-capable kernel without the grouped convenience: stack
+            # and regroup host-side (ops.kernel owns the layout).
+            from yoda_tpu.ops.kernel import evaluate_joint_via_burst
+
+            grouped = evaluate_joint_via_burst(
+                self._kern, dyn, host_ok_groups, request_groups,
+                self.batch_requests,
+            )
         self.dispatch_count += 1
-        self.gang_burst_dispatches += 1
-        self._gang_bursts[gang] = _BurstSet(
-            fleet_version=self._fleet_version(snapshot),
-            names=list(static.names),
-            index={nm: i for i, nm in enumerate(static.names)},
-            base_reserved=np.asarray(dyn[1]).copy(),
-            entries={
-                pod.uid: _BurstEntry(
-                    request=reqk,
-                    constraints=_pod_constraints(pod),
-                    result=results[i],
-                    pref_bonus=self._preference_bonus(static, snapshot, pod),
+        if len(eligible) >= 2:
+            self.joint_dispatches += 1
+        else:
+            self.gang_burst_dispatches += 1
+        fleet_version = self._fleet_version(snapshot)
+        base_reserved = np.asarray(dyn[1]).copy()
+        index = {nm: i for i, nm in enumerate(static.names)}
+        # ONE ledger across the whole joint group: gang g's serves deduct
+        # from what gang g+1's serves (and spot-checks) see.
+        shared_consumed: dict[str, int] = {}
+        shared_res: dict[str, list[tuple[str, int, int]]] = {}
+        sim = np.zeros(len(static.names), dtype=np.int64)
+        verdicts: list[str] = []
+        fused: list[str] = []
+        gi = 0
+        for name, cand in zip(gang_names, cands):
+            if not cand:
+                verdicts.append("solo")
+                continue
+            rows = grouped[gi]
+            gi += 1
+            if fit_gate and not self._joint_gang_fits(
+                cand, rows, static, snapshot, sim
+            ):
+                verdicts.append("park")
+                self.joint_parked += 1
+                log.debug(
+                    "gang %s: joint plan cannot fit it whole net of %d "
+                    "higher-priority gang(s); parking untouched",
+                    name, len(fused),
                 )
-                for i, (pod, reqk) in enumerate(candidates)
-            },
-        )
+                continue
+            self._gang_bursts[name] = _BurstSet(
+                fleet_version=fleet_version,
+                names=list(static.names),
+                index=index,
+                base_reserved=base_reserved,
+                entries={
+                    pod.uid: _BurstEntry(
+                        request=reqk,
+                        constraints=_pod_constraints(pod),
+                        result=rows[m],
+                        pref_bonus=self._preference_bonus(
+                            static, snapshot, pod
+                        ),
+                    )
+                    for m, (pod, _req, reqk) in enumerate(cand)
+                },
+                consumed=shared_consumed,
+                res=shared_res,
+            )
+            fused.append(name)
+            verdicts.append("fused")
+        if len(eligible) >= 2:
+            # Joint dispatch: count every gang it served rows for, and tag
+            # the sets as one group so invalidation drops them together.
+            self.joint_gangs += len(fused)
+        if len(fused) >= 2:
+            group = tuple(fused)
+            for name in fused:
+                self._gang_bursts[name].group = group
         if len(self._gang_bursts) > 8:
-            # Bounded, like the gang plans: evict the oldest live set.
-            self._drop_gang_burst(next(iter(self._gang_bursts)))
+            # Bounded, like the gang plans: evict stale sets, oldest
+            # first, never this dispatch's own.
+            for stale in [g for g in self._gang_bursts if g not in fused]:
+                if len(self._gang_bursts) <= 8:
+                    break
+                self._drop_gang_burst(stale)
+        return verdicts
+
+    def _gang_candidates(
+        self, pods: "list[PodSpec]"
+    ) -> "list[tuple[PodSpec, object, KernelRequest]] | None":
+        """Validate one gathered gang for a fused dispatch: every member
+        parses and none carries per-cycle state a cached row cannot track
+        (inter-pod terms, spread, hostPorts, PVCs). One ineligible member
+        refuses the whole gang — a fused pass that skipped members would
+        reintroduce the very inter-member window it exists to close.
+        Returns (pod, parsed request, kernel request) per member, or
+        None = ineligible (members schedule per-cycle)."""
+        from yoda_tpu.api.requests import LabelParseError, pod_request
+
+        out: list[tuple[PodSpec, object, KernelRequest]] = []
+        for pod in pods:
+            try:
+                req = pod_request(pod)
+            except LabelParseError:
+                return None  # the member's own cycle reports the parse error
+            if (
+                req.gang is None
+                or pod_has_inter_pod_terms(pod)
+                or pod.topology_spread
+                or pod.host_ports
+                or pod.pvc_names
+            ):
+                return None
+            out.append((pod, req, KernelRequest.from_request(req)))
+        return out
+
+    def _joint_gang_fits(
+        self,
+        cand: "list[tuple[PodSpec, object, KernelRequest]]",
+        rows: "list[KernelResult]",
+        static: FleetArrays,
+        snapshot: Snapshot,
+        sim: np.ndarray,
+    ) -> bool:
+        """Host-side fit simulation for one gang of a joint dispatch: can
+        every gathered member place, net of ``sim`` (the chips earlier
+        fitting gangs' members would claim)? Fitting gangs consume into
+        ``sim``; an unfit gang consumes nothing, so gangs below it still
+        see its capacity. This is a PREDICATE, not a placement: the serve
+        path re-validates every pick against the live accountant, and a
+        wrong "fit" degrades to the normal admission park — but a "park"
+        verdict saves the gang a reserve->cascade->backoff round trip and
+        its siblings a wasted dispatch. Topology gangs run the real block
+        planner (contiguous ICI block, one member per host) against the
+        first member's row; plain gangs greedily deduct each member's own
+        row's claimable in score order, mirroring ``_build_gang_plan``."""
+        from yoda_tpu.plugins.yoda.topology import plan_multislice_placement
+
+        req0 = cand[0][1]
+        spec = getattr(req0, "gang", None)
+        chips0 = max(cand[0][2].number, 1)
+        if spec is not None and spec.topology is not None:
+            row0 = rows[0]
+            idx = {nm: i for i, nm in enumerate(static.names)}
+
+            def host_ok(ni) -> bool:
+                i = idx.get(ni.name)
+                return (
+                    i is not None
+                    and bool(row0.feasible[i])
+                    and int(row0.claimable[i]) - int(sim[i]) >= chips0
+                )
+
+            plan = plan_multislice_placement(
+                snapshot,
+                want_dims=spec.topology,
+                slices=spec.slices,
+                host_ok=host_ok,
+            )
+            if plan is None:
+                return False
+            # Gathered members claim one planned host each (partial gangs
+            # claim only what they will reserve this turn).
+            for host in sorted(plan)[: len(cand)]:
+                sim[idx[host]] += chips0
+            return True
+        tentative = sim.copy()
+        for (_pod, _req, reqk), row in zip(cand, rows):
+            chips = max(reqk.number, 1)
+            avail = row.claimable.astype(np.int64) - tentative
+            ok = row.feasible.astype(bool) & (avail >= chips)
+            if not ok.any():
+                return False
+            tentative[int(np.argmax(np.where(ok, row.scores, -1)))] += chips
+        sim[:] = tentative
+        return True
 
     def _drop_gang_burst(self, gang: str) -> None:
         b = self._gang_bursts.pop(gang, None)
-        if b is not None:
-            self.gang_burst_invalidated += len(b.entries)
-            log.debug("gang %s: fused dispatch rows invalidated", gang)
+        if b is None:
+            return
+        self.gang_burst_invalidated += len(b.entries)
+        log.debug("gang %s: fused dispatch rows invalidated", gang)
+        # A joint group's sets share one dispatch baseline and ledger:
+        # stale for one gang means stale for every sibling gang.
+        for sibling in b.group or ():
+            s = self._gang_bursts.pop(sibling, None)
+            if s is not None:
+                self.gang_burst_invalidated += len(s.entries)
+                log.debug(
+                    "gang %s: joint sibling rows invalidated", sibling
+                )
 
-    def _serve_gang_burst(
+    def _serve_joint_burst(
         self,
         state: CycleState,
         pod: PodSpec,
@@ -1049,13 +1267,16 @@ class YodaBatch(BatchFilterScorePlugin):
         snapshot: Snapshot,
         reqk: KernelRequest,
     ) -> tuple[dict[str, Status], dict[str, int]] | None:
-        """Serve a gang member's cycle from the gang-fused dispatch — its
-        own row, minus what earlier members claimed (``consumed``), pinned
-        to the gang's planned hosts when the PreFilter wrote them (the
-        allowed set already excludes hosts assigned to parked siblings, so
-        topology gangs stay one-member-per-host), and spot-checked against
-        the live accountant/Node state exactly like a burst serve. None =
-        dispatch fresh (a stale row must never park a pod)."""
+        """Serve a gang member's cycle from the gang-fused or cross-gang
+        joint dispatch — its own row, minus what earlier members claimed
+        (``consumed``; shared across a joint group's gangs, so a later
+        gang's members transparently see the chips earlier gangs took),
+        pinned to the gang's planned hosts when the PreFilter wrote them
+        (the allowed set already excludes hosts assigned to parked
+        siblings, so topology gangs stay one-member-per-host), and
+        spot-checked against the live accountant/Node state exactly like
+        a burst serve. None = dispatch fresh (a stale row must never park
+        a pod)."""
         b = self._gang_bursts.get(gang)
         if b is None:
             return None

@@ -274,6 +274,25 @@ def build_stack(
                 lambda: sum(p.gang_burst_invalidated for p in acc),
             )
             metrics.registry.counter(
+                "yoda_joint_dispatches_total",
+                "Cross-gang joint kernel dispatches (several co-queued "
+                "gangs evaluated in one kernel call, serving disjoint "
+                "blocks)",
+                lambda: sum(p.joint_dispatches for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_joint_gangs_fused_total",
+                "Gangs whose placement rows came from a cross-gang joint "
+                "dispatch",
+                lambda: sum(p.joint_gangs for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_joint_gangs_parked_total",
+                "Gangs the joint fit gate parked whole (restored to the "
+                "queue untouched instead of reserving and cascading)",
+                lambda: sum(p.joint_parked for p in acc),
+            )
+            metrics.registry.counter(
                 "yoda_burst_dispatches_total",
                 "Multi-pod burst kernel dispatches (config batch_requests: "
                 "one dispatch pre-evaluates up to K pending pods)",
