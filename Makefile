@@ -8,7 +8,7 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test native bench smoke demo soak image push format clean
+.PHONY: all test native bench smoke chaos demo soak image push format clean
 
 all: native test
 
@@ -25,6 +25,16 @@ bench: native
 # burst+gang hot-path rate without the full bench's minutes of scenarios.
 smoke:
 	$(PY) bench.py --smoke
+
+# Fault-injection suite (fixed seed, replayable): gang bind rollback,
+# transient-error retry, dispatch fallback chain, leader fencing, and the
+# seeded stress sweep — tests/test_chaos.py, slow tests included. The fast
+# chaos tests also run in tier-1 (`make test` / the default gate), so
+# rollback-path regressions fail CI without this target; this target adds
+# the stress sweep. Override the sweep seed via CHAOS_SEED (the test reads
+# its default from the source; the seed is printed on failure for replay).
+chaos:
+	$(PY) -m pytest tests/test_chaos.py -q
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

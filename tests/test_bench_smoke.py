@@ -42,6 +42,23 @@ def test_multi_gang_contended_invariants():
     assert out["multi_gang_joint_parked"] == 0
 
 
+def test_degraded_chaos_scenario_invariants():
+    import bench
+
+    # The scenario asserts its own invariants inline (everything binds
+    # despite the seeded faults, no oversubscription); here we pin that
+    # the fault schedule actually engaged the recovery machinery.
+    out = bench._degraded_chaos_scenario(hosts=4, gangs=2, singles=8)
+    assert out["degraded_pods_per_s"] > 0
+    assert out["degraded_faults_fired"] > 0
+    assert (
+        out["degraded_bind_retries"]
+        + out["degraded_gang_rollbacks"]
+        + out["degraded_dispatch_fallbacks"]
+        > 0
+    )
+
+
 def test_smoke_mode_runs_reduced_fleet():
     import bench
 

@@ -1,0 +1,442 @@
+"""Failure-domain hardening under injected faults (the chaos harness).
+
+Every test here is DETERMINISTIC: faults fire on scheduled invocation
+indices (or from a seeded plan), so a failure reproduces from the seed
+alone. The fast tests are tier-1 — regressions in the rollback, retry,
+fencing, and dispatch-fallback paths fail CI immediately; the seeded
+stress sweep is slow-marked (`make chaos` runs the whole file).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig, Weights
+from yoda_tpu.plugins.yoda.binder import ClusterBinder
+from yoda_tpu.standalone import build_stack
+from yoda_tpu.testing.chaos import (
+    ChaosApiError,
+    ChaosCluster,
+    ChaosPlan,
+    ChaosTimeout,
+    FaultSpec,
+    install_chaos_kernel,
+)
+
+
+def gang_pods(name, n, chips=4):
+    labels = {
+        "tpu/gang": name,
+        "tpu/gang-size": str(n),
+        "tpu/chips": str(chips),
+    }
+    return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+def make_chaos_stack(plan, *, hosts=4, chips=4, **cfg):
+    cluster = ChaosCluster(plan=plan)
+    stack = build_stack(
+        cluster=cluster, config=SchedulerConfig(mode="batch", **cfg)
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(hosts):
+        agent.add_host(f"host-{i}", generation="v5p", chips=chips)
+    agent.publish_all()
+    return stack, agent
+
+
+def bound_pods(stack):
+    return {p.name: p.node_name for p in stack.cluster.list_pods() if p.node_name}
+
+
+def the_binder(stack) -> ClusterBinder:
+    return next(
+        p for p in stack.framework.bind_plugins if isinstance(p, ClusterBinder)
+    )
+
+
+def assert_no_leaked_reservations(stack):
+    """The accountant must hold exactly the bound pods' claims — a leaked
+    reservation (a rolled-back member still charged) shows up as a node
+    whose in-use count exceeds its bound pods' chips."""
+    expected: dict[str, int] = {}
+    for p in stack.cluster.list_pods():
+        if p.node_name:
+            expected[p.node_name] = expected.get(p.node_name, 0) + int(
+                p.labels.get("tpu/chips", "1")
+            )
+    actual = {n: c for n, c in stack.accountant.chips_by_node().items() if c}
+    assert actual == expected, (actual, expected)
+
+
+class TestChaosPlan:
+    def test_seeded_plan_is_replayable(self):
+        a = ChaosPlan.seeded(1234, ops=("bind", "dispatch"), horizon=30)
+        b = ChaosPlan.seeded(1234, ops=("bind", "dispatch"), horizon=30)
+        assert a.faults == b.faults
+        assert a.faults  # rate 0.2 over 60 draws: statistically certain
+        c = ChaosPlan.seeded(1235, ops=("bind", "dispatch"), horizon=30)
+        assert a.faults != c.faults
+
+    def test_fired_records_replay_script(self):
+        plan = ChaosPlan([FaultSpec("bind", 1, "conflict", count=2)])
+        assert plan.next("bind") is None
+        assert plan.next("bind").kind == "conflict"
+        assert plan.next("bind").kind == "conflict"
+        assert plan.next("bind") is None
+        assert plan.fired == [("bind", 1, "conflict"), ("bind", 2, "conflict")]
+
+    def test_classification_of_injected_errors(self):
+        from yoda_tpu.cluster.retry import retryable_api_error
+
+        assert retryable_api_error(ChaosApiError(409, "x"))
+        assert retryable_api_error(ChaosTimeout("x"))
+        assert not retryable_api_error(ValueError("already bound to host-1"))
+        # Wrapped causes classify by their root (KubeCluster wraps
+        # KubeApiError in ValueError).
+        wrapped = ValueError("binding p -> n")
+        wrapped.__cause__ = ChaosApiError(429, "slow down")
+        assert retryable_api_error(wrapped)
+
+
+class TestBindRetry:
+    def test_transient_conflict_retried_transparently(self):
+        # One injected 409 on the first bind: the binder's jittered retry
+        # absorbs it and the pod binds — no scheduling failure surfaces.
+        plan = ChaosPlan([FaultSpec("bind", 0, "conflict")])
+        stack, _ = make_chaos_stack(plan, hosts=1)
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert bound_pods(stack) == {"solo": "host-0"}
+        assert the_binder(stack).retries == 1
+        rendered = stack.metrics.registry.render_prometheus()
+        assert "yoda_recovery_bind_retries_total" in rendered
+
+    def test_exhausted_retries_fail_genuinely(self):
+        # More consecutive conflicts than the retry budget: the bind is a
+        # genuine failure and the pod requeues (then succeeds once the
+        # fault window passes).
+        plan = ChaosPlan([FaultSpec("bind", 0, "timeout", count=4)])
+        stack, _ = make_chaos_stack(plan, hosts=1)
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        assert bound_pods(stack) == {"solo": "host-0"}
+        assert_no_leaked_reservations(stack)
+
+    def test_backoff_policy_is_seeded_and_bounded(self):
+        from yoda_tpu.cluster.retry import BackoffPolicy
+
+        policy = BackoffPolicy(attempts=3, base_s=0.05, cap_s=0.2)
+        rng_a, rng_b = random.Random(7), random.Random(7)
+        delays_a = [policy.delay_s(k, rng_a) for k in range(4)]
+        delays_b = [policy.delay_s(k, rng_b) for k in range(4)]
+        assert delays_a == delays_b  # deterministic under a seed
+        assert all(0.0 <= d <= 0.2 for d in delays_a)
+
+
+class TestGangBindRollback:
+    def test_mid_gang_bind_failure_rolls_back_everything(self):
+        # The acceptance invariant: a mid-gang bind failure (every bind
+        # from invocation 2 onward fails; retry disabled) leaves ZERO
+        # members bound and ZERO leaked chip reservations.
+        plan = ChaosPlan([FaultSpec("bind", 2, "conflict", count=200)])
+        stack, _ = make_chaos_stack(plan, bind_retry_attempts=0)
+        for pod in gang_pods("job-r", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        assert bound_pods(stack) == {}, "partially-bound gang survived"
+        assert all(
+            c == 0 for c in stack.accountant.chips_by_node().values()
+        ), stack.accountant.chips_by_node()
+        assert stack.gang.gang_status("job-r") in ((4, 0, 0), None)
+        assert stack.gang.bind_rollbacks >= 1
+        assert stack.metrics.recovery_rollbacks.total() >= 1
+        assert the_binder(stack).unbinds == 2  # both landed binds reversed
+
+    def test_gang_recovers_whole_after_transient_rollback(self):
+        # One hard bind failure mid-release: the gang rolls back whole,
+        # requeues untouched, and the next pass binds all-or-nothing.
+        plan = ChaosPlan([FaultSpec("bind", 2, "conflict")])
+        stack, _ = make_chaos_stack(plan, bind_retry_attempts=0)
+        for pod in gang_pods("job-t", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        assert len(bound_pods(stack)) == 4
+        assert stack.gang.gang_status("job-t") == (4, 0, 4)
+        assert stack.gang.bind_rollbacks == 1
+        assert_no_leaked_reservations(stack)
+
+    def test_unbind_failure_does_not_leak_reservations(self):
+        # The rollback's own unbind hits a transient timeout: the binder
+        # retries it; accounting still ends clean.
+        plan = ChaosPlan(
+            [
+                FaultSpec("bind", 2, "conflict"),
+                FaultSpec("unbind", 0, "timeout"),
+            ]
+        )
+        stack, _ = make_chaos_stack(plan, bind_retry_attempts=0)
+        for pod in gang_pods("job-u", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        assert len(bound_pods(stack)) == 4
+        assert_no_leaked_reservations(stack)
+
+
+class TestDispatchFallback:
+    def _warmed_stack(self, hosts=2):
+        stack, agent = make_chaos_stack(ChaosPlan(), hosts=hosts)
+        stack.cluster.create_pod(PodSpec("warmup", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert "warmup" in bound_pods(stack)
+        return stack
+
+    def test_dispatch_exception_falls_back_and_completes_pass(self):
+        # The acceptance invariant: an injected kernel dispatch exception
+        # demotes to the XLA host kernel, the scheduling pass completes,
+        # and yoda_dispatch_fallback_total increments.
+        stack = self._warmed_stack()
+        batch = stack.framework.batch_plugins[0]
+        plan = ChaosPlan([FaultSpec("dispatch", 0, "error")])
+        install_chaos_kernel(batch, plan)
+        stack.cluster.create_pod(PodSpec("after", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert "after" in bound_pods(stack)
+        assert batch.dispatch_errors >= 1
+        assert batch.dispatch_fallbacks >= 1
+        rendered = stack.metrics.registry.render_prometheus()
+        fallback_line = [
+            ln
+            for ln in rendered.splitlines()
+            if ln.startswith("yoda_dispatch_fallback_total")
+        ][0]
+        assert float(fallback_line.split()[-1]) >= 1.0
+
+    def test_circuit_breaker_pins_backend_down(self):
+        stack = self._warmed_stack()
+        batch = stack.framework.batch_plugins[0]
+        plan = ChaosPlan([FaultSpec("dispatch", 0, "error", count=100)])
+        install_chaos_kernel(batch, plan)
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", labels={"tpu/chips": "1"})
+            )
+            stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_pods(stack)) == 5  # warmup + 4, all served demoted
+        assert batch.backend_level == 1, "breaker should pin below primary"
+        # Pinned: the broken primary is no longer probed per dispatch.
+        probes_when_pinned = plan.invocations("dispatch")
+        stack.cluster.create_pod(PodSpec("p-last", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert "p-last" in bound_pods(stack)
+        assert plan.invocations("dispatch") == probes_when_pinned
+
+    def test_pallas_primary_demotes_to_xla_host(self):
+        # kernel_backend=pallas builds its kernel eagerly, so the chaos
+        # wrapper installs without a warmup; a dispatch fault there must
+        # demote to the XLA host kernel and still bind the pod.
+        plan = ChaosPlan([FaultSpec("dispatch", 0, "error")])
+        stack, _ = make_chaos_stack(ChaosPlan(), hosts=1, kernel_backend="pallas")
+        batch = stack.framework.batch_plugins[0]
+        install_chaos_kernel(batch, plan)
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=20)
+        assert bound_pods(stack) == {"solo": "host-0"}
+        assert batch.dispatch_fallbacks >= 1
+
+    def test_numpy_evaluator_matches_xla_kernel(self):
+        # The last fallback rung must agree with the device kernel, or
+        # degraded mode would change placement decisions.
+        import jax
+
+        from yoda_tpu.ops.arrays import FleetArrays
+        from yoda_tpu.ops.kernel import (
+            DeviceFleetKernel,
+            KernelRequest,
+            NumpyFleetKernel,
+        )
+
+        stack, agent = make_chaos_stack(ChaosPlan(), hosts=5, chips=8)
+        snapshot = stack.informer.snapshot()
+        static = FleetArrays.from_snapshot(snapshot)
+        dyn = static.dyn_packed(None, None)
+        dk = DeviceFleetKernel(Weights(), device=jax.devices("cpu")[0])
+        nk = NumpyFleetKernel(Weights())
+        dk.put_static(static)
+        nk.put_static(static)
+        for req in (
+            KernelRequest(1, 0, 0, 0, 0),
+            KernelRequest(4, 1024, 900, 0, 0),
+            KernelRequest(8, 16 << 10, 0, 1, 1),
+        ):
+            a, b = dk.evaluate(dyn, req), nk.evaluate(dyn, req)
+            np.testing.assert_array_equal(a.feasible, b.feasible)
+            np.testing.assert_array_equal(a.reasons, b.reasons)
+            np.testing.assert_array_equal(a.scores, b.scores)
+            np.testing.assert_array_equal(a.claimable, b.claimable)
+            assert a.best_index == b.best_index
+
+
+class TestLeaderFencing:
+    def test_fenced_bind_aborts_before_api_write(self):
+        stack, _ = make_chaos_stack(ChaosPlan(), hosts=1)
+        leading = [True]
+        stack.scheduler.fence_fn = lambda: leading[0]
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "2"}))
+        qpi = stack.queue.pop(timeout=2.0)
+        assert qpi is not None
+        leading[0] = False
+        res = stack.scheduler.schedule_one(qpi)
+        assert res.outcome == "unschedulable"
+        assert "fenced" in res.message
+        assert bound_pods(stack) == {}
+        assert all(
+            c == 0 for c in stack.accountant.chips_by_node().values()
+        )
+        assert stack.metrics.fenced_binds.total() == 1
+        # Leadership returns: the parked pod binds cleanly.
+        leading[0] = True
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert bound_pods(stack) == {"solo": "host-0"}
+
+    def test_fence_between_permit_release_and_bind_rolls_gang_back(self):
+        # The window the ISSUE names: members park at Permit while leader,
+        # leadership drops, the last member arrives — every released bind
+        # must abort BEFORE the API write and the gang must roll back.
+        stack, _ = make_chaos_stack(ChaosPlan())
+        leading = [True]
+        stack.scheduler.fence_fn = lambda: leading[0]
+        pods = gang_pods("job-f", 4, chips=4)
+        for pod in pods:
+            stack.cluster.create_pod(pod)
+        qpis = [stack.queue.pop(timeout=2.0) for _ in range(4)]
+        assert all(q is not None for q in qpis)
+        for q in qpis[:3]:
+            assert stack.scheduler.schedule_one(q).outcome == "waiting"
+        leading[0] = False  # lost the lease while the gang was parked
+        stack.scheduler.schedule_one(qpis[3])
+        assert bound_pods(stack) == {}, "a fenced bind reached the API"
+        assert all(
+            c == 0 for c in stack.accountant.chips_by_node().values()
+        )
+        assert stack.metrics.fenced_binds.total() >= 1
+        leading[0] = True
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        assert len(bound_pods(stack)) == 4
+        assert_no_leaked_reservations(stack)
+
+    def test_serve_forever_parks_queue_while_fenced(self):
+        import threading
+        import time
+
+        stack, _ = make_chaos_stack(ChaosPlan(), hosts=1)
+        leading = [False]
+        stack.scheduler.fence_fn = lambda: leading[0]
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "2"}))
+        stop = threading.Event()
+        t = threading.Thread(
+            target=stack.scheduler.serve_forever,
+            args=(stop,),
+            kwargs={"poll_s": 0.02},
+            daemon=True,
+        )
+        t.start()
+        try:
+            time.sleep(0.3)
+            assert bound_pods(stack) == {}  # parked, not scheduled
+            leading[0] = True
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not bound_pods(stack):
+                time.sleep(0.02)
+            assert bound_pods(stack) == {"solo": "host-0"}
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+class TestMetricStaleness:
+    def test_stale_publish_parks_then_fresh_publish_recovers(self):
+        # An injected agent staleness fault (backdated CR) must park the
+        # pod on the freshness gate, not bind onto dead metrics; the next
+        # healthy publish reactivates and binds it.
+        plan = ChaosPlan([FaultSpec("metrics", 0, "stale")])
+        cluster = ChaosCluster(plan=plan)
+        stack = build_stack(
+            cluster=cluster,
+            config=SchedulerConfig(mode="batch", max_metrics_age_s=60.0),
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host-0", generation="v5p", chips=4)
+        agent.publish_all()  # faulted: lands backdated -> stale
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert bound_pods(stack) == {}
+        agent.publish_all()  # healthy republish: fresh again
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert bound_pods(stack) == {"solo": "host-0"}
+
+
+@pytest.mark.slow
+class TestChaosStress:
+    def test_joint_placement_invariants_under_seeded_chaos(self):
+        # The standing invariants — no oversubscription, no partially
+        # bound gangs, no leaked reservations — asserted after EVERY
+        # drain while a seeded plan injects bind conflicts/timeouts and
+        # kernel dispatch failures across waves of contending gangs.
+        # CHAOS_SEED overrides the fixed default (`make chaos`); the seed
+        # is in the failure message, so a red run replays from the log.
+        import os
+
+        seed = int(os.environ.get("CHAOS_SEED", "20260804"))
+        plan = ChaosPlan.seeded(
+            seed, ops=("bind", "dispatch"), horizon=120, rate=0.25
+        )
+        stack, agent = make_chaos_stack(
+            plan, hosts=8, chips=8, batch_requests=4, bind_retry_attempts=1
+        )
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        batch = stack.framework.batch_plugins[0]
+        install_chaos_kernel(batch, plan)
+
+        def check_invariants():
+            snapshot = stack.informer.snapshot()
+            for ni in snapshot.infos():
+                cap = len(ni.tpu.chips) if ni.tpu else 0
+                used = stack.accountant.chips_in_use(ni.name)
+                assert used <= cap, f"{ni.name} oversubscribed: {used}/{cap}"
+            if stack.framework.waiting_pods():
+                # Members parked at Permit legitimately hold reservations
+                # and partial bound counts; the settled-state invariants
+                # below apply only between releases.
+                return
+            for g in range(6):
+                st = stack.gang.gang_status(f"wave-{g}")
+                if st is not None:
+                    size, _waiting, bound = st
+                    assert bound in (0, size), (
+                        f"wave-{g} partially bound: {st}"
+                    )
+            assert_no_leaked_reservations(stack)
+
+        for g in range(6):
+            for pod in gang_pods(f"wave-{g}", 4, chips=2):
+                stack.cluster.create_pod(pod)
+            stack.scheduler.run_until_idle(max_wall_s=20)
+            check_invariants()
+        # Whatever the fault schedule did, the cluster must converge once
+        # the horizon passes: drain until every gang is fully bound.
+        for _ in range(6):
+            if len(bound_pods(stack)) == 25:  # warm + 6 gangs x 4
+                break
+            stack.scheduler.run_until_idle(max_wall_s=20)
+        check_invariants()
+        assert len(bound_pods(stack)) == 25, (
+            f"seed {seed}: converged to {len(bound_pods(stack))} bound; "
+            f"fired={plan.fired}"
+        )

@@ -158,6 +158,71 @@ class TestKubeCluster:
             msg="post-compaction relist reconciliation",
         )
 
+    def test_http_410_relists_immediately_without_backoff(self):
+        """A watch REQUEST answered with HTTP 410 (not an in-band ERROR
+        event) must trigger an immediate full relist-and-resync — the
+        stored resourceVersion is stale, and the generic error backoff
+        would only widen the blind window."""
+        relists = []
+        watch_calls = []
+
+        class Stub410Api:
+            def request(self, method, path, **kw):
+                relists.append(path)
+                return {"items": [], "metadata": {"resourceVersion": "5"}}
+
+            def watch(self, path, *, params=None):
+                watch_calls.append(dict(params or {}))
+                if len(watch_calls) == 1:
+                    raise KubeApiError(410, "Expired")
+                time.sleep(0.05)  # orderly empty stream, then re-watch
+                return iter(())
+
+        # backoff_initial_s of 5 s proves the point: if the 410 went
+        # through the generic backoff path, the relist below could not
+        # land within the 2 s window.
+        kc = KubeCluster(Stub410Api(), backoff_initial_s=5.0, kinds=("Pod",))
+        kc.start()
+        try:
+            wait_until(
+                lambda: len(relists) >= 2,
+                timeout_s=2.0,
+                msg="immediate relist after HTTP 410",
+            )
+        finally:
+            kc.stop()
+
+    def test_http_410_on_expired_watch_reconciles(self, server):
+        """With the fake server answering expired fresh watches with an
+        HTTP 410 status (some API-server paths do), the client still
+        reconciles after compaction — whichever of the in-band or
+        HTTP-level 410 paths the timing lands on, both relist."""
+        server.state.http_410_on_expired = True
+        api = KubeApiClient(
+            KubeApiConfig(base_url=server.base_url, watch_timeout_s=1)
+        )
+        kc = KubeCluster(api, backoff_initial_s=0.05, backoff_max_s=0.2)
+        kc.start()
+        try:
+            assert kc.wait_for_sync(10.0)
+            server.put_object("Pod", "default/seed", PodSpec("seed").to_obj())
+            wait_until(
+                lambda: kc.get_pod("default/seed") is not None, msg="seed"
+            )
+            server.compact()
+            server.put_object(
+                "Pod", "default/after", PodSpec("after").to_obj()
+            )
+            server.delete_object("Pod", "default/seed")
+            wait_until(
+                lambda: kc.get_pod("default/after") is not None
+                and kc.get_pod("default/seed") is None,
+                timeout_s=15.0,
+                msg="post-compaction reconciliation under HTTP-410 mode",
+            )
+        finally:
+            kc.stop()
+
     def test_relist_diff_emits_events(self, server):
         """Deletions that happen while the client is disconnected surface as
         'deleted' events from the relist diff (informer accounting depends

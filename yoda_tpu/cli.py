@@ -124,6 +124,13 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 namespace=args.lease_namespace,
                 name=args.lease_name,
             )
+            # Leader fencing: every scheduler checks the lease BEFORE each
+            # bind API write and parks its queue while not leading — the
+            # exit-on-loss below is seconds-grained, and an in-flight
+            # permit release in that window must not race the new leader's
+            # binds.
+            for st in stacks:
+                st.scheduler.fence_fn = elector.is_leader
             became_leader = threading.Event()
 
             def _on_lost() -> None:

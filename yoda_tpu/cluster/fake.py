@@ -107,6 +107,18 @@ class FakeCluster:
             pod.phase = "Running"
             self._emit(Event("modified", "Pod", pod))
 
+    def unbind_pod(self, pod_key: str, node_name: str) -> None:
+        """Reverse a binding (gang transactional rollback). Only the named
+        node's binding is cleared — a pod re-bound elsewhere concurrently
+        is left alone. Missing pods are a no-op (deleted mid-rollback)."""
+        with self._lock:
+            pod = self._pods.get(pod_key)
+            if pod is None or pod.node_name != node_name:
+                return
+            pod.node_name = None
+            pod.phase = "Pending"
+            self._emit(Event("modified", "Pod", pod))
+
     def update_pod(self, pod: PodSpec) -> None:
         """Replace an existing pod's spec (e.g. a controller clearing
         spec.schedulingGates) and emit the modified event. Object identity

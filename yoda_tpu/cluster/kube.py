@@ -609,6 +609,18 @@ class KubeCluster:
             except Exception as e:
                 if self._stop.is_set():
                     return
+                if isinstance(e, KubeApiError) and e.status == 410:
+                    # Resume window gone (the server answered the watch
+                    # request itself with 410, not an in-band ERROR event):
+                    # the stored resourceVersion is stale, so relist NOW —
+                    # a full list-and-resync reconciles the store and
+                    # replays the diff as events. Backing off here would
+                    # only widen the blind window; this is not an outage.
+                    log.warning(
+                        "watch %s: resume window expired (410 Gone); "
+                        "relisting immediately", target.kind,
+                    )
+                    continue
                 if (
                     target.optional
                     and isinstance(e, KubeApiError)
@@ -726,6 +738,15 @@ class KubeCluster:
         except KubeApiError as e:
             if e.status != 404:
                 raise
+
+    def unbind_pod(self, pod_key: str, node_name: str) -> None:
+        """Gang transactional rollback against a real API server: a bound
+        pod cannot be un-bound (spec.nodeName is immutable once set), so
+        the rollback deletes the pod and its controller (Job/Deployment)
+        recreates a fresh unbound replica — the same remediation
+        coscheduling operators apply to partially-bound gangs. An
+        already-gone pod counts as rolled back (delete_pod's 404 path)."""
+        self.delete_pod(pod_key)
 
     def set_nominated_node(self, pod_key: str, node_name: str | None) -> None:
         """PATCH status.nominatedNodeName (merge-patch on pods/status) —

@@ -120,6 +120,15 @@ class SchedulerConfig:
     # amortizing the fleet scan and the dispatch floor across pods. 1 =
     # one dispatch per pod (the pre-r4 behavior). Batch mode only.
     batch_requests: int = 1
+    # Transient-error bind retry (failure-domain hardening): a bind that
+    # fails with a retryable error (409 conflict, 429 throttle, 5xx,
+    # socket timeout — cluster.retry classification) is retried up to
+    # this many times with full-jitter exponential backoff (base
+    # doubling, capped) before it becomes a scheduling failure and, for
+    # gang members, a transactional rollback. 0 disables retry.
+    bind_retry_attempts: int = 3
+    bind_retry_base_s: float = 0.05
+    bind_retry_cap_s: float = 1.0
     # Cluster events retry a parked pod immediately through this many
     # scheduling attempts; beyond it the pod's exponential backoff timer
     # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue

@@ -383,3 +383,17 @@ class Framework:
             if st.code != Code.SKIP:
                 return st
         return Status.error(f"no bind plugin bound pod {pod.key}")
+
+    def run_unbind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        """Reverse a landed bind (transactional gang rollback): the first
+        bind plugin implementing ``unbind`` handles it. An error status —
+        including no plugin implementing it — means the pod may be
+        stranded bound; the caller logs it and the watch stream remains
+        the source of truth."""
+        for p in self.bind_plugins:
+            unbind = getattr(p, "unbind", None)
+            if unbind is not None:
+                st = unbind(state, pod, node_name)
+                if st.code != Code.SKIP:
+                    return st
+        return Status.error(f"no bind plugin can unbind pod {pod.key}")

@@ -79,6 +79,7 @@ class Scheduler:
         percentage_nodes_to_score: int = 100,
         pod_alive: Callable[[PodSpec], bool] | None = None,
         burst_size: int = 1,
+        fence_fn: "Callable[[], bool] | None" = None,
     ) -> None:
         self.framework = framework
         self.snapshot_fn = snapshot_fn
@@ -123,6 +124,12 @@ class Scheduler:
         self._activity = threading.Condition()
         self._activity_seq = 0
         queue.on_activity = self._signal_activity
+        # Leader fencing (failure-domain hardening): when wired (cli.py
+        # passes LeaderElector.is_leader), a False return FENCES the
+        # scheduler — binds are aborted BEFORE the API write (a new leader
+        # may already be acting on the same pods) and the serve loop parks
+        # the queue until leadership returns. Settable post-construction.
+        self.fence_fn = fence_fn
         self._search_rotor = 0
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
@@ -130,6 +137,19 @@ class Scheduler:
         # capacity otherwise). Entries drop on bind or deletion.
         self._nominated: dict[str, str] = {}
         self._lock = threading.Lock()
+
+    def _fenced(self) -> bool:
+        """True when a leader gate is wired and this process does NOT hold
+        leadership right now: no bind may hit the API. A raising fence
+        check counts as fenced — fail closed."""
+        fn = self.fence_fn
+        if fn is None:
+            return False
+        try:
+            return not fn()
+        except Exception:  # noqa: BLE001 — fail closed
+            log.exception("fence check failed; treating scheduler as fenced")
+            return True
 
     def _search_limit(self, n_nodes: int) -> int:
         """Upstream percentageOfNodesToScore, the SEARCH half: how many
@@ -272,6 +292,14 @@ class Scheduler:
                     self.queue.add_unschedulable(qpi, message)
                 if self.on_unschedulable:
                     self.on_unschedulable(pod, message)
+            elif outcome == "error":
+                # Errors are RETRYABLE, not terminal: a kernel-dispatch or
+                # plugin exception must not silently drop the pod from the
+                # queue (the pre-hardening behavior). The backoff ladder
+                # bounds the retry rate if the error is chronic.
+                self.queue.add_unschedulable(qpi, message)
+                if self.on_unschedulable:
+                    self.on_unschedulable(pod, message)
             elif outcome == "nominated":
                 # Preemption made room; victims must terminate before the pod
                 # fits, so requeue and let the next cycle place it. The
@@ -330,7 +358,20 @@ class Scheduler:
 
         # Fused batch filter+score (TPU-native hot path), else per-node loops.
         with timer.span("filter"):
-            batch = self.framework.run_batch_filter_score(state, pod, snapshot)
+            try:
+                batch = self.framework.run_batch_filter_score(
+                    state, pod, snapshot
+                )
+            except Exception as e:  # noqa: BLE001 — keep the loop serving
+                # The batch plugin's own fallback chain (YodaBatch._dispatch)
+                # already demoted through every kernel backend; reaching
+                # here means even the host evaluator failed. The pod
+                # retries via the error path; the loop survives.
+                log.exception(
+                    "batch filter/score failed for %s; retrying via backoff",
+                    pod.key,
+                )
+                return done("error", message=f"batch filter/score failed: {e}")
             if batch is not None:
                 statuses, batch_scores = batch
                 feasible = sorted(batch_scores)
@@ -434,6 +475,19 @@ class Scheduler:
         return self._bind(state, qpi, pod, best, done)
 
     def _bind(self, state, qpi, pod, node_name, done) -> ScheduleResult:
+        if self._fenced():
+            # Leader fencing: abort BEFORE the API write. The reservation
+            # rolls back and the pod requeues; the new leader (or this
+            # process after re-acquiring) schedules it cleanly.
+            if self.metrics is not None:
+                self.metrics.fenced_binds.inc()
+            self.framework.run_unreserve(state, pod, node_name)
+            return done(
+                "unschedulable",
+                node=node_name,
+                message="scheduler fenced (not leader); bind aborted before "
+                "the API write",
+            )
         st = self.framework.run_bind(state, pod, node_name)
         if not st.success:
             self.framework.run_unreserve(state, pod, node_name)
@@ -481,8 +535,32 @@ class Scheduler:
         if self.metrics is not None and wp.parked_at is not None:
             self.metrics.gang_wait.observe(max(self.clock() - wp.parked_at, 0.0))
         if status.success:
-            st = self.framework.run_bind(wp.state, pod, wp.node_name)
+            if self._fenced():
+                # Leader fencing between permit release and bind: the one
+                # window nothing used to check. Abort before the API write;
+                # the gang rolls back transactionally below, exactly as a
+                # bind failure would.
+                if self.metrics is not None:
+                    self.metrics.fenced_binds.inc()
+                st = Status.unschedulable(
+                    "scheduler fenced (lost leadership); bind aborted "
+                    "before the API write"
+                )
+            else:
+                st = self.framework.run_bind(wp.state, pod, wp.node_name)
             if st.success:
+                if not self._confirm_bound(wp):
+                    # The gang began a bind-failure rollback while this
+                    # member's bind was in flight (parallel release): the
+                    # landed bind is unwound, not celebrated.
+                    self._rollback_bound(
+                        pod,
+                        wp.node_name,
+                        wp.state,
+                        "gang rolled back while this member's bind was in "
+                        "flight",
+                    )
+                    return
                 log.info("bound %s -> %s (permit released)", pod.key, wp.node_name)
                 with self._lock:
                     self.stats.binds += 1
@@ -493,6 +571,7 @@ class Scheduler:
                 self._clear_stale_nomination(pod, wp.node_name)
                 self.queue.move_all_to_active()
                 return
+            self._handle_bind_failure(wp, st)
             status = st
         log.info(
             "permit rejected %s on %s: %s", pod.key, wp.node_name, status.message
@@ -501,6 +580,76 @@ class Scheduler:
         self.queue.add_unschedulable(QueuedPodInfo(pod=pod), status.message)
         if self.on_unschedulable:
             self.on_unschedulable(pod, status.message)
+
+    def _confirm_bound(self, wp: WaitingPod) -> bool:
+        """Let Permit plugins observe a landed permit-release bind
+        (transactional gang bookkeeping). Any False verdict means the bind
+        must be rolled back — the gang failed while this bind was in
+        flight."""
+        keep = True
+        for p in self.framework.permit_plugins:
+            hook = getattr(p, "on_pod_bound", None)
+            if hook is not None and not hook(self.framework, wp):
+                keep = False
+        return keep
+
+    def _handle_bind_failure(self, wp: WaitingPod, st: Status) -> None:
+        """A permit-released bind failed after the binder's transient
+        retries (or was fenced): give Permit plugins the chance to make
+        the failure TRANSACTIONAL — the gang plugin rejects still-waiting
+        members and returns the siblings whose binds already landed, which
+        are unbound, unreserved, and requeued here. The failing member
+        itself goes through the caller's standard rejection path."""
+        rollbacks: list = []
+        initiated = False
+        for p in self.framework.permit_plugins:
+            hook = getattr(p, "on_bind_failed", None)
+            if hook is None:
+                continue
+            got = hook(self.framework, wp, st)
+            if got is None:
+                continue
+            initiated = True
+            rollbacks.extend(got)
+        if initiated:
+            if self.metrics is not None:
+                self.metrics.recovery_rollbacks.inc()
+            for spec, node in rollbacks:
+                self._rollback_bound(
+                    spec, node, None, f"gang rollback: {st.message}"
+                )
+
+    def _rollback_bound(
+        self, pod: PodSpec, node_name: str, state, why: str
+    ) -> None:
+        """Undo a LANDED bind (transactional gang rollback): unbind via the
+        bind plugins, release the reservation, requeue the pod untouched.
+        An unbind the backend cannot perform is logged — the watch stream
+        stays the source of truth and the pod re-admits via the gang's
+        self-heal on its next cycle."""
+        state = state if state is not None else CycleState()
+        st = self.framework.run_unbind(state, pod, node_name)
+        if not st.success:
+            # The pod REMAINS bound on the cluster: keep its reservation
+            # (a bound pod holds its chips) and restore its membership so
+            # the gang completes AROUND it when the rolled-back siblings
+            # requeue — forgetting a still-bound member would wedge the
+            # barrier on a ghost until the permit timeout, forever.
+            log.error(
+                "gang rollback could not unbind %s from %s (%s); pod "
+                "remains bound — restoring its gang membership",
+                pod.key, node_name, st.message,
+            )
+            for p in self.framework.permit_plugins:
+                hook = getattr(p, "on_unbind_failed", None)
+                if hook is not None:
+                    hook(self.framework, pod, node_name)
+            return
+        self.framework.run_unreserve(state, pod, node_name)
+        log.warning("rolled back bind of %s on %s: %s", pod.key, node_name, why)
+        self.queue.add_unschedulable(QueuedPodInfo(pod=pod), why)
+        if self.on_unschedulable:
+            self.on_unschedulable(pod, why)
 
     # --- the loop ---
 
@@ -659,7 +808,13 @@ class Scheduler:
                 seq = self._activity_seq  # pre-check capture: a resolution
                 # landing between the checks below and the wait bumps the
                 # seq and turns the wait into a no-op (no lost wakeup).
-            qpi = self.queue.pop(timeout=0.0)
+            if self._fenced():
+                # Leader fencing: park the queue — nothing is popped or
+                # bound while fenced. The drain's fixed-point checks below
+                # conclude quickly (no binds advance).
+                qpi = None
+            else:
+                qpi = self.queue.pop(timeout=0.0)
             if qpi is not None:
                 for q in self._pop_batch(qpi):
                     self.schedule_one(q)
@@ -702,6 +857,13 @@ class Scheduler:
         scheduled entry — pure overhead, since expiry resolution only needs
         to be poll_s-grained and each sweep walks the whole waitlist)."""
         while not stop.is_set():
+            if self._fenced():
+                # Leader fencing: park the queue until leadership returns.
+                # Permit expirations still sweep so parked gangs cannot
+                # hold reservations past their deadlines while fenced.
+                self.framework.expire_waiting(now=self.clock())
+                stop.wait(poll_s)
+                continue
             qpi = self.queue.pop(timeout=poll_s)
             if qpi is not None:
                 for q in self._pop_batch(qpi):

@@ -288,6 +288,23 @@ class SchedulingMetrics:
             "yoda_gang_wait_seconds",
             "Time gang members spend parked at Permit before bind/reject",
         )
+        # Failure-domain recovery (docs/OPERATIONS.md failure modes):
+        # rollbacks = transactional gang-bind rollbacks initiated (a
+        # member's bind failed after the binder's transient retries and
+        # the whole release cohort was unwound); fenced = binds aborted
+        # before the API write because the leader gate reported this
+        # process not leading.
+        self.recovery_rollbacks = r.counter(
+            "yoda_recovery_gang_rollbacks_total",
+            "Transactional gang bind rollbacks (a member's bind failure "
+            "unwound the whole release: landed binds unbound, waiting "
+            "members cascaded, reservations released)",
+        )
+        self.fenced_binds = r.counter(
+            "yoda_recovery_fenced_binds_total",
+            "Binds aborted before the API write because the scheduler was "
+            "fenced (leader gate reported not-leader)",
+        )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
 

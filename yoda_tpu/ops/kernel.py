@@ -150,22 +150,29 @@ class KernelResult:
     claimable: np.ndarray | None = None
 
 
-def _norm(metric: jnp.ndarray, maximum: jnp.ndarray) -> jnp.ndarray:
+def _norm(metric, maximum):
     return metric * 100 // maximum
 
 
 def kernel_impl(
-    a: dict, number, hbm_mib, clock_mhz, gen_rank, wants_topology, weights: Weights
+    a: dict, number, hbm_mib, clock_mhz, gen_rank, wants_topology, weights: Weights,
+    xp=jnp,
 ):
+    # ``xp`` selects the array namespace: jnp for the jitted XLA kernels,
+    # numpy for the host-side fallback evaluator (NumpyFleetKernel) — one
+    # body, so the dispatch fallback chain cannot drift from the device
+    # semantics. Only namespace-portable ops are used (clip-at-zero is
+    # spelled maximum(x, 0); numpy's clip signature differs across
+    # versions).
     healthy = a["chip_valid"] & a["chip_healthy"]
     hbm_ok = healthy & (a["hbm_free_mib"] >= hbm_mib)
     clock_ok = healthy & (a["clock_mhz"] >= clock_mhz)
     qual = hbm_ok & clock_ok
 
-    count_healthy = jnp.sum(healthy, axis=1)
-    count_hbm = jnp.sum(hbm_ok, axis=1)
-    count_clock = jnp.sum(clock_ok, axis=1)
-    count_qual = jnp.sum(qual, axis=1)
+    count_healthy = xp.sum(healthy, axis=1)
+    count_hbm = xp.sum(hbm_ok, axis=1)
+    count_clock = xp.sum(clock_ok, axis=1)
+    count_qual = xp.sum(qual, axis=1)
 
     # Predicate parity with plugins/yoda/filter_plugin.py (and reference
     # filter.go): the hbm/clock counts are independent; the reservation
@@ -175,16 +182,16 @@ def kernel_impl(
     # usage has no live claim behind it (freed by a delete/evict the agent
     # hasn't re-scraped — filter_plugin.stale_freed_chips) are added back
     # at full HBM, gated on qualifying-when-full.
-    apparently_used = jnp.sum(healthy & a["chip_used"], axis=1)
+    apparently_used = xp.sum(healthy & a["chip_used"], axis=1)
     # External-tenant chips (hardware-read usage no running pod explains —
     # api/types.py external_used_chips) are occupied-by-nobody: they absorb
     # no reservation (else a reservation on a genuinely-free chip would be
     # cancelled by a foreign tenant's usage and the node overcommits) and
     # they are never stale-freed (their usage is live truth, not a
     # deletion awaiting re-scrape).
-    absorbable = jnp.clip(apparently_used - a["ext_chips"], 0)
-    invisible = jnp.clip(a["reserved_chips"] - absorbable, 0)
-    stale_freed = jnp.clip(absorbable - a["reserved_chips"], 0)
+    absorbable = xp.maximum(apparently_used - a["ext_chips"], 0)
+    invisible = xp.maximum(a["reserved_chips"] - absorbable, 0)
+    stale_freed = xp.maximum(absorbable - a["reserved_chips"], 0)
     # WHICH used chips are free is unknown: worst case, the external
     # chips and remaining live claims sit on qualifying used chips first
     # (filter_plugin.stale_freed_chips parity). External-tenant chips are
@@ -195,18 +202,18 @@ def kernel_impl(
     # No-accounting callers neutralize both corrections by passing
     # reserved_chips == absorbable, i.e. apparently_used - ext_chips
     # (ops.arrays._neutral_reserved, used by dyn_packed / with_dynamic).
-    freed_candidates = jnp.sum(
+    freed_candidates = xp.sum(
         healthy
         & a["chip_used"]
         & (a["clock_mhz"] >= clock_mhz)
         & (a["hbm_total_mib"] >= hbm_mib),
         axis=1,
     )
-    freed_candidates = jnp.clip(freed_candidates - a["ext_chips"], 0)
-    freed = jnp.minimum(
-        stale_freed, jnp.clip(freed_candidates - a["reserved_chips"], 0)
+    freed_candidates = xp.maximum(freed_candidates - a["ext_chips"], 0)
+    freed = xp.minimum(
+        stale_freed, xp.maximum(freed_candidates - a["reserved_chips"], 0)
     )
-    count_avail = jnp.sum(qual & ~a["chip_used"], axis=1)
+    count_avail = xp.sum(qual & ~a["chip_used"], axis=1)
     fits_chips = count_healthy >= number
     fits_hbm = (hbm_mib == 0) | ((count_hbm + freed) >= number)
     fits_clock = (clock_mhz == 0) | (count_clock >= number)
@@ -225,7 +232,7 @@ def kernel_impl(
     )
 
     # First failing predicate, in the same order the Python filter checks.
-    reasons = jnp.select(
+    reasons = xp.select(
         [
             ~a["node_valid"],
             ~a["host_ok"],
@@ -247,13 +254,13 @@ def kernel_impl(
             REASON_RESERVED,
         ],
         REASON_OK,
-    ).astype(jnp.int32)
+    ).astype(xp.int32)
 
     # --- collection: maxima over feasible nodes' qualifying chips ---
     cmask = feasible[:, None] & qual
 
     def masked_max(x):
-        return jnp.maximum(jnp.max(jnp.where(cmask, x, 0)), 1)
+        return xp.maximum(xp.max(xp.where(cmask, x, 0)), 1)
 
     max_bw = masked_max(a["hbm_bandwidth"])
     max_clock = masked_max(a["clock_mhz"])
@@ -272,41 +279,41 @@ def kernel_impl(
         + _norm(a["hbm_free_mib"], max_free) * w.hbm_free
         + _norm(a["hbm_total_mib"], max_total) * w.hbm_total
     )
-    basic = jnp.sum(jnp.where(qual, chip_scores, 0), axis=1)
+    basic = xp.sum(xp.where(qual, chip_scores, 0), axis=1)
 
-    free_sum = jnp.sum(jnp.where(a["chip_valid"], a["hbm_free_mib"], 0), axis=1)
-    total_sum = jnp.sum(jnp.where(a["chip_valid"], a["hbm_total_mib"], 0), axis=1)
-    safe_total = jnp.maximum(total_sum, 1)
-    actual = jnp.where(total_sum > 0, free_sum * 100 // safe_total, 0) * w.actual
-    headroom = jnp.clip(total_sum - a["claimed_hbm_mib"], 0)
+    free_sum = xp.sum(xp.where(a["chip_valid"], a["hbm_free_mib"], 0), axis=1)
+    total_sum = xp.sum(xp.where(a["chip_valid"], a["hbm_total_mib"], 0), axis=1)
+    safe_total = xp.maximum(total_sum, 1)
+    actual = xp.where(total_sum > 0, free_sum * 100 // safe_total, 0) * w.actual
+    headroom = xp.maximum(total_sum - a["claimed_hbm_mib"], 0)
     allocate = (
-        jnp.where(total_sum > 0, headroom * 100 // safe_total, 0) * w.allocate
+        xp.where(total_sum > 0, headroom * 100 // safe_total, 0) * w.allocate
     )
 
-    raw = jnp.where(feasible, basic + actual + allocate, 0).astype(jnp.int32)
+    raw = xp.where(feasible, basic + actual + allocate, 0).astype(xp.int32)
 
     # --- normalize (min-max to [0,100], all-equal guard) ---
     # Fillers must sit outside BOTH reductions' ranges: raw scores can be
     # negative under most-allocated's negated weights, so the `highest`
     # filler is -big, not -1 (a -1 filler would beat an all-negative
     # feasible set and crush the span).
-    big = jnp.iinfo(jnp.int32).max
-    lowest = jnp.min(jnp.where(feasible, raw, big))
-    highest = jnp.max(jnp.where(feasible, raw, -big))
-    lowest = jnp.where(highest == lowest, lowest - 1, lowest)
-    span = jnp.maximum(highest - lowest, 1)
-    normalized = jnp.where(feasible, (raw - lowest) * 100 // span, 0).astype(jnp.int32)
+    big = xp.iinfo(xp.int32).max
+    lowest = xp.min(xp.where(feasible, raw, big))
+    highest = xp.max(xp.where(feasible, raw, -big))
+    lowest = xp.where(highest == lowest, lowest - 1, lowest)
+    span = xp.maximum(highest - lowest, 1)
+    normalized = xp.where(feasible, (raw - lowest) * 100 // span, 0).astype(xp.int32)
 
     # Anti-fragmentation tier (config.SLICE_PROTECT_TIER): added AFTER
     # normalization so the tier dominates without crushing within-tier
     # metric resolution. Non-topology pods strictly prefer hosts outside
     # multi-host ICI slices.
-    protect = jnp.where(
+    protect = xp.where(
         (wants_topology == 0) & ~a["in_slice"],
         SLICE_PROTECT_TIER * w.slice_protect,
         0,
-    ).astype(jnp.int32)
-    final = jnp.where(feasible, normalized + protect, 0).astype(jnp.int32)
+    ).astype(xp.int32)
+    final = xp.where(feasible, normalized + protect, 0).astype(xp.int32)
 
     # --- select: highest score, ties -> later row (lexicographically
     # greatest name, matching the Python driver's (score, name) max).
@@ -314,11 +321,11 @@ def kernel_impl(
     # `final * n + idx` combined key — that overflows int32 at the fleet
     # scales the sharded path serves). ---
     n = final.shape[0]
-    masked = jnp.where(feasible, final, -1)
-    best = (n - 1 - jnp.argmax(masked[::-1])).astype(jnp.int32)
-    best = jnp.where(jnp.any(feasible), best, -1)
+    masked = xp.where(feasible, final, -1)
+    best = (n - 1 - xp.argmax(masked[::-1])).astype(xp.int32)
+    best = xp.where(xp.any(feasible), best, -1)
 
-    claimable = jnp.clip(count_avail + freed - invisible, 0).astype(jnp.int32)
+    claimable = xp.maximum(count_avail + freed - invisible, 0).astype(xp.int32)
 
     return feasible, reasons, raw, final, best, claimable
 
@@ -601,6 +608,99 @@ class DeviceFleetKernel:
         """G gangs' member rows in ONE dispatch (cross-gang joint
         placement): stacked into one padded burst and regrouped per gang
         (:func:`evaluate_joint_via_burst`)."""
+        return evaluate_joint_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
+
+
+class NumpyFleetKernel:
+    """Pure-host evaluator with the same output contract as the jitted
+    kernels — the last rung of the dispatch fallback chain
+    (plugins/yoda/batch.py): when the primary backend (Pallas/mesh/XLA
+    device) and the XLA host kernel both fail, the scheduler keeps serving
+    from this evaluator at numpy speed instead of crashing the loop. It
+    shares :func:`kernel_impl` through the ``xp`` namespace parameter, so
+    the math cannot drift from the device semantics; no jax machinery is
+    touched on this path, which is the point — a wedged runtime or a
+    lowering bug cannot take it down with the device kernels."""
+
+    def __init__(self, weights: Weights) -> None:
+        self.weights = weights
+        self._static: dict | None = None
+        self._names: list[str] = []
+
+    @property
+    def names(self) -> list[str]:
+        return self._names
+
+    def put_static(self, arrays: FleetArrays) -> None:
+        # References, not copies: in-place row updates by the batch
+        # plugin's incremental static refresh stay visible.
+        self._static = {
+            k: np.asarray(getattr(arrays, k))
+            for k in STATIC_NODE_KEYS + CHIP_KEYS
+        }
+        self._names = list(arrays.names)
+
+    def _packed(self, dyn: np.ndarray, reqv: np.ndarray) -> np.ndarray:
+        a = dict(self._static)
+        a["fresh"] = np.asarray(dyn[0]).astype(bool)
+        a["reserved_chips"] = np.asarray(dyn[1])
+        a["claimed_hbm_mib"] = np.asarray(dyn[2])
+        a["host_ok"] = np.asarray(dyn[3]).astype(bool)
+        feasible, reasons, raw, final, best, claimable = kernel_impl(
+            a,
+            int(reqv[0]), int(reqv[1]), int(reqv[2]), int(reqv[3]),
+            int(reqv[4]),
+            weights=self.weights,
+            xp=np,
+        )
+        return np.stack(
+            [
+                feasible.astype(np.int32),
+                np.asarray(reasons, dtype=np.int32),
+                np.asarray(raw, dtype=np.int32),
+                np.asarray(final, dtype=np.int32),
+                np.full_like(np.asarray(final, dtype=np.int32), best),
+                np.asarray(claimable, dtype=np.int32),
+            ]
+        )
+
+    def evaluate(self, dyn: np.ndarray, request: "KernelRequest") -> KernelResult:
+        if self._static is None:
+            raise RuntimeError("put_static() must run before evaluate()")
+        return result_from_packed(self._names, self._packed(dyn, pack_request(request)))
+
+    def evaluate_burst(
+        self,
+        dyn: np.ndarray,
+        host_ok_k: np.ndarray,
+        requests: "list[KernelRequest]",
+    ) -> list[KernelResult]:
+        """K requests, one host loop — no amortization to protect here
+        (this path only runs in degraded mode), just the same results."""
+        if self._static is None:
+            raise RuntimeError("put_static() must run before evaluate_burst()")
+        dyn = np.asarray(dyn)
+        out: list[KernelResult] = []
+        for k, request in enumerate(requests):
+            row_dyn = np.stack(
+                [dyn[0], dyn[1], dyn[2], np.asarray(host_ok_k[k], dtype=np.int32)]
+            )
+            out.append(
+                result_from_packed(
+                    self._names, self._packed(row_dyn, pack_request(request))
+                )
+            )
+        return out
+
+    def evaluate_joint(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "list[list[KernelResult]]":
         return evaluate_joint_via_burst(
             self, dyn, host_ok_groups, request_groups, minimum
         )

@@ -425,7 +425,15 @@ class PallasFleetKernel:
         self.weights = weights
         self.block_n = max(_LANES, _pad_to(block_n, _LANES))
         if interpret is None:
-            interpret = jax.default_backend() != "tpu"
+            # A broken accelerator runtime (libtpu init failure, dead
+            # tunnel) must not take backend auto-selection down with it:
+            # fall to interpret mode — correct, slow, and survivable; the
+            # batch plugin's dispatch fallback chain demotes to the XLA
+            # host kernel if even that fails.
+            try:
+                interpret = jax.default_backend() != "tpu"
+            except Exception:  # noqa: BLE001 — degraded, not fatal
+                interpret = True
         self.interpret = interpret
         self._chips = None
         self._nodes_static: np.ndarray | None = None

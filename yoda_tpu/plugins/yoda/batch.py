@@ -80,6 +80,17 @@ AUTO_DEVICE_MIN_ELEMS = 1 << 22
 # orders of magnitude, so the cut does not need to be precise.
 AUTO_REMOTE_FLOOR_MS = 2.0
 
+# Dispatch fallback chain (failure-domain hardening): a kernel dispatch
+# exception demotes the call one backend level instead of crashing the
+# scheduling loop. Levels: 0 = the configured primary backend
+# (Pallas/mesh/XLA device), 1 = a fresh XLA kernel pinned to host CPU,
+# 2 = the pure-numpy evaluator (ops.kernel.NumpyFleetKernel). After this
+# many failures at a level the circuit breaker pins dispatches below it
+# until process restart — a wedged runtime must not pay a failed dispatch
+# attempt per scheduling cycle forever.
+CIRCUIT_BREAK_FAILURES = 3
+_MAX_FALLBACK_LEVEL = 2
+
 
 def _pod_constraints(pod: PodSpec) -> tuple:
     """Everything pod-side that shapes admission or ranking beyond the
@@ -341,6 +352,15 @@ class YodaBatch(BatchFilterScorePlugin):
         self.joint_dispatches = 0   # multi-gang kernel dispatches
         self.joint_gangs = 0        # gangs whose rows came from a joint one
         self.joint_parked = 0       # gangs parked whole by the joint fit gate
+        # Dispatch fallback chain + circuit breaker (failure-domain
+        # hardening): counters feed yoda_dispatch_* metrics; _fb_* cache
+        # the demoted kernels and the static arrays they last uploaded.
+        self.dispatch_errors = 0      # kernel dispatch exceptions caught
+        self.dispatch_fallbacks = 0   # dispatches completed on a demoted level
+        self._backend_level = 0       # circuit-breaker pin (0 = primary)
+        self._level_failures: dict[int, int] = {}
+        self._fb_kerns: dict[int, object] = {}
+        self._fb_static_key: dict[int, tuple] = {}
         # (snapshot.version, fleet has inter-pod terms) — bursting is
         # refused on fleets where evaluators would be needed per pod.
         self._fleet_terms: tuple[int, bool] = (0, False)
@@ -362,9 +382,21 @@ class YodaBatch(BatchFilterScorePlugin):
             # Hand-written Mosaic TPU kernel (ops/pallas_kernel.py). Fixed
             # for the plugin's lifetime; the platform policy does not apply
             # (on non-TPU backends it runs in interpret mode — tests).
-            from yoda_tpu.ops.pallas_kernel import PallasFleetKernel
+            # Construction hardening: an image rolled onto a node whose
+            # environment lost pallas must boot DEGRADED on the XLA
+            # kernel, not crash-loop the scheduler Deployment — dispatch
+            # failures after construction are the fallback chain's job.
+            try:
+                from yoda_tpu.ops.pallas_kernel import PallasFleetKernel
 
-            self._kern = PallasFleetKernel(self.weights)
+                self._kern = PallasFleetKernel(self.weights)
+            except Exception:
+                log.exception(
+                    "kernel_backend=pallas requested but the Pallas kernel "
+                    "cannot be constructed; falling back to the XLA kernel "
+                    "(degraded configuration, not an outage)"
+                )
+                self.kernel_backend = "xla"
 
     def _device_for(self, arrays: FleetArrays):
         """None = process default device (the accelerator in production)."""
@@ -416,6 +448,100 @@ class YodaBatch(BatchFilterScorePlugin):
                 self.device_min_elems,
             )
         return self._floor_ms
+
+    @property
+    def backend_level(self) -> int:
+        """0 = primary backend, 1 = XLA host fallback, 2 = numpy evaluator:
+        the circuit breaker's current pin (yoda_dispatch_backend_level —
+        nonzero means the scheduler is serving in degraded mode)."""
+        return self._backend_level
+
+    def _kernel_at(self, level: int, static: FleetArrays):
+        """The kernel serving fallback ``level``, with ``static`` uploaded.
+        Level 0 is the configured primary (already loaded by
+        _refresh_static); demoted levels are built lazily and re-upload
+        the static arrays only when they changed. None = this level is
+        unavailable (construction/upload failed) and the chain skips it."""
+        if level == 0:
+            return self._kern
+        kern = self._fb_kerns.get(level)
+        if kern is False:
+            return None  # permanently unavailable (construction failed)
+        try:
+            if kern is None:
+                if level == 1:
+                    import jax
+
+                    kern = DeviceFleetKernel(
+                        self.weights, device=jax.devices("cpu")[0]
+                    )
+                else:
+                    from yoda_tpu.ops.kernel import NumpyFleetKernel
+
+                    kern = NumpyFleetKernel(self.weights)
+                self._fb_kerns[level] = kern
+            # Strong ref to the arrays in the key: identity-keyed caching
+            # must not alias a GC'd object's reused id.
+            key = (static, self._cache_version)
+            if self._fb_static_key.get(level) != key:
+                kern.put_static(static)
+                self._fb_static_key[level] = key
+            return kern
+        except Exception:  # noqa: BLE001 — a broken level is skipped, not fatal
+            log.exception("fallback kernel level %d unavailable", level)
+            self._fb_kerns[level] = False
+            return None
+
+    def _dispatch(self, static: FleetArrays, call):
+        """Run ``call`` (kern -> result) with backend demotion: primary ->
+        XLA host kernel -> numpy evaluator. Any dispatch exception
+        (Pallas lowering/Mosaic error, device runtime failure, transport
+        loss) falls to the next level in the SAME call, so the scheduling
+        cycle completes instead of crashing the loop; the circuit breaker
+        pins the level down after CIRCUIT_BREAK_FAILURES failures so a
+        wedged backend is not re-probed every cycle. Raises only when
+        every level failed."""
+        level = self._backend_level
+        last_error: Exception | None = None
+        while level <= _MAX_FALLBACK_LEVEL:
+            kern = self._kernel_at(level, static)
+            if kern is None:
+                level += 1
+                continue
+            try:
+                out = call(kern)
+            except Exception as e:  # noqa: BLE001 — any failure demotes
+                self.dispatch_errors += 1
+                last_error = e
+                fails = self._level_failures.get(level, 0) + 1
+                self._level_failures[level] = fails
+                if (
+                    fails >= CIRCUIT_BREAK_FAILURES
+                    and self._backend_level == level
+                    and level < _MAX_FALLBACK_LEVEL
+                ):
+                    self._backend_level = level + 1
+                    log.error(
+                        "kernel backend level %d failed %d times (%s); "
+                        "circuit breaker pins dispatches to level %d (%s) "
+                        "until restart",
+                        level, fails, e, level + 1,
+                        "xla-host" if level + 1 == 1 else "numpy",
+                    )
+                else:
+                    log.warning(
+                        "kernel dispatch failed at backend level %d (%s); "
+                        "demoting this dispatch", level, e,
+                    )
+                level += 1
+                continue
+            self._level_failures[level] = 0  # consecutive-failure semantics
+            if level > 0:
+                self.dispatch_fallbacks += 1
+            return out
+        if last_error is not None:
+            raise last_error
+        raise RuntimeError("no kernel backend available for dispatch")
 
     def _dyn_sources(self) -> tuple:
         """(reserved, claimed) inputs for FleetArrays.dyn_packed: the bulk
@@ -565,7 +691,7 @@ class YodaBatch(BatchFilterScorePlugin):
             host_ok=_host_admission(static, snapshot, pod, aff, pending_res),
             last_updated=self._live_timestamps(),
         )
-        result = self._kern.evaluate(dyn, reqk)
+        result = self._dispatch(static, lambda kern: kern.evaluate(dyn, reqk))
         self.dispatch_count += 1
         # Soft steering (preferredDuringScheduling node affinity, preferred
         # pod affinity, spread balance) is a host-side additive term — per
@@ -774,7 +900,9 @@ class YodaBatch(BatchFilterScorePlugin):
         pad = KernelRequest(1, 0, 0, 0, 0)
         while len(requests) < k:
             requests.append(pad)
-        results = self._kern.evaluate_burst(dyn, host_ok_k, requests)
+        results = self._dispatch(
+            static, lambda kern: kern.evaluate_burst(dyn, host_ok_k, requests)
+        )
         self.dispatch_count += 1
         self.burst_dispatches += 1
         entries = {
@@ -1069,19 +1197,21 @@ class YodaBatch(BatchFilterScorePlugin):
                 ok[m] = _host_admission(static, snapshot, pod)
             host_ok_groups.append(ok)
             request_groups.append([reqk for _, _, reqk in cands[i]])
-        if hasattr(self._kern, "evaluate_joint"):
-            grouped = self._kern.evaluate_joint(
-                dyn, host_ok_groups, request_groups, self.batch_requests
-            )
-        else:
+        def run_joint(kern):
+            if hasattr(kern, "evaluate_joint"):
+                return kern.evaluate_joint(
+                    dyn, host_ok_groups, request_groups, self.batch_requests
+                )
             # Burst-capable kernel without the grouped convenience: stack
             # and regroup host-side (ops.kernel owns the layout).
             from yoda_tpu.ops.kernel import evaluate_joint_via_burst
 
-            grouped = evaluate_joint_via_burst(
-                self._kern, dyn, host_ok_groups, request_groups,
+            return evaluate_joint_via_burst(
+                kern, dyn, host_ok_groups, request_groups,
                 self.batch_requests,
             )
+
+        grouped = self._dispatch(static, run_joint)
         self.dispatch_count += 1
         if len(eligible) >= 2:
             self.joint_dispatches += 1

@@ -1,23 +1,107 @@
 """Bind plugin: posts the pod->node binding to the cluster backend — the
 step the reference delegates to upstream default binding (SURVEY.md §3.2
-[bind] row)."""
+[bind] row) — hardened for partial failure:
+
+- **Transient-error retry.** A bind that fails with a retryable error
+  (409 conflict, 429 throttle, 5xx, socket timeout — cluster.retry
+  classification, ``__cause__`` chains included) is retried with bounded
+  jittered exponential backoff before it is reported as a scheduling
+  failure. The reference turned any transient API blip into a permanent
+  "unschedulable"; here only genuine infeasibility (e.g. the pod is
+  already bound elsewhere and stays that way) survives the retries.
+- **Rollback.** ``unbind`` reverses a bind for the gang transactional
+  rollback path (scheduler._do_permit_resolved): backends that can clear
+  the binding do (FakeCluster.unbind_pod); against a real API server a
+  bound pod cannot be un-bound, so KubeCluster's unbind deletes the pod
+  and its controller recreates it — the standard gang remediation.
+"""
 
 from __future__ import annotations
 
+import logging
+import random
+import time
+
 from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.retry import BackoffPolicy, call_with_retries
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import BindPlugin, Status
+
+log = logging.getLogger("yoda_tpu.binder")
 
 
 class ClusterBinder(BindPlugin):
     name = "yoda-binder"
 
-    def __init__(self, cluster) -> None:
+    def __init__(
+        self,
+        cluster,
+        *,
+        retry_attempts: int = 3,
+        retry_base_s: float = 0.05,
+        retry_cap_s: float = 1.0,
+        rng: "random.Random | None" = None,
+        sleep=time.sleep,
+    ) -> None:
         self.cluster = cluster  # anything with bind_pod(pod_key, node_name)
+        self.policy = BackoffPolicy(
+            attempts=max(retry_attempts, 0),
+            base_s=retry_base_s,
+            cap_s=retry_cap_s,
+        )
+        # Seedable for deterministic chaos replays; fresh entropy otherwise.
+        self.rng = rng or random.Random()
+        self.sleep = sleep
+        self.retries = 0   # feeds yoda_recovery_bind_retries_total
+        self.unbinds = 0   # feeds yoda_recovery_unbinds_total
 
     def bind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        def on_retry(attempt: int, e: BaseException) -> None:
+            self.retries += 1
+            log.warning(
+                "bind %s -> %s failed transiently (attempt %d: %s); "
+                "retrying with backoff", pod.key, node_name, attempt + 1, e,
+            )
+
         try:
-            self.cluster.bind_pod(pod.key, node_name)
-        except Exception as e:  # bind conflicts surface as scheduling failures
+            call_with_retries(
+                lambda: self.cluster.bind_pod(pod.key, node_name),
+                policy=self.policy,
+                rng=self.rng,
+                sleep=self.sleep,
+                on_retry=on_retry,
+            )
+        except Exception as e:  # retries exhausted or genuinely infeasible
             return Status.error(f"binding {pod.key} to {node_name}: {e}")
+        return Status.ok()
+
+    def unbind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        """Reverse a bind (gang rollback). Best-effort with the same
+        transient-retry policy; backends without any rollback surface
+        report an error and the caller logs the stranded pod."""
+        target = getattr(self.cluster, "unbind_pod", None)
+        if target is None:
+            # No unbind and no delete: nothing this backend can do.
+            target = getattr(self.cluster, "delete_pod", None)
+            if target is None:
+                return Status.error(
+                    f"backend cannot roll back binding of {pod.key}"
+                )
+            call = lambda: target(pod.key)  # noqa: E731
+        else:
+            call = lambda: target(pod.key, node_name)  # noqa: E731
+        try:
+            call_with_retries(
+                call,
+                policy=self.policy,
+                rng=self.rng,
+                sleep=self.sleep,
+                on_retry=lambda a, e: log.warning(
+                    "unbind %s from %s failed transiently (attempt %d: %s); "
+                    "retrying", pod.key, node_name, a + 1, e,
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — rollback must not raise
+            return Status.error(f"unbinding {pod.key} from {node_name}: {e}")
+        self.unbinds += 1
         return Status.ok()

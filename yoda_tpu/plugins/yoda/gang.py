@@ -115,6 +115,16 @@ class _GangState:
     specs: dict[str, "PodSpec"] = field(default_factory=dict)
     plan: dict[str, tuple[int, int, int]] | None = None  # host -> coord
     failing: bool = False
+    # Transactional bind rollback (failure-domain hardening): the member
+    # keys of the CURRENT waitlist release, the subset of them whose binds
+    # already landed (key -> host), and whether a bind in this release
+    # failed — reset at each release start. A member's bind failure rolls
+    # the whole cohort back: landed binds are unbound, waiting members
+    # cascade, and a concurrent bind landing after the failure is undone
+    # by its own on_pod_bound verdict (parallel-release race).
+    release_cohort: set[str] = field(default_factory=set)
+    release_bound: dict[str, str] = field(default_factory=dict)
+    bind_failed: bool = False
     # Hosts that died (value: which kinds' deletion marked them — a Node
     # deletion is only cleared by a Node re-add, not by the agent's CR
     # republish, and vice versa). Marked on EVERY gang so a death landing
@@ -150,6 +160,10 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # (member pod, gang name, why) — standalone wires the Event
         # recorder's GangRollback reason here (VERDICT r2 #6).
         self.on_rollback = on_rollback
+        # Transactional bind rollbacks initiated (a member's bind failed
+        # after the binder's retries and the release cohort was rolled
+        # back) — feeds yoda_recovery_gang_rollbacks_total.
+        self.bind_rollbacks = 0
         self._lock = threading.RLock()
         # Concurrent waitlist release (on_pod_waiting): created lazily on
         # the first multi-member release (gang-free stacks and tests never
@@ -532,6 +546,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             dead = gs.assigned.get(wp.pod.key) in gs.dead_hosts
             complete = len(gs.waiting) + len(gs.bound) >= gs.spec.size
             targets = list(gs.waiting) if complete and not dead else []
+            if targets:
+                # Release starts: arm the transactional-bind cohort. Any
+                # member's bind failure from here rolls the whole cohort
+                # back (on_bind_failed).
+                gs.release_cohort = set(targets)
+                gs.release_bound = {}
+                gs.bind_failed = False
         if dead:
             wp.reject(
                 f"assigned host {gs.assigned.get(wp.pod.key)} disappeared "
@@ -659,6 +680,118 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             if not gs.waiting:
                 gs.failing = False
                 gs.plan = None
+
+    # --- transactional bind rollback (failure-domain hardening) ---
+
+    def on_pod_bound(self, framework, wp) -> bool:
+        """Framework hook: a permit-released pod's bind SUCCEEDED. Records
+        the member in its gang's release cohort so a later sibling's bind
+        failure can roll it back. Returns False when the gang already
+        began a bind-failure rollback — the caller must then undo THIS
+        bind too (parallel-release race: binds in flight concurrently,
+        the first failure wins and the stragglers are unwound)."""
+        gang_name = gang_name_of(wp.pod.labels)
+        if not gang_name:
+            return True
+        with self._lock:
+            gs = self._gangs.get(gang_name)
+            if gs is None or wp.pod.key not in gs.release_cohort:
+                return True
+            if gs.bind_failed:
+                gs.bound.discard(wp.pod.key)
+                gs.assigned.pop(wp.pod.key, None)
+                gs.specs.pop(wp.pod.key, None)
+                return False
+            gs.release_bound[wp.pod.key] = wp.node_name
+            return True
+
+    def on_bind_failed(
+        self, framework, wp, status: Status
+    ) -> "list[tuple[PodSpec, str]] | None":
+        """Framework hook: a permit-released member's bind FAILED after the
+        binder's transient retries. Makes the gang bind transactional —
+        the all-or-nothing contract the fit gate gives placement, extended
+        through the bind phase: siblings whose binds already landed this
+        release are returned as (pod, host) pairs for the scheduler to
+        unbind/unreserve/requeue, still-waiting members are rejected (the
+        standard cascade releases their reservations), and the gang's
+        bookkeeping forgets the release so the WHOLE gang re-queues
+        untouched. Returns None when no new rollback was initiated (not a
+        gang member, or the cohort is already rolling back — repeat
+        failures do only their own member bookkeeping)."""
+        gang_name = gang_name_of(wp.pod.labels)
+        if not gang_name:
+            return None
+        with self._lock:
+            gs = self._gangs.get(gang_name)
+            if gs is None:
+                return None
+            already = gs.bind_failed
+            gs.bind_failed = True
+            # The member resolved SUCCESS at Permit, so on_pod_resolved
+            # counted it bound — undo that; the caller's standard
+            # rejection path unreserves and requeues the member itself.
+            gs.bound.discard(wp.pod.key)
+            gs.assigned.pop(wp.pod.key, None)
+            gs.specs.pop(wp.pod.key, None)
+            gs.release_cohort.discard(wp.pod.key)
+            if already:
+                return None
+            rollbacks: list[tuple[PodSpec, str]] = []
+            for key, host in gs.release_bound.items():
+                spec = gs.specs.pop(key, None)
+                gs.bound.discard(key)
+                gs.assigned.pop(key, None)
+                if spec is not None:
+                    rollbacks.append((spec, host))
+            gs.release_bound = {}
+            targets = list(gs.waiting)
+            gs.plan = None
+            self.bind_rollbacks += 1
+        why = (
+            f"member {wp.pod.key} failed to bind: {status.message}; "
+            "rolling the gang back"
+        )
+        log.warning(
+            "gang %s: bind failure on %s — unbinding %d landed member(s), "
+            "cascading %d waiting member(s)",
+            gang_name, wp.pod.key, len(rollbacks), len(targets),
+        )
+        if self.on_rollback is not None:
+            self.on_rollback(wp.pod, gang_name, why)
+            for spec, _host in rollbacks:
+                self.on_rollback(spec, gang_name, why)
+        # Outside the lock (reject re-enters the resolution chain — the
+        # standard collect-then-reject discipline of on_pod_resolved).
+        for key in targets:
+            w = framework.get_waiting_pod(key)
+            if w is not None:
+                if self.on_rollback is not None:
+                    self.on_rollback(w.pod, gang_name, why)
+                w.reject(f"gang {why}")
+        return rollbacks
+
+    def on_unbind_failed(self, framework, pod: PodSpec, node_name: str) -> None:
+        """Framework hook: a rollback's unbind FAILED, so the member
+        remains bound on the cluster. Restore its membership — the
+        re-queued siblings then complete the gang AROUND the stranded
+        member instead of waiting at the barrier for a ghost that never
+        reschedules (its queue entries drop on the already-bound check)."""
+        gang_name = gang_name_of(pod.labels)
+        if not gang_name:
+            return
+        with self._lock:
+            gs = self._gangs.get(gang_name)
+            if gs is None:
+                return
+            gs.bound.add(pod.key)
+            gs.assigned[pod.key] = node_name
+            gs.specs[pod.key] = pod
+            log.warning(
+                "gang %s: member %s could not be unbound; keeping it as a "
+                "bound member (%d/%d)",
+                gang_name, pod.key, len(gs.bound), gs.spec.size,
+            )
 
     # --- watch: membership lifecycle across restarts and deletions ---
 

@@ -133,7 +133,13 @@ def build_stack(
         plugins.append(preemption)
     if extra_plugins:
         plugins.extend(extra_plugins)
-    plugins.append(ClusterBinder(cluster))
+    binder = ClusterBinder(
+        cluster,
+        retry_attempts=config.bind_retry_attempts,
+        retry_base_s=config.bind_retry_base_s,
+        retry_cap_s=config.bind_retry_cap_s,
+    )
+    plugins.append(binder)
     framework = Framework(plugins)
     gang.attach_framework(framework)
     queue = SchedulingQueue(
@@ -164,6 +170,26 @@ def build_stack(
             lambda: sum(q.depths()[2] for q in qacc),
         )
     qacc.append(queue)
+
+    # Recovery counters fed by the binder (accumulator pattern, as above:
+    # one family on the shared registry, summed over profiles' binders).
+    bacc = getattr(metrics, "_binders", None)
+    if bacc is None:
+        bacc = metrics._binders = []
+        metrics.registry.counter(
+            "yoda_recovery_bind_retries_total",
+            "Bind attempts retried after a transient API error (409 "
+            "conflict / 429 throttle / 5xx / timeout) with jittered "
+            "backoff, instead of failing the pod",
+            lambda: sum(b.retries for b in bacc),
+        )
+        metrics.registry.counter(
+            "yoda_recovery_unbinds_total",
+            "Landed binds reversed by the transactional gang rollback "
+            "(unbind or delete-for-recreate, backend-dependent)",
+            lambda: sum(b.unbinds for b in bacc),
+        )
+    bacc.append(binder)
 
     def on_change(event: Event) -> None:
         # New/changed TPU metrics may make parked pods schedulable; pod
@@ -316,6 +342,28 @@ def build_stack(
                 "auto platform policy probes it; ~0.1 locally-attached, "
                 "~100 over a tunnel/RPC transport)",
                 lambda: max((p._floor_ms or 0.0 for p in acc), default=0.0),
+            )
+            metrics.registry.counter(
+                "yoda_dispatch_errors_total",
+                "Kernel dispatch exceptions caught by the fallback chain "
+                "(each one demoted the dispatch a backend level instead "
+                "of crashing the scheduling loop)",
+                lambda: sum(p.dispatch_errors for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_dispatch_fallback_total",
+                "Dispatches completed on a DEMOTED kernel backend "
+                "(primary -> XLA host kernel -> numpy evaluator) — "
+                "nonzero means degraded-mode operation",
+                lambda: sum(p.dispatch_fallbacks for p in acc),
+            )
+            metrics.registry.gauge(
+                "yoda_dispatch_backend_level",
+                "Circuit-breaker backend pin: 0 = primary kernel, 1 = XLA "
+                "host fallback, 2 = numpy evaluator (max over profiles; "
+                "nonzero = a backend was pinned down after repeated "
+                "dispatch failures)",
+                lambda: max((p.backend_level for p in acc), default=0),
             )
             metrics.registry.gauge(
                 "yoda_kernel_on_accelerator",

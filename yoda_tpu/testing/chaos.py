@@ -1,0 +1,254 @@
+"""Fault-injection harness: deterministic, replayable failure schedules.
+
+The reference plugin was verified by deploying it and watching (SURVEY.md
+§4); its failure story is "the framework retries the pod". This harness
+exists to PROVE the recovery machinery this repo adds — transactional gang
+bind rollback, transient-error bind retry, the kernel dispatch fallback
+chain, watch 410 relist — by injecting the failures production actually
+produces, on a seeded schedule a test can replay exactly:
+
+- ``ChaosPlan``: the schedule. Either an explicit list of ``FaultSpec``
+  (op, invocation index, kind, consecutive count) or ``ChaosPlan.seeded``
+  — the same seed always generates the same plan, and ``plan.fired``
+  records what actually triggered, so a failing run's log IS its repro.
+- ``ChaosCluster``: wraps a ``FakeCluster``; injects bind conflicts
+  (409-status errors, duck-typing ``KubeApiError`` for the retry
+  classifier), transient timeouts, unbind failures, dropped agent
+  publishes, and metric staleness (backdated ``last_updated_unix``).
+- ``ChaosKernel``: wraps any ``FleetKernelLike``; injects kernel dispatch
+  exceptions (the Pallas-lowering / device-runtime failure class). Only
+  the PRIMARY kernel is wrapped, so YodaBatch's fallback chain demotes to
+  healthy backends — exactly the path the tests assert.
+- ``maybe_drop_watch``: consumes a scheduled "watch" fault by compacting
+  a ``FakeKubeApiServer``'s event window, killing open watch streams with
+  410 Gone (forcing the client's relist-and-resync).
+
+Ops recognized by the built-in wrappers: ``bind``, ``unbind``,
+``metrics``, ``dispatch``, ``watch``. Each retry of a faulted call counts
+as a fresh invocation — a ``count=1`` bind conflict fails once and the
+binder's first retry succeeds; ``count > retry budget`` forces the
+genuine-failure path (gang rollback).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+
+# Backdate applied by the "stale" metrics fault — far past any reasonable
+# max_metrics_age_s, so the staleness gate trips deterministically.
+STALE_BACKDATE_S = 3600.0
+
+_DEFAULT_KINDS = {
+    "bind": ("conflict", "timeout"),
+    "unbind": ("timeout",),
+    "metrics": ("stale", "drop"),
+    "dispatch": ("error",),
+    "watch": ("drop",),
+}
+
+
+class ChaosApiError(Exception):
+    """Injected API error carrying an HTTP-ish ``status`` — duck-types
+    ``cluster.kube.KubeApiError`` for ``cluster.retry.retryable_api_error``
+    without importing kube internals into every test."""
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"chaos HTTP {status}: {message}")
+        self.status = status
+
+
+class ChaosTimeout(TimeoutError):
+    """Injected transport timeout (retryable by classification)."""
+
+
+def make_error(kind: str, detail: str) -> Exception:
+    if kind == "conflict":
+        return ChaosApiError(409, f"injected conflict: {detail}")
+    if kind == "timeout":
+        return ChaosTimeout(f"chaos: injected timeout: {detail}")
+    return RuntimeError(f"chaos: injected failure ({kind}): {detail}")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Fire on invocations ``at .. at+count-1`` (0-based) of ``op``."""
+
+    op: str
+    at: int
+    kind: str
+    count: int = 1
+
+
+class ChaosPlan:
+    """A deterministic fault schedule plus the record of what fired.
+
+    Thread-safe: the scheduler's permit-release pool may drive wrapped
+    calls concurrently, and each call must consume exactly one invocation
+    index."""
+
+    def __init__(self, faults: "tuple[FaultSpec, ...] | list" = (), *, seed: int | None = None) -> None:
+        self.seed = seed
+        self.faults = tuple(faults)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        # (op, invocation index, kind) triples, in firing order — a
+        # failing chaos run's exact repro script.
+        self.fired: list[tuple[str, int, str]] = []
+        self._by_op: dict[str, dict[int, FaultSpec]] = {}
+        for f in self.faults:
+            slots = self._by_op.setdefault(f.op, {})
+            for i in range(f.at, f.at + max(f.count, 1)):
+                slots.setdefault(i, f)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        *,
+        ops: "tuple[str, ...]" = ("bind", "dispatch"),
+        horizon: int = 40,
+        rate: float = 0.2,
+        kinds_by_op: "dict[str, tuple[str, ...]] | None" = None,
+    ) -> "ChaosPlan":
+        """A random-but-replayable plan: the same seed ALWAYS yields the
+        same schedule (random.Random(seed), op-ordered draw sequence).
+        ``rate`` is the per-invocation fault probability over the first
+        ``horizon`` invocations of each op."""
+        rng = random.Random(seed)
+        faults: list[FaultSpec] = []
+        for op in ops:
+            kinds = (kinds_by_op or {}).get(op) or _DEFAULT_KINDS.get(
+                op, ("error",)
+            )
+            for at in range(horizon):
+                if rng.random() < rate:
+                    faults.append(
+                        FaultSpec(op=op, at=at, kind=rng.choice(list(kinds)))
+                    )
+        return cls(faults, seed=seed)
+
+    def next(self, op: str) -> "FaultSpec | None":
+        """Consume one invocation of ``op``; the scheduled fault, if any."""
+        with self._lock:
+            i = self._counts.get(op, 0)
+            self._counts[op] = i + 1
+            f = self._by_op.get(op, {}).get(i)
+            if f is not None:
+                self.fired.append((op, i, f.kind))
+            return f
+
+    def invocations(self, op: str) -> int:
+        with self._lock:
+            return self._counts.get(op, 0)
+
+
+class ChaosCluster:
+    """A ``FakeCluster`` front that injects faults per plan; every other
+    attribute delegates, so ``standalone.build_stack`` and the agents run
+    unchanged against it."""
+
+    def __init__(self, inner=None, plan: "ChaosPlan | None" = None) -> None:
+        from yoda_tpu.cluster.fake import FakeCluster
+
+        self._inner = inner if inner is not None else FakeCluster()
+        self.plan = plan if plan is not None else ChaosPlan()
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    # --- faulted surfaces ---
+
+    def bind_pod(self, pod_key: str, node_name: str) -> None:
+        f = self.plan.next("bind")
+        if f is not None:
+            raise make_error(f.kind, f"bind {pod_key} -> {node_name}")
+        return self._inner.bind_pod(pod_key, node_name)
+
+    def unbind_pod(self, pod_key: str, node_name: str) -> None:
+        f = self.plan.next("unbind")
+        if f is not None:
+            raise make_error(f.kind, f"unbind {pod_key} from {node_name}")
+        return self._inner.unbind_pod(pod_key, node_name)
+
+    def put_tpu_metrics(self, tpu) -> None:
+        f = self.plan.next("metrics")
+        if f is not None:
+            if f.kind == "drop":
+                return  # publish lost in transit: the CR simply ages
+            if f.kind == "stale":
+                # Agent clock skew / scrape stall: the CR lands already
+                # ancient, tripping any max_metrics_age_s gate.
+                tpu.last_updated_unix -= STALE_BACKDATE_S
+        return self._inner.put_tpu_metrics(tpu)
+
+
+class ChaosKernel:
+    """Wraps a ``FleetKernelLike``; scheduled "dispatch" faults raise from
+    every evaluate path (the Pallas/XLA runtime-failure class)."""
+
+    def __init__(self, inner, plan: ChaosPlan) -> None:
+        self._inner = inner
+        self.plan = plan
+
+    @property
+    def names(self):
+        return self._inner.names
+
+    def put_static(self, arrays) -> None:
+        self._inner.put_static(arrays)
+
+    def _maybe_fail(self, what: str) -> None:
+        f = self.plan.next("dispatch")
+        if f is not None:
+            raise make_error(f.kind, f"kernel {what} dispatch")
+
+    def evaluate(self, dyn, request):
+        self._maybe_fail("evaluate")
+        return self._inner.evaluate(dyn, request)
+
+    def evaluate_burst(self, dyn, host_ok_k, requests):
+        self._maybe_fail("burst")
+        return self._inner.evaluate_burst(dyn, host_ok_k, requests)
+
+    def evaluate_joint(self, dyn, host_ok_groups, request_groups, minimum=1):
+        self._maybe_fail("joint")
+        if hasattr(self._inner, "evaluate_joint"):
+            return self._inner.evaluate_joint(
+                dyn, host_ok_groups, request_groups, minimum
+            )
+        from yoda_tpu.ops.kernel import evaluate_joint_via_burst
+
+        return evaluate_joint_via_burst(
+            self._inner, dyn, host_ok_groups, request_groups, minimum
+        )
+
+
+def install_chaos_kernel(batch_plugin, plan: ChaosPlan) -> ChaosKernel:
+    """Wrap ``batch_plugin``'s PRIMARY kernel with a ``ChaosKernel``. The
+    fallback levels (XLA host / numpy) are not wrapped — dispatch faults
+    prove the demotion path, they don't sabotage it. The XLA kernel is
+    built lazily by the platform policy, so run one scheduling cycle (or
+    use kernel_backend='pallas' / mesh, built eagerly) before installing."""
+    inner = batch_plugin._kern
+    if inner is None:
+        raise RuntimeError(
+            "batch plugin has no kernel yet — run one scheduling cycle "
+            "before installing the chaos kernel (the XLA kernel is built "
+            "lazily by the platform policy)"
+        )
+    wrapped = ChaosKernel(inner, plan)
+    batch_plugin._kern = wrapped
+    return wrapped
+
+
+def maybe_drop_watch(plan: ChaosPlan, server) -> bool:
+    """Consume a scheduled "watch" fault: compact ``server``'s event
+    window (testing.fake_kube_api.FakeKubeApiServer) so open watch
+    streams die with 410 Gone and clients must relist-and-resync."""
+    f = plan.next("watch")
+    if f is None:
+        return False
+    server.compact()
+    return True

@@ -63,6 +63,11 @@ class _State:
     # Pod keys whose eviction returns 429 (a PodDisruptionBudget would be
     # violated) — set via FakeKubeApiServer.set_eviction_blocked.
     eviction_blocked: set = field(default_factory=set)
+    # When True, a fresh watch from an expired resourceVersion is refused
+    # with an HTTP 410 STATUS (some API-server paths answer this way)
+    # instead of the in-band one-event ERROR stream — exercises the
+    # client's immediate-relist handling of transport-level 410s.
+    http_410_on_expired: bool = False
 
 
 class FakeKubeApiServer:
@@ -466,6 +471,11 @@ class _Handler(BaseHTTPRequestHandler):
         state = self.state
         with state.lock:
             expired = since and since < state.window_start[kind]
+            http_410 = state.http_410_on_expired
+        if expired and http_410:
+            return self._send_status(
+                410, f"too old resource version: {since}"
+            )
         if expired:
             # Resume window compacted away: the client must relist. Sent as
             # a one-event watch stream (newline-framed), like the real API.
