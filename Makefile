@@ -8,12 +8,25 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test native bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native bench smoke chaos demo soak image push format clean
 
-all: native test
+all: native lint test
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# Static checks (ruff; rule config in pyproject.toml [tool.ruff]). The
+# container image may not ship ruff — fall back to a byte-compile sweep so
+# `make all` still gates on syntax-clean sources everywhere.
+lint:
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check yoda_tpu tests bench.py __graft_entry__.py; \
+	elif command -v ruff >/dev/null 2>&1; then \
+		ruff check yoda_tpu tests bench.py __graft_entry__.py; \
+	else \
+		echo "lint: ruff not installed; running compileall syntax sweep only"; \
+		$(PY) -m compileall -q yoda_tpu tests bench.py __graft_entry__.py; \
+	fi
 
 native:
 	$(MAKE) -C native

@@ -616,6 +616,117 @@ def _multi_gang_contended_scenario(
     }
 
 
+def _bind_latency_scenario(
+    *, members: int = 64, latency_s: float = 0.010, hosts: int = 8,
+    chips: int = 8, reps: int = 3,
+) -> dict:
+    """Pipelined bind fan-out (ISSUE 4): one ``members``-member plain gang
+    whose every bind costs ``latency_s`` of injected API latency
+    (FakeCluster.bind_latency_s — the pods/binding round-trip a real API
+    server charges), drained to completion, pipelined vs serial:
+
+    - serial:    bind_workers=1, bind_pipeline="off" — every member bind
+                 runs inline on the scheduling thread, one after another
+                 (the reference shape: members x latency of dead time).
+    - pipelined: bind_workers=8 (default), pipeline on — the release fans
+                 out on the bind executor, ~members/8 latency waves, and
+                 the serve loop overlaps the next cycle with the I/O.
+
+    Reported fields:
+      serial_bind_pods_per_s     bind-dominated drain rate, serial
+      pipelined_bind_pods_per_s  same drain through the pipeline (the
+                                 acceptance metric: >= 4x serial at 10 ms
+                                 x 64 members)
+      bind_pipeline_speedup      the ratio
+      bind_inflight_peak         max yoda_bind_inflight observed mid-drain
+                                 (> 1 proves real fan-out)
+
+    ``bench.py --smoke`` / ``make smoke`` runs this at full shape (the
+    drain is bind-bound, not kernel-bound — seconds on CPU)."""
+    import threading as _threading
+    import time as _time
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.cluster.fake import FakeCluster
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    assert hosts * chips >= members, "gang must fit the fleet"
+    out: dict = {}
+    peak = 0
+    for key, workers, pipeline in (
+        ("serial_bind_pods_per_s", 1, "off"),
+        ("pipelined_bind_pods_per_s", 8, "auto"),  # latency flips auto on
+    ):
+        stack = build_stack(
+            cluster=FakeCluster(bind_latency_s=latency_s),
+            config=SchedulerConfig(
+                mode="batch",
+                batch_requests=16,
+                bind_workers=workers,
+                bind_pipeline=pipeline,
+            ),
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(hosts):
+            agent.add_host(f"bl-{i}", generation="v5e", chips=chips)
+        agent.publish_all()
+
+        def gang(tag):
+            labels = {
+                "tpu/gang": tag,
+                "tpu/gang-size": str(members),
+                "tpu/chips": "1",
+            }
+            return [
+                PodSpec(f"{tag}-{i}", labels=dict(labels))
+                for i in range(members)
+            ]
+
+        def drain(tag, timeout_s=120.0):
+            for pod in gang(tag):
+                stack.cluster.create_pod(pod)
+            t0 = _time.monotonic()
+            stack.scheduler.run_until_idle(max_wall_s=timeout_s)
+            dt = _time.monotonic() - t0
+            bound = [p for p in stack.cluster.list_pods() if p.node_name]
+            assert len(bound) == members, (
+                f"{tag}: only {len(bound)}/{members} bound"
+            )
+            for i in range(hosts):
+                assert stack.accountant.chips_in_use(f"bl-{i}") <= chips
+            for p in bound:
+                stack.cluster.delete_pod(p.key)
+            stack.scheduler.run_until_idle(max_wall_s=30)
+            return dt
+
+        # Warmup pays the kernel compiles at this gang shape (and the
+        # first wave of binds) outside the measurement.
+        drain("blw", timeout_s=240.0)
+        sampler_stop = _threading.Event()
+        if stack.bind_executor is not None:
+
+            def sample():
+                nonlocal peak
+                while not sampler_stop.is_set():
+                    peak = max(peak, stack.bind_executor.inflight())
+                    sampler_stop.wait(0.002)
+
+            sampler = _threading.Thread(target=sample, daemon=True)
+            sampler.start()
+        best = min(drain(f"bl{r}") for r in range(reps))
+        sampler_stop.set()
+        out[key] = round(members / best, 1)
+    out["bind_pipeline_speedup"] = round(
+        out["pipelined_bind_pods_per_s"] / out["serial_bind_pods_per_s"], 2
+    )
+    out["bind_inflight_peak"] = peak
+    out["bind_latency_ms"] = round(latency_s * 1e3, 1)
+    out["bind_gang_members"] = members
+    return out
+
+
 def _degraded_chaos_scenario(
     *, hosts: int = 8, gangs: int = 3, singles: int = 16, seed: int = 20260804
 ) -> dict:
@@ -1114,6 +1225,8 @@ def run_bench() -> dict:
     print(f"multi-gang contended joint placement: {multi}", file=sys.stderr)
     degraded = _degraded_chaos_scenario()
     print(f"degraded-mode throughput under injected faults: {degraded}", file=sys.stderr)
+    bindpipe = _bind_latency_scenario()
+    print(f"pipelined bind fan-out vs serial: {bindpipe}", file=sys.stderr)
     http = _http_gang_scenario()
     print(f"gang over real HTTP wire path: {http}", file=sys.stderr)
     probe = _device_probe()
@@ -1140,6 +1253,7 @@ def run_bench() -> dict:
         **burst,
         **multi,
         **degraded,
+        **bindpipe,
         **http,
         **probe,
         **pallas,
@@ -1151,6 +1265,8 @@ def run_smoke() -> dict:
     the burst+gang scenario on a reduced fleet (2 v5p slices + 4 v5e
     hosts, 24 singletons + one 4-member topology gang) PLUS the
     multi-gang joint-placement scenario (2 gangs racing for 2 slices),
+    the degraded-chaos drain, and the bind-latency pipeline comparison
+    (64-member gang at 10 ms injected bind latency, pipelined vs serial),
     pinned to host CPU so no tunnel/compile variance leaks in. Runs in
     seconds and guards the contended-hot-path RATES; the scenarios' own
     assertions (all bound, gangs one-per-host on disjoint blocks, no
@@ -1162,6 +1278,7 @@ def run_smoke() -> dict:
     out = _burst_with_gang_scenario(slices=2, singles=4, burst_pods=24)
     out.update(_multi_gang_contended_scenario(slices=2, gangs=2))
     out.update(_degraded_chaos_scenario(hosts=4, gangs=2, singles=8))
+    out.update(_bind_latency_scenario())
     return {"metric": "smoke_burst_with_gang_pods_per_s", **out}
 
 

@@ -59,6 +59,23 @@ def test_degraded_chaos_scenario_invariants():
     )
 
 
+def test_bind_latency_pipeline_speedup():
+    import bench
+
+    # The ISSUE 4 acceptance bar: at 10 ms injected bind latency and a
+    # 64-member gang, the pipelined fan-out must beat the bind_workers=1
+    # serial baseline by >= 4x (the scenario asserts the correctness
+    # invariants — all bound, no oversubscription — inline).
+    out = bench._bind_latency_scenario()
+    assert out["serial_bind_pods_per_s"] > 0
+    assert (
+        out["pipelined_bind_pods_per_s"] >= 4 * out["serial_bind_pods_per_s"]
+    ), out
+    # Real fan-out, not just async handoff: several binds were in flight
+    # at once.
+    assert out["bind_inflight_peak"] > 1
+
+
 def test_smoke_mode_runs_reduced_fleet():
     import bench
 
@@ -68,3 +85,5 @@ def test_smoke_mode_runs_reduced_fleet():
     # The multi-gang joint scenario rides the same smoke run.
     assert out["multi_gang_joint_dispatches"] == 1
     assert out["multi_gang_contended_pods_per_s"] > 0
+    # The bind-latency pipeline scenario rides the smoke run too.
+    assert out["pipelined_bind_pods_per_s"] > 0

@@ -1,0 +1,253 @@
+"""Pipelined bind fan-out (ISSUE 4): executor mechanics, serve-loop
+overlap, the drain barrier, interruptible retry backoff, and worker-side
+fencing.
+
+The chaos-flavored cases (mid-flight bind faults, fencing flips during
+fan-out, the seeded sweep with the pipeline enabled) live in
+tests/test_chaos.py; this file covers the pipeline's own machinery.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.runtime import BindExecutor
+from yoda_tpu.plugins.yoda.binder import ClusterBinder
+from yoda_tpu.standalone import build_stack
+
+
+def gang_pods(name, n, chips=1):
+    labels = {
+        "tpu/gang": name,
+        "tpu/gang-size": str(n),
+        "tpu/chips": str(chips),
+    }
+    return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+def make_stack(*, bind_latency_s=0.0, hosts=4, chips=4, **cfg):
+    stack = build_stack(
+        cluster=FakeCluster(bind_latency_s=bind_latency_s),
+        config=SchedulerConfig(mode="batch", **cfg),
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(hosts):
+        agent.add_host(f"host-{i}", generation="v5p", chips=chips)
+    agent.publish_all()
+    return stack
+
+
+def bound_pods(stack):
+    return {p.name: p.node_name for p in stack.cluster.list_pods() if p.node_name}
+
+
+class TestBindExecutor:
+    def test_tracks_inflight_and_signals_settles(self):
+        ex = BindExecutor(2)
+        settled = []
+        ex.on_settled = lambda: settled.append(1)
+        gate = threading.Event()
+        started = threading.Event()
+
+        def task():
+            started.set()
+            gate.wait(5.0)
+
+        ex.submit(task)
+        assert started.wait(5.0)
+        assert ex.inflight() == 1
+        gate.set()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and ex.inflight():
+            time.sleep(0.005)
+        assert ex.inflight() == 0
+        assert settled == [1]
+        assert ex.submitted == 1
+
+    def test_task_exception_settles_and_never_propagates(self):
+        ex = BindExecutor(1)
+
+        def boom():
+            raise RuntimeError("injected")
+
+        fut = ex.submit(boom)
+        fut.result(timeout=5.0)  # the wrapper swallowed the exception
+        assert ex.inflight() == 0
+
+    def test_shutdown_sets_stop_event(self):
+        ex = BindExecutor(1)
+        ex.submit(lambda: None).result(timeout=5.0)
+        assert not ex.stop_event.is_set()
+        ex.shutdown()
+        assert ex.stop_event.is_set()
+
+    def test_pipeline_off_leaves_executor_unused(self):
+        # bind_workers=0 builds no executor at all; synchronous releases
+        # keep the pre-pipeline shape.
+        stack = make_stack(bind_workers=0)
+        assert stack.bind_executor is None
+        for pod in gang_pods("sync", 4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_pods(stack)) == 4
+
+
+class TestPipelinedRelease:
+    def test_fanout_overlaps_member_binds(self):
+        # 8 members x 50 ms injected bind latency: serial commitment would
+        # take >= 400 ms; the 8-worker fan-out takes ~one latency wave.
+        # The wall-clock bound is deliberately loose (3x the ideal) so CI
+        # load cannot flake it while still refuting serial behavior.
+        stack = make_stack(
+            bind_latency_s=0.05, hosts=8, chips=1, bind_workers=8
+        )
+        assert stack.gang.parallel_release  # auto gate: latency > 0
+        # Warm the kernel compiles (and the executor's worker threads)
+        # outside the measured window.
+        for pod in gang_pods("fwarm", 8):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        for pod in gang_pods("fwarm", 8):
+            stack.cluster.delete_pod(pod.key)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        for pod in gang_pods("fan", 8):
+            stack.cluster.create_pod(pod)
+        t0 = time.monotonic()
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        dt = time.monotonic() - t0
+        assert len(bound_pods(stack)) == 8  # the drain BARRIER held: no
+        # early idle verdict while binds were still in flight
+        assert dt < 0.35, f"fan-out did not overlap binds: {dt:.3f}s"
+        assert stack.bind_executor.inflight() == 0
+
+    def test_overlap_cycles_counted(self):
+        # A gang's release leaves its binds in flight (100 ms each) while
+        # the serve loop pops and schedules the co-queued singletons: those
+        # turns must count into yoda_overlap_cycles_total.
+        stack = make_stack(
+            bind_latency_s=0.1, hosts=8, chips=2, bind_workers=4
+        )
+        for pod in gang_pods("ov", 4):
+            stack.cluster.create_pod(pod)
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(f"solo-{i}", labels={"tpu/chips": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=15)
+        assert len(bound_pods(stack)) == 8
+        assert stack.metrics.overlap_cycles.total() >= 1
+        rendered = stack.metrics.registry.render_prometheus()
+        assert "yoda_overlap_cycles_total" in rendered
+        assert "yoda_bind_inflight" in rendered
+        assert "yoda_bind_wall_ms" in rendered
+
+    def test_inflight_reservations_block_overlapped_dispatch(self):
+        # The no-revalidation-race invariant: while a gang's binds are in
+        # flight, its chips stay charged to the accountant, so a pod
+        # whose cycle overlaps the I/O cannot be placed onto them. One
+        # 1-chip host: the gang member's bind is mid-air when the
+        # singleton schedules — the singleton must NOT bind there.
+        stack = make_stack(
+            bind_latency_s=0.15, hosts=1, chips=1, bind_workers=2,
+            bind_pipeline="on",
+        )
+        for pod in gang_pods("hold", 1):
+            stack.cluster.create_pod(pod)
+        # Pop and schedule the member's cycle directly, so its bind is
+        # in flight when the contender is created.
+        qpi = stack.queue.pop(timeout=2.0)
+        assert qpi is not None
+        stack.scheduler.schedule_one(qpi)
+        assert stack.accountant.chips_in_use("host-0") == 1  # reserved
+        stack.cluster.create_pod(PodSpec("late", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = bound_pods(stack)
+        assert bound.get("hold-0") == "host-0"
+        assert "late" not in bound  # parked: capacity was never double-seen
+        assert stack.accountant.chips_in_use("host-0") == 1
+
+    def test_bind_wall_histogram_observes_latency(self):
+        stack = make_stack(bind_latency_s=0.02, hosts=1, chips=1)
+        stack.cluster.create_pod(PodSpec("solo", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert bound_pods(stack) == {"solo": "host-0"}
+        assert stack.metrics.bind_wall.count() == 1
+        # 20 ms of injected latency must land beyond the 10 ms bucket.
+        assert stack.metrics.bind_wall.quantile(0.5) >= 20.0
+
+
+class _CountingCluster:
+    """Minimal bind backend: fails every bind with a retryable timeout."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def bind_pod(self, pod_key, node_name):
+        self.calls += 1
+        raise TimeoutError("injected transient failure")
+
+
+class TestInterruptibleBackoff:
+    def test_stop_event_aborts_pending_retry_sleep(self):
+        # Generous backoff (cap 30 s): without interruption the retry
+        # ladder would hold the thread for many seconds. Firing the stop
+        # event mid-sleep must abort within milliseconds.
+        cluster = _CountingCluster()
+        stop = threading.Event()
+        binder = ClusterBinder(
+            cluster,
+            retry_attempts=5,
+            retry_base_s=10.0,
+            retry_cap_s=30.0,
+            stop_event=stop,
+        )
+        pod = PodSpec("p", labels={})
+        threading.Timer(0.05, stop.set).start()
+        t0 = time.monotonic()
+        st = binder.bind(CycleState(), pod, "host-0")
+        dt = time.monotonic() - t0
+        assert not st.success
+        assert "backoff" in st.message or "abandoned" in st.message
+        assert dt < 2.0, f"stop did not interrupt the backoff sleep: {dt:.1f}s"
+        assert cluster.calls == 1  # first attempt only; retries abandoned
+        assert binder.aborted == 1
+
+    def test_stop_preset_abandons_before_api_write(self):
+        cluster = _CountingCluster()
+        stop = threading.Event()
+        stop.set()
+        binder = ClusterBinder(cluster, stop_event=stop)
+        st = binder.bind(CycleState(), PodSpec("p", labels={}), "host-0")
+        assert not st.success
+        assert cluster.calls == 0  # abandoned before touching the API
+
+
+class TestWorkerSideFencing:
+    def test_fence_rechecked_immediately_before_write(self):
+        cluster = _CountingCluster()
+        binder = ClusterBinder(cluster)
+        binder.fenced_fn = lambda: True
+        fenced_hits = []
+        binder.on_fenced = lambda: fenced_hits.append(1)
+        st = binder.bind(CycleState(), PodSpec("p", labels={}), "host-0")
+        assert not st.success
+        assert "fenced" in st.message
+        assert cluster.calls == 0  # aborted BEFORE the API write
+        assert binder.fenced == 1 and fenced_hits == [1]
+
+    def test_standalone_wires_binder_fence_to_scheduler(self):
+        # The binder must read the scheduler's LIVE fence (cli sets
+        # fence_fn after construction): flipping it fences binder writes.
+        stack = make_stack(hosts=1, chips=1)
+        assert stack.binder.fenced_fn.__self__ is stack.scheduler
+        leading = [True]
+        stack.scheduler.fence_fn = lambda: leading[0]
+        assert stack.binder.fenced_fn() is False
+        leading[0] = False
+        assert stack.binder.fenced_fn() is True

@@ -38,8 +38,12 @@ def gang_pods(name, n, chips=4):
     return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
 
 
-def make_chaos_stack(plan, *, hosts=4, chips=4, **cfg):
-    cluster = ChaosCluster(plan=plan)
+def make_chaos_stack(plan, *, hosts=4, chips=4, bind_latency_s=0.0, **cfg):
+    from yoda_tpu.cluster.fake import FakeCluster
+
+    cluster = ChaosCluster(
+        inner=FakeCluster(bind_latency_s=bind_latency_s), plan=plan
+    )
     stack = build_stack(
         cluster=cluster, config=SchedulerConfig(mode="batch", **cfg)
     )
@@ -359,6 +363,97 @@ class TestLeaderFencing:
             t.join(timeout=5)
 
 
+class TestBindPipelineChaos:
+    """ISSUE 4 satellite: faults landing while sibling binds are IN FLIGHT
+    on the pipelined fan-out. The PR 3 invariants — no oversubscription,
+    no partially-bound gangs, no leaked reservations — must survive the
+    overlap, and the rollback must fire only after the whole release
+    cohort settles (the completion barrier)."""
+
+    def test_conflict_while_siblings_in_flight_rolls_back_whole(self):
+        # 20 ms injected bind latency + 2-worker fan-out: when the faulted
+        # member's 409 surfaces (retry disabled), sibling binds are still
+        # mid-air. The barrier defers the unwind until they settle; the
+        # gang then requeues whole and the second pass binds everything.
+        plan = ChaosPlan([FaultSpec("bind", 2, "conflict")])
+        stack, _ = make_chaos_stack(
+            plan,
+            bind_latency_s=0.02,
+            bind_retry_attempts=0,
+            bind_workers=2,
+            bind_pipeline="on",
+        )
+        for pod in gang_pods("pipe-c", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=20)
+        assert len(bound_pods(stack)) == 4
+        assert stack.gang.gang_status("pipe-c") == (4, 0, 4)
+        assert stack.gang.bind_rollbacks == 1
+        assert the_binder(stack).unbinds >= 1  # a landed bind was unwound
+        assert_no_leaked_reservations(stack)
+
+    def test_timeouts_exhaust_retries_mid_flight(self):
+        # A member's timeouts outlast its retry budget while the fan-out
+        # holds siblings in flight: genuine failure -> transactional
+        # rollback -> clean recovery once the fault window passes.
+        plan = ChaosPlan([FaultSpec("bind", 1, "timeout", count=4)])
+        stack, _ = make_chaos_stack(
+            plan,
+            bind_latency_s=0.01,
+            bind_retry_attempts=1,
+            bind_retry_base_s=0.01,
+            bind_retry_cap_s=0.02,
+            bind_workers=4,
+            bind_pipeline="on",
+        )
+        for pod in gang_pods("pipe-t", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=20)
+        assert len(bound_pods(stack)) == 4
+        assert the_binder(stack).retries >= 1
+        assert stack.gang.bind_rollbacks >= 1
+        assert_no_leaked_reservations(stack)
+
+    def test_fence_flips_during_fanout(self):
+        # Leadership drops after the first TWO bind API writes of the
+        # release: the remaining members' worker-side fence re-check must
+        # abort BEFORE their writes, the landed binds must be unwound
+        # (after the cohort settles), and nothing may stay bound or
+        # charged. bind_workers=1 serializes the fan-out so the flip
+        # point is deterministic: binds 1-2 land, bind 3 is fenced.
+        plan = ChaosPlan()  # no faults — the plan only counts invocations
+        stack, _ = make_chaos_stack(
+            plan,
+            bind_latency_s=0.01,
+            bind_workers=1,
+            bind_pipeline="on",
+        )
+        state = {"restored": False}
+
+        def fence():
+            if state["restored"]:
+                return True
+            return stack.cluster.plan.invocations("bind") < 2
+
+        stack.scheduler.fence_fn = fence
+        for pod in gang_pods("pipe-f", 4, chips=4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=20)
+        # Fenced mid-release: whole gang rolled back, queue parked.
+        assert bound_pods(stack) == {}, "a fenced bind reached the API"
+        assert stack.cluster.plan.invocations("bind") >= 2  # two landed
+        assert the_binder(stack).unbinds >= 1  # ...and were unwound
+        assert stack.metrics.fenced_binds.total() >= 1
+        assert all(
+            c == 0 for c in stack.accountant.chips_by_node().values()
+        ), stack.accountant.chips_by_node()
+        # Leadership returns: the gang completes whole.
+        state["restored"] = True
+        stack.scheduler.run_until_idle(max_wall_s=20)
+        assert len(bound_pods(stack)) == 4
+        assert_no_leaked_reservations(stack)
+
+
 class TestMetricStaleness:
     def test_stale_publish_parks_then_fresh_publish_recovers(self):
         # An injected agent staleness fault (backdated CR) must park the
@@ -383,21 +478,31 @@ class TestMetricStaleness:
 
 @pytest.mark.slow
 class TestChaosStress:
-    def test_joint_placement_invariants_under_seeded_chaos(self):
+    @pytest.mark.parametrize("pipelined", [False, True], ids=["serial", "pipelined"])
+    def test_joint_placement_invariants_under_seeded_chaos(self, pipelined):
         # The standing invariants — no oversubscription, no partially
         # bound gangs, no leaked reservations — asserted after EVERY
         # drain while a seeded plan injects bind conflicts/timeouts and
         # kernel dispatch failures across waves of contending gangs.
         # CHAOS_SEED overrides the fixed default (`make chaos`); the seed
         # is in the failure message, so a red run replays from the log.
+        # Runs twice: the synchronous release path, and the pipelined
+        # fan-out (injected bind latency + forced pipeline) so the same
+        # fault schedule also hits binds mid-flight (ISSUE 4 acceptance).
         import os
 
         seed = int(os.environ.get("CHAOS_SEED", "20260804"))
         plan = ChaosPlan.seeded(
             seed, ops=("bind", "dispatch"), horizon=120, rate=0.25
         )
+        pipeline_cfg = (
+            {"bind_latency_s": 0.002, "bind_pipeline": "on", "bind_workers": 4}
+            if pipelined
+            else {}
+        )
         stack, agent = make_chaos_stack(
-            plan, hosts=8, chips=8, batch_requests=4, bind_retry_attempts=1
+            plan, hosts=8, chips=8, batch_requests=4, bind_retry_attempts=1,
+            **pipeline_cfg,
         )
         stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
         stack.scheduler.run_until_idle(max_wall_s=10)

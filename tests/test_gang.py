@@ -981,10 +981,11 @@ class TestCoschedulingCompat:
 
 
 class TestParallelRelease:
-    """The concurrent waitlist-release path (gang.py parallel_release —
-    wired for remote-bind backends, forced on here so the pool branch
-    keeps test coverage): lazy executor creation, every member released,
-    and the flaky-bind self-heal through overlapping releases."""
+    """The pipelined waitlist-release path (gang.py parallel_release +
+    the bind executor — wired for remote-bind / latency-injected
+    backends, forced on here so the fan-out branch keeps test coverage):
+    lazy worker creation, every member released, and the flaky-bind
+    self-heal through overlapping releases."""
 
     def _stack(self):
         from yoda_tpu.config import SchedulerConfig
@@ -1001,7 +1002,9 @@ class TestParallelRelease:
         for i in range(4):
             agent.add_host(f"host-{i}", generation="v5p", chips=4)
         agent.publish_all()
-        assert stack.gang._release_pool is None  # lazy until first release
+        # Workers are lazy: nothing submitted, no pool, until a release.
+        assert stack.bind_executor is not None
+        assert stack.bind_executor._pool is None
         for pod in gang_pods("par", 4, chips=4):
             stack.cluster.create_pod(pod)
         stack.scheduler.run_until_idle(max_wall_s=10)
@@ -1009,7 +1012,10 @@ class TestParallelRelease:
         assert all(p.node_name for p in pods)
         assert len({p.node_name for p in pods}) == 4
         assert stack.gang.gang_status("par") == (4, 0, 4)
-        assert stack.gang._release_pool is not None  # pool path engaged
+        # The fan-out path engaged: all 4 member releases went through
+        # the executor and have settled.
+        assert stack.bind_executor.submitted == 4
+        assert stack.bind_executor.inflight() == 0
 
     def test_two_gangs_reuse_the_pool(self):
         stack = self._stack()
@@ -1021,8 +1027,9 @@ class TestParallelRelease:
             for pod in gang_pods(tag, 4, chips=4):
                 stack.cluster.create_pod(pod)
         stack.scheduler.run_until_idle(max_wall_s=20)
-        pool = stack.gang._release_pool
-        assert pool is not None
+        # One persistent executor served both gangs' releases.
+        assert stack.bind_executor.submitted == 8
+        assert stack.bind_executor._pool is not None
         assert all(p.node_name for p in stack.cluster.list_pods())
         assert stack.gang.gang_status("g1") == (4, 0, 4)
         assert stack.gang.gang_status("g2") == (4, 0, 4)

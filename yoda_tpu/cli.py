@@ -94,7 +94,10 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     # Upstream profiles: one process can serve several schedulerNames,
     # each with its own plugin config (config `profiles:`). The base
     # profile's stack owns the metrics endpoint and the leader gate.
-    stacks = build_profile_stacks(cluster, config)
+    # `stop` doubles as the bind executors' stop event: a SIGTERM or a
+    # lost lease aborts pending bind-retry backoff sleeps immediately
+    # instead of draining up to bind_retry_cap_s per attempt.
+    stacks = build_profile_stacks(cluster, config, stop_event=stop)
     stack = stacks[0]
 
     metrics_srv = None
@@ -187,8 +190,9 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             t.join(timeout=10)
     finally:
         for st in stacks:
-            # Release the gang concurrent-release executor without waiting
-            # on a possibly stalled bind round-trip (GangPlugin.close).
+            # Release the bind-pipeline executor without waiting on a
+            # possibly stalled bind round-trip (GangPlugin.close sets the
+            # shared stop event, aborting pending retry sleeps too).
             st.gang.close()
         for st in stacks[1:]:
             if st.events is not None:

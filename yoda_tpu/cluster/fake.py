@@ -8,6 +8,7 @@ subset of the Kubernetes API the scheduler touches. Thread-safe.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Literal
 
@@ -36,7 +37,13 @@ class Event:
 
 
 class FakeCluster:
-    def __init__(self) -> None:
+    def __init__(self, *, bind_latency_s: float = 0.0) -> None:
+        # Injectable per-bind latency (bind-pipeline bench + tests):
+        # emulates the API round-trip a real pods/binding POST costs.
+        # Slept OUTSIDE the store lock so concurrent pipelined binds
+        # overlap the way real RPCs do; > 0 also flips build_stack's
+        # bind_pipeline="auto" gate on.
+        self.bind_latency_s = bind_latency_s
         self._lock = threading.RLock()
         self._pods: dict[str, PodSpec] = {}
         self._tpus: dict[str, TpuNodeMetrics] = {}
@@ -97,6 +104,8 @@ class FakeCluster:
     def bind_pod(self, pod_key: str, node_name: str) -> None:
         """The pods/binding subresource (upstream default binding POSTs this,
         SURVEY.md §3.2 [bind])."""
+        if self.bind_latency_s > 0:
+            time.sleep(self.bind_latency_s)
         with self._lock:
             pod = self._pods[pod_key]
             if pod.node_name is not None and pod.node_name != node_name:

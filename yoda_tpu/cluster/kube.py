@@ -374,10 +374,12 @@ class KubeCluster:
     store first (list-then-watch), matching ``FakeCluster.add_watcher``.
     """
 
-    # Binds are real API round-trips: gang waitlist releases overlap them
-    # on a thread pool (standalone.build_stack -> GangPlugin
-    # parallel_release). In-process backends leave this False — their
-    # binds are microseconds and the thread handoff costs more.
+    # Binds are real API round-trips: the bind pipeline fans gang releases
+    # out on the bounded bind executor and overlaps the next scheduling
+    # cycle with the in-flight POSTs (standalone.build_stack's
+    # bind_pipeline="auto" gate keys on this flag). In-process backends
+    # leave this False — their binds are microseconds and the thread
+    # handoff costs more.
     remote_binds = True
 
     def __init__(
@@ -387,8 +389,14 @@ class KubeCluster:
         backoff_initial_s: float = 0.5,
         backoff_max_s: float = 30.0,
         kinds: tuple[str, ...] = SCHEDULER_KINDS,
+        bind_latency_s: float = 0.0,
     ) -> None:
         self.api = api
+        # Injectable extra per-bind latency (bench/soak only — emulates a
+        # slower API server in front of the real wire path; 0 in
+        # production). Slept before the POST, outside any lock, so
+        # pipelined binds overlap it.
+        self.bind_latency_s = bind_latency_s
         self._backoff_initial_s = backoff_initial_s
         self._backoff_max_s = backoff_max_s
         self._lock = threading.RLock()
@@ -717,6 +725,8 @@ class KubeCluster:
     def bind_pod(self, pod_key: str, node_name: str) -> None:
         """POST the pods/binding subresource — upstream default binding's
         API call (SURVEY.md §3.2 [bind])."""
+        if self.bind_latency_s > 0:
+            time.sleep(self.bind_latency_s)
         namespace, name = _split_key(pod_key)
         body = {
             "apiVersion": "v1",

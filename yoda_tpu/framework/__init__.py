@@ -39,7 +39,7 @@ from yoda_tpu.framework.interfaces import (
 )
 from yoda_tpu.framework.cyclestate import CycleState, StateData
 from yoda_tpu.framework.queue import SchedulingQueue, QueuedPodInfo
-from yoda_tpu.framework.runtime import Framework, WaitingPod
+from yoda_tpu.framework.runtime import BindExecutor, Framework, WaitingPod
 from yoda_tpu.framework.scheduler import ScheduleResult, Scheduler, SchedulerStats
 
 __all__ = [
@@ -62,6 +62,7 @@ __all__ = [
     "StateData",
     "SchedulingQueue",
     "QueuedPodInfo",
+    "BindExecutor",
     "Framework",
     "WaitingPod",
     "Scheduler",

@@ -17,9 +17,12 @@ reference's O(nodes) per-pod round-trips (reference pkg/yoda/scheduler.go:70,108
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Mapping, Sequence
+
+log = logging.getLogger("yoda_tpu.framework")
 
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.framework.cyclestate import CycleState
@@ -89,6 +92,97 @@ class WaitingPod:
                 return
             self._resolved = Status.unschedulable(message)
         self._on_resolved(self, Status.unschedulable(message))
+
+
+class BindExecutor:
+    """Bounded-concurrency bind fan-out — the bind pipeline (config
+    ``bind_workers``).
+
+    A persistent thread pool that carries bind API round-trips, and their
+    retry/backoff sleeps, OFF the scheduling thread: a gang's waitlist
+    release submits every member's allow-and-bind here and returns, so the
+    serve loop starts the next cycle's snapshot refresh and kernel dispatch
+    while the previous cycle's binds are still in flight. In-flight binds
+    stay charged to the accountant through their reservations, so the
+    overlapped dispatch already sees their capacity as consumed.
+
+    The executor is the pipeline's completion bookkeeping too:
+
+    - ``inflight()`` feeds the ``yoda_bind_inflight`` gauge and the drain
+      barrier (``Scheduler.run_until_idle`` treats pending binds as active
+      work instead of concluding idle under them);
+    - every settle fires ``on_settled`` (the scheduler wires its activity
+      signal) so drain waits are event-bound, not polled;
+    - ``stop_event`` is shared with the binder's interruptible backoff
+      sleeps: setting it (shutdown, leadership loss) aborts pending retry
+      waits promptly instead of draining up to ``retry_cap_s`` each.
+
+    Workers are created lazily on the first submit, so pipeline-disabled
+    stacks and tests never pay the threads.
+    """
+
+    def __init__(
+        self,
+        workers: int = 8,
+        *,
+        stop_event: "threading.Event | None" = None,
+        name: str = "bind",
+    ) -> None:
+        self.workers = max(int(workers), 1)
+        self.stop_event = stop_event if stop_event is not None else threading.Event()
+        # Fired (no args) after every task settles, successes and failures
+        # alike, AFTER the in-flight count dropped — a waiter woken by it
+        # observes the decrement.
+        self.on_settled: Callable[[], None] | None = None
+        self._name = name
+        self._lock = threading.Lock()
+        self._pool = None
+        self._inflight = 0
+        self.submitted = 0  # lifetime task count (tests, introspection)
+
+    def submit(self, fn: Callable[[], None]):
+        """Run ``fn`` on a worker; returns the Future. ``fn``'s exceptions
+        are logged, never propagated — bind failures are reported through
+        the resolution chain, not the future."""
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix=f"{self._name}-worker",
+                )
+            self._inflight += 1
+            self.submitted += 1
+
+        def run() -> None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — workers must never die silently
+                log.exception("bind executor task failed")
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                cb = self.on_settled
+                if cb is not None:
+                    cb()
+
+        return self._pool.submit(run)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def shutdown(self) -> None:
+        """Stop accepting work and abort pending retry sleeps. ``wait=False``
+        so a SIGTERM during a stalled bind round-trip does not block the
+        drain on the worker; the in-flight HTTP call is bounded by the API
+        client's request timeout either way."""
+        self.stop_event.set()
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 class Framework:

@@ -80,6 +80,7 @@ class Scheduler:
         pod_alive: Callable[[PodSpec], bool] | None = None,
         burst_size: int = 1,
         fence_fn: "Callable[[], bool] | None" = None,
+        bind_executor=None,
     ) -> None:
         self.framework = framework
         self.snapshot_fn = snapshot_fn
@@ -130,6 +131,16 @@ class Scheduler:
         # may already be acting on the same pods) and the serve loop parks
         # the queue until leadership returns. Settable post-construction.
         self.fence_fn = fence_fn
+        # Bind pipeline (ISSUE 4): when wired, gang releases fan their
+        # member binds out on this executor and the serve loop OVERLAPS
+        # the next cycle (snapshot refresh + kernel dispatch) with the
+        # in-flight binds. The scheduler treats pending binds as active
+        # work: run_until_idle never concludes idle under them, and every
+        # settle bumps the activity condition so drain waits stay
+        # event-bound.
+        self.bind_executor = bind_executor
+        if bind_executor is not None:
+            bind_executor.on_settled = self._signal_activity
         self._search_rotor = 0
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
@@ -137,6 +148,12 @@ class Scheduler:
         # capacity otherwise). Entries drop on bind or deletion.
         self._nominated: dict[str, str] = {}
         self._lock = threading.Lock()
+
+    def _bind_inflight(self) -> int:
+        """Binds currently in flight on the pipeline executor (0 when no
+        executor is wired — every bind then runs inline in its cycle)."""
+        ex = self.bind_executor
+        return ex.inflight() if ex is not None else 0
 
     def _fenced(self) -> bool:
         """True when a leader gate is wired and this process does NOT hold
@@ -522,13 +539,32 @@ class Scheduler:
 
     def _on_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
         """Fires when a waiting pod is allowed (bind it) or rejected
-        (roll back its reservation and requeue). Signals the drain
-        condition on exit — AFTER the bind or requeue landed, so a woken
-        ``run_until_idle`` never observes the half-resolved state."""
+        (roll back its reservation and requeue) — on the pipelined
+        release, from a bind-executor worker. Flushes any gang rollbacks
+        whose release barrier this settle completed, then signals the
+        drain condition — AFTER the bind, requeue, and rollbacks landed,
+        so a woken ``run_until_idle`` never observes the half-resolved
+        state."""
         try:
             self._do_permit_resolved(wp, status)
         finally:
-            self._signal_activity()
+            try:
+                self._flush_deferred_rollbacks()
+            finally:
+                self._signal_activity()
+
+    def _flush_deferred_rollbacks(self) -> None:
+        """Completion-barrier flush: unwind landed binds of gangs whose
+        release cohort has FULLY settled after a bind failure (every
+        in-flight sibling bound, failed, or was cascade-rejected). Runs
+        after every settle, on whichever thread settled last — an unbind
+        never races a sibling's bind still mid-air."""
+        for p in self.framework.permit_plugins:
+            hook = getattr(p, "collect_rollbacks", None)
+            if hook is None:
+                continue
+            for spec, node, why in hook(self.framework):
+                self._rollback_bound(spec, node, None, why)
 
     def _do_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
         pod = wp.pod
@@ -597,27 +633,21 @@ class Scheduler:
         """A permit-released bind failed after the binder's transient
         retries (or was fenced): give Permit plugins the chance to make
         the failure TRANSACTIONAL — the gang plugin rejects still-waiting
-        members and returns the siblings whose binds already landed, which
-        are unbound, unreserved, and requeued here. The failing member
+        members and parks the siblings whose binds already landed for a
+        deferred unwind — the actual unbind/unreserve/requeue happens in
+        ``_flush_deferred_rollbacks`` once the release cohort has fully
+        settled (the completion barrier: an unbind must never race a
+        sibling's bind still mid-air on the pipeline). The failing member
         itself goes through the caller's standard rejection path."""
-        rollbacks: list = []
         initiated = False
         for p in self.framework.permit_plugins:
             hook = getattr(p, "on_bind_failed", None)
             if hook is None:
                 continue
-            got = hook(self.framework, wp, st)
-            if got is None:
-                continue
-            initiated = True
-            rollbacks.extend(got)
-        if initiated:
-            if self.metrics is not None:
-                self.metrics.recovery_rollbacks.inc()
-            for spec, node in rollbacks:
-                self._rollback_bound(
-                    spec, node, None, f"gang rollback: {st.message}"
-                )
+            if hook(self.framework, wp, st):
+                initiated = True
+        if initiated and self.metrics is not None:
+            self.metrics.recovery_rollbacks.inc()
 
     def _rollback_bound(
         self, pod: PodSpec, node_name: str, state, why: str
@@ -816,14 +846,28 @@ class Scheduler:
             else:
                 qpi = self.queue.pop(timeout=0.0)
             if qpi is not None:
+                if self.metrics is not None and self._bind_inflight() > 0:
+                    # Pipeline overlap: this cycle's snapshot + dispatch
+                    # runs while the previous release's binds are in
+                    # flight — the serialization the pipeline removes.
+                    self.metrics.overlap_cycles.inc()
                 for q in self._pop_batch(qpi):
                     self.schedule_one(q)
                 continue
             self.framework.expire_waiting(now=self.clock())
             waiters = self.framework.waiting_pods()
-            if waiters:
+            inflight = self._bind_inflight()
+            if waiters or inflight:
+                # Pending pipelined binds are active work: their pods left
+                # the waitlist when allow() fired, but the bind API write
+                # (and any rollback it triggers) has not landed. Each
+                # settle signals the activity condition.
                 now = self.clock()
-                next_deadline = min(w.deadline for w in waiters)
+                next_deadline = (
+                    min(w.deadline for w in waiters)
+                    if waiters
+                    else now + self.DRAIN_WAIT_CAP_S
+                )
                 timeout = max(
                     min(
                         next_deadline - now,
@@ -855,7 +899,14 @@ class Scheduler:
         batch, then sweep permit expirations ONCE per iteration (the sweep
         ran twice per iteration before — once after the pop and once per
         scheduled entry — pure overhead, since expiry resolution only needs
-        to be poll_s-grained and each sweep walks the whole waitlist)."""
+        to be poll_s-grained and each sweep walks the whole waitlist).
+
+        With the bind pipeline wired, a gang release returns before its
+        binds land: the next iteration's pop -> snapshot -> kernel dispatch
+        OVERLAPS the in-flight bind I/O (yoda_overlap_cycles_total counts
+        these turns). Correctness needs no extra synchronization — the
+        in-flight members' reservations stay charged to the accountant, so
+        the overlapped evaluation already sees their capacity as consumed."""
         while not stop.is_set():
             if self._fenced():
                 # Leader fencing: park the queue until leadership returns.
@@ -866,6 +917,8 @@ class Scheduler:
                 continue
             qpi = self.queue.pop(timeout=poll_s)
             if qpi is not None:
+                if self.metrics is not None and self._bind_inflight() > 0:
+                    self.metrics.overlap_cycles.inc()
                 for q in self._pop_batch(qpi):
                     self.schedule_one(q)
             self.framework.expire_waiting(now=self.clock())

@@ -305,6 +305,27 @@ class SchedulingMetrics:
             "Binds aborted before the API write because the scheduler was "
             "fenced (leader gate reported not-leader)",
         )
+        # Bind pipeline (docs/OPERATIONS.md bind-pipeline section): wall
+        # time of one bind plugin call — retries and backoff included — and
+        # serve-loop turns whose snapshot/dispatch started while an earlier
+        # release's binds were still in flight (the overlap the pipeline
+        # exists to create; 0 with the pipeline off). The companion
+        # yoda_bind_inflight gauge reads the executor and is registered in
+        # standalone.build_stack.
+        self.bind_wall = r.histogram(
+            "yoda_bind_wall_ms",
+            "Wall milliseconds of one bind call, transient retries and "
+            "backoff sleeps included (pipelined binds accrue this on the "
+            "executor workers, not the scheduling thread)",
+            buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     1000.0, 5000.0),
+        )
+        self.overlap_cycles = r.counter(
+            "yoda_overlap_cycles_total",
+            "Scheduling turns whose snapshot refresh and kernel dispatch "
+            "overlapped in-flight binds from a previous release (the bind "
+            "pipeline working; 0 = fully serial commitment)",
+        )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
 

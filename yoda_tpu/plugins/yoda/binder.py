@@ -9,6 +9,16 @@ step the reference delegates to upstream default binding (SURVEY.md §3.2
   failure. The reference turned any transient API blip into a permanent
   "unschedulable"; here only genuine infeasibility (e.g. the pod is
   already bound elsewhere and stays that way) survives the retries.
+- **Interruptible backoff.** With ``stop_event`` wired (the bind
+  executor's event), retry sleeps wait on the event instead of
+  ``time.sleep``: shutdown and leadership loss abort a pending retry
+  immediately instead of draining up to ``retry_cap_s`` per attempt.
+- **Worker-side fencing.** With ``fenced_fn`` wired (the scheduler's
+  fence), leadership is re-checked immediately before EVERY API write —
+  each first attempt and each retry. The scheduler's own fence check runs
+  at resolution time; when binds fan out on the executor, the write can
+  happen milliseconds later on a worker, and that window must not race a
+  new leader's binds.
 - **Rollback.** ``unbind`` reverses a bind for the gang transactional
   rollback path (scheduler._do_permit_resolved): backends that can clear
   the binding do (FakeCluster.unbind_pod); against a real API server a
@@ -20,14 +30,28 @@ from __future__ import annotations
 
 import logging
 import random
+import threading
 import time
+from typing import Callable
 
 from yoda_tpu.api.types import PodSpec
-from yoda_tpu.cluster.retry import BackoffPolicy, call_with_retries
+from yoda_tpu.cluster.retry import (
+    BackoffPolicy,
+    RetryAborted,
+    call_with_retries,
+    interruptible_sleep,
+)
 from yoda_tpu.framework.cyclestate import CycleState
 from yoda_tpu.framework.interfaces import BindPlugin, Status
 
 log = logging.getLogger("yoda_tpu.binder")
+
+
+class BindFenced(RuntimeError):
+    """Raised by the pre-write fence check: this process is not leader, so
+    the bind must not reach the API. Non-retryable by classification —
+    retrying would just spin against the fence; the gang rolls back
+    transactionally instead."""
 
 
 class ClusterBinder(BindPlugin):
@@ -42,6 +66,7 @@ class ClusterBinder(BindPlugin):
         retry_cap_s: float = 1.0,
         rng: "random.Random | None" = None,
         sleep=time.sleep,
+        stop_event: "threading.Event | None" = None,
     ) -> None:
         self.cluster = cluster  # anything with bind_pod(pod_key, node_name)
         self.policy = BackoffPolicy(
@@ -52,8 +77,26 @@ class ClusterBinder(BindPlugin):
         # Seedable for deterministic chaos replays; fresh entropy otherwise.
         self.rng = rng or random.Random()
         self.sleep = sleep
+        # Interruptible backoff: when set (standalone wires the bind
+        # executor's stop event), sleeps wait on it and abort on fire.
+        self.stop_event = stop_event
+        # Worker-side leader fencing: True return = fenced, abort before
+        # the API write (standalone wires Scheduler._fenced).
+        self.fenced_fn: Callable[[], bool] | None = None
+        self.on_fenced: Callable[[], None] | None = None  # metrics hook
+        # Per-bind wall time (retries + backoff included), in ms — feeds
+        # yoda_bind_wall_ms (standalone wires the histogram).
+        self.observe_wall_ms: Callable[[float], None] | None = None
         self.retries = 0   # feeds yoda_recovery_bind_retries_total
         self.unbinds = 0   # feeds yoda_recovery_unbinds_total
+        self.fenced = 0    # worker-side fence aborts (pre-write)
+        self.aborted = 0   # retries abandoned by the stop event
+
+    def _backoff_sleep(self, delay_s: float) -> None:
+        if self.stop_event is not None:
+            interruptible_sleep(self.stop_event)(delay_s)
+            return
+        self.sleep(delay_s)
 
     def bind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
         def on_retry(attempt: int, e: BaseException) -> None:
@@ -63,16 +106,41 @@ class ClusterBinder(BindPlugin):
                 "retrying with backoff", pod.key, node_name, attempt + 1, e,
             )
 
+        def attempt() -> None:
+            # Re-checked before EVERY write, retries included: the fan-out
+            # worker may reach this point well after the scheduler's own
+            # resolution-time fence check passed.
+            if self.stop_event is not None and self.stop_event.is_set():
+                raise RetryAborted("scheduler stopping; bind abandoned")
+            if self.fenced_fn is not None and self.fenced_fn():
+                raise BindFenced(
+                    f"scheduler fenced (not leader); bind of {pod.key} "
+                    "aborted before the API write"
+                )
+            self.cluster.bind_pod(pod.key, node_name)
+
+        t0 = time.monotonic()
         try:
             call_with_retries(
-                lambda: self.cluster.bind_pod(pod.key, node_name),
+                attempt,
                 policy=self.policy,
                 rng=self.rng,
-                sleep=self.sleep,
+                sleep=self._backoff_sleep,
                 on_retry=on_retry,
             )
+        except BindFenced as e:
+            self.fenced += 1
+            if self.on_fenced is not None:
+                self.on_fenced()
+            return Status.unschedulable(str(e))
+        except RetryAborted as e:
+            self.aborted += 1
+            return Status.error(f"binding {pod.key} to {node_name}: {e}")
         except Exception as e:  # retries exhausted or genuinely infeasible
             return Status.error(f"binding {pod.key} to {node_name}: {e}")
+        finally:
+            if self.observe_wall_ms is not None:
+                self.observe_wall_ms((time.monotonic() - t0) * 1e3)
         return Status.ok()
 
     def unbind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
@@ -95,7 +163,7 @@ class ClusterBinder(BindPlugin):
                 call,
                 policy=self.policy,
                 rng=self.rng,
-                sleep=self.sleep,
+                sleep=self._backoff_sleep,
                 on_retry=lambda a, e: log.warning(
                     "unbind %s from %s failed transiently (attempt %d: %s); "
                     "retrying", pod.key, node_name, a + 1, e,

@@ -125,6 +125,15 @@ class _GangState:
     release_cohort: set[str] = field(default_factory=set)
     release_bound: dict[str, str] = field(default_factory=dict)
     bind_failed: bool = False
+    # Completion barrier (the bind pipeline, ISSUE 4): members of the
+    # release whose bind has not SETTLED yet (landed, failed, or was
+    # cascade-rejected before binding). After a failure, the landed
+    # binds to unwind park in release_rollbacks until the barrier drains
+    # — rollback API writes fire only once every in-flight sibling has
+    # settled (collect_rollbacks), never while a bind is mid-air.
+    release_pending: set[str] = field(default_factory=set)
+    release_rollbacks: list = field(default_factory=list)  # (spec, host, why)
+    rollback_ready: bool = False
     # Hosts that died (value: which kinds' deletion marked them — a Node
     # deletion is only cleared by a Node re-add, not by the agent's CR
     # republish, and vice versa). Marked on EVERY gang so a death landing
@@ -146,16 +155,21 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         reserved_fn: Callable[[str], int] | None = None,
         on_rollback: Callable[[PodSpec, str, str], None] | None = None,
         parallel_release: bool = False,
+        bind_executor=None,
     ) -> None:
         self.timeout_s = timeout_s
-        # Overlap the waitlist-release binds on a thread pool. ONLY worth
-        # it when a bind is an API round-trip (KubeCluster: ~1 ms+ each;
-        # standalone.build_stack wires True for backends with a real HTTP
-        # client): against an in-process FakeCluster a bind is
+        # Pipelined release (ISSUE 4): with both a bind executor and
+        # parallel_release True, a completed gang's member binds FAN OUT
+        # on the executor and on_pod_waiting returns without draining
+        # them — the serve loop overlaps the next cycle with the in-flight
+        # binds. ONLY worth it when a bind is real I/O (KubeCluster's API
+        # round-trips, injected bind latency; standalone.build_stack's
+        # bind_pipeline gate): against an in-process FakeCluster a bind is
         # microseconds and the thread handoff itself costs more than it
         # saves (measured: in-process gang p99 1.9 -> 5.3 ms when always
         # on).
         self.parallel_release = parallel_release
+        self.bind_executor = bind_executor
         self.reserved_fn = reserved_fn
         # (member pod, gang name, why) — standalone wires the Event
         # recorder's GangRollback reason here (VERDICT r2 #6).
@@ -165,11 +179,6 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # back) — feeds yoda_recovery_gang_rollbacks_total.
         self.bind_rollbacks = 0
         self._lock = threading.RLock()
-        # Concurrent waitlist release (on_pod_waiting): created lazily on
-        # the first multi-member release (gang-free stacks and tests never
-        # pay the threads) and persistent from then on, so the workers'
-        # per-thread pooled API connections amortize across gangs.
-        self._release_pool = None
         self._gangs: dict[str, _GangState] = {}
         self._framework = None
 
@@ -547,11 +556,16 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             complete = len(gs.waiting) + len(gs.bound) >= gs.spec.size
             targets = list(gs.waiting) if complete and not dead else []
             if targets:
-                # Release starts: arm the transactional-bind cohort. Any
-                # member's bind failure from here rolls the whole cohort
-                # back (on_bind_failed).
+                # Release starts: arm the transactional-bind cohort AND
+                # the completion barrier. Any member's bind failure from
+                # here rolls the whole cohort back (on_bind_failed), but
+                # the unwind of landed binds waits until every in-flight
+                # sibling settles (release_pending drains).
                 gs.release_cohort = set(targets)
                 gs.release_bound = {}
+                gs.release_pending = set(targets)
+                gs.release_rollbacks = []
+                gs.rollback_ready = False
                 gs.bind_failed = False
         if dead:
             wp.reject(
@@ -569,36 +583,30 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             for key in targets
             if (w := framework.get_waiting_pod(key)) is not None
         ]
-        if len(waiters) <= 1 or not self.parallel_release:
+        if (
+            len(waiters) <= 1
+            or not self.parallel_release
+            or self.bind_executor is None
+        ):
             self._observed_release(waiters, lambda w: w.allow(self.name))
             return
-        # Release members CONCURRENTLY: each allow() runs the member's
-        # bind synchronously (an API round-trip on real clusters), and a
-        # gang of N pays N-1 of them here — sequentially that is the
-        # dominant share of wire gang latency (BENCH r5 decomposition:
-        # the `visible` phase). Upstream binds from a goroutine per pod;
-        # waiting on a bounded PERSISTENT executor keeps this framework's
-        # cycle-returns-after-release semantics while the round trips
-        # overlap — persistent so the workers' per-thread keep-alive
-        # connections (KubeApiClient._pooled) amortize across gangs
-        # instead of paying a TCP handshake per release. Each WaitingPod
-        # resolves exactly once under its own lock, so a concurrent
-        # cascade reject (one member's bind failing) degrades exactly as
-        # the sequential order did.
-        if self._release_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with self._lock:
-                if self._release_pool is None:
-                    self._release_pool = ThreadPoolExecutor(
-                        max_workers=8, thread_name_prefix="gang-release"
-                    )
-        futures = [
-            self._release_pool.submit(w.allow, self.name) for w in waiters
-        ]
-        self._observed_release(
-            list(zip(waiters, futures)), lambda pair: pair[1].result()
-        )
+        # Pipelined release (ISSUE 4): each allow() runs the member's bind
+        # — an API round-trip on real clusters, retry backoff included —
+        # and a gang of N pays N-1 of them here. Fan them out on the
+        # bounded bind executor and RETURN WITHOUT DRAINING: the serve
+        # loop goes on to the next cycle's snapshot refresh and kernel
+        # dispatch while these binds are in flight (overlap), bounded by
+        # bind_workers concurrent API writes. The executor is persistent,
+        # so the workers' per-thread keep-alive connections
+        # (KubeApiClient._pooled) amortize across gangs instead of paying
+        # a TCP handshake per release. Safety: each WaitingPod resolves
+        # exactly once under its own lock; in-flight members keep their
+        # reservations charged to the accountant, so overlapped dispatches
+        # see their capacity as consumed; a member's bind failure rolls
+        # the cohort back only after every in-flight sibling settles
+        # (release_pending barrier + collect_rollbacks).
+        for w in waiters:
+            self.bind_executor.submit(lambda w=w: w.allow(self.name))
 
     @staticmethod
     def _observed_release(items, invoke) -> None:
@@ -618,14 +626,15 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             raise first_error
 
     def close(self) -> None:
-        """Release the concurrent-release executor (cli.py's drain path).
-        ``wait=False`` so a SIGTERM during a stalled bind round-trip does
-        not block the drain on the worker; the in-flight HTTP call is
-        bounded by KubeApiConfig.request_timeout_s either way (the
-        atexit join observes that cap at worst)."""
-        pool, self._release_pool = self._release_pool, None
-        if pool is not None:
-            pool.shutdown(wait=False, cancel_futures=True)
+        """Release the bind executor (cli.py's drain path). Shutdown sets
+        the executor's stop event, which also aborts any pending
+        interruptible retry sleeps in the binder; workers are not joined
+        (a SIGTERM during a stalled bind round-trip must not block the
+        drain — the in-flight HTTP call is bounded by
+        KubeApiConfig.request_timeout_s either way)."""
+        executor, self.bind_executor = self.bind_executor, None
+        if executor is not None:
+            executor.shutdown()
 
     def on_pod_resolved(self, framework, wp, status: Status) -> None:
         """Framework hook on waitlist resolution: success moves the member to
@@ -647,7 +656,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                         k: v for k, v in gs.specs.items() if k in gs.bound
                     }
                 return
-            # Rejection: roll the rest of the gang back (once).
+            # Rejection: roll the rest of the gang back (once). A cohort
+            # member rejected BEFORE its bind (cascade, host death, permit
+            # expiry) settles its slot in the release barrier — it will
+            # never reach the API.
+            gs.release_pending.discard(wp.pod.key)
+            gs.release_cohort.discard(wp.pod.key)
+            self._maybe_rollback_ready(gs)
             gs.assigned.pop(wp.pod.key, None)
             gs.specs.pop(wp.pod.key, None)
             if gs.failing:
@@ -683,13 +698,22 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
 
     # --- transactional bind rollback (failure-domain hardening) ---
 
+    def _maybe_rollback_ready(self, gs: _GangState) -> None:
+        """Under the lock: arm the deferred-rollback handoff once the
+        release cohort has FULLY settled after a failure — every in-flight
+        sibling bound, failed, or was rejected. collect_rollbacks then
+        hands the parked (spec, host, why) triples to the scheduler."""
+        if gs.bind_failed and not gs.release_pending and gs.release_rollbacks:
+            gs.rollback_ready = True
+
     def on_pod_bound(self, framework, wp) -> bool:
         """Framework hook: a permit-released pod's bind SUCCEEDED. Records
         the member in its gang's release cohort so a later sibling's bind
-        failure can roll it back. Returns False when the gang already
-        began a bind-failure rollback — the caller must then undo THIS
-        bind too (parallel-release race: binds in flight concurrently,
-        the first failure wins and the stragglers are unwound)."""
+        failure can roll it back, and settles the member's slot in the
+        release barrier. Returns False when the gang already began a
+        bind-failure rollback — the caller must then undo THIS bind too
+        (pipelined-release race: binds in flight concurrently, the first
+        failure wins and the stragglers are unwound)."""
         gang_name = gang_name_of(wp.pod.labels)
         if not gang_name:
             return True
@@ -697,31 +721,39 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             gs = self._gangs.get(gang_name)
             if gs is None or wp.pod.key not in gs.release_cohort:
                 return True
+            gs.release_pending.discard(wp.pod.key)
             if gs.bind_failed:
                 gs.bound.discard(wp.pod.key)
                 gs.assigned.pop(wp.pod.key, None)
                 gs.specs.pop(wp.pod.key, None)
+                gs.release_cohort.discard(wp.pod.key)
+                self._maybe_rollback_ready(gs)
                 return False
             gs.release_bound[wp.pod.key] = wp.node_name
             return True
 
-    def on_bind_failed(
-        self, framework, wp, status: Status
-    ) -> "list[tuple[PodSpec, str]] | None":
+    def on_bind_failed(self, framework, wp, status: Status) -> "bool | None":
         """Framework hook: a permit-released member's bind FAILED after the
         binder's transient retries. Makes the gang bind transactional —
         the all-or-nothing contract the fit gate gives placement, extended
         through the bind phase: siblings whose binds already landed this
-        release are returned as (pod, host) pairs for the scheduler to
-        unbind/unreserve/requeue, still-waiting members are rejected (the
-        standard cascade releases their reservations), and the gang's
-        bookkeeping forgets the release so the WHOLE gang re-queues
-        untouched. Returns None when no new rollback was initiated (not a
-        gang member, or the cohort is already rolling back — repeat
+        release are parked for unbind/unreserve/requeue, still-waiting
+        members are rejected (the standard cascade releases their
+        reservations), and the gang's bookkeeping forgets the release so
+        the WHOLE gang re-queues untouched. The landed-bind unwinds are
+        DEFERRED behind the release barrier: the scheduler collects them
+        via ``collect_rollbacks`` once every in-flight sibling has settled
+        — an unbind must never race a sibling's bind still mid-air.
+        Returns True when this call initiated the rollback, None otherwise
+        (not a gang member, or the cohort is already rolling back — repeat
         failures do only their own member bookkeeping)."""
         gang_name = gang_name_of(wp.pod.labels)
         if not gang_name:
             return None
+        why = (
+            f"member {wp.pod.key} failed to bind: {status.message}; "
+            "rolling the gang back"
+        )
         with self._lock:
             gs = self._gangs.get(gang_name)
             if gs is None:
@@ -735,7 +767,9 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             gs.assigned.pop(wp.pod.key, None)
             gs.specs.pop(wp.pod.key, None)
             gs.release_cohort.discard(wp.pod.key)
+            gs.release_pending.discard(wp.pod.key)
             if already:
+                self._maybe_rollback_ready(gs)
                 return None
             rollbacks: list[tuple[PodSpec, str]] = []
             for key, host in gs.release_bound.items():
@@ -744,17 +778,15 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 gs.assigned.pop(key, None)
                 if spec is not None:
                     rollbacks.append((spec, host))
+                    gs.release_rollbacks.append((spec, host, why))
             gs.release_bound = {}
             targets = list(gs.waiting)
             gs.plan = None
             self.bind_rollbacks += 1
-        why = (
-            f"member {wp.pod.key} failed to bind: {status.message}; "
-            "rolling the gang back"
-        )
+            self._maybe_rollback_ready(gs)
         log.warning(
-            "gang %s: bind failure on %s — unbinding %d landed member(s), "
-            "cascading %d waiting member(s)",
+            "gang %s: bind failure on %s — rolling back %d landed member(s) "
+            "once the release settles, cascading %d waiting member(s)",
             gang_name, wp.pod.key, len(rollbacks), len(targets),
         )
         if self.on_rollback is not None:
@@ -769,7 +801,22 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 if self.on_rollback is not None:
                     self.on_rollback(w.pod, gang_name, why)
                 w.reject(f"gang {why}")
-        return rollbacks
+        return True
+
+    def collect_rollbacks(self, framework) -> "list[tuple[PodSpec, str, str]]":
+        """Framework hook, polled by the scheduler after every release
+        settle: the deferred (spec, host, why) unwinds of gangs whose
+        release cohort has FULLY settled after a bind failure. Each
+        rollback is returned exactly once; the scheduler unbinds,
+        unreserves, and requeues them (_rollback_bound)."""
+        out: list[tuple[PodSpec, str, str]] = []
+        with self._lock:
+            for gs in self._gangs.values():
+                if gs.rollback_ready:
+                    gs.rollback_ready = False
+                    out.extend(gs.release_rollbacks)
+                    gs.release_rollbacks = []
+        return out
 
     def on_unbind_failed(self, framework, pod: PodSpec, node_name: str) -> None:
         """Framework hook: a rollback's unbind FAILED, so the member

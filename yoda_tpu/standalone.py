@@ -16,7 +16,7 @@ from dataclasses import dataclass
 from yoda_tpu.cluster import Event, FakeCluster, InformerCache
 from yoda_tpu.cluster.events import EventRecorder
 from yoda_tpu.config import SchedulerConfig
-from yoda_tpu.framework import Framework, Scheduler, SchedulingQueue
+from yoda_tpu.framework import BindExecutor, Framework, Scheduler, SchedulingQueue
 from yoda_tpu.observability import SchedulingMetrics
 from yoda_tpu.plugins.yoda import default_plugins
 from yoda_tpu.plugins.yoda.accounting import ChipAccountant
@@ -37,6 +37,8 @@ class Stack:
     preemption: TpuPreemption | None = None
     metrics: SchedulingMetrics | None = None
     events: EventRecorder | None = None
+    binder: ClusterBinder | None = None
+    bind_executor: BindExecutor | None = None
 
 
 def build_stack(
@@ -50,6 +52,7 @@ def build_stack(
     metrics: SchedulingMetrics | None = None,
     scheduler_names: "tuple[str, ...] | None" = None,
     clock=time.monotonic,
+    stop_event: "threading.Event | None" = None,
 ) -> Stack:
     """Build a fully-wired scheduler stack against ``cluster`` (a fresh
     FakeCluster by default). Watchers are registered list-then-watch, so a
@@ -78,15 +81,36 @@ def build_stack(
         else None
     )
 
+    # Bind pipeline (docs/OPERATIONS.md bind-pipeline section): the
+    # bounded executor that fans gang releases out and carries bind
+    # retry/backoff sleeps off the scheduling thread. `stop_event` (cli
+    # passes its serve stop) doubles as the binder's interruptible-sleep
+    # event, so shutdown and leadership loss abort pending retries
+    # promptly. Async fan-out engages only when binds are real I/O —
+    # remote API round-trips or injected bind latency — unless forced by
+    # config; in-process microsecond binds stay synchronous (the thread
+    # handoff would cost more than it hides).
+    bind_executor = (
+        BindExecutor(config.bind_workers, stop_event=stop_event)
+        if config.bind_workers > 0
+        else None
+    )
+    pipelined = bind_executor is not None and (
+        config.bind_pipeline == "on"
+        or (
+            config.bind_pipeline == "auto"
+            and (
+                getattr(cluster, "remote_binds", False)
+                or getattr(cluster, "bind_latency_s", 0.0) > 0.0
+            )
+        )
+    )
     gang = GangPlugin(
         timeout_s=config.gang_permit_timeout_s,
         reserved_fn=accountant.chips_in_use,
         on_rollback=recorder.gang_rollback if recorder else None,
-        # Overlap waitlist-release binds only when each bind is a real
-        # API round-trip (KubeCluster declares remote_binds = True);
-        # in-process binds are microseconds and the thread handoff would
-        # cost more than it saves (gang.py parallel_release).
-        parallel_release=getattr(cluster, "remote_binds", False),
+        parallel_release=pipelined,
+        bind_executor=bind_executor,
     )
     plugins = default_plugins(
         mode=config.mode,
@@ -138,6 +162,9 @@ def build_stack(
         retry_attempts=config.bind_retry_attempts,
         retry_base_s=config.bind_retry_base_s,
         retry_cap_s=config.bind_retry_cap_s,
+        # Interruptible backoff: the executor's stop event (set on
+        # shutdown / leadership loss) aborts pending retry sleeps.
+        stop_event=bind_executor.stop_event if bind_executor else None,
     )
     plugins.append(binder)
     framework = Framework(plugins)
@@ -190,6 +217,20 @@ def build_stack(
             lambda: sum(b.unbinds for b in bacc),
         )
     bacc.append(binder)
+
+    # Bind-pipeline gauge: binds currently in flight on the executor(s)
+    # (accumulator pattern, as above — one family, summed over profiles).
+    if bind_executor is not None:
+        eacc = getattr(metrics, "_bind_executors", None)
+        if eacc is None:
+            eacc = metrics._bind_executors = []
+            metrics.registry.gauge(
+                "yoda_bind_inflight",
+                "Bind API calls currently in flight on the bind executor "
+                "(the pipeline's overlap window; 0 = no pending binds)",
+                lambda: float(sum(e.inflight() for e in eacc)),
+            )
+        eacc.append(bind_executor)
 
     def on_change(event: Event) -> None:
         # New/changed TPU metrics may make parked pods schedulable; pod
@@ -413,7 +454,16 @@ def build_stack(
         ),
         pod_alive=informer.pod_schedulable,
         burst_size=config.batch_requests,
+        bind_executor=bind_executor,
     )
+    # Worker-side fencing + pipeline observability: the binder re-checks
+    # the scheduler's CURRENT fence immediately before every bind API
+    # write (fence_fn is settable post-construction — cli wires the
+    # leader elector later — so the indirection through _fenced reads the
+    # live value), and feeds the yoda_bind_wall_ms histogram.
+    binder.fenced_fn = scheduler._fenced
+    binder.on_fenced = metrics.fenced_binds.inc
+    binder.observe_wall_ms = metrics.bind_wall.observe
     return Stack(
         cluster,
         informer,
@@ -425,6 +475,8 @@ def build_stack(
         preemption,
         metrics,
         recorder,
+        binder=binder,
+        bind_executor=bind_executor,
     )
 
 
@@ -433,6 +485,7 @@ def build_profile_stacks(
     config: SchedulerConfig,
     *,
     clock=time.monotonic,
+    stop_event: "threading.Event | None" = None,
 ) -> "list[Stack]":
     """One stack per scheduler profile (upstream KubeSchedulerConfiguration
     profiles: one process, several schedulerNames with different plugin
@@ -471,6 +524,7 @@ def build_profile_stacks(
             metrics=shared_metrics,
             scheduler_names=names,
             clock=clock,
+            stop_event=stop_event,
         )
     ]
     for prof in config.profiles:
@@ -484,6 +538,7 @@ def build_profile_stacks(
                 metrics=shared_metrics,
                 scheduler_names=names,
                 clock=clock,
+                stop_event=stop_event,
             )
         )
     # Pending-placement visibility must span profiles: a gang member of
