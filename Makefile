@@ -40,14 +40,17 @@ smoke:
 	$(PY) bench.py --smoke
 
 # Fault-injection suite (fixed seed, replayable): gang bind rollback,
-# transient-error retry, dispatch fallback chain, leader fencing, and the
-# seeded stress sweep — tests/test_chaos.py, slow tests included. The fast
-# chaos tests also run in tier-1 (`make test` / the default gate), so
-# rollback-path regressions fail CI without this target; this target adds
-# the stress sweep. Override the sweep seed via CHAOS_SEED (the test reads
-# its default from the source; the seed is printed on failure for replay).
+# transient-error retry, dispatch fallback chain, leader fencing, the
+# seeded stress sweep, and the scheduler_crash failover sweep (leader
+# killed mid-gang at a seeded bind, fresh scheduler promoted over the
+# same cluster) — tests/test_chaos.py + tests/test_failover.py, slow
+# tests included. The fast chaos/failover tests also run in tier-1
+# (`make test` / the default gate), so rollback- and resync-path
+# regressions fail CI without this target; this target adds the sweeps.
+# Override the sweep seed via CHAOS_SEED (the test reads its default
+# from the source; the seed is printed on failure for replay).
 chaos:
-	$(PY) -m pytest tests/test_chaos.py -q
+	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py -q
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

@@ -1111,6 +1111,56 @@ def _pallas_probe() -> dict:
             )
         except Exception as e:  # pragma: no cover
             out["pallas_burst_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            # The 65536 kernel-sweep shape — the scale whose burst lowering
+            # BENCH_r05 recorded as failing (last-two-dims divisibility in
+            # Mosaic; fixed by the [K, 8, Np] host_ok padding, see
+            # _pallas_eval_burst). block_n=8192 keeps every block
+            # (8, 8192)-tiled, so the divisibility invariant holds at this
+            # scale too; a failure is recorded as an explicit skip with the
+            # reason rather than silently omitting the shape.
+            from yoda_tpu.config import Weights
+            from yoda_tpu.ops.kernel import DeviceFleetKernel
+            from yoda_tpu.ops.pallas_kernel import PallasFleetKernel
+
+            arrays_big = _synthetic_arrays(65536)
+            k = 2
+            n_pad = arrays_big.node_valid.shape[0]
+            rng = np.random.default_rng(5)
+            host_ok_k = (rng.random((k, n_pad)) > 0.2).astype(np.int32)
+            requests = [
+                KernelRequest(1 + i, 1024 * (i % 2), 0, 0, 0)
+                for i in range(k)
+            ]
+            dyn = np.stack(
+                [
+                    np.asarray(arrays_big.fresh, dtype=np.int32),
+                    np.asarray(arrays_big.reserved_chips, dtype=np.int32),
+                    np.asarray(arrays_big.claimed_hbm_mib, dtype=np.int32),
+                    np.asarray(arrays_big.host_ok, dtype=np.int32),
+                ]
+            )
+            pk = PallasFleetKernel(
+                Weights(), interpret=interpret, block_n=8192
+            )
+            pk.put_static(arrays_big)
+            t0 = time.monotonic()
+            got_b = pk.evaluate_burst(dyn, host_ok_k, requests)
+            big_s = time.monotonic() - t0
+            xk = DeviceFleetKernel(Weights())
+            xk.put_static(arrays_big)
+            want_b = xk.evaluate_burst(dyn, host_ok_k, requests)
+            out["pallas_burst_65536_parity"] = all(
+                np.array_equal(g.scores, w.scores)
+                and g.best_index == w.best_index
+                for g, w in zip(got_b, want_b)
+            )
+            out["pallas_burst_65536_first_eval_s"] = round(big_s, 2)
+        except Exception as e:  # pragma: no cover
+            out["pallas_burst_65536_skipped"] = (
+                f"shape unsupported on this backend: "
+                f"{type(e).__name__}: {e}"[:200]
+            )
         return out
     except Exception as e:  # pragma: no cover - probe must never kill bench
         print(f"pallas probe failed: {e}", file=sys.stderr)

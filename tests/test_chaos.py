@@ -28,6 +28,8 @@ from yoda_tpu.testing.chaos import (
     install_chaos_kernel,
 )
 
+CHAOS_SEED_DEFAULT = "20260804"
+
 
 def gang_pods(name, n, chips=4):
     labels = {
@@ -545,3 +547,98 @@ class TestChaosStress:
             f"seed {seed}: converged to {len(bound_pods(stack))} bound; "
             f"fired={plan.fired}"
         )
+
+
+@pytest.mark.slow
+class TestSchedulerCrashSweep:
+    """scheduler_crash mode in the seeded sweep (crash-safe failover PR):
+    each generation schedules a crash at a seeded bind invocation; the
+    serving scheduler dies there mid-gang, a fresh stack is promoted over
+    the SAME cluster, its warm-start resync rebuilds state, and the
+    standing invariants — no double bind, no oversubscription, no leaked
+    reservation, no partially-bound gang at rest — must hold across every
+    crash/promotion cycle until the workload converges."""
+
+    def test_failover_invariants_under_seeded_crashes(self):
+        import os
+
+        from yoda_tpu.cluster.fake import FakeCluster
+
+        seed = int(os.environ.get("CHAOS_SEED", CHAOS_SEED_DEFAULT))
+        rng = random.Random(seed ^ 0xC4A5)
+        inner = FakeCluster()
+        agent = FakeTpuAgent(inner)
+        for i in range(8):
+            agent.add_host(f"host-{i}", generation="v5p", chips=8)
+
+        def promote():
+            """A 'new process': fresh front over the same cluster, fresh
+            stack, warm-start resync — with the next seeded crash armed."""
+            plan = ChaosPlan(
+                [
+                    FaultSpec(
+                        "crash",
+                        rng.randrange(0, 16),
+                        rng.choice(("after_bind", "before_bind")),
+                    )
+                ],
+                seed=seed,
+            )
+            front = ChaosCluster(inner=inner, plan=plan)
+            stack = build_stack(
+                cluster=front,
+                config=SchedulerConfig(
+                    mode="batch",
+                    batch_requests=4,
+                    gang_permit_timeout_s=2.0,
+                ),
+            )
+            agent.publish_all()
+            stack.reconciler.resync()
+            return front, stack
+
+        def check_invariants(stack, waves_created):
+            snapshot = stack.informer.snapshot()
+            for ni in snapshot.infos():
+                cap = len(ni.tpu.chips) if ni.tpu else 0
+                used = stack.accountant.chips_in_use(ni.name)
+                assert used <= cap, f"{ni.name} oversubscribed: {used}/{cap}"
+            if stack.framework.waiting_pods():
+                return  # parked members legitimately hold partial state
+            by_gang: dict[str, int] = {}
+            for p in inner.list_pods():
+                if p.node_name and p.labels.get("tpu/gang"):
+                    g = p.labels["tpu/gang"]
+                    by_gang[g] = by_gang.get(g, 0) + 1
+            for g, n in by_gang.items():
+                assert n in (0, 4), f"seed {seed}: gang {g} partial: {n}/4"
+            assert_no_leaked_reservations(stack)
+
+        front, stack = promote()
+        failovers = 0
+        for wave in range(6):
+            for pod in gang_pods(f"wave-{wave}", 4, chips=2):
+                # User/controller writes go to the backing cluster — they
+                # survive scheduler death.
+                inner.create_pod(pod)
+            stack.scheduler.run_until_idle(max_wall_s=20)
+            if front.crashed.is_set():
+                failovers += 1
+                front, stack = promote()
+                stack.scheduler.run_until_idle(max_wall_s=20)
+            check_invariants(stack, wave + 1)
+        for _ in range(6):
+            if len(bound_pods(stack)) == 24:
+                break
+            if front.crashed.is_set():
+                failovers += 1
+                front, stack = promote()
+            stack.scheduler.run_until_idle(max_wall_s=20)
+        check_invariants(stack, 6)
+        assert len(bound_pods(stack)) == 24, (
+            f"seed {seed}: converged to {len(bound_pods(stack))} bound "
+            f"after {failovers} failover(s)"
+        )
+        # The sweep must actually exercise the crash path: the seeded
+        # schedule fires well inside 6 waves x 4 binds.
+        assert failovers >= 1, f"seed {seed}: no crash fired"

@@ -464,12 +464,18 @@ class TestDeletedQueuedPod:
         )
         stack.scheduler.run_until_idle()
         assert len(stack.queue) == 1  # parked in backoff
-        # The delete event itself reactivates the parked pod (build_stack's
-        # on_change calls move_all_to_active for deletions).
+        # Delete-event fast path (failover PR): the deletion removes the
+        # queue entry AT EVENT TIME — no further cycle runs for the dead
+        # pod (before this, the entry lingered until its next pop's
+        # alive-check reported "gone").
+        cycles_before = len(stack.scheduler.stats.results)
         stack.cluster.delete_pod("default/wanter")
-        stack.scheduler.run_until_idle()
         assert len(stack.queue) == 0
-        assert stack.scheduler.stats.results[-1].outcome == "gone"
+        stack.scheduler.run_until_idle()
+        assert all(
+            r.pod_key != "default/wanter"
+            for r in stack.scheduler.stats.results[cycles_before:]
+        )
 
 
 class TestSearchTruncation:

@@ -431,6 +431,79 @@ class TestGangBatchedDispatch:
             assert stack.accountant.chips_in_use(h) <= 4, h
 
 
+class TestDeleteEventFastPath:
+    """Satellite of the crash-safe failover PR: a watch ``deleted`` for a
+    queued / backoff / Permit-parked pod takes effect AT EVENT TIME —
+    before this, only host deletions cancelled gang waits, and a deleted
+    member left its siblings holding reservations for the full 120 s
+    permit timeout."""
+
+    def test_deleting_parked_member_cancels_gang_wait_immediately(self):
+        stack, agent = make_stack()
+        for i in range(4):
+            agent.add_host(f"h{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        for pod in gang_pods("g", 3)[:2]:
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert len(stack.framework.waiting_pods()) == 2
+        assert sum(stack.accountant.chips_by_node().values()) == 8
+        # The deletion alone — no expiry sweep, no scheduling cycle —
+        # resolves the deleted member's wait and cascades the sibling,
+        # releasing every reservation synchronously with the event.
+        stack.cluster.delete_pod("default/g-0")
+        assert stack.framework.waiting_pods() == []
+        assert sum(stack.accountant.chips_by_node().values()) == 0
+        # The surviving sibling is re-queued, not lost: a third member's
+        # arrival later completes the (now 2-member-short) gang normally.
+        for pod in gang_pods("g", 3)[1:]:
+            if stack.cluster.get_pod(f"default/{pod.name}") is None:
+                stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        # g-0 is gone; g-1 and g-2 alone cannot complete a size-3 gang.
+        assert bound == []
+
+    def test_deleting_backoff_member_removes_queue_entry(self):
+        stack, agent = make_stack()
+        agent.add_host("tiny", generation="v5p", chips=2)
+        agent.publish_all()
+        # One member of a gang the fleet cannot admit: parks in backoff.
+        stack.cluster.create_pod(gang_pods("big", 4)[0])
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert len(stack.queue) == 1
+        cycles = len(stack.scheduler.stats.results)
+        stack.cluster.delete_pod("default/big-0")
+        # Removed at event time: no phantom depth, no "gone" cycle later.
+        assert len(stack.queue) == 0
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert len(stack.scheduler.stats.results) == cycles
+
+    def test_deleting_queued_member_fuses_remaining_gang(self):
+        # A deleted ACTIVE-queue member must not wedge its siblings: the
+        # entry disappears with the event and the others schedule on
+        # their own barrier when the replacement arrives.
+        stack, agent = make_stack()
+        for i in range(2):
+            agent.add_host(f"h{i}", generation="v5p", chips=4)
+        agent.publish_all()
+        pods = gang_pods("q", 2)
+        stack.cluster.create_pod(pods[0])
+        # Delete while still queued (no cycle has run).
+        stack.cluster.delete_pod("default/q-0")
+        assert len(stack.queue) == 0
+        # A fresh copy of the gang completes whole.
+        for pod in gang_pods("q", 2):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        placements = {
+            p.name: p.node_name
+            for p in stack.cluster.list_pods()
+            if p.node_name
+        }
+        assert sorted(placements) == ["q-0", "q-1"]
+
+
 class TestNodeFailureMidGang:
     """SURVEY.md §5 fault-injection: a planned host dies while members wait
     at the Permit barrier. The waitlist must expire, the cascade must roll

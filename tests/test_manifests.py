@@ -48,6 +48,10 @@ class TestSchedulerManifest:
         (container,) = spec["containers"]
         assert any(a.startswith("--config=") for a in container["args"])
         assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        # Readiness is DISTINCT from liveness: /readyz gates routing on
+        # leadership + informer sync + the warm-start resync, while a
+        # standby must stay alive (unrestarted) on /healthz.
+        assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
         (vol,) = spec["volumes"]
         assert vol["configMap"]["name"] == "yoda-tpu-scheduler-config"
 

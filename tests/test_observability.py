@@ -161,6 +161,64 @@ class TestMetricsServer:
             server.stop()
 
 
+class TestFailoverMetrics:
+    """Crash-safe failover PR: the warm-start resync and drift reconciler
+    expose their work as first-class series — the runbook's "how do I
+    know what the promoted scheduler did" answer."""
+
+    def test_resync_and_reconciler_families_exposed(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        text = stack.metrics.registry.render_prometheus()
+        for family in (
+            "yoda_resync_adopted_gangs",
+            "yoda_resync_rolled_back_gangs",
+            "yoda_resync_rebuilt_reservations",
+            "yoda_resync_duration_ms",
+            "yoda_reconciler_leaked_reservations_total",
+            "yoda_reconciler_ghost_pods_total",
+            "yoda_reconciler_stranded_waits_total",
+        ):
+            assert f"\n{family} " in text, family
+
+    def test_resync_pass_moves_the_series(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        # A bind the watch stream dropped: resync rebuilds its claim.
+        stack.cluster.suppress_kinds.add("Pod")
+        ghost = PodSpec("ghost", labels={"tpu/chips": "2"})
+        ghost.node_name = "host"
+        ghost.phase = "Running"
+        stack.cluster.create_pod(ghost)
+        stack.cluster.suppress_kinds.clear()
+        stack.reconciler.resync()
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_resync_rebuilt_reservations 1.0" in text
+        # Duration gauge reflects the pass that just ran.
+        assert "yoda_resync_duration_ms 0.0\n" not in text
+
+    def test_reconciler_counters_move_on_repair(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.accountant._claim("leak-uid", "host", 1)
+        stack.reconciler.reconcile()
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_reconciler_leaked_reservations_total 1.0" in text
+
+    def test_readyz_defaults_open_without_ready_fn(self):
+        stack, _ = make_stack()
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert urllib.request.urlopen(f"{base}/readyz").status == 200
+        finally:
+            server.stop()
+
+
 class TestQueueDepthGauges:
     def test_depths_flow_to_metrics(self):
         from yoda_tpu.agent import FakeTpuAgent

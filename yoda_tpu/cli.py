@@ -100,11 +100,37 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     stacks = build_profile_stacks(cluster, config, stop_event=stop)
     stack = stacks[0]
 
+    # Readiness (/readyz, distinct from /healthz liveness): the Deployment
+    # must not route to a replica that is alive but still a standby or
+    # still rebuilding state. Ready = leadership held (the gate is swapped
+    # in below when --leader-elect is on) AND every profile's warm-start
+    # resync has completed AND we are not draining. The informer-sync half
+    # is implied: _build_kube_cluster() blocked on wait_for_sync above.
+    leader_gate: list = [lambda: True]
+
+    def _ready() -> bool:
+        return (
+            not stop.is_set()
+            and leader_gate[0]()
+            and all(st.reconciler.resynced.is_set() for st in stacks)
+        )
+
     metrics_srv = None
     if args.metrics_port >= 0:
-        metrics_srv = MetricsServer(stack.metrics, port=args.metrics_port)
+        metrics_srv = MetricsServer(
+            stack.metrics, port=args.metrics_port, ready_fn=_ready
+        )
         metrics_srv.start()
         print(f"metrics on :{metrics_srv.port}/metrics", file=sys.stderr)
+
+    # Warm-start resync: each profile's serve loop runs its reconciler's
+    # resync pass ONCE, after the fence first admits leadership and
+    # before the first queue pop — cluster truth is re-listed, bound
+    # pods' reservations charged, and every partially-bound gang adopted
+    # or rolled back whole BEFORE any post-promotion bind can happen
+    # (/readyz flips only once this completes, via resynced above).
+    for st in stacks:
+        st.scheduler.on_serve_start = st.reconciler.resync
 
     _install_stop_handlers(stop)
 
@@ -134,6 +160,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             # binds.
             for st in stacks:
                 st.scheduler.fence_fn = elector.is_leader
+            leader_gate[0] = elector.is_leader  # /readyz follows the lease
             became_leader = threading.Event()
 
             def _on_lost() -> None:
@@ -183,6 +210,21 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             )
             for st in stacks[1:]
         ]
+        # Background drift reconciler: repairs leaked reservations, ghost
+        # bindings, and stranded Permit waits while serving. Started here
+        # — with (or after) leadership — never on a standby, whose
+        # repairs would fight the live leader's state.
+        if config.reconcile_period_s > 0:
+            extra_threads.extend(
+                threading.Thread(
+                    target=st.reconciler.run_forever,
+                    args=(stop,),
+                    kwargs={"period_s": config.reconcile_period_s},
+                    name=f"reconciler-{st.informer.scheduler_name}",
+                    daemon=True,
+                )
+                for st in stacks
+            )
         for t in extra_threads:
             t.start()
         stack.scheduler.serve_forever(stop)

@@ -63,6 +63,12 @@ class FakeCluster:
         self._rv = 0
         # Pod keys whose eviction a PodDisruptionBudget would block (tests).
         self.eviction_blocked: set[str] = set()
+        # Watch-drop injection (failover / reconciler tests): events of
+        # these kinds mutate the store but are NOT delivered to watchers
+        # — the store (cluster truth) and the informer caches diverge
+        # exactly the way a dropped watch stream makes them diverge, and
+        # the drift reconciler's repair is what re-converges them.
+        self.suppress_kinds: set[str] = set()
 
     # --- watch ---
 
@@ -88,6 +94,8 @@ class FakeCluster:
                     fn(Event("added", "Pod", pod))
 
     def _emit(self, event: Event) -> None:
+        if event.kind in self.suppress_kinds:
+            return  # injected watch drop: store updated, stream silent
         for fn in list(self._watchers):
             fn(event)
 

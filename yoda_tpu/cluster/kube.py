@@ -851,6 +851,20 @@ class KubeCluster:
         with self._lock:
             return list(self._pods.values())
 
+    def resync_pods(self) -> None:
+        """Force one pod LIST against the API server and reconcile the
+        local store to it — the failover reconciler's truth refresh
+        (framework/reconciler.py). The diff replays through every
+        registered watcher as added/modified/deleted events (_list_rv's
+        contract), so a bind or deletion the watch stream dropped is
+        repaired in the informer, the accountant, and the gang plugin in
+        one pass. The watch loop keeps streaming from its own
+        resourceVersion; re-applying an already-seen change is a no-op
+        (same rv -> no event)."""
+        target = next((t for t in self._targets if t.kind == "Pod"), None)
+        if target is not None:
+            self._list_rv(target)
+
     # --- FakeCluster surface: TpuNodeMetrics CRs (agent side) ---
 
     def put_tpu_metrics(self, tpu: TpuNodeMetrics) -> None:

@@ -144,6 +144,21 @@ class SchedulerConfig:
     # stay synchronous (the thread handoff would cost more than it
     # hides). "on" forces the pipeline, "off" forbids it.
     bind_pipeline: str = "auto"
+    # Crash-safe failover (framework/reconciler.py): how a promoted
+    # scheduler's warm-start resync treats a PARTIALLY-BOUND gang left by
+    # the dead leader. > 0: the gang is ADOPTED — its bound members stay,
+    # their claims are charged, and the remaining members get this many
+    # seconds to complete the gang before the drift reconciler rolls the
+    # whole thing back via the unbind path. 0: never adopt — every
+    # partial gang is rolled back whole at resync (the conservative
+    # policy: strictly no state inherited from the dead leader).
+    failover_adopt_window_s: float = 60.0
+    # Period of the background drift reconciler (leaked reservations,
+    # ghost bindings the watch stream dropped, permit waits whose pod was
+    # deleted). Each round diffs local accounting against cluster truth;
+    # on a real API server it re-LISTs pods, so keep it tens of seconds.
+    # 0 disables the background loop (the warm-start resync still runs).
+    reconcile_period_s: float = 30.0
     # Cluster events retry a parked pod immediately through this many
     # scheduling attempts; beyond it the pod's exponential backoff timer
     # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue
@@ -262,6 +277,24 @@ class SchedulerConfig:
             raise ValueError(
                 "bind_pipeline='on' requires bind_workers >= 1 (the "
                 "pipeline IS the executor)"
+            )
+        if not isinstance(
+            cfg.failover_adopt_window_s, (int, float)
+        ) or isinstance(
+            cfg.failover_adopt_window_s, bool
+        ) or cfg.failover_adopt_window_s < 0:
+            raise ValueError(
+                "failover_adopt_window_s must be >= 0 (0 = never adopt), "
+                f"got {cfg.failover_adopt_window_s!r}"
+            )
+        if not isinstance(
+            cfg.reconcile_period_s, (int, float)
+        ) or isinstance(
+            cfg.reconcile_period_s, bool
+        ) or cfg.reconcile_period_s < 0:
+            raise ValueError(
+                "reconcile_period_s must be >= 0 (0 disables the "
+                f"background reconciler), got {cfg.reconcile_period_s!r}"
             )
         if (
             isinstance(cfg.immediate_retry_attempts, bool)

@@ -243,6 +243,53 @@ class SchedulingQueue:
             qpi.attempts += 1
         return out
 
+    def find(self, uid: str) -> "PodSpec | None":
+        """The queued spec for a pod uid, wherever it is parked (active /
+        backoff / unresolvable) — the drift reconciler recovers the full
+        object this way when it must replay a dropped deletion for a pod
+        that exists nowhere else anymore."""
+        with self._lock:
+            for item in self._active:
+                if item.qpi.pod.uid == uid:
+                    return item.qpi.pod
+            for _, _, qpi in self._backoff:
+                if qpi.pod.uid == uid:
+                    return qpi.pod
+            for qpi in self._unschedulable.values():
+                if qpi.pod.uid == uid:
+                    return qpi.pod
+        return None
+
+    def remove(self, uid: str) -> bool:
+        """Drop every entry for the pod with this uid from all three pools
+        — the delete-event fast path: a watch ``deleted`` removes the pod
+        from the queue NOW instead of waiting for its next pop's
+        pod-alive check (which, for a pod deep in backoff, could be 10 s
+        of phantom queue depth away). Returns whether anything was
+        removed."""
+        removed = False
+        with self._cond:
+            active = [it for it in self._active if it.qpi.pod.uid != uid]
+            if len(active) != len(self._active):
+                heapq.heapify(active)
+                self._active = active
+                removed = True
+            backoff = [e for e in self._backoff if e[2].pod.uid != uid]
+            if len(backoff) != len(self._backoff):
+                heapq.heapify(backoff)
+                self._backoff = backoff
+                removed = True
+            for key in [
+                k
+                for k, q in self._unschedulable.items()
+                if q.pod.uid == uid
+            ]:
+                del self._unschedulable[key]
+                removed = True
+        if removed:
+            self._fire_activity()
+        return removed
+
     def restore(self, qpi: QueuedPodInfo) -> None:
         """Return a popped-but-unscheduled entry to the active queue (the
         burst pop un-pops gang members it encounters so their own pop runs

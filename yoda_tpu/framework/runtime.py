@@ -463,6 +463,18 @@ class Framework:
         with self._waiting_lock:
             return self._waiting.get(pod_key)
 
+    def cancel_waiting(self, pod_key: str, message: str) -> bool:
+        """Reject ONE waiting pod by key, if present — the delete-event
+        fast path and the drift reconciler cancel a deleted pod's Permit
+        wait immediately instead of letting it eat the full timeout (its
+        gang cascade then releases every sibling's reservation). Returns
+        whether a wait was actually cancelled."""
+        wp = self.get_waiting_pod(pod_key)
+        if wp is None:
+            return False
+        wp.reject(message)
+        return True
+
     def expire_waiting(self, *, now: float | None = None) -> int:
         """Reject waiting pods past their Permit deadline. Returns count."""
         now = time.monotonic() if now is None else now

@@ -141,6 +141,15 @@ class Scheduler:
         self.bind_executor = bind_executor
         if bind_executor is not None:
             bind_executor.on_settled = self._signal_activity
+        # Warm-start gate (crash-safe failover): when wired (cli.py sets
+        # the stack reconciler's resync), serve_forever invokes this ONCE,
+        # after the fence first reports leadership but BEFORE the first
+        # queue pop — so the resync pass (rebuild reservations from
+        # cluster truth, adopt/rollback partial gangs) completes before
+        # any post-promotion bind can happen. A raising hook propagates:
+        # serving on un-resynced state risks double-placement, so the
+        # process fails closed and restarts into standby.
+        self.on_serve_start: "Callable[[], None] | None" = None
         self._search_rotor = 0
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
@@ -915,6 +924,12 @@ class Scheduler:
                 self.framework.expire_waiting(now=self.clock())
                 stop.wait(poll_s)
                 continue
+            if self.on_serve_start is not None:
+                # Warm-start resync: runs exactly once, after the fence
+                # first admits leadership and before the first pop — no
+                # bind can precede it (the /readyz contract).
+                hook, self.on_serve_start = self.on_serve_start, None
+                hook()
             qpi = self.queue.pop(timeout=poll_s)
             if qpi is not None:
                 if self.metrics is not None and self._bind_inflight() > 0:

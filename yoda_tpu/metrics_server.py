@@ -7,6 +7,12 @@ endpoint is first-party and dependency-free (stdlib http.server):
     GET /metrics  -> Prometheus text exposition of the registry
     GET /healthz  -> 200 "ok" (liveness; the Deployment probes this,
                      deploy/yoda-tpu-scheduler.yaml)
+    GET /readyz   -> readiness, DISTINCT from liveness: 200 only once the
+                     wired ``ready_fn`` reports true — leadership held,
+                     informer caches synced, and the warm-start resync
+                     complete — else 503, so the Deployment never routes
+                     to a still-rebuilding standby (a standby is alive
+                     and must not be restarted, hence the split)
     GET /trace    -> last N scheduling traces, one line each
 """
 
@@ -14,13 +20,25 @@ from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
 
 from yoda_tpu.observability import SchedulingMetrics
 
 
 class MetricsServer:
-    def __init__(self, metrics: SchedulingMetrics, *, host: str = "", port: int = 10259):
+    def __init__(
+        self,
+        metrics: SchedulingMetrics,
+        *,
+        host: str = "",
+        port: int = 10259,
+        ready_fn: "Callable[[], bool] | None" = None,
+    ):
         self.metrics = metrics
+        # None = no readiness concept wired (agent mode, tests): /readyz
+        # answers 200 like /healthz. A raising ready_fn reads as NOT
+        # ready — fail closed, never route to a broken standby.
+        self.ready_fn = ready_fn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -31,6 +49,18 @@ class MetricsServer:
                     ctype = "text/plain; version=0.0.4"
                 elif path == "/healthz":
                     body, ctype = "ok\n", "text/plain"
+                elif path == "/readyz":
+                    try:
+                        ready = outer.ready_fn is None or bool(outer.ready_fn())
+                    except Exception:  # noqa: BLE001 — fail closed
+                        ready = False
+                    data = (b"ok\n" if ready else b"unready\n")
+                    self.send_response(200 if ready else 503)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 elif path == "/trace":
                     body = (
                         "\n".join(

@@ -326,6 +326,50 @@ class SchedulingMetrics:
             "overlapped in-flight binds from a previous release (the bind "
             "pipeline working; 0 = fully serial commitment)",
         )
+        # Crash-safe failover (docs/OPERATIONS.md failover runbook): the
+        # warm-start resync pass a promoted scheduler runs BEFORE admitting
+        # any pod, and the periodic drift reconciler that repairs what the
+        # watch stream dropped while running.
+        self.resync_adopted = r.counter(
+            "yoda_resync_adopted_gangs",
+            "Partially-bound gangs the warm-start resync ADOPTED (bound "
+            "members kept, siblings' claims charged, remaining members "
+            "re-queued to complete the gang in place)",
+        )
+        self.resync_rolled_back = r.counter(
+            "yoda_resync_rolled_back_gangs",
+            "Partially-bound gangs the warm-start resync (or the adopt-"
+            "window deadline) ROLLED BACK whole via the unbind path",
+        )
+        self.resync_rebuilt = r.counter(
+            "yoda_resync_rebuilt_reservations",
+            "Reservations the warm-start resync charged from cluster truth "
+            "that local accounting was missing (bound pods the watch "
+            "replay had not yet delivered)",
+        )
+        self.resync_duration_ms = r.gauge(
+            "yoda_resync_duration_ms",
+            "Wall milliseconds of the most recent warm-start resync pass "
+            "(the window between promotion and the first admitted pod)",
+        )
+        self.reconciler_leaked = r.counter(
+            "yoda_reconciler_leaked_reservations_total",
+            "Reservations released by the drift reconciler because no "
+            "live pod stands behind them (deletion events the watch "
+            "stream dropped)",
+        )
+        self.reconciler_ghosts = r.counter(
+            "yoda_reconciler_ghost_pods_total",
+            "Pod records repaired by the drift reconciler: bindings the "
+            "watch stream dropped (cluster truth bound, cache not) and "
+            "cache entries for pods the cluster no longer has",
+        )
+        self.reconciler_stranded = r.counter(
+            "yoda_reconciler_stranded_waits_total",
+            "Permit waits cancelled by the drift reconciler because the "
+            "waiting pod was deleted (instead of eating the full permit "
+            "timeout)",
+        )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
 

@@ -818,6 +818,29 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     gs.release_rollbacks = []
         return out
 
+    def drop_membership(self, pod: PodSpec) -> None:
+        """Forget a BOUND member the failover resync is about to roll back
+        (framework/reconciler.py): its stale bound entry must not satisfy
+        the Permit barrier while the unbind is in flight — size-4 gang
+        with 2 stale bound entries + 2 fresh waiters would release with
+        only half the gang actually placed. The plan drops too (the block
+        must replan around the rollback). If the unbind then FAILS, the
+        scheduler's on_unbind_failed hook restores the membership — the
+        same contract as the transactional bind rollback."""
+        gang_name = gang_name_of(pod.labels)
+        if not gang_name:
+            return
+        with self._lock:
+            gs = self._gangs.get(gang_name)
+            if gs is None:
+                return
+            gs.bound.discard(pod.key)
+            gs.assigned.pop(pod.key, None)
+            gs.specs.pop(pod.key, None)
+            gs.plan = None
+            if not gs.bound and not gs.waiting:
+                self._gangs.pop(gang_name, None)
+
     def on_unbind_failed(self, framework, pod: PodSpec, node_name: str) -> None:
         """Framework hook: a rollback's unbind FAILED, so the member
         remains bound on the cluster. Restore its membership — the
@@ -877,16 +900,38 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         gang_name = gang_name_of(pod.labels)
         if not gang_name:
             return
-        with self._lock:
-            gs = self._gangs.get(gang_name)
-            if event.type == "deleted":
+        if event.type == "deleted":
+            reject_key = None
+            with self._lock:
+                gs = self._gangs.get(gang_name)
                 if gs is not None:
                     gs.bound.discard(pod.key)
-                    gs.waiting.discard(pod.key)
-                    gs.assigned.pop(pod.key, None)
+                    if pod.key in gs.waiting:
+                        # Delete-event fast path: the member is PARKED at
+                        # Permit holding its (and, via the barrier, its
+                        # siblings') reservations. Reject it NOW — the
+                        # standard cascade releases everything immediately
+                        # instead of eating the permit timeout. Membership
+                        # cleanup happens through the rejection
+                        # (on_pod_resolved), NOT here: discarding waiting
+                        # first would make the resolution miss the gang
+                        # and skip the cascade.
+                        reject_key = pod.key
+                    else:
+                        gs.assigned.pop(pod.key, None)
+                        gs.specs.pop(pod.key, None)
                     if not gs.bound and not gs.waiting:
                         self._gangs.pop(gang_name, None)
-                return
+            # Outside the lock (reject re-enters the resolution chain —
+            # the standard collect-then-reject discipline).
+            if reject_key is not None and self._framework is not None:
+                self._framework.cancel_waiting(
+                    reject_key,
+                    f"pod {reject_key} was deleted while waiting at permit",
+                )
+            return
+        with self._lock:
+            gs = self._gangs.get(gang_name)
             if pod.node_name:
                 # Bound member (bind we initiated, or watch replay after a
                 # scheduler restart): reconstruct membership — unless its

@@ -17,6 +17,7 @@ from yoda_tpu.cluster import Event, FakeCluster, InformerCache
 from yoda_tpu.cluster.events import EventRecorder
 from yoda_tpu.config import SchedulerConfig
 from yoda_tpu.framework import BindExecutor, Framework, Scheduler, SchedulingQueue
+from yoda_tpu.framework.reconciler import Reconciler
 from yoda_tpu.observability import SchedulingMetrics
 from yoda_tpu.plugins.yoda import default_plugins
 from yoda_tpu.plugins.yoda.accounting import ChipAccountant
@@ -39,6 +40,7 @@ class Stack:
     events: EventRecorder | None = None
     binder: ClusterBinder | None = None
     bind_executor: BindExecutor | None = None
+    reconciler: Reconciler | None = None
 
 
 def build_stack(
@@ -233,6 +235,14 @@ def build_stack(
         eacc.append(bind_executor)
 
     def on_change(event: Event) -> None:
+        # Delete-event fast path (crash-safe failover PR): a pod deleted
+        # while queued or in backoff leaves the queue NOW — not at its
+        # next pop's alive-check, which for a pod deep in backoff is
+        # seconds of phantom depth away (the Permit-parked half of this
+        # fast path lives in GangPlugin.handle: the deleted member's wait
+        # is rejected and the cascade releases the gang immediately).
+        if event.kind == "Pod" and event.type == "deleted":
+            queue.remove(event.obj.uid)
         # New/changed TPU metrics may make parked pods schedulable; pod
         # deletions free chips; Node changes (uncordon, taint removal, node
         # re-added) re-open hosts. Binds already reactivate via the scheduler.
@@ -464,6 +474,26 @@ def build_stack(
     binder.fenced_fn = scheduler._fenced
     binder.on_fenced = metrics.fenced_binds.inc
     binder.observe_wall_ms = metrics.bind_wall.observe
+    # Crash-safe failover: the warm-start resync + drift reconciler for
+    # this stack. Built but NOT started — cli.py wires resync() as
+    # scheduler.on_serve_start (so it runs after promotion, before the
+    # first admitted pod) and puts run_forever on a thread; tests drive
+    # both passes directly.
+    reconciler = Reconciler(
+        cluster=cluster,
+        informer=informer,
+        accountant=accountant,
+        gang=gang,
+        framework=framework,
+        queue=queue,
+        scheduler=scheduler,
+        metrics=metrics,
+        adopt_window_s=config.failover_adopt_window_s,
+        # THIS profile's name only (not every profile's): gang adopt /
+        # rollback classification must have exactly one owner per gang.
+        scheduler_names=(config.scheduler_name,),
+        clock=clock,
+    )
     return Stack(
         cluster,
         informer,
@@ -477,6 +507,7 @@ def build_stack(
         recorder,
         binder=binder,
         bind_executor=bind_executor,
+        reconciler=reconciler,
     )
 
 

@@ -24,10 +24,22 @@ produces, on a seeded schedule a test can replay exactly:
   410 Gone (forcing the client's relist-and-resync).
 
 Ops recognized by the built-in wrappers: ``bind``, ``unbind``,
-``metrics``, ``dispatch``, ``watch``. Each retry of a faulted call counts
-as a fresh invocation — a ``count=1`` bind conflict fails once and the
-binder's first retry succeeds; ``count > retry budget`` forces the
-genuine-failure path (gang rollback).
+``metrics``, ``dispatch``, ``watch``, ``crash``. Each retry of a faulted
+call counts as a fresh invocation — a ``count=1`` bind conflict fails
+once and the binder's first retry succeeds; ``count > retry budget``
+forces the genuine-failure path (gang rollback).
+
+The ``crash`` op is the **scheduler_crash mode** (crash-safe failover
+PR): a scheduled crash fault fires on the Nth bind call and kills the
+"process" — kind ``after_bind`` lands the bind first (the worst case: the
+dead leader's write reached the API but nothing in-memory survives),
+``before_bind`` dies just before the write. From that point EVERY write
+through this ChaosCluster raises :class:`SchedulerCrashed` (a dead
+process makes no API calls) and ``on_crash`` fires (tests wire the serve
+loop's stop event). The promoted standby is modeled by
+:meth:`ChaosCluster.respawn` — a fresh front over the SAME backing
+cluster — plus a fresh ``build_stack`` whose warm-start resync
+(framework/reconciler.py) must then recover the half-bound state.
 """
 
 from __future__ import annotations
@@ -46,6 +58,7 @@ _DEFAULT_KINDS = {
     "metrics": ("stale", "drop"),
     "dispatch": ("error",),
     "watch": ("drop",),
+    "crash": ("after_bind", "before_bind"),
 }
 
 
@@ -61,6 +74,14 @@ class ChaosApiError(Exception):
 
 class ChaosTimeout(TimeoutError):
     """Injected transport timeout (retryable by classification)."""
+
+
+class SchedulerCrashed(RuntimeError):
+    """The scheduler "process" died (scheduler_crash mode): the API write
+    that triggered the crash — and every write after it — fails with
+    this. Non-retryable by classification, so the dying instance's own
+    retry/rollback machinery cannot clean up after its death, exactly as
+    a real crash leaves the cluster."""
 
 
 def make_error(kind: str, detail: str) -> Exception:
@@ -139,6 +160,12 @@ class ChaosPlan:
                 self.fired.append((op, i, f.kind))
             return f
 
+    def has_op(self, op: str) -> bool:
+        """Whether any fault is scheduled for ``op`` — wrappers with an
+        opt-in op (crash) skip consuming invocation indices when the plan
+        never schedules it, keeping other ops' indices stable."""
+        return op in self._by_op
+
     def invocations(self, op: str) -> int:
         with self._lock:
             return self._counts.get(op, 0)
@@ -154,23 +181,72 @@ class ChaosCluster:
 
         self._inner = inner if inner is not None else FakeCluster()
         self.plan = plan if plan is not None else ChaosPlan()
+        # scheduler_crash mode: set when a scheduled "crash" fault fires;
+        # from then on every write through THIS front raises
+        # SchedulerCrashed. on_crash (tests wire the serve loop's stop
+        # event) fires exactly once, before the triggering call raises.
+        self.crashed = threading.Event()
+        self.on_crash = None  # Callable[[], None] | None
 
     def __getattr__(self, name: str):
         return getattr(self._inner, name)
 
+    def respawn(self, plan: "ChaosPlan | None" = None) -> "ChaosCluster":
+        """A fresh front over the SAME backing cluster — the promoted
+        standby's API connection after the old leader crashed. Builds a
+        new stack against this (build_stack registers fresh watchers on
+        the shared inner cluster) and run the warm-start resync."""
+        return ChaosCluster(inner=self._inner, plan=plan or ChaosPlan())
+
     # --- faulted surfaces ---
 
+    def _check_alive(self, detail: str) -> None:
+        if self.crashed.is_set():
+            raise SchedulerCrashed(f"scheduler process is dead: {detail}")
+
+    def _maybe_crash(self, pod_key: str, node_name: str) -> None:
+        if not self.plan.has_op("crash"):
+            return
+        f = self.plan.next("crash")
+        if f is None:
+            return
+        if f.kind == "after_bind":
+            # The write reached the API; the process died before the
+            # result could update any in-memory state.
+            self._inner.bind_pod(pod_key, node_name)
+        self.crashed.set()
+        cb = self.on_crash
+        if cb is not None:
+            cb()
+        raise SchedulerCrashed(
+            f"injected crash at bind {pod_key} -> {node_name} ({f.kind})"
+        )
+
     def bind_pod(self, pod_key: str, node_name: str) -> None:
+        self._check_alive(f"bind {pod_key}")
+        self._maybe_crash(pod_key, node_name)
         f = self.plan.next("bind")
         if f is not None:
             raise make_error(f.kind, f"bind {pod_key} -> {node_name}")
         return self._inner.bind_pod(pod_key, node_name)
 
     def unbind_pod(self, pod_key: str, node_name: str) -> None:
+        self._check_alive(f"unbind {pod_key}")
         f = self.plan.next("unbind")
         if f is not None:
             raise make_error(f.kind, f"unbind {pod_key} from {node_name}")
         return self._inner.unbind_pod(pod_key, node_name)
+
+    def evict_pod(self, pod_key: str) -> bool:
+        # Scheduler-originated write (preemption): dead processes evict
+        # nothing. External actors (tests playing the user/controller)
+        # use delete_pod on the inner cluster, which stays live.
+        self._check_alive(f"evict {pod_key}")
+        return self._inner.evict_pod(pod_key)
+
+    def set_nominated_node(self, pod_key: str, node_name) -> None:
+        self._check_alive(f"nominate {pod_key}")
+        return self._inner.set_nominated_node(pod_key, node_name)
 
     def put_tpu_metrics(self, tpu) -> None:
         f = self.plan.next("metrics")
