@@ -41,16 +41,20 @@ smoke:
 
 # Fault-injection suite (fixed seed, replayable): gang bind rollback,
 # transient-error retry, dispatch fallback chain, leader fencing, the
-# seeded stress sweep, and the scheduler_crash failover sweep (leader
-# killed mid-gang at a seeded bind, fresh scheduler promoted over the
-# same cluster) — tests/test_chaos.py + tests/test_failover.py, slow
-# tests included. The fast chaos/failover tests also run in tier-1
-# (`make test` / the default gate), so rollback- and resync-path
-# regressions fail CI without this target; this target adds the sweeps.
-# Override the sweep seed via CHAOS_SEED (the test reads its default
-# from the source; the seed is printed on failure for replay).
+# seeded stress sweep, the scheduler_crash failover sweep (leader killed
+# mid-gang at a seeded bind, fresh scheduler promoted over the same
+# cluster), and the federation partition sweep (cluster_partition /
+# cluster_loss faults against a three-cluster federation: surviving
+# serve loops keep placing, gangs spill whole or park whole, rejoins
+# reconcile clean) — tests/test_chaos.py + tests/test_failover.py +
+# tests/test_federation.py, slow tests included. The fast chaos/
+# failover/federation tests also run in tier-1 (`make test` / the
+# default gate), so rollback- and resync-path regressions fail CI
+# without this target; this target adds the sweeps. Override the sweep
+# seed via CHAOS_SEED (the test reads its default from the source; the
+# seed is printed on failure for replay).
 chaos:
-	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py -q
+	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py -q
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

@@ -823,6 +823,89 @@ def _degraded_chaos_scenario(
     }
 
 
+def _federated_spillover_scenario(
+    *, gangs: int = 2, remote_hosts: int = 8, chips: int = 4
+) -> dict:
+    """Federated spillover throughput (multi-cluster PR): the home
+    cluster is FULL, so every submitted gang must migrate WHOLE to the
+    secondary cluster and bind there — home serve pass (parks the gang),
+    spillover fit-check + migration, secondary placement, end to end.
+    Invariants asserted inline: every gang lands complete on the
+    secondary (never split, no copy left at home) and no node on either
+    cluster oversubscribes.
+
+    Reported fields:
+      federated_spillover_pods_per_s  gang creation -> all members bound
+                                      on the secondary cluster
+      federated_spillover_gangs       gangs migrated (== gangs submitted)
+    """
+    import time as _time
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_federation
+    from yoda_tpu.testing.chaos import ChaosCluster
+
+    home, remote = ChaosCluster(), ChaosCluster()
+    fed = build_federation(
+        [("home", home), ("remote", remote)],
+        SchedulerConfig(mode="batch", batch_requests=8),
+    )
+    ah = FakeTpuAgent(home.inner)
+    ah.add_host("fh-0", generation="v5p", chips=chips)
+    ah.publish_all()
+    ar = FakeTpuAgent(remote.inner)
+    for i in range(remote_hosts):
+        ar.add_host(f"fr-{i}", generation="v5p", chips=chips)
+    ar.publish_all()
+    fed.health_pass()
+    hm, rm = fed.members
+    home.create_pod(PodSpec("f-filler", labels={"tpu/chips": str(chips)}))
+    hm.stack.scheduler.run_until_idle(max_wall_s=30)
+
+    n_members = gangs * 4
+    t0 = _time.monotonic()
+    for g in range(gangs):
+        labels = {
+            "tpu/gang": f"fgang-{g}",
+            "tpu/gang-size": "4",
+            "tpu/chips": str(chips),
+        }
+        for i in range(4):
+            home.create_pod(PodSpec(f"fgang-{g}-{i}", labels=dict(labels)))
+    bound: dict = {}
+    for _ in range(8):
+        hm.stack.scheduler.run_until_idle(max_wall_s=10)
+        fed.spillover_pass()
+        rm.stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = {
+            p.name: p.node_name
+            for p in remote.inner.list_pods()
+            if p.node_name
+        }
+        if len(bound) == n_members:
+            break
+    dt = _time.monotonic() - t0
+    assert len(bound) == n_members, (
+        f"spillover did not converge: {len(bound)}/{n_members} bound on "
+        f"the secondary"
+    )
+    for g in range(gangs):
+        members = sum(1 for n in bound if n.startswith(f"fgang-{g}-"))
+        assert members == 4, f"gang fgang-{g} split: {members}/4 on remote"
+    home_names = {p.name for p in home.inner.list_pods()}
+    assert home_names == {"f-filler"}, f"home kept copies: {home_names}"
+    assert hm.stack.accountant.chips_in_use("fh-0") <= chips
+    for i in range(remote_hosts):
+        assert rm.stack.accountant.chips_in_use(f"fr-{i}") <= chips
+    assert fed.spillover_gangs == gangs
+    return {
+        "federated_spillover_pods_per_s": round(n_members / dt, 1),
+        "federated_spillover_gangs": fed.spillover_gangs,
+    }
+
+
 def _device_probe() -> dict:
     """Sweep the device-resident kernel's per-eval latency, accelerator vs
     host CPU, across fleet buckets — the measured curve behind the 'auto'
@@ -1277,6 +1360,8 @@ def run_bench() -> dict:
     print(f"degraded-mode throughput under injected faults: {degraded}", file=sys.stderr)
     bindpipe = _bind_latency_scenario()
     print(f"pipelined bind fan-out vs serial: {bindpipe}", file=sys.stderr)
+    fedspill = _federated_spillover_scenario()
+    print(f"federated spillover (home full -> secondary): {fedspill}", file=sys.stderr)
     http = _http_gang_scenario()
     print(f"gang over real HTTP wire path: {http}", file=sys.stderr)
     probe = _device_probe()
@@ -1304,6 +1389,7 @@ def run_bench() -> dict:
         **multi,
         **degraded,
         **bindpipe,
+        **fedspill,
         **http,
         **probe,
         **pallas,
@@ -1315,9 +1401,11 @@ def run_smoke() -> dict:
     the burst+gang scenario on a reduced fleet (2 v5p slices + 4 v5e
     hosts, 24 singletons + one 4-member topology gang) PLUS the
     multi-gang joint-placement scenario (2 gangs racing for 2 slices),
-    the degraded-chaos drain, and the bind-latency pipeline comparison
+    the degraded-chaos drain, the bind-latency pipeline comparison
     (64-member gang at 10 ms injected bind latency, pipelined vs serial),
-    pinned to host CPU so no tunnel/compile variance leaks in. Runs in
+    and the federated spillover scenario (home cluster full -> gangs
+    migrate whole to the secondary), pinned to host CPU so no
+    tunnel/compile variance leaks in. Runs in
     seconds and guards the contended-hot-path RATES; the scenarios' own
     assertions (all bound, gangs one-per-host on disjoint blocks, no
     oversubscription) guard correctness, mirrored by the slow-marked
@@ -1329,6 +1417,7 @@ def run_smoke() -> dict:
     out.update(_multi_gang_contended_scenario(slices=2, gangs=2))
     out.update(_degraded_chaos_scenario(hosts=4, gangs=2, singles=8))
     out.update(_bind_latency_scenario())
+    out.update(_federated_spillover_scenario(gangs=2, remote_hosts=8))
     return {"metric": "smoke_burst_with_gang_pods_per_s", **out}
 
 

@@ -87,3 +87,16 @@ def test_smoke_mode_runs_reduced_fleet():
     assert out["multi_gang_contended_pods_per_s"] > 0
     # The bind-latency pipeline scenario rides the smoke run too.
     assert out["pipelined_bind_pods_per_s"] > 0
+
+
+def test_federated_spillover_invariants():
+    import bench
+
+    # The scenario asserts its own invariants inline (every gang whole on
+    # the secondary, no copies left at home, no oversubscription on
+    # either cluster); here we pin the routing economics: every submitted
+    # gang actually took the spillover path — none bound at home, none
+    # split, none lost.
+    out = bench._federated_spillover_scenario(gangs=2, remote_hosts=8)
+    assert out["federated_spillover_pods_per_s"] > 0
+    assert out["federated_spillover_gangs"] == 2

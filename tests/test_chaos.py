@@ -642,3 +642,171 @@ class TestSchedulerCrashSweep:
         # The sweep must actually exercise the crash path: the seeded
         # schedule fires well inside 6 waves x 4 binds.
         assert failovers >= 1, f"seed {seed}: no crash fired"
+
+
+@pytest.mark.slow
+class TestFederationPartitionSweep:
+    """cluster_partition / cluster_loss modes in the seeded sweep
+    (federation PR): a three-cluster federation serves waves of gangs and
+    singletons while a seeded schedule partitions members (healed a round
+    or two later) and permanently loses a remote. Invariants asserted
+    after every round and at convergence: no oversubscription on any
+    cluster, no pod bound on two clusters, every gang WHOLE on exactly
+    one cluster or not placed at all (whole-gang spillover or whole-gang
+    park — never split), the surviving members' serve loops keep placing
+    through every partition, and rejoined members reconcile with zero
+    leaked reservations."""
+
+    def test_partition_invariants_under_seeded_sweep(self):
+        import os
+        import time as _time
+
+        from yoda_tpu.standalone import build_federation
+        from yoda_tpu.testing.chaos import maybe_cluster_fault
+
+        seed = int(os.environ.get("CHAOS_SEED", CHAOS_SEED_DEFAULT))
+        rng = random.Random(seed ^ 0xFED0)
+        rounds = 10
+        # Per-member fault schedules: every member may partition; only
+        # remotes may be LOST (a lost home ends the experiment, not the
+        # invariants — the home front is where the workload arrives).
+        plans = {
+            "home": ChaosPlan.seeded(
+                seed, ops=("cluster_partition",), horizon=rounds, rate=0.2
+            ),
+            "r1": ChaosPlan.seeded(
+                seed + 1,
+                ops=("cluster_partition", "cluster_loss"),
+                horizon=rounds,
+                rate=0.15,
+            ),
+            "r2": ChaosPlan.seeded(
+                seed + 2, ops=("cluster_partition",), horizon=rounds, rate=0.25
+            ),
+        }
+        fronts = {"home": ChaosCluster(), "r1": ChaosCluster(), "r2": ChaosCluster()}
+        cfg = SchedulerConfig(
+            mode="batch",
+            batch_requests=4,
+            gang_permit_timeout_s=5.0,
+            bind_retry_attempts=1,
+            bind_retry_base_s=0.01,
+            bind_retry_cap_s=0.05,
+            federation_degraded_after_s=0.05,
+            federation_partitioned_after_s=0.1,
+            federation_lost_after_s=1.0,
+        )
+        fed = build_federation(list(fronts.items()), cfg)
+        chips = 8
+        for name, hosts in (("home", 2), ("r1", 4), ("r2", 4)):
+            agent = FakeTpuAgent(fronts[name].inner)
+            for i in range(hosts):
+                agent.add_host(f"{name}-{i}", generation="v5p", chips=chips)
+            agent.publish_all()
+        fed.health_pass()
+
+        def serving(m):
+            return (
+                m.health.state.serving
+                and m.stack.reconciler.resynced.is_set()
+            )
+
+        def check_invariants():
+            for m in fed.members:
+                for node, used in m.stack.accountant.chips_by_node().items():
+                    assert used <= chips, (
+                        f"seed {seed}: {m.name}/{node} oversubscribed: "
+                        f"{used}/{chips}"
+                    )
+            bound_on: dict[str, str] = {}
+            gang_clusters: dict[str, set] = {}
+            for name, front in fronts.items():
+                for p in front.inner.list_pods():
+                    if not p.node_name:
+                        continue
+                    assert p.name not in bound_on, (
+                        f"seed {seed}: {p.name} bound on BOTH "
+                        f"{bound_on[p.name]} and {name}"
+                    )
+                    bound_on[p.name] = name
+                    g = p.labels.get("tpu/gang")
+                    if g:
+                        gang_clusters.setdefault(g, set()).add(name)
+            for g, cs in gang_clusters.items():
+                assert len(cs) == 1, f"seed {seed}: gang {g} split across {cs}"
+            # At rest (no Permit waiters), a gang is bound whole or not at
+            # all on its cluster.
+            for m in fed.members:
+                if m.stack.framework.waiting_pods():
+                    continue
+                by_gang: dict[str, int] = {}
+                for p in fronts[m.name].inner.list_pods():
+                    g = p.labels.get("tpu/gang")
+                    if g and p.node_name:
+                        by_gang[g] = by_gang.get(g, 0) + 1
+                for g, n in by_gang.items():
+                    assert n in (0, 4), (
+                        f"seed {seed}: gang {g} partial on {m.name}: {n}/4"
+                    )
+
+        partitioned_since: dict[str, int] = {}
+        home = fronts["home"]
+        for rnd in range(rounds):
+            for name, front in fronts.items():
+                fired = maybe_cluster_fault(plans[name], front)
+                if fired == "cluster_partition":
+                    partitioned_since.setdefault(name, rnd)
+            for name in list(partitioned_since):
+                if rnd - partitioned_since[name] >= rng.choice((1, 2)):
+                    fronts[name].heal()
+                    del partitioned_since[name]
+            # Workload arrives on the HOME cluster's truth regardless of
+            # partitions (users are on the far side): one gang too big
+            # for whatever home has left, plus a singleton.
+            for pod in gang_pods(f"fg-{rnd}", 4, chips=2):
+                home.inner.create_pod(pod)
+            home.inner.create_pod(
+                PodSpec(f"fs-{rnd}", labels={"tpu/chips": "1"})
+            )
+            _time.sleep(0.12)  # cross the partition-silence threshold
+            fed.health_pass()
+            for m in fed.members:
+                if serving(m):
+                    m.stack.scheduler.run_until_idle(max_wall_s=10)
+            fed.spillover_pass()
+            for m in fed.members[1:]:
+                if serving(m):
+                    m.stack.scheduler.run_until_idle(max_wall_s=10)
+            check_invariants()
+        # Heal every partition (a LOST cluster stays lost) and converge.
+        for front in fronts.values():
+            front.heal()
+        for _ in range(6):
+            fed.health_pass()
+            for m in fed.members:
+                if serving(m):
+                    m.stack.scheduler.run_until_idle(max_wall_s=10)
+            fed.spillover_pass()
+        check_invariants()
+        fired_total = sum(len(p.fired) for p in plans.values())
+        assert fired_total >= 1, f"seed {seed}: no cluster fault fired"
+        # The home serve loop kept placing through the sweep (singles are
+        # home-only work) and spillover engaged at least once.
+        singles_bound = sum(
+            1
+            for p in home.inner.list_pods()
+            if p.name.startswith("fs-") and p.node_name
+        )
+        assert singles_bound >= 1, f"seed {seed}: home never placed"
+        assert fed.spillover_gangs >= 1, (
+            f"seed {seed}: spillover never engaged (fired={plans['home'].fired})"
+        )
+        # Rejoined members reconcile clean: every serving member's claims
+        # are backed by live pods in its cluster's truth.
+        for m in fed.members:
+            if not serving(m):
+                continue
+            m.stack.reconciler.reconcile()
+            live = {p.uid for p in fronts[m.name].inner.list_pods()}
+            leaked = m.stack.accountant.claimed_uids() - live
+            assert not leaked, f"seed {seed}: {m.name} leaked {leaked}"

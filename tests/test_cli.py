@@ -199,3 +199,85 @@ class TestAgentMode:
         )
         obj = server.get_object("TpuNodeMetrics", "worker-0")
         assert obj["status"]["chipCount"] == 8
+
+
+class TestFederatedSchedulerMode:
+    def test_readyz_follows_degraded_readiness_with_dead_remote(
+        self, server, tmp_path, run_main_bg
+    ):
+        """Federated CLI end-to-end over real HTTP, with the remote API
+        server DEAD from the start: boot must not block on it, /readyz
+        must go ready once the HOME cluster resyncs (the degraded-
+        readiness contract — the old all-stacks-resynced gate would hold
+        503 forever), the home serve loop must keep binding, and /metrics
+        must report the remote's health ladder at LOST."""
+        import socket
+        import urllib.request
+
+        remote_srv = FakeKubeApiServer().start()
+        remote_url = remote_srv.base_url
+        remote_srv.stop()  # dead before the scheduler ever dials it
+
+        seed = KubeCluster(
+            KubeApiClient(
+                KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+            )
+        )
+        seed.put_tpu_metrics(make_node("fh-1", chips=4))
+
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(
+            "federation_degraded_after_s: 0.2\n"
+            "federation_partitioned_after_s: 0.4\n"
+            "federation_lost_after_s: 0.8\n"
+            "federation_probe_period_s: 0.1\n"
+        )
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        run_main_bg(
+            [
+                "--config", str(cfg),
+                "--metrics-port", str(port),
+                "--federate-url", f"remote={remote_url}",
+            ]
+        )
+        base = f"http://127.0.0.1:{port}"
+
+        def ready() -> bool:
+            try:
+                return (
+                    urllib.request.urlopen(f"{base}/readyz", timeout=1).status
+                    == 200
+                )
+            except Exception:  # noqa: BLE001 — server not up yet / 503
+                return False
+
+        _wait_until(
+            ready, timeout_s=60.0, msg="/readyz ready despite dead remote"
+        )
+        # The home cluster still schedules at full speed.
+        seed.create_pod(PodSpec("fed-pod", labels={"tpu/chips": "1"}))
+        _wait_until(
+            lambda: (server.get_object("Pod", "default/fed-pod") or {})
+            .get("spec", {})
+            .get("nodeName")
+            == "fh-1",
+            timeout_s=60.0,
+            msg="home cluster bound the pod in federated mode",
+        )
+
+        # And the remote's silence walked the ladder to LOST on /metrics.
+        def remote_lost() -> bool:
+            try:
+                text = (
+                    urllib.request.urlopen(f"{base}/metrics", timeout=2)
+                    .read()
+                    .decode()
+                )
+            except Exception:  # noqa: BLE001
+                return False
+            return 'yoda_cluster_state{cluster="remote"} 3' in text
+
+        _wait_until(remote_lost, timeout_s=60.0, msg="remote reported LOST")
+        seed.stop()

@@ -1,0 +1,412 @@
+"""Federated multi-cluster scheduling: health ladder, per-cluster fencing,
+spillover routing, degraded readiness, and rejoin resync.
+
+Every test is deterministic: health runs on an injected clock, partitions
+are explicit ChaosCluster controls, and the spillover pass is driven
+directly (the production driver, Federation.run_forever, is the same calls
+on a timer). The seeded partition/loss sweep lives in tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.cluster import FakeCluster, InformerCache
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.federation import ClusterHealthMonitor, ClusterState
+from yoda_tpu.standalone import build_federation
+from yoda_tpu.testing.chaos import ChaosCluster, ChaosTimeout
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def gang_pods(name, n, chips=4):
+    labels = {
+        "tpu/gang": name,
+        "tpu/gang-size": str(n),
+        "tpu/chips": str(chips),
+    }
+    return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+def add_fleet(cluster, prefix, hosts, chips=4):
+    agent = FakeTpuAgent(cluster)
+    for i in range(hosts):
+        agent.add_host(f"{prefix}-{i}", generation="v5p", chips=chips)
+    agent.publish_all()
+    return agent
+
+
+def make_federation(
+    *, home_hosts=1, remote_hosts=4, chips=4, clock=None, **cfg_kw
+):
+    """Two-member federation over ChaosCluster fronts; fleets published
+    through the INNER clusters (agents are external actors on the far
+    side of any partition)."""
+    home, remote = ChaosCluster(), ChaosCluster()
+    cfg = SchedulerConfig(
+        federation_degraded_after_s=cfg_kw.pop("degraded", 5.0),
+        federation_partitioned_after_s=cfg_kw.pop("partitioned", 10.0),
+        federation_lost_after_s=cfg_kw.pop("lost", 60.0),
+        **cfg_kw,
+    )
+    kw = {"clock": clock} if clock is not None else {}
+    fed = build_federation([("home", home), ("remote", remote)], cfg, **kw)
+    add_fleet(home.inner, "h", home_hosts, chips)
+    add_fleet(remote.inner, "r", remote_hosts, chips)
+    return fed, home, remote
+
+
+def bound_names(cluster) -> dict:
+    return {p.name: p.node_name for p in cluster.inner.list_pods() if p.node_name}
+
+
+class TestInformerStalenessClock:
+    def test_last_event_age_tracks_the_watch_stream(self):
+        clock = FakeClock()
+        informer = InformerCache(mono_fn=clock)
+        # No event ever delivered: age is None, not 0 — "never heard from"
+        # is distinct from "heard from just now".
+        assert informer.last_event_age_s() is None
+        cluster = FakeCluster()
+        cluster.add_watcher(informer.handle)
+        cluster.put_tpu_metrics(make_node("n1", chips=4))
+        assert informer.last_event_age_s() == 0.0
+        clock.advance(7.5)
+        assert informer.last_event_age_s() == pytest.approx(7.5)
+        # Any kind of event resets the clock — it measures stream
+        # liveness, not per-object freshness.
+        cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        assert informer.last_event_age_s() == 0.0
+
+    def test_suppressed_events_do_not_reset_the_clock(self):
+        clock = FakeClock()
+        informer = InformerCache(mono_fn=clock)
+        cluster = FakeCluster()
+        cluster.add_watcher(informer.handle)
+        cluster.put_tpu_metrics(make_node("n1", chips=4))
+        clock.advance(5.0)
+        cluster.suppress_kinds.add("Pod")
+        cluster.create_pod(PodSpec("dropped", labels={"tpu/chips": "1"}))
+        # The store moved but the stream stayed silent: exactly the
+        # divergence the staleness clock exists to expose.
+        assert informer.last_event_age_s() == pytest.approx(5.0)
+
+
+class TestHealthLadder:
+    def test_silence_walks_the_ladder(self):
+        clock = FakeClock()
+        failing = {"on": False}
+
+        def probe():
+            if failing["on"]:
+                raise ChaosTimeout("probe timed out")
+
+        mon = ClusterHealthMonitor(
+            "c1",
+            probe_fn=probe,
+            degraded_after_s=5,
+            partitioned_after_s=10,
+            lost_after_s=60,
+            clock=clock,
+        )
+        assert mon.probe() is ClusterState.UP
+        failing["on"] = True
+        clock.advance(6)
+        assert mon.probe() is ClusterState.DEGRADED
+        clock.advance(6)
+        assert mon.probe() is ClusterState.PARTITIONED
+        clock.advance(60)
+        assert mon.probe() is ClusterState.LOST
+        assert mon.transitions == 3
+        # Contact returns: straight back to UP (a recovered cluster
+        # rejoins; the federation handles the resync on the transition).
+        failing["on"] = False
+        assert mon.probe() is ClusterState.UP
+        assert mon.transitions == 4
+
+    def test_nonretryable_probe_error_pins_degraded_not_partitioned(self):
+        clock = FakeClock()
+
+        def probe():
+            raise ValueError("server answered with nonsense")
+
+        mon = ClusterHealthMonitor(
+            "c1", probe_fn=probe, degraded_after_s=5,
+            partitioned_after_s=10, lost_after_s=60, clock=clock,
+        )
+        # The server ANSWERED (non-retryable classification): reachable
+        # but broken. The partition clock resets on every answer, so the
+        # state pins at DEGRADED no matter how long this lasts.
+        for _ in range(10):
+            clock.advance(8)
+            assert mon.probe() is ClusterState.DEGRADED
+
+    def test_watch_events_count_as_contact(self):
+        clock = FakeClock()
+        age = {"v": None}
+        mon = ClusterHealthMonitor(
+            "c1",
+            probe_fn=lambda: (_ for _ in ()).throw(ChaosTimeout("down")),
+            staleness_fn=lambda: age["v"],
+            degraded_after_s=5, partitioned_after_s=10, lost_after_s=60,
+            clock=clock,
+        )
+        # Probes fail but the watch stream is chatty: the cluster is
+        # demonstrably alive, so the fresher signal wins.
+        clock.advance(20)
+        age["v"] = 1.0
+        assert mon.probe() is ClusterState.UP
+        # Watch goes silent too: now it is a real partition.
+        age["v"] = 30.0
+        assert mon.probe() is ClusterState.PARTITIONED
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError):
+            ClusterHealthMonitor("c", degraded_after_s=10, partitioned_after_s=5)
+        with pytest.raises(ValueError):
+            SchedulerConfig.from_dict({"federation_degraded_after_s": 0})
+        with pytest.raises(ValueError):
+            SchedulerConfig.from_dict({"federation_probe_period_s": 0})
+
+
+class TestFencingAndReadiness:
+    def test_partitioned_member_is_fenced_without_blocking_survivors(self):
+        clock = FakeClock()
+        fed, home, remote = make_federation(
+            home_hosts=2, clock=clock, degraded=5, partitioned=10, lost=60
+        )
+        fed.health_pass()
+        hm, rm = fed.members
+        assert not hm.stack.scheduler._fenced()
+        remote.partition()
+        clock.advance(12)
+        fed.health_pass()
+        assert fed.states()["remote"] is ClusterState.PARTITIONED
+        # The sick cluster is fenced (no bind may hit its API) and its
+        # warm-start gate closed; the home serve path is untouched and
+        # keeps placing at full speed.
+        assert rm.stack.scheduler._fenced()
+        assert not rm.stack.reconciler.resynced.is_set()
+        assert not hm.stack.scheduler._fenced()
+        home.create_pod(PodSpec("local", labels={"tpu/chips": "1"}))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        assert "local" in bound_names(home)
+
+    def test_degraded_member_still_serves_locally(self):
+        clock = FakeClock()
+        fed, home, remote = make_federation(
+            clock=clock, degraded=5, partitioned=30, lost=60
+        )
+        fed.health_pass()
+        rm = fed.members[1]
+        # Silence past degraded but short of partitioned: the cluster
+        # still answers, so its own scheduler may still bind (it is only
+        # excluded as a NEW spillover target).
+        clock.advance(10)
+        for m in fed.members:
+            m.health.tick()
+        assert fed.states()["remote"] is ClusterState.DEGRADED
+        assert not rm.stack.scheduler._fenced()
+        remote.create_pod(PodSpec("deg", labels={"tpu/chips": "1"}))
+        rm.stack.scheduler.run_until_idle(max_wall_s=5)
+        assert "deg" in bound_names(remote)
+
+    def test_ready_requires_home_resync_but_not_a_lost_remote(self):
+        clock = FakeClock()
+        fed, home, remote = make_federation(
+            clock=clock, degraded=5, partitioned=10, lost=60
+        )
+        hm, rm = fed.members
+        # Nothing resynced yet: not ready.
+        assert not fed.ready()
+        # Home resynced, remote REACHABLE but not yet resynced: still not
+        # ready — a healthy remote will resync within one health pass and
+        # must be waited for.
+        hm.stack.reconciler.resync()
+        assert not fed.ready()
+        # The remote goes dark before ever resyncing: readiness must NOT
+        # wedge on it (the degraded-readiness contract — the old
+        # all-stacks-resynced gate would hold the standby unready
+        # forever on a dead remote).
+        remote.partition()
+        clock.advance(12)
+        rm.health.probe()
+        assert fed.states()["remote"] is ClusterState.PARTITIONED
+        assert fed.ready()
+        clock.advance(60)
+        rm.health.probe()
+        assert fed.states()["remote"] is ClusterState.LOST
+        assert fed.ready()
+        # And a recovered remote holds readiness again until it resyncs.
+        remote.heal()
+        rm.health.probe()
+        assert not fed.ready()
+        fed.health_pass()
+        assert fed.ready()
+
+
+class TestSpillover:
+    def test_gang_spills_whole_to_one_secondary(self):
+        fed, home, remote = make_federation(home_hosts=1, remote_hosts=4)
+        fed.health_pass()
+        hm, rm = fed.members
+        # Fill home so the gang provably cannot fit there.
+        home.create_pod(PodSpec("filler", labels={"tpu/chips": "4"}))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        for p in gang_pods("g1", 4, chips=4):
+            home.create_pod(p)
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        assert not bound_names(remote)
+        assert fed.spillover_pass() == 1
+        rm.stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = bound_names(remote)
+        # Whole gang, one cluster, one member per host; home retains only
+        # its own pod — no copy of any member remains there.
+        assert set(bound) == {f"g1-{i}" for i in range(4)}
+        assert len(set(bound.values())) == 4
+        assert [p.name for p in home.inner.list_pods()] == ["filler"]
+        assert fed.metrics.spillover_gangs.total() == 1.0
+
+    def test_gang_that_fits_home_is_not_migrated(self):
+        fed, home, remote = make_federation(home_hosts=4, remote_hosts=4)
+        fed.health_pass()
+        hm, _ = fed.members
+        for p in gang_pods("stay", 4, chips=4):
+            home.create_pod(p)
+        # Entries sit queued (no cycle has run); the pass must leave a
+        # home-fittable gang to the home scheduler.
+        assert fed.spillover_pass() == 0
+        hm.stack.scheduler.run_until_idle(max_wall_s=10)
+        assert set(bound_names(home)) == {f"stay-{i}" for i in range(4)}
+        assert not remote.inner.list_pods()
+
+    def test_shared_ledger_never_promises_the_same_remote_chips_twice(self):
+        fed, home, remote = make_federation(home_hosts=1, remote_hosts=4)
+        fed.health_pass()
+        hm, rm = fed.members
+        home.create_pod(PodSpec("filler", labels={"tpu/chips": "4"}))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        for name in ("ga", "gb"):
+            for p in gang_pods(name, 4, chips=4):
+                home.create_pod(p)
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        # The remote fits exactly ONE 4x4-chip gang. One pass must
+        # migrate one and keep the other home whole — the second fit
+        # check sees the first gang's simulated claims (the shared
+        # consumption ledger), not the untouched snapshot.
+        assert fed.spillover_pass() == 1
+        rm.stack.scheduler.run_until_idle(max_wall_s=10)
+        remote_bound = bound_names(remote)
+        assert len(remote_bound) == 4
+        gangs_on_remote = {n.rsplit("-", 1)[0] for n in remote_bound}
+        assert len(gangs_on_remote) == 1
+        stayed = ({"ga", "gb"} - gangs_on_remote).pop()
+        home_names = {p.name for p in home.inner.list_pods()}
+        assert {f"{stayed}-{i}" for i in range(4)} <= home_names
+
+    def test_partition_mid_migration_rolls_back_whole(self):
+        fed, home, remote = make_federation(home_hosts=1, remote_hosts=4)
+        fed.health_pass()
+        hm, rm = fed.members
+        home.create_pod(PodSpec("filler", labels={"tpu/chips": "4"}))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        for p in gang_pods("gp", 4, chips=4):
+            home.create_pod(p)
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        # The remote partitions AFTER the health pass judged it UP: the
+        # migration's first create times out, the pass rolls back, and
+        # the gang returns to the home queue whole — no partial copy on
+        # either cluster, nothing lost.
+        remote.partition()
+        assert fed.spillover_pass() == 0
+        assert not remote.inner.list_pods()
+        assert hm.stack.queue.pending_gangs().get("gp", (0, 0))[0] == 4
+        # Heal: the next pass migrates it cleanly.
+        remote.heal()
+        fed.health_pass()
+        assert fed.spillover_pass() == 1
+        rm.stack.scheduler.run_until_idle(max_wall_s=10)
+        assert set(bound_names(remote)) == {f"gp-{i}" for i in range(4)}
+
+    def test_sick_clusters_take_no_new_spillover(self):
+        clock = FakeClock()
+        fed, home, remote = make_federation(
+            home_hosts=1, remote_hosts=4, clock=clock,
+            degraded=5, partitioned=10, lost=60,
+        )
+        fed.health_pass()
+        hm, _ = fed.members
+        home.create_pod(PodSpec("filler", labels={"tpu/chips": "4"}))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        for p in gang_pods("gs", 4, chips=4):
+            home.create_pod(p)
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        # DEGRADED is enough to exclude a target — spillover is new work,
+        # and new work goes only to fully-healthy clusters.
+        clock.advance(6)
+        for m in fed.members:
+            m.health.tick()
+        assert fed.states()["remote"] is ClusterState.DEGRADED
+        assert fed.spillover_pass() == 0
+        assert not remote.inner.list_pods()
+
+
+class TestRejoinResync:
+    def test_rejoined_cluster_recovers_partition_era_work(self):
+        clock = FakeClock()
+        fed, home, remote = make_federation(
+            clock=clock, degraded=5, partitioned=10, lost=60
+        )
+        fed.health_pass()
+        rm = fed.members[1]
+        remote.partition()
+        clock.advance(12)
+        fed.health_pass()
+        assert rm.stack.scheduler._fenced()
+        # External actors keep hitting the cluster during the partition:
+        # a pod is created (its add event is lost in transit).
+        remote.inner.create_pod(PodSpec("during", labels={"tpu/chips": "1"}))
+        remote.heal()
+        fed.health_pass()
+        # The rejoin warm-started through the reconciler: the gate is
+        # open, the partition-era pod surfaced and schedules, and no
+        # reservation leaks.
+        assert rm.stack.reconciler.resynced.is_set()
+        assert not rm.stack.scheduler._fenced()
+        rm.stack.scheduler.run_until_idle(max_wall_s=5)
+        assert "during" in bound_names(remote)
+        live = {p.uid for p in remote.inner.list_pods()}
+        assert rm.stack.accountant.claimed_uids() <= live
+
+    def test_rejoin_repairs_deletions_dropped_by_the_partition(self):
+        clock = FakeClock()
+        fed, home, remote = make_federation(
+            clock=clock, degraded=5, partitioned=10, lost=60
+        )
+        fed.health_pass()
+        rm = fed.members[1]
+        remote.create_pod(PodSpec("victim", labels={"tpu/chips": "1"}))
+        rm.stack.scheduler.run_until_idle(max_wall_s=5)
+        assert "victim" in bound_names(remote)
+        remote.partition()
+        clock.advance(12)
+        fed.health_pass()
+        # The pod dies during the partition; the deletion event is lost.
+        remote.inner.delete_pod("default/victim")
+        assert rm.stack.accountant.claimed_uids()  # stale claim held
+        remote.heal()
+        fed.health_pass()
+        # Rejoin releases the orphaned reservation through the drift pass.
+        assert not rm.stack.accountant.claimed_uids()

@@ -50,10 +50,30 @@ class TestSchedulerManifest:
         assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
         # Readiness is DISTINCT from liveness: /readyz gates routing on
         # leadership + informer sync + the warm-start resync, while a
-        # standby must stay alive (unrestarted) on /healthz.
+        # standby must stay alive (unrestarted) on /healthz. In federated
+        # mode the same endpoint follows the degraded-readiness contract
+        # (home-resynced even when a remote is LOST) — the probe path
+        # must not change with the mode.
         assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
         (vol,) = spec["volumes"]
         assert vol["configMap"]["name"] == "yoda-tpu-scheduler-config"
+
+    def test_configmap_federation_knobs_validate(self):
+        """The shipped federation thresholds must pass SchedulerConfig's
+        ladder validation (0 < degraded <= partitioned <= lost) — a
+        drifted ConfigMap would otherwise crash-loop the Deployment at
+        startup in federated mode."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert (
+            0
+            < cfg.federation_degraded_after_s
+            <= cfg.federation_partitioned_after_s
+            <= cfg.federation_lost_after_s
+        )
+        assert cfg.federation_spillover is True
 
     def test_rbac_covers_client_verbs(self):
         """KubeCluster issues: pod list/watch, pods/binding create,

@@ -182,6 +182,62 @@ class TestFailoverMetrics:
         ):
             assert f"\n{family} " in text, family
 
+    def test_federation_families_exposed(self):
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        text = stack.metrics.registry.render_prometheus()
+        for family in (
+            "yoda_cluster_state",
+            "yoda_cluster_transitions_total",
+            "yoda_spillover_gangs_total",
+        ):
+            assert f"\n# TYPE {family} " in text, family
+
+    def test_federation_series_move_with_health_and_spillover(self):
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.api.types import PodSpec as _Pod
+        from yoda_tpu.standalone import build_federation
+        from yoda_tpu.testing.chaos import ChaosCluster
+
+        home, remote = ChaosCluster(), ChaosCluster()
+        fed = build_federation(
+            [("home", home), ("remote", remote)],
+            SchedulerConfig(
+                federation_degraded_after_s=0.01,
+                federation_partitioned_after_s=0.02,
+                federation_lost_after_s=0.05,
+            ),
+        )
+        ah = FakeTpuAgent(home.inner)
+        ah.add_host("h-0", generation="v5p", chips=4)
+        ah.publish_all()
+        ar = FakeTpuAgent(remote.inner)
+        for i in range(4):
+            ar.add_host(f"r-{i}", generation="v5p", chips=4)
+        ar.publish_all()
+        fed.health_pass()
+        hm, _rm = fed.members
+        home.create_pod(_Pod("filler", labels={"tpu/chips": "4"}))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        labels = {"tpu/gang": "mg", "tpu/gang-size": "4", "tpu/chips": "4"}
+        for i in range(4):
+            home.create_pod(_Pod(f"mg-{i}", labels=dict(labels)))
+        hm.stack.scheduler.run_until_idle(max_wall_s=5)
+        assert fed.spillover_pass() == 1
+        import time as _t
+
+        _t.sleep(0.06)
+        remote.partition()
+        fed.health_pass()
+        text = fed.metrics.registry.render_prometheus()
+        assert "yoda_spillover_gangs_total 1.0" in text
+        assert 'yoda_cluster_state{cluster="home"} 0' in text
+        # The partitioned remote walked the ladder and each transition
+        # counted.
+        assert 'yoda_cluster_state{cluster="remote"} 3' in text
+        assert 'yoda_cluster_transitions_total{cluster="remote"}' in text
+
     def test_resync_pass_moves_the_series(self):
         stack, agent = make_stack()
         agent.add_host("host", generation="v5e", chips=4)

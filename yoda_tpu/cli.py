@@ -39,17 +39,39 @@ def _load_config(path: str | None):
     return SchedulerConfig.from_dict(raw)
 
 
-def _build_kube_cluster(*, kinds=None):
+def _build_kube_cluster(*, kinds=None, url=None, required=True):
+    """A started KubeCluster. ``url`` overrides the env-derived endpoint
+    (federation remotes share the home token/CA env). ``required=False``
+    is the federation-remote contract: a remote API server that cannot
+    sync at boot must NOT block startup — the health monitor will mark it
+    PARTITIONED/LOST, readiness will not wait for it (degraded
+    readiness), and the first successful rejoin resyncs it."""
     from yoda_tpu.cluster import KubeApiClient, KubeApiConfig, KubeCluster
 
-    cfg = KubeApiConfig.from_env()
+    if url is None:
+        cfg = KubeApiConfig.from_env()
+    else:
+        cfg = KubeApiConfig(
+            base_url=url,
+            token=os.environ.get("YODA_KUBE_TOKEN", ""),
+            ca_file=os.environ.get("YODA_KUBE_CA_FILE") or None,
+            insecure_skip_verify=os.environ.get("YODA_KUBE_INSECURE") == "1",
+        )
     if kinds is None:
         cluster = KubeCluster(KubeApiClient(cfg))
     else:
         cluster = KubeCluster(KubeApiClient(cfg), kinds=kinds)
     cluster.start()
-    if not cluster.wait_for_sync(60.0):
-        raise RuntimeError("timed out syncing informer caches from the API server")
+    if not cluster.wait_for_sync(60.0 if required else 5.0):
+        if required:
+            raise RuntimeError(
+                "timed out syncing informer caches from the API server"
+            )
+        print(
+            f"yoda-tpu-scheduler: federation remote {url} not syncing; "
+            "continuing degraded (health monitor will gate it)",
+            file=sys.stderr,
+        )
     return cluster
 
 
@@ -86,18 +108,54 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     Deployment restarts the pod into standby (upstream kube-scheduler
     behavior, reference deploy/yoda-scheduler.yaml:11-14)."""
     from yoda_tpu.metrics_server import MetricsServer
-    from yoda_tpu.standalone import build_profile_stacks
+    from yoda_tpu.standalone import build_federation, build_profile_stacks
 
     config = _load_config(args.config)
     _init_jax(args.jax_platform)
     cluster = _build_kube_cluster()
-    # Upstream profiles: one process can serve several schedulerNames,
-    # each with its own plugin config (config `profiles:`). The base
-    # profile's stack owns the metrics endpoint and the leader gate.
-    # `stop` doubles as the bind executors' stop event: a SIGTERM or a
-    # lost lease aborts pending bind-retry backoff sleeps immediately
-    # instead of draining up to bind_retry_cap_s per attempt.
-    stacks = build_profile_stacks(cluster, config, stop_event=stop)
+    clusters = [cluster]
+    federation = None
+    if args.federate_url:
+        # Federated multi-cluster mode: the env-configured cluster is the
+        # HOME front; each --federate-url NAME=URL adds a secondary
+        # cluster front (same token/CA env) behind this one scheduler.
+        # Remotes are best-effort at boot — a dead remote degrades
+        # instead of blocking startup (see _build_kube_cluster). The
+        # federation owns per-member fencing and warm-start resyncs, so
+        # profiles are not combined with it (the base profile serves
+        # every member).
+        if config.profiles:
+            print(
+                "yoda-tpu-scheduler: config profiles are ignored in "
+                "federated mode (base profile serves every cluster)",
+                file=sys.stderr,
+            )
+        remotes = []
+        for spec in args.federate_url:
+            name, sep, url = spec.partition("=")
+            if not sep or not name or not url:
+                print(
+                    f"yoda-tpu-scheduler: --federate-url must be NAME=URL, "
+                    f"got {spec!r}",
+                    file=sys.stderr,
+                )
+                return 2
+            remotes.append(
+                (name, _build_kube_cluster(url=url, required=False))
+            )
+        clusters += [c for _, c in remotes]
+        federation = build_federation(
+            [("home", cluster), *remotes], config, stop_event=stop
+        )
+        stacks = [m.stack for m in federation.members]
+    else:
+        # Upstream profiles: one process can serve several schedulerNames,
+        # each with its own plugin config (config `profiles:`). The base
+        # profile's stack owns the metrics endpoint and the leader gate.
+        # `stop` doubles as the bind executors' stop event: a SIGTERM or a
+        # lost lease aborts pending bind-retry backoff sleeps immediately
+        # instead of draining up to bind_retry_cap_s per attempt.
+        stacks = build_profile_stacks(cluster, config, stop_event=stop)
     stack = stacks[0]
 
     # Readiness (/readyz, distinct from /healthz liveness): the Deployment
@@ -106,14 +164,18 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     # in below when --leader-elect is on) AND every profile's warm-start
     # resync has completed AND we are not draining. The informer-sync half
     # is implied: _build_kube_cluster() blocked on wait_for_sync above.
+    # Federated mode swaps in the DEGRADED-READINESS contract
+    # (Federation.ready): ready once the HOME cluster has resynced even
+    # while a remote is PARTITIONED/LOST — an all-stacks-resynced gate
+    # would wedge the standby forever on a dead remote.
     leader_gate: list = [lambda: True]
 
     def _ready() -> bool:
-        return (
-            not stop.is_set()
-            and leader_gate[0]()
-            and all(st.reconciler.resynced.is_set() for st in stacks)
-        )
+        if stop.is_set() or not leader_gate[0]():
+            return False
+        if federation is not None:
+            return federation.ready()
+        return all(st.reconciler.resynced.is_set() for st in stacks)
 
     metrics_srv = None
     if args.metrics_port >= 0:
@@ -129,8 +191,14 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     # pods' reservations charged, and every partially-bound gang adopted
     # or rolled back whole BEFORE any post-promotion bind can happen
     # (/readyz flips only once this completes, via resynced above).
-    for st in stacks:
-        st.scheduler.on_serve_start = st.reconciler.resync
+    # Federated mode: the federation's control loop owns resyncs instead
+    # (each member's fence stays closed until its resync completes, and a
+    # rejoining cluster re-runs the pass) — an on_serve_start hook that
+    # raised on a dead remote would kill that member's serve loop for
+    # good, exactly the wedge the health ladder exists to avoid.
+    if federation is None:
+        for st in stacks:
+            st.scheduler.on_serve_start = st.reconciler.resync
 
     _install_stop_handlers(stop)
 
@@ -157,9 +225,14 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             # bind API write and parks its queue while not leading — the
             # exit-on-loss below is seconds-grained, and an in-flight
             # permit release in that window must not race the new leader's
-            # binds.
-            for st in stacks:
-                st.scheduler.fence_fn = elector.is_leader
+            # binds. Federated members compose the lease with their
+            # per-cluster health fence (Federation.set_leader_gate);
+            # overwriting fence_fn directly would drop the health half.
+            if federation is not None:
+                federation.set_leader_gate(elector.is_leader)
+            else:
+                for st in stacks:
+                    st.scheduler.fence_fn = elector.is_leader
             leader_gate[0] = elector.is_leader  # /readyz follows the lease
             became_leader = threading.Event()
 
@@ -225,6 +298,19 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 )
                 for st in stacks
             )
+        # Federation control loop: health probes, rejoin resyncs, and
+        # spillover migration — ONE background thread, so degradation
+        # never serializes against any member's serve loop.
+        if federation is not None:
+            extra_threads.append(
+                threading.Thread(
+                    target=federation.run_forever,
+                    args=(stop,),
+                    kwargs={"period_s": config.federation_probe_period_s},
+                    name="federation",
+                    daemon=True,
+                )
+            )
         for t in extra_threads:
             t.start()
         stack.scheduler.serve_forever(stop)
@@ -247,7 +333,8 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             metrics_srv.stop()
         if elector_thread is not None:
             elector_thread.join(timeout=5.0)  # lets the elector release the lease
-        cluster.stop()
+        for c in clusters:
+            c.stop()
     return 1 if lost_leadership.is_set() else 0
 
 
@@ -365,6 +452,20 @@ def main(
         "--jax-platform",
         default="cpu",
         help="JAX platform for the scheduler's fused kernel ('' = ambient default)",
+    )
+    fedg = parser.add_argument_group("federation")
+    fedg.add_argument(
+        "--federate-url",
+        action="append",
+        default=None,
+        metavar="NAME=URL",
+        help="add a secondary cluster front (repeatable): NAME labels the "
+        "cluster in metrics/logs, URL is its API server (authenticated "
+        "with the same YODA_KUBE_TOKEN/CA env as the home cluster). The "
+        "env-configured cluster becomes the HOME front; gangs the home "
+        "cluster cannot fit whole spill over to healthy secondaries, and "
+        "a partitioned or lost secondary degrades to local-only placement "
+        "instead of blocking the scheduler",
     )
     ha = parser.add_argument_group("leader election")
     ha.add_argument(

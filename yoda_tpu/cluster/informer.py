@@ -38,6 +38,7 @@ class InformerCache:
         watches_pdbs: bool = False,
         staleness_s: float = 0.0,
         now_fn: Callable[[], float] = time.time,
+        mono_fn: Callable[[], float] = time.monotonic,
     ) -> None:
         self.scheduler_name = scheduler_name
         self.on_pod_pending = on_pod_pending
@@ -66,6 +67,13 @@ class InformerCache:
         # misclassifies as a stale-node refresh).
         self.staleness_s = staleness_s
         self.now_fn = now_fn
+        # Watch-stream staleness clock (federation health signal, also a
+        # standalone stuck-watch debugging probe): the monotonic instant
+        # the last watch event of ANY kind reached this cache. Separate
+        # clock domain from now_fn — event age is a local liveness
+        # measure, never compared against agent-stamped wall timestamps.
+        self.mono_fn = mono_fn
+        self._last_event_mono: float | None = None
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
         self._nodes: dict[str, K8sNode] = {}
@@ -102,6 +110,8 @@ class InformerCache:
     # --- watch sink ---
 
     def handle(self, event: Event) -> None:
+        with self._lock:
+            self._last_event_mono = self.mono_fn()
         relevant = True
         if event.kind == "TpuNodeMetrics":
             relevant = self._handle_tpu(event)
@@ -307,6 +317,21 @@ class InformerCache:
         ChipAccountant.chips_by_node — same per-dispatch N-call cost)."""
         with self._lock:
             return dict(self._claimed_mib)
+
+    def last_event_age_s(self) -> "float | None":
+        """Seconds since the last watch event of any kind reached this
+        cache, or None before the first event (a stack built list-then-
+        watch replays existing objects, so None means the watch source
+        never delivered anything at all). The federation health monitor's
+        primary staleness signal — a partitioned API server goes silent
+        here long before a probe times out — and a standalone probe for
+        debugging stuck watch streams (`informer.last_event_age_s()`
+        climbing while the cluster churns = the watch is dead, not the
+        cluster quiet)."""
+        with self._lock:
+            if self._last_event_mono is None:
+                return None
+            return max(self.mono_fn() - self._last_event_mono, 0.0)
 
     def last_updated_map(self) -> dict[str, float]:
         """Live per-node metric timestamps — the freshness source for the

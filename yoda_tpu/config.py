@@ -159,6 +159,26 @@ class SchedulerConfig:
     # on a real API server it re-LISTs pods, so keep it tens of seconds.
     # 0 disables the background loop (the warm-start resync still runs).
     reconcile_period_s: float = 30.0
+    # Federated multi-cluster scheduling (yoda_tpu/federation): the
+    # per-cluster health ladder's silence thresholds. A cluster front
+    # whose probes AND watch stream have been silent for degraded_after_s
+    # stops receiving new spillover; past partitioned_after_s it is
+    # fenced from binding entirely (and its warm-start gate closes, so a
+    # rejoin resyncs through the reconciler before the first new bind);
+    # past lost_after_s readiness stops waiting for it. Must satisfy
+    # 0 < degraded <= partitioned <= lost.
+    federation_degraded_after_s: float = 10.0
+    federation_partitioned_after_s: float = 30.0
+    federation_lost_after_s: float = 120.0
+    # Period of the federation control loop (health probes, rejoin
+    # resyncs, spillover migration) — one background thread, never the
+    # serve loops. Probes are one cheap LIST per cluster per pass.
+    federation_probe_period_s: float = 1.0
+    # Spillover routing: migrate a gang the home cluster cannot fit whole
+    # to the first healthy secondary whose snapshot fits it (all-or-
+    # nothing, exactly one cluster). False = clusters federate for
+    # health/failover only; every gang stays on its home cluster.
+    federation_spillover: bool = True
     # Cluster events retry a parked pod immediately through this many
     # scheduling attempts; beyond it the pod's exponential backoff timer
     # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue
@@ -295,6 +315,29 @@ class SchedulerConfig:
             raise ValueError(
                 "reconcile_period_s must be >= 0 (0 disables the "
                 f"background reconciler), got {cfg.reconcile_period_s!r}"
+            )
+        thresholds = (
+            cfg.federation_degraded_after_s,
+            cfg.federation_partitioned_after_s,
+            cfg.federation_lost_after_s,
+        )
+        if any(
+            isinstance(t, bool) or not isinstance(t, (int, float))
+            for t in thresholds
+        ) or not (0 < thresholds[0] <= thresholds[1] <= thresholds[2]):
+            raise ValueError(
+                "federation health thresholds must satisfy 0 < "
+                "degraded_after_s <= partitioned_after_s <= lost_after_s, "
+                f"got {thresholds}"
+            )
+        if not isinstance(
+            cfg.federation_probe_period_s, (int, float)
+        ) or isinstance(
+            cfg.federation_probe_period_s, bool
+        ) or cfg.federation_probe_period_s <= 0:
+            raise ValueError(
+                "federation_probe_period_s must be > 0, got "
+                f"{cfg.federation_probe_period_s!r}"
             )
         if (
             isinstance(cfg.immediate_retry_attempts, bool)

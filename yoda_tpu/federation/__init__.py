@@ -1,0 +1,33 @@
+"""Federated multi-cluster scheduling: several cluster fronts behind one
+scheduler, with partition-tolerant degradation.
+
+Every robustness layer before this PR (chaos hardening, the bind pipeline,
+crash-safe failover) assumed a single cluster front — one watch stream, one
+reconciler, one failure domain — so a partitioned or dead API server still
+took the whole scheduler down with it. This package closes that gap:
+
+- :mod:`yoda_tpu.federation.health` — a per-cluster health state machine
+  (``UP -> DEGRADED -> PARTITIONED -> LOST``) driven by watch-stream
+  staleness (``InformerCache.last_event_age_s``), probe deadlines, and the
+  transient-error classifier in ``cluster/retry.py``.
+- :mod:`yoda_tpu.federation.federation` — the ``Federation`` coordinator:
+  one fully-wired stack (and therefore one PR 5 ``Reconciler``) per
+  cluster front, per-cluster fencing that keeps a sick cluster's binds off
+  the API without blocking any serve loop, spillover routing that migrates
+  a gang the home cluster cannot fit WHOLE onto exactly one secondary
+  cluster (all-or-nothing, never split), and rejoin handling that
+  warm-starts a healed cluster through its reconciler's resync while the
+  other clusters keep serving.
+
+Assemble one with ``standalone.build_federation``.
+"""
+
+from yoda_tpu.federation.federation import Federation, FederationMember
+from yoda_tpu.federation.health import ClusterHealthMonitor, ClusterState
+
+__all__ = [
+    "ClusterHealthMonitor",
+    "ClusterState",
+    "Federation",
+    "FederationMember",
+]

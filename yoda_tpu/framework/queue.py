@@ -290,6 +290,75 @@ class SchedulingQueue:
             self._fire_activity()
         return removed
 
+    def pending_gangs(self) -> "dict[str, tuple[int, int]]":
+        """gang name -> (queued member count, min attempts over them),
+        across all three pools. The federation spillover pass reads this
+        to find gangs that are WHOLE in the queue (count >= declared size)
+        and have already failed locally (min attempts >= 1) — candidates
+        for migration to a secondary cluster."""
+        with self._lock:
+            out: dict[str, tuple[int, int]] = {}
+
+            def count(qpi: QueuedPodInfo) -> None:
+                gang = gang_name_of(qpi.pod.labels)
+                if not gang:
+                    return
+                n, a = out.get(gang, (0, 1 << 30))
+                out[gang] = (n + 1, min(a, qpi.attempts))
+
+            for item in self._active:
+                count(item.qpi)
+            for _, _, qpi in self._backoff:
+                count(qpi)
+            for qpi in self._unschedulable.values():
+                count(qpi)
+            return out
+
+    def take_gang(self, gang: str) -> list[QueuedPodInfo]:
+        """Atomically remove EVERY entry of ``gang`` from all three pools
+        and return them (attempt counts untouched — no scheduling cycle
+        runs on this path). While taken, this queue cannot pop or bind the
+        members, which is what makes cross-cluster spillover migration
+        race-free: the home cluster provably cannot place a gang whose
+        entries are in the migrator's hands. Give unmigrated entries back
+        with :meth:`readd`."""
+        taken: list[QueuedPodInfo] = []
+        with self._cond:
+            keep_active: list[_HeapItem] = []
+            for item in self._active:
+                if gang_name_of(item.qpi.pod.labels) == gang:
+                    taken.append(item.qpi)
+                else:
+                    keep_active.append(item)
+            if len(keep_active) != len(self._active):
+                heapq.heapify(keep_active)
+                self._active = keep_active
+            keep_backoff: list[tuple[float, int, QueuedPodInfo]] = []
+            for entry in self._backoff:
+                if gang_name_of(entry[2].pod.labels) == gang:
+                    taken.append(entry[2])
+                else:
+                    keep_backoff.append(entry)
+            if len(keep_backoff) != len(self._backoff):
+                heapq.heapify(keep_backoff)
+                self._backoff = keep_backoff
+            for key in [
+                k
+                for k, q in self._unschedulable.items()
+                if gang_name_of(q.pod.labels) == gang
+            ]:
+                taken.append(self._unschedulable.pop(key))
+        return taken
+
+    def readd(self, qpi: QueuedPodInfo) -> None:
+        """Return a :meth:`take_gang` entry to the active queue untouched
+        (unlike :meth:`restore`, no attempt decrement — take_gang never
+        incremented one)."""
+        with self._cond:
+            self._push_active(qpi)
+            self._cond.notify()
+        self._fire_activity()
+
     def restore(self, qpi: QueuedPodInfo) -> None:
         """Return a popped-but-unscheduled entry to the active queue (the
         burst pop un-pops gang members it encounters so their own pop runs

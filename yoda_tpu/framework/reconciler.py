@@ -294,10 +294,19 @@ class Reconciler:
         truth_keys = {p.key for p in pods}
 
         # Ghost bindings: cluster truth says bound, the cache does not —
-        # the watch dropped the bind. Re-inject it.
+        # the watch dropped the bind. Re-inject it. The reverse ADD gap
+        # too: a pod CREATED while the watch was down (e.g. during a
+        # cluster partition) exists in truth but never reached the
+        # informer, so it was never queued — replay its add so it
+        # schedules (the federation rejoin path depends on this: work
+        # submitted to a partitioned cluster must surface on heal).
+        live_cache = self.informer.live_uid_set()
         for p in pods:
             if p.node_name and not self.informer.counts_bound(p.uid):
                 self._repair_event("modified", p)
+                report.ghost_pods += 1
+            elif p.uid not in live_cache:
+                self._repair_event("added", p)
                 report.ghost_pods += 1
 
         # Reverse ghosts: the cache believes a pod alive that cluster

@@ -370,6 +370,28 @@ class SchedulingMetrics:
             "waiting pod was deleted (instead of eating the full permit "
             "timeout)",
         )
+        # Federated multi-cluster scheduling (docs/OPERATIONS.md
+        # multi-cluster runbook): per-cluster health, health transitions,
+        # and gangs migrated off the home cluster by spillover routing.
+        self.cluster_state = r.gauge(
+            "yoda_cluster_state",
+            "Federated cluster-front health per cluster (0=up 1=degraded "
+            "2=partitioned 3=lost); a non-up cluster takes no new "
+            "spillover, and partitioned/lost clusters are fenced from "
+            "binding entirely",
+        )
+        self.cluster_transitions = r.counter(
+            "yoda_cluster_transitions_total",
+            "Health-state transitions per cluster front (flapping here "
+            "means the degraded/partitioned thresholds sit too close to "
+            "the cluster's real probe/watch latency)",
+        )
+        self.spillover_gangs = r.counter(
+            "yoda_spillover_gangs_total",
+            "Gangs the federation migrated whole to a secondary cluster "
+            "because the home cluster could not fit them (all-or-nothing: "
+            "a gang is never split across clusters)",
+        )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
 

@@ -285,6 +285,10 @@ def build_stack(
         # republishes of unchanged metrics do not bump the metrics
         # version or reactivate parked pods; a stale node's refresh does.
         staleness_s=config.max_metrics_age_s,
+        # The watch-staleness clock (last_event_age_s) runs on the
+        # stack's scheduling clock so fake-clock tests can advance it;
+        # production passes time.monotonic either way.
+        mono_fn=clock,
     )
 
     # Wire the PDB source now the informer exists: preemption's victim
@@ -508,6 +512,60 @@ def build_stack(
         binder=binder,
         bind_executor=bind_executor,
         reconciler=reconciler,
+    )
+
+
+def build_federation(
+    clusters: "list[tuple[str, object]]",
+    config: SchedulerConfig | None = None,
+    *,
+    clock=time.monotonic,
+    stop_event: "threading.Event | None" = None,
+):
+    """Assemble a federated multi-cluster scheduler: one fully-wired stack
+    per cluster front (own informer, accountant, gang plugin, and PR 5
+    reconciler — cluster capacity is disjoint, so only the metrics
+    registry is shared), each front watched by a health monitor fed from
+    the cluster's probe surface and the informer's watch-staleness clock.
+    ``clusters`` is ordered (name, cluster) pairs; the FIRST entry is the
+    HOME cluster — the front workloads arrive on, and the one spillover
+    migrates gangs off when it cannot fit them whole.
+
+    The returned ``Federation`` owns per-member fencing (health + resync
+    gate + leader gate) and the background control loop
+    (``Federation.run_forever``); member serve loops start fenced and open
+    once the first health pass completes their warm-start resync."""
+    from yoda_tpu.federation import ClusterHealthMonitor, Federation, FederationMember
+
+    config = config or SchedulerConfig()
+    shared_metrics = SchedulingMetrics()
+    members: list[FederationMember] = []
+    for name, cluster in clusters:
+        stack = build_stack(
+            cluster=cluster,
+            config=config,
+            metrics=shared_metrics,
+            clock=clock,
+            stop_event=stop_event,
+        )
+        health = ClusterHealthMonitor(
+            name,
+            # Probe the cluster front when it offers one (KubeCluster /
+            # FakeCluster / ChaosCluster all do); a front without a probe
+            # is judged on watch staleness alone.
+            probe_fn=getattr(cluster, "probe", None),
+            staleness_fn=stack.informer.last_event_age_s,
+            degraded_after_s=config.federation_degraded_after_s,
+            partitioned_after_s=config.federation_partitioned_after_s,
+            lost_after_s=config.federation_lost_after_s,
+            clock=clock,
+        )
+        members.append(FederationMember(name, cluster, stack, health))
+    return Federation(
+        members,
+        metrics=shared_metrics,
+        spillover=config.federation_spillover,
+        clock=clock,
     )
 
 

@@ -24,10 +24,22 @@ produces, on a seeded schedule a test can replay exactly:
   410 Gone (forcing the client's relist-and-resync).
 
 Ops recognized by the built-in wrappers: ``bind``, ``unbind``,
-``metrics``, ``dispatch``, ``watch``, ``crash``. Each retry of a faulted
-call counts as a fresh invocation — a ``count=1`` bind conflict fails
-once and the binder's first retry succeeds; ``count > retry budget``
-forces the genuine-failure path (gang rollback).
+``metrics``, ``dispatch``, ``watch``, ``crash``, ``cluster_partition``,
+``cluster_loss``. Each retry of a faulted call counts as a fresh
+invocation — a ``count=1`` bind conflict fails once and the binder's
+first retry succeeds; ``count > retry budget`` forces the genuine-failure
+path (gang rollback).
+
+The ``cluster_partition`` / ``cluster_loss`` ops are the **federation
+fault modes** (multi-cluster PR): while a ChaosCluster front is
+partitioned, every scheduler-side read and write through it raises
+:class:`ChaosTimeout` (retryable — the transport signature of a real
+partition) and every watch event is dropped in transit, so cluster truth
+and the scheduler's caches diverge for the whole window; ``heal()`` ends
+a partition and the federation's rejoin path (health monitor transition →
+reconciler resync) re-converges the state. ``cluster_loss`` is the
+permanent form. Consumed via :func:`maybe_cluster_fault` at points the
+sweep chooses, so the fault schedule stays seeded and replayable.
 
 The ``crash`` op is the **scheduler_crash mode** (crash-safe failover
 PR): a scheduled crash fault fires on the Nth bind call and kills the
@@ -59,6 +71,13 @@ _DEFAULT_KINDS = {
     "dispatch": ("error",),
     "watch": ("drop",),
     "crash": ("after_bind", "before_bind"),
+    # Federation fault modes (multi-cluster PR): a scheduled
+    # cluster_partition fault partitions the scheduler from one cluster
+    # front (every scheduler-side read/write times out, every watch event
+    # is lost in transit) until the sweep heals it; cluster_loss is the
+    # permanent version. Consumed via maybe_cluster_fault.
+    "cluster_partition": ("partition",),
+    "cluster_loss": ("loss",),
 }
 
 
@@ -187,9 +206,95 @@ class ChaosCluster:
         # event) fires exactly once, before the triggering call raises.
         self.crashed = threading.Event()
         self.on_crash = None  # Callable[[], None] | None
+        # cluster_partition / cluster_loss modes (federation PR): while
+        # either is set, every scheduler-side read/write through this
+        # front raises ChaosTimeout (retryable — exactly what a real
+        # network partition produces) and every watch event is DROPPED in
+        # transit: the inner store (cluster truth) keeps moving, the
+        # scheduler's caches go silent and stale, and only a rejoin
+        # resync re-converges them. Loss is partition made permanent.
+        self._partitioned = threading.Event()
+        self.lost = threading.Event()
+        self.dropped_events = 0
 
     def __getattr__(self, name: str):
         return getattr(self._inner, name)
+
+    @property
+    def inner(self):
+        """The backing cluster — tests play EXTERNAL actors (users,
+        controllers, node agents on the far side of the partition)
+        through this; the partition severs only the scheduler's path."""
+        return self._inner
+
+    # --- partition / loss controls ---
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partitioned.is_set() or self.lost.is_set()
+
+    def partition(self) -> None:
+        """Sever the scheduler from this cluster front (heal() restores)."""
+        self._partitioned.set()
+
+    def heal(self) -> None:
+        """End a partition. A LOST cluster stays lost — loss is the
+        permanent failure mode (clear ``lost`` manually to model a
+        rebuilt cluster)."""
+        self._partitioned.clear()
+
+    def lose(self) -> None:
+        """Permanently sever the cluster (cluster_loss mode)."""
+        self.lost.set()
+
+    def _check_partition(self, detail: str) -> None:
+        if self.partitioned:
+            raise ChaosTimeout(f"chaos: cluster partitioned: {detail}")
+
+    def add_watcher(self, fn, *, replay: bool = True) -> None:
+        """Register ``fn`` behind the partition gate: events raised while
+        partitioned/lost are dropped in transit (counted), exactly as a
+        severed watch stream loses them — the drift the rejoin resync
+        must repair."""
+
+        def gated(event) -> None:
+            if self.partitioned:
+                self.dropped_events += 1
+                return
+            fn(event)
+
+        self._inner.add_watcher(gated, replay=replay)
+
+    def probe(self) -> None:
+        """The health monitor's probe: times out while partitioned/lost
+        (transient by classification — silence, not refusal), else
+        delegates to the inner cluster's probe."""
+        self._check_partition("probe")
+        inner_probe = getattr(self._inner, "probe", None)
+        if inner_probe is not None:
+            inner_probe()
+
+    # --- scheduler-side reads (partitioned reads time out too) ---
+
+    def list_pods(self):
+        self._check_partition("list pods")
+        return self._inner.list_pods()
+
+    def get_pod(self, pod_key: str):
+        self._check_partition(f"get {pod_key}")
+        return self._inner.get_pod(pod_key)
+
+    def list_tpu_metrics(self):
+        self._check_partition("list tpunodemetrics")
+        return self._inner.list_tpu_metrics()
+
+    def create_pod(self, pod):
+        self._check_partition(f"create {pod.key}")
+        return self._inner.create_pod(pod)
+
+    def delete_pod(self, pod_key: str) -> None:
+        self._check_partition(f"delete {pod_key}")
+        return self._inner.delete_pod(pod_key)
 
     def respawn(self, plan: "ChaosPlan | None" = None) -> "ChaosCluster":
         """A fresh front over the SAME backing cluster — the promoted
@@ -224,6 +329,7 @@ class ChaosCluster:
 
     def bind_pod(self, pod_key: str, node_name: str) -> None:
         self._check_alive(f"bind {pod_key}")
+        self._check_partition(f"bind {pod_key}")
         self._maybe_crash(pod_key, node_name)
         f = self.plan.next("bind")
         if f is not None:
@@ -232,6 +338,7 @@ class ChaosCluster:
 
     def unbind_pod(self, pod_key: str, node_name: str) -> None:
         self._check_alive(f"unbind {pod_key}")
+        self._check_partition(f"unbind {pod_key}")
         f = self.plan.next("unbind")
         if f is not None:
             raise make_error(f.kind, f"unbind {pod_key} from {node_name}")
@@ -242,10 +349,12 @@ class ChaosCluster:
         # nothing. External actors (tests playing the user/controller)
         # use delete_pod on the inner cluster, which stays live.
         self._check_alive(f"evict {pod_key}")
+        self._check_partition(f"evict {pod_key}")
         return self._inner.evict_pod(pod_key)
 
     def set_nominated_node(self, pod_key: str, node_name) -> None:
         self._check_alive(f"nominate {pod_key}")
+        self._check_partition(f"nominate {pod_key}")
         return self._inner.set_nominated_node(pod_key, node_name)
 
     def put_tpu_metrics(self, tpu) -> None:
@@ -317,6 +426,28 @@ def install_chaos_kernel(batch_plugin, plan: ChaosPlan) -> ChaosKernel:
     wrapped = ChaosKernel(inner, plan)
     batch_plugin._kern = wrapped
     return wrapped
+
+
+def maybe_cluster_fault(plan: ChaosPlan, cluster: ChaosCluster) -> "str | None":
+    """Consume one invocation each of the federation cluster-fault ops
+    against ``cluster`` (a ChaosCluster front). A scheduled
+    ``cluster_partition`` fault partitions the front (the sweep heals it
+    on its own schedule); a scheduled ``cluster_loss`` fault severs it
+    permanently. Returns which op fired ("cluster_partition" /
+    "cluster_loss") or None. Ops never scheduled by the plan do not
+    consume invocation indices (``has_op``), keeping other ops' indices
+    stable — same discipline as the crash op."""
+    if plan.has_op("cluster_loss"):
+        f = plan.next("cluster_loss")
+        if f is not None:
+            cluster.lose()
+            return "cluster_loss"
+    if plan.has_op("cluster_partition"):
+        f = plan.next("cluster_partition")
+        if f is not None:
+            cluster.partition()
+            return "cluster_partition"
+    return None
 
 
 def maybe_drop_watch(plan: ChaosPlan, server) -> bool:
