@@ -8,7 +8,7 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test lint native bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native bench bench-scale smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -38,6 +38,13 @@ bench: native
 # burst+gang hot-path rate without the full bench's minutes of scenarios.
 smoke:
 	$(PY) bench.py --smoke
+
+# Synthetic 1k/10k/100k-node fleet sweeps (CPU-pinned, virtual 8-device
+# mesh): device-resident delta-apply flatness at low churn + node-axis
+# sharded joint-dispatch scaling, emitted as one bench JSON line.
+bench-scale:
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) bench.py --scale
 
 # Fault-injection suite (fixed seed, replayable): gang bind rollback,
 # transient-error retry, dispatch fallback chain, leader fencing, the

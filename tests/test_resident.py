@@ -1,0 +1,489 @@
+"""Device-resident incremental fleet state (ops/resident.py, ISSUE 7).
+
+The contract under test: applying informer watch deltas through
+``FleetStateCache`` — changed-row refills scattered in place onto the
+kernel's device, dynamics rows maintained from the reservation/claim
+delta feeds — must produce BIT-IDENTICAL filter/score results to a cold
+full re-stack at every point of a randomized add/update/delete/churn
+sequence, across bucket boundaries and through a forced epoch-skew
+fallback; and the epoch feed must let cached dispatch sets survive
+unrelated-node changes instead of re-dispatching (the old behavior
+dropped every cached row on ANY fleet change).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.cluster import Event, InformerCache
+from yoda_tpu.config import SchedulerConfig, Weights
+from yoda_tpu.ops.arrays import FleetArrays
+from yoda_tpu.ops.kernel import DeviceFleetKernel, KernelRequest
+from yoda_tpu.ops.resident import FleetStateCache
+from yoda_tpu.plugins.yoda import YodaBatch
+from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+from yoda_tpu.standalone import build_stack
+
+GIB = 1 << 30
+
+
+def _informer_with(n: int, chips: int = 4) -> InformerCache:
+    inf = InformerCache()
+    for i in range(n):
+        inf.handle(
+            Event(
+                "added", "TpuNodeMetrics",
+                make_node(f"n{i:04d}", chips=chips, now=0.0),
+            )
+        )
+    return inf
+
+
+def _cache_over(informer, accountant, kern) -> FleetStateCache:
+    return FleetStateCache(
+        changes_fn=informer.changes_since,
+        kern_fn=lambda arrays, _k=kern: _k,
+        reserved_delta_fn=accountant.reserved_changes_since,
+        reserved_map_fn=accountant.chips_by_node,
+        claimed_delta_fn=informer.claimed_changes_since,
+        claimed_map_fn=informer.claimed_hbm_mib_map,
+    )
+
+
+def _cold_results(informer, accountant, req):
+    """The reference: a cold full re-stack + fresh dyn from the live maps
+    — what every cycle paid before the resident cache."""
+    arrays = FleetArrays.from_snapshot(informer.snapshot())
+    kern = DeviceFleetKernel(Weights())
+    kern.put_static(arrays)
+    dyn = arrays.dyn_packed(
+        accountant.chips_by_node(), informer.claimed_hbm_mib_map()
+    )
+    return arrays, kern.evaluate(dyn, req)
+
+
+def _assert_identical(got, want, names):
+    np.testing.assert_array_equal(got.feasible, want.feasible)
+    np.testing.assert_array_equal(got.reasons, want.reasons)
+    np.testing.assert_array_equal(got.raw_scores, want.raw_scores)
+    np.testing.assert_array_equal(got.scores, want.scores)
+    np.testing.assert_array_equal(got.claimable, want.claimable)
+    assert got.best_index == want.best_index, names
+
+
+class TestDeltaParity:
+    """Satellite: randomized churn through the cache == cold re-stack."""
+
+    def test_randomized_churn_parity(self):
+        rng = random.Random(1234)
+        informer = _informer_with(12)
+        accountant = ChipAccountant()
+        kern = DeviceFleetKernel(Weights())
+        cache = _cache_over(informer, accountant, kern)
+        req = KernelRequest(2, 4 * 1024, 0, 0, 0)
+        live = {f"n{i:04d}" for i in range(12)}
+        next_id = 12
+        uids: list[str] = []
+        for step in range(40):
+            op = rng.choice(["update", "update", "update", "add", "delete",
+                            "reserve", "release", "pod"])
+            if op == "update" and live:
+                name = rng.choice(sorted(live))
+                informer.handle(
+                    Event(
+                        "modified", "TpuNodeMetrics",
+                        make_node(
+                            name, chips=4,
+                            hbm_free_per_chip=rng.choice(
+                                [2, 4, 8, 16]
+                            ) * GIB,
+                            unhealthy=(0,) if rng.random() < 0.3 else (),
+                            now=0.0,
+                        ),
+                    )
+                )
+            elif op == "add":
+                name = f"n{next_id:04d}"
+                next_id += 1
+                live.add(name)
+                informer.handle(
+                    Event(
+                        "added", "TpuNodeMetrics",
+                        make_node(name, chips=4, now=0.0),
+                    )
+                )
+            elif op == "delete" and len(live) > 4:
+                name = live.pop()
+                informer.handle(
+                    Event(
+                        "deleted", "TpuNodeMetrics",
+                        make_node(name, chips=4, now=0.0),
+                    )
+                )
+            elif op == "reserve" and live:
+                uid = f"uid-{step}"
+                uids.append(uid)
+                accountant._claim(uid, rng.choice(sorted(live)), 2)
+            elif op == "release" and uids:
+                accountant.release(uids.pop(0))
+            elif op == "pod" and live:
+                node = rng.choice(sorted(live))
+                informer.handle(
+                    Event(
+                        "added", "Pod",
+                        PodSpec(
+                            f"pod-{step}", uid=f"pu-{step}",
+                            node_name=node,
+                            labels={"tpu/chips": "1", "tpu/hbm": "2Gi"},
+                        ),
+                    )
+                )
+            snap = informer.snapshot()
+            cache.sync(snap)
+            got = cache.kern.evaluate(cache.dyn_packed(), req)
+            _, want = _cold_results(informer, accountant, req)
+            _assert_identical(got, want, cache.arrays.names)
+        # The steady stream of single-node updates rode the delta path.
+        assert cache.delta_syncs > 0
+        assert cache.rows_applied > 0
+
+    def test_bucket_growth_forces_restack_and_stays_identical(self):
+        informer = _informer_with(7)  # bucket 8
+        accountant = ChipAccountant()
+        kern = DeviceFleetKernel(Weights())
+        cache = _cache_over(informer, accountant, kern)
+        req = KernelRequest(1, 0, 0, 0, 0)
+        cache.sync(informer.snapshot())
+        assert cache.arrays.padded_shape[0] == 8
+        r0 = cache.restacks
+        for i in range(7, 10):  # across the 8 -> 16 row-bucket boundary
+            informer.handle(
+                Event(
+                    "added", "TpuNodeMetrics",
+                    make_node(f"n{i:04d}", chips=4, now=0.0),
+                )
+            )
+        cache.sync(informer.snapshot())
+        assert cache.arrays.padded_shape[0] == 16
+        assert cache.restacks == r0 + 1  # structural delta: one re-stack
+        got = cache.kern.evaluate(cache.dyn_packed(), req)
+        _, want = _cold_results(informer, accountant, req)
+        _assert_identical(got, want, cache.arrays.names)
+
+    def test_chip_bucket_growth_forces_restack(self):
+        informer = _informer_with(6, chips=4)
+        accountant = ChipAccountant()
+        kern = DeviceFleetKernel(Weights())
+        cache = _cache_over(informer, accountant, kern)
+        cache.sync(informer.snapshot())
+        assert cache.arrays.padded_shape[1] == 4
+        r0 = cache.restacks
+        # One node's CR grows past the chip bucket: a value change (not
+        # structural), but the mirror cannot hold 6 chip columns.
+        informer.handle(
+            Event(
+                "modified", "TpuNodeMetrics",
+                make_node("n0001", chips=6, now=0.0),
+            )
+        )
+        cache.sync(informer.snapshot())
+        assert cache.restacks == r0 + 1
+        assert cache.arrays.padded_shape[1] >= 6
+        req = KernelRequest(5, 0, 0, 0, 0)  # only the 6-chip node fits
+        got = cache.kern.evaluate(cache.dyn_packed(), req)
+        _, want = _cold_results(informer, accountant, req)
+        _assert_identical(got, want, cache.arrays.names)
+
+    def test_epoch_skew_falls_back_to_restack(self):
+        informer = _informer_with(6)
+        accountant = ChipAccountant()
+        kern = DeviceFleetKernel(Weights())
+        cache = _cache_over(informer, accountant, kern)
+        cache.sync(informer.snapshot())
+        # Ahead-skew (state inherited from another informer): the feed
+        # cannot serve and the cache must re-stack, not serve stale rows.
+        cache.epoch = 10_000
+        assert informer.changes_since(10_000) is None
+        informer.handle(
+            Event(
+                "modified", "TpuNodeMetrics",
+                make_node("n0000", chips=4, hbm_free_per_chip=2 * GIB,
+                          now=0.0),
+            )
+        )
+        r0 = cache.restacks
+        cache.sync(informer.snapshot())
+        assert cache.restacks == r0 + 1
+        req = KernelRequest(2, 1024, 0, 0, 0)
+        got = cache.kern.evaluate(cache.dyn_packed(), req)
+        _, want = _cold_results(informer, accountant, req)
+        _assert_identical(got, want, cache.arrays.names)
+
+    def test_behind_skew_returns_none(self):
+        informer = _informer_with(3)
+        # A consumer from before the ring's reach: the feed refuses
+        # rather than returning a partial delta.
+        assert informer.changes_since(-5) is None
+        cur = informer.metrics_version
+        d = informer.changes_since(cur)
+        assert d is not None and not d.changed and not d.structural
+
+
+class TestDeltaFeed:
+    def test_modified_vs_structural_kinds(self):
+        informer = _informer_with(4)
+        e0 = informer.metrics_version
+        informer.handle(
+            Event(
+                "modified", "TpuNodeMetrics",
+                make_node("n0002", chips=4, hbm_free_per_chip=GIB, now=0.0),
+            )
+        )
+        d = informer.changes_since(e0)
+        assert d.changed == {"n0002"} and not d.structural
+        informer.handle(
+            Event(
+                "deleted", "TpuNodeMetrics",
+                make_node("n0003", chips=4, now=0.0),
+            )
+        )
+        d = informer.changes_since(e0)
+        assert d.structural
+        # Heartbeat (value-identical republish): no epoch bump, no delta.
+        e1 = informer.metrics_version
+        informer.handle(
+            Event(
+                "modified", "TpuNodeMetrics",
+                make_node("n0002", chips=4, hbm_free_per_chip=GIB, now=0.0),
+            )
+        )
+        assert informer.metrics_version == e1
+        assert informer.changes_since(e1).changed == frozenset()
+
+    def test_reserved_delta_feed(self):
+        acc = ChipAccountant()
+        e0 = acc.reservation_epoch
+        acc._claim("u1", "host-a", 3)
+        acc._claim("u2", "host-b", 2)
+        cur, changes = acc.reserved_changes_since(e0)
+        assert changes == {"host-a": 3, "host-b": 2}
+        acc.release("u1")
+        cur2, changes2 = acc.reserved_changes_since(cur)
+        assert changes2 == {"host-a": 0}
+        # Same-epoch ask: empty delta, not a rebuild.
+        assert acc.reserved_changes_since(cur2) == (cur2, {})
+        # Ahead-skew: rebuild signal.
+        assert acc.reserved_changes_since(cur2 + 50)[1] is None
+
+
+class TestSelectiveInvalidation:
+    """Satellite: an unrelated node update no longer forces re-dispatch
+    of a cached burst / gang-fused set (ISSUE 7)."""
+
+    def _stack(self):
+        stack = build_stack(
+            config=SchedulerConfig(mode="batch", batch_requests=8)
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(2):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        # The UNRELATED node: 1 chip — infeasible for every 2-chip pod
+        # below, so its churn cannot touch any cached row's math.
+        agent.add_host("tiny", generation="v5e", chips=1)
+        agent.publish_all()
+        yb = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        return stack, agent, yb
+
+    def test_unrelated_node_update_keeps_burst(self):
+        stack, agent, yb = self._stack()
+        pods = [
+            PodSpec(f"p-{i}", labels={"tpu/chips": "2"}) for i in range(2)
+        ]
+        for p in pods:
+            stack.cluster.create_pod(p)
+        stack.framework.prepare_burst(pods, stack.informer.snapshot())
+        assert yb._burst is not None
+        # Unrelated churn between prepare and the serves: the tiny node's
+        # chip flips health — a real metrics-epoch bump.
+        agent.set_chip_health("tiny", 0, False)
+        agent.refresh("tiny")
+        d0 = yb.dispatch_count
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        bound = [
+            p for p in stack.cluster.list_pods()
+            if p.node_name and p.name.startswith("p-")
+        ]
+        assert len(bound) == 2
+        # THE regression assertion: both cycles served from the cached
+        # rows — no re-dispatch, no invalidation, set retained.
+        assert yb.burst_served == 2
+        assert yb.burst_invalidated == 0
+        assert yb.dispatch_count == d0
+        assert yb.sets_retained >= 1
+
+    def test_related_node_update_still_drops_burst(self):
+        stack, agent, yb = self._stack()
+        pods = [
+            PodSpec(f"p-{i}", labels={"tpu/chips": "2"}) for i in range(2)
+        ]
+        for p in pods:
+            stack.cluster.create_pod(p)
+        stack.framework.prepare_burst(pods, stack.informer.snapshot())
+        assert yb._burst is not None
+        # A node the rows are FEASIBLE on changes: stale capacity math,
+        # the set must drop and the cycles re-dispatch fresh.
+        agent.set_chip_health("v5e-0", 0, False)
+        agent.refresh("v5e-0")
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        bound = [
+            p for p in stack.cluster.list_pods()
+            if p.node_name and p.name.startswith("p-")
+        ]
+        assert len(bound) == 2
+        assert yb.burst_invalidated >= 1
+
+    def test_unrelated_node_update_keeps_gang_rows(self):
+        stack, agent, yb = self._stack()
+        members = [
+            PodSpec(
+                f"g-{m}",
+                labels={
+                    "tpu/gang": "g", "tpu/gang-size": "2", "tpu/chips": "2",
+                },
+            )
+            for m in range(2)
+        ]
+        for p in members:
+            stack.cluster.create_pod(p)
+        stack.framework.prepare_gang(members, stack.informer.snapshot())
+        assert "g" in yb._gang_bursts
+        agent.set_chip_health("tiny", 0, False)
+        agent.refresh("tiny")
+        while (q := stack.scheduler.queue.pop(timeout=0)) is not None:
+            stack.scheduler.schedule_one(q)
+        bound = [
+            p for p in stack.cluster.list_pods()
+            if p.node_name and p.name.startswith("g-")
+        ]
+        assert len(bound) == 2
+        assert yb.gang_burst_served == 2
+        assert yb.gang_burst_invalidated == 0
+        assert yb.sets_retained >= 1
+
+
+class TestResidentStack:
+    """The wired stack rides the resident path end to end."""
+
+    def test_stack_delta_syncs_instead_of_restacks(self):
+        stack = build_stack(config=SchedulerConfig(mode="batch"))
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"h-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("warm", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=30)
+        yb = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        assert yb._resident is not None
+        static0 = yb._static
+        restacks0 = yb.restacks
+        # Rolling single-node refreshes + dispatches: absorbed in place.
+        for k in range(3):
+            agent.set_chip_health(f"h-{k}", 0, False)
+            agent.refresh(f"h-{k}")
+            stack.cluster.create_pod(
+                PodSpec(f"p{k}", labels={"tpu/chips": "2"})
+            )
+            stack.scheduler.run_until_idle(max_wall_s=30)
+        assert yb.restacks == restacks0, "refreshes must not re-stack"
+        assert yb._resident.delta_syncs >= 3
+        assert yb._resident.rows_applied >= 3
+        assert yb._static is static0  # same mirror object, rows refilled
+        assert not static0.chip_healthy[
+            static0.names.index("h-0"), 0
+        ]
+        pods = [p for p in stack.cluster.list_pods() if p.name.startswith("p")]
+        assert len(pods) == 3 and all(p.node_name for p in pods)
+
+    def test_mesh_stack_counts_sharded_dispatches(self):
+        stack = build_stack(
+            config=SchedulerConfig(mesh_devices=8, batch_requests=4)
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for i in range(4):
+            agent.add_host(f"m-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(4):
+            stack.cluster.create_pod(
+                PodSpec(f"q-{i}", labels={"tpu/chips": "2"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        yb = next(
+            p for p in stack.framework.batch_plugins if isinstance(p, YodaBatch)
+        )
+        assert all(p.node_name for p in stack.cluster.list_pods())
+        assert yb.sharded_dispatches >= 1
+        # The resident cache drives the SHARDED kernel: row updates land
+        # on the mesh kernel's sharded static state.
+        from yoda_tpu.parallel import ShardedDeviceFleetKernel
+
+        assert isinstance(yb._resident.kern, ShardedDeviceFleetKernel)
+        agent.set_chip_health("m-0", 0, False)
+        agent.refresh("m-0")
+        stack.cluster.create_pod(PodSpec("qx", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        assert stack.cluster.get_pod("default/qx").node_name
+        assert yb._resident.rows_applied >= 1
+
+
+@pytest.mark.slow
+class TestFlatOverheadAtScale:
+    def test_delta_cycle_overhead_flat_at_low_churn(self):
+        """ISSUE 7 acceptance: at fixed low churn, the per-cycle
+        pre-dispatch overhead (delta sync + dynamics build — no re-stack)
+        must not scale with the fleet. 16x the fleet must cost less than
+        4x the small-fleet cycle (a full re-stack is ~16x)."""
+        times = {}
+        for n in (512, 8192):
+            informer = _informer_with(n, chips=8)
+            accountant = ChipAccountant()
+            kern = DeviceFleetKernel(Weights())
+            cache = _cache_over(informer, accountant, kern)
+            cache.sync(informer.snapshot())
+            cache.dyn_packed()
+            samples = []
+            for c in range(15):
+                for j in range(4):
+                    i = (c * 4 + j) % n
+                    informer.handle(
+                        Event(
+                            "modified", "TpuNodeMetrics",
+                            make_node(
+                                f"n{i:04d}", chips=8,
+                                hbm_free_per_chip=(8 + c % 8) * GIB,
+                                now=0.0,
+                            ),
+                        )
+                    )
+                    accountant._claim(f"u-{c}-{j}", f"n{i:04d}", 1)
+                snap = informer.snapshot()
+                t0 = time.perf_counter()
+                cache.sync(snap)
+                cache.dyn_packed()
+                samples.append(time.perf_counter() - t0)
+            assert cache.restacks == 1, "low churn must never re-stack"
+            samples.sort()
+            times[n] = samples[len(samples) // 2]
+        # Generous bound (timing test): flat-ish, nowhere near O(N).
+        assert times[8192] < max(4 * times[512], 0.01), times

@@ -9,13 +9,20 @@ scheduler reads ONLY this cache during a cycle. The cache maintains:
 - incrementally-maintained claimed-HBM per node,
 - two monotonic versions: ``version`` (any change — snapshot cache key) and
   ``metrics_version`` (TPU CR changes only — fleet-array cache key, so pod
-  binds do not force an O(nodes x chips) array rebuild).
+  binds do not force an O(nodes x chips) array rebuild),
+- an epoch/delta feed over ``metrics_version`` (:meth:`changes_since`):
+  consumers holding device-resident fleet state ask "which nodes changed
+  since epoch E" and apply only those rows instead of re-reading the whole
+  fleet (ops/resident.py FleetStateCache), plus the analogous
+  :meth:`claimed_changes_since` feed over the per-node claimed-HBM totals.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
+from dataclasses import dataclass
 from typing import Callable
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
@@ -24,6 +31,21 @@ from yoda_tpu.cluster.fake import Event
 from yoda_tpu.framework.interfaces import NodeInfo, Snapshot
 
 MIB = 1 << 20
+
+
+@dataclass(frozen=True)
+class FleetDelta:
+    """What changed in the metrics-relevant fleet between two epochs
+    (:meth:`InformerCache.changes_since`). ``epoch`` is the current
+    metrics epoch the delta brings the consumer up to; ``changed`` names
+    nodes whose CR VALUES changed in place (row refill suffices);
+    ``structural`` means the candidate-node SET itself changed (CR or
+    Node object added/deleted) — bucketed row indices may have shifted
+    and the consumer must re-stack from a snapshot."""
+
+    epoch: int
+    changed: frozenset
+    structural: bool
 
 
 class InformerCache:
@@ -106,6 +128,24 @@ class InformerCache:
         self._version = 1
         self._metrics_version = 1
         self._snapshot_cache: Snapshot | None = None
+        # Epoch/delta feed over metrics_version: one ring entry per bump,
+        # (epoch-after-bump, kind, node name), kind "modified" (row refill
+        # suffices) or "structural" (node set changed: full re-stack).
+        # The ring therefore covers epochs (ring[0].epoch - 1, current];
+        # a consumer further behind gets None and re-stacks. 4096 bumps of
+        # slack ≈ minutes of heavy churn between consumer syncs.
+        self._delta_ring: deque[tuple[int, str, str]] = deque(maxlen=4096)
+        # Claimed-HBM delta feed (dyn row 2 of the device-resident state):
+        # epoch bumped per claimed-total change, ring of (epoch, node).
+        self._claim_epoch = 0
+        self._claim_ring: deque[tuple[int, str]] = deque(maxlen=65536)
+        # NodeInfo reuse across snapshots: rebuilding 10^5 NodeInfo objects
+        # (plus their pod-list copies) per watch event dominated snapshot()
+        # at datacenter scale. Entries are invalidated per node on the
+        # events that change what NodeInfo carries (CR, Node object, pod
+        # set); unchanged nodes share one immutable NodeInfo across
+        # snapshots.
+        self._ni_cache: dict[str, NodeInfo] = {}
 
     # --- watch sink ---
 
@@ -205,6 +245,7 @@ class InformerCache:
                 self._nodes.pop(node.name, None)
             else:
                 self._nodes[node.name] = node
+            self._ni_cache.pop(node.name, None)
             self._version += 1
             if event.type in ("added", "deleted"):
                 # The candidate-node SET changed (a CR may enter/leave the
@@ -212,6 +253,9 @@ class InformerCache:
                 # metrics_version. A cordon/taint flip (modified) does not:
                 # admission is evaluated per cycle, not baked into arrays.
                 self._metrics_version += 1
+                self._delta_ring.append(
+                    (self._metrics_version, "structural", node.name)
+                )
             self._snapshot_cache = None
 
     def _handle_tpu(self, event: Event) -> bool:
@@ -226,12 +270,14 @@ class InformerCache:
         change."""
         tpu: TpuNodeMetrics = event.obj  # type: ignore[assignment]
         with self._lock:
+            structural = False
             if event.type == "deleted":
                 self._tpus.pop(tpu.name, None)
-                relevant = True
+                relevant = structural = True
             else:
                 prev = self._tpus.get(tpu.name)
                 self._tpus[tpu.name] = tpu
+                structural = prev is None  # CR added: node set changed
                 relevant = prev is None or not prev.values_equal(tpu)
                 if not relevant and self.staleness_s > 0:
                     # Observed AGE at arrival, not the publish gap: watch
@@ -241,9 +287,17 @@ class InformerCache:
                     # (arrival age >= publish gap, so this test dominates).
                     age = self.now_fn() - prev.last_updated_unix
                     relevant = age > self.staleness_s  # was stale: now fresh
+            self._ni_cache.pop(tpu.name, None)
             self._version += 1
             if relevant:
                 self._metrics_version += 1
+                self._delta_ring.append(
+                    (
+                        self._metrics_version,
+                        "structural" if structural else "modified",
+                        tpu.name,
+                    )
+                )
             self._snapshot_cache = None
         return relevant
 
@@ -290,11 +344,19 @@ class InformerCache:
         self._pods_by_node.setdefault(node, {})[pod.uid] = pod
         self._pod_nodes[pod.uid] = (node, claim)
         self._claimed_mib[node] = self._claimed_mib.get(node, 0) + claim
+        self._ni_cache.pop(node, None)
+        if claim:
+            self._claim_epoch += 1
+            self._claim_ring.append((self._claim_epoch, node))
 
     def _uncount_pod(self, uid: str) -> None:
         node, claim = self._pod_nodes.pop(uid)
         self._pods_by_node.get(node, {}).pop(uid, None)
         self._claimed_mib[node] = max(self._claimed_mib.get(node, 0) - claim, 0)
+        self._ni_cache.pop(node, None)
+        if claim:
+            self._claim_epoch += 1
+            self._claim_ring.append((self._claim_epoch, node))
 
     # --- readers ---
 
@@ -307,6 +369,64 @@ class InformerCache:
     def metrics_version(self) -> int:
         with self._lock:
             return self._metrics_version
+
+    def changes_since(self, epoch: int) -> "FleetDelta | None":
+        """The epoch/delta feed over ``metrics_version``: which nodes
+        changed in epochs ``(epoch, current]``. Returns None when the
+        consumer is too far behind (the bounded ring no longer covers its
+        epoch) or ahead (epoch skew — e.g. state inherited from another
+        informer): either way the consumer must fall back to a full
+        re-stack from a snapshot. A device-resident consumer
+        (ops/resident.py) applies ``changed`` rows in place and re-stacks
+        only on ``structural`` deltas."""
+        with self._lock:
+            cur = self._metrics_version
+            if epoch == cur:
+                return FleetDelta(cur, frozenset(), False)
+            if epoch > cur or not self._delta_ring:
+                return None
+            if self._delta_ring[0][0] > epoch + 1:
+                return None  # ring evicted past the consumer's epoch
+            changed: set[str] = set()
+            structural = False
+            for e, kind, name in reversed(self._delta_ring):
+                if e <= epoch:
+                    break
+                if kind == "structural":
+                    structural = True
+                else:
+                    changed.add(name)
+            return FleetDelta(cur, frozenset(changed), structural)
+
+    @property
+    def claimed_epoch(self) -> int:
+        with self._lock:
+            return self._claim_epoch
+
+    def claimed_changes_since(
+        self, epoch: int
+    ) -> "tuple[int, dict[str, int] | None]":
+        """Delta feed over the per-node claimed-HBM totals: returns
+        ``(current_epoch, {node: claimed_mib})`` for nodes whose total
+        changed in epochs ``(epoch, current]``, or ``(current_epoch,
+        None)`` when the ring no longer reaches back — the consumer then
+        rebuilds from :meth:`claimed_hbm_mib_map` (reading the returned
+        epoch FIRST keeps the rebuild race-free: changes landing during
+        the map copy are simply re-applied on the next delta)."""
+        with self._lock:
+            cur = self._claim_epoch
+            if epoch == cur:
+                return cur, {}
+            if epoch > cur or not self._claim_ring:
+                return cur, None
+            if self._claim_ring[0][0] > epoch + 1:
+                return cur, None
+            nodes: set[str] = set()
+            for e, name in reversed(self._claim_ring):
+                if e <= epoch:
+                    break
+                nodes.add(name)
+            return cur, {n: self._claimed_mib.get(n, 0) for n in nodes}
 
     def claimed_hbm_mib(self, node_name: str) -> int:
         with self._lock:
@@ -393,20 +513,31 @@ class InformerCache:
         with self._lock:
             if self._snapshot_cache is not None:
                 return self._snapshot_cache
-            nodes = {
-                name: NodeInfo(
-                    name=name,
-                    tpu=tpu,
-                    pods=list(self._pods_by_node.get(name, {}).values()),
-                    node=self._nodes.get(name),
-                )
-                for name, tpu in self._tpus.items()
+            # NodeInfo objects are REUSED across snapshots for nodes whose
+            # CR / Node object / pod set did not change (the per-event
+            # invalidations above): at 10^5 nodes, rebuilding every
+            # NodeInfo (and copying every pod list) per watch event was
+            # the dominant snapshot cost. The returned objects are
+            # treated as immutable by every consumer.
+            cache = self._ni_cache
+            nodes = {}
+            for name, tpu in self._tpus.items():
                 # Once Node-informed, a CR whose Node is gone is a deleted
                 # node with a not-yet-expired metrics object: never a
                 # candidate (the round-1 gap: pods could bind to deleted
                 # nodes on stale-but-fresh CRs).
-                if not self._node_informed or name in self._nodes
-            }
+                if self._node_informed and name not in self._nodes:
+                    continue
+                ni = cache.get(name)
+                if ni is None or ni.tpu is not tpu:
+                    ni = NodeInfo(
+                        name=name,
+                        tpu=tpu,
+                        pods=list(self._pods_by_node.get(name, {}).values()),
+                        node=self._nodes.get(name),
+                    )
+                    cache[name] = ni
+                nodes[name] = ni
             snap = Snapshot(
                 nodes,
                 version=self._version,

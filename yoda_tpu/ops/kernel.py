@@ -477,6 +477,42 @@ def evaluate_joint_via_burst(
     ]
 
 
+def row_update_bucket(n_rows: int) -> int:
+    """Compile bucket for a row-update scatter: next power of two, so a
+    steady trickle of 1-3 changed rows per cycle shares one compiled
+    scatter executable per fleet bucket."""
+    return 1 << max(n_rows - 1, 0).bit_length()
+
+
+def pack_row_update(
+    arrays: "FleetArrays", rows: "list[int]", bucket: int
+) -> "tuple[np.ndarray, dict]":
+    """Host-side payload for an in-place static row update: the changed
+    rows' STATIC_NODE_KEYS + CHIP_KEYS values, padded to ``bucket`` by
+    repeating the first row (duplicate scatter indices carrying identical
+    payloads are deterministic)."""
+    idx = np.asarray(
+        list(rows) + [rows[0]] * (bucket - len(rows)), dtype=np.int32
+    )
+    payload = {
+        k: np.asarray(getattr(arrays, k))[idx]
+        for k in STATIC_NODE_KEYS + CHIP_KEYS
+    }
+    return idx, payload
+
+
+def apply_row_update(static: dict, idx, payload: dict):
+    """Scatter changed rows into the device-resident static arrays. Jitted
+    with the static dict DONATED (double-buffered in-place update: XLA
+    reuses the old buffers instead of allocating a second fleet copy) —
+    the pjit/donation discipline the device-resident fleet state rides
+    (ops/resident.py FleetStateCache)."""
+    return {k: static[k].at[idx].set(payload[k]) for k in static}
+
+
+_row_update = functools.partial(jax.jit, donate_argnums=(0,))(apply_row_update)
+
+
 def pack_request(request: "KernelRequest") -> np.ndarray:
     return np.array(
         [
@@ -508,7 +544,11 @@ class FleetKernelLike(Protocol):
     """The device-resident evaluator contract YodaBatch drives: upload the
     metrics-version-static arrays once, then evaluate per cycle with O(1)
     host<->device round trips. Satisfied by :class:`DeviceFleetKernel`
-    (single device) and ``parallel.ShardedDeviceFleetKernel`` (mesh)."""
+    (single device) and ``parallel.ShardedDeviceFleetKernel`` (mesh).
+    Kernels may additionally offer ``update_rows(arrays, rows)`` — apply
+    only the changed rows to the resident static state via a donated
+    scatter instead of re-uploading the fleet (the incremental path
+    FleetStateCache prefers; kernels without it get a full put_static)."""
 
     @property
     def names(self) -> list[str]: ...
@@ -555,6 +595,25 @@ class DeviceFleetKernel:
             else jax.device_put(host)
         )
         self._names = list(arrays.names)
+
+    def update_rows(self, arrays: FleetArrays, rows: "list[int]") -> None:
+        """Apply ONLY the given (already re-filled) rows of ``arrays`` to
+        the device-resident static state, in place via a donated scatter
+        (:func:`apply_row_update`) — O(changed x C) host->device transfer
+        instead of the O(N x C) full re-upload. The caller guarantees the
+        fleet's names/buckets are unchanged since the last put_static
+        (FleetStateCache re-stacks otherwise)."""
+        if self._static is None or not rows:
+            if self._static is None:
+                self.put_static(arrays)
+            return
+        idx, payload = pack_row_update(
+            arrays, rows, row_update_bucket(len(rows))
+        )
+        if self._needs_put:
+            idx = jax.device_put(idx, self.device)
+            payload = jax.device_put(payload, self.device)
+        self._static = _row_update(self._static, idx, payload)
 
     def evaluate(
         self,
@@ -641,6 +700,15 @@ class NumpyFleetKernel:
             for k in STATIC_NODE_KEYS + CHIP_KEYS
         }
         self._names = list(arrays.names)
+
+    def update_rows(self, arrays: FleetArrays, rows: "list[int]") -> None:
+        """No device state: put_static stored REFERENCES into ``arrays``,
+        so the caller's in-place row refills are already visible. Re-sync
+        only if the arrays object itself was swapped."""
+        if self._static is None or self._static.get("chip_valid") is not (
+            arrays.chip_valid
+        ):
+            self.put_static(arrays)
 
     def _packed(self, dyn: np.ndarray, reqv: np.ndarray) -> np.ndarray:
         a = dict(self._static)

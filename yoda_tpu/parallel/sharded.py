@@ -25,13 +25,16 @@ from yoda_tpu.ops.kernel import (
     STATIC_NODE_KEYS,
     KernelRequest,
     KernelResult,
+    apply_row_update,
     arrays_dict,
     kernel_impl,
     kernel_packed,
     kernel_packed_burst,
     pack_request,
+    pack_row_update,
     result_from_outputs,
     result_from_packed,
+    row_update_bucket,
 )
 
 FLEET_AXIS = "fleet"
@@ -159,6 +162,21 @@ class ShardedDeviceFleetKernel:
             ),
             out_shardings=NamedSharding(self.mesh, P(None, None, FLEET_AXIS)),
         )
+        # In-place static row update (device-resident incremental state):
+        # the changed rows scatter into the ROW-SHARDED static arrays with
+        # the old buffers DONATED, so a per-cycle trickle of agent
+        # refreshes costs O(changed x C) transfer instead of re-sharding
+        # the whole fleet across the mesh.
+        self._jitted_update = jax.jit(
+            apply_row_update,
+            in_shardings=(
+                self._static_shardings,
+                rep,
+                {k: rep for k in STATIC_NODE_KEYS + CHIP_KEYS},
+            ),
+            out_shardings=self._static_shardings,
+            donate_argnums=(0,),
+        )
         self._static: dict | None = None
         self._names: list[str] = []
 
@@ -176,6 +194,19 @@ class ShardedDeviceFleetKernel:
         host = {k: getattr(arrays, k) for k in STATIC_NODE_KEYS + CHIP_KEYS}
         self._static = jax.device_put(host, self._static_shardings)
         self._names = list(arrays.names)
+
+    def update_rows(self, arrays: FleetArrays, rows: "list[int]") -> None:
+        """Apply only the changed rows to the mesh-sharded resident static
+        state (donated scatter; see DeviceFleetKernel.update_rows for the
+        contract)."""
+        if self._static is None or not rows:
+            if self._static is None:
+                self.put_static(arrays)
+            return
+        idx, payload = pack_row_update(
+            arrays, rows, row_update_bucket(len(rows))
+        )
+        self._static = self._jitted_update(self._static, idx, payload)
 
     def evaluate(self, dyn: np.ndarray, request: KernelRequest) -> KernelResult:
         if self._static is None:

@@ -48,6 +48,7 @@ def default_plugins(
     batch_requests: int = 1,
     pending_fn: Callable | None = None,
     reserved_map_fn: Callable | None = None,
+    reserved_delta_fn: Callable | None = None,
 ) -> list:
     """Assemble the standard plugin set.
 
@@ -83,6 +84,7 @@ def default_plugins(
                 batch_requests=batch_requests,
                 pending_fn=pending_fn,
                 reserved_map_fn=reserved_map_fn,
+                reserved_delta_fn=reserved_delta_fn,
             )
         )
     elif mode == "loop":

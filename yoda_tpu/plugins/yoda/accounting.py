@@ -19,6 +19,7 @@ has no Reserve hook; SURVEY.md §3.3). Model here:
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass
 
 from yoda_tpu.api.requests import LabelParseError, pod_request
@@ -52,6 +53,13 @@ class ChipAccountant(ReservePlugin):
         self._lock = threading.Lock()
         self._claims: dict[str, _Claim] = {}  # pod uid -> claim
         self._in_use: dict[str, int] = {}     # node -> chips
+        # Reservation delta feed (dyn row 1 of the device-resident fleet
+        # state, ops/resident.py): epoch bumped per node-total change,
+        # bounded ring of (epoch, node) so a consumer can apply only the
+        # nodes whose reservations moved since its last sync instead of
+        # copying the whole map per dispatch.
+        self._epoch = 0
+        self._changes: deque[tuple[int, str]] = deque(maxlen=65536)
 
     # --- ReservePlugin ---
 
@@ -102,6 +110,11 @@ class ChipAccountant(ReservePlugin):
 
     # --- internals / readers ---
 
+    def _note(self, node: str) -> None:
+        """Record a node-total change on the delta feed (lock held)."""
+        self._epoch += 1
+        self._changes.append((self._epoch, node))
+
     def _claim(self, uid: str, node: str, chips: int) -> None:
         with self._lock:
             existing = self._claims.get(uid)
@@ -109,8 +122,10 @@ class ChipAccountant(ReservePlugin):
                 if existing.node == node:
                     return  # reserve->bind transition: single claim
                 self._in_use[existing.node] -= existing.chips
+                self._note(existing.node)
             self._claims[uid] = _Claim(node, chips)
             self._in_use[node] = self._in_use.get(node, 0) + chips
+            self._note(node)
 
     def release(self, uid: str) -> None:
         with self._lock:
@@ -119,6 +134,7 @@ class ChipAccountant(ReservePlugin):
                 self._in_use[claim.node] = max(
                     self._in_use.get(claim.node, 0) - claim.chips, 0
                 )
+                self._note(claim.node)
 
     def chips_in_use(self, node_name: str) -> int:
         with self._lock:
@@ -142,3 +158,33 @@ class ChipAccountant(ReservePlugin):
         more than the kernel itself at large fleets."""
         with self._lock:
             return dict(self._in_use)
+
+    @property
+    def reservation_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def reserved_changes_since(
+        self, epoch: int
+    ) -> "tuple[int, dict[str, int] | None]":
+        """Delta feed over the per-node reservation totals: returns
+        ``(current_epoch, {node: chips})`` for nodes whose total changed
+        in epochs ``(epoch, current]``, or ``(current_epoch, None)`` when
+        the ring no longer reaches back — the consumer then rebuilds from
+        :meth:`chips_by_node` (read the epoch FIRST: a change landing
+        between the epoch read and the map copy is re-applied next delta
+        instead of lost)."""
+        with self._lock:
+            cur = self._epoch
+            if epoch == cur:
+                return cur, {}
+            if epoch > cur or not self._changes:
+                return cur, None
+            if self._changes[0][0] > epoch + 1:
+                return cur, None
+            nodes: set[str] = set()
+            for e, name in reversed(self._changes):
+                if e <= epoch:
+                    break
+                nodes.add(name)
+            return cur, {n: self._in_use.get(n, 0) for n in nodes}

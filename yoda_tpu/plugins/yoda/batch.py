@@ -248,6 +248,9 @@ class YodaBatch(BatchFilterScorePlugin):
         reserved_map_fn: "Callable[[], dict] | None" = None,
         claimed_map_fn: "Callable[[], dict] | None" = None,
         last_updated_map_fn: "Callable[[], dict] | None" = None,
+        changes_fn: "Callable | None" = None,
+        reserved_delta_fn: "Callable | None" = None,
+        claimed_delta_fn: "Callable | None" = None,
     ) -> None:
         if batch_requests < 1:
             raise ValueError(f"batch_requests must be >= 1, got {batch_requests}")
@@ -316,6 +319,25 @@ class YodaBatch(BatchFilterScorePlugin):
         # Per-row CR object tags for incremental static updates
         # (_incremental_update): row i was built from _row_src[i].
         self._row_src: "list | None" = None
+        # Device-resident incremental fleet state (ops/resident.py):
+        # active when the informer's epoch/delta feed is wired alongside
+        # live claims — watch deltas then refill only the changed rows
+        # and scatter them into the resident static arrays in place; the
+        # delta feeds below maintain the dynamics vector the same way.
+        # Without the feed, the pre-resident per-snapshot rebuild path
+        # below still serves (bare constructions, loop-mode stacks).
+        self.changes_fn = changes_fn
+        self.reserved_delta_fn = reserved_delta_fn
+        self.claimed_delta_fn = claimed_delta_fn
+        self._resident: "object | None" = None  # lazy FleetStateCache
+        # Resident-state counters (classic-path restacks/reuse counted
+        # here too, so yoda_snapshot_reuse_total / yoda_restack_total
+        # stay meaningful in every mode).
+        self._reuse_count = 0
+        self._restack_count = 0
+        self.sharded_dispatches = 0   # level-0 dispatches on the mesh kernel
+        self.sets_retained = 0        # burst/joint sets kept across an
+                                      # unrelated-node epoch bump
         self._kern: FleetKernelLike | None = None
         self._kern_device = None
         # Whole-gang placement plans: gang name -> _GangPlan. One kernel
@@ -456,6 +478,30 @@ class YodaBatch(BatchFilterScorePlugin):
         nonzero means the scheduler is serving in degraded mode)."""
         return self._backend_level
 
+    # --- resident-state counters (yoda_snapshot_reuse_total /
+    # yoda_restack_total / yoda_delta_apply_ms) ---
+
+    @property
+    def snapshot_reuse(self) -> int:
+        """Static refreshes answered without touching the fleet (epoch /
+        version unchanged), across the resident and classic paths."""
+        r = self._resident
+        return self._reuse_count + (r.reuse if r is not None else 0)
+
+    @property
+    def restacks(self) -> int:
+        """Full fleet re-stacks (from_snapshot + whole-fleet device
+        upload) — at low churn this should stay near the boot count."""
+        r = self._resident
+        return self._restack_count + (r.restacks if r is not None else 0)
+
+    @property
+    def delta_apply_ms(self) -> float:
+        """Wall ms of the most recent delta sync (row refill + in-place
+        device scatter); 0 until the resident path served one."""
+        r = self._resident
+        return r.last_delta_ms if r is not None else 0.0
+
     def _kernel_at(self, level: int, static: FleetArrays):
         """The kernel serving fallback ``level``, with ``static`` uploaded.
         Level 0 is the configured primary (already loaded by
@@ -538,6 +584,11 @@ class YodaBatch(BatchFilterScorePlugin):
             self._level_failures[level] = 0  # consecutive-failure semantics
             if level > 0:
                 self.dispatch_fallbacks += 1
+            elif self.mesh_devices:
+                # Level 0 on the mesh kernel: a node-axis sharded dispatch
+                # (yoda_sharded_dispatches_total — the fallback chain
+                # demotes to single-device XLA / numpy below this).
+                self.sharded_dispatches += 1
             return out
         if last_error is not None:
             raise last_error
@@ -549,6 +600,24 @@ class YodaBatch(BatchFilterScorePlugin):
         return (
             self.reserved_map_fn() if self.reserved_map_fn else self.reserved_fn,
             self.claimed_map_fn() if self.claimed_map_fn else self.claimed_fn,
+        )
+
+    def _dyn_for(
+        self, static: FleetArrays, host_ok: "np.ndarray | None" = None
+    ) -> np.ndarray:
+        """The per-dispatch [4, N] dynamics array: maintained in place by
+        the resident cache's delta feeds when it serves ``static``
+        (O(changed) per cycle), else rebuilt from the live sources (the
+        pre-resident O(N) path)."""
+        if self._resident is not None and self._resident.arrays is static:
+            return self._resident.dyn_packed(host_ok=host_ok)
+        reserved_src, claimed_src = self._dyn_sources()
+        return static.dyn_packed(
+            reserved_src,
+            claimed_src,
+            max_metrics_age_s=self.max_metrics_age_s,
+            host_ok=host_ok,
+            last_updated=self._live_timestamps(),
         )
 
     def _live_timestamps(self) -> "dict | None":
@@ -569,11 +638,60 @@ class YodaBatch(BatchFilterScorePlugin):
             )
         return snapshot.version
 
+    def _kern_for(self, arrays: FleetArrays):
+        """The kernel the fleet should run on at this shape: the fixed
+        mesh/pallas kernel when configured, else the single-device kernel
+        under the platform policy (re-built only when the policy's device
+        choice changes)."""
+        if not self.mesh_devices and self.kernel_backend != "pallas":
+            device = self._device_for(arrays)
+            if self._kern is None or device != self._kern_device:
+                self._kern = DeviceFleetKernel(self.weights, device=device)
+                self._kern_device = device
+        return self._kern
+
+    def _resident_active(self, snapshot: Snapshot) -> bool:
+        """The device-resident delta path needs the informer's epoch feed
+        (changes_fn keyed on metrics_version), live claims (claimed_fn —
+        so _fleet_version IS the metrics epoch), accounting, and a
+        metrics-versioned snapshot."""
+        return (
+            self.changes_fn is not None
+            and self.claimed_fn is not None
+            and self.reserved_fn is not None
+            and bool(getattr(snapshot, "metrics_version", None))
+        )
+
     def _refresh_static(self, snapshot: Snapshot) -> FleetArrays:
+        if self._resident_active(snapshot):
+            from yoda_tpu.ops.resident import FleetStateCache
+
+            if self._resident is None:
+                self._resident = FleetStateCache(
+                    changes_fn=self.changes_fn,
+                    kern_fn=self._kern_for,
+                    max_metrics_age_s=self.max_metrics_age_s,
+                    mesh_multiple=self.mesh_devices,
+                    reserved_delta_fn=self.reserved_delta_fn,
+                    reserved_map_fn=self.reserved_map_fn,
+                    reserved_fn=self.reserved_fn,
+                    claimed_delta_fn=self.claimed_delta_fn,
+                    claimed_map_fn=self.claimed_map_fn,
+                    claimed_fn=self.claimed_fn,
+                    last_updated_map_fn=self.last_updated_map_fn,
+                )
+            static = self._resident.sync(snapshot)
+            self._kern = self._resident.kern
+            self._static = static
+            self._cache_version = self._resident.epoch
+            self._row_src = None  # the delta feed replaces the identity diff
+            return static
         version = self._fleet_version(snapshot)
         if version and self._cache_version == version and self._static is not None:
+            self._reuse_count += 1
             return self._static
-        static = self._incremental_update(snapshot) or FleetArrays.from_snapshot(
+        incremental = self._incremental_update(snapshot)
+        static = incremental or FleetArrays.from_snapshot(
             snapshot,
             max_metrics_age_s=self.max_metrics_age_s,
             node_bucket=(
@@ -582,11 +700,9 @@ class YodaBatch(BatchFilterScorePlugin):
                 else None
             ),
         )
-        if not self.mesh_devices and self.kernel_backend != "pallas":
-            device = self._device_for(static)
-            if self._kern is None or device != self._kern_device:
-                self._kern = DeviceFleetKernel(self.weights, device=device)
-                self._kern_device = device
+        if incremental is None:
+            self._restack_count += 1
+        self._kern_for(static)
         self._kern.put_static(static)
         if version:
             self._cache_version = version
@@ -683,13 +799,8 @@ class YodaBatch(BatchFilterScorePlugin):
         # metrics bump, and Node-object admission (cordon + taints +
         # inter-pod affinity/spread + resource fit + host ports + volume
         # pins vs THIS pod) is per (pod, cycle): one packed upload.
-        reserved_src, claimed_src = self._dyn_sources()
-        dyn = static.dyn_packed(
-            reserved_src,
-            claimed_src,
-            max_metrics_age_s=self.max_metrics_age_s,
-            host_ok=_host_admission(static, snapshot, pod, aff, pending_res),
-            last_updated=self._live_timestamps(),
+        dyn = self._dyn_for(
+            static, host_ok=_host_admission(static, snapshot, pod, aff, pending_res)
         )
         result = self._dispatch(static, lambda kern: kern.evaluate(dyn, reqk))
         self.dispatch_count += 1
@@ -881,13 +992,7 @@ class YodaBatch(BatchFilterScorePlugin):
         static = self._refresh_static(snapshot)
         if not hasattr(self._kern, "evaluate_burst"):
             return
-        reserved_src, claimed_src = self._dyn_sources()
-        dyn = static.dyn_packed(
-            reserved_src,
-            claimed_src,
-            max_metrics_age_s=self.max_metrics_age_s,
-            last_updated=self._live_timestamps(),
-        )
+        dyn = self._dyn_for(static)
         k = self.batch_requests
         n_pad = static.node_valid.shape[0]
         host_ok_k = np.zeros((k, n_pad), dtype=np.int32)
@@ -992,6 +1097,38 @@ class YodaBatch(BatchFilterScorePlugin):
             and node_fits_resources(ni, pod, {best: (p_cpu, p_mem, p_cnt)})[0]
         )
 
+    def _retain_set(self, b: _BurstSet, ver: int) -> bool:
+        """Epoch-skew tolerance for cached dispatch sets: the fleet epoch
+        moved past the set's baseline, but if every node that actually
+        changed is UNREFERENCED by the set — infeasible for every
+        remaining entry and untouched by its consumption ledger — the
+        rows' capacity math is intact and the set keeps serving (the
+        baseline advances to ``ver``). Before the epoch/delta feed, ANY
+        fleet change dropped the whole group and forced a re-dispatch.
+        Structural deltas (node add/delete: row indices may have moved)
+        and feed gaps always drop. Changed-but-unreferenced nodes can only
+        have become MORE attractive; missing that is bounded staleness,
+        and every pick is still spot-checked live (_pick_checks)."""
+        if self.changes_fn is None or self.claimed_fn is None:
+            return False  # fleet_version is not a metrics epoch here
+        delta = self.changes_fn(b.fleet_version)
+        if delta is None or delta.structural:
+            return False
+        if delta.changed:
+            mask = np.zeros(len(b.names), dtype=bool)
+            for e in b.entries.values():
+                mask |= e.result.feasible[: len(b.names)].astype(bool)
+            for nm in delta.changed:
+                if nm in b.consumed:
+                    return False
+                i = b.index.get(nm)
+                if i is not None and mask[i]:
+                    return False
+        b.fleet_version = ver
+        self.sets_retained += 1
+        log.debug("cached dispatch set retained across unrelated epoch bump")
+        return True
+
     def _drop_burst(self) -> None:
         if self._burst is not None:
             self.burst_invalidated += len(self._burst.entries)
@@ -1010,8 +1147,9 @@ class YodaBatch(BatchFilterScorePlugin):
         b = self._burst
         if b is None:
             return None
-        if self._fleet_version(snapshot) != b.fleet_version:
-            self._drop_burst()  # fleet metrics changed: every row is stale
+        ver = self._fleet_version(snapshot)
+        if ver != b.fleet_version and not self._retain_set(b, ver):
+            self._drop_burst()  # a referenced node changed: rows are stale
             return None
         entry = b.entries.get(pod.uid)
         if entry is None:
@@ -1181,13 +1319,7 @@ class YodaBatch(BatchFilterScorePlugin):
         static = self._refresh_static(snapshot)
         if not hasattr(self._kern, "evaluate_burst"):
             return None  # future kernels without a burst path: plan fallback
-        reserved_src, claimed_src = self._dyn_sources()
-        dyn = static.dyn_packed(
-            reserved_src,
-            claimed_src,
-            max_metrics_age_s=self.max_metrics_age_s,
-            last_updated=self._live_timestamps(),
-        )
+        dyn = self._dyn_for(static)
         n_pad = static.node_valid.shape[0]
         host_ok_groups: list[np.ndarray] = []
         request_groups: list[list[KernelRequest]] = []
@@ -1410,8 +1542,9 @@ class YodaBatch(BatchFilterScorePlugin):
         b = self._gang_bursts.get(gang)
         if b is None:
             return None
-        if self._fleet_version(snapshot) != b.fleet_version:
-            self._drop_gang_burst(gang)  # fleet metrics changed
+        ver = self._fleet_version(snapshot)
+        if ver != b.fleet_version and not self._retain_set(b, ver):
+            self._drop_gang_burst(gang)  # a referenced node changed
             return None
         entry = b.entries.get(pod.uid)
         if entry is None:

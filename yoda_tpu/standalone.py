@@ -129,6 +129,9 @@ def build_stack(
         pending_fn=gang.pending_placements,
         # Bulk accountant read: one lock per dispatch, not N.
         reserved_map_fn=accountant.chips_by_node,
+        # Reservation delta feed: the device-resident dynamics row applies
+        # only the nodes whose totals moved since the last dispatch.
+        reserved_delta_fn=accountant.reserved_changes_since,
     )
     plugins.append(gang)
     plugins.append(accountant)
@@ -310,8 +313,16 @@ def build_stack(
         if p.claimed_fn is None:
             p.claimed_fn = informer.claimed_hbm_mib
             p.claimed_map_fn = informer.claimed_hbm_mib_map
+            p.claimed_delta_fn = informer.claimed_changes_since
         if p.last_updated_map_fn is None:
             p.last_updated_map_fn = informer.last_updated_map
+        if p.changes_fn is None:
+            # The informer's epoch/delta feed turns the batch plugin's
+            # fleet state DEVICE-RESIDENT (ops/resident.py): watch deltas
+            # refill only the changed rows and scatter them onto the
+            # kernel's device in place; a full re-stack happens only on
+            # epoch skew, node add/delete, or bucket growth.
+            p.changes_fn = informer.changes_since
     if batches:
         # Accumulator pattern so a SHARED metrics registry (profiles)
         # registers each family once and sums over every stack's plugins.
@@ -419,6 +430,36 @@ def build_stack(
                 "nonzero = a backend was pinned down after repeated "
                 "dispatch failures)",
                 lambda: max((p.backend_level for p in acc), default=0),
+            )
+            metrics.registry.counter(
+                "yoda_snapshot_reuse_total",
+                "Static fleet refreshes answered without touching the "
+                "fleet (metrics epoch unchanged since the last dispatch) "
+                "— the device-resident state's steady-state hit path",
+                lambda: sum(p.snapshot_reuse for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_restack_total",
+                "Full fleet re-stacks (snapshot -> host arrays -> whole-"
+                "fleet device upload): epoch skew, node add/delete, or "
+                "bucket growth. At low churn this should sit near the "
+                "boot count — a climbing rate means the delta feed is "
+                "being outrun",
+                lambda: sum(p.restacks for p in acc),
+            )
+            metrics.registry.gauge(
+                "yoda_delta_apply_ms",
+                "Wall milliseconds of the most recent incremental fleet "
+                "delta apply (changed-row refill + in-place device "
+                "scatter); independent of fleet size at low churn",
+                lambda: max((p.delta_apply_ms for p in acc), default=0.0),
+            )
+            metrics.registry.counter(
+                "yoda_sharded_dispatches_total",
+                "Kernel dispatches served by the node-axis mesh-sharded "
+                "backend (config mesh_devices; the fallback chain demotes "
+                "to single-device XLA / numpy below it)",
+                lambda: sum(p.sharded_dispatches for p in acc),
             )
             metrics.registry.gauge(
                 "yoda_kernel_on_accelerator",

@@ -409,6 +409,15 @@ class ChaosKernel:
             self._inner, dyn, host_ok_groups, request_groups, minimum
         )
 
+    def update_rows(self, arrays, rows) -> None:
+        # Incremental static refresh is not an evaluate: faults target
+        # dispatches, so the row update passes through (kernels without
+        # the method fall back to put_static upstream).
+        if hasattr(self._inner, "update_rows"):
+            self._inner.update_rows(arrays, rows)
+        else:
+            self._inner.put_static(arrays)
+
 
 def install_chaos_kernel(batch_plugin, plan: ChaosPlan) -> ChaosKernel:
     """Wrap ``batch_plugin``'s PRIMARY kernel with a ``ChaosKernel``. The
@@ -425,6 +434,12 @@ def install_chaos_kernel(batch_plugin, plan: ChaosPlan) -> ChaosKernel:
         )
     wrapped = ChaosKernel(inner, plan)
     batch_plugin._kern = wrapped
+    # The device-resident state cache (ops/resident.py) holds its own
+    # kernel reference and re-publishes it to the plugin on every sync —
+    # wrap it there too, or the next cycle would silently unwrap.
+    resident = getattr(batch_plugin, "_resident", None)
+    if resident is not None and resident.kern is inner:
+        resident.kern = wrapped
     return wrapped
 
 
