@@ -8,7 +8,7 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test lint native bench bench-scale smoke chaos demo soak image push format clean
+.PHONY: all test lint native bench bench-scale rebalance-bench smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -46,6 +46,14 @@ bench-scale:
 	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) bench.py --scale
 
+# Goodput-driven rebalancer evidence (CPU-pinned): the seeded long-churn
+# replay (fragmentation-score series with the rebalancer on vs off over
+# the SAME arrival/departure stream) plus the preemptive-admission
+# scenario (parked high-priority gang admitted by unbinding cheapest
+# victims; victims requeue whole, zero oversubscription). One JSON line.
+rebalance-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --rebalance
+
 # Fault-injection suite (fixed seed, replayable): gang bind rollback,
 # transient-error retry, dispatch fallback chain, leader fencing, the
 # seeded stress sweep, the scheduler_crash failover sweep (leader killed
@@ -61,7 +69,7 @@ bench-scale:
 # seed via CHAOS_SEED (the test reads its default from the source; the
 # seed is printed on failure for replay).
 chaos:
-	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py -q
+	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py tests/test_rebalance.py -q
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

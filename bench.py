@@ -1200,6 +1200,205 @@ def _fragmentation_scenario() -> dict:
     return out
 
 
+def _assert_no_oversubscription(stack) -> None:
+    """Chips charged by bound pods on any host must fit its healthy-chip
+    capacity — the invariant every rebalance action must preserve."""
+    from yoda_tpu.api.requests import LabelParseError, pod_request
+
+    caps = {
+        t.name: len(t.healthy_chips()) for t in stack.cluster.list_tpu_metrics()
+    }
+    used: dict[str, int] = {}
+    for p in stack.cluster.list_pods():
+        if not p.node_name:
+            continue
+        try:
+            chips = pod_request(p).effective_chips
+        except LabelParseError:
+            chips = 0
+        used[p.node_name] = used.get(p.node_name, 0) + chips
+    for host, n in used.items():
+        assert n <= caps.get(host, 0), (
+            f"oversubscribed {host}: {n} chips used of {caps.get(host, 0)}"
+        )
+
+
+def _churn_replay_scenario(
+    *, seed: int = 7, rounds: int = 40, slices: int = 3, rebalance: bool = True
+) -> dict:
+    """Seeded long-churn replay (the rebalancer's acceptance scenario):
+    linear v5p slices take a random arrival/departure stream of topology
+    gangs with random lifetimes — exactly the churn that punches holes
+    into ICI blocks. The SAME seed drives one run with the background
+    rebalancer applied every round and one without; the fragmentation-score
+    series (rebalance/score.py) shows decay bounded with it on vs
+    accumulating off. Invariants asserted every round: no chip
+    oversubscription, no split gang at settle."""
+    import random
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.requests import gang_name_of, pod_request
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.rebalance import fragmentation_score
+    from yoda_tpu.standalone import build_stack
+
+    stack = build_stack(
+        config=SchedulerConfig(
+            mode="batch", enable_preemption=False, rebalance_min_gain=0.01
+        )
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for s in range(slices):
+        agent.add_slice(f"churn-{s}", generation="v5p", host_topology=(8, 1, 1))
+    agent.publish_all()
+
+    rng = random.Random(seed)
+    shapes = ["2x1x1", "3x1x1", "4x1x1"]
+    live: dict[str, int] = {}  # gang tag -> expiry round
+    series: list[float] = []
+    seq = 0
+    for rnd in range(rounds):
+        # Departures first (holes), then arrivals (partial refills).
+        for tag in [t for t, exp in live.items() if exp <= rnd]:
+            del live[tag]
+            for p in list(stack.cluster.list_pods()):
+                if gang_name_of(p.labels) == tag:
+                    stack.cluster.delete_pod(p.key)
+        for _ in range(rng.randint(1, 2)):
+            shape = rng.choice(shapes)
+            size = int(shape.split("x")[0])
+            tag = f"cg{seq}"
+            seq += 1
+            live[tag] = rnd + rng.randint(2, 8)
+            labels = {"tpu/gang": tag, "tpu/topology": shape, "tpu/chips": "4"}
+            for i in range(size):
+                stack.cluster.create_pod(
+                    PodSpec(f"{tag}-{i}", labels=dict(labels))
+                )
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        if rebalance:
+            stack.rebalancer.run_once()
+            stack.scheduler.run_until_idle(max_wall_s=60)
+        _assert_no_oversubscription(stack)
+        # No split gang at settle: every gang fully bound or fully pending.
+        by_gang: dict[str, list] = {}
+        for p in stack.cluster.list_pods():
+            g = gang_name_of(p.labels)
+            if g:
+                by_gang.setdefault(g, []).append(p)
+        for g, members in by_gang.items():
+            bound = [p for p in members if p.node_name]
+            size = next(
+                (
+                    pod_request(p).gang.size
+                    for p in members
+                    if pod_request(p).gang is not None
+                ),
+                len(members),
+            )
+            assert len(bound) in (0, size), (
+                f"gang {g} split at settle: {len(bound)}/{size} bound"
+            )
+        series.append(
+            fragmentation_score(
+                stack.informer.snapshot(), stack.accountant.chips_by_node()
+            )
+        )
+    tail = series[len(series) // 2:]
+    out = {
+        "final": round(series[-1], 4),
+        "mean": round(sum(series) / len(series), 4),
+        "tail_mean": round(sum(tail) / len(tail), 4),
+        "peak": round(max(series), 4),
+    }
+    if rebalance:
+        out["moves"] = int(stack.metrics.rebalance_moves.value())
+    return out
+
+
+def _rebalance_churn_scenario(*, seed: int = 7, rounds: int = 40) -> dict:
+    """The with/without comparison the ISSUE 8 acceptance reads: same
+    seeded churn replay, rebalancer on vs off. ``frag_churn_*_on`` must
+    stay bounded (tail no worse than off); moves > 0 proves the
+    rebalancer actually acted rather than the stream being benign."""
+    off = _churn_replay_scenario(seed=seed, rounds=rounds, rebalance=False)
+    on = _churn_replay_scenario(seed=seed, rounds=rounds, rebalance=True)
+    return {
+        "frag_churn_rounds": rounds,
+        "frag_churn_seed": seed,
+        "frag_churn_final_off": off["final"],
+        "frag_churn_final_on": on["final"],
+        "frag_churn_tail_mean_off": off["tail_mean"],
+        "frag_churn_tail_mean_on": on["tail_mean"],
+        "frag_churn_peak_off": off["peak"],
+        "frag_churn_peak_on": on["peak"],
+        "frag_churn_moves": on["moves"],
+    }
+
+
+def _preemption_admit_scenario(*, hosts: int = 4) -> dict:
+    """Background priority preemption admitting a parked whole gang: a
+    full fleet of low-priority singletons, then a high-priority gang that
+    cannot fit — the rebalancer must unbind (not delete) the cheapest
+    victims, the gang must admit whole, every victim must requeue, and no
+    host may ever oversubscribe. Reports the wall time from gang creation
+    to fully bound (``preemption_admit_latency_ms``)."""
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    stack = build_stack(
+        config=SchedulerConfig(mode="batch", enable_preemption=False)
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(hosts):
+        agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+    agent.publish_all()
+    n_low = hosts * 2
+    for i in range(n_low):
+        stack.cluster.create_pod(
+            PodSpec(f"low-{i}", labels={"tpu/chips": "4", "tpu/priority": "1"})
+        )
+    stack.scheduler.run_until_idle(max_wall_s=60)
+    assert all(p.node_name for p in stack.cluster.list_pods()), "fleet not full"
+
+    gang_size = hosts
+    labels = {
+        "tpu/gang": "urgent", "tpu/gang-size": str(gang_size),
+        "tpu/chips": "4", "tpu/priority": "50",
+    }
+    t0 = time.monotonic()
+    for m in range(gang_size):
+        stack.cluster.create_pod(PodSpec(f"urgent-{m}", labels=dict(labels)))
+    deadline = time.monotonic() + 60
+    bound = 0
+    while time.monotonic() < deadline:
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = sum(
+            1
+            for p in stack.cluster.list_pods()
+            if p.name.startswith("urgent-") and p.node_name
+        )
+        if bound == gang_size:
+            break
+        stack.rebalancer.run_once()
+    latency_ms = (time.monotonic() - t0) * 1000.0
+    assert bound == gang_size, f"urgent gang never admitted ({bound}/{gang_size})"
+    _assert_no_oversubscription(stack)
+    # Victims were requeued, never deleted: every low pod still exists.
+    low = [p for p in stack.cluster.list_pods() if p.name.startswith("low-")]
+    assert len(low) == n_low, "a preempted victim was deleted, not requeued"
+    preempted = int(stack.metrics.rebalance_preemptions.value())
+    assert preempted > 0, "admission happened without the preemption pass"
+    return {
+        "preemption_admit_latency_ms": round(latency_ms, 2),
+        "preemption_victims": preempted,
+        "preemption_weight": int(stack.metrics.preempted_weight.value()),
+    }
+
+
 def _constrained_scenario() -> dict:
     """Scheduling latency with the inter-pod family engaged: 4-member
     gangs whose members carry required self-anti-affinity over hostname
@@ -1529,6 +1728,10 @@ def run_bench() -> dict:
     print(f"binpack efficiency (saturated v5e-64): {efficiency:.3f}", file=sys.stderr)
     frag = _fragmentation_scenario()
     print(f"fragmentation (whole-host pod after partial load): {frag}", file=sys.stderr)
+    churn = _rebalance_churn_scenario()
+    print(f"long-churn fragmentation replay (rebalancer off/on): {churn}", file=sys.stderr)
+    preadmit = _preemption_admit_scenario()
+    print(f"preemptive admission of a parked gang: {preadmit}", file=sys.stderr)
     mixed = _mixed_fleet_scenario()
     print(f"mixed-fleet contention (config 5): {mixed}", file=sys.stderr)
     constrained = _constrained_scenario()
@@ -1564,6 +1767,8 @@ def run_bench() -> dict:
         "p50_ms": round(p50, 2),
         "binpack_efficiency": round(efficiency, 4),
         **frag,
+        **churn,
+        **preadmit,
         **mixed,
         **constrained,
         **burst,
@@ -1599,7 +1804,30 @@ def run_smoke() -> dict:
     out.update(_degraded_chaos_scenario(hosts=4, gangs=2, singles=8))
     out.update(_bind_latency_scenario())
     out.update(_federated_spillover_scenario(gangs=2, remote_hosts=8))
+    out.update(_rebalance_churn_scenario(rounds=16, seed=7))
+    out.update(_preemption_admit_scenario(hosts=2))
     return {"metric": "smoke_burst_with_gang_pods_per_s", **out}
+
+
+def run_rebalance() -> dict:
+    """``bench.py --rebalance`` / ``make rebalance-bench``: the long form
+    of the seeded churn replay (more rounds than the smoke's 16) plus the
+    preemptive-admission scenario, CPU-pinned. The acceptance evidence
+    for the goodput-driven rebalancer: fragmentation bounded with the
+    rebalancer on while the same stream decays without it, and a parked
+    high-priority gang admitted via preemption with all victims requeued
+    whole and zero oversubscription."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = _rebalance_churn_scenario(rounds=60, seed=7)
+    out.update(_preemption_admit_scenario(hosts=4))
+    return {
+        "metric": "frag_churn_tail_mean_on",
+        "value": out["frag_churn_tail_mean_on"],
+        "unit": "score",
+        **out,
+    }
 
 
 def _child(force_cpu: bool) -> int:
@@ -1618,6 +1846,9 @@ def main() -> int:
         return 0
     if "--scale" in sys.argv:
         print(json.dumps(run_scale()))
+        return 0
+    if "--rebalance" in sys.argv:
+        print(json.dumps(run_rebalance()))
         return 0
     if "--run" in sys.argv:
         return _child(force_cpu="--cpu" in sys.argv)

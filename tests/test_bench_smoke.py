@@ -76,6 +76,34 @@ def test_bind_latency_pipeline_speedup():
     assert out["bind_inflight_peak"] > 1
 
 
+def test_rebalance_churn_replay_bounds_fragmentation():
+    import bench
+
+    # The ISSUE 8 acceptance: the SAME seeded churn stream, rebalancer
+    # off vs on — with it on, the fragmentation tail must be bounded (no
+    # worse than off, and the replay's later half no worse than its
+    # peak), and the rebalancer must have actually moved gangs rather
+    # than the stream being benign. Per-round invariants (no
+    # oversubscription, no split gang) are asserted inside the scenario.
+    out = bench._rebalance_churn_scenario(rounds=16, seed=7)
+    assert out["frag_churn_moves"] > 0
+    assert out["frag_churn_tail_mean_on"] <= out["frag_churn_tail_mean_off"]
+    assert out["frag_churn_final_on"] <= out["frag_churn_final_off"]
+    assert out["frag_churn_peak_on"] <= out["frag_churn_peak_off"]
+
+
+def test_preemption_admit_scenario_invariants():
+    import bench
+
+    # A parked high-priority gang admits via background preemption; the
+    # scenario asserts inline that every victim still exists (requeued,
+    # never deleted) and nothing oversubscribes.
+    out = bench._preemption_admit_scenario(hosts=2)
+    assert out["preemption_admit_latency_ms"] > 0
+    assert out["preemption_victims"] > 0
+    assert out["preemption_weight"] > 0
+
+
 def test_smoke_mode_runs_reduced_fleet():
     import bench
 
@@ -87,6 +115,9 @@ def test_smoke_mode_runs_reduced_fleet():
     assert out["multi_gang_contended_pods_per_s"] > 0
     # The bind-latency pipeline scenario rides the smoke run too.
     assert out["pipelined_bind_pods_per_s"] > 0
+    # The rebalancer churn replay and preemptive admission ride it too.
+    assert out["frag_churn_moves"] > 0
+    assert out["preemption_admit_latency_ms"] > 0
 
 
 def test_federated_spillover_invariants():

@@ -75,6 +75,20 @@ class TestSchedulerManifest:
         )
         assert cfg.federation_spillover is True
 
+    def test_configmap_rebalance_knobs_validate(self):
+        """The shipped rebalancer knobs must pass SchedulerConfig
+        validation (a drifted ConfigMap would crash-loop the Deployment),
+        and the subsystem ships enabled with the documented defaults."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert cfg.rebalance_period_s > 0
+        assert 0 <= cfg.rebalance_min_gain <= 1
+        assert cfg.rebalance_max_moves >= 1
+        assert cfg.rebalance_preemption is True
+        assert cfg.rebalance_elastic is True
+
     def test_rbac_covers_client_verbs(self):
         """KubeCluster issues: pod list/watch, pods/binding create,
         pods/eviction create (preemption), node list/watch, TpuNodeMetrics

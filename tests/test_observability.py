@@ -238,6 +238,49 @@ class TestFailoverMetrics:
         ][0]
         assert float(line.split()[-1]) >= 1.0
 
+    def test_rebalance_families_exposed_and_move(self):
+        """Goodput-driven rebalancer (ISSUE 8): the move/preemption/
+        resize/abort counters, the fragmentation gauge, and the
+        priority-weight counter exist — and the preemption ones move when
+        a background pass actually admits a parked gang."""
+        stack, agent = make_stack(enable_preemption=False)
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        text = stack.metrics.registry.render_prometheus()
+        for family in (
+            "yoda_rebalance_moves_total",
+            "yoda_rebalance_preemptions_total",
+            "yoda_rebalance_resizes_total",
+            "yoda_rebalance_aborted_moves_total",
+            "yoda_fragmentation_score",
+            "yoda_preempted_priority_weight_total",
+        ):
+            assert f"\n# TYPE {family} " in text, family
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"low-{i}", labels={"tpu/chips": "4", "tpu/priority": "1"}
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"hi-{m}",
+                    labels={
+                        "tpu/gang": "hi", "tpu/gang-size": "2",
+                        "tpu/chips": "4", "tpu/priority": "10",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.rebalancer.run_once()
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.metrics.rebalance_preemptions.value() == 2.0
+        assert stack.metrics.preempted_weight.value() > 0
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_rebalance_preemptions_total 2.0" in text
+
     def test_federation_families_exposed(self):
         stack, agent = make_stack()
         agent.add_host("host", generation="v5e", chips=4)

@@ -60,6 +60,14 @@ PG_MIN_LITE = "pod-group.scheduling.sigs.k8s.io/min-available"
 PG_NAME = "scheduling.x-k8s.io/pod-group"
 TOPOLOGY = "tpu/topology"
 MULTISLICE = "tpu/multislice"
+# Elastic gangs (goodput-driven rebalancing): the gang may run with any
+# member count in [min-members, max-members]; tpu/gang-size remains the
+# DESIRED size. The background rebalancer shrinks an elastic gang toward
+# min-members under contention (surplus members park) and grows it toward
+# max-members into free capacity. Plain gangs only — a topology gang's
+# size is pinned by its ICI block shape.
+MIN_MEMBERS = "tpu/min-members"
+MAX_MEMBERS = "tpu/max-members"
 
 
 class LabelParseError(ValueError):
@@ -75,10 +83,30 @@ class GangSpec:
     # data parallelism over DCN between blocks, ICI within each).
     # size == slices x prod(topology) when topology is set.
     slices: int = 1
+    # Elastic bounds (tpu/min-members / tpu/max-members): None = rigid.
+    # When set, the gang runs whole at any EFFECTIVE size in
+    # [min_size, max_size]; the rebalancer owns the effective size
+    # (GangPlugin.set_effective_size) and `size` stays the desired one.
+    min_size: int | None = None
+    max_size: int | None = None
 
     @property
     def hosts(self) -> int:
         return self.size
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_size is not None or self.max_size is not None
+
+    @property
+    def floor(self) -> int:
+        """Smallest member count the gang may run at."""
+        return self.min_size if self.min_size is not None else self.size
+
+    @property
+    def ceiling(self) -> int:
+        """Largest member count the gang may grow to."""
+        return self.max_size if self.max_size is not None else self.size
 
 
 @dataclass(frozen=True)
@@ -200,11 +228,13 @@ def parse_request(
         or size_raw is not None
         or TOPOLOGY in labels
         or MULTISLICE in labels
+        or MIN_MEMBERS in labels
+        or MAX_MEMBERS in labels
     ):
         if gang_raw is None:
             present = [
                 k
-                for k in (size_key, TOPOLOGY, MULTISLICE)
+                for k in (size_key, TOPOLOGY, MULTISLICE, MIN_MEMBERS, MAX_MEMBERS)
                 if k in labels
             ]
             raise LabelParseError(
@@ -248,8 +278,36 @@ def parse_request(
                 raise LabelParseError(
                     f"{what} implies {expected} hosts but {GANG_SIZE} is {size}"
                 )
+        min_size = max_size = None
+        if MIN_MEMBERS in labels or MAX_MEMBERS in labels:
+            if topology is not None:
+                raise LabelParseError(
+                    f"{MIN_MEMBERS}/{MAX_MEMBERS} apply to plain gangs only "
+                    f"(a {TOPOLOGY} gang's size is pinned by its ICI block)"
+                )
+            if MIN_MEMBERS in labels:
+                try:
+                    min_size = parse_int(labels[MIN_MEMBERS], field=MIN_MEMBERS)
+                except QuantityError as e:
+                    raise LabelParseError(str(e)) from e
+                if not 1 <= min_size <= size:
+                    raise LabelParseError(
+                        f"{MIN_MEMBERS} must be in [1, {GANG_SIZE}={size}], "
+                        f"got {min_size}"
+                    )
+            if MAX_MEMBERS in labels:
+                try:
+                    max_size = parse_int(labels[MAX_MEMBERS], field=MAX_MEMBERS)
+                except QuantityError as e:
+                    raise LabelParseError(str(e)) from e
+                if max_size < size:
+                    raise LabelParseError(
+                        f"{MAX_MEMBERS} must be >= {GANG_SIZE}={size}, "
+                        f"got {max_size}"
+                    )
         gang = GangSpec(
-            name=name, size=size, topology=topology, slices=n_slices
+            name=name, size=size, topology=topology, slices=n_slices,
+            min_size=min_size, max_size=max_size,
         )
 
     return TpuRequest(

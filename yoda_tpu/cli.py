@@ -298,6 +298,22 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 )
                 for st in stacks
             )
+        # Goodput-driven rebalancer: background ICI defragmentation,
+        # priority preemption, elastic resize — one thread per stack,
+        # started with leadership like the reconciler (its per-tick gate
+        # additionally re-checks the live fence + resync state, so a
+        # lease blip cannot race a move against the new leader).
+        if config.rebalance_period_s > 0:
+            extra_threads.extend(
+                threading.Thread(
+                    target=st.rebalancer.run_forever,
+                    args=(stop,),
+                    kwargs={"period_s": config.rebalance_period_s},
+                    name=f"rebalance-{st.informer.scheduler_name}",
+                    daemon=True,
+                )
+                for st in stacks
+            )
         # Federation control loop: health probes, rejoin resyncs, and
         # spillover migration — ONE background thread, so degradation
         # never serializes against any member's serve loop.

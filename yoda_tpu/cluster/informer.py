@@ -19,6 +19,7 @@ scheduler reads ONLY this cache during a cycle. The cache maintains:
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -98,6 +99,12 @@ class InformerCache:
         self._last_event_mono: float | None = None
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
+        # _tpus keys maintained in sorted order incrementally (bisect on
+        # CR add/delete): snapshot() hands the pre-sorted candidate list
+        # to Snapshot(order=...) instead of re-sorting O(N log N) per
+        # build — at datacenter scale the sort was the next wall after
+        # the NodeInfo reuse cache.
+        self._tpu_order: list[str] = []
         self._nodes: dict[str, K8sNode] = {}
         self._namespaces: dict[str, dict[str, str]] = {}
         # "namespace/name" -> K8sPvc (minimal volume awareness: the
@@ -272,11 +279,19 @@ class InformerCache:
         with self._lock:
             structural = False
             if event.type == "deleted":
-                self._tpus.pop(tpu.name, None)
+                if self._tpus.pop(tpu.name, None) is not None:
+                    i = bisect.bisect_left(self._tpu_order, tpu.name)
+                    if (
+                        i < len(self._tpu_order)
+                        and self._tpu_order[i] == tpu.name
+                    ):
+                        del self._tpu_order[i]
                 relevant = structural = True
             else:
                 prev = self._tpus.get(tpu.name)
                 self._tpus[tpu.name] = tpu
+                if prev is None:
+                    bisect.insort(self._tpu_order, tpu.name)
                 structural = prev is None  # CR added: node set changed
                 relevant = prev is None or not prev.values_equal(tpu)
                 if not relevant and self.staleness_s > 0:
@@ -521,7 +536,12 @@ class InformerCache:
             # treated as immutable by every consumer.
             cache = self._ni_cache
             nodes = {}
-            for name, tpu in self._tpus.items():
+            order: list[str] = []
+            # _tpu_order is maintained sorted incrementally (bisect on CR
+            # add/delete), so the candidate list below is born sorted and
+            # Snapshot skips its O(N log N) re-sort per build.
+            for name in self._tpu_order:
+                tpu = self._tpus[name]
                 # Once Node-informed, a CR whose Node is gone is a deleted
                 # node with a not-yet-expired metrics object: never a
                 # candidate (the round-1 gap: pods could bind to deleted
@@ -538,8 +558,10 @@ class InformerCache:
                     )
                     cache[name] = ni
                 nodes[name] = ni
+                order.append(name)
             snap = Snapshot(
                 nodes,
+                order=order,
                 version=self._version,
                 namespaces=self._namespaces or None,
                 pvcs=(

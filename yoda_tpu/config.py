@@ -179,6 +179,33 @@ class SchedulerConfig:
     # nothing, exactly one cluster). False = clusters federate for
     # health/failover only; every gang stays on its home cluster.
     federation_spillover: bool = True
+    # Goodput-driven rebalancer (yoda_tpu/rebalance): period of the
+    # background pass that repacks bound topology gangs onto tighter ICI
+    # blocks, preempts strictly-lower-priority work to admit a parked
+    # whole gang, and resizes elastic gangs (tpu/min-members /
+    # tpu/max-members). One pass per stack, leadership-gated, never on a
+    # serve loop. 0 disables the background loop (Stack.rebalancer can
+    # still be driven manually via run_once()).
+    rebalance_period_s: float = 30.0
+    # Minimum fragmentation-score improvement (rebalance/score.py, [0,1])
+    # a repack move must buy before a bound gang is migrated — moves are
+    # not free (unbind + rebind I/O), so tiny gains are not worth churn.
+    rebalance_min_gain: float = 0.05
+    # At most this many gang moves per pass (migration cost is hidden
+    # behind the bind pipeline, but each move still re-places a whole
+    # gang — bound per pass keeps the blast radius one gang at a time).
+    rebalance_max_moves: int = 1
+    # Enable the priority-preemption pass (victims are UNBOUND and
+    # requeued through the standard rollback path — never deleted — so
+    # preempted work re-places when capacity returns).
+    rebalance_preemption: bool = True
+    # Enable elastic gang resize (grow toward tpu/max-members into free
+    # capacity; shrink toward tpu/min-members as the cheapest preemption
+    # unit).
+    rebalance_elastic: bool = True
+    # Victim budget per admitted gang: the preemption pass gives up
+    # rather than evict more than this many pods for one parked gang.
+    rebalance_max_victims: int = 8
     # Cluster events retry a parked pod immediately through this many
     # scheduling attempts; beyond it the pod's exponential backoff timer
     # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue
@@ -315,6 +342,42 @@ class SchedulerConfig:
             raise ValueError(
                 "reconcile_period_s must be >= 0 (0 disables the "
                 f"background reconciler), got {cfg.reconcile_period_s!r}"
+            )
+        if not isinstance(
+            cfg.rebalance_period_s, (int, float)
+        ) or isinstance(
+            cfg.rebalance_period_s, bool
+        ) or cfg.rebalance_period_s < 0:
+            raise ValueError(
+                "rebalance_period_s must be >= 0 (0 disables the "
+                f"background rebalancer), got {cfg.rebalance_period_s!r}"
+            )
+        if not isinstance(
+            cfg.rebalance_min_gain, (int, float)
+        ) or isinstance(
+            cfg.rebalance_min_gain, bool
+        ) or not 0 <= cfg.rebalance_min_gain <= 1:
+            raise ValueError(
+                "rebalance_min_gain must be in [0, 1], got "
+                f"{cfg.rebalance_min_gain!r}"
+            )
+        if (
+            isinstance(cfg.rebalance_max_moves, bool)
+            or not isinstance(cfg.rebalance_max_moves, int)
+            or cfg.rebalance_max_moves < 0
+        ):
+            raise ValueError(
+                "rebalance_max_moves must be an int >= 0, got "
+                f"{cfg.rebalance_max_moves!r}"
+            )
+        if (
+            isinstance(cfg.rebalance_max_victims, bool)
+            or not isinstance(cfg.rebalance_max_victims, int)
+            or cfg.rebalance_max_victims < 1
+        ):
+            raise ValueError(
+                "rebalance_max_victims must be an int >= 1, got "
+                f"{cfg.rebalance_max_victims!r}"
             )
         thresholds = (
             cfg.federation_degraded_after_s,

@@ -106,9 +106,15 @@ class Snapshot:
         namespaces: "Mapping[str, Mapping[str, str]] | None" = None,
         pvcs: "Mapping[str, object] | None" = None,
         pvs: "Mapping[str, object] | None" = None,
+        order: "list[str] | None" = None,
     ) -> None:
         self._nodes = dict(nodes)
-        self._order = sorted(self._nodes)
+        # ``order``: the node names ALREADY in sorted order, supplied by a
+        # builder that maintains it incrementally (InformerCache keeps a
+        # bisect-maintained name list) — re-sorting O(N log N) per snapshot
+        # build was the next serve-path wall at fleet scale. Bare
+        # constructions (tests, ad-hoc snapshots) omit it and pay the sort.
+        self._order = sorted(self._nodes) if order is None else order
         # Monotonic cache key bumped by the informer on any node/pod/metrics
         # change; lets the batch plugin reuse lowered fleet arrays across
         # cycles (0 = uncacheable).

@@ -392,6 +392,47 @@ class SchedulingMetrics:
             "because the home cluster could not fit them (all-or-nothing: "
             "a gang is never split across clusters)",
         )
+        # Goodput-driven rebalancer (docs/OPERATIONS.md rebalancer
+        # runbook): background defragmentation moves, priority
+        # preemptions (victims unbound + requeued, never deleted),
+        # elastic resizes, and the fleet fragmentation score the pass
+        # optimizes (rebalance/score.py; 0 = free capacity perfectly
+        # consolidated).
+        self.rebalance_moves = r.counter(
+            "yoda_rebalance_moves_total",
+            "Bound gangs the rebalancer migrated onto a tighter ICI block "
+            "(take -> unbind -> install plan -> re-admit, all-or-nothing)",
+        )
+        self.rebalance_preemptions = r.counter(
+            "yoda_rebalance_preemptions_total",
+            "Pods the rebalancer unbound and requeued to admit a parked "
+            "higher-priority gang whole (victims requeue, never deleted)",
+        )
+        self.rebalance_resizes = r.counter(
+            "yoda_rebalance_resizes_total",
+            "Elastic gang effective-size changes (grown into free "
+            "capacity toward tpu/max-members, or shrunk under contention "
+            "toward tpu/min-members — never below it)",
+        )
+        self.rebalance_aborted = r.counter(
+            "yoda_rebalance_aborted_moves_total",
+            "Repack moves abandoned mid-flight (fence flipped, or a "
+            "member's unbind refused); the gang replans through normal "
+            "admission — never split, never oversubscribed",
+        )
+        self.fragmentation = r.gauge(
+            "yoda_fragmentation_score",
+            "Fleet fragmentation in [0,1] (free-block islands in ICI "
+            "slices + stranded free chips; 0 = perfectly consolidated). "
+            "Monotonic growth with the rebalancer enabled means moves "
+            "are being starved or min_gain sits too high",
+        )
+        self.preempted_weight = r.counter(
+            "yoda_preempted_priority_weight_total",
+            "Priority-weighted work evicted by rebalancer preemptions "
+            "(sum over victims of (max(priority,0)+1) x chips) — the cost "
+            "side of preemptive admission",
+        )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
 

@@ -114,6 +114,26 @@ def _pod_constraints(pod: PodSpec) -> tuple:
     )
 
 
+def _admission_key(pod: PodSpec) -> "tuple | None":
+    """Everything pod-side that shapes the cacheable admission vector
+    (no AffinityData, no pending resources): two pods with equal keys get
+    identical vectors against the same snapshot + fleet arrays. None when
+    a constraint is unhashable — the caller then skips the cache."""
+    try:
+        key = (
+            tuple(pod.tolerations),
+            tuple(sorted(pod.node_selector.items())),
+            tuple(pod.node_affinity),
+            tuple(pod.host_ports),
+            pod.cpu_milli_request,
+            pod.memory_request,
+        )
+        hash(key)
+    except TypeError:
+        return None
+    return key
+
+
 def _host_admission(
     static: FleetArrays,
     snapshot: Snapshot,
@@ -128,7 +148,32 @@ def _host_admission(
     topology-spread feasibility (absent for the vast majority of pods, so
     the common path stays one pod_admits_on call per node). Padding rows
     are masked by node_valid in the kernel, so their value is
-    irrelevant."""
+    irrelevant.
+
+    Amortized across pods (the per-pod O(N) Python loop was the next
+    serve-path wall after the snapshot sort): when no AffinityData or
+    pending resources are in play, the vector depends only on the
+    SNAPSHOT and the pod's admission constraints — so it is cached on the
+    snapshot object keyed by (fleet arrays identity, constraint tuple).
+    Every plain label-only pod of a burst shares one key, so a K-pod
+    burst (and every gang member, and every pod until the next watch
+    event) pays the loop once instead of K times. The snapshot is
+    rebuilt (and the cache with it) on any watch event, so staleness is
+    impossible by construction."""
+    cacheable = aff is None and not pending_res
+    key = None
+    if cacheable:
+        key = _admission_key(pod)
+        if key is not None:
+            cache = getattr(snapshot, "_admission_cache", None)
+            if cache is None:
+                cache = snapshot._admission_cache = {}
+            hit = cache.get(key)
+            # Entries pin their FleetArrays (identity-checked, never by
+            # id() — a collected static's id could be reused) so a
+            # re-stack against the same snapshot misses cleanly.
+            if hit is not None and hit[0] is static:
+                return hit[1]
 
     def _ok(name: str) -> bool:
         if name not in snapshot:
@@ -144,11 +189,16 @@ def _host_admission(
             return False
         return aff is None or aff.feasible(ni)[0]
 
-    return np.array(
+    vec = np.array(
         [_ok(name) for name in static.names]
         + [True] * (static.node_valid.shape[0] - len(static.names)),
         dtype=bool,
     )
+    if key is not None:
+        if len(cache) >= 256:  # runaway-constraint-diversity backstop
+            cache.clear()
+        cache[key] = (static, vec)
+    return vec
 
 
 @dataclass

@@ -105,6 +105,12 @@ class _GangRemaining:
 @dataclass
 class _GangState:
     spec: GangSpec
+    # Elastic gangs (tpu/min-members / tpu/max-members): the member count
+    # the gang currently runs at, owned by the rebalancer
+    # (set_effective_size). None = the declared spec.size. The Permit
+    # barrier releases at this count and admission parks surplus members
+    # beyond it; never below spec.floor, never above spec.ceiling.
+    eff_size: int | None = None
     waiting: set[str] = field(default_factory=set)       # pod keys on waitlist
     bound: set[str] = field(default_factory=set)         # pod keys bound
     assigned: dict[str, str] = field(default_factory=dict)  # pod key -> host
@@ -189,6 +195,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
 
     # --- helpers ---
 
+    @staticmethod
+    def _eff(gs: _GangState) -> int:
+        """The gang's CURRENT effective size: the member count the Permit
+        barrier releases at and admission admits up to. spec.size unless
+        an elastic resize (set_effective_size) moved it."""
+        return gs.eff_size if gs.eff_size is not None else gs.spec.size
+
     def _member_slots(self, ni: NodeInfo, req, *, exclude_hosts: set[str]) -> int:
         """How many members of ``req`` the node could take right now."""
         if ni.tpu is None or ni.name in exclude_hosts:
@@ -243,7 +256,18 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 # never-bound member as bound).
                 gs.bound.discard(pod.key)
                 gs.assigned.pop(pod.key, None)
-            remaining = gs.spec.size - len(gs.bound) - len(gs.waiting)
+            remaining = self._eff(gs) - len(gs.bound) - len(gs.waiting)
+            if gs.spec.elastic and remaining <= 0:
+                # Surplus member of an elastic gang: the gang already runs
+                # at its effective size — park until a resize-up
+                # (Rebalancer) raises it (the resize calls
+                # move_all_to_active, which reactivates this entry).
+                return Status.unschedulable(
+                    f"gang {req.gang.name}: already at its effective size "
+                    f"{self._eff(gs)} (elastic {gs.spec.floor}.."
+                    f"{gs.spec.ceiling}); surplus member parked until a "
+                    "resize-up"
+                )
             state.write(GANG_REMAINING_KEY, _GangRemaining(remaining))
 
             if gs.spec.topology is not None:
@@ -553,7 +577,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 return
             gs = self._gangs[gang_name]
             dead = gs.assigned.get(wp.pod.key) in gs.dead_hosts
-            complete = len(gs.waiting) + len(gs.bound) >= gs.spec.size
+            complete = len(gs.waiting) + len(gs.bound) >= self._eff(gs)
             targets = list(gs.waiting) if complete and not dead else []
             if targets:
                 # Release starts: arm the transactional-bind cohort AND
@@ -648,7 +672,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             gs.waiting.discard(wp.pod.key)
             if status.success:
                 gs.bound.add(wp.pod.key)
-                if len(gs.bound) >= gs.spec.size:
+                if len(gs.bound) >= self._eff(gs):
                     gs.assigned = {
                         k: v for k, v in gs.assigned.items() if k in gs.bound
                     }
@@ -995,6 +1019,66 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     key, reason,
                 )
                 w.reject(reason)
+
+    # --- rebalancer surface (yoda_tpu/rebalance) ---
+
+    def set_effective_size(self, name: str, n: int) -> int | None:
+        """Elastic resize: set the gang's effective size, clamped to
+        [spec.floor, spec.ceiling]. Returns the size actually set, or None
+        when the gang is unknown or not elastic (rigid gangs cannot be
+        resized — the invariant the min-members floor exists to protect).
+        The caller (Rebalancer) reactivates parked surplus members via
+        ``queue.move_all_to_active`` after a resize-up; a resize-down of a
+        BOUND gang additionally unbinds the surplus members through the
+        standard rollback path."""
+        with self._lock:
+            gs = self._gangs.get(name)
+            if gs is None or not gs.spec.elastic:
+                return None
+            n = max(gs.spec.floor, min(gs.spec.ceiling, n))
+            gs.eff_size = n
+            return n
+
+    def effective_size(self, name: str) -> int | None:
+        """The gang's current effective size (None when unknown here)."""
+        with self._lock:
+            gs = self._gangs.get(name)
+            return self._eff(gs) if gs is not None else None
+
+    def install_plan(
+        self,
+        name: str,
+        spec: GangSpec,
+        plan: "dict[str, tuple[int, int, int]]",
+    ) -> bool:
+        """Pin a topology gang's NEXT placement to ``plan`` (host ->
+        coord) — the rebalancer's repack steering: after the move
+        primitive unbinds and requeues the members, admission finds this
+        plan already installed (all hosts free and feasible) and steers
+        the members onto the chosen tight block instead of replanning
+        from scratch. Refused while any member waits at Permit (a live
+        release owns the current plan). Advisory: if the target hosts are
+        taken before the members re-admit, the normal replan runs."""
+        with self._lock:
+            gs = self._gangs.get(name)
+            if gs is None:
+                gs = _GangState(spec=spec)
+                self._gangs[name] = gs
+            if gs.waiting:
+                return False
+            gs.plan = dict(plan)
+            return True
+
+    def bound_members(self, name: str) -> "dict[str, str]":
+        """pod key -> assigned host for the gang's BOUND members (empty
+        when unknown) — the rebalancer's view of what a move must unbind."""
+        with self._lock:
+            gs = self._gangs.get(name)
+            if gs is None:
+                return {}
+            return {
+                k: h for k, h in gs.assigned.items() if k in gs.bound and h
+            }
 
     # --- introspection (tests, metrics) ---
 

@@ -1,0 +1,22 @@
+"""Goodput-driven rebalancing: background ICI defragmentation, priority
+preemption, and elastic gang resize (see rebalance/rebalancer.py)."""
+
+from yoda_tpu.rebalance.rebalancer import (
+    RebalanceReport,
+    Rebalancer,
+    priority_weight,
+)
+from yoda_tpu.rebalance.score import (
+    FleetOccupancy,
+    HostOccupancy,
+    fragmentation_score,
+)
+
+__all__ = [
+    "FleetOccupancy",
+    "HostOccupancy",
+    "RebalanceReport",
+    "Rebalancer",
+    "fragmentation_score",
+    "priority_weight",
+]

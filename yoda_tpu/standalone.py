@@ -24,6 +24,7 @@ from yoda_tpu.plugins.yoda.accounting import ChipAccountant
 from yoda_tpu.plugins.yoda.binder import ClusterBinder
 from yoda_tpu.plugins.yoda.gang import GangPlugin
 from yoda_tpu.plugins.yoda.preemption import TpuPreemption
+from yoda_tpu.rebalance import Rebalancer
 
 
 @dataclass
@@ -41,6 +42,7 @@ class Stack:
     binder: ClusterBinder | None = None
     bind_executor: BindExecutor | None = None
     reconciler: Reconciler | None = None
+    rebalancer: Rebalancer | None = None
 
 
 def build_stack(
@@ -539,6 +541,32 @@ def build_stack(
         scheduler_names=(config.scheduler_name,),
         clock=clock,
     )
+    # Goodput-driven rebalancer (yoda_tpu/rebalance): background ICI
+    # defragmentation + priority preemption + elastic resize. Built but
+    # NOT started — cli.py puts run_forever on a thread (with leadership,
+    # like the reconciler); tests drive run_once() directly. The gate
+    # composes leadership (via the scheduler's live fence) with the
+    # warm-start contract: no rebalancing on un-resynced state.
+    rebalancer = Rebalancer(
+        cluster=cluster,
+        informer=informer,
+        accountant=accountant,
+        gang=gang,
+        framework=framework,
+        queue=queue,
+        scheduler=scheduler,
+        metrics=metrics,
+        bind_executor=bind_executor,
+        clock=clock,
+        min_gain=config.rebalance_min_gain,
+        max_moves=config.rebalance_max_moves,
+        preemption=config.rebalance_preemption,
+        elastic=config.rebalance_elastic,
+        max_victims=config.rebalance_max_victims,
+        gate_fn=lambda: (
+            not scheduler._fenced() and reconciler.resynced.is_set()
+        ),
+    )
     return Stack(
         cluster,
         informer,
@@ -553,6 +581,7 @@ def build_stack(
         binder=binder,
         bind_executor=bind_executor,
         reconciler=reconciler,
+        rebalancer=rebalancer,
     )
 
 
