@@ -17,7 +17,9 @@ test:
 
 # Static checks (ruff; rule config in pyproject.toml [tool.ruff]). The
 # container image may not ship ruff — fall back to a byte-compile sweep so
-# `make all` still gates on syntax-clean sources everywhere.
+# `make all` still gates on syntax-clean sources everywhere. The metric-
+# drift check gates every registered yoda_* series on being asserted in
+# tests/test_observability.py AND documented in docs/OPERATIONS.md.
 lint:
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
 		$(PY) -m ruff check yoda_tpu tests bench.py __graft_entry__.py; \
@@ -27,6 +29,7 @@ lint:
 		echo "lint: ruff not installed; running compileall syntax sweep only"; \
 		$(PY) -m compileall -q yoda_tpu tests bench.py __graft_entry__.py; \
 	fi
+	$(PY) tools/check_metrics.py
 
 native:
 	$(MAKE) -C native

@@ -510,6 +510,116 @@ def _burst_with_gang_scenario(
     }
 
 
+def _observability_overhead_scenario(
+    *, slices: int = 2, singles: int = 4, burst_pods: int = 40
+) -> dict:
+    """Lifecycle-tracing overhead (ISSUE 9): the burst+gang contended
+    drain run three times — tracing OFF (`trace_sample_rate: 0`),
+    SAMPLED (0.05), and FULL (1.0) — on identical fleets, reporting the
+    throughput of each and the full-tracing delta. The acceptance bar:
+    full tracing costs < 10% of the `burst_with_gang` rate at smoke
+    shape, and sampled/off are within run-to-run noise (the knob table
+    in docs/OPERATIONS.md records the measured numbers).
+
+    Reported fields:
+      obs_off_pods_per_s       tracing off
+      obs_sampled_pods_per_s   trace_sample_rate=0.05
+      obs_full_pods_per_s      trace_sample_rate=1.0 (every lifecycle)
+      obs_full_overhead_pct    (off - full) / off, clamped at 0
+      obs_full_spans           spans the FULL run recorded (sanity: the
+                               run actually traced something)
+    """
+    import time as _time
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    def build(rate: float):
+        stack = build_stack(
+            config=SchedulerConfig(
+                mode="batch",
+                batch_requests=16,
+                trace_sample_rate=rate,
+                trace_capacity=16384,
+            )
+        )
+        agent = FakeTpuAgent(stack.cluster)
+        for s in range(slices):
+            agent.add_slice(
+                f"v5p-{s}", generation="v5p", host_topology=(2, 2, 1)
+            )
+        for i in range(singles):
+            agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(2):  # warm both compiled kernels outside the window
+            stack.cluster.create_pod(
+                PodSpec(f"warm-{i}", labels={"tpu/chips": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=120)
+        for i in range(2):
+            stack.cluster.delete_pod(f"default/warm-{i}")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        return stack
+
+    n_total = burst_pods + 4
+
+    def drain(stack, rep: int) -> float:
+        gang = {
+            "tpu/gang": f"og{rep}", "tpu/topology": "2x2x1",
+            "tpu/chips": "4",
+        }
+        t0 = _time.monotonic()
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(f"og{rep}-{i}", labels=dict(gang))
+            )
+        for i in range(burst_pods):
+            stack.cluster.create_pod(
+                PodSpec(f"op{rep}-{i}", labels={"tpu/chips": "1"})
+            )
+        for i in range(2, 4):
+            stack.cluster.create_pod(
+                PodSpec(f"og{rep}-{i}", labels=dict(gang))
+            )
+        stack.scheduler.run_until_idle(max_wall_s=120)
+        dt = _time.monotonic() - t0
+        pods = stack.cluster.list_pods()
+        assert (
+            len([p for p in pods if p.node_name]) == n_total
+        ), "not all bound"
+        for p in list(pods):
+            stack.cluster.delete_pod(p.key)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        return n_total / dt
+
+    # All three stacks live in one process, and the measured drains are
+    # INTERLEAVED (off, sampled, full, off, ...) taking the best of N per
+    # mode: the per-drain wall at smoke shape is ~10 ms, so process-level
+    # jitter (CPU frequency, allocator state) dwarfs the effect when the
+    # modes run in separate blocks — interleaving makes the jitter land
+    # on every mode equally and best-of-N reads through it.
+    stacks = {rate: build(rate) for rate in (0.0, 0.05, 1.0)}
+    best = {rate: 0.0 for rate in stacks}
+    for rep in range(5):
+        for rate, stack in stacks.items():
+            best[rate] = max(best[rate], drain(stack, rep))
+    off, sampled, full = best[0.0], best[0.05], best[1.0]
+    assert not stacks[0.0].metrics.tracer.records(), (
+        "tracing off must record nothing"
+    )
+    full_spans = len(stacks[1.0].metrics.tracer.records())
+    assert full_spans > 0, "full tracing recorded no spans"
+    return {
+        "obs_off_pods_per_s": round(off, 1),
+        "obs_sampled_pods_per_s": round(sampled, 1),
+        "obs_full_pods_per_s": round(full, 1),
+        "obs_full_overhead_pct": round(max((off - full) / off * 100, 0.0), 1),
+        "obs_full_spans": full_spans,
+    }
+
+
 def _multi_gang_contended_scenario(
     *, slices: int = 4, gangs: int = 3
 ) -> dict:
@@ -1746,6 +1856,8 @@ def run_bench() -> dict:
     print(f"pipelined bind fan-out vs serial: {bindpipe}", file=sys.stderr)
     fedspill = _federated_spillover_scenario()
     print(f"federated spillover (home full -> secondary): {fedspill}", file=sys.stderr)
+    obs = _observability_overhead_scenario()
+    print(f"lifecycle-tracing overhead (off/sampled/full): {obs}", file=sys.stderr)
     http = _http_gang_scenario()
     print(f"gang over real HTTP wire path: {http}", file=sys.stderr)
     probe = _device_probe()
@@ -1776,6 +1888,7 @@ def run_bench() -> dict:
         **degraded,
         **bindpipe,
         **fedspill,
+        **obs,
         **http,
         **probe,
         **pallas,
@@ -1806,6 +1919,7 @@ def run_smoke() -> dict:
     out.update(_federated_spillover_scenario(gangs=2, remote_hosts=8))
     out.update(_rebalance_churn_scenario(rounds=16, seed=7))
     out.update(_preemption_admit_scenario(hosts=2))
+    out.update(_observability_overhead_scenario())
     return {"metric": "smoke_burst_with_gang_pods_per_s", **out}
 
 

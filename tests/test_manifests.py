@@ -89,6 +89,18 @@ class TestSchedulerManifest:
         assert cfg.rebalance_preemption is True
         assert cfg.rebalance_elastic is True
 
+    def test_configmap_trace_knobs_validate(self):
+        """The shipped tracing knobs must pass SchedulerConfig validation
+        and ship with full sampling on (the near-zero-overhead default
+        the overhead bench certifies)."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert cfg.trace_sample_rate == 1.0
+        assert cfg.trace_capacity >= 16
+        assert cfg.trace_sink == ""
+
     def test_rbac_covers_client_verbs(self):
         """KubeCluster issues: pod list/watch, pods/binding create,
         pods/eviction create (preemption), node list/watch, TpuNodeMetrics

@@ -139,6 +139,100 @@ class TestSchedulerMetrics:
         assert "bound" in t.oneline()
 
 
+# The canonical registered-series list — tools/check_metrics.py (run by
+# `make lint`) asserts every yoda_* family registered anywhere in code
+# appears BOTH here and in docs/OPERATIONS.md, so a new metric cannot
+# silently skip the test suite or the operator docs.
+ALL_METRIC_FAMILIES = (
+    "yoda_bind_inflight",
+    "yoda_bind_wall_ms",
+    "yoda_binds_total",
+    "yoda_burst_dispatches_total",
+    "yoda_burst_invalidated_total",
+    "yoda_burst_served_total",
+    "yoda_cluster_state",
+    "yoda_cluster_transitions_total",
+    "yoda_delta_apply_ms",
+    "yoda_dispatch_backend_level",
+    "yoda_dispatch_errors_total",
+    "yoda_dispatch_fallback_total",
+    "yoda_events_dropped_total",
+    "yoda_fragmentation_score",
+    "yoda_gang_fused_dispatches_total",
+    "yoda_gang_fused_invalidated_total",
+    "yoda_gang_fused_served_total",
+    "yoda_gang_plan_invalidated_total",
+    "yoda_gang_plan_served_total",
+    "yoda_gang_wait_seconds",
+    "yoda_joint_dispatches_total",
+    "yoda_joint_gangs_fused_total",
+    "yoda_joint_gangs_parked_total",
+    "yoda_kernel_dispatch_floor_ms",
+    "yoda_kernel_dispatches_total",
+    "yoda_kernel_on_accelerator",
+    "yoda_overlap_cycles_total",
+    "yoda_preempted_priority_weight_total",
+    "yoda_preemptions_total",
+    "yoda_queue_active_pods",
+    "yoda_queue_backoff_pods",
+    "yoda_queue_parked_pods",
+    "yoda_rebalance_aborted_moves_total",
+    "yoda_rebalance_moves_total",
+    "yoda_rebalance_preemptions_total",
+    "yoda_rebalance_resizes_total",
+    "yoda_reconciler_ghost_pods_total",
+    "yoda_reconciler_leaked_reservations_total",
+    "yoda_reconciler_stranded_waits_total",
+    "yoda_recovery_bind_retries_total",
+    "yoda_recovery_fenced_binds_total",
+    "yoda_recovery_gang_rollbacks_total",
+    "yoda_recovery_unbinds_total",
+    "yoda_restack_total",
+    "yoda_resync_adopted_gangs",
+    "yoda_resync_duration_ms",
+    "yoda_resync_rebuilt_reservations",
+    "yoda_resync_rolled_back_gangs",
+    "yoda_scheduling_attempts_total",
+    "yoda_scheduling_latency_seconds",
+    "yoda_sharded_dispatches_total",
+    "yoda_snapshot_reuse_total",
+    "yoda_spillover_gangs_total",
+    "yoda_tpu_binpack_efficiency",
+    "yoda_tpu_chips_free",
+    "yoda_tpu_chips_total",
+    "yoda_tpu_duty_cycle_avg_pct",
+    "yoda_trace_dropped_total",
+)
+
+
+class TestAllFamiliesRegistered:
+    def test_every_series_renders_from_a_default_stack(self):
+        """Every yoda_* family registered in code is present in one
+        default stack's scrape — the runtime half of the metric-drift
+        contract (tools/check_metrics.py is the static half)."""
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        text = stack.metrics.registry.render_prometheus()
+        for family in ALL_METRIC_FAMILIES:
+            assert f"# TYPE {family} " in text, family
+
+    def test_checker_list_matches_code(self):
+        """The explicit list above IS what tools/check_metrics.py finds
+        in the source tree — adding a metric without updating this list
+        (and OPERATIONS.md) fails here, not just under make lint."""
+        import pathlib
+        import sys
+
+        tools = str(pathlib.Path(__file__).parent.parent / "tools")
+        sys.path.insert(0, tools)
+        try:
+            from check_metrics import registered_names
+        finally:
+            sys.path.remove(tools)
+        assert sorted(registered_names()) == sorted(ALL_METRIC_FAMILIES)
+
+
 class TestMetricsServer:
     def test_endpoints(self):
         stack, agent = make_stack()
@@ -159,6 +253,55 @@ class TestMetricsServer:
             assert "default/p: bound -> host" in trace
         finally:
             server.stop()
+
+    def test_trace_endpoint_n_and_json(self):
+        """/trace upgrades (ISSUE 9 satellite): ?n= bounds the window,
+        ?format=json returns the structured TraceEntry dump instead of
+        the hard-coded last-100 one-liners."""
+        import json
+
+        stack, agent = make_stack()
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", labels={"tpu/chips": "1"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            oneline = urllib.request.urlopen(f"{base}/trace?n=1").read().decode()
+            assert len(oneline.strip().splitlines()) == 1
+            body = urllib.request.urlopen(
+                f"{base}/trace?n=2&format=json"
+            ).read().decode()
+            entries = json.loads(body)
+            assert len(entries) == 2
+            assert entries[-1]["outcome"] == "bound"
+            assert entries[-1]["pod_key"] == "default/p2"
+            assert "phases_ms" in entries[-1]
+        finally:
+            server.stop()
+
+    def test_trace_dropped_counter_counts_ring_overflow(self):
+        from yoda_tpu.observability import SchedulingMetrics, TraceEntry
+        from yoda_tpu.tracing import Tracer
+
+        m = SchedulingMetrics(
+            trace_capacity=4, tracer=Tracer(capacity=16)
+        )
+        for i in range(7):
+            m.trace(TraceEntry(f"ns/p{i}", "bound", "h", 1, 1))
+        assert m.trace_dropped.value() == 3
+        # The span ring's overflow counts into the same family.
+        for i in range(20):
+            m.tracer.add(f"pod:ns/x{i}", "cycle")
+        assert m.trace_dropped.value() == 3 + 4
+        assert "yoda_trace_dropped_total 7" in (
+            m.registry.render_prometheus()
+        )
 
 
 class TestFailoverMetrics:

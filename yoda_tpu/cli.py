@@ -441,12 +441,80 @@ def _run_agent(args, stop: threading.Event) -> int:
     return 0
 
 
+def _run_explain(argv: "list[str]") -> int:
+    """``yoda-tpu-scheduler explain <pod|gang>`` — the why-pending CLI:
+    queries a running scheduler's ``/debug/pending/<key>`` endpoint
+    (metrics_server.py) and renders the aggregated rejection summary —
+    verdict kind, attempt count, and the top per-node reasons — so "why
+    is gang X still parked?" is one command, not a debugger session."""
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="yoda-tpu-scheduler explain",
+        description="explain why a pod (ns/name) or gang is still pending",
+    )
+    p.add_argument("key", help="pod key (namespace/name) or gang name")
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:10259",
+        help="scheduler metrics endpoint base URL",
+    )
+    args = p.parse_args(argv)
+    url = (
+        f"{args.url.rstrip('/')}/debug/pending/"
+        f"{urllib.parse.quote(args.key, safe='/')}"
+    )
+    try:
+        data = json.loads(urllib.request.urlopen(url, timeout=10).read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print(
+                f"{args.key}: nothing pending under this key (bound, never "
+                "seen by this scheduler, or aged out)"
+            )
+            return 1
+        print(f"explain: {url} -> HTTP {e.code}", file=sys.stderr)
+        return 2
+    except (urllib.error.URLError, OSError) as e:
+        print(f"explain: cannot reach {args.url}: {e}", file=sys.stderr)
+        return 2
+    import datetime
+
+    age = ""
+    if data.get("last_wall_unix"):
+        dt = datetime.datetime.fromtimestamp(data["last_wall_unix"])
+        age = f" (last verdict {dt.isoformat(sep=' ', timespec='seconds')})"
+    print(
+        f"{data['key']}: {data['kind']} after {data['attempts']} "
+        f"attempt(s){age}"
+    )
+    print(f"  last: {data['last_message']}")
+    if data.get("members"):
+        print(f"  members seen: {', '.join(data['members'])}")
+    reasons = data.get("top_reasons") or []
+    if reasons:
+        print("  top rejection reasons:")
+        for r in reasons:
+            nodes = f" [{', '.join(r['nodes'])}]" if r.get("nodes") else ""
+            print(f"    {r['count']:>4}x {r['reason']}{nodes}")
+    return 0
+
+
 def main(
     argv: list[str] | None = None, *, stop: threading.Event | None = None
 ) -> int:
     """``stop`` lets an embedding caller (tests, a supervising process)
     terminate the scheduler/agent loop; standalone runs get SIGTERM/SIGINT
     handlers instead."""
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "explain":
+        # Subcommand-style dispatch (the rest of the CLI is flag-driven;
+        # `explain` is an operator query against a RUNNING scheduler, not
+        # a serving mode, so it short-circuits before the main parser).
+        return _run_explain(argv[1:])
     parser = argparse.ArgumentParser(
         prog="yoda-tpu-scheduler",
         description="TPU-native Kubernetes scheduler (yoda-tpu)",

@@ -206,6 +206,20 @@ class SchedulerConfig:
     # Victim budget per admitted gang: the preemption pass gives up
     # rather than evict more than this many pods for one parked gang.
     rebalance_max_victims: int = 8
+    # Lifecycle tracing (yoda_tpu/tracing.py): fraction of pod/gang
+    # lifetimes traced end-to-end (enqueue -> gather -> dispatch ->
+    # reserve -> permit-park -> bind -> bound, plus rebalancer moves,
+    # spillover, and resync repairs). Sampling is deterministic per
+    # subject (a gang's members always land on the same side). 0 turns
+    # tracing off entirely — call sites pay one attribute read.
+    trace_sample_rate: float = 1.0
+    # Bounded span-ring size; overflow evicts oldest and counts into
+    # yoda_trace_dropped_total. Sized for ~minutes of burst traffic.
+    trace_capacity: int = 4096
+    # Optional JSONL sink: every span is also appended to this file (one
+    # JSON object per line) for offline analysis. "" disables. A sink
+    # that becomes unwritable is dropped silently; the ring keeps working.
+    trace_sink: str = ""
     # Cluster events retry a parked pod immediately through this many
     # scheduling attempts; beyond it the pod's exponential backoff timer
     # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue
@@ -401,6 +415,29 @@ class SchedulerConfig:
             raise ValueError(
                 "federation_probe_period_s must be > 0, got "
                 f"{cfg.federation_probe_period_s!r}"
+            )
+        if not isinstance(
+            cfg.trace_sample_rate, (int, float)
+        ) or isinstance(
+            cfg.trace_sample_rate, bool
+        ) or not 0 <= cfg.trace_sample_rate <= 1:
+            raise ValueError(
+                "trace_sample_rate must be in [0, 1] (0 = tracing off), "
+                f"got {cfg.trace_sample_rate!r}"
+            )
+        if (
+            isinstance(cfg.trace_capacity, bool)
+            or not isinstance(cfg.trace_capacity, int)
+            or cfg.trace_capacity < 16
+        ):
+            raise ValueError(
+                f"trace_capacity must be an int >= 16, got "
+                f"{cfg.trace_capacity!r}"
+            )
+        if not isinstance(cfg.trace_sink, str):
+            raise ValueError(
+                f"trace_sink must be a path string ('' disables), got "
+                f"{cfg.trace_sink!r}"
             )
         if (
             isinstance(cfg.immediate_retry_attempts, bool)

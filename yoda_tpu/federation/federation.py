@@ -314,6 +314,10 @@ class Federation:
         to the home queue untouched; a failed home delete is retried by
         the health pass (the lingering home copy has no queue entry, so
         it is inert — no double bind either way)."""
+        tracer = getattr(self.metrics, "tracer", None)
+        if tracer is not None and not tracer.enabled:
+            tracer = None
+        t0 = time.monotonic()
         pods = [q.pod for q in qpis]
         created: "list[PodSpec]" = []
         for pod in pods:
@@ -338,6 +342,15 @@ class Federation:
                             "cluster %s", c.key, target.name,
                         )
                 self._readd(home, qpis)
+                if tracer is not None:
+                    tracer.add(
+                        f"gang:{gang}", "spillover",
+                        t0=t0, t1=time.monotonic(), track="federation",
+                        attrs={
+                            "home": home.name, "target": target.name,
+                            "members": len(pods), "aborted": "create-failed",
+                        },
+                    )
                 return False
             created.append(clone)
         for pod in pods:
@@ -354,6 +367,18 @@ class Federation:
             self.spillover_gangs += 1
         if self.metrics is not None:
             self.metrics.spillover_gangs.inc()
+        if tracer is not None:
+            # The gang's trace crosses clusters here: the span joins the
+            # same trace_id its home-cluster cycles recorded under, so the
+            # migrated story stays one connected walk.
+            tracer.add(
+                f"gang:{gang}", "spillover",
+                t0=t0, t1=time.monotonic(), track="federation",
+                attrs={
+                    "home": home.name, "target": target.name,
+                    "members": len(pods), "aborted": "",
+                },
+            )
         log.info(
             "spillover: migrated gang %s (%d member(s)) %s -> %s",
             gang, len(pods), home.name, target.name,

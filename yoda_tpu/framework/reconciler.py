@@ -115,6 +115,10 @@ class Reconciler:
         # Set after the first successful resync — the /readyz half of the
         # warm-start contract (cli.py flips routing on it).
         self.resynced = threading.Event()
+        # Lifecycle tracer shortcut (metrics.tracer when wired): repairs
+        # land events on the affected gang's own trace — the resync
+        # chapter of "one gang, one story".
+        self._tracer = getattr(metrics, "tracer", None)
         self._lock = threading.Lock()
         # gang name -> clock deadline by which an ADOPTED partial gang
         # must have completed whole, or the drift pass rolls it back.
@@ -169,6 +173,12 @@ class Reconciler:
             "failover: rolling back partial gang %s (%d bound member(s)): %s",
             name, len(bound), why,
         )
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.add(
+                f"gang:{name}", "resync-rollback",
+                track="reconciler",
+                attrs={"members": len(bound), "why": why[:200]},
+            )
         for pod in bound:
             self.gang.drop_membership(pod)
             self.scheduler._rollback_bound(pod, pod.node_name, None, why)
@@ -252,6 +262,12 @@ class Reconciler:
                     "%.0fs to complete before rollback)",
                     name, len(bound), size, self.adopt_window_s,
                 )
+                if self._tracer is not None and self._tracer.enabled:
+                    self._tracer.add(
+                        f"gang:{name}", "resync-adopt",
+                        track="reconciler",
+                        attrs={"bound": len(bound), "size": size},
+                    )
                 if self.metrics is not None:
                     self.metrics.resync_adopted.inc()
             else:
@@ -268,6 +284,18 @@ class Reconciler:
                 report.rolled_back_gangs.append(name)
 
         report.duration_ms = (self.clock() - t0) * 1e3
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.add(
+                "loop:reconciler", "resync",
+                track="reconciler",
+                attrs={
+                    "rebuilt": report.rebuilt_reservations,
+                    "released": report.released_reservations,
+                    "adopted": len(report.adopted_gangs),
+                    "rolled_back": len(report.rolled_back_gangs),
+                    "ms": round(report.duration_ms, 2),
+                },
+            )
         if self.metrics is not None:
             self.metrics.resync_rebuilt.inc(report.rebuilt_reservations)
             self.metrics.reconciler_leaked.inc(report.released_reservations)
@@ -360,6 +388,24 @@ class Reconciler:
             )
             report.expired_adoptions.append(name)
 
+        if self._tracer is not None and self._tracer.enabled and (
+            report.leaked_reservations
+            or report.ghost_pods
+            or report.stranded_waits
+            or report.expired_adoptions
+        ):
+            # Only non-no-op rounds are recorded: an idle 30 s drift loop
+            # must not age real lifecycle spans out of the ring.
+            self._tracer.add(
+                "loop:reconciler", "reconcile",
+                track="reconciler",
+                attrs={
+                    "leaked": report.leaked_reservations,
+                    "ghosts": report.ghost_pods,
+                    "stranded": report.stranded_waits,
+                    "expired": len(report.expired_adoptions),
+                },
+            )
         if self.metrics is not None:
             self.metrics.reconciler_leaked.inc(report.leaked_reservations)
             self.metrics.reconciler_ghosts.inc(report.ghost_pods)

@@ -26,6 +26,7 @@ log = logging.getLogger("yoda_tpu.framework")
 
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.tracing import subject_of
 from yoda_tpu.framework.interfaces import (
     BatchFilterScorePlugin,
     BindPlugin,
@@ -187,6 +188,12 @@ class BindExecutor:
 
 class Framework:
     def __init__(self, plugins: Sequence[Plugin]) -> None:
+        # Lifecycle tracer (yoda_tpu/tracing.py), wired by
+        # standalone.build_stack: run_bind/run_unbind record spans on
+        # WHICHEVER thread executes them — inline binds on the serve
+        # thread, pipelined binds on the executor workers — so the
+        # Perfetto view shows bind I/O overlapping the next cycle's track.
+        self.tracer = None
         self.queue_sort = next(
             (p for p in plugins if isinstance(p, QueueSortPlugin)), None
         )
@@ -483,7 +490,36 @@ class Framework:
             w.reject(f"permit wait timed out for pod {w.pod.key}")
         return len(expired)
 
+    # An inline bind cheaper than this adds no information beyond its
+    # cycle span (whose wall already contains it) — recording it would be
+    # pure hot-path cost. Real API binds are milliseconds and always
+    # clear the gate; executor-side binds record regardless (their wall
+    # lives on a worker track the cycle span cannot show).
+    BIND_SPAN_MIN_S = 0.0005
+
     def run_bind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled:
+            return self._run_bind_inner(state, pod, node_name)
+        t0 = time.monotonic()
+        st = self._run_bind_inner(state, pod, node_name)
+        t1 = time.monotonic()
+        track = threading.current_thread().name
+        if (
+            t1 - t0 >= self.BIND_SPAN_MIN_S
+            or not st.success
+            or track.startswith("bind-")
+        ):
+            tracer.add(
+                subject_of(pod), "bind",
+                t0=t0, t1=t1, track=track,
+                attrs={"pod": pod.key, "node": node_name, "ok": st.success},
+            )
+        return st
+
+    def _run_bind_inner(
+        self, state: CycleState, pod: PodSpec, node_name: str
+    ) -> Status:
         for p in self.bind_plugins:
             st = p.bind(state, pod, node_name)
             if st.code != Code.SKIP:
@@ -496,6 +532,21 @@ class Framework:
         including no plugin implementing it — means the pod may be
         stranded bound; the caller logs it and the watch stream remains
         the source of truth."""
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            t0 = time.monotonic()
+            st = self._run_unbind_inner(state, pod, node_name)
+            tracer.add(
+                subject_of(pod), "unbind",
+                t0=t0, t1=time.monotonic(),
+                attrs={"pod": pod.key, "node": node_name, "ok": st.success},
+            )
+            return st
+        return self._run_unbind_inner(state, pod, node_name)
+
+    def _run_unbind_inner(
+        self, state: CycleState, pod: PodSpec, node_name: str
+    ) -> Status:
         for p in self.bind_plugins:
             unbind = getattr(p, "unbind", None)
             if unbind is not None:

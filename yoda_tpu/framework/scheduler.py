@@ -30,6 +30,7 @@ from yoda_tpu.framework.interfaces import (
 from yoda_tpu.framework.queue import QueuedPodInfo, SchedulingQueue
 from yoda_tpu.framework.runtime import Framework, WaitingPod
 from yoda_tpu.observability import PhaseTimer, SchedulingMetrics, TraceEntry
+from yoda_tpu.tracing import subject_of
 
 
 @dataclass
@@ -258,6 +259,17 @@ class Scheduler:
         snapshot = self.snapshot_fn()
         timer = PhaseTimer(self.clock)
         feasible_count = 0
+        # Pre-bound for done()'s closure: the filter section rebinds it
+        # with the real per-node verdict map; prefilter-path exits see {}.
+        statuses: dict[str, Status] = {}
+        # Lifecycle tracing (yoda_tpu/tracing.py): one "cycle" span per
+        # scheduling attempt on the pod/gang's trace, with the outcome,
+        # chosen node, and per-phase wall splits as attributes. None when
+        # tracing is off — the only cost then is this attribute read.
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        if tracer is not None and not tracer.enabled:
+            tracer = None
+        subject = subject_of(pod) if tracer is not None else None
 
         def done(
             outcome: str,
@@ -311,6 +323,56 @@ class Scheduler:
                         phases_ms=dict(timer.phases_ms),
                     )
                 )
+            if tracer is not None:
+                # timer.phases_ms is handed over as-is (the timer dies
+                # with this cycle) — building per-phase attr keys here
+                # costs more than the whole record append.
+                cycle_id = tracer.add(
+                    subject,
+                    "cycle",
+                    t0=t0,
+                    t1=now,
+                    attrs={
+                        "pod": pod.key,
+                        "outcome": outcome,
+                        "node": node or "",
+                        "message": message[:200],
+                        "phases_ms": timer.phases_ms,
+                    },
+                )
+                if outcome == "waiting":
+                    tracer.add(
+                        subject, "permit-park", parent=cycle_id,
+                        attrs={"pod": pod.key, "node": node or ""},
+                    )
+                elif outcome == "bound" and gang_name_of(pod.labels):
+                    # Gang members bound directly (the fused pass's last
+                    # member) mark the edge explicitly; singleton cycles
+                    # already say outcome=bound on the cycle span — a
+                    # second record per bind would be pure hot-path cost.
+                    tracer.add(
+                        subject, "bound", parent=cycle_id,
+                        attrs={"pod": pod.key, "node": node or ""},
+                    )
+            if self.metrics is not None:
+                # Why-pending index: every rejection verdict aggregates
+                # per pod AND per gang; a bind retires the entry.
+                gang = gang_name_of(pod.labels)
+                if outcome in ("unschedulable", "error", "nominated"):
+                    self.metrics.pending.record(
+                        pod.key,
+                        kind=outcome,
+                        message=message,
+                        gang=gang,
+                        node_reasons={
+                            n: s.message
+                            for n, s in statuses.items()
+                            if not s.success
+                        }
+                        or None,
+                    )
+                elif outcome == "bound":
+                    self.metrics.pending.resolve(pod.key, gang=gang)
             if outcome == "unschedulable":
                 if unresolvable:
                     self.queue.park_unresolvable(qpi, message)
@@ -611,6 +673,17 @@ class Scheduler:
                     self.stats.binds += 1
                 if self.metrics is not None:
                     self.metrics.binds.inc()
+                    gang = gang_name_of(pod.labels)
+                    self.metrics.pending.resolve(pod.key, gang=gang)
+                    if self.metrics.tracer.enabled:
+                        # Emitted on whichever thread settled the bind —
+                        # on the pipelined release that is a bind-executor
+                        # worker, so the span's track links the bind back
+                        # to the releasing cycle's overlapped turn.
+                        self.metrics.tracer.add(
+                            subject_of(pod), "bound",
+                            attrs={"pod": pod.key, "node": wp.node_name},
+                        )
                 if self.on_bound:
                     self.on_bound(pod, wp.node_name)
                 self._clear_stale_nomination(pod, wp.node_name)
@@ -622,6 +695,22 @@ class Scheduler:
             "permit rejected %s on %s: %s", pod.key, wp.node_name, status.message
         )
         self.framework.run_unreserve(wp.state, pod, wp.node_name)
+        if self.metrics is not None:
+            self.metrics.pending.record(
+                pod.key,
+                kind="permit-rejected",
+                message=status.message,
+                gang=gang_name_of(pod.labels),
+            )
+            if self.metrics.tracer.enabled:
+                self.metrics.tracer.add(
+                    subject_of(pod), "permit-rejected",
+                    attrs={
+                        "pod": pod.key,
+                        "node": wp.node_name,
+                        "message": status.message[:200],
+                    },
+                )
         self.queue.add_unschedulable(QueuedPodInfo(pod=pod), status.message)
         if self.on_unschedulable:
             self.on_unschedulable(pod, status.message)
@@ -759,6 +848,10 @@ class Scheduler:
             "joint pass: gathered %d gang(s) (%s) for one dispatch",
             len(ordered), ", ".join(n for n, _ in ordered),
         )
+        tracer = self.metrics.tracer if self.metrics is not None else None
+        if tracer is not None and not tracer.enabled:
+            tracer = None
+        tg0 = self.clock()
         verdicts = None
         try:
             verdicts = self.framework.prepare_joint(
@@ -771,6 +864,16 @@ class Scheduler:
                 "joint gang pre-evaluation failed; scheduling gangs "
                 "per-gang"
             )
+        if tracer is not None:
+            # The gather edge: one span per gathered gang, so each gang's
+            # trace shows the joint pass it rode (same wall window).
+            names = ",".join(n for n, _ in ordered)
+            for name, g in ordered:
+                tracer.add(
+                    f"gang:{name}", "gather",
+                    t0=tg0, t1=self.clock(),
+                    attrs={"gangs": names, "members": len(g)},
+                )
         if verdicts is None:
             return [q for _, g in ordered for q in g]
         batch: list[QueuedPodInfo] = []
@@ -785,6 +888,24 @@ class Scheduler:
                     "gang %s: does not fit the joint plan; restored "
                     "untouched (%d member(s))", name, len(g),
                 )
+                why = (
+                    f"gang {name}: joint fit gate — cannot place whole "
+                    "net of higher-priority co-queued gangs; restored "
+                    "untouched"
+                )
+                if tracer is not None:
+                    tracer.add(
+                        f"gang:{name}", "joint-park",
+                        attrs={"members": len(g), "behind": i},
+                    )
+                if self.metrics is not None:
+                    for q in g:
+                        self.metrics.pending.record(
+                            q.pod.key,
+                            kind="joint-park",
+                            message=why,
+                            gang=name,
+                        )
                 for q in g:
                     self.queue.restore(q)
             else:

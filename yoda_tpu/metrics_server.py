@@ -1,4 +1,4 @@
-"""HTTP endpoint for metrics, health, and the scheduling trace.
+"""HTTP endpoint for metrics, health, traces, and why-pending.
 
 The reference exposed /metrics and pprof only via the wrapped upstream
 command (reference pkg/register/register.go:10; SURVEY.md §5). Here the
@@ -13,16 +13,37 @@ endpoint is first-party and dependency-free (stdlib http.server):
                      complete — else 503, so the Deployment never routes
                      to a still-rebuilding standby (a standby is alive
                      and must not be restarted, hence the split)
-    GET /trace    -> last N scheduling traces, one line each
+    GET /trace    -> last N scheduling traces, one line each;
+                     ``?n=`` sizes the window (default 100),
+                     ``?format=json`` returns the structured TraceEntry
+                     dump instead of one-liners
+    GET /debug/traces -> the lifecycle span trace (yoda_tpu/tracing.py).
+                     Filters: ``?gang=NAME`` / ``?pod=ns/name`` /
+                     ``?subject=`` / ``?trace=ID``; ``?n=`` bounds the
+                     record count. ``?format=perfetto`` emits Chrome
+                     trace-event JSON loadable at ui.perfetto.dev (one
+                     track per loop/thread); the default is a structured
+                     JSON record list.
+    GET /debug/pending/<key> -> the why-pending summary for a pod key
+                     ("default/name") or gang name: aggregated rejection
+                     kinds, attempt counts, and top per-node reasons.
+                     404 (JSON body) when nothing is pending under that
+                     key. Also the backend of `yoda-tpu-scheduler
+                     explain <key>`.
 """
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.parse
+from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from yoda_tpu.observability import SchedulingMetrics
+
+PENDING_PREFIX = "/debug/pending/"
 
 
 class MetricsServer:
@@ -43,7 +64,8 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (stdlib API)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                qs = urllib.parse.parse_qs(query)
                 if path == "/metrics":
                     body = outer.metrics.registry.render_prometheus()
                     ctype = "text/plain; version=0.0.4"
@@ -62,13 +84,29 @@ class MetricsServer:
                     self.wfile.write(data)
                     return
                 elif path == "/trace":
-                    body = (
-                        "\n".join(
-                            t.oneline() for t in outer.metrics.recent_traces(100)
-                        )
-                        + "\n"
-                    )
-                    ctype = "text/plain"
+                    body, ctype = self._trace(qs)
+                elif path == "/debug/traces":
+                    body, ctype = self._debug_traces(qs)
+                elif path.startswith(PENDING_PREFIX):
+                    key = urllib.parse.unquote(path[len(PENDING_PREFIX):])
+                    info = outer.metrics.pending.explain(key)
+                    if info is None:
+                        data = json.dumps(
+                            {
+                                "key": key,
+                                "found": False,
+                                "detail": "nothing pending under this key "
+                                "(bound, never seen, or aged out)",
+                            }
+                        ).encode()
+                        self.send_response(404)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
+                    body = json.dumps({"found": True, **info}, indent=1) + "\n"
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -78,6 +116,50 @@ class MetricsServer:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _qs_int(self, qs, key, default):
+                try:
+                    return int(qs.get(key, [default])[0])
+                except (TypeError, ValueError):
+                    return default
+
+            def _trace(self, qs) -> "tuple[str, str]":
+                n = self._qs_int(qs, "n", 100)
+                entries = outer.metrics.recent_traces(n)
+                if qs.get("format", [""])[0] == "json":
+                    return (
+                        json.dumps([asdict(t) for t in entries], indent=1)
+                        + "\n",
+                        "application/json",
+                    )
+                return (
+                    "\n".join(t.oneline() for t in entries) + "\n",
+                    "text/plain",
+                )
+
+            def _debug_traces(self, qs) -> "tuple[str, str]":
+                tracer = outer.metrics.tracer
+                subject = qs.get("subject", [None])[0]
+                if subject is None and "gang" in qs:
+                    subject = f"gang:{qs['gang'][0]}"
+                if subject is None and "pod" in qs:
+                    subject = f"pod:{qs['pod'][0]}"
+                n = self._qs_int(qs, "n", -1)
+                records = tracer.records(
+                    subject=subject,
+                    trace_id=qs.get("trace", [None])[0],
+                    n=n if n >= 0 else None,
+                )
+                if qs.get("format", [""])[0] == "perfetto":
+                    return (
+                        json.dumps(tracer.to_perfetto(records)) + "\n",
+                        "application/json",
+                    )
+                return (
+                    json.dumps([r.to_dict() for r in records], indent=1)
+                    + "\n",
+                    "application/json",
+                )
 
             def log_message(self, *args) -> None:  # quiet
                 pass

@@ -183,13 +183,17 @@ class Histogram:
     def quantile(self, q: float, **labels: str) -> float:
         """Quantile over the recent-observation ring (exact for <=4096
         samples — the BASELINE p99 is computed from this, not from bucket
-        interpolation)."""
+        interpolation). The ring is COPIED under the metric lock and
+        sorted outside it: the O(n log n) sort used to run inside the
+        lock, so a scrape/quantile burst could stall every ``observe()``
+        on the serve path behind 4096-sample sorts."""
         key = tuple(sorted(labels.items()))
         with self._lock:
             s = self._series.get(key)
             if not s or not s[3]:
                 return 0.0
-            data = sorted(s[3])
+            data = list(s[3])
+        data.sort()
         return data[min(int(len(data) * q), len(data) - 1)]
 
     def render(self) -> list[str]:
@@ -261,10 +265,28 @@ class TraceEntry:
 
 
 class SchedulingMetrics:
-    """The scheduler's metric set + trace ring, shared across plugins."""
+    """The scheduler's metric set + trace ring, shared across plugins.
 
-    def __init__(self, *, registry: Registry | None = None, trace_capacity: int = 512):
+    Also carries the cross-loop observability surfaces of ISSUE 9 —
+    ``tracer`` (yoda_tpu/tracing.Tracer, the lifecycle span recorder) and
+    ``pending`` (tracing.PendingIndex, the why-pending rejection index) —
+    because this object is already threaded through every control loop
+    (scheduler, reconciler, rebalancer, federation) and shared across
+    profile stacks exactly the way traces must be."""
+
+    def __init__(
+        self,
+        *,
+        registry: Registry | None = None,
+        trace_capacity: int = 512,
+        tracer=None,
+        pending=None,
+    ):
+        from yoda_tpu.tracing import PendingIndex, Tracer
+
         self.registry = registry or Registry()
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.pending = pending if pending is not None else PendingIndex()
         r = self.registry
         self.attempts = r.counter(
             "yoda_scheduling_attempts_total",
@@ -435,6 +457,19 @@ class SchedulingMetrics:
         )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
+        # Ring-overflow accounting for BOTH bounded trace surfaces: the
+        # one-line TraceEntry ring below and the span tracer's ring. A
+        # high rate means the rings are undersized for the traffic
+        # (config trace_capacity) — entries are being evicted before an
+        # operator could read them.
+        self._trace_drops = 0
+        self.trace_dropped = r.counter(
+            "yoda_trace_dropped_total",
+            "Trace entries evicted by ring overflow (one-line trace ring "
+            "+ lifecycle span ring) before being read — raise "
+            "trace_capacity if this climbs during incidents",
+            collect_fn=lambda: self._trace_drops + self.tracer.dropped,
+        )
 
     # --- fleet gauges (lazy, fed by the informer at scrape time) ---
 
@@ -513,6 +548,8 @@ class SchedulingMetrics:
     def trace(self, entry: TraceEntry) -> None:
         entry.wall_unix = entry.wall_unix or time.time()
         with self._trace_lock:
+            if len(self._trace) == self._trace.maxlen:
+                self._trace_drops += 1
             self._trace.append(entry)
 
     def recent_traces(self, n: int = 50) -> list[TraceEntry]:

@@ -25,6 +25,7 @@ the ~0.1 ms local dispatch cost. ``"cpu"``/``"device"`` force either side.
 from __future__ import annotations
 
 import logging
+import time
 from collections import Counter
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
@@ -440,6 +441,12 @@ class YodaBatch(BatchFilterScorePlugin):
         # (snapshot.version, fleet has PreferNoSchedule taints) — lets the
         # soft-score loop be skipped entirely on taint-free fleets.
         self._soft_taints: tuple[int, bool] = (0, False)
+        # Lifecycle tracer (yoda_tpu/tracing.py), wired by build_stack:
+        # gang-fused / joint kernel dispatches record a span on each
+        # gathered gang's trace — the "which loop spent the p99 budget"
+        # half of the observability story lands the dispatch wall time on
+        # the gang's own timeline.
+        self.tracer = None
         if mesh_devices:
             # Eager: an infeasible mesh (more devices than exist) must fail
             # at construction, not mid-scheduling-cycle. The mesh is fixed
@@ -1393,12 +1400,27 @@ class YodaBatch(BatchFilterScorePlugin):
                 self.batch_requests,
             )
 
+        td0 = time.monotonic()
         grouped = self._dispatch(static, run_joint)
         self.dispatch_count += 1
         if len(eligible) >= 2:
             self.joint_dispatches += 1
         else:
             self.gang_burst_dispatches += 1
+        if self.tracer is not None and self.tracer.enabled:
+            td1 = time.monotonic()
+            kind = "joint-dispatch" if len(eligible) >= 2 else "gang-dispatch"
+            rows = sum(len(cands[i]) for i in eligible)
+            for i in eligible:
+                self.tracer.add(
+                    f"gang:{gang_names[i]}", kind,
+                    t0=td0, t1=td1,
+                    attrs={
+                        "gangs": ",".join(gang_names),
+                        "rows": rows,
+                        "fit_gate": fit_gate,
+                    },
+                )
         fleet_version = self._fleet_version(snapshot)
         base_reserved = np.asarray(dyn[1]).copy()
         index = {nm: i for i, nm in enumerate(static.names)}

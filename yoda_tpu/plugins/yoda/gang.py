@@ -184,6 +184,14 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # after the binder's retries and the release cohort was rolled
         # back) — feeds yoda_recovery_gang_rollbacks_total.
         self.bind_rollbacks = 0
+        # Observability surfaces (ISSUE 9), wired by build_stack: the
+        # lifecycle tracer (gang-release / gang-rollback events on the
+        # gang's trace) and the why-pending index (topology admission
+        # parks record the REAL per-node reason — infeasible host vs
+        # feasible-but-no-contiguous-block — so `yoda explain <gang>`
+        # answers "why is this gang parked" with node-level evidence).
+        self.tracer = None
+        self.pending = None
         self._lock = threading.RLock()
         self._gangs: dict[str, _GangState] = {}
         self._framework = None
@@ -522,12 +530,53 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 set(gs.plan) - set(pinned) if gs.plan else set()
             )
         if not plan_hosts_free:
-            return Status.unschedulable(
+            msg = (
                 f"gang {gs.spec.name}: no slice has a free contiguous "
                 f"{'x'.join(map(str, gs.spec.topology))} host block"
             )
+            self._note_topology_park(
+                snapshot, gs, req, pod, pending_res, assigned_hosts, msg
+            )
+            return Status.unschedulable(msg)
         state.write(ALLOWED_HOSTS_KEY, _AllowedHosts(frozenset(plan_hosts_free)))
         return Status.ok()
+
+    def _note_topology_park(
+        self, snapshot, gs: _GangState, req, pod, pending_res,
+        assigned_hosts: set, msg: str,
+    ) -> None:
+        """Why-pending evidence for a topology admission park: classify
+        every node — member-infeasible (admission/resources/chips) vs
+        feasible-but-outside-any-free-contiguous-block — so the operator
+        sees WHICH hosts block the block, not just "no block". Only runs
+        when the index is wired and only on the park path (never on the
+        admit path), so the serve loop pays nothing in the steady state."""
+        if self.pending is None:
+            return
+        shape = "x".join(map(str, gs.spec.topology))
+        reasons: dict[str, str] = {}
+        for ni in snapshot.infos():
+            if ni.tpu is None:
+                reasons[ni.name] = f"node {ni.name} has no TPU metrics"
+            elif not self._host_fits_member(
+                ni, req, assigned_hosts, pod, pending_res
+            ):
+                reasons[ni.name] = (
+                    f"node {ni.name} cannot take a gang member "
+                    "(admission/resources/free chips)"
+                )
+            else:
+                reasons[ni.name] = (
+                    f"node {ni.name} is feasible but no free contiguous "
+                    f"{shape} block contains it"
+                )
+        self.pending.record(
+            pod.key,
+            kind="admission-park",
+            message=msg,
+            gang=gs.spec.name,
+            node_reasons=reasons,
+        )
 
     # --- Filter: pin topology-gang members to planned hosts ---
 
@@ -602,6 +651,11 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 "gang %s complete: releasing %d waiting member(s)",
                 gang_name, len(targets),
             )
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.add(
+                    f"gang:{gang_name}", "gang-release",
+                    attrs={"members": len(targets)},
+                )
         waiters = [
             w
             for key in targets
@@ -813,6 +867,15 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             "once the release settles, cascading %d waiting member(s)",
             gang_name, wp.pod.key, len(rollbacks), len(targets),
         )
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.add(
+                f"gang:{gang_name}", "gang-rollback",
+                attrs={
+                    "trigger": wp.pod.key,
+                    "landed": len(rollbacks),
+                    "cascaded": len(targets),
+                },
+            )
         if self.on_rollback is not None:
             self.on_rollback(wp.pod, gang_name, why)
             for spec, _host in rollbacks:

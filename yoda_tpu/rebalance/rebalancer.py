@@ -545,6 +545,29 @@ class Rebalancer:
                 report.preempted.append(pod.key)
                 weight += priority_weight(pod)
         report.preempted_weight += weight
+        tr = self._tracer()
+        if tr is not None:
+            from yoda_tpu.tracing import subject_of
+
+            tr.add(
+                f"gang:{for_gang}", "preempt-admit",
+                track="rebalancer",
+                attrs={
+                    "victims": sum(len(u.members) for u in chosen),
+                    "weight": weight,
+                },
+            )
+            for unit in chosen:
+                for pod, host in unit.members:
+                    tr.add(
+                        subject_of(pod), "preempted",
+                        track="rebalancer",
+                        attrs={
+                            "for_gang": for_gang,
+                            "host": host,
+                            "unit": unit.kind,
+                        },
+                    )
         if self.metrics is not None:
             self.metrics.rebalance_preemptions.inc(
                 sum(len(u.members) for u in chosen)
@@ -654,6 +677,10 @@ class Rebalancer:
                 for host in plan:
                     occ.occupy(host, chips)
 
+    def _tracer(self):
+        tr = getattr(self.metrics, "tracer", None)
+        return tr if tr is not None and tr.enabled else None
+
     def _execute_move(
         self, name: str, spec, members, plan, report: RebalanceReport
     ) -> bool:
@@ -661,10 +688,30 @@ class Rebalancer:
         -> install plan -> readd. Any member left bound (unbind refused,
         fence flipped) aborts the plan install — the unbound members
         requeue and the gang replans around the stragglers through the
-        normal admission path, never split, never oversubscribed."""
+        normal admission path, never split, never oversubscribed.
+
+        Traced as one ``rebalance-move`` span on the gang's lifecycle
+        trace with a child event per step, so a Perfetto view of the gang
+        shows the move sitting between its two bound epochs and WHICH
+        step aborted when one does."""
+        tr = self._tracer()
+        subj = f"gang:{name}"
+        move_id = tr.new_span_id() if tr is not None else None
+        t0 = time.monotonic()
+
+        def step(step_name: str, **attrs) -> None:
+            if tr is not None:
+                tr.add(
+                    subj, step_name, parent=move_id, track="rebalancer",
+                    attrs=attrs,
+                )
+
+        aborted = ""
         qpis = self.queue.take_gang(name)
+        step("move-take", members=len(qpis))
         try:
             if self.scheduler._fenced():
+                aborted = "fenced"
                 report.aborted_moves.append(name)
                 if self.metrics is not None:
                     self.metrics.rebalance_aborted.inc()
@@ -673,6 +720,7 @@ class Rebalancer:
             for pod, _host in members:
                 self.gang.drop_membership(pod)
             self._unbind_all(list(members), why)
+            step("move-unbind", members=len(members))
             stranded = []
             for pod, _host in members:
                 try:
@@ -687,11 +735,13 @@ class Rebalancer:
                     "could not be unbound (%s); gang will replan normally",
                     name, len(stranded), stranded[:3],
                 )
+                aborted = f"stranded:{len(stranded)}"
                 report.aborted_moves.append(name)
                 if self.metrics is not None:
                     self.metrics.rebalance_aborted.inc()
                 return False
             self.gang.install_plan(name, spec, plan)
+            step("move-install-plan", hosts=",".join(sorted(plan)))
             report.moves.append(name)
             if self.metrics is not None:
                 self.metrics.rebalance_moves.inc()
@@ -704,3 +754,15 @@ class Rebalancer:
             for q in qpis:
                 self.queue.readd(q)
             self.queue.move_all_to_active()
+            step("move-readd", members=len(qpis))
+            if tr is not None:
+                tr.add(
+                    subj, "rebalance-move",
+                    t0=t0, t1=time.monotonic(),
+                    span_id=move_id, track="rebalancer",
+                    attrs={
+                        "from": ",".join(sorted({h for _, h in members})),
+                        "to": ",".join(sorted(plan)),
+                        "aborted": aborted,
+                    },
+                )

@@ -73,10 +73,21 @@ def build_stack(
         accountant = ChipAccountant(scheduler_name=config.scheduler_name)
     # A provided metrics registry is SHARED across profile stacks (one
     # /metrics endpoint aggregates every profile — series would otherwise
-    # be created per stack and silently unreachable).
+    # be created per stack and silently unreachable). The lifecycle
+    # tracer and why-pending index ride on it for the same reason: one
+    # gang's trace must stay one trace across profiles and cluster
+    # fronts.
     own_metrics = metrics is None
     if own_metrics:
-        metrics = SchedulingMetrics()
+        from yoda_tpu.tracing import Tracer
+
+        metrics = SchedulingMetrics(
+            tracer=Tracer(
+                sample_rate=config.trace_sample_rate,
+                capacity=config.trace_capacity,
+                sink=config.trace_sink or None,
+            )
+        )
     # Scheduling Events (kubectl describe pod): the reference got these from
     # the upstream scheduler's recorder; here the loop emits its own.
     recorder = (
@@ -176,6 +187,14 @@ def build_stack(
     plugins.append(binder)
     framework = Framework(plugins)
     gang.attach_framework(framework)
+    # Lifecycle tracing + why-pending (ISSUE 9): every hook that emits
+    # spans or rejection verdicts reads the SHARED tracer/index off the
+    # metrics object — bind/unbind spans land on whichever thread runs
+    # them (executor workers included), gang releases/rollbacks and
+    # topology admission parks annotate the gang's own trace.
+    framework.tracer = metrics.tracer
+    gang.tracer = metrics.tracer
+    gang.pending = metrics.pending
     queue = SchedulingQueue(
         framework.queue_sort,
         clock=clock,
@@ -270,9 +289,21 @@ def build_stack(
         ):
             queue.move_all_to_active()
 
+    # Enqueue edge of the lifecycle trace: the pod's (or its gang's)
+    # trace ROOT — everything later (gather, dispatch, cycles, binds,
+    # moves) parents back to it.
+    tracer = metrics.tracer
+
+    def on_pod_pending(pod) -> None:
+        if tracer.enabled:
+            from yoda_tpu.tracing import subject_of
+
+            tracer.add(subject_of(pod), "enqueue", attrs={"pod": pod.key})
+        queue.add(pod)
+
     informer = InformerCache(
         scheduler_name=config.scheduler_name,
-        on_pod_pending=queue.add,
+        on_pod_pending=on_pod_pending,
         on_change=on_change,
         # In-process backends with a PVC surface (FakeCluster.put_pvc)
         # always enforce the minimal volume filter. KubeCluster upgrades
@@ -312,6 +343,7 @@ def build_stack(
 
     batches = [p for p in framework.batch_plugins if isinstance(p, YodaBatch)]
     for p in batches:
+        p.tracer = metrics.tracer
         if p.claimed_fn is None:
             p.claimed_fn = informer.claimed_hbm_mib
             p.claimed_map_fn = informer.claimed_hbm_mib_map
