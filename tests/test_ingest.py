@@ -1,0 +1,464 @@
+"""Batched watch-event ingestion (cluster/ingest.py + InformerCache.
+handle_batch, ISSUE 10).
+
+The contract under test, same discipline as test_resident.py's churn
+parity suite: a randomized event stream applied per-event and applied as
+coalesced batches must produce IDENTICAL end state — informer stores,
+snapshot content, claimed-HBM totals, accountant reservations, and
+(effective) queue membership. Only what coalescing is ALLOWED to change
+differs: intermediate observations and the version/epoch counter values
+(one bump per batch instead of per event). Plus the coalescing rule
+units (modify-after-add, delete-supersedes, cross-kind ordering) and the
+EventBatcher's buffering/flush behavior.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from yoda_tpu.api.types import K8sNode, PodSpec, make_node
+from yoda_tpu.cluster import Event, InformerCache
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.cluster.ingest import EventBatcher, coalesce
+from yoda_tpu.framework.queue import SchedulingQueue
+from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+
+MIB = 1 << 20
+
+
+def _pod(name, uid, *, node=None, chips="1", ns="default"):
+    return PodSpec(
+        name,
+        namespace=ns,
+        uid=uid,
+        node_name=node,
+        labels={"tpu/chips": chips},
+    )
+
+
+class TestCoalesce:
+    def test_modify_after_add_stays_added_with_latest_object(self):
+        a = _pod("p", "u1")
+        b = _pod("p", "u1", node="n0")
+        out = coalesce(
+            [Event("added", "Pod", a), Event("modified", "Pod", b)]
+        )
+        assert len(out) == 1
+        assert out[0].type == "added"  # the consumer never saw the add
+        assert out[0].obj is b  # last write wins
+
+    def test_modify_after_modify_last_write_wins(self):
+        a = _pod("p", "u1", node="n0")
+        b = _pod("p", "u1", node="n1")
+        out = coalesce(
+            [Event("modified", "Pod", a), Event("modified", "Pod", b)]
+        )
+        assert len(out) == 1
+        assert out[0].type == "modified" and out[0].obj is b
+
+    def test_delete_supersedes_modify(self):
+        a = _pod("p", "u1", node="n0")
+        out = coalesce(
+            [Event("modified", "Pod", a), Event("deleted", "Pod", a)]
+        )
+        assert len(out) == 1 and out[0].type == "deleted"
+
+    def test_add_then_delete_is_net_zero(self):
+        a = _pod("p", "u1")
+        out = coalesce(
+            [Event("added", "Pod", a), Event("deleted", "Pod", a)]
+        )
+        assert out == []
+
+    def test_distinct_uids_never_merge(self):
+        # A deleted-and-recreated pod has a fresh uid: the delete of the
+        # old incarnation and the add of the new both survive, in order.
+        old = _pod("p", "u1")
+        new = _pod("p", "u2")
+        out = coalesce(
+            [Event("deleted", "Pod", old), Event("added", "Pod", new)]
+        )
+        assert [(e.type, e.obj.uid) for e in out] == [
+            ("deleted", "u1"),
+            ("added", "u2"),
+        ]
+
+    def test_cross_kind_order_of_first_appearance_preserved(self):
+        node = K8sNode("n0")
+        tpu = make_node("n0", now=0.0)
+        pod = _pod("p", "u1", node="n0")
+        out = coalesce(
+            [
+                Event("added", "Node", node),
+                Event("added", "TpuNodeMetrics", tpu),
+                Event("added", "Pod", pod),
+                Event("modified", "TpuNodeMetrics", make_node("n0", now=1.0)),
+            ]
+        )
+        # The TPU modify folded into its add, which keeps its slot
+        # BEFORE the pod bound to the node (causal order).
+        assert [(e.type, e.kind) for e in out] == [
+            ("added", "Node"),
+            ("added", "TpuNodeMetrics"),
+            ("added", "Pod"),
+        ]
+
+    def test_synced_sentinels_are_barriers(self):
+        out = coalesce(
+            [
+                Event("synced", "PersistentVolumeClaim", None),
+                Event("synced", "PersistentVolumeClaim", None),
+            ]
+        )
+        assert len(out) == 2  # never merged, never dropped
+
+
+class TestEventBatcher:
+    def test_batch_max_triggers_flush(self):
+        batches = []
+        b = EventBatcher(batches.append, batch_max=3, window_s=60.0)
+        for i in range(7):
+            b.offer(Event("added", "Pod", _pod(f"p{i}", f"u{i}")))
+        assert len(batches) == 2 and all(len(x) == 3 for x in batches)
+        b.flush()
+        assert len(batches) == 3 and len(batches[2]) == 1
+        assert b.events_in == 7 and b.events_out == 7
+        b.stop()
+
+    def test_zero_window_flushes_per_event(self):
+        batches = []
+        b = EventBatcher(batches.append, batch_max=100, window_s=0.0)
+        b.offer(Event("added", "Pod", _pod("p", "u1")))
+        b.offer(Event("modified", "Pod", _pod("p", "u1", node="n0")))
+        assert [len(x) for x in batches] == [1, 1]
+
+    def test_window_thread_drains(self):
+        applied = threading.Event()
+        b = EventBatcher(
+            lambda evs: applied.set(), batch_max=1000, window_s=0.02
+        )
+        b.offer(Event("added", "Pod", _pod("p", "u1")))
+        assert applied.wait(2.0)
+        b.stop()
+
+    def test_coalesces_across_buffer(self):
+        batches = []
+        b = EventBatcher(batches.append, batch_max=100, window_s=60.0)
+        b.offer(Event("added", "Pod", _pod("p", "u1")))
+        b.offer(Event("modified", "Pod", _pod("p", "u1", node="n0")))
+        b.offer(Event("added", "Pod", _pod("q", "u2")))
+        b.offer(Event("deleted", "Pod", _pod("q", "u2")))
+        b.flush()
+        assert len(batches) == 1
+        (batch,) = batches
+        assert [(e.type, e.obj.uid) for e in batch] == [("added", "u1")]
+        assert b.events_in == 4 and b.events_out == 1
+        b.stop()
+
+
+class _World:
+    """informer + queue + accountant wired the way standalone.build_stack
+    wires them (delete fast path + one reactivation decision per batch),
+    minus the scheduling framework — the ingest path under test."""
+
+    def __init__(self):
+        self.queue = SchedulingQueue(clock=lambda: 0.0)
+        self.accountant = ChipAccountant()
+
+        def on_change_batch(events):
+            for e in events:
+                if e.kind == "Pod" and e.type == "deleted":
+                    self.queue.remove(e.obj.uid)
+            if any(
+                e.kind in ("TpuNodeMetrics", "Node") or e.type == "deleted"
+                for e in events
+            ) and self.queue.has_parked():
+                self.queue.move_all_to_active()
+
+        self.informer = InformerCache(
+            on_pod_pending=self.queue.add,
+            on_change_batch=on_change_batch,
+        )
+
+    def apply_per_event(self, events):
+        for e in events:
+            self.accountant.handle(e)
+            self.informer.handle(e)
+
+    def apply_batched(self, events):
+        batch = coalesce(events)
+        for e in batch:
+            self.accountant.handle(e)
+        self.informer.handle_batch(batch)
+
+    def fingerprint(self):
+        inf = self.informer
+        snap = inf.snapshot()
+        nodes = {}
+        for ni in snap.infos():
+            nodes[ni.name] = (
+                ni.tpu.last_updated_unix,
+                tuple(c.hbm_free for c in ni.tpu.chips),
+                tuple(sorted(p.uid for p in ni.pods)),
+                ni.node is not None,
+            )
+        # Queue membership filtered through pod_schedulable: coalescing
+        # legitimately never enqueues a pod that was added AND bound (or
+        # deleted) inside one window — per-event application leaves a
+        # stale entry the scheduler would drop at its pop's alive-check,
+        # so the EFFECTIVE content is what must match.
+        def pool_uids(qpis):
+            return frozenset(
+                q.pod.uid for q in qpis if inf.pod_schedulable(q.pod)
+            )
+
+        q = self.queue
+        with q._lock:
+            active = [it.qpi for h in q._active.values() for it in h]
+            backoff = [e[2] for e in q._backoff]
+            parked = list(q._unschedulable.values())
+        return {
+            "nodes": nodes,
+            "live": frozenset(inf.live_uid_set()),
+            "claimed": {
+                k: v for k, v in inf.claimed_hbm_mib_map().items() if v
+            },
+            "reserved": {
+                k: v for k, v in self.accountant.chips_by_node().items() if v
+            },
+            "q_active": pool_uids(active),
+            "q_backoff": pool_uids(backoff),
+            "q_parked": pool_uids(parked),
+        }
+
+
+def _stream(seed: int, n: int) -> list[Event]:
+    """Seeded randomized event stream: TPU adds/value-modifies/heartbeats/
+    deletes, Node add/delete, pod add (pending), bind-modify, delete.
+    Modify values come off a monotonic counter so an exact A->B->A revert
+    cannot happen inside one window (coalescing would legitimately hide
+    it and the reactivation decision could differ)."""
+    rng = random.Random(seed)
+    events: list[Event] = []
+    tpus: dict[str, int] = {}  # name -> last value counter
+    pods: dict[str, PodSpec] = {}  # uid -> last spec
+    ctr = 0
+    next_node = 0
+    next_pod = 0
+    for _ in range(n):
+        op = rng.choice(
+            ["tpu_add", "tpu_mod", "tpu_mod", "tpu_hb", "tpu_del",
+             "node", "pod_add", "pod_add", "pod_bind", "pod_del"]
+        )
+        if op == "tpu_add" or (op in ("tpu_mod", "tpu_hb", "tpu_del") and not tpus):
+            name = f"n{next_node:03d}"
+            next_node += 1
+            ctr += 1
+            tpus[name] = ctr
+            events.append(
+                Event(
+                    "added", "TpuNodeMetrics",
+                    make_node(
+                        name, chips=4,
+                        hbm_free_per_chip=((ctr % 4096) + 1) * MIB,
+                        now=0.0,
+                    ),
+                )
+            )
+        elif op == "tpu_mod":
+            name = rng.choice(sorted(tpus))
+            ctr += 1
+            tpus[name] = ctr
+            events.append(
+                Event(
+                    "modified", "TpuNodeMetrics",
+                    make_node(
+                        name, chips=4,
+                        hbm_free_per_chip=((ctr % 4096) + 1) * MIB,
+                        now=0.0,
+                    ),
+                )
+            )
+        elif op == "tpu_hb":
+            # Value-identical republish: must NOT reactivate or bump the
+            # metrics epoch in either mode.
+            name = rng.choice(sorted(tpus))
+            events.append(
+                Event(
+                    "modified", "TpuNodeMetrics",
+                    make_node(
+                        name, chips=4,
+                        hbm_free_per_chip=((tpus[name] % 4096) + 1) * MIB,
+                        now=1.0,
+                    ),
+                )
+            )
+        elif op == "tpu_del":
+            name = rng.choice(sorted(tpus))
+            del tpus[name]
+            events.append(
+                Event(
+                    "deleted", "TpuNodeMetrics",
+                    make_node(name, chips=4, now=0.0),
+                )
+            )
+        elif op == "node":
+            events.append(
+                Event(
+                    rng.choice(["added", "deleted"]), "Node",
+                    K8sNode(f"n{rng.randrange(max(next_node, 1)):03d}"),
+                )
+            )
+        elif op == "pod_add":
+            uid = f"u{next_pod}"
+            next_pod += 1
+            pod = _pod(f"p{uid}", uid)
+            pods[uid] = pod
+            events.append(Event("added", "Pod", pod))
+        elif op == "pod_bind" and pods:
+            uid = rng.choice(sorted(pods))
+            node = f"n{rng.randrange(max(next_node, 1)):03d}"
+            pod = _pod(f"p{uid}", uid, node=node)
+            pods[uid] = pod
+            events.append(Event("modified", "Pod", pod))
+        elif op == "pod_del" and pods:
+            uid = rng.choice(sorted(pods))
+            pod = pods.pop(uid)
+            events.append(Event("deleted", "Pod", pod))
+    return events
+
+
+class TestIngestParity:
+    def test_randomized_stream_parity(self):
+        for seed in (7, 41, 1234):
+            events = _stream(seed, 400)
+            per_event = _World()
+            batched = _World()
+            rng = random.Random(seed ^ 0xFF)
+            i = 0
+            while i < len(events):
+                chunk = events[i : i + rng.randint(1, 64)]
+                i += len(chunk)
+                per_event.apply_per_event(chunk)
+                batched.apply_batched(chunk)
+                got, want = batched.fingerprint(), per_event.fingerprint()
+                assert got == want, f"seed {seed} diverged at event {i}"
+
+    def test_single_event_batch_is_per_event(self):
+        # handle() wraps handle_batch of one: byte-for-byte the same
+        # state including the version counters.
+        events = _stream(99, 200)
+        a, b = _World(), _World()
+        for e in events:
+            a.apply_per_event([e])
+            b.informer.handle_batch([e])
+            b.accountant.handle(e)
+        assert a.fingerprint() == b.fingerprint()
+        assert a.informer.version == b.informer.version
+        assert a.informer.metrics_version == b.informer.metrics_version
+
+    def test_one_epoch_bump_and_full_delta_per_batch(self):
+        inf = InformerCache()
+        inf.handle_batch(
+            [
+                Event("added", "TpuNodeMetrics", make_node("a", now=0.0)),
+                Event("added", "TpuNodeMetrics", make_node("b", now=0.0)),
+                Event("added", "TpuNodeMetrics", make_node("c", now=0.0)),
+            ]
+        )
+        assert inf.metrics_version == 2  # one bump for the whole batch
+        before = inf.metrics_version
+        inf.handle_batch(
+            [
+                Event(
+                    "modified", "TpuNodeMetrics",
+                    make_node("a", hbm_free_per_chip=1 * MIB, now=0.0),
+                ),
+                Event(
+                    "modified", "TpuNodeMetrics",
+                    make_node("b", hbm_free_per_chip=2 * MIB, now=0.0),
+                ),
+            ]
+        )
+        assert inf.metrics_version == before + 1
+        delta = inf.changes_since(before)
+        assert delta is not None and not delta.structural
+        assert delta.changed == frozenset({"a", "b"})
+
+    def test_batched_reactivation_is_one_sweep(self):
+        """The tentpole's reactivation amortization: N qualifying events
+        in one batch trigger ONE move_all_to_active, and a batch with
+        nothing parked triggers none (the quick-fix skip)."""
+        sweeps = []
+        w = _World()
+        orig = w.queue.move_all_to_active
+        w.queue.move_all_to_active = lambda **kw: (
+            sweeps.append(1), orig(**kw)
+        )[1]
+        # Nothing parked: qualifying events skip the sweep entirely.
+        w.apply_batched(
+            [Event("added", "TpuNodeMetrics", make_node("x", now=0.0))]
+        )
+        assert sweeps == []
+        # Park something, then apply a 10-event qualifying batch.
+        from yoda_tpu.framework.queue import QueuedPodInfo
+
+        w.queue.add_unschedulable(QueuedPodInfo(pod=_pod("p", "u1")), "no fit")
+        ctr = [0]
+
+        def ev():
+            ctr[0] += 1
+            return Event(
+                "modified", "TpuNodeMetrics",
+                make_node("x", hbm_free_per_chip=ctr[0] * MIB, now=0.0),
+            )
+
+        w.apply_batched([ev() for _ in range(10)])
+        assert sweeps == [1]
+
+
+class TestClusterListPlumbing:
+    def test_fake_replay_delivers_one_batch(self):
+        cluster = FakeCluster()
+        cluster.put_tpu_metrics(make_node("a", now=0.0))
+        cluster.put_tpu_metrics(make_node("b", now=0.0))
+        cluster.create_pod(_pod("p", "u1"))
+        batches = []
+        cluster.add_watcher(
+            lambda e: batches.append([e]), batch_fn=batches.append
+        )
+        assert len(batches) == 1 and len(batches[0]) == 3
+
+    def test_fake_replay_per_event_without_batch_fn(self):
+        cluster = FakeCluster()
+        cluster.put_tpu_metrics(make_node("a", now=0.0))
+        seen = []
+        cluster.add_watcher(seen.append)
+        assert len(seen) == 1
+
+    def test_build_stack_with_batching_schedules(self):
+        """End to end through a real stack: batching on, events buffered
+        by the window, flushed, pod binds — identical outcome to the
+        per-event stack."""
+        from yoda_tpu.agent import FakeTpuAgent
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(
+            config=SchedulerConfig(
+                ingest_batch_window_ms=5.0, ingest_batch_max=128
+            )
+        )
+        assert stack.ingestor is not None
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "2"}))
+        stack.ingestor.flush()
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.ingestor.flush()  # the bind's own watch event
+        assert stack.cluster.get_pod("default/p").node_name == "host"
+        assert stack.metrics.ingest_events.value() > 0
+        assert stack.metrics.ingest_batch.count() > 0
+        stack.ingestor.stop()

@@ -42,6 +42,18 @@ class TestSchedulerManifest:
         assert cfg.mode in ("batch", "loop")
         assert cfg.gang_permit_timeout_s > 0
 
+    def test_configmap_ships_ingest_and_tenancy_knobs(self):
+        # ISSUE 10: the deploy config turns batched ingest and tenant
+        # fairness on (quotas default unlimited), and the knobs VALIDATE
+        # — a drifted ConfigMap would crash-loop the Deployment.
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(yaml.safe_load(cm["data"]["config.yaml"]))
+        assert cfg.ingest_batch_window_ms > 0
+        assert cfg.ingest_batch_max >= 1
+        assert cfg.tenant_fairness is True
+        assert cfg.tenant_quota_chips == 0
+        assert cfg.tenant_quota_hbm_gib == 0
+
     def test_deployment_mounts_config_and_probes_healthz(self):
         (dep,) = by_kind(self.docs, "Deployment")
         spec = dep["spec"]["template"]["spec"]

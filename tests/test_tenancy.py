@@ -1,0 +1,366 @@
+"""Per-tenant DRF fair queuing + quota admission (framework/tenancy.py +
+the tenant-aware SchedulingQueue, ISSUE 10).
+
+Invariants under test: zero starvation under a flooding tenant (the
+fairness acceptance), dominant-resource-share ordering across
+heterogeneous chip/HBM asks, quota parks retiring when capacity frees,
+gang atomicity within a tenant unchanged, and fairness-off reproducing
+the classic tenant-blind queue bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.requests import gang_name_of
+from yoda_tpu.api.types import PodSpec, make_node
+from yoda_tpu.cluster import Event
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework.queue import QueuedPodInfo, SchedulingQueue
+from yoda_tpu.framework.tenancy import TenantLedger, tenant_of
+from yoda_tpu.standalone import build_stack
+
+GIB = 1 << 30
+
+
+def _pod(name, ns="default", labels=None, uid=""):
+    return PodSpec(name, namespace=ns, uid=uid, labels=dict(labels or {}))
+
+
+def _stack(**cfg):
+    stack = build_stack(config=SchedulerConfig(**cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+class TestTenantOf:
+    def test_namespace_default_and_label_override(self):
+        assert tenant_of(_pod("p", ns="team-a")) == "team-a"
+        assert (
+            tenant_of(_pod("p", ns="team-a", labels={"tpu/tenant": "big"}))
+            == "big"
+        )
+
+
+class TestTenantLedger:
+    def _capacity(self, ledger, nodes=2, chips=4):
+        for i in range(nodes):
+            ledger.handle(
+                Event(
+                    "added", "TpuNodeMetrics",
+                    make_node(f"n{i}", chips=chips, now=0.0),
+                )
+            )
+
+    def test_capacity_from_tpu_events(self):
+        led = TenantLedger()
+        self._capacity(led)  # 2 nodes x 4 chips x 16 GiB/chip
+        chips, hbm_mib = led.capacity()
+        assert chips == 8
+        assert hbm_mib == 8 * 16 * 1024
+
+    def test_dominant_share_heterogeneous_asks(self):
+        """DRF: a tenant's share is its MAX resource fraction — a small
+        chip ask with a huge HBM ask outranks a chip-heavy tenant."""
+        led = TenantLedger()
+        self._capacity(led)
+        # A: 4 chips, no HBM ask -> chip share 0.5 dominates.
+        led.handle(
+            Event(
+                "modified", "Pod",
+                _pod("a", ns="team-a", uid="ua", labels={"tpu/chips": "4"}),
+            )
+        )
+        # Bound pods only: the event must carry node_name to charge.
+        led.release("ua")
+        pa = _pod("a", ns="team-a", uid="ua", labels={"tpu/chips": "4"})
+        pa.node_name = "n0"
+        led.handle(Event("modified", "Pod", pa))
+        # B: 1 chip but 96 GiB of HBM -> HBM share 0.75 dominates.
+        pb = _pod(
+            "b", ns="team-b", uid="ub",
+            labels={"tpu/chips": "1", "tpu/hbm": "96Gi"},
+        )
+        pb.node_name = "n1"
+        led.handle(Event("modified", "Pod", pb))
+        assert led.dominant_share("team-a") == pytest.approx(0.5)
+        assert led.dominant_share("team-b") == pytest.approx(0.75)
+        assert led.dominant_share("team-c") == 0.0
+
+    def test_charge_idempotent_and_release_on_delete_or_unbind(self):
+        led = TenantLedger()
+        self._capacity(led)
+        p = _pod("a", ns="t", uid="u1", labels={"tpu/chips": "2"})
+        p.node_name = "n0"
+        led.handle(Event("added", "Pod", p))
+        led.handle(Event("modified", "Pod", p))  # replay: single charge
+        assert led.usage("t") == (2, 0)
+        unbound = _pod("a", ns="t", uid="u1", labels={"tpu/chips": "2"})
+        led.handle(Event("modified", "Pod", unbound))  # rollback unbind
+        assert led.usage("t") == (0, 0)
+        led.handle(Event("modified", "Pod", p))
+        led.handle(Event("deleted", "Pod", p))
+        assert led.usage("t") == (0, 0)
+
+    def test_quota_verdict(self):
+        led = TenantLedger()
+        self._capacity(led)
+        p = _pod("a", ns="t", uid="u1", labels={"tpu/chips": "2"})
+        p.node_name = "n0"
+        led.handle(Event("modified", "Pod", p))
+        ask = _pod("b", ns="t", uid="u2", labels={"tpu/chips": "2"})
+        assert led.quota_verdict("t", ask, chips_cap=4) is None
+        why = led.quota_verdict("t", ask, chips_cap=3)
+        assert why is not None and "chip quota" in why
+
+
+class TestQueueFairness:
+    def _queue(self, shares, quota=None, parks=None):
+        return SchedulingQueue(
+            tenant_of=lambda p: p.namespace,
+            share_fn=lambda t: shares.get(t, 0.0),
+            quota_fn=quota,
+            on_quota_park=(
+                (lambda qpi, why: parks.append((qpi.pod.key, why)))
+                if parks is not None
+                else None
+            ),
+        )
+
+    def test_pop_draws_lowest_share_tenant_first(self):
+        shares = {"hog": 0.6, "light": 0.1}
+        q = self._queue(shares)
+        for i in range(3):
+            q.add(_pod(f"h{i}", ns="hog"))
+        q.add(_pod("l0", ns="light"))
+        assert q.pop(timeout=0).pod.namespace == "light"
+        assert q.pop(timeout=0).pod.namespace == "hog"
+        # Shares are read live: the hog draining below light's share
+        # flips the order back.
+        shares["hog"] = 0.0
+        shares["light"] = 0.9
+        q.add(_pod("l1", ns="light"))
+        assert q.pop(timeout=0).pod.namespace == "hog"
+
+    def test_pop_matching_orders_tenants_by_share(self):
+        shares = {"a": 0.5, "b": 0.0}
+        q = self._queue(shares)
+        q.add(_pod("a0", ns="a", labels={"tpu/gang": "ga", "tpu/gang-size": "1"}))
+        q.add(_pod("b0", ns="b", labels={"tpu/gang": "gb", "tpu/gang-size": "1"}))
+        taken = q.pop_matching(lambda p: gang_name_of(p.labels) is not None)
+        assert [t.pod.namespace for t in taken] == ["b", "a"]
+
+    def test_quota_park_and_retire_on_event(self):
+        parks = []
+        over = {"t": "tenant t over chip quota"}
+        q = self._queue(
+            {}, quota=lambda tenant, pod: over.get(tenant), parks=parks
+        )
+        q.add(_pod("p", ns="t"))
+        assert q.pop(timeout=0) is None  # parked, not returned
+        assert parks == [("t/p", "tenant t over chip quota")]
+        assert q.depths() == (0, 0, 1)
+        # Capacity freed: the quota verdict clears, the event re-admits.
+        over.clear()
+        q.move_all_to_active()
+        got = q.pop(timeout=0)
+        assert got is not None and got.pod.key == "t/p"
+        assert q.quota_parks == 1
+
+    def test_quota_parks_whole_gang_in_one_gather(self):
+        parks = []
+        q = self._queue(
+            {}, quota=lambda tenant, pod: "over quota", parks=parks
+        )
+        for i in range(3):
+            q.add(
+                _pod(
+                    f"m{i}", ns="t",
+                    labels={"tpu/gang": "g", "tpu/gang-size": "3"},
+                )
+            )
+        taken = q.pop_matching(lambda p: gang_name_of(p.labels) is not None)
+        assert taken == []  # nothing gathered...
+        assert len(parks) == 3  # ...the whole gang parked together
+        assert q.depths() == (0, 0, 3)
+
+    def test_fairness_off_is_classic_fifo(self):
+        q = SchedulingQueue()
+        q.add(_pod("a", ns="zz"))
+        q.add(_pod("b", ns="aa"))
+        assert [q.pop(timeout=0).pod.name for _ in range(2)] == ["a", "b"]
+
+    def test_take_gang_and_remove_span_tenant_heaps(self):
+        q = self._queue({})
+        q.add(_pod("m0", ns="a", labels={"tpu/gang": "g", "tpu/gang-size": "2"}, uid="u0"))
+        q.add(_pod("m1", ns="b", labels={"tpu/gang": "g", "tpu/gang-size": "2"}, uid="u1"))
+        q.add(_pod("x", ns="a", uid="u2"))
+        taken = q.take_gang("g")
+        assert sorted(t.pod.name for t in taken) == ["m0", "m1"]
+        assert len(q) == 1
+        for t in taken:
+            q.readd(t)
+        assert q.remove("u0") and len(q) == 2
+
+
+class TestFairnessEndToEnd:
+    def test_flooding_tenant_cannot_starve_a_gang(self):
+        """The acceptance pair: the SAME workload — 30 flooding singles
+        queued BEFORE a two-member gang from another tenant, 8 chips of
+        capacity — binds the gang whole with fairness on and starves it
+        with fairness off (arrival order wins: the knob gate)."""
+        for fairness, gang_bound in ((True, 2), (False, 0)):
+            stack, agent = _stack(tenant_fairness=fairness)
+            agent.add_host("host", generation="v5e", chips=8)
+            agent.publish_all()
+            for i in range(30):
+                stack.cluster.create_pod(
+                    _pod(f"f{i}", ns="flood", labels={"tpu/chips": "1"})
+                )
+            for i in range(2):
+                stack.cluster.create_pod(
+                    _pod(
+                        f"g{i}", ns="small",
+                        labels={
+                            "tpu/chips": "2",
+                            "tpu/gang": "team-gang",
+                            "tpu/gang-size": "2",
+                        },
+                    )
+                )
+            stack.scheduler.run_until_idle(max_wall_s=30)
+            bound = [
+                p for p in stack.cluster.list_pods() if p.node_name
+            ]
+            gang = [p for p in bound if p.namespace == "small"]
+            flood = [p for p in bound if p.namespace == "flood"]
+            assert len(gang) == gang_bound, f"fairness={fairness}"
+            # Capacity is never wasted either way: all 8 chips handed out.
+            assert len(flood) * 1 + len(gang) * 2 == 8
+
+    def test_gang_atomicity_within_tenant_unchanged(self):
+        stack, agent = _stack(tenant_fairness=True)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                _pod(
+                    f"g{i}", ns="t",
+                    labels={
+                        "tpu/chips": "4",
+                        "tpu/gang": "big",
+                        "tpu/gang-size": "3",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert all(
+            p.node_name is None for p in stack.cluster.list_pods()
+        )  # 12 chips > 8: parks whole, never partially binds
+
+    def test_quota_park_retires_when_capacity_frees(self):
+        stack, agent = _stack(tenant_fairness=True, tenant_quota_chips=2)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            _pod("p1", ns="t", labels={"tpu/chips": "2"})
+        )
+        stack.cluster.create_pod(
+            _pod("p2", ns="t", labels={"tpu/chips": "2"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.cluster.get_pod("t/p1").node_name == "host"
+        assert stack.cluster.get_pod("t/p2").node_name is None
+        assert stack.metrics.tenant_quota_parks.value() >= 1
+        # The first pod's deletion frees quota: the park retires.
+        stack.cluster.delete_pod("t/p1")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.cluster.get_pod("t/p2").node_name == "host"
+
+
+@pytest.mark.slow
+class TestMultiTenantSoak:
+    def test_seeded_churn_no_starvation(self):
+        """Soak acceptance (wired into make chaos): a seeded churn trace
+        with a deliberately flooding tenant — every tenant's work makes
+        progress in EVERY soak window, no node ever oversubscribes, and
+        per-tenant scheduling p99 stays under the SLO."""
+        import random
+
+        stack, agent = _stack(
+            tenant_fairness=True, ingest_batch_window_ms=2.0
+        )
+        for h in range(4):
+            agent.add_host(f"h{h}", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.ingestor.flush()
+        rng = random.Random(7)
+        tenants = ("flood", "team-a", "team-b")
+        live: dict[str, int] = {}  # pod key -> expiry round
+        ever_bound: set[str] = set()  # pod keys observed bound (cluster truth)
+        seq = 0
+        for rnd in range(12):
+            for key in [k for k, exp in live.items() if exp <= rnd]:
+                del live[key]
+                stack.cluster.delete_pod(key)
+            # The flooder submits 10 singles per round (living 1-2
+            # rounds); the other tenants one 2-member gang each, living
+            # exactly one round — so the teams' fair share is always
+            # free again by their next ask and zero starvation is a
+            # provable invariant, not seed luck.
+            for _ in range(10):
+                p = _pod(f"f{seq}", ns="flood", labels={"tpu/chips": "1"})
+                seq += 1
+                live[p.key] = rnd + rng.randint(1, 2)
+                stack.cluster.create_pod(p)
+            for t in ("team-a", "team-b"):
+                tag = f"{t}-g{seq}"
+                seq += 1
+                for i in range(2):
+                    p = _pod(
+                        f"{tag}-{i}", ns=t,
+                        labels={
+                            "tpu/chips": "2",
+                            "tpu/gang": tag,
+                            "tpu/gang-size": "2",
+                        },
+                    )
+                    live[p.key] = rnd + 1
+                    stack.cluster.create_pod(p)
+            stack.ingestor.flush()
+            stack.scheduler.run_until_idle(max_wall_s=30)
+            stack.ingestor.flush()
+            # No oversubscription, ever.
+            for tpu in stack.cluster.list_tpu_metrics():
+                used = stack.accountant.chips_in_use(tpu.name)
+                assert used <= len(tpu.healthy_chips()), tpu.name
+            # Every tenant progressed this window: cluster truth, not
+            # ScheduleResult outcomes — gang members bind via permit
+            # release, which keeps the cycle's "waiting" outcome.
+            bound_now = {
+                p.key
+                for p in stack.cluster.list_pods()
+                if p.node_name
+            }
+            fresh = bound_now - ever_bound
+            ever_bound |= bound_now
+            progressed = {k.split("/", 1)[0] for k in fresh}
+            for t in tenants:
+                assert t in progressed, (
+                    f"tenant {t} starved in round {rnd}"
+                )
+        # Per-tenant p99 cycle latency SLO (generous for CI hardware —
+        # the point is no tenant's tail exploding under the flood).
+        # "waiting" counts: that cycle reserved a gang member — its
+        # latency is the member's scheduling cost.
+        by_tenant: dict[str, list[float]] = {t: [] for t in tenants}
+        for r in stack.scheduler.stats.results:
+            ns = r.pod_key.split("/", 1)[0]
+            if ns in by_tenant and r.outcome in ("bound", "waiting"):
+                by_tenant[ns].append(r.latency_s)
+        for t, lats in by_tenant.items():
+            lats.sort()
+            p99 = lats[min(int(len(lats) * 0.99), len(lats) - 1)]
+            assert p99 < 2.0, f"tenant {t} p99 {p99:.3f}s"
+        stack.ingestor.stop()

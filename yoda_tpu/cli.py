@@ -338,6 +338,10 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             # possibly stalled bind round-trip (GangPlugin.close sets the
             # shared stop event, aborting pending retry sleeps too).
             st.gang.close()
+            if st.ingestor is not None:
+                # Stop the ingest drain thread and apply any buffered
+                # watch residue (bounded by the batch window anyway).
+                st.ingestor.stop()
         for st in stacks[1:]:
             if st.events is not None:
                 st.events.close(timeout_s=5.0)

@@ -76,26 +76,49 @@ class FakeCluster:
 
     # --- watch ---
 
-    def add_watcher(self, fn: Callable[[Event], None], *, replay: bool = True) -> None:
+    def add_watcher(
+        self,
+        fn: Callable[[Event], None],
+        *,
+        replay: bool = True,
+        batch_fn: "Callable[[list[Event]], None] | None" = None,
+    ) -> None:
         """Register a watcher; with ``replay`` it first receives synthetic
-        'added' events for existing objects (list-then-watch semantics)."""
+        'added' events for existing objects (list-then-watch semantics).
+        ``batch_fn``, when given, marks the watcher batch-capable: bulk
+        deliveries (the replay here, KubeCluster's LIST reconcile diffs)
+        arrive as ONE list call instead of per-event — the batched-ingest
+        pipeline's list plumbing (cluster.ingest). Live mutations still
+        deliver per-event via ``fn``."""
         with self._lock:
             self._watchers.append(fn)
             if replay:
-                for ns in self._namespaces.values():
-                    fn(Event("added", "Namespace", ns))
-                for pvc in self._pvcs.values():
-                    fn(Event("added", "PersistentVolumeClaim", pvc))
-                for pv in self._pvs.values():
-                    fn(Event("added", "PersistentVolume", pv))
-                for pdb in self._pdbs.values():
-                    fn(Event("added", "PodDisruptionBudget", pdb))
-                for node in self._nodes.values():
-                    fn(Event("added", "Node", node))
-                for tpu in self._tpus.values():
-                    fn(Event("added", "TpuNodeMetrics", tpu))
-                for pod in self._pods.values():
-                    fn(Event("added", "Pod", pod))
+                events = self._replay_events()
+                if batch_fn is not None:
+                    batch_fn(events)
+                else:
+                    for event in events:
+                        fn(event)
+
+    def _replay_events(self) -> "list[Event]":
+        return (
+            [Event("added", "Namespace", ns) for ns in self._namespaces.values()]
+            + [
+                Event("added", "PersistentVolumeClaim", pvc)
+                for pvc in self._pvcs.values()
+            ]
+            + [Event("added", "PersistentVolume", pv) for pv in self._pvs.values()]
+            + [
+                Event("added", "PodDisruptionBudget", pdb)
+                for pdb in self._pdbs.values()
+            ]
+            + [Event("added", "Node", node) for node in self._nodes.values()]
+            + [
+                Event("added", "TpuNodeMetrics", tpu)
+                for tpu in self._tpus.values()
+            ]
+            + [Event("added", "Pod", pod) for pod in self._pods.values()]
+        )
 
     def _emit(self, event: Event) -> None:
         if event.kind in self.suppress_kinds:

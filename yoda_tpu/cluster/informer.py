@@ -56,6 +56,7 @@ class InformerCache:
         scheduler_name: str = "yoda-tpu",
         on_pod_pending: Callable[[PodSpec], None] | None = None,
         on_change: Callable[[Event], None] | None = None,
+        on_change_batch: "Callable[[list[Event]], None] | None" = None,
         watches_pvcs: bool = False,
         watches_pvs: bool = False,
         watches_pdbs: bool = False,
@@ -66,6 +67,12 @@ class InformerCache:
         self.scheduler_name = scheduler_name
         self.on_pod_pending = on_pod_pending
         self.on_change = on_change
+        # Batched-ingest hook (ISSUE 10): when set, one call per applied
+        # batch with the list of schedulability-relevant events —
+        # standalone wires the delete fast path plus ONE
+        # move_all_to_active decision here, instead of a per-event sweep.
+        # Falls back to per-event ``on_change`` when unset.
+        self.on_change_batch = on_change_batch
         # True when the backend streams PersistentVolumeClaim events: then
         # an empty PVC store means "no claims exist" (pods referencing one
         # wait), while False means "no PVC data" (volume constraints are
@@ -153,117 +160,148 @@ class InformerCache:
         # set); unchanged nodes share one immutable NodeInfo across
         # snapshots.
         self._ni_cache: dict[str, NodeInfo] = {}
+        # Per-batch accumulators, written by the ``_handle_*`` internals
+        # (which run with the lock held and must NOT bump versions
+        # themselves): ``handle_batch`` resets them, applies every event,
+        # then finalizes — ONE ``_version`` bump, ONE ``_metrics_version``
+        # bump covering every changed node, one snapshot invalidation.
+        self._batch_dirty = False
+        self._batch_metrics: list[tuple[str, str]] = []  # (kind, node)
+        self._batch_pending: list[PodSpec] = []
 
     # --- watch sink ---
 
     def handle(self, event: Event) -> None:
+        self.handle_batch((event,))
+
+    def handle_batch(self, events) -> None:
+        """Apply a run of watch events under ONE lock acquisition, with
+        one ``version`` bump, one ``metrics_version`` bump (covering every
+        metrics-relevant node in the batch — the delta ring gets one entry
+        per node, all at the new epoch), and one snapshot invalidation.
+        Callers hand in coalesced batches (cluster.ingest); a single-event
+        batch is exactly the old per-event ``handle``. Pending-pod and
+        change callbacks fire after the batch is fully applied, outside
+        the lock — consumers never observe a half-applied batch."""
+        relevant_events: list[Event] = []
         with self._lock:
             self._last_event_mono = self.mono_fn()
-        relevant = True
-        if event.kind == "TpuNodeMetrics":
-            relevant = self._handle_tpu(event)
-        elif event.kind == "Pod":
-            self._handle_pod(event)
-        elif event.kind == "Node":
-            self._handle_node(event)
-        elif event.kind == "Namespace":
-            self._handle_namespace(event)
-        elif event.kind == "PersistentVolumeClaim":
-            self._handle_pvc(event)
-        elif event.kind == "PersistentVolume":
-            self._handle_pv(event)
-        elif event.kind == "PodDisruptionBudget":
-            self._handle_pdb(event)
-        # Timestamp-only heartbeats are NOT propagated as cluster changes
-        # (upstream's queueing-hint discipline): on a fleet of agents
-        # republishing unchanged metrics every few seconds, reactivating
-        # every parked pod per heartbeat is a retry storm that burns a
-        # full-queue dispatch sweep per event for zero new information.
-        if relevant and self.on_change is not None:
-            self.on_change(event)
+            self._batch_dirty = False
+            self._batch_metrics = []
+            self._batch_pending = []
+            for event in events:
+                relevant = True
+                if event.kind == "TpuNodeMetrics":
+                    relevant = self._handle_tpu(event)
+                elif event.kind == "Pod":
+                    self._handle_pod(event)
+                elif event.kind == "Node":
+                    self._handle_node(event)
+                elif event.kind == "Namespace":
+                    self._handle_namespace(event)
+                elif event.kind == "PersistentVolumeClaim":
+                    self._handle_pvc(event)
+                elif event.kind == "PersistentVolume":
+                    self._handle_pv(event)
+                elif event.kind == "PodDisruptionBudget":
+                    self._handle_pdb(event)
+                # Timestamp-only heartbeats are NOT propagated as cluster
+                # changes (upstream's queueing-hint discipline):
+                # reactivating every parked pod per heartbeat is a retry
+                # storm burning a full-queue sweep per event for zero new
+                # information.
+                if relevant:
+                    relevant_events.append(event)
+            if self._batch_dirty:
+                self._version += 1
+                self._snapshot_cache = None
+            if self._batch_metrics:
+                self._metrics_version += 1
+                for kind, name in self._batch_metrics:
+                    self._delta_ring.append(
+                        (self._metrics_version, kind, name)
+                    )
+            pending = self._batch_pending
+            self._batch_pending = []
+        if self.on_pod_pending is not None:
+            for pod in pending:
+                self.on_pod_pending(pod)
+        if relevant_events:
+            if self.on_change_batch is not None:
+                self.on_change_batch(relevant_events)
+            elif self.on_change is not None:
+                for event in relevant_events:
+                    self.on_change(event)
 
     def _handle_pvc(self, event: Event) -> None:
-        with self._lock:
-            if event.type == "synced":
-                # KubeCluster emits this after a successful PVC LIST: the
-                # watch is genuinely live (RBAC granted), so an empty
-                # store now means "no claims exist" and enforcement is on.
-                # Without it (403: missing ClusterRole rule) volume
-                # constraints degrade to not-enforced instead of parking
-                # every PVC-referencing pod on "claim not found".
-                self.watches_pvcs = True
-                self._version += 1
-                self._snapshot_cache = None
-                return
-            pvc: K8sPvc = event.obj  # type: ignore[assignment]
-            if event.type == "deleted":
-                self._pvcs.pop(pvc.key, None)
-            else:
-                self._pvcs[pvc.key] = pvc
-            self._version += 1
-            self._snapshot_cache = None
+        # Lock held by handle_batch; version bumps via the accumulators.
+        if event.type == "synced":
+            # KubeCluster emits this after a successful PVC LIST: the
+            # watch is genuinely live (RBAC granted), so an empty
+            # store now means "no claims exist" and enforcement is on.
+            # Without it (403: missing ClusterRole rule) volume
+            # constraints degrade to not-enforced instead of parking
+            # every PVC-referencing pod on "claim not found".
+            self.watches_pvcs = True
+            self._batch_dirty = True
+            return
+        pvc: K8sPvc = event.obj  # type: ignore[assignment]
+        if event.type == "deleted":
+            self._pvcs.pop(pvc.key, None)
+        else:
+            self._pvcs[pvc.key] = pvc
+        self._batch_dirty = True
 
     def _handle_pv(self, event: Event) -> None:
-        with self._lock:
-            if event.type == "synced":
-                self.watches_pvs = True
-                self._version += 1
-                self._snapshot_cache = None
-                return
-            pv: K8sPv = event.obj  # type: ignore[assignment]
-            if event.type == "deleted":
-                self._pvs.pop(pv.name, None)
-            else:
-                self._pvs[pv.name] = pv
-            self._version += 1
-            self._snapshot_cache = None
+        if event.type == "synced":
+            self.watches_pvs = True
+            self._batch_dirty = True
+            return
+        pv: K8sPv = event.obj  # type: ignore[assignment]
+        if event.type == "deleted":
+            self._pvs.pop(pv.name, None)
+        else:
+            self._pvs[pv.name] = pv
+        self._batch_dirty = True
 
     def _handle_pdb(self, event: Event) -> None:
-        with self._lock:
-            if event.type == "synced":
-                # PDB LIST succeeded (RBAC granted): enforcement on, as
-                # for _handle_pvc's sentinel.
-                self.watches_pdbs = True
-                return
-            pdb: K8sPdb = event.obj  # type: ignore[assignment]
-            if event.type == "deleted":
-                self._pdbs.pop(pdb.key, None)
-            else:
-                self._pdbs[pdb.key] = pdb
-            # No version bump: budgets gate victim PREFERENCE inside
-            # preemption, not filtering/scoring — snapshots and fleet
-            # arrays are unaffected.
+        if event.type == "synced":
+            # PDB LIST succeeded (RBAC granted): enforcement on, as
+            # for _handle_pvc's sentinel.
+            self.watches_pdbs = True
+            return
+        pdb: K8sPdb = event.obj  # type: ignore[assignment]
+        if event.type == "deleted":
+            self._pdbs.pop(pdb.key, None)
+        else:
+            self._pdbs[pdb.key] = pdb
+        # No version bump: budgets gate victim PREFERENCE inside
+        # preemption, not filtering/scoring — snapshots and fleet
+        # arrays are unaffected.
 
     def _handle_namespace(self, event: Event) -> None:
         ns = event.obj
-        with self._lock:
-            if event.type == "deleted":
-                self._namespaces.pop(ns.name, None)
-            else:
-                self._namespaces[ns.name] = dict(ns.labels)
-            self._version += 1
-            self._snapshot_cache = None
+        if event.type == "deleted":
+            self._namespaces.pop(ns.name, None)
+        else:
+            self._namespaces[ns.name] = dict(ns.labels)
+        self._batch_dirty = True
 
     def _handle_node(self, event: Event) -> None:
         node: K8sNode = event.obj  # type: ignore[assignment]
-        with self._lock:
-            self._node_informed = True
-            if event.type == "deleted":
-                self._nodes.pop(node.name, None)
-            else:
-                self._nodes[node.name] = node
-            self._ni_cache.pop(node.name, None)
-            self._version += 1
-            if event.type in ("added", "deleted"):
-                # The candidate-node SET changed (a CR may enter/leave the
-                # snapshot), which invalidates the fleet arrays keyed on
-                # metrics_version. A cordon/taint flip (modified) does not:
-                # admission is evaluated per cycle, not baked into arrays.
-                self._metrics_version += 1
-                self._delta_ring.append(
-                    (self._metrics_version, "structural", node.name)
-                )
-            self._snapshot_cache = None
+        self._node_informed = True
+        if event.type == "deleted":
+            self._nodes.pop(node.name, None)
+        else:
+            self._nodes[node.name] = node
+        self._ni_cache.pop(node.name, None)
+        self._batch_dirty = True
+        if event.type in ("added", "deleted"):
+            # The candidate-node SET changed (a CR may enter/leave the
+            # snapshot), which invalidates the fleet arrays keyed on
+            # metrics_version. A cordon/taint flip (modified) does not:
+            # admission is evaluated per cycle, not baked into arrays.
+            self._batch_metrics.append(("structural", node.name))
 
     def _handle_tpu(self, event: Event) -> bool:
         """Returns whether the event carries schedulability-relevant change.
@@ -276,83 +314,71 @@ class InformerCache:
         gone STALE — its refresh changes feasibility and counts as a real
         change."""
         tpu: TpuNodeMetrics = event.obj  # type: ignore[assignment]
-        with self._lock:
-            structural = False
-            if event.type == "deleted":
-                if self._tpus.pop(tpu.name, None) is not None:
-                    i = bisect.bisect_left(self._tpu_order, tpu.name)
-                    if (
-                        i < len(self._tpu_order)
-                        and self._tpu_order[i] == tpu.name
-                    ):
-                        del self._tpu_order[i]
-                relevant = structural = True
-            else:
-                prev = self._tpus.get(tpu.name)
-                self._tpus[tpu.name] = tpu
-                if prev is None:
-                    bisect.insort(self._tpu_order, tpu.name)
-                structural = prev is None  # CR added: node set changed
-                relevant = prev is None or not prev.values_equal(tpu)
-                if not relevant and self.staleness_s > 0:
-                    # Observed AGE at arrival, not the publish gap: watch
-                    # delivery latency can push a node past the staleness
-                    # threshold even when the agent published on time, and
-                    # its refresh must still reactivate parked pods
-                    # (arrival age >= publish gap, so this test dominates).
-                    age = self.now_fn() - prev.last_updated_unix
-                    relevant = age > self.staleness_s  # was stale: now fresh
-            self._ni_cache.pop(tpu.name, None)
-            self._version += 1
-            if relevant:
-                self._metrics_version += 1
-                self._delta_ring.append(
-                    (
-                        self._metrics_version,
-                        "structural" if structural else "modified",
-                        tpu.name,
-                    )
-                )
-            self._snapshot_cache = None
+        structural = False
+        if event.type == "deleted":
+            if self._tpus.pop(tpu.name, None) is not None:
+                i = bisect.bisect_left(self._tpu_order, tpu.name)
+                if (
+                    i < len(self._tpu_order)
+                    and self._tpu_order[i] == tpu.name
+                ):
+                    del self._tpu_order[i]
+            relevant = structural = True
+        else:
+            prev = self._tpus.get(tpu.name)
+            self._tpus[tpu.name] = tpu
+            if prev is None:
+                bisect.insort(self._tpu_order, tpu.name)
+            structural = prev is None  # CR added: node set changed
+            relevant = prev is None or not prev.values_equal(tpu)
+            if not relevant and self.staleness_s > 0:
+                # Observed AGE at arrival, not the publish gap: watch
+                # delivery latency can push a node past the staleness
+                # threshold even when the agent published on time, and
+                # its refresh must still reactivate parked pods
+                # (arrival age >= publish gap, so this test dominates).
+                age = self.now_fn() - prev.last_updated_unix
+                relevant = age > self.staleness_s  # was stale: now fresh
+        self._ni_cache.pop(tpu.name, None)
+        self._batch_dirty = True
+        if relevant:
+            self._batch_metrics.append(
+                ("structural" if structural else "modified", tpu.name)
+            )
         return relevant
 
     def _handle_pod(self, event: Event) -> None:
         pod: PodSpec = event.obj  # type: ignore[assignment]
-        pending = False
-        with self._lock:
-            if event.type == "deleted":
-                self._live_uids.discard(pod.uid)
-            else:
-                self._live_uids.add(pod.uid)
-            counted = self._pod_nodes.get(pod.uid)
-            if counted and (event.type == "deleted" or counted[0] != pod.node_name):
-                self._uncount_pod(pod.uid)
-                counted = None
-            if event.type != "deleted" and pod.node_name and counted is None:
-                self._count_pod(pod, pod.node_name)
-            ours_unbound = (
-                event.type != "deleted"
-                and pod.node_name is None
-                and pod.scheduler_name == self.scheduler_name
-            )
-            if event.type == "deleted":
-                self._gated_uids.discard(pod.uid)
-            elif ours_unbound and pod.scheduling_gates:
-                self._gated_uids.add(pod.uid)  # held, not schedulable
-            elif event.type == "added" and ours_unbound:
-                pending = True
-            elif (
-                event.type == "modified"
-                and ours_unbound
-                and pod.uid in self._gated_uids
-            ):
-                # Gates cleared: NOW the pod becomes schedulable.
-                self._gated_uids.discard(pod.uid)
-                pending = True
-            self._version += 1
-            self._snapshot_cache = None
-        if pending and self.on_pod_pending is not None:
-            self.on_pod_pending(pod)
+        if event.type == "deleted":
+            self._live_uids.discard(pod.uid)
+        else:
+            self._live_uids.add(pod.uid)
+        counted = self._pod_nodes.get(pod.uid)
+        if counted and (event.type == "deleted" or counted[0] != pod.node_name):
+            self._uncount_pod(pod.uid)
+            counted = None
+        if event.type != "deleted" and pod.node_name and counted is None:
+            self._count_pod(pod, pod.node_name)
+        ours_unbound = (
+            event.type != "deleted"
+            and pod.node_name is None
+            and pod.scheduler_name == self.scheduler_name
+        )
+        if event.type == "deleted":
+            self._gated_uids.discard(pod.uid)
+        elif ours_unbound and pod.scheduling_gates:
+            self._gated_uids.add(pod.uid)  # held, not schedulable
+        elif event.type == "added" and ours_unbound:
+            self._batch_pending.append(pod)
+        elif (
+            event.type == "modified"
+            and ours_unbound
+            and pod.uid in self._gated_uids
+        ):
+            # Gates cleared: NOW the pod becomes schedulable.
+            self._gated_uids.discard(pod.uid)
+            self._batch_pending.append(pod)
+        self._batch_dirty = True
 
     def _count_pod(self, pod: PodSpec, node: str) -> None:
         claim = _pod_claim_mib(pod)

@@ -574,8 +574,10 @@ class KubeCluster:
             # serializes against this, so a registering watcher sees each
             # object exactly once — via replay or via these events, never
             # both (the FakeCluster mutate+emit-under-lock contract).
-            for event in events:
-                self._emit(event)
+            # Delivered as ONE list to batch-capable watchers: a relist
+            # after a 410/partition replays thousands of diffs, and the
+            # batched-ingest pipeline applies them in one pass.
+            self._emit_many(events)
         return data.get("metadata", {}).get("resourceVersion", "")
 
     def _watch_loop(self, target: _WatchTarget) -> None:
@@ -690,12 +692,22 @@ class KubeCluster:
 
     # --- FakeCluster surface: watch ---
 
-    def add_watcher(self, fn, *, replay: bool = True) -> None:
+    def add_watcher(
+        self, fn, *, replay: bool = True, batch_fn=None
+    ) -> None:
+        """Register a watcher (``FakeCluster.add_watcher`` contract).
+        ``batch_fn`` marks it batch-capable: the replay here and every
+        LIST reconcile diff (``_list_rv``) arrive as ONE list call — the
+        batched-ingest pipeline's list plumbing. Live watch events still
+        deliver per-event via ``fn``."""
         with self._lock:
-            self._watchers.append(fn)
+            self._watchers.append((fn, batch_fn))
             if replay:
-                for ns in self._nss.values():
-                    fn(Event("added", "Namespace", ns))
+                events: list[Event] = []
+                events.extend(
+                    Event("added", "Namespace", ns)
+                    for ns in self._nss.values()
+                )
                 for t in self._targets:
                     # Late watchers must not miss the liveness sentinel
                     # (the informer may register after the first LIST).
@@ -704,27 +716,60 @@ class KubeCluster:
                     # and replaying the sentinel for it would turn the
                     # degradation into enforcement-over-no-data.
                     if t.sentinel and t.listed.is_set():
-                        fn(Event("synced", t.kind, None))
-                for pvc in self._pvcs.values():
-                    fn(Event("added", "PersistentVolumeClaim", pvc))
-                for pv in self._pvs.values():
-                    fn(Event("added", "PersistentVolume", pv))
-                for pdb in self._pdbs.values():
-                    fn(Event("added", "PodDisruptionBudget", pdb))
-                for node in self._nodes.values():
-                    fn(Event("added", "Node", node))
-                for tpu in self._tpus.values():
-                    fn(Event("added", "TpuNodeMetrics", tpu))
-                for pod in sorted(self._pods.values(), key=lambda p: p.creation_seq):
-                    fn(Event("added", "Pod", pod))
+                        events.append(Event("synced", t.kind, None))
+                events.extend(
+                    Event("added", "PersistentVolumeClaim", pvc)
+                    for pvc in self._pvcs.values()
+                )
+                events.extend(
+                    Event("added", "PersistentVolume", pv)
+                    for pv in self._pvs.values()
+                )
+                events.extend(
+                    Event("added", "PodDisruptionBudget", pdb)
+                    for pdb in self._pdbs.values()
+                )
+                events.extend(
+                    Event("added", "Node", node)
+                    for node in self._nodes.values()
+                )
+                events.extend(
+                    Event("added", "TpuNodeMetrics", tpu)
+                    for tpu in self._tpus.values()
+                )
+                events.extend(
+                    Event("added", "Pod", pod)
+                    for pod in sorted(
+                        self._pods.values(), key=lambda p: p.creation_seq
+                    )
+                )
+                if batch_fn is not None:
+                    batch_fn(events)
+                else:
+                    for event in events:
+                        fn(event)
 
     def _emit(self, event: Event) -> None:
         # Callers hold self._lock (RLock) so store mutation + delivery are
         # atomic w.r.t. add_watcher replay, as in FakeCluster._emit.
         with self._lock:
             self._last_event_mono = time.monotonic()
-            for fn in list(self._watchers):
+            for fn, _ in list(self._watchers):
                 fn(event)
+
+    def _emit_many(self, events: "list[Event]") -> None:
+        """Deliver a reconcile diff: one list call to batch-capable
+        watchers, per-event to the rest. Callers hold self._lock."""
+        if not events:
+            return
+        with self._lock:
+            self._last_event_mono = time.monotonic()
+            for fn, batch_fn in list(self._watchers):
+                if batch_fn is not None:
+                    batch_fn(events)
+                else:
+                    for event in events:
+                        fn(event)
 
     def last_event_age_s(self) -> "float | None":
         """Seconds since the last watch event was applied (None before the

@@ -226,6 +226,28 @@ class SchedulerConfig:
     # semantics — bounds retry storms over chronically unschedulable pods).
     # 0 = strict upstream behavior (every event move respects backoff).
     immediate_retry_attempts: int = 5
+    # Batched watch-event ingestion (cluster/ingest.py, ISSUE 10): when
+    # ingest_batch_window_ms > 0, watch events are drained into bounded
+    # batches — coalesced by (kind, uid): last-write-wins for modifies,
+    # delete supersedes — and each batch is applied under ONE informer
+    # lock acquisition with one metrics-epoch bump and one parked-pod
+    # reactivation decision. 0 (default) keeps per-event delivery:
+    # every event applies synchronously, exactly the pre-batching
+    # behavior. The window bounds event-to-queue latency; size it well
+    # under the scheduling cadence (a few ms).
+    ingest_batch_max: int = 256
+    ingest_batch_window_ms: float = 0.0
+    # Per-tenant DRF fair queuing (framework/tenancy.py): a tenant is
+    # the pod's namespace, overridable via the tpu/tenant label. When
+    # on, the scheduling queue pops from the LOWEST dominant-resource-
+    # share (chips/HBM) tenant first, so a flooding tenant cannot starve
+    # the others. Off (default) = the classic single tenant-blind queue.
+    tenant_fairness: bool = False
+    # Per-tenant quota admission (requires tenant_fairness): admitting a
+    # pod whose tenant's BOUND usage would exceed these caps parks it
+    # with a why-pending verdict until capacity frees. 0 = unlimited.
+    tenant_quota_chips: int = 0
+    tenant_quota_hbm_gib: float = 0.0
     # Additional profiles (upstream KubeSchedulerConfiguration profiles):
     # each entry inherits every unspecified key from the base config and
     # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
@@ -447,6 +469,55 @@ class SchedulerConfig:
             raise ValueError(
                 "immediate_retry_attempts must be an int in [0, 1000], got "
                 f"{cfg.immediate_retry_attempts!r}"
+            )
+        if (
+            isinstance(cfg.ingest_batch_max, bool)
+            or not isinstance(cfg.ingest_batch_max, int)
+            or not 1 <= cfg.ingest_batch_max <= 65536
+        ):
+            raise ValueError(
+                "ingest_batch_max must be an int in [1, 65536], got "
+                f"{cfg.ingest_batch_max!r}"
+            )
+        if not isinstance(
+            cfg.ingest_batch_window_ms, (int, float)
+        ) or isinstance(
+            cfg.ingest_batch_window_ms, bool
+        ) or not 0 <= cfg.ingest_batch_window_ms <= 10_000:
+            raise ValueError(
+                "ingest_batch_window_ms must be in [0, 10000] (0 = "
+                "per-event delivery, batching off), got "
+                f"{cfg.ingest_batch_window_ms!r}"
+            )
+        if not isinstance(cfg.tenant_fairness, bool):
+            raise ValueError(
+                f"tenant_fairness must be a bool, got "
+                f"{cfg.tenant_fairness!r}"
+            )
+        if (
+            isinstance(cfg.tenant_quota_chips, bool)
+            or not isinstance(cfg.tenant_quota_chips, int)
+            or cfg.tenant_quota_chips < 0
+        ):
+            raise ValueError(
+                "tenant_quota_chips must be an int >= 0 (0 = unlimited), "
+                f"got {cfg.tenant_quota_chips!r}"
+            )
+        if not isinstance(
+            cfg.tenant_quota_hbm_gib, (int, float)
+        ) or isinstance(
+            cfg.tenant_quota_hbm_gib, bool
+        ) or cfg.tenant_quota_hbm_gib < 0:
+            raise ValueError(
+                "tenant_quota_hbm_gib must be >= 0 (0 = unlimited), got "
+                f"{cfg.tenant_quota_hbm_gib!r}"
+            )
+        if (
+            cfg.tenant_quota_chips or cfg.tenant_quota_hbm_gib
+        ) and not cfg.tenant_fairness:
+            raise ValueError(
+                "tenant_quota_* requires tenant_fairness: true (quotas "
+                "are enforced by the tenant-aware queue)"
             )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)

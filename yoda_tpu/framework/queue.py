@@ -1,10 +1,22 @@
 """Scheduling queue: active heap ordered by the QueueSort plugin, with
-backoff for unschedulable pods.
+backoff for unschedulable pods and (ISSUE 10) per-tenant DRF fair queuing.
 
 The reference supplies only the ordering function (``Less``, reference
 pkg/yoda/sort/sort.go:8-18) and inherits the queue machinery (active /
 backoff / unschedulable pools, event-driven re-activation) from upstream;
-this module is the from-scratch equivalent of that machinery.
+this module is the from-scratch equivalent of that machinery, grown a
+tenant model the upstream framework (KEP-624) lacks entirely: the active
+pool is sharded per tenant (``framework/tenancy.tenant_of`` — namespace,
+overridable via the ``tpu/tenant`` label), and every pop draws from the
+LOWEST dominant-resource-share tenant first (DRF over chips/HBM,
+``TenantLedger.dominant_share``), so a flooding tenant's backlog cannot
+starve anyone: each bind raises its share and pushes it behind the
+tenants it was flooding past. Per-tenant quota admission parks over-quota
+entries in the unresolvable pool with a why-pending verdict; they retire
+when capacity frees (the freeing event's ``move_all_to_active`` re-admits
+them through a fresh quota check). With no ``tenant_of`` hook (fairness
+off, the default) everything lives under one tenant key and behavior is
+bit-identical to the single-queue implementation.
 """
 
 from __future__ import annotations
@@ -72,6 +84,10 @@ class SchedulingQueue:
         *,
         clock: Callable[[], float] = time.monotonic,
         immediate_retry_attempts: int = IMMEDIATE_RETRY_ATTEMPTS,
+        tenant_of: "Callable[[PodSpec], str] | None" = None,
+        share_fn: "Callable[[str], float] | None" = None,
+        quota_fn: "Callable[[str, PodSpec], str | None] | None" = None,
+        on_quota_park: "Callable[[QueuedPodInfo, str], None] | None" = None,
     ) -> None:
         if sort_plugin is not None:
             self._less = sort_plugin.less
@@ -82,10 +98,30 @@ class SchedulingQueue:
         # (every event move respects backoff); higher trades retry-storm
         # exposure for lower latency on late-resolving pods.
         self.immediate_retry_attempts = immediate_retry_attempts
+        # Tenant fair queuing (off when tenant_of is None — everything
+        # shares the "" tenant and ordering is the classic single heap):
+        # - tenant_of(pod): which tenant an entry bills to;
+        # - share_fn(tenant): dominant resource share in [0,1] — pops
+        #   draw from the LOWEST share first (DRF); missing/raising hook
+        #   reads as share 0 (FIFO among tenants);
+        # - quota_fn(tenant, pod): why-pending verdict when admitting the
+        #   pod would exceed the tenant's quota (None = admit). Verdicted
+        #   entries park in the unresolvable pool and re-enter through
+        #   move_all_to_active when capacity frees;
+        # - on_quota_park(qpi, why): observability callback (counter +
+        #   pending index). Fired under the queue lock — must not
+        #   re-enter the queue.
+        self._tenant_of = tenant_of
+        self._share_fn = share_fn
+        self._quota_fn = quota_fn
+        self.on_quota_park = on_quota_park
+        self.quota_parks = 0  # total entries quota-parked (metrics)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._seq = itertools.count()
-        self._active: list[_HeapItem] = []
+        # tenant -> active heap. Fairness off: single "" key, identical
+        # ordering to the pre-tenant single heap.
+        self._active: dict[str, list[_HeapItem]] = {}
         self._backoff: list[tuple[float, int, QueuedPodInfo]] = []  # (ready_at, seq, qpi)
         self._unschedulable: dict[str, QueuedPodInfo] = {}  # pod key -> qpi
         self._closed = False
@@ -95,9 +131,71 @@ class SchedulingQueue:
         # of polling.
         self.on_activity: Callable[[], None] | None = None
 
+    # --- tenant helpers ---
+
+    def _tenant(self, pod: PodSpec) -> str:
+        if self._tenant_of is None:
+            return ""
+        try:
+            return self._tenant_of(pod)
+        except Exception:  # noqa: BLE001 — a bad hook must not wedge the queue
+            return ""
+
+    def _share(self, tenant: str) -> float:
+        if self._share_fn is None:
+            return 0.0
+        try:
+            return float(self._share_fn(tenant))
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def _tenant_order(self) -> "list[str]":
+        """Non-empty tenants, lowest dominant share first (name tiebreak
+        for determinism). One entry when fairness is off."""
+        tenants = [t for t, h in self._active.items() if h]
+        if self._tenant_of is None or len(tenants) <= 1:
+            return tenants
+        return sorted(tenants, key=lambda t: (self._share(t), t))
+
+    def _quota_park_locked(self, qpi: QueuedPodInfo, why: str) -> None:
+        """Park an over-quota entry in the unresolvable pool (lock held):
+        no backoff ladder — it re-enters the active queue on the next
+        capacity-freeing cluster event and re-takes the quota check."""
+        qpi.unschedulable_message = why
+        self._unschedulable[qpi.pod.key] = qpi
+        self.quota_parks += 1
+        if self.on_quota_park is not None:
+            try:
+                self.on_quota_park(qpi, why)
+            except Exception:  # noqa: BLE001 — observability must not wedge pops
+                pass
+
+    def _pop_active_locked(self) -> "QueuedPodInfo | None":
+        """Next admissible entry in (tenant share, priority, FIFO) order,
+        quota-parking over-quota heads along the way. Lock held."""
+        while True:
+            order = self._tenant_order()
+            if not order:
+                return None
+            tenant = order[0]
+            heap = self._active[tenant]
+            item = heapq.heappop(heap)
+            if not heap:
+                del self._active[tenant]
+            if self._quota_fn is not None:
+                why = self._quota_fn(tenant, item.qpi.pod)
+                if why is not None:
+                    self._quota_park_locked(item.qpi, why)
+                    continue
+            return item.qpi
+
     def __len__(self) -> int:
         with self._lock:
-            return len(self._active) + len(self._backoff) + len(self._unschedulable)
+            return (
+                sum(len(h) for h in self._active.values())
+                + len(self._backoff)
+                + len(self._unschedulable)
+            )
 
     def depths(self) -> tuple[int, int, int]:
         """(active, backoff, parked-unresolvable) pool sizes — the
@@ -106,7 +204,7 @@ class SchedulingQueue:
         deep parked = pods waiting on cluster events)."""
         with self._lock:
             return (
-                len(self._active),
+                sum(len(h) for h in self._active.values()),
                 len(self._backoff),
                 len(self._unschedulable),
             )
@@ -115,7 +213,17 @@ class SchedulingQueue:
         """Pods that will re-enter the active queue without an external
         event (active + backoff); excludes the parked-unresolvable pool."""
         with self._lock:
-            return len(self._active) + len(self._backoff)
+            return sum(len(h) for h in self._active.values()) + len(
+                self._backoff
+            )
+
+    def has_parked(self) -> bool:
+        """Anything waiting on an event or a timer (backoff OR
+        unresolvable)? The ``move_all_to_active`` fast-skip reads this: on
+        an idle or fully-drained cluster every heartbeat used to pay a
+        locked full-queue sweep to move nothing."""
+        with self._lock:
+            return bool(self._backoff or self._unschedulable)
 
     def add(self, pod: PodSpec) -> None:
         with self._cond:
@@ -157,7 +265,8 @@ class SchedulingQueue:
             self._push_active(self._unschedulable.pop(key))
 
     def _push_active(self, qpi: QueuedPodInfo) -> None:
-        heapq.heappush(self._active, _HeapItem(qpi, next(self._seq), self._less))
+        heap = self._active.setdefault(self._tenant(qpi.pod), [])
+        heapq.heappush(heap, _HeapItem(qpi, next(self._seq), self._less))
 
     def _flush_backoff_locked(self) -> None:
         now = self._clock()
@@ -166,16 +275,17 @@ class SchedulingQueue:
             self._push_active(qpi)
 
     def pop(self, timeout: float | None = None) -> QueuedPodInfo | None:
-        """Pop the highest-priority active pod; blocks up to ``timeout``
-        (forever if None) until one is available or the queue is closed."""
+        """Pop the highest-priority active pod of the lowest-share tenant;
+        blocks up to ``timeout`` (forever if None) until one is available
+        or the queue is closed."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
             while True:
                 self._flush_backoff_locked()
-                if self._active:
-                    item = heapq.heappop(self._active)
-                    item.qpi.attempts += 1
-                    return item.qpi
+                qpi = self._pop_active_locked()
+                if qpi is not None:
+                    qpi.attempts += 1
+                    return qpi
                 if self._closed:
                     return None
                 # Wake up when the earliest backoff expires, a pod arrives,
@@ -198,12 +308,21 @@ class SchedulingQueue:
         include_backoff: bool = False,
     ) -> list[QueuedPodInfo]:
         """Pop every ACTIVE entry whose pod satisfies ``pred``, in queue
-        (priority, FIFO) order — the gang-aware gather next to the
-        scheduler's ``_pop_burst``: when a popped pod is a gang member, its
-        co-queued siblings are pulled out so the whole gang runs
-        back-to-back in one fused pass instead of one cycle per loop turn.
-        Non-blocking; expired backoff entries are flushed first so a
-        sibling whose retry timer just lapsed is gathered too.
+        order — (tenant share, priority, FIFO): tenants are visited
+        lowest dominant share first, so the gang gather (and therefore
+        the joint pass's placement precedence) inherits DRF fairness —
+        the gang of a lightly-used tenant places before a flooding
+        tenant's even when the flood arrived first. This is the gang-
+        aware gather next to the scheduler's ``_pop_burst``: when a
+        popped pod is a gang member, its co-queued siblings are pulled
+        out so the whole gang runs back-to-back in one fused pass
+        instead of one cycle per loop turn. Non-blocking; expired
+        backoff entries are flushed first so a sibling whose retry timer
+        just lapsed is gathered too. Over-quota tenants' matching
+        entries are quota-parked, never gathered — usage only moves at
+        bind time, so every member of a gang sees one consistent verdict
+        within this single locked pass (whole gang gathers or whole gang
+        parks).
 
         ``include_backoff`` additionally pulls matching entries whose
         backoff timer is STILL TICKING (appended after the active matches,
@@ -212,24 +331,48 @@ class SchedulingQueue:
         them to the gang-arrival signal or the backoff ladder."""
         with self._cond:
             self._flush_backoff_locked()
-            taken: list[_HeapItem] = []
-            keep: list[_HeapItem] = []
-            for item in self._active:
-                if (limit is None or len(taken) < limit) and pred(
-                    item.qpi.pod
+            taken: list[QueuedPodInfo] = []
+            n_taken = 0
+            for tenant in self._tenant_order():
+                heap = self._active.get(tenant)
+                if not heap:
+                    continue
+                quota_why = None
+                if self._quota_fn is not None and any(
+                    pred(item.qpi.pod) for item in heap
                 ):
-                    taken.append(item)
-                else:
-                    keep.append(item)
-            if taken:
-                heapq.heapify(keep)
-                self._active = keep
+                    # One verdict per tenant per pass (usage is constant
+                    # under the lock): probe with the first matching pod.
+                    probe = next(
+                        item.qpi.pod for item in heap if pred(item.qpi.pod)
+                    )
+                    quota_why = self._quota_fn(tenant, probe)
+                t_taken: list[_HeapItem] = []
+                keep: list[_HeapItem] = []
+                for item in heap:
+                    if not pred(item.qpi.pod):
+                        keep.append(item)
+                    elif quota_why is not None:
+                        self._quota_park_locked(item.qpi, quota_why)
+                    elif limit is None or n_taken < limit:
+                        t_taken.append(item)
+                        n_taken += 1
+                    else:
+                        keep.append(item)
+                if len(keep) != len(heap):
+                    if keep:
+                        heapq.heapify(keep)
+                        self._active[tenant] = keep
+                    else:
+                        del self._active[tenant]
+                t_taken.sort()  # heap-internal order -> queue order
+                taken.extend(item.qpi for item in t_taken)
             back_taken: list[QueuedPodInfo] = []
             if include_backoff:
                 still: list[tuple[float, int, QueuedPodInfo]] = []
                 for entry in sorted(self._backoff):
                     if (
-                        limit is None or len(taken) + len(back_taken) < limit
+                        limit is None or n_taken + len(back_taken) < limit
                     ) and pred(entry[2].pod):
                         back_taken.append(entry[2])
                     else:
@@ -237,8 +380,7 @@ class SchedulingQueue:
                 if back_taken:
                     heapq.heapify(still)
                     self._backoff = still
-        taken.sort()  # heap-internal order -> queue order
-        out = [item.qpi for item in taken] + back_taken
+        out = taken + back_taken
         for qpi in out:
             qpi.attempts += 1
         return out
@@ -249,9 +391,10 @@ class SchedulingQueue:
         object this way when it must replay a dropped deletion for a pod
         that exists nowhere else anymore."""
         with self._lock:
-            for item in self._active:
-                if item.qpi.pod.uid == uid:
-                    return item.qpi.pod
+            for heap in self._active.values():
+                for item in heap:
+                    if item.qpi.pod.uid == uid:
+                        return item.qpi.pod
             for _, _, qpi in self._backoff:
                 if qpi.pod.uid == uid:
                     return qpi.pod
@@ -269,11 +412,15 @@ class SchedulingQueue:
         removed."""
         removed = False
         with self._cond:
-            active = [it for it in self._active if it.qpi.pod.uid != uid]
-            if len(active) != len(self._active):
-                heapq.heapify(active)
-                self._active = active
-                removed = True
+            for tenant, heap in list(self._active.items()):
+                kept = [it for it in heap if it.qpi.pod.uid != uid]
+                if len(kept) != len(heap):
+                    removed = True
+                    if kept:
+                        heapq.heapify(kept)
+                        self._active[tenant] = kept
+                    else:
+                        del self._active[tenant]
             backoff = [e for e in self._backoff if e[2].pod.uid != uid]
             if len(backoff) != len(self._backoff):
                 heapq.heapify(backoff)
@@ -306,8 +453,9 @@ class SchedulingQueue:
                 n, a = out.get(gang, (0, 1 << 30))
                 out[gang] = (n + 1, min(a, qpi.attempts))
 
-            for item in self._active:
-                count(item.qpi)
+            for heap in self._active.values():
+                for item in heap:
+                    count(item.qpi)
             for _, _, qpi in self._backoff:
                 count(qpi)
             for qpi in self._unschedulable.values():
@@ -324,15 +472,19 @@ class SchedulingQueue:
         with :meth:`readd`."""
         taken: list[QueuedPodInfo] = []
         with self._cond:
-            keep_active: list[_HeapItem] = []
-            for item in self._active:
-                if gang_name_of(item.qpi.pod.labels) == gang:
-                    taken.append(item.qpi)
-                else:
-                    keep_active.append(item)
-            if len(keep_active) != len(self._active):
-                heapq.heapify(keep_active)
-                self._active = keep_active
+            for tenant, heap in list(self._active.items()):
+                kept: list[_HeapItem] = []
+                for item in heap:
+                    if gang_name_of(item.qpi.pod.labels) == gang:
+                        taken.append(item.qpi)
+                    else:
+                        kept.append(item)
+                if len(kept) != len(heap):
+                    if kept:
+                        heapq.heapify(kept)
+                        self._active[tenant] = kept
+                    else:
+                        del self._active[tenant]
             keep_backoff: list[tuple[float, int, QueuedPodInfo]] = []
             for entry in self._backoff:
                 if gang_name_of(entry[2].pod.labels) == gang:

@@ -586,7 +586,12 @@ class Scheduler:
             self.metrics.binds.inc()
         if self.on_bound:
             self.on_bound(pod, node_name)
-        self.queue.move_all_to_active()  # cluster changed: retry parked pods
+        # Cluster changed: retry parked pods. Skipped when nothing is
+        # parked — on a drained queue the sweep (a full lock + heap walk)
+        # would run once per bind to move nothing (ISSUE 10 quick fix,
+        # same guard as the event path in standalone.build_stack).
+        if self.queue.has_parked():
+            self.queue.move_all_to_active()
         return done("bound", node=node_name)
 
     def _clear_stale_nomination(self, pod: PodSpec, node: str) -> None:
@@ -687,7 +692,8 @@ class Scheduler:
                 if self.on_bound:
                     self.on_bound(pod, wp.node_name)
                 self._clear_stale_nomination(pod, wp.node_name)
-                self.queue.move_all_to_active()
+                if self.queue.has_parked():  # see _bind: skip empty sweeps
+                    self.queue.move_all_to_active()
                 return
             self._handle_bind_failure(wp, st)
             status = st

@@ -455,6 +455,32 @@ class SchedulingMetrics:
             "(sum over victims of (max(priority,0)+1) x chips) — the cost "
             "side of preemptive admission",
         )
+        # Batched watch-event ingestion + tenant fair queuing (ISSUE 10,
+        # docs/OPERATIONS.md multi-tenancy runbook): raw events through
+        # the ingest pipeline, coalesced events applied per batch (size 1
+        # everywhere with batching off), and queue entries parked by
+        # per-tenant quota admission. The companion per-tenant
+        # yoda_tenant_dominant_share gauge reads the TenantLedger and is
+        # registered in standalone.build_stack (accumulator pattern).
+        self.ingest_events = r.counter(
+            "yoda_ingest_events_total",
+            "Watch events entering the batched ingest pipeline, counted "
+            "before coalescing (the batch-size histogram counts after)",
+        )
+        self.ingest_batch = r.histogram(
+            "yoda_ingest_batch_size",
+            "Coalesced events applied per ingest batch under one informer "
+            "lock acquisition (sitting at 1 = batching off or an idle "
+            "stream; the amortization win is the mean of this series)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
+        )
+        self.tenant_quota_parks = r.counter(
+            "yoda_tenant_quota_parks_total",
+            "Queue entries parked by per-tenant quota admission (they "
+            "re-enter and re-check when capacity frees); a climbing rate "
+            "with flat binds means a tenant is submitting far past its "
+            "quota",
+        )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)
         # Ring-overflow accounting for BOTH bounded trace surfaces: the

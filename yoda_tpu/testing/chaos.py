@@ -251,11 +251,13 @@ class ChaosCluster:
         if self.partitioned:
             raise ChaosTimeout(f"chaos: cluster partitioned: {detail}")
 
-    def add_watcher(self, fn, *, replay: bool = True) -> None:
+    def add_watcher(self, fn, *, replay: bool = True, batch_fn=None) -> None:
         """Register ``fn`` behind the partition gate: events raised while
         partitioned/lost are dropped in transit (counted), exactly as a
         severed watch stream loses them — the drift the rejoin resync
-        must repair."""
+        must repair. Batch deliveries (the ingest pipeline's list
+        plumbing) are gated whole: a partitioned stream loses the entire
+        run in transit."""
 
         def gated(event) -> None:
             if self.partitioned:
@@ -263,7 +265,16 @@ class ChaosCluster:
                 return
             fn(event)
 
-        self._inner.add_watcher(gated, replay=replay)
+        gated_batch = None
+        if batch_fn is not None:
+
+            def gated_batch(events) -> None:
+                if self.partitioned:
+                    self.dropped_events += len(events)
+                    return
+                batch_fn(events)
+
+        self._inner.add_watcher(gated, replay=replay, batch_fn=gated_batch)
 
     def probe(self) -> None:
         """The health monitor's probe: times out while partitioned/lost
