@@ -72,7 +72,7 @@ rebalance-bench:
 # seed via CHAOS_SEED (the test reads its default from the source; the
 # seed is printed on failure for replay).
 chaos:
-	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py tests/test_rebalance.py tests/test_tenancy.py -q
+	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py tests/test_rebalance.py tests/test_tenancy.py tests/test_node_health.py -q
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

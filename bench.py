@@ -933,6 +933,129 @@ def _degraded_chaos_scenario(
     }
 
 
+def _node_failure_repair_scenario(*, slices: int = 3, kill: int = 2) -> dict:
+    """Node failure domains (yoda_tpu/nodehealth): kill K hosts under a
+    bound fleet of ICI-row topology gangs and let the health monitor
+    repair every affected gang whole. Run twice over the same shape —
+    patch repair on (lost members re-plan into the same slice, healthy
+    members keep their bindings) vs forced whole-requeue — to prove the
+    patch demonstrably cheaper: it re-binds ONE pod per killed host where
+    the requeue re-binds the whole gang.
+
+    Reported fields:
+      node_repair_p99_ms            per-gang repair pass wall p99
+      node_repair_time_to_whole_ms  kill -> every gang whole again
+      node_repair_pods_per_s        re-binds completed / repair wall
+      node_repair_patch_rebinds     binds paid with patch repair on
+      node_repair_requeue_rebinds   binds paid with whole-requeue forced
+      node_repair_patch_gangs       gangs repaired by patch
+    """
+    import time as _time
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    def run(patch: bool) -> dict:
+        stack = build_stack(
+            config=SchedulerConfig(
+                mode="batch",
+                enable_preemption=False,
+                rebalance_period_s=0,
+            )
+        )
+        stack.nodehealth.patch_repair = patch
+        agent = FakeTpuAgent(stack.cluster)
+        # 6-host ICI rows; each gang takes a 4-host block, leaving two
+        # in-slice spares — the patch target when a block host dies.
+        for s in range(slices):
+            agent.add_slice(
+                f"nf{s}", generation="v5p", host_topology=(6, 1, 1),
+                chips_per_host=4,
+            )
+        agent.publish_all()
+        n_pods = 0
+        for s in range(slices):
+            labels = {
+                "tpu/gang": f"nfg-{s}", "tpu/topology": "4",
+                "tpu/chips": "4",
+            }
+            for i in range(4):
+                stack.cluster.create_pod(
+                    PodSpec(f"nfg-{s}-{i}", labels=dict(labels))
+                )
+                n_pods += 1
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        bound = [p for p in stack.cluster.list_pods() if p.node_name]
+        assert len(bound) == n_pods, f"{len(bound)}/{n_pods} bound pre-kill"
+        binds_before = stack.metrics.binds.value()
+        survivors = {p.key: p.node_name for p in bound}
+        t0 = _time.monotonic()
+        for s in range(kill):
+            # The block's origin host dies (Node + CR deleted).
+            stack.cluster.kill_node(f"nf{s}-0")
+        whole = False
+        for _ in range(8):
+            stack.nodehealth.run_once()
+            stack.scheduler.run_until_idle(max_wall_s=30)
+            if (
+                len([p for p in stack.cluster.list_pods() if p.node_name])
+                == n_pods
+            ):
+                whole = True
+                break
+        dt = _time.monotonic() - t0
+        assert whole, "repair did not re-complete every gang"
+        # Invariants: never a deleted pod, never a split gang, nothing
+        # left on a dead node, no oversubscription.
+        assert len(stack.cluster.list_pods()) == n_pods
+        dead = {f"nf{s}-0" for s in range(kill)}
+        for p in stack.cluster.list_pods():
+            assert p.node_name not in dead
+        for t in stack.cluster.list_tpu_metrics():
+            assert stack.accountant.chips_in_use(t.name) <= len(t.chips)
+        kept = sum(
+            1
+            for p in stack.cluster.list_pods()
+            if survivors.get(p.key) == p.node_name
+            and p.node_name not in dead
+        )
+        rebinds = stack.metrics.binds.value() - binds_before
+        return {
+            "rebinds": int(rebinds),
+            "kept": kept,
+            "wall_ms": dt * 1e3,
+            "p99_ms": stack.metrics.repair_duration.quantile(0.99),
+            "patch_gangs": int(
+                stack.metrics.gang_repairs.value(mode="patch")
+            ),
+        }
+
+    patched = run(True)
+    requeued = run(False)
+    # The acceptance claim: patch repair is demonstrably cheaper — healthy
+    # members keep their bindings when a same-slice replacement exists.
+    assert patched["rebinds"] < requeued["rebinds"], (
+        f"patch repair not cheaper: {patched['rebinds']} vs "
+        f"{requeued['rebinds']} rebinds"
+    )
+    assert patched["patch_gangs"] == kill
+    assert patched["kept"] > requeued["kept"]
+    return {
+        "node_repair_p99_ms": round(patched["p99_ms"], 2),
+        "node_repair_time_to_whole_ms": round(patched["wall_ms"], 1),
+        "node_repair_pods_per_s": round(
+            patched["rebinds"] / (patched["wall_ms"] / 1e3), 1
+        )
+        if patched["wall_ms"] > 0
+        else 0.0,
+        "node_repair_patch_rebinds": patched["rebinds"],
+        "node_repair_requeue_rebinds": requeued["rebinds"],
+        "node_repair_patch_gangs": patched["patch_gangs"],
+    }
+
+
 def _federated_spillover_scenario(
     *, gangs: int = 2, remote_hosts: int = 8, chips: int = 4
 ) -> dict:
@@ -2132,6 +2255,8 @@ def run_bench() -> dict:
     print(f"pipelined bind fan-out vs serial: {bindpipe}", file=sys.stderr)
     fedspill = _federated_spillover_scenario()
     print(f"federated spillover (home full -> secondary): {fedspill}", file=sys.stderr)
+    noderepair = _node_failure_repair_scenario()
+    print(f"node-failure gang repair (patch vs requeue): {noderepair}", file=sys.stderr)
     obs = _observability_overhead_scenario()
     print(f"lifecycle-tracing overhead (off/sampled/full): {obs}", file=sys.stderr)
     http = _http_gang_scenario()
@@ -2165,6 +2290,7 @@ def run_bench() -> dict:
         **degraded,
         **bindpipe,
         **fedspill,
+        **noderepair,
         **obs,
         **http,
         **probe,
@@ -2194,6 +2320,7 @@ def run_smoke() -> dict:
     out.update(_degraded_chaos_scenario(hosts=4, gangs=2, singles=8))
     out.update(_bind_latency_scenario())
     out.update(_federated_spillover_scenario(gangs=2, remote_hosts=8))
+    out.update(_node_failure_repair_scenario(slices=2, kill=1))
     out.update(_rebalance_churn_scenario(rounds=16, seed=7))
     out.update(_preemption_admit_scenario(hosts=2))
     out.update(_multi_tenant_churn_scenario(rounds=4, hosts=2))

@@ -59,6 +59,20 @@ def test_degraded_chaos_scenario_invariants():
     )
 
 
+def test_node_failure_repair_scenario_invariants():
+    import bench
+
+    # The scenario asserts its own invariants inline (every gang whole
+    # again, nothing on a dead node, no deleted pods, no
+    # oversubscription, patch strictly cheaper than whole requeue); here
+    # we pin the reported evidence shape.
+    out = bench._node_failure_repair_scenario(slices=2, kill=1)
+    assert out["node_repair_patch_rebinds"] < out["node_repair_requeue_rebinds"]
+    assert out["node_repair_patch_gangs"] == 1
+    assert out["node_repair_time_to_whole_ms"] > 0
+    assert out["node_repair_p99_ms"] >= 0
+
+
 def test_bind_latency_pipeline_speedup():
     import bench
 

@@ -101,6 +101,20 @@ class TestSchedulerManifest:
         assert cfg.rebalance_preemption is True
         assert cfg.rebalance_elastic is True
 
+    def test_configmap_node_health_knobs_validate(self):
+        """The shipped node-failure-domain knobs must pass
+        SchedulerConfig's ladder validation (0 < suspect <= down) and
+        ship with repair + the background loop enabled — a drifted
+        ConfigMap would crash-loop the Deployment."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert 0 < cfg.node_suspect_after_s <= cfg.node_down_after_s
+        assert cfg.node_repair is True
+        assert cfg.node_drain_deadline_s > 0
+        assert cfg.node_health_period_s > 0
+
     def test_configmap_trace_knobs_validate(self):
         """The shipped tracing knobs must pass SchedulerConfig validation
         and ship with full sampling on (the near-zero-overhead default

@@ -1,0 +1,634 @@
+"""Node failure domains (yoda_tpu/nodehealth): the per-node health
+ladder, gang-whole repair, and graceful drain.
+
+- ladder transitions with debounce: silence fences (SUSPECT), a resumed
+  heartbeat recovers — a FLAPPING heartbeat never triggers repair;
+  continuous silence / deletion / NotReady is DOWN;
+- fencing rides the existing host_ok admission vector: SUSPECT/DOWN/
+  DRAINING hosts take no new placements (batch bursts, gang plans, the
+  loop-mode filter chain);
+- DOWN repair goes through the transactional primitives, whole-gang
+  semantics preserved: patch repair re-plans ONLY the lost members into
+  the same ICI block (healthy members keep their bindings), elastic
+  gangs shrink toward tpu/min-members, fallback whole-requeue — never a
+  split gang, never a deleted pod;
+- ghost reservations of pods bound to a deleted node release at EVENT
+  time;
+- DRAINING: the rebalancer migrates bound gangs off proactively; the
+  deadline force-evacuates the remainder;
+- a seeded node_death / heartbeat_stop / chip_degrade sweep (slow, in
+  `make chaos`) holding the accounting invariants.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.requests import LabelParseError, gang_name_of, pod_request
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.nodehealth import NodeState
+from yoda_tpu.standalone import build_stack
+from yoda_tpu.testing.chaos import ChaosPlan, maybe_node_fault
+
+
+class FakeNow:
+    """One wall clock shared by the agent's publish stamps and the
+    monitor's staleness reads — silence is advanced, never slept."""
+
+    def __init__(self, t: float = 1_000_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_stack(cluster=None, *, now: "FakeNow | None" = None, **cfg):
+    cfg.setdefault("enable_preemption", False)
+    cfg.setdefault("node_suspect_after_s", 10.0)
+    cfg.setdefault("node_down_after_s", 30.0)
+    stack = build_stack(cluster=cluster, config=SchedulerConfig(**cfg))
+    agent = FakeTpuAgent(
+        stack.cluster, now_fn=now if now is not None else time.time
+    )
+    if now is not None:
+        stack.nodehealth.now_fn = now
+    return stack, agent
+
+
+def plain_gang(tag, n, chips=4, extra=None):
+    labels = {
+        "tpu/gang": tag, "tpu/gang-size": str(n), "tpu/chips": str(chips),
+    }
+    labels.update(extra or {})
+    return [PodSpec(f"{tag}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+def topo_gang(tag, shape, chips=4):
+    size = 1
+    for d in shape.split("x"):
+        size *= int(d)
+    labels = {"tpu/gang": tag, "tpu/topology": shape, "tpu/chips": str(chips)}
+    return [PodSpec(f"{tag}-{i}", labels=dict(labels)) for i in range(size)]
+
+
+def bound_map(stack) -> dict:
+    return {
+        p.name: p.node_name for p in stack.cluster.list_pods() if p.node_name
+    }
+
+
+def assert_no_oversubscription(stack):
+    # Capacity = TOTAL chips: a chip degrading UNDER a bound pod drops
+    # healthy capacity below committed work — that is the DEGRADED state
+    # (observational), not double-booking. Placement-time health is
+    # enforced by admission; this invariant catches double-booking.
+    caps = {
+        t.name: len(t.chips) for t in stack.cluster.list_tpu_metrics()
+    }
+    used: dict = {}
+    for p in stack.cluster.list_pods():
+        if not p.node_name:
+            continue
+        try:
+            chips = pod_request(p).effective_chips
+        except LabelParseError:
+            chips = 0
+        used[p.node_name] = used.get(p.node_name, 0) + chips
+    for host, n in used.items():
+        assert n <= caps.get(host, 0), f"{host}: {n}/{caps.get(host, 0)}"
+    for host, cap in caps.items():
+        assert stack.accountant.chips_in_use(host) <= cap
+
+
+def assert_no_split_gangs(stack):
+    by_gang: dict = {}
+    for p in stack.cluster.list_pods():
+        g = gang_name_of(p.labels)
+        if g:
+            by_gang.setdefault(g, []).append(p)
+    for g, members in by_gang.items():
+        spec = next(
+            (
+                pod_request(p).gang
+                for p in members
+                if pod_request(p).gang is not None
+            ),
+            None,
+        )
+        if spec is None:
+            continue
+        bound = sum(1 for p in members if p.node_name)
+        floor = spec.floor if spec.elastic else spec.size
+        ceiling = spec.ceiling if spec.elastic else spec.size
+        assert bound == 0 or floor <= bound <= ceiling, (
+            f"gang {g} split at settle: {bound} bound, "
+            f"allowed 0 or [{floor}, {ceiling}]"
+        )
+
+
+class TestLadder:
+    def test_flapping_heartbeat_debounces_no_repair(self):
+        """Silence past suspect_after fences the node; a publish inside
+        the debounce window returns it to HEALTHY — no repair, no unbind,
+        the bound pod never moves."""
+        now = FakeNow()
+        stack, agent = make_stack(now=now)
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.add_host("h1", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p0", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        victim = bound_map(stack)["p0"]
+        agent.stop_heartbeat(victim)
+        spared = "h1" if victim == "h0" else "h0"
+        # Within the window: still HEALTHY (debounce has not even begun).
+        now.advance(5.0)
+        agent.publish_all()  # the live host keeps heartbeating
+        stack.nodehealth.run_once()
+        assert stack.nodehealth.state_of(victim) is NodeState.HEALTHY
+        # Past suspect_after: fenced, but nothing is repaired.
+        now.advance(10.0)
+        agent.publish_all()
+        rep = stack.nodehealth.run_once()
+        assert stack.nodehealth.state_of(victim) is NodeState.SUSPECT
+        assert victim in stack.nodehealth.fenced_nodes()
+        assert rep.repaired == 0 and not rep.singles
+        # A new pod lands on the spared host — SUSPECT takes no NEW work.
+        stack.cluster.create_pod(PodSpec("p1", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert bound_map(stack)["p1"] == spared
+        # The heartbeat resumes inside the debounce window: HEALTHY
+        # again, the bound pod untouched, zero repairs ever fired.
+        agent.resume_heartbeat(victim)
+        rep = stack.nodehealth.run_once()
+        assert stack.nodehealth.state_of(victim) is NodeState.HEALTHY
+        assert victim not in stack.nodehealth.fenced_nodes()
+        assert bound_map(stack)["p0"] == victim
+        assert stack.metrics.gang_repairs.total() == 0
+        assert rep.repaired == 0 and not rep.singles
+
+    def test_continuous_silence_is_down_and_repairs_singleton(self):
+        now = FakeNow()
+        stack, agent = make_stack(now=now)
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.add_host("h1", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p0", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        victim = bound_map(stack)["p0"]
+        spared = "h1" if victim == "h0" else "h0"
+        agent.stop_heartbeat(victim)
+        now.advance(15.0)
+        agent.publish_all()  # the live host keeps heartbeating
+        now.advance(16.0)
+        agent.publish_all()
+        rep = stack.nodehealth.run_once()
+        assert stack.nodehealth.state_of(victim) is NodeState.DOWN
+        assert stack.nodehealth.state_of(spared) is NodeState.HEALTHY
+        assert rep.singles == ["default/p0"]
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # Requeued (never deleted) and re-placed off the dead host.
+        assert bound_map(stack)["p0"] == spared
+        assert_no_oversubscription(stack)
+        # Why-pending carries the node-repair verdict until the re-bind
+        # retired it; the trace carries the repair chapter.
+        assert stack.metrics.pending.explain("default/p0") is None  # rebound
+
+    def test_chip_degrade_is_observational_not_fenced(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        agent.fail_chips("h0", [0, 1])
+        assert stack.nodehealth.state_of("h0") is NodeState.DEGRADED
+        assert "h0" not in stack.nodehealth.fenced_nodes()
+        # Still serves: 6 healthy chips remain.
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert bound_map(stack)["p"] == "h0"
+        agent.heal_chips("h0", [0, 1])
+        assert stack.nodehealth.state_of("h0") is NodeState.HEALTHY
+
+    def test_not_ready_is_down_at_event_time_and_recovers(self):
+        now = FakeNow()
+        stack, agent = make_stack(now=now)
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.set_node_ready("h0", False)
+        assert stack.nodehealth.state_of("h0") is NodeState.DOWN
+        assert "h0" in stack.nodehealth.fenced_nodes()
+        stack.cluster.set_node_ready("h0", True)
+        agent.refresh("h0")  # fresh publish + Ready: back on the ladder
+        stack.nodehealth.run_once()
+        assert stack.nodehealth.state_of("h0") is NodeState.HEALTHY
+
+    def test_deletion_is_down_and_readd_recovers(self):
+        now = FakeNow()
+        stack, agent = make_stack(now=now)
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.delete_tpu_metrics("h0")
+        assert stack.nodehealth.state_of("h0") is NodeState.DOWN
+        agent.refresh("h0")  # CR re-added (host replaced/rebooted)
+        stack.nodehealth.run_once()
+        assert stack.nodehealth.state_of("h0") is NodeState.HEALTHY
+
+
+class TestGhostRelease:
+    def test_deleted_node_releases_bound_claims_at_event_time(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "3"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        uid = stack.cluster.list_pods()[0].uid
+        assert stack.accountant.has_claim(uid)
+        # Event time — no monitor pass, no reconcile round.
+        stack.cluster.kill_node("h0")
+        assert not stack.accountant.has_claim(uid)
+        assert stack.metrics.node_ghost_releases.value() == 1
+        assert stack.accountant.chips_in_use("h0") == 0
+
+
+class TestGangRepair:
+    def test_topology_patch_keeps_healthy_members_bound(self):
+        """A 2-host ICI block loses one host; the patch re-plans ONLY the
+        lost member into the same slice (healthy member pinned) — its
+        sibling never unbinds."""
+        stack, agent = make_stack()
+        agent.add_slice("s", generation="v5p", host_topology=(4, 1, 1))
+        agent.publish_all()
+        for p in topo_gang("g", "2"):
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = bound_map(stack)
+        assert sorted(bound.values()) == ["s-0", "s-1"]
+        binds_before = stack.metrics.binds.value()
+        survivor_pod = next(n for n, h in bound.items() if h == "s-1")
+        stack.cluster.kill_node("s-0")
+        rep = stack.nodehealth.run_once()
+        assert rep.patched == ["g"] and not rep.requeued
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        after = bound_map(stack)
+        # Healthy member kept its binding; the lost one re-placed onto a
+        # live host of the SAME slice (contiguous with the survivor).
+        assert after[survivor_pod] == "s-1"
+        assert set(after.values()) == {"s-1", "s-2"}
+        # Exactly ONE rebind paid — the patch dividend.
+        assert stack.metrics.binds.value() == binds_before + 1
+        assert stack.metrics.gang_repairs.value(mode="patch") == 1
+        assert len(stack.cluster.list_pods()) == 2  # never a deleted pod
+        assert_no_oversubscription(stack)
+        assert_no_split_gangs(stack)
+
+    def test_plain_gang_patch_requeues_only_lost_member(self):
+        stack, agent = make_stack()
+        for h in ("h0", "h1", "h2"):
+            agent.add_host(h, generation="v5e", chips=4)
+        agent.publish_all()
+        for p in plain_gang("g", 2, chips=4):
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        bound = bound_map(stack)
+        victim_host = bound["g-0"]
+        survivor, survivor_host = next(
+            (n, h) for n, h in bound.items() if n != "g-0"
+        )
+        stack.cluster.kill_node(victim_host)
+        rep = stack.nodehealth.run_once()
+        assert rep.patched == ["g"]
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        after = bound_map(stack)
+        assert after[survivor] == survivor_host  # kept
+        assert after["g-0"] not in (victim_host, None)
+        assert_no_split_gangs(stack)
+
+    def test_fallback_whole_requeue_when_no_replacement_capacity(self):
+        """No live capacity for the lost member: the gang requeues WHOLE
+        (healthy member's chips free up), then completes whole when a
+        replacement host appears."""
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        for p in plain_gang("g", 2, chips=4):
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_map(stack)) == 2
+        # The agent forgets the host too (a republish must not resurrect
+        # the CR — this host is gone for good).
+        agent.remove_host("h1")
+        stack.cluster.delete_node("h1")
+        rep = stack.nodehealth.run_once()
+        assert rep.requeued == ["g"] and not rep.patched
+        assert bound_map(stack) == {}  # whole gang unbound, none deleted
+        assert len(stack.cluster.list_pods()) == 2
+        assert stack.metrics.gang_repairs.value(mode="requeue") == 1
+        # Why-pending: the gang carries a node-repair verdict until the
+        # re-bind retires it, and the lifecycle trace carries the repair
+        # chapter (one `repair` span with detect/fence/requeue children).
+        entry = stack.metrics.pending.explain("g")
+        assert entry is not None and entry["kind"] == "node-repair"
+        recs = stack.metrics.tracer.records(subject="gang:g")
+        by_name = {r.name for r in recs}
+        assert {"repair", "repair-detect", "repair-fence",
+                "repair-requeue"} <= by_name
+        repair = next(r for r in recs if r.name == "repair")
+        children = {
+            r.name for r in recs if r.parent_id == repair.span_id
+        }
+        assert {"repair-detect", "repair-fence", "repair-requeue"} <= children
+        # Replacement capacity arrives: the gang returns whole.
+        agent.add_host("h2", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert sorted(bound_map(stack).values()) == ["h0", "h2"]
+        assert_no_oversubscription(stack)
+        assert_no_split_gangs(stack)
+
+    def test_elastic_gang_shrinks_toward_floor_instead_of_requeue(self):
+        stack, agent = make_stack()
+        for h in ("h0", "h1", "h2"):
+            agent.add_host(h, generation="v5e", chips=4)
+        agent.publish_all()
+        for p in plain_gang(
+            "e", 3, chips=4,
+            extra={"tpu/min-members": "2", "tpu/max-members": "3"},
+        ):
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_map(stack)) == 3
+        victim_host = bound_map(stack)["e-2"]
+        stack.cluster.kill_node(victim_host)
+        rep = stack.nodehealth.run_once()
+        assert rep.shrunk == ["e"] and not rep.requeued
+        assert stack.gang.effective_size("e") == 2
+        survivors = bound_map(stack)
+        assert len(survivors) == 2 and victim_host not in survivors.values()
+        assert stack.metrics.gang_repairs.value(mode="shrink") == 1
+        assert_no_split_gangs(stack)
+
+    def test_repair_defers_while_members_wait_at_permit(self):
+        """A gang mid-flight (members parked at Permit) is not repaired
+        out from under its own release — the pass defers and stays
+        armed."""
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        # One member already BOUND on h0 (a restart-replayed bind), a
+        # second admits and parks at Permit waiting for the still-absent
+        # third: the gang is mid-flight.
+        pods = plain_gang("g", 3, chips=2)
+        pods[0].node_name = "h0"
+        pods[0].phase = "Running"
+        stack.cluster.create_pod(pods[0])
+        stack.cluster.create_pod(pods[1])
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.gang.gang_status("g")[1] >= 1  # waiting at Permit
+        # Force a DOWN mark for h0 without tearing the CR down.
+        stack.cluster.set_node_ready("h0", False)
+        rep = stack.nodehealth.run_once()
+        assert rep.deferred == ["g"] and rep.repaired == 0
+
+
+class TestDrain:
+    def test_drain_fences_and_rebalancer_migrates_gang_off(self):
+        stack, agent = make_stack()
+        agent.add_slice("s", generation="v5p", host_topology=(4, 1, 1))
+        agent.publish_all()
+        for p in topo_gang("g", "2"):
+            stack.cluster.create_pod(p)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert sorted(bound_map(stack).values()) == ["s-0", "s-1"]
+        stack.nodehealth.drain("s-0")
+        assert stack.nodehealth.state_of("s-0") is NodeState.DRAINING
+        assert "s-0" in stack.nodehealth.fenced_nodes()
+        report = stack.rebalancer.run_once()
+        assert report.drained == ["g"]
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        after = bound_map(stack)
+        assert "s-0" not in after.values()
+        assert len(after) == 2  # whole gang re-placed
+        assert stack.metrics.gang_repairs.value(mode="drain") == 1
+        assert_no_split_gangs(stack)
+        assert_no_oversubscription(stack)
+        # New placements avoid the draining node even when it is free.
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert bound_map(stack)["p"] != "s-0"
+
+    def test_drain_deadline_force_evacuates(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "2"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        host = bound_map(stack)["p"]
+        stack.nodehealth.drain(host, deadline_s=0.0)
+        rep = stack.nodehealth.run_once()  # deadline already passed
+        assert rep.singles == ["default/p"]
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert bound_map(stack)["p"] != host
+
+    def test_cancel_drain_reopens_the_node(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.nodehealth.drain("h0")
+        assert "h0" in stack.nodehealth.fenced_nodes()
+        stack.nodehealth.cancel_drain("h0")
+        assert "h0" not in stack.nodehealth.fenced_nodes()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert bound_map(stack)["p"] == "h0"
+
+
+class TestDownDuringBindFanout:
+    def test_node_death_mid_fanout_never_splits_the_gang(self):
+        """A host dies while a gang's binds are in flight on the pipeline:
+        whatever interleaving lands, the gang settles whole-or-nothing
+        and subsequent monitor passes repair it whole."""
+        cluster = FakeCluster(bind_latency_s=0.02)
+        stack, agent = make_stack(
+            cluster=cluster, bind_pipeline="on", bind_workers=4
+        )
+        for h in ("h0", "h1", "h2", "h3", "h4"):
+            agent.add_host(h, generation="v5e", chips=4)
+        agent.publish_all()
+        for p in plain_gang("g", 4, chips=4):
+            stack.cluster.create_pod(p)
+        t = threading.Thread(
+            target=lambda: stack.scheduler.run_until_idle(max_wall_s=10)
+        )
+        t.start()
+        # Wait for the release fan-out to start, then kill an assigned
+        # host mid-flight.
+        victim = None
+        deadline = time.monotonic() + 5
+        while victim is None and time.monotonic() < deadline:
+            placements = stack.gang.pending_placements()
+            if placements:
+                victim = placements[0][0]
+            else:
+                time.sleep(0.002)
+        if victim is not None:
+            stack.cluster.kill_node(victim)
+        t.join(timeout=15)
+        assert not t.is_alive()
+        for _ in range(5):
+            stack.nodehealth.run_once()
+            stack.scheduler.run_until_idle(max_wall_s=10)
+            assert_no_oversubscription(stack)
+            assert_no_split_gangs(stack)
+        # Fleet still has 4 live hosts x 4 chips: the gang must be whole.
+        assert len(bound_map(stack)) == 4
+        if victim is not None:
+            assert victim not in bound_map(stack).values()
+
+
+class TestFakeHelpers:
+    def test_stop_heartbeat_freezes_last_updated(self):
+        now = FakeNow()
+        stack, agent = make_stack(now=now)
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.publish_all()
+        t0 = stack.informer.last_updated_map()["h0"]
+        agent.stop_heartbeat("h0")
+        now.advance(100.0)
+        agent.publish_all()
+        assert stack.informer.last_updated_map()["h0"] == t0
+        agent.resume_heartbeat("h0")
+        assert stack.informer.last_updated_map()["h0"] == t0 + 100.0
+
+    def test_node_ready_round_trips_through_serialization(self):
+        from yoda_tpu.api.types import K8sNode
+
+        node = K8sNode(name="n", ready=False)
+        assert K8sNode.from_obj(node.to_obj()).ready is False
+        ready = K8sNode(name="n")
+        obj = ready.to_obj()
+        assert "conditions" not in (obj.get("status") or {})
+        assert K8sNode.from_obj(obj).ready is True
+
+    def test_maybe_node_fault_is_deterministic(self):
+        from yoda_tpu.testing.chaos import FaultSpec
+
+        cluster = FakeCluster()
+        agent = FakeTpuAgent(cluster)
+        for h in ("a", "b", "c"):
+            agent.add_host(h, generation="v5e", chips=4)
+        agent.publish_all()
+        plan = ChaosPlan(
+            [
+                FaultSpec(op="node_death", at=1, kind="death"),
+                FaultSpec(op="heartbeat_stop", at=0, kind="flap"),
+            ]
+        )
+        fired = maybe_node_fault(plan, agent, cluster)
+        assert fired == [("heartbeat_stop", "flap", "a")]
+        fired = maybe_node_fault(plan, agent, cluster)
+        assert fired == [("node_death", "death", "b")]
+        assert {t.name for t in cluster.list_tpu_metrics()} == {"a", "c"}
+
+
+@pytest.mark.slow
+class TestNodeFailureSweep:
+    def test_seeded_sweep_holds_invariants(self):
+        """Seeded node_death / heartbeat_stop / chip_degrade storm over a
+        churning bound fleet: zero oversubscription, zero split gangs,
+        zero leaked reservations, every affected gang repaired or
+        requeued whole within a bounded number of passes, and flapped
+        heartbeats never cause a repair."""
+        seed = int(os.environ.get("CHAOS_SEED", "20260804"))
+        now = FakeNow()
+        stack, agent = make_stack(
+            now=now, node_suspect_after_s=10.0, node_down_after_s=30.0
+        )
+        # Any patch that cannot complete escalates to whole-requeue on
+        # the very next pass — the sweep asserts whole-or-nothing at
+        # every settle point, so no patch may linger partial.
+        stack.nodehealth.patch_grace_s = 0.0
+        for s in range(3):
+            agent.add_slice(
+                f"s{s}", generation="v5e", host_topology=(4, 1, 1),
+                chips_per_host=4,
+            )
+        agent.publish_all()
+        plan = ChaosPlan.seeded(
+            seed,
+            ops=("node_death", "heartbeat_stop", "chip_degrade"),
+            horizon=8,
+            rate=0.6,
+        )
+        flapped: set[str] = set()
+        genuinely_dead: set[str] = set()
+        for rnd in range(8):
+            # Arrivals: one plain gang + singletons per round.
+            for p in plain_gang(f"g{rnd}", 2, chips=2):
+                try:
+                    stack.cluster.create_pod(p)
+                except ValueError:
+                    pass
+            stack.cluster.create_pod(
+                PodSpec(f"one-{rnd}", labels={"tpu/chips": "1"})
+            )
+            stack.scheduler.run_until_idle(max_wall_s=10)
+            fired = maybe_node_fault(plan, agent, stack.cluster)
+            for op, kind, node in fired:
+                if op == "heartbeat_stop" and kind == "flap":
+                    flapped.add(node)
+                elif op in ("node_death", "heartbeat_stop"):
+                    genuinely_dead.add(node)
+            # Time passes: flaps resume INSIDE the debounce window
+            # (silence < down_after), real deaths cross it.
+            now.advance(15.0)
+            agent.publish_all()
+            for node in list(flapped):
+                agent.resume_heartbeat(node)
+                flapped.discard(node)
+            stack.nodehealth.run_once()
+            now.advance(20.0)
+            agent.publish_all()
+            for _ in range(4):
+                stack.nodehealth.run_once()
+                stack.scheduler.run_until_idle(max_wall_s=10)
+            assert_no_oversubscription(stack)
+            assert_no_split_gangs(stack)
+            # Leaked reservations: every claim has a live pod behind it.
+            live = {p.uid for p in stack.cluster.list_pods()}
+            waiting = {
+                w.pod.uid for w in stack.framework.waiting_pods()
+            }
+            assert stack.accountant.claimed_uids() <= (live | waiting)
+        # Bounded time-to-repair: after the storm settles, no pod of ours
+        # remains bound on a genuinely dead node.
+        for _ in range(4):
+            stack.nodehealth.run_once()
+            stack.scheduler.run_until_idle(max_wall_s=10)
+        for p in stack.cluster.list_pods():
+            assert p.node_name not in genuinely_dead, (
+                f"{p.key} still bound to dead node {p.node_name} "
+                f"(seed {seed}, fired {plan.fired})"
+            )
+        # Flap debounce: flapped-and-resumed nodes are HEALTHY (never
+        # repaired away) unless a LATER fault genuinely killed them.
+        states = stack.nodehealth.states()
+        for node, st in states.items():
+            if node in genuinely_dead:
+                continue
+            assert st in (
+                NodeState.HEALTHY, NodeState.DEGRADED
+            ), f"live node {node} stuck {st} (seed {seed})"
+        assert_no_oversubscription(stack)
+        assert_no_split_gangs(stack)

@@ -158,6 +158,7 @@ ALL_METRIC_FAMILIES = (
     "yoda_dispatch_fallback_total",
     "yoda_events_dropped_total",
     "yoda_fragmentation_score",
+    "yoda_gang_repairs_total",
     "yoda_gang_fused_dispatches_total",
     "yoda_gang_fused_invalidated_total",
     "yoda_gang_fused_served_total",
@@ -172,6 +173,9 @@ ALL_METRIC_FAMILIES = (
     "yoda_kernel_dispatch_floor_ms",
     "yoda_kernel_dispatches_total",
     "yoda_kernel_on_accelerator",
+    "yoda_node_ghost_releases_total",
+    "yoda_node_state",
+    "yoda_node_transitions_total",
     "yoda_overlap_cycles_total",
     "yoda_preempted_priority_weight_total",
     "yoda_preemptions_total",
@@ -189,6 +193,7 @@ ALL_METRIC_FAMILIES = (
     "yoda_recovery_fenced_binds_total",
     "yoda_recovery_gang_rollbacks_total",
     "yoda_recovery_unbinds_total",
+    "yoda_repair_duration_ms",
     "yoda_restack_total",
     "yoda_resync_adopted_gangs",
     "yoda_resync_duration_ms",
@@ -277,6 +282,41 @@ class TestIngestAndTenantMetrics:
         # Why-pending verdict recorded for the parked pod.
         entry = stack.metrics.pending.explain("team-a/a2")
         assert entry is not None and entry["kind"] == "quota-park"
+
+
+class TestNodeHealthMetrics:
+    """Node failure domains: the ladder/repair series carry real values
+    when a node dies under bound work (the schema itself is covered by
+    the default-stack render test above)."""
+
+    def test_node_death_populates_ladder_and_ghost_series(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        for i in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{i}",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.metrics.binds.value() == 2
+        stack.cluster.kill_node("h1")
+        stack.nodehealth.run_once()
+        m = stack.metrics
+        assert m.node_transitions.value() >= 1
+        assert m.node_ghost_releases.value() >= 1
+        # Full fleet elsewhere -> no patch capacity -> whole requeue.
+        assert m.gang_repairs.value(mode="requeue") == 1
+        assert m.repair_duration.count() == 1
+        text = m.registry.render_prometheus()
+        assert 'yoda_node_state{node="h1"} 4.0' in text
+        assert 'yoda_gang_repairs_total{mode="requeue"} 1.0' in text
 
 
 class TestMetricsServer:

@@ -74,6 +74,12 @@ class FakeTpuAgent:
         self.cluster = cluster  # needs put_tpu_metrics / list_pods
         self.now_fn = now_fn
         self._hosts: dict[str, _Host] = {}
+        # Hosts whose heartbeat is stopped (node-death injection without
+        # deleting anything: the CR simply ages until the health
+        # monitor's silence ladder fires). publish_all() skips them;
+        # an explicit refresh(name) still publishes — tests use that to
+        # model a single late packet.
+        self._stopped: set[str] = set()
 
     # --- fleet construction ---
 
@@ -134,15 +140,49 @@ class FakeTpuAgent:
         h = self._hosts[host]
         (h.unhealthy.discard if healthy else h.unhealthy.add)(chip_index)
 
+    def fail_chips(
+        self, host: str, idxs, *, publish: bool = True
+    ) -> None:
+        """Mark chips Unhealthy and (by default) publish the CR — the
+        chip_degrade injection surface: the agent is alive and says so,
+        but some of its silicon is not (health ladder: DEGRADED)."""
+        for i in idxs:
+            self.set_chip_health(host, i, False)
+        if publish and host not in self._stopped:
+            self.refresh(host)
+
+    def heal_chips(self, host: str, idxs, *, publish: bool = True) -> None:
+        for i in idxs:
+            self.set_chip_health(host, i, True)
+        if publish and host not in self._stopped:
+            self.refresh(host)
+
+    def stop_heartbeat(self, name: str) -> None:
+        """Stop publishing for ``name`` — the host-death-without-deletion
+        injection (a wedged kubelet, a dead DaemonSet pod): the stored CR
+        ages until the node health monitor's silence ladder fences and
+        eventually repairs the node. Nothing is deleted."""
+        self._stopped.add(name)
+
+    def resume_heartbeat(self, name: str, *, publish: bool = True) -> None:
+        """Resume publishing (the flap / recovery half): by default a
+        fresh CR goes out immediately, which is what returns a SUSPECT
+        node to HEALTHY inside the debounce window."""
+        self._stopped.discard(name)
+        if publish and name in self._hosts:
+            self.refresh(name)
+
     def remove_host(self, name: str) -> None:
         self._hosts.pop(name, None)
+        self._stopped.discard(name)
         self.cluster.delete_tpu_metrics(name)
 
     # --- publishing ---
 
     def publish_all(self) -> None:
         for name in self._hosts:
-            self.refresh(name)
+            if name not in self._stopped:
+                self.refresh(name)
 
     def refresh(self, name: str) -> None:
         """Recompute and publish one host's CR, accounting for bound pods'

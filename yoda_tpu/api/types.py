@@ -763,6 +763,12 @@ class K8sNode:
     unschedulable: bool = False
     taints: list[Taint] = field(default_factory=list)
     labels: dict[str, str] = field(default_factory=dict)
+    # status.conditions[type=Ready]: False when the node controller
+    # reports the kubelet unreachable/NotReady. Deliberately NOT part of
+    # pod_admits_on — readiness policy belongs to the node health
+    # monitor (yoda_tpu/nodehealth), which fences and repairs; hard
+    # admission here would silently drop a whole failure-handling layer.
+    ready: bool = True
     # status.allocatable, parsed (0 = undeclared -> that resource is not
     # enforced): the upstream NodeResourcesFit inputs. TPU chips are NOT
     # tracked here — the TpuNodeMetrics CR is the authority for those.
@@ -812,6 +818,10 @@ class K8sNode:
                 {"names": [name], "sizeBytes": size}
                 for name, size in sorted(self.images.items())
             ]
+        if not self.ready:
+            # Emitted only when NotReady so ready nodes round-trip to the
+            # same minimal object they always did.
+            status["conditions"] = [{"type": "Ready", "status": "False"}]
         if status:
             out["status"] = status
         return out
@@ -885,6 +895,11 @@ class K8sNode:
             alloc_pods=pods,
             images=images,
             attach_limits=attach_limits,
+            ready=not any(
+                c.get("type") == "Ready"
+                and str(c.get("status", "True")) == "False"
+                for c in (obj.get("status") or {}).get("conditions") or ()
+            ),
         )
 
 

@@ -314,6 +314,23 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 )
                 for st in stacks
             )
+        # Node health monitor: silence ladder + gang-whole repair of
+        # DOWN nodes — one thread per stack, leadership-gated like the
+        # rebalancer (its per-tick gate re-checks the live fence +
+        # resync state). Event-time signals (deletions, NotReady, ghost
+        # releases) are live regardless; this loop adds the staleness
+        # ladder and the repair pass.
+        if config.node_health_period_s > 0:
+            extra_threads.extend(
+                threading.Thread(
+                    target=st.nodehealth.run_forever,
+                    args=(stop,),
+                    kwargs={"period_s": config.node_health_period_s},
+                    name=f"nodehealth-{st.informer.scheduler_name}",
+                    daemon=True,
+                )
+                for st in stacks
+            )
         # Federation control loop: health probes, rejoin resyncs, and
         # spillover migration — ONE background thread, so degradation
         # never serializes against any member's serve loop.

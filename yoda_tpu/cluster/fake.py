@@ -361,6 +361,24 @@ class FakeCluster:
             self._nodes[node.name] = node
             self._emit(Event("added" if is_new else "modified", "Node", node))
 
+    def set_node_ready(self, name: str, ready: bool) -> None:
+        """Node-condition helper (node failure-domain tests + chaos): flip
+        the stored Node's Ready condition — what the node controller does
+        when a kubelet stops responding — creating a bare Node object if
+        none exists. The node health monitor treats NotReady as DOWN."""
+        with self._lock:
+            node = self._nodes.get(name) or K8sNode(name=name)
+            node.ready = ready
+        self.put_node(node)
+
+    def kill_node(self, name: str) -> None:
+        """Full host death in one call: the Node object AND the TPU CR
+        deleted (what a cloud provider's node deletion looks like on the
+        watch stream). Bound pods are left in place — node GC owns them;
+        the health monitor's ghost-release + repair handle the fallout."""
+        self.delete_node(name)
+        self.delete_tpu_metrics(name)
+
     def delete_node(self, name: str) -> None:
         with self._lock:
             node = self._nodes.pop(name, None)

@@ -104,6 +104,11 @@ class InformerCache:
         # measure, never compared against agent-stamped wall timestamps.
         self.mono_fn = mono_fn
         self._last_event_mono: float | None = None
+        # Node-health fence hook (yoda_tpu/nodehealth): returns the node
+        # names currently fenced from NEW placements; stamped onto every
+        # snapshot (Snapshot.fenced) so the admission call sites veto
+        # them. The monitor calls invalidate_snapshot() on fence flips.
+        self.fence_fn: "Callable[[], frozenset] | None" = None
         self._lock = threading.RLock()
         self._tpus: dict[str, TpuNodeMetrics] = {}
         # _tpus keys maintained in sorted order incrementally (bisect on
@@ -602,8 +607,24 @@ class InformerCache:
                 ),
             )
             snap.metrics_version = self._metrics_version
+            if self.fence_fn is not None:
+                try:
+                    snap.fenced = frozenset(self.fence_fn())
+                except Exception:  # noqa: BLE001 — a bad hook must not
+                    pass           # wedge snapshot builds; fail open
             self._snapshot_cache = snap
             return snap
+
+    def invalidate_snapshot(self) -> None:
+        """An EXTERNAL schedulability input changed (the node health
+        monitor's fence set): bump the snapshot version and drop the
+        cached snapshot so the next cycle rebuilds it — and with it the
+        per-snapshot admission-vector caches. metrics_version is NOT
+        bumped: the fleet arrays are fence-independent (the veto rides
+        the host_ok dynamics vector, not the static arrays)."""
+        with self._lock:
+            self._version += 1
+            self._snapshot_cache = None
 
 
 def _pod_claim_mib(pod: PodSpec) -> int:

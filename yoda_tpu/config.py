@@ -206,6 +206,31 @@ class SchedulerConfig:
     # Victim budget per admitted gang: the preemption pass gives up
     # rather than evict more than this many pods for one parked gang.
     rebalance_max_victims: int = 8
+    # Node failure domains (yoda_tpu/nodehealth): the per-node health
+    # ladder's silence thresholds. A node whose agent has been silent
+    # past node_suspect_after_s is SUSPECT — fenced from NEW placements
+    # (the debounce window: a publish returns it to HEALTHY, so a
+    # flapping heartbeat never triggers repair); continuous silence past
+    # node_down_after_s (or a TPU CR / Node deletion, or Node NotReady)
+    # is DOWN — every gang with a member on the node is repaired WHOLE
+    # (patch repair preferred: only the lost members re-plan, healthy
+    # members keep their bindings; fallback whole unbind-and-requeue —
+    # never a split gang, never a deleted pod). Must satisfy
+    # 0 < suspect <= down.
+    node_suspect_after_s: float = 15.0
+    node_down_after_s: float = 60.0
+    # Enable automatic DOWN repair (False = the monitor only classifies
+    # and fences; repair is the operator's job).
+    node_repair: bool = True
+    # Graceful drain (NodeHealthMonitor.drain, rolling cluster
+    # upgrades): how long the rebalancer gets to migrate bound gangs off
+    # a draining node before the monitor force-evacuates the remainder.
+    node_drain_deadline_s: float = 300.0
+    # Period of the background node-health pass (ladder tick + repair),
+    # leadership-gated like the rebalancer. 0 disables the loop
+    # (Stack.nodehealth can still be driven via run_once()); event-time
+    # signals (deletions, NotReady, ghost releases) stay live either way.
+    node_health_period_s: float = 5.0
     # Lifecycle tracing (yoda_tpu/tracing.py): fraction of pod/gang
     # lifetimes traced end-to-end (enqueue -> gather -> dispatch ->
     # reserve -> permit-park -> bind -> bound, plus rebalancer moves,
@@ -414,6 +439,38 @@ class SchedulerConfig:
             raise ValueError(
                 "rebalance_max_victims must be an int >= 1, got "
                 f"{cfg.rebalance_max_victims!r}"
+            )
+        node_thresholds = (cfg.node_suspect_after_s, cfg.node_down_after_s)
+        if any(
+            isinstance(t, bool) or not isinstance(t, (int, float))
+            for t in node_thresholds
+        ) or not 0 < node_thresholds[0] <= node_thresholds[1]:
+            raise ValueError(
+                "node health thresholds must satisfy 0 < "
+                "node_suspect_after_s <= node_down_after_s, got "
+                f"{node_thresholds}"
+            )
+        if not isinstance(cfg.node_repair, bool):
+            raise ValueError(
+                f"node_repair must be a bool, got {cfg.node_repair!r}"
+            )
+        if not isinstance(
+            cfg.node_drain_deadline_s, (int, float)
+        ) or isinstance(
+            cfg.node_drain_deadline_s, bool
+        ) or cfg.node_drain_deadline_s < 0:
+            raise ValueError(
+                "node_drain_deadline_s must be >= 0, got "
+                f"{cfg.node_drain_deadline_s!r}"
+            )
+        if not isinstance(
+            cfg.node_health_period_s, (int, float)
+        ) or isinstance(
+            cfg.node_health_period_s, bool
+        ) or cfg.node_health_period_s < 0:
+            raise ValueError(
+                "node_health_period_s must be >= 0 (0 disables the "
+                f"background loop), got {cfg.node_health_period_s!r}"
             )
         thresholds = (
             cfg.federation_degraded_after_s,

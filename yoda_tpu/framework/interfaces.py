@@ -135,6 +135,13 @@ class Snapshot:
         # instead of the claim's zone-label stand-in. Same None-vs-empty
         # contract as pvcs.
         self.pvs = dict(pvs) if pvs is not None else None
+        # Node names fenced from NEW placements by the node health monitor
+        # (SUSPECT / DRAINING / DOWN — yoda_tpu/nodehealth). Populated by
+        # the informer's fence_fn at snapshot build; admission call sites
+        # (batch _host_admission, the Filter chain, gang planning, the
+        # rebalancer's fit checks) veto these hosts. Fence flips
+        # invalidate the snapshot, so the set is never stale per build.
+        self.fenced: frozenset = frozenset()
 
     def get(self, name: str) -> NodeInfo:
         return self._nodes[name]

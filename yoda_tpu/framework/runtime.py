@@ -239,8 +239,18 @@ class Framework:
         infos = snapshot.infos()
         n = len(infos)
         feasible = 0
+        # Node-health fence (yoda_tpu/nodehealth): SUSPECT/DRAINING/DOWN
+        # hosts take no NEW placements — the loop-mode half of the veto
+        # the batch path applies in its cached admission vector.
+        fenced = getattr(snapshot, "fenced", None)
         for i in range(n):
             node = infos[(start_index + i) % n]
+            if fenced and node.name in fenced:
+                statuses[node.name] = Status.unschedulable(
+                    "node fenced by the health monitor (suspect/draining/"
+                    "down)"
+                )
+                continue
             st = Status.ok()
             for p in self.filter_plugins:
                 st = p.filter(state, pod, node)

@@ -455,6 +455,46 @@ class SchedulingMetrics:
             "(sum over victims of (max(priority,0)+1) x chips) — the cost "
             "side of preemptive admission",
         )
+        # Node failure domains (yoda_tpu/nodehealth, docs/OPERATIONS.md
+        # node-failure runbook): the per-node health ladder, gang-whole
+        # repair actions, repair latency, and ghost reservations
+        # released at node-deletion event time.
+        self.node_state = r.gauge(
+            "yoda_node_state",
+            "Per-node health ladder state (0=healthy 1=degraded "
+            "2=suspect 3=draining 4=down); suspect/draining/down nodes "
+            "are fenced from new placements, down nodes trigger "
+            "gang-whole repair",
+        )
+        self.node_transitions = r.counter(
+            "yoda_node_transitions_total",
+            "Node health-state transitions (flapping here means "
+            "node_suspect_after_s sits too close to the agents' real "
+            "publish cadence)",
+        )
+        self.gang_repairs = r.counter(
+            "yoda_gang_repairs_total",
+            "Gangs repaired whole after a node failure, by mode: patch "
+            "(lost members re-planned into the same ICI block, healthy "
+            "members kept bound), shrink (elastic gang reduced toward "
+            "tpu/min-members), requeue (whole gang unbound and "
+            "re-queued), drain (migrated off a draining node)",
+        )
+        self.repair_duration = r.histogram(
+            "yoda_repair_duration_ms",
+            "Wall milliseconds of one gang repair (take -> unbind lost "
+            "-> install plan -> readd); the time-to-repair the node "
+            "failure bench bounds",
+            buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     1000.0, 5000.0),
+        )
+        self.node_ghost_releases = r.counter(
+            "yoda_node_ghost_releases_total",
+            "Reservations released at EVENT TIME because their pod was "
+            "bound to a node whose TPU CR / Node object was deleted "
+            "(used to stay charged against the ghost row until the "
+            "periodic reconcile)",
+        )
         # Batched watch-event ingestion + tenant fair queuing (ISSUE 10,
         # docs/OPERATIONS.md multi-tenancy runbook): raw events through
         # the ingest pipeline, coalesced events applied per batch (size 1

@@ -176,7 +176,14 @@ def _host_admission(
             if hit is not None and hit[0] is static:
                 return hit[1]
 
+    fenced = getattr(snapshot, "fenced", None)
+
     def _ok(name: str) -> bool:
+        # Node-health fence (yoda_tpu/nodehealth): SUSPECT/DRAINING/DOWN
+        # hosts take no new placements. Cache-safe: the set is stamped
+        # per snapshot and fence flips invalidate the snapshot.
+        if fenced and name in fenced:
+            return False
         if name not in snapshot:
             return True
         ni = snapshot.get(name)

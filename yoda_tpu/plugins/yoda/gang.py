@@ -225,7 +225,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         assigned_hosts: set[str],
         pod: PodSpec,
         pending_res: dict | None = None,
+        fenced: frozenset = frozenset(),
     ) -> bool:
+        # Node-health fence (yoda_tpu/nodehealth): a SUSPECT/DRAINING/
+        # DOWN host must never enter a gang plan — the fence gates
+        # planning exactly as it gates the admission vector.
+        if ni.name in fenced:
+            return False
         # Node-object admission (cordon / untolerated taints / selector /
         # required affinity) gates planning the same way it gates Filter —
         # a planned block must never include a host the members cannot
@@ -298,6 +304,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 deferred = []
                 aff = get_affinity(state)
                 pending_res = get_pending_resources(state)
+                fenced = getattr(snapshot, "fenced", frozenset())
                 # Gang members share labels, so a required term matching the
                 # pod's OWN labels constrains the gang against itself and
                 # caps admission — without a cap the surplus member holds
@@ -326,6 +333,8 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     # `remaining` even when an evaluator exists (it only
                     # filters nodes, it cannot cap the sum).
                     for ni in snapshot.infos():
+                        if ni.name in fenced:
+                            continue
                         if not pod_admits_on(ni.node, pod)[0]:
                             continue
                         if not node_fits_resources(
@@ -344,6 +353,8 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     # short-circuit (self-constrained gangs are rare).
                     contributing: list[tuple[NodeInfo, int]] = []
                     for ni in snapshot.infos():
+                        if ni.name in fenced:
+                            continue
                         if not pod_admits_on(ni.node, pod)[0]:
                             continue
                         if not node_fits_resources(
@@ -426,6 +437,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
     ) -> Status:
         assigned_hosts = set(gs.assigned.values())
         pending_res = get_pending_resources(state)
+        fenced = getattr(snapshot, "fenced", frozenset())
         plan_hosts_free = (
             set(gs.plan) - assigned_hosts if gs.plan is not None else set()
         )
@@ -469,7 +481,8 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             or not plan_hosts_free
             or not all(
                 self._host_fits_member(
-                    snapshot.get(h), req, assigned_hosts, pod, pending_res
+                    snapshot.get(h), req, assigned_hosts, pod, pending_res,
+                    fenced,
                 )
                 for h in plan_hosts_free
                 if h in snapshot
@@ -506,7 +519,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 want_dims=gs.spec.topology,
                 slices=gs.spec.slices,
                 host_ok=lambda ni: self._host_fits_member(
-                    ni, req, assigned_hosts, pod, pending_res
+                    ni, req, assigned_hosts, pod, pending_res, fenced
                 ),
                 pinned=pinned,
             )
@@ -554,9 +567,15 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         if self.pending is None:
             return
         shape = "x".join(map(str, gs.spec.topology))
+        fenced = getattr(snapshot, "fenced", frozenset())
         reasons: dict[str, str] = {}
         for ni in snapshot.infos():
-            if ni.tpu is None:
+            if ni.name in fenced:
+                reasons[ni.name] = (
+                    f"node {ni.name} is fenced by the health monitor "
+                    "(suspect/draining/down)"
+                )
+            elif ni.tpu is None:
                 reasons[ni.name] = f"node {ni.name} has no TPU metrics"
             elif not self._host_fits_member(
                 ni, req, assigned_hosts, pod, pending_res

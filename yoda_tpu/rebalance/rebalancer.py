@@ -109,6 +109,9 @@ class RebalanceReport:
 
     fragmentation_before: float = 0.0
     fragmentation_after: float = 0.0
+    # Gangs/singletons migrated off DRAINING nodes (the node health
+    # monitor's graceful-drain integration).
+    drained: list[str] = field(default_factory=list)
     moves: list[str] = field(default_factory=list)
     aborted_moves: list[str] = field(default_factory=list)
     preempted: list[str] = field(default_factory=list)      # victim pod keys
@@ -141,6 +144,7 @@ class Rebalancer:
         elastic: bool = True,
         max_victims: int = 8,
         gate_fn: "Callable[[], bool] | None" = None,
+        draining_fn: "Callable[[], frozenset] | None" = None,
     ) -> None:
         self.cluster = cluster
         self.informer = informer
@@ -160,6 +164,11 @@ class Rebalancer:
         # run_forever's per-tick admission gate (cli wires leadership +
         # resynced); run_once ignores it — direct drivers decide themselves.
         self.gate_fn = gate_fn
+        # Node health integration (yoda_tpu/nodehealth): nodes under a
+        # graceful drain — the pass migrates bound gangs off them
+        # PROACTIVELY (rolling-upgrade support), before the monitor's
+        # deadline forces a DOWN-style evacuation.
+        self.draining_fn = draining_fn
         self.scheduler_name = informer.scheduler_name
         self._lock = threading.Lock()
         self.passes = 0
@@ -175,6 +184,7 @@ class Rebalancer:
         report.fragmentation_before = occ.score()
         if self.metrics is not None:
             self.metrics.fragmentation.set(report.fragmentation_before)
+        self._drain_pass(snapshot, occ, report)
         if self.enable_preemption:
             self._preempt_pass(snapshot, occ, report)
         if self.enable_elastic:
@@ -304,13 +314,17 @@ class Rebalancer:
         except LabelParseError:
             return False
         chips = max(req0.effective_chips, 1)
+        # Node-health fence: SUSPECT/DRAINING/DOWN hosts must not be
+        # promised capacity by any rebalance decision.
+        fenced = getattr(snapshot, "fenced", frozenset())
         if spec is not None and spec.topology is not None:
             plan = plan_multislice_placement(
                 snapshot,
                 want_dims=spec.topology,
                 slices=spec.slices,
                 host_ok=lambda ni: (
-                    occ.free_chips(ni.name) >= chips
+                    ni.name not in fenced
+                    and occ.free_chips(ni.name) >= chips
                     and pod_admits_on(ni.node, pods[0])[0]
                 ),
             )
@@ -328,6 +342,8 @@ class Rebalancer:
                 chips = 1
             best, best_free = None, -1
             for ni in snapshot.infos():
+                if ni.name in fenced:
+                    continue
                 f = occ.free_chips(ni.name)
                 if f >= chips and f > best_free and pod_admits_on(ni.node, pod)[0]:
                     best, best_free = ni.name, f
@@ -341,6 +357,109 @@ class Rebalancer:
             for host, c in taken:
                 occ.release(host, c)
         return True
+
+    # --- (0) graceful drain (node health monitor integration) ---
+
+    def _drain_pass(self, snapshot, occ, report: RebalanceReport) -> None:
+        """Migrate bound work off DRAINING nodes proactively (rolling
+        cluster upgrades, docs/OPERATIONS.md node-failure runbook): the
+        node health monitor fences a draining node from new placements
+        and hands its name out via ``draining_fn``; this pass moves every
+        bound gang with a member there through the standard transactional
+        primitives BEFORE the drain deadline forces a DOWN-style
+        evacuation. Topology gangs use the repack move primitive onto a
+        live block (no min_gain requirement — the drain overrides the
+        churn economics); plain gangs unbind-and-requeue whole and
+        re-place off the fence; singletons requeue when capacity exists."""
+        if self.draining_fn is None:
+            return
+        draining = self.draining_fn()
+        if not draining:
+            return
+        gangs, singles = self._bound_by_gang(snapshot)
+        for name in sorted(gangs):
+            members = gangs[name]
+            if not any(h in draining for _, h in members):
+                continue
+            status = self.gang.gang_status(name)
+            if status is not None and status[1] > 0:
+                continue  # members waiting at Permit: mid-flight
+            spec = self._spec_of([p for p, _ in members])
+            why = (
+                f"rebalance: draining node(s) "
+                f"{sorted({h for _, h in members if h in draining})}; "
+                f"migrating gang {name} off before the deadline"
+            )
+            if spec is not None and spec.topology is not None:
+                try:
+                    chips = max(
+                        pod_request(members[0][0]).effective_chips, 1
+                    )
+                except LabelParseError:
+                    continue
+                fenced = getattr(snapshot, "fenced", frozenset())
+                sim = occ.clone()
+                for _pod, host in members:
+                    sim.release(host, chips)
+                plan = plan_multislice_placement(
+                    snapshot,
+                    want_dims=spec.topology,
+                    slices=spec.slices,
+                    host_ok=lambda ni: (
+                        ni.name not in draining
+                        and ni.name not in fenced
+                        and sim.free_chips(ni.name) >= chips
+                        and pod_admits_on(ni.node, members[0][0])[0]
+                    ),
+                )
+                if plan is None or set(plan) == {h for _, h in members}:
+                    continue  # nowhere live to go yet; deadline escalates
+                if self._execute_move(name, spec, members, plan, report):
+                    for _pod, host in members:
+                        occ.release(host, chips)
+                    for host in plan:
+                        occ.occupy(host, chips)
+                    report.drained.append(name)
+                    if self.metrics is not None:
+                        self.metrics.gang_repairs.inc(mode="drain")
+                continue
+            # Plain/elastic gang: requeue whole — admission re-places it
+            # off the fenced node. Only when live capacity fits it now
+            # (a gang with nowhere to go keeps running until the
+            # deadline, beats thrashing it into the queue).
+            pods = [p for p, _ in members]
+            if not self._fits(snapshot, occ, pods, charge=True):
+                continue
+            qpis = self.queue.take_gang(name)
+            try:
+                if self.scheduler._fenced():
+                    return
+                for pod, _host in members:
+                    self.gang.drop_membership(pod)
+                self._unbind_all(list(members), why)
+            finally:
+                for q in qpis:
+                    self.queue.readd(q)
+                self.queue.move_all_to_active()
+            report.drained.append(name)
+            if self.metrics is not None:
+                self.metrics.gang_repairs.inc(mode="drain")
+            log.info(
+                "rebalance: drained gang %s off %s (requeued whole)",
+                name, sorted({h for _, h in members if h in draining}),
+            )
+        for pod, host in singles:
+            if host not in draining:
+                continue
+            if not self._fits(snapshot, occ, [pod], charge=True):
+                continue
+            if self.scheduler._fenced():
+                return
+            self.scheduler._rollback_bound(
+                pod, host, None,
+                f"rebalance: draining node {host}; pod requeued",
+            )
+            report.drained.append(pod.key)
 
     # --- (1) priority preemption ---
 
@@ -654,12 +773,14 @@ class Rebalancer:
             sim = occ.clone()
             for _pod, host in members:
                 sim.release(host, chips)
+            fenced = getattr(snapshot, "fenced", frozenset())
             plan = plan_multislice_placement(
                 snapshot,
                 want_dims=spec.topology,
                 slices=spec.slices,
                 host_ok=lambda ni: (
-                    sim.free_chips(ni.name) >= chips
+                    ni.name not in fenced
+                    and sim.free_chips(ni.name) >= chips
                     and pod_admits_on(ni.node, members[0][0])[0]
                 ),
             )

@@ -20,6 +20,7 @@ from yoda_tpu.config import SchedulerConfig
 from yoda_tpu.framework import BindExecutor, Framework, Scheduler, SchedulingQueue
 from yoda_tpu.framework.reconciler import Reconciler
 from yoda_tpu.framework.tenancy import TenantLedger, tenant_of
+from yoda_tpu.nodehealth import NodeHealthMonitor
 from yoda_tpu.observability import SchedulingMetrics
 from yoda_tpu.plugins.yoda import default_plugins
 from yoda_tpu.plugins.yoda.accounting import ChipAccountant
@@ -51,6 +52,12 @@ class Stack:
     ingestor: EventBatcher | None = None
     # Per-tenant DRF ledger (tenant_fairness); None with fairness off.
     tenants: TenantLedger | None = None
+    # Node failure domains (yoda_tpu/nodehealth): the per-node health
+    # ladder + gang-whole repair monitor. Built always (event-time
+    # signals — deletions, NotReady, ghost releases — are live from the
+    # first watch event); the background ladder/repair loop is started
+    # by cli.py when node_health_period_s > 0.
+    nodehealth: NodeHealthMonitor | None = None
 
 
 def build_stack(
@@ -368,6 +375,14 @@ def build_stack(
         # so no reactivation is ever missed.
         if any(map(_reactivates, events)) and queue.has_parked():
             queue.move_all_to_active()
+        # Node failure domains: condition signals (TPU CR / Node
+        # deletions, NotReady) and per-chip health feed the health
+        # ladder at EVENT TIME, and a deleted node's still-bound pods
+        # have their ghost reservations released now. State-only on this
+        # (watch) thread — repair I/O runs on the monitor's background
+        # pass. `nodehealth` is assigned below, before any watcher is
+        # registered, so the closure never sees it unbound.
+        nodehealth.observe_events(events)
 
     # Enqueue edge of the lifecycle trace: the pod's (or its gang's)
     # trace ROOT — everything later (gather, dispatch, cycles, binds,
@@ -406,6 +421,30 @@ def build_stack(
         # production passes time.monotonic either way.
         mono_fn=clock,
     )
+
+    # Node failure domains (yoda_tpu/nodehealth): the per-node health
+    # ladder, built BEFORE any watcher registers so the replayed events
+    # already flow through observe_events. Fencing rides the existing
+    # host_ok admission vector: the monitor's fence set is stamped onto
+    # every snapshot (informer.fence_fn) and the admission call sites
+    # veto it — no new kernel work. The scheduler handle (repair's
+    # unbind path + fence check) is wired after construction below.
+    nodehealth = NodeHealthMonitor(
+        cluster=cluster,
+        informer=informer,
+        accountant=accountant,
+        gang=gang,
+        framework=framework,
+        queue=queue,
+        metrics=metrics,
+        bind_executor=bind_executor,
+        suspect_after_s=config.node_suspect_after_s,
+        down_after_s=config.node_down_after_s,
+        drain_deadline_s=config.node_drain_deadline_s,
+        repair=config.node_repair,
+        clock=clock,
+    )
+    informer.fence_fn = nodehealth.fenced_nodes
 
     # Wire the PDB source now the informer exists: preemption's victim
     # preference reads the informer's budget cache (None until a PDB watch
@@ -723,6 +762,18 @@ def build_stack(
         gate_fn=lambda: (
             not scheduler._fenced() and reconciler.resynced.is_set()
         ),
+        # Graceful drain: the rebalancer's pass migrates bound gangs off
+        # DRAINING nodes proactively, before the monitor's deadline
+        # forces a DOWN-style evacuation.
+        draining_fn=nodehealth.draining_nodes,
+    )
+    # Late wiring (the scheduler/reconciler are built after the informer
+    # the monitor hangs off): repair runs through the scheduler's unbind
+    # path, and the background loop's gate composes leadership with the
+    # warm-start contract — no repair on un-resynced state.
+    nodehealth.scheduler = scheduler
+    nodehealth.gate_fn = lambda: (
+        not scheduler._fenced() and reconciler.resynced.is_set()
     )
     return Stack(
         cluster,
@@ -741,6 +792,7 @@ def build_stack(
         rebalancer=rebalancer,
         ingestor=ingestor,
         tenants=ledger,
+        nodehealth=nodehealth,
     )
 
 

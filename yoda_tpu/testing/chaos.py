@@ -78,6 +78,17 @@ _DEFAULT_KINDS = {
     # permanent version. Consumed via maybe_cluster_fault.
     "cluster_partition": ("partition",),
     "cluster_loss": ("loss",),
+    # Node failure modes (node health PR, yoda_tpu/nodehealth): consumed
+    # via maybe_node_fault against a FakeTpuAgent + cluster pair.
+    # node_death deletes the host's TPU CR and Node object (cloud node
+    # deletion); heartbeat_stop silences the agent — kind "stop" is
+    # permanent until the sweep resumes it, "flap" signals the sweep to
+    # resume it within the debounce window (the flapping-heartbeat case
+    # the SUSPECT debounce exists for); chip_degrade marks chips
+    # Unhealthy while the host stays alive (ladder: DEGRADED).
+    "node_death": ("death",),
+    "heartbeat_stop": ("stop", "flap"),
+    "chip_degrade": ("degrade",),
 }
 
 
@@ -474,6 +485,47 @@ def maybe_cluster_fault(plan: ChaosPlan, cluster: ChaosCluster) -> "str | None":
             cluster.partition()
             return "cluster_partition"
     return None
+
+
+def maybe_node_fault(
+    plan: ChaosPlan, agent, cluster, *, nodes=None
+) -> "list[tuple[str, str, str]]":
+    """Consume one invocation each of the node-failure ops against the
+    fleet ``agent`` (a FakeTpuAgent) publishes into ``cluster``. Target
+    choice is deterministic: invocation index i of an op strikes
+    ``sorted(nodes)[i % len]`` — the same seed always kills the same
+    hosts in the same order, so a failing sweep's log IS its repro.
+    Returns the fired ``(op, kind, node)`` triples; the sweep uses them
+    to resume "flap" heartbeats inside the debounce window and to know
+    which nodes are genuinely dead. Ops never scheduled by the plan do
+    not consume invocation indices (``has_op``), keeping other ops'
+    indices stable — the crash-op discipline."""
+    fired: list[tuple[str, str, str]] = []
+    for op in ("node_death", "heartbeat_stop", "chip_degrade"):
+        if not plan.has_op(op):
+            continue
+        # Recomputed per op: an earlier op this call may have removed a
+        # host, and striking a ghost would crash the sweep.
+        pool = nodes if nodes is not None else agent._hosts
+        targets = sorted(n for n in pool if n in agent._hosts)
+        if not targets:
+            continue
+        i = plan.invocations(op)
+        f = plan.next(op)
+        if f is None:
+            continue
+        name = targets[i % len(targets)]
+        if op == "node_death":
+            agent.remove_host(name)  # deletes the TPU CR
+            delete_node = getattr(cluster, "delete_node", None)
+            if delete_node is not None:
+                delete_node(name)
+        elif op == "heartbeat_stop":
+            agent.stop_heartbeat(name)
+        else:
+            agent.fail_chips(name, [0])
+        fired.append((op, f.kind, name))
+    return fired
 
 
 def maybe_drop_watch(plan: ChaosPlan, server) -> bool:
