@@ -8,7 +8,7 @@ IMAGE ?= yoda-tpu/scheduler
 TAG ?= latest
 PY ?= python
 
-.PHONY: all test lint native bench bench-scale rebalance-bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native bench bench-scale rebalance-bench slo-bench smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -56,6 +56,16 @@ bench-scale:
 # victims; victims requeue whole, zero oversubscription). One JSON line.
 rebalance-bench:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --rebalance
+
+# Fleet SLO evidence (CPU-pinned): the trace-replay scenario matrix at
+# the standard dev shape — >= 1M pod lifecycles through batched ingest
+# across spot-tier / flash-crowd / rolling-upgrade / deadline-gang
+# scenarios, per-tenant admission-wait p99 + zero starved windows
+# asserted by the SLO engine itself — plus the engine on/off overhead
+# pair (< 2% acceptance). One JSON line. The smoke slice of the same
+# matrix rides `make smoke`.
+slo-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --slo
 
 # Fault-injection suite (fixed seed, replayable): gang bind rollback,
 # transient-error retry, dispatch fallback chain, leader fencing, the

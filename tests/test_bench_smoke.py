@@ -171,6 +171,16 @@ def test_smoke_mode_runs_reduced_fleet():
     assert out["obs_full_spans"] > 0
     assert out["obs_full_pods_per_s"] > 0
     assert out["obs_full_overhead_pct"] < 15.0
+    # The SLO engine overhead pair and the trace-replay scenario matrix
+    # (smoke slice) ride the smoke run too.
+    assert out["slo_on_admissions"] > 0
+    assert out["slo_overhead_pct"] < 3.0  # smoke-level slack; 2% below
+    assert out["slo_matrix_lifecycles_total"] > 10_000
+    for scen in (
+        "spot_tier", "flash_crowd", "rolling_upgrade", "deadline_gangs"
+    ):
+        assert out[f"slo_{scen}_starved_windows"] == 0
+        assert out[f"slo_{scen}_binds"] > 0
 
 
 def test_observability_overhead_invariants():
@@ -202,3 +212,41 @@ def test_federated_spillover_invariants():
     out = bench._federated_spillover_scenario(gangs=2, remote_hosts=8)
     assert out["federated_spillover_pods_per_s"] > 0
     assert out["federated_spillover_gangs"] == 2
+
+
+def test_slo_overhead_invariants():
+    import bench
+
+    # ISSUE 12 acceptance: the SLO engine's serve-path cost, engine on
+    # vs off over the SAME stack (interleaved best-of-N, min over
+    # epochs), must stay under 2% pods/s — the record paths are ~1 us
+    # dict ops per enqueue/bind. One retry absorbs a machine-noise
+    # outlier (A/A control pairs on shared CI boxes read +-3%).
+    out = bench._slo_overhead_scenario()
+    if out["slo_overhead_pct"] >= 2.0:
+        out = bench._slo_overhead_scenario()
+    assert out["slo_overhead_pct"] < 2.0, out
+    assert out["slo_on_admissions"] > 0
+    assert out["slo_off_pods_per_s"] > 0
+
+
+def test_slo_matrix_smoke_invariants():
+    import bench
+
+    # ISSUE 12 acceptance (reduced shape for CI; `make slo-bench` runs
+    # the >= 1M-lifecycle standard dev shape): all four replay scenarios
+    # hold their per-tenant admission-wait p99 and zero-starved-window
+    # SLOs (asserted inside the matrix), and the evidence shape is sane
+    # — six-figure smoke lifecycles through batched ingest, real binds,
+    # drains fully evacuated.
+    out = bench._slo_scenario_matrix(scale=0.2)
+    assert out["slo_matrix_lifecycles_total"] > 10_000
+    assert out["slo_matrix_ingest_events_total"] > 10_000
+    for scen in (
+        "spot_tier", "flash_crowd", "rolling_upgrade", "deadline_gangs"
+    ):
+        assert out[f"slo_{scen}_starved_windows"] == 0
+        assert out[f"slo_{scen}_binds"] > 0
+        assert out[f"slo_{scen}_p99_worst_s"] <= 60.0
+    assert out["slo_rolling_upgrade_drained_nodes"] > 0
+    assert out["slo_deadline_gangs_p99_s"] <= 30.0

@@ -127,6 +127,27 @@ class TestSchedulerManifest:
         assert cfg.trace_capacity >= 16
         assert cfg.trace_sink == ""
 
+    def test_configmap_slo_knobs_validate(self):
+        """The shipped SLO knobs (ISSUE 12) must pass SchedulerConfig
+        validation — the engine enabled, real declarative targets, and
+        the classic 5m/1h burn windows — so the deploy ConfigMap IS the
+        documented SLO posture."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert cfg.slo_enabled is True
+        assert cfg.slo_targets.admission_wait_p99_s == 60
+        assert cfg.slo_targets.starved_windows == 0
+        assert 0 < cfg.slo_targets.admission_wait_slo < 1
+        assert (
+            0
+            < cfg.slo_burn_fast_window_s
+            <= cfg.slo_burn_slow_window_s
+        )
+        assert cfg.slo_burn_threshold > 0
+        assert cfg.slo_starvation_window_s > 0
+
     def test_rbac_covers_client_verbs(self):
         """KubeCluster issues: pod list/watch, pods/binding create,
         pods/eviction create (preemption), node list/watch, TpuNodeMetrics

@@ -202,6 +202,14 @@ ALL_METRIC_FAMILIES = (
     "yoda_scheduling_attempts_total",
     "yoda_scheduling_latency_seconds",
     "yoda_sharded_dispatches_total",
+    "yoda_slo_admission_wait_p99_seconds",
+    "yoda_slo_alerts_firing",
+    "yoda_slo_burn_rate",
+    "yoda_slo_evaluations_total",
+    "yoda_slo_goodput",
+    "yoda_slo_preemption_rate_per_min",
+    "yoda_slo_repair_rate_per_min",
+    "yoda_slo_starved_windows",
     "yoda_snapshot_reuse_total",
     "yoda_spillover_gangs_total",
     "yoda_tenant_dominant_share",
@@ -317,6 +325,146 @@ class TestNodeHealthMetrics:
         text = m.registry.render_prometheus()
         assert 'yoda_node_state{node="h1"} 4.0' in text
         assert 'yoda_gang_repairs_total{mode="requeue"} 1.0' in text
+
+
+class TestSloSeries:
+    """Fleet SLO engine (ISSUE 12): every yoda_slo_* family renders from
+    a default stack (schema test above) AND carries real values once
+    pods bind — the per-tenant series labeled by the live tenant set."""
+
+    def test_slo_series_populated_with_real_values(self):
+        stack, agent = make_stack(tenant_fairness=True)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"p{i}", namespace="team-a", labels={"tpu/chips": "2"}
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        text = stack.metrics.registry.render_prometheus()
+        p99_rows = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(
+                'yoda_slo_admission_wait_p99_seconds{tenant="team-a"}'
+            )
+        ]
+        assert p99_rows, text
+        assert 'yoda_slo_starved_windows{tenant="team-a"} 0.0' in text
+        assert 'yoda_slo_burn_rate{window="fast"}' in text
+        assert 'yoda_slo_burn_rate{window="slow"}' in text
+        goodput = [
+            ln for ln in text.splitlines()
+            if ln.startswith("yoda_slo_goodput ")
+        ][0]
+        assert float(goodput.split()[-1]) == 6 / 8
+        assert "yoda_slo_alerts_firing 0.0" in text
+        evals = [
+            ln for ln in text.splitlines()
+            if ln.startswith("yoda_slo_evaluations_total ")
+        ][0]
+        assert float(evals.split()[-1]) >= 1.0
+
+    def test_slo_rate_series_move_with_preemption_and_repair(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{m}",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.cluster.kill_node("h1")
+        stack.nodehealth.run_once()
+        text = stack.metrics.registry.render_prometheus()
+        repair = [
+            ln for ln in text.splitlines()
+            if ln.startswith("yoda_slo_repair_rate_per_min ")
+        ][0]
+        assert float(repair.split()[-1]) > 0
+
+
+class TestBoundedGaugeCardinality:
+    """ISSUE 12 satellite: per-object label series must RETIRE with
+    their objects, or a long-lived process scrapes every tenant/node
+    that EVER existed."""
+
+    def test_tenant_share_series_retires_with_last_pod(self):
+        stack, agent = make_stack(tenant_fairness=True)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("a1", namespace="team-a", labels={"tpu/chips": "2"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        text = stack.metrics.registry.render_prometheus()
+        assert 'yoda_tenant_dominant_share{tenant="team-a"}' in text
+        stack.cluster.delete_pod("team-a/a1")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        text = stack.metrics.registry.render_prometheus()
+        assert 'yoda_tenant_dominant_share{tenant="team-a"}' not in text
+
+    def test_node_state_series_retires_after_node_deletion(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "4"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.cluster.kill_node("h1")
+        # First pass: repair settles, the DOWN transition stays
+        # scrapeable for at least one monitor period.
+        stack.nodehealth.run_once()
+        text = stack.metrics.registry.render_prometheus()
+        assert 'yoda_node_state{node="h1"} 4.0' in text
+        # Next pass retires the record and its label series.
+        stack.nodehealth.run_once()
+        text = stack.metrics.registry.render_prometheus()
+        assert 'yoda_node_state{node="h1"}' not in text
+        assert "h1" not in stack.nodehealth.states()
+        # The live node's ladder record survives retirement sweeps.
+        agent.refresh("h0")
+        stack.nodehealth.run_once()
+        assert "h0" in stack.nodehealth.states()
+
+    def test_recreated_node_gets_a_fresh_series(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.kill_node("h0")
+        stack.nodehealth.run_once()
+        stack.nodehealth.run_once()
+        assert "h0" not in stack.nodehealth.states()
+        # The host returns (replacement hardware, same name): a fresh
+        # HEALTHY record with no stale DOWN series (a healthy node that
+        # never transitioned exports no row — the existing contract).
+        agent.publish_all()
+        stack.nodehealth.run_once()
+        text = stack.metrics.registry.render_prometheus()
+        assert 'yoda_node_state{node="h0"} 4.0' not in text
+        from yoda_tpu.nodehealth import NodeState
+
+        assert stack.nodehealth.state_of("h0") is NodeState.HEALTHY
+        assert "h0" in stack.nodehealth.states()
+
+    def test_gauge_remove_is_idempotent(self):
+        from yoda_tpu.observability import Registry
+
+        r = Registry()
+        g = r.gauge("g", "g")
+        g.set(1.0, node="x")
+        g.remove(node="x")
+        g.remove(node="x")  # second removal is a no-op
+        assert 'g{node="x"}' not in r.render_prometheus()
 
 
 class TestMetricsServer:

@@ -1,0 +1,477 @@
+"""Fleet SLO engine tests (ISSUE 12): SLI math over a fake clock, the
+declarative-target validation, multi-window burn-rate alerting, the
+/debug/slo + CLI surfaces, and the seeded trace-replay determinism
+contract (identical seeds -> identical SLI output)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.metrics_server import MetricsServer
+from yoda_tpu.slo import SloEngine, SloTargets
+from yoda_tpu.standalone import build_stack
+
+
+class FakeClock:
+    def __init__(self, now: float = 0.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def pod(name: str, ns: str = "team-a") -> PodSpec:
+    return PodSpec(name, namespace=ns, labels={"tpu/chips": "1"})
+
+
+class TestSloTargets:
+    def test_from_dict_roundtrip_and_defaults(self):
+        t = SloTargets.from_dict({"admission_wait_p99_s": 30.0})
+        assert t.admission_wait_p99_s == 30.0
+        assert t.admission_wait_slo == 0.99  # default kept
+        assert t.to_dict()["starved_windows"] == 0
+
+    def test_from_dict_rejects_unknown_and_bad_values(self):
+        with pytest.raises(ValueError, match="unknown slo_targets"):
+            SloTargets.from_dict({"nope": 1})
+        with pytest.raises(ValueError, match="non-negative"):
+            SloTargets.from_dict({"admission_wait_p99_s": -1})
+        with pytest.raises(ValueError, match="admission_wait_slo"):
+            SloTargets.from_dict({"admission_wait_slo": 1.0})
+        with pytest.raises(ValueError, match="goodput_min"):
+            SloTargets.from_dict({"goodput_min": 2.0})
+
+    def test_config_parses_and_validates_slo_knobs(self):
+        cfg = SchedulerConfig.from_dict(
+            {
+                "slo_targets": {"admission_wait_p99_s": 45.0},
+                "slo_starvation_window_s": 30.0,
+                "slo_burn_fast_window_s": 60.0,
+                "slo_burn_slow_window_s": 600.0,
+                "slo_burn_threshold": 3.0,
+            }
+        )
+        assert cfg.slo_targets.admission_wait_p99_s == 45.0
+        with pytest.raises(ValueError, match="SLO windows"):
+            SchedulerConfig.from_dict(
+                {
+                    "slo_burn_fast_window_s": 600.0,
+                    "slo_burn_slow_window_s": 60.0,
+                }
+            )
+        with pytest.raises(ValueError, match="slo_burn_threshold"):
+            SchedulerConfig.from_dict({"slo_burn_threshold": 0})
+        with pytest.raises(ValueError, match="slo_targets"):
+            SchedulerConfig.from_dict({"slo_targets": [1, 2]})
+
+    def test_config_profiles_inherit_parsed_targets(self):
+        cfg = SchedulerConfig.from_dict(
+            {
+                "slo_targets": {"admission_wait_p99_s": 45.0},
+                "profiles": [{"scheduler_name": "alt"}],
+            }
+        )
+        assert cfg.profiles[0].slo_targets.admission_wait_p99_s == 45.0
+
+
+class TestSliMath:
+    def test_admission_wait_quantiles_per_tenant(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk)
+        for i in range(100):
+            clk.now = float(i)
+            e.observe_enqueue(pod(f"p{i}"))
+        clk.now = 200.0
+        for i in range(100):
+            e.observe_bound(pod(f"p{i}"))
+        out = e.evaluate(200.0)
+        row = out["tenants"]["team-a"]
+        assert row["admissions_total"] == 100
+        # Waits are 101..200: p99 (index 99) = 200, p50 (index 50) = 151.
+        assert row["admission_wait_p99_s"] == 200.0
+        assert row["admission_wait_p50_s"] == 151.0
+
+    def test_first_enqueue_wins_and_unknown_bound_skipped(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk)
+        e.observe_enqueue(pod("p"))
+        clk.now = 50.0
+        e.observe_enqueue(pod("p"))  # re-delivery must not reset t0
+        clk.now = 60.0
+        e.observe_bound(pod("p"))
+        e.observe_bound(pod("ghost"))  # never enqueued: no sample
+        out = e.evaluate(60.0)
+        row = out["tenants"]["team-a"]
+        assert row["admissions_total"] == 1
+        assert row["admission_wait_p99_s"] == 60.0
+
+    def test_retired_pod_records_no_admission(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk)
+        e.observe_enqueue(pod("p"))
+        e.observe_retired(pod("p"))
+        clk.now = 10.0
+        e.observe_bound(pod("p"))  # late bound after retire: ignored
+        assert e.evaluate(10.0)["tenants"] == {}
+
+    def test_disabled_engine_records_nothing(self):
+        e = SloEngine(enabled=False)
+        e.observe_enqueue(pod("p"))
+        e.observe_bound(pod("p"))
+        e.observe_preemption(5)
+        e.observe_repair()
+        out = e.evaluate(100.0)
+        assert out["enabled"] is False and out["tenants"] == {}
+
+    def test_preemption_and_repair_rates_windowed(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk, fast_window_s=60.0, slow_window_s=600.0)
+        clk.now = 100.0
+        e.observe_preemption(6)
+        e.observe_repair()
+        out = e.evaluate(130.0)
+        # 6 preemptions in a 60 s fast window = 6 per min.
+        assert out["fleet"]["preemption_rate_per_min"] == 6.0
+        assert out["fleet"]["repair_rate_per_min"] == 1.0
+        # Outside the fast window they stop counting toward the rate.
+        out = e.evaluate(200.0)
+        assert out["fleet"]["preemption_rate_per_min"] == 0.0
+
+
+class QueueStub:
+    def __init__(self, stats):
+        self.stats = stats
+
+    def tenant_wait_stats(self):
+        return self.stats
+
+
+class TestStarvationWindows:
+    def test_windows_accrue_only_past_a_full_window(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk, starvation_window_s=60.0)
+        q = QueueStub({"team-a": (3, 0.0)})
+        e.add_queue(q)
+        assert e.evaluate(30.0)["tenants"]["team-a"]["starved_windows"] == 0
+        assert e.evaluate(61.0)["tenants"]["team-a"]["starved_windows"] == 1
+        # Idempotent: re-evaluating inside the same window adds nothing.
+        assert e.evaluate(65.0)["tenants"]["team-a"]["starved_windows"] == 1
+        assert e.evaluate(125.0)["tenants"]["team-a"]["starved_windows"] == 2
+
+    def test_admission_resets_the_starvation_clock(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk, starvation_window_s=60.0)
+        q = QueueStub({"team-a": (3, 0.0)})
+        e.add_queue(q)
+        e.evaluate(50.0)
+        # A bind at t=55 restarts the window even with depth pending.
+        clk.now = 55.0
+        e.observe_enqueue(pod("p"))
+        e.observe_bound(pod("p"))
+        assert e.evaluate(100.0)["tenants"]["team-a"]["starved_windows"] == 0
+        assert e.evaluate(116.0)["tenants"]["team-a"]["starved_windows"] == 1
+
+    def test_drained_tenant_restarts_accounting(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk, starvation_window_s=60.0)
+        q = QueueStub({"team-a": (1, 0.0)})
+        e.add_queue(q)
+        e.evaluate(61.0)
+        q.stats = {}  # queue drained
+        e.evaluate(120.0)
+        # Re-pending later: the old mark must not double-charge history.
+        q.stats = {"team-a": (1, 200.0)}
+        out = e.evaluate(230.0)
+        assert out["tenants"]["team-a"]["starved_windows"] == 1  # the old one
+        out = e.evaluate(261.0)
+        assert out["tenants"]["team-a"]["starved_windows"] == 2
+
+    def test_starvation_alert_fires_past_target(self):
+        clk = FakeClock()
+        e = SloEngine(clock=clk, starvation_window_s=60.0)
+        e.add_queue(QueueStub({"team-a": (1, 0.0)}))
+        out = e.evaluate(61.0)
+        assert any(a["sli"] == "starvation" for a in out["alerts"])
+
+
+class TestBurnRateAlerting:
+    def build(self):
+        clk = FakeClock()
+        e = SloEngine(
+            clock=clk,
+            targets=SloTargets(
+                admission_wait_p99_s=10.0, admission_wait_slo=0.9
+            ),
+            fast_window_s=100.0,
+            slow_window_s=1000.0,
+            burn_threshold=2.0,
+        )
+        return clk, e
+
+    def admit(self, e, clk, name, wait):
+        t_bound = clk.now
+        clk.now = t_bound - wait
+        e.observe_enqueue(pod(name))
+        clk.now = t_bound
+        e.observe_bound(pod(name))
+
+    def test_both_windows_required(self):
+        clk, e = self.build()
+        # Slow window: 40 good admissions early (budget intact there).
+        clk.now = 200.0
+        for i in range(40):
+            self.admit(e, clk, f"g{i}", 1.0)
+        # Fast window: every admission bad -> fast burn 10x, slow burn
+        # diluted by the good history -> under threshold -> NO alert.
+        clk.now = 1000.0
+        for i in range(10):
+            self.admit(e, clk, f"b{i}", 50.0)
+        out = e.evaluate(1050.0)
+        row = out["tenants"]["team-a"]
+        assert row["burn_fast"] == 10.0
+        assert row["burn_slow"] == 2.0
+        assert row["alert"] == "ok" or row["burn_slow"] >= 2.0
+        # Keep burning: the slow window fills with bad admissions and
+        # both windows cross the threshold -> alert fires.
+        clk.now = 1100.0
+        for i in range(30):
+            self.admit(e, clk, f"c{i}", 50.0)
+        out = e.evaluate(1150.0)
+        row = out["tenants"]["team-a"]
+        assert row["burn_fast"] >= 2.0 and row["burn_slow"] >= 2.0
+        assert row["alert"] == "burning"
+        assert any(a["sli"] == "admission_wait" for a in out["alerts"])
+
+    def test_no_target_no_alert(self):
+        clk = FakeClock()
+        e = SloEngine(
+            clock=clk, targets=SloTargets(admission_wait_p99_s=0.0)
+        )
+        clk.now = 10.0
+        e.observe_enqueue(pod("p"))
+        clk.now = 500.0
+        e.observe_bound(pod("p"))
+        out = e.evaluate(500.0)
+        assert out["tenants"]["team-a"]["alert"] == "ok"
+        assert out["alerts"] == []
+
+
+class TestEngineWiredIntoStack:
+    def make(self, **cfg):
+        stack = build_stack(config=SchedulerConfig(**cfg))
+        agent = FakeTpuAgent(stack.cluster)
+        return stack, agent
+
+    def test_enqueue_bound_edge_measured_from_real_binds(self):
+        stack, agent = self.make()
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        for i in range(3):
+            stack.cluster.create_pod(
+                PodSpec(f"p{i}", namespace="team-a", labels={"tpu/chips": "2"})
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        out = stack.metrics.slo.evaluate()
+        row = out["tenants"]["team-a"]
+        assert row["admissions_total"] == 3
+        assert row["admission_wait_p99_s"] >= 0.0
+        # Goodput sampled from the accountant-backed efficiency gauge.
+        assert out["fleet"]["goodput"] == pytest.approx(6 / 8)
+
+    def test_gang_members_bound_via_permit_release_are_measured(self):
+        stack, agent = self.make()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{m}",
+                    namespace="team-b",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        row = stack.metrics.slo.evaluate()["tenants"]["team-b"]
+        assert row["admissions_total"] == 2
+
+    def test_deleted_pending_pod_retires_without_a_sample(self):
+        stack, agent = self.make()
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("big", namespace="team-a", labels={"tpu/chips": "64"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.cluster.delete_pod("team-a/big")
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        out = stack.metrics.slo.evaluate()
+        row = out["tenants"].get("team-a")
+        assert row is None or row["admissions_total"] == 0
+        with stack.metrics.slo._lock:
+            assert "team-a/big" not in stack.metrics.slo._enqueued
+
+    def test_preemption_feeds_the_rate_sli(self):
+        stack, agent = self.make()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("low", labels={"tpu/chips": "4", "tpu/priority": "1"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.cluster.create_pod(
+            PodSpec("hi", labels={"tpu/chips": "4", "tpu/priority": "10"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.metrics.preemptions.total() >= 1
+        out = stack.metrics.slo.evaluate()
+        assert out["fleet"]["preemption_rate_per_min"] > 0
+
+    def test_repair_feeds_the_rate_sli(self):
+        stack, agent = self.make()
+        agent.add_host("h0", generation="v5e", chips=4)
+        agent.add_host("h1", generation="v5e", chips=4)
+        agent.publish_all()
+        for m in range(2):
+            stack.cluster.create_pod(
+                PodSpec(
+                    f"g-{m}",
+                    labels={
+                        "tpu/gang": "g", "tpu/gang-size": "2",
+                        "tpu/chips": "4",
+                    },
+                )
+            )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.cluster.kill_node("h1")
+        stack.nodehealth.run_once()
+        out = stack.metrics.slo.evaluate()
+        assert out["fleet"]["repair_rate_per_min"] > 0
+
+    def test_queue_pending_feeds_tenant_stats(self):
+        stack, agent = self.make(tenant_fairness=True)
+        agent.add_host("host", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("big", namespace="team-a", labels={"tpu/chips": "64"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        row = stack.metrics.slo.evaluate()["tenants"]["team-a"]
+        assert row["pending"] == 1
+        assert row["oldest_wait_s"] >= 0.0
+
+    def test_slo_disabled_stack_records_nothing(self):
+        stack, agent = self.make(slo_enabled=False)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        out = stack.metrics.slo.evaluate()
+        assert out["enabled"] is False and out["tenants"] == {}
+
+
+class TestSloHttpAndCli:
+    def test_debug_slo_endpoint_and_cli(self, capsys):
+        stack = build_stack(config=SchedulerConfig())
+        agent = FakeTpuAgent(stack.cluster)
+        agent.add_host("host", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(
+            PodSpec("p", namespace="team-a", labels={"tpu/chips": "2"})
+        )
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            data = json.loads(
+                urllib.request.urlopen(f"{base}/debug/slo").read()
+            )
+            assert data["enabled"] is True
+            assert data["tenants"]["team-a"]["admissions_total"] == 1
+            assert "targets" in data and "fleet" in data
+            from yoda_tpu import cli
+
+            rc = cli.main(["slo", "--url", base])
+            out = capsys.readouterr().out
+            assert rc == 0  # nothing firing
+            assert "team-a" in out and "no SLO alerts firing" in out
+            rc = cli.main(["slo", "--url", base, "--json"])
+            assert rc == 0
+            assert '"team-a"' in capsys.readouterr().out
+        finally:
+            server.stop()
+
+    def test_cli_slo_unreachable(self, capsys):
+        from yoda_tpu import cli
+
+        rc = cli.main(["slo", "--url", "http://127.0.0.1:1"])
+        assert rc == 2
+        assert "cannot reach" in capsys.readouterr().err
+
+
+class TestTraceReplayDeterminism:
+    """The acceptance contract: identical seeds -> identical SLI output
+    (virtual clock + seeded draws end to end)."""
+
+    SPEC_KW = dict(
+        duration_s=90.0,
+        base_rate_per_s=1.5,
+        diurnal_amplitude=0.4,
+        foreign_rate_per_s=30.0,
+        failure_bursts=((45.0, 1),),
+    )
+
+    def spec(self, seed):
+        from yoda_tpu.testing.tracegen import TenantMix, TraceSpec
+
+        return TraceSpec(
+            seed=seed,
+            tenants=(
+                TenantMix("team-a", priority=5),
+                TenantMix("team-b", gang_fraction=0.3, gang_sizes=(2,)),
+            ),
+            **self.SPEC_KW,
+        )
+
+    def test_identical_seeds_identical_sli_output(self):
+        from yoda_tpu.testing.tracegen import replay
+
+        a = replay(self.spec(7), hosts=6)
+        b = replay(self.spec(7), hosts=6)
+        assert a.fingerprint() == b.fingerprint()
+        assert a.lifecycles > 100 and a.binds > 0
+
+    def test_different_seeds_differ(self):
+        from yoda_tpu.testing.tracegen import replay
+
+        a = replay(self.spec(7), hosts=6)
+        b = replay(self.spec(8), hosts=6)
+        assert a.fingerprint() != b.fingerprint()
+
+    def test_generator_is_deterministic_and_lazy(self):
+        from yoda_tpu.testing.tracegen import generate
+
+        ops_a = list(generate(self.spec(3)))
+        ops_b = list(generate(self.spec(3)))
+        assert [vars(o) for o in ops_a] == [vars(o) for o in ops_b]
+        assert any(o.foreign for o in ops_a)
+        assert any(o.gang_size > 0 for o in ops_a)
+
+    def test_replay_drives_batched_ingest(self):
+        from yoda_tpu.testing.tracegen import replay
+
+        rep = replay(self.spec(5), hosts=6)
+        # Every lifecycle rides the batched path: at least one add and
+        # one (eventual) delete per departed pod, applied in batches.
+        assert rep.ingest_events >= rep.lifecycles
+        assert rep.ingest_batches < rep.ingest_events
+        # The failure burst actually killed a node.
+        assert len(rep.killed_nodes) == 1

@@ -409,3 +409,241 @@ class TestConcurrentScrapeVsServe:
         for r in recs:
             assert r.span_id and r.dur_ms >= 0 and r.name
         assert {"enqueue", "cycle", "bound"} <= {r.name for r in recs}
+
+
+class TestSinkRotation:
+    """trace_sink JSONL rotation (ISSUE 12 satellite): past
+    trace_sink_max_bytes the sink rotates to "<sink>.1" — two
+    generations, disk-bounded — so a week-long soak cannot fill the
+    disk."""
+
+    def test_rotates_on_threshold_keeping_two_generations(self, tmp_path):
+        import os
+
+        from yoda_tpu.tracing import Tracer
+
+        sink = str(tmp_path / "spans.jsonl")
+        tracer = Tracer(sink=sink, sink_max_bytes=2048)
+        for i in range(200):
+            tracer.add(f"pod:ns/p{i}", "cycle", attrs={"i": i})
+        tracer.close()
+        assert tracer.sink_rotations >= 1
+        assert os.path.exists(sink) and os.path.exists(sink + ".1")
+        # Two generations only, each bounded near the threshold.
+        assert not os.path.exists(sink + ".2")
+        assert os.path.getsize(sink) <= 2048 + 512
+        assert os.path.getsize(sink + ".1") <= 2048 + 512
+        # Both generations stay valid JSONL (rotation never splits a line).
+        for path in (sink, sink + ".1"):
+            with open(path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    assert rec["name"] == "cycle"
+
+    def test_no_rotation_at_zero_threshold(self, tmp_path):
+        import os
+
+        from yoda_tpu.tracing import Tracer
+
+        sink = str(tmp_path / "spans.jsonl")
+        tracer = Tracer(sink=sink)  # sink_max_bytes=0: never rotate
+        for i in range(200):
+            tracer.add(f"pod:ns/p{i}", "cycle")
+        tracer.close()
+        assert tracer.sink_rotations == 0
+        assert not os.path.exists(sink + ".1")
+
+    def test_stack_wires_rotation_from_config(self, tmp_path):
+        sink = str(tmp_path / "spans.jsonl")
+        stack = build_stack(
+            config=SchedulerConfig(
+                trace_sink=sink, trace_sink_max_bytes=4096
+            )
+        )
+        assert stack.metrics.tracer.sink_max_bytes == 4096
+
+
+class TestVerdictTaxonomy:
+    """Checker-style pin (ISSUE 12 satellite): every park site in the
+    source tree records a why-pending verdict class from the documented
+    set — a new park site cannot ship an unexplained verdict."""
+
+    def _record_sites(self):
+        """(file, kind-literal-or-None, call-node) for every
+        ``*.record(kind=...)`` call under yoda_tpu/."""
+        import ast
+        import pathlib
+
+        pkg = pathlib.Path(__file__).parent.parent / "yoda_tpu"
+        sites = []
+        for path in sorted(pkg.rglob("*.py")):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "record"
+                ):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "kind":
+                        literal = (
+                            kw.value.value
+                            if isinstance(kw.value, ast.Constant)
+                            else None
+                        )
+                        sites.append(
+                            (str(path.relative_to(pkg.parent)), literal)
+                        )
+        return sites
+
+    def test_every_park_site_uses_a_documented_class(self):
+        from yoda_tpu.tracing import VERDICT_CLASSES
+
+        sites = self._record_sites()
+        assert sites, "found no pending.record(kind=...) sites — checker broken"
+        # Dynamic-kind sites (kind=<variable>) must be the scheduler's
+        # outcome passthrough, whose domain is pinned below.
+        dynamic_ok = {"yoda_tpu/framework/scheduler.py"}
+        for path, literal in sites:
+            if literal is None:
+                assert path in dynamic_ok, (
+                    f"{path}: pending.record with a non-literal kind — "
+                    "use a VERDICT_CLASSES literal or extend the checker"
+                )
+            else:
+                assert literal in VERDICT_CLASSES, (
+                    f"{path}: verdict class {literal!r} is not in "
+                    "tracing.VERDICT_CLASSES — document it there (and in "
+                    "OPERATIONS.md) or use an existing class"
+                )
+        # The one dynamic site records the cycle outcome, and only the
+        # documented outcome subset reaches it.
+        import pathlib
+
+        sched_src = (
+            pathlib.Path(__file__).parent.parent
+            / "yoda_tpu/framework/scheduler.py"
+        ).read_text()
+        assert (
+            'in ("unschedulable", "error", "nominated")' in sched_src
+        ), "scheduler's dynamic-kind guard changed; re-pin the taxonomy"
+
+    def test_every_class_is_used_and_documented(self):
+        import pathlib
+
+        from yoda_tpu.tracing import VERDICT_CLASSES
+
+        literals = {lit for _, lit in self._record_sites() if lit}
+        literals |= {"unschedulable", "error", "nominated"}  # dynamic site
+        assert literals == set(VERDICT_CLASSES), (
+            f"taxonomy drift: documented {sorted(VERDICT_CLASSES)} vs "
+            f"recorded {sorted(literals)}"
+        )
+        ops = (
+            pathlib.Path(__file__).parent.parent / "docs/OPERATIONS.md"
+        ).read_text()
+        for cls in VERDICT_CLASSES:
+            assert f"`{cls}`" in ops, (
+                f"verdict class {cls} not documented in OPERATIONS.md"
+            )
+
+    def test_runtime_records_stay_in_taxonomy(self):
+        """Drive the common park sites end-to-end and assert every
+        recorded verdict kind is classed."""
+        from yoda_tpu.tracing import VERDICT_CLASSES
+
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("big", labels={"tpu/chips": "32"}))
+        labels = {"tpu/gang": "tg", "tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(4):
+            stack.cluster.create_pod(PodSpec(f"tg-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        listing = stack.metrics.pending.summary()
+        assert listing["count"] > 0
+        for kind in listing["by_kind"]:
+            assert kind in VERDICT_CLASSES, kind
+
+
+class TestPendingListing:
+    """GET /debug/pending (no key) + `explain --list` (ISSUE 12
+    satellite): every currently-pending key with verdict-class counts."""
+
+    def test_summary_lists_keys_with_class_counts(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("big", labels={"tpu/chips": "32"}))
+        labels = {"tpu/gang": "tg", "tpu/topology": "2x2x1", "tpu/chips": "4"}
+        for i in range(4):
+            stack.cluster.create_pod(PodSpec(f"tg-{i}", labels=dict(labels)))
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        got = stack.metrics.pending.summary()
+        keys = {e["key"] for e in got["pending"]}
+        assert "default/big" in keys and "tg" in keys
+        assert got["count"] == len(got["pending"])
+        assert sum(got["by_kind"].values()) == got["count"]
+        assert got["by_kind"].get("unschedulable", 0) >= 1
+
+    def test_bind_retires_from_listing(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("p", labels={"tpu/chips": "64"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.metrics.pending.summary()["count"] >= 1
+        agent.add_host("h1", generation="v5e", chips=64)
+        agent.publish_all()
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert stack.metrics.pending.summary()["count"] == 0
+
+    def test_http_listing_and_cli_list(self, capsys):
+        from yoda_tpu import cli
+
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=2)
+        agent.publish_all()
+        stack.cluster.create_pod(PodSpec("big", labels={"tpu/chips": "32"}))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            data = json.loads(
+                urllib.request.urlopen(f"{base}/debug/pending").read()
+            )
+            assert data["count"] >= 1
+            assert data["pending"][0]["key"]
+            # Trailing-slash spelling answers the same listing.
+            data2 = json.loads(
+                urllib.request.urlopen(f"{base}/debug/pending/").read()
+            )
+            assert data2["count"] == data["count"]
+            assert cli.main(["explain", "--list", "--url", base]) == 0
+            out = capsys.readouterr().out
+            assert "default/big" in out and "unschedulable" in out
+        finally:
+            server.stop()
+
+    def test_cli_list_empty(self, capsys):
+        stack, _agent = make_stack()
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            from yoda_tpu import cli
+
+            base = f"http://127.0.0.1:{server.port}"
+            assert cli.main(["explain", "--list", "--url", base]) == 0
+            assert "nothing pending" in capsys.readouterr().out
+        finally:
+            server.stop()
+
+    def test_cli_requires_key_or_list(self, capsys):
+        import pytest
+
+        from yoda_tpu import cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["explain"])

@@ -477,13 +477,29 @@ def _run_explain(argv: "list[str]") -> int:
         prog="yoda-tpu-scheduler explain",
         description="explain why a pod (ns/name) or gang is still pending",
     )
-    p.add_argument("key", help="pod key (namespace/name) or gang name")
+    p.add_argument(
+        "key",
+        nargs="?",
+        default=None,
+        help="pod key (namespace/name) or gang name",
+    )
+    p.add_argument(
+        "--list",
+        action="store_true",
+        dest="list_pending",
+        help="list every currently-pending pod/gang key with its verdict "
+        "class instead of explaining one key",
+    )
     p.add_argument(
         "--url",
         default="http://127.0.0.1:10259",
         help="scheduler metrics endpoint base URL",
     )
     args = p.parse_args(argv)
+    if args.list_pending:
+        return _explain_list(args.url)
+    if not args.key:
+        p.error("a pod/gang key is required (or pass --list)")
     url = (
         f"{args.url.rstrip('/')}/debug/pending/"
         f"{urllib.parse.quote(args.key, safe='/')}"
@@ -524,6 +540,119 @@ def _run_explain(argv: "list[str]") -> int:
     return 0
 
 
+def _explain_list(base_url: str) -> int:
+    """``yoda-tpu-scheduler explain --list`` — the no-key half of
+    why-pending: every currently-pending pod/gang key with its verdict
+    class, from ``GET /debug/pending``."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    url = f"{base_url.rstrip('/')}/debug/pending"
+    try:
+        data = json.loads(urllib.request.urlopen(url, timeout=10).read())
+    except (urllib.error.URLError, OSError) as e:
+        print(f"explain: cannot reach {base_url}: {e}", file=sys.stderr)
+        return 2
+    if not data.get("count"):
+        print("nothing pending (no rejection verdicts recorded)")
+        return 0
+    by_kind = data.get("by_kind") or {}
+    print(
+        f"{data['count']} pending key(s): "
+        + ", ".join(f"{k}={n}" for k, n in sorted(by_kind.items()))
+    )
+    for e in data.get("pending", []):
+        members = f" ({e['members']} member(s))" if e.get("members") else ""
+        print(
+            f"  {e['key']}: {e['kind']} after {e['attempts']} "
+            f"attempt(s){members}"
+        )
+    return 0
+
+
+def _run_slo(argv: "list[str]") -> int:
+    """``yoda-tpu-scheduler slo`` — the fleet SLO CLI: queries a running
+    scheduler's ``GET /debug/slo`` (yoda_tpu/slo engine) and renders the
+    per-tenant + fleet SLIs, targets, burn rates, and firing alerts —
+    "are tenants getting the service we promised?" as one command."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="yoda-tpu-scheduler slo",
+        description="per-tenant/fleet SLO status from a running scheduler",
+    )
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:10259",
+        help="scheduler metrics endpoint base URL",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="dump the raw /debug/slo JSON instead of the table",
+    )
+    args = p.parse_args(argv)
+    url = f"{args.url.rstrip('/')}/debug/slo"
+    try:
+        data = json.loads(urllib.request.urlopen(url, timeout=10).read())
+    except (urllib.error.URLError, OSError) as e:
+        print(f"slo: cannot reach {args.url}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(data, indent=1))
+        return 1 if data.get("alerts") else 0
+    if not data.get("enabled", False):
+        print("SLO engine disabled (slo_enabled: false)")
+        return 0
+    t = data.get("targets", {})
+    w = data.get("windows", {})
+    print(
+        f"targets: admission p99 <= {t.get('admission_wait_p99_s', 0)}s "
+        f"(goal {t.get('admission_wait_slo', 0):.0%}), starved windows <= "
+        f"{t.get('starved_windows', 0)} "
+        f"(window {w.get('starvation_s', 0):.0f}s); burn alert needs both "
+        f"{w.get('burn_fast_s', 0):.0f}s and {w.get('burn_slow_s', 0):.0f}s "
+        f"windows >= {w.get('burn_threshold', 0)}x"
+    )
+    fleet = data.get("fleet", {})
+    goodput = fleet.get("goodput")
+    print(
+        f"fleet: admission p99 {fleet.get('admission_wait_p99_s', 0):.3f}s "
+        f"over {fleet.get('admissions_window', 0)} admission(s), "
+        f"starved windows {fleet.get('starved_windows', 0)}, "
+        f"preemptions/min {fleet.get('preemption_rate_per_min', 0):.2f}, "
+        f"repairs/min {fleet.get('repair_rate_per_min', 0):.2f}, "
+        f"goodput {goodput if goodput is not None else 'n/a'}"
+    )
+    tenants = data.get("tenants", {})
+    if tenants:
+        print(
+            f"{'tenant':<20} {'p99_s':>8} {'admits':>7} {'pending':>8} "
+            f"{'starved':>8} {'burn_f':>7} {'burn_s':>7} alert"
+        )
+        for name in sorted(tenants):
+            row = tenants[name]
+            print(
+                f"{(name or '(default)'):<20} "
+                f"{row['admission_wait_p99_s']:>8.3f} "
+                f"{row['admissions_window']:>7} {row['pending']:>8} "
+                f"{row['starved_windows']:>8} {row['burn_fast']:>7.2f} "
+                f"{row['burn_slow']:>7.2f} {row['alert']}"
+            )
+    alerts = data.get("alerts", [])
+    for a in alerts:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(a.items()) if k not in ("sli",)
+        )
+        print(f"ALERT {a['sli']}: {detail}")
+    if not alerts:
+        print("no SLO alerts firing")
+    return 1 if alerts else 0
+
+
 def main(
     argv: list[str] | None = None, *, stop: threading.Event | None = None
 ) -> int:
@@ -536,6 +665,10 @@ def main(
         # `explain` is an operator query against a RUNNING scheduler, not
         # a serving mode, so it short-circuits before the main parser).
         return _run_explain(argv[1:])
+    if argv and argv[0] == "slo":
+        # Same contract: an operator query against a running scheduler's
+        # /debug/slo endpoint (the fleet SLO engine).
+        return _run_slo(argv[1:])
     parser = argparse.ArgumentParser(
         prog="yoda-tpu-scheduler",
         description="TPU-native Kubernetes scheduler (yoda-tpu)",

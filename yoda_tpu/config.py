@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, fields
 
+from yoda_tpu.slo.engine import SloTargets
+
 
 @dataclass(frozen=True)
 class Weights:
@@ -245,6 +247,32 @@ class SchedulerConfig:
     # JSON object per line) for offline analysis. "" disables. A sink
     # that becomes unwritable is dropped silently; the ring keeps working.
     trace_sink: str = ""
+    # JSONL sink rotation: when the sink file grows past this many bytes
+    # it is rotated to "<trace_sink>.1" (two generations kept: current +
+    # .1, the previous .1 overwritten), so a week-long soak cannot fill
+    # the disk. 0 = never rotate (the pre-rotation behavior).
+    trace_sink_max_bytes: int = 0
+    # Fleet SLO engine (yoda_tpu/slo, docs/OPERATIONS.md "SLO monitoring"
+    # runbook): per-tenant sliding-window SLIs (admission-wait quantiles,
+    # starvation windows, preemption/repair rates, goodput) computed from
+    # events the scheduler already emits, judged against the declarative
+    # slo_targets with multi-window burn-rate alerting, served at
+    # /debug/slo + `yoda-tpu-scheduler slo` + the yoda_slo_* series.
+    # False turns the record paths off entirely (one attribute read per
+    # call site — the same near-zero-when-off contract as tracing).
+    slo_enabled: bool = True
+    # Declarative targets (keys of slo.SloTargets; unset keys keep their
+    # defaults, 0 disables a target).
+    slo_targets: "SloTargets" = field(default_factory=lambda: SloTargets())
+    # A starved window: one tenant holding queued work with ZERO
+    # admissions for this long. The bench matrix asserts zero of these.
+    slo_starvation_window_s: float = 60.0
+    # Multi-window burn-rate alerting: the admission SLI's error budget
+    # is burned over BOTH windows; an alert needs both past the
+    # threshold (fast-only = noise, slow-only = old news).
+    slo_burn_fast_window_s: float = 300.0
+    slo_burn_slow_window_s: float = 3600.0
+    slo_burn_threshold: float = 2.0
     # Cluster events retry a parked pod immediately through this many
     # scheduling attempts; beyond it the pod's exponential backoff timer
     # holds regardless of event rate (upstream moveAllToActiveOrBackoffQueue
@@ -283,6 +311,18 @@ class SchedulerConfig:
     def from_dict(cls, d: dict) -> "SchedulerConfig":
         d = dict(d)
         w = d.pop("weights", None)
+        slo_t = d.pop("slo_targets", None)
+        if slo_t is not None:
+            # Instance passthrough: profile resolution re-runs from_dict
+            # over merged base keys that may already be parsed.
+            if isinstance(slo_t, SloTargets):
+                d["slo_targets"] = slo_t
+            elif isinstance(slo_t, dict):
+                d["slo_targets"] = SloTargets.from_dict(slo_t)
+            else:
+                raise ValueError(
+                    f"slo_targets must be a mapping, got {slo_t!r}"
+                )
         profile_dicts = d.pop("profiles", None) or ()
         if profile_dicts:
             base = dict(d)
@@ -517,6 +557,47 @@ class SchedulerConfig:
             raise ValueError(
                 f"trace_sink must be a path string ('' disables), got "
                 f"{cfg.trace_sink!r}"
+            )
+        if (
+            isinstance(cfg.trace_sink_max_bytes, bool)
+            or not isinstance(cfg.trace_sink_max_bytes, int)
+            or cfg.trace_sink_max_bytes < 0
+        ):
+            raise ValueError(
+                "trace_sink_max_bytes must be an int >= 0 (0 = never "
+                f"rotate), got {cfg.trace_sink_max_bytes!r}"
+            )
+        if not isinstance(cfg.slo_enabled, bool):
+            raise ValueError(
+                f"slo_enabled must be a bool, got {cfg.slo_enabled!r}"
+            )
+        if not isinstance(cfg.slo_targets, SloTargets):
+            raise ValueError(
+                f"slo_targets must resolve to SloTargets, got "
+                f"{cfg.slo_targets!r}"
+            )
+        slo_windows = (
+            cfg.slo_starvation_window_s,
+            cfg.slo_burn_fast_window_s,
+            cfg.slo_burn_slow_window_s,
+        )
+        if any(
+            isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0
+            for v in slo_windows
+        ) or not slo_windows[1] <= slo_windows[2]:
+            raise ValueError(
+                "SLO windows must satisfy slo_starvation_window_s > 0 and "
+                "0 < slo_burn_fast_window_s <= slo_burn_slow_window_s, got "
+                f"{slo_windows}"
+            )
+        if not isinstance(
+            cfg.slo_burn_threshold, (int, float)
+        ) or isinstance(
+            cfg.slo_burn_threshold, bool
+        ) or cfg.slo_burn_threshold <= 0:
+            raise ValueError(
+                "slo_burn_threshold must be > 0, got "
+                f"{cfg.slo_burn_threshold!r}"
             )
         if (
             isinstance(cfg.immediate_retry_attempts, bool)

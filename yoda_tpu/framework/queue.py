@@ -265,6 +265,11 @@ class SchedulingQueue:
             self._push_active(self._unschedulable.pop(key))
 
     def _push_active(self, qpi: QueuedPodInfo) -> None:
+        if qpi.added_unix == 0.0:
+            # Entries rebuilt on requeue paths (permit rejection, gang
+            # rollback, repair) arrive without a timestamp: stamp them so
+            # the SLO engine's wait accounting never sees epoch zero.
+            qpi.added_unix = self._clock()
         heap = self._active.setdefault(self._tenant(qpi.pod), [])
         heapq.heappush(heap, _HeapItem(qpi, next(self._seq), self._less))
 
@@ -437,6 +442,33 @@ class SchedulingQueue:
             self._fire_activity()
         return removed
 
+    def tenant_wait_stats(self) -> "dict[str, tuple[int, float | None]]":
+        """tenant -> (queued entries across all three pools, oldest
+        ``added_unix`` on the queue clock, None when unknown) — the
+        pending/starvation side of the SLO engine's SLIs. With fairness
+        off everything reports under the single ``""`` tenant. One locked
+        sweep; called on evaluation demand (scrape/HTTP/bench), never on
+        the serve path."""
+        with self._lock:
+            out: dict[str, tuple[int, float | None]] = {}
+
+            def note(qpi: QueuedPodInfo) -> None:
+                tenant = self._tenant(qpi.pod)
+                n, oldest = out.get(tenant, (0, None))
+                t = qpi.added_unix if qpi.added_unix > 0.0 else None
+                if t is not None and (oldest is None or t < oldest):
+                    oldest = t
+                out[tenant] = (n + 1, oldest)
+
+            for heap in self._active.values():
+                for item in heap:
+                    note(item.qpi)
+            for _, _, qpi in self._backoff:
+                note(qpi)
+            for qpi in self._unschedulable.values():
+                note(qpi)
+            return out
+
     def pending_gangs(self) -> "dict[str, tuple[int, int]]":
         """gang name -> (queued member count, min attempts over them),
         across all three pools. The federation spillover pass reads this
@@ -526,6 +558,8 @@ class SchedulingQueue:
         after backoff (cheap retry loop) AND on any cluster event via
         ``move_all_to_active`` (the upstream event-driven path)."""
         qpi.unschedulable_message = message
+        if qpi.added_unix == 0.0:
+            qpi.added_unix = self._clock()
         with self._cond:
             ready_at = self._clock() + qpi.backoff_seconds()
             heapq.heappush(self._backoff, (ready_at, next(self._seq), qpi))
@@ -537,6 +571,8 @@ class SchedulingQueue:
         on an explicit cluster event (``move_all_to_active``), mirroring the
         upstream UnschedulableAndUnresolvable pool semantics."""
         qpi.unschedulable_message = message
+        if qpi.added_unix == 0.0:
+            qpi.added_unix = self._clock()
         with self._lock:
             self._unschedulable[qpi.pod.key] = qpi
 

@@ -584,6 +584,8 @@ class Scheduler:
             self.stats.binds += 1
         if self.metrics is not None:
             self.metrics.binds.inc()
+            # SLO engine: close the enqueue->bound admission-wait edge.
+            self.metrics.slo.observe_bound(pod, now=self.clock())
         if self.on_bound:
             self.on_bound(pod, node_name)
         # Cluster changed: retry parked pods. Skipped when nothing is
@@ -678,6 +680,10 @@ class Scheduler:
                     self.stats.binds += 1
                 if self.metrics is not None:
                     self.metrics.binds.inc()
+                    # SLO engine: permit-released members close their
+                    # admission-wait edge here, on whichever thread
+                    # settled the bind.
+                    self.metrics.slo.observe_bound(pod, now=self.clock())
                     gang = gang_name_of(pod.labels)
                     self.metrics.pending.resolve(pod.key, gang=gang)
                     if self.metrics.tracer.enabled:

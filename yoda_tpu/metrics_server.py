@@ -30,6 +30,15 @@ endpoint is first-party and dependency-free (stdlib http.server):
                      404 (JSON body) when nothing is pending under that
                      key. Also the backend of `yoda-tpu-scheduler
                      explain <key>`.
+    GET /debug/pending -> no key: every currently-pending pod/gang key
+                     with verdict-class counts (`explain --list`).
+    GET /debug/slo -> the fleet SLO engine's evaluation (yoda_tpu/slo):
+                     per-tenant and fleet SLIs (admission-wait
+                     quantiles, starvation windows, preemption/repair
+                     rates, goodput), declarative targets, multi-window
+                     burn rates, and firing alerts. Backend of
+                     `yoda-tpu-scheduler slo`; the same numbers export
+                     as the yoda_slo_* Prometheus series.
 """
 
 from __future__ import annotations
@@ -87,6 +96,26 @@ class MetricsServer:
                     body, ctype = self._trace(qs)
                 elif path == "/debug/traces":
                     body, ctype = self._debug_traces(qs)
+                elif path == "/debug/slo":
+                    # Fleet SLO engine (yoda_tpu/slo): a FRESH evaluation
+                    # — per-tenant + fleet SLIs, targets, burn rates, and
+                    # firing alerts. Backend of `yoda-tpu-scheduler slo`.
+                    body = (
+                        json.dumps(outer.metrics.slo.summary(), indent=1)
+                        + "\n"
+                    )
+                    ctype = "application/json"
+                elif path in ("/debug/pending", PENDING_PREFIX):
+                    # No key: list EVERY currently-pending pod/gang key
+                    # with verdict-class counts (before this you had to
+                    # already know the key to ask why it was pending).
+                    body = (
+                        json.dumps(
+                            outer.metrics.pending.summary(), indent=1
+                        )
+                        + "\n"
+                    )
+                    ctype = "application/json"
                 elif path.startswith(PENDING_PREFIX):
                     key = urllib.parse.unquote(path[len(PENDING_PREFIX):])
                     info = outer.metrics.pending.explain(key)

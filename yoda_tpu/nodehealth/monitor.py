@@ -213,6 +213,9 @@ class NodeHealthMonitor:
         self._lock = threading.Lock()
         self._states: dict[str, _NodeRecord] = {}
         self._fenced: frozenset[str] = frozenset()
+        # Deleted nodes whose ladder record (and yoda_node_state series)
+        # retires on the NEXT settled pass (bounded gauge cardinality).
+        self._retire_armed: set[str] = set()
         self.passes = 0
 
     # --- readers ---
@@ -480,13 +483,47 @@ class NodeHealthMonitor:
                 n for n, r in self._states.items() if r.repair_pending
             )
         if not self.repair:
+            self._retire_deleted()
             return report
         if self.scheduler is not None and self.scheduler._fenced():
             return report  # not leading: the new leader's monitor repairs
         if targets:
             self._repair_nodes(set(targets), report)
         self._check_patches(report)
+        self._retire_deleted()
         return report
+
+    def _retire_deleted(self) -> None:
+        """Bounded gauge cardinality: drop the ladder record — and its
+        ``yoda_node_state{node=...}`` label series — for nodes whose TPU
+        CR is deleted once no repair is owed. Without this a long-lived
+        process scrapes one series per node that EVER lived; a recreated
+        node starts a fresh record from its next watch event. Retirement
+        is deferred one pass past settling, so the DOWN transition stays
+        scrapeable for at least one monitor period."""
+        removed: list[str] = []
+        with self._lock:
+            for name, rec in list(self._states.items()):
+                if "TpuNodeMetrics" not in rec.deleted_kinds or (
+                    rec.repair_pending and self.repair
+                ):
+                    self._retire_armed.discard(name)
+                    continue
+                if name not in self._retire_armed:
+                    self._retire_armed.add(name)  # retire NEXT pass
+                    continue
+                self._retire_armed.discard(name)
+                del self._states[name]
+                removed.append(name)
+            if removed:
+                # Deleted nodes were fenced; they exist in no snapshot, so
+                # shrinking the set needs no invalidation/reactivation.
+                self._fenced = frozenset(
+                    n for n, r in self._states.items() if r.state.fenced
+                )
+        if removed and self.metrics is not None:
+            for name in removed:
+                self.metrics.node_state.remove(node=name)
 
     def _check_patches(self, report: RepairReport) -> None:
         """Escalate patch repairs that never completed: the fit check's
@@ -538,6 +575,7 @@ class NodeHealthMonitor:
             report.requeued.append(name)
             if self.metrics is not None:
                 self.metrics.gang_repairs.inc(mode="requeue")
+                self.metrics.slo.observe_repair(now=self.clock())
             log.warning("nodehealth: %s", why)
 
     def run_forever(
@@ -756,6 +794,9 @@ class NodeHealthMonitor:
             step(f"repair-{mode}", unbound=len(to_unbind))
             if self.metrics is not None:
                 self.metrics.gang_repairs.inc(mode=mode)
+                # SLO engine: every gang-whole repair feeds the fleet
+                # repair-rate SLI.
+                self.metrics.slo.observe_repair(now=self.clock())
                 for pod, host in lost:
                     self.metrics.pending.record(
                         pod.key,

@@ -119,6 +119,15 @@ class Gauge:
         with self._lock:
             self._values[key] = value
 
+    def remove(self, **labels: str) -> None:
+        """Retire one label series (bounded gauge cardinality): a
+        long-lived process must drop per-object series — a deleted
+        node's ``yoda_node_state{node=...}`` row, a departed tenant's
+        share — or every object that EVER existed scrapes forever."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
     def value(self, **labels: str) -> float:
         if self.collect_fn is not None:
             got = self.collect_fn()
@@ -281,12 +290,19 @@ class SchedulingMetrics:
         trace_capacity: int = 512,
         tracer=None,
         pending=None,
+        slo=None,
     ):
+        from yoda_tpu.slo import SloEngine
         from yoda_tpu.tracing import PendingIndex, Tracer
 
         self.registry = registry or Registry()
         self.tracer = tracer if tracer is not None else Tracer()
         self.pending = pending if pending is not None else PendingIndex()
+        # Fleet SLO engine (ISSUE 12, yoda_tpu/slo): rides this object for
+        # the same reason the tracer does — one engine must aggregate
+        # per-tenant SLIs across every profile stack and federation
+        # member that can bind the tenant's pods.
+        self.slo = slo if slo is not None else SloEngine()
         r = self.registry
         self.attempts = r.counter(
             "yoda_scheduling_attempts_total",
@@ -520,6 +536,65 @@ class SchedulingMetrics:
             "re-enter and re-check when capacity frees); a climbing rate "
             "with flat binds means a tenant is submitting far past its "
             "quota",
+        )
+        # Fleet SLO engine series (docs/OPERATIONS.md "SLO monitoring"
+        # runbook): all lazy reads of the shared engine's cached
+        # evaluation — one scrape triggers at most one window walk, and
+        # the serve path never evaluates anything. Label series come and
+        # go with the engine's live tenant set (bounded cardinality).
+        slo_engine = self.slo
+        self.slo_admission_p99 = r.gauge(
+            "yoda_slo_admission_wait_p99_seconds",
+            "Per-tenant p99 of the enqueue->bound admission wait over the "
+            "slow SLO window (the SLI judged against "
+            "slo_targets.admission_wait_p99_s)",
+            slo_engine.prom_admission_p99,
+        )
+        self.slo_starved = r.gauge(
+            "yoda_slo_starved_windows",
+            "Cumulative starved windows per tenant (queued work and ZERO "
+            "admissions across a whole slo_starvation_window_s); any "
+            "nonzero value on a healthy fleet is an SLO violation",
+            slo_engine.prom_starved_windows,
+        )
+        self.slo_burn = r.gauge(
+            "yoda_slo_burn_rate",
+            "Fleet admission-SLI error-budget burn rate per window "
+            "(window=fast|slow); an alert needs BOTH windows past "
+            "slo_burn_threshold",
+            slo_engine.prom_burn,
+        )
+        self.slo_preemption_rate = r.gauge(
+            "yoda_slo_preemption_rate_per_min",
+            "Fleet preemptions per minute over the fast SLO window "
+            "(PostFilter evictions + rebalancer priority preemptions)",
+            slo_engine.prom_preemption_rate,
+        )
+        self.slo_repair_rate = r.gauge(
+            "yoda_slo_repair_rate_per_min",
+            "Gang-whole repairs per minute over the fast SLO window "
+            "(nodehealth patch/shrink/requeue + drain migrations)",
+            slo_engine.prom_repair_rate,
+        )
+        self.slo_goodput = r.gauge(
+            "yoda_slo_goodput",
+            "Chip-utilization goodput sampled at the last SLO evaluation "
+            "(bin-packing efficiency; judged against "
+            "slo_targets.goodput_min while the fleet sees traffic)",
+            slo_engine.prom_goodput,
+        )
+        self.slo_alerts = r.gauge(
+            "yoda_slo_alerts_firing",
+            "SLO alerts currently firing (multi-window burn, starvation, "
+            "preemption/repair rate, goodput) — the pager-side summary of "
+            "/debug/slo",
+            slo_engine.prom_alerts_firing,
+        )
+        self.slo_evaluations = r.counter(
+            "yoda_slo_evaluations_total",
+            "SLO engine evaluations (scrape / /debug/slo / CLI / bench "
+            "demand; the serve path never evaluates)",
+            collect_fn=lambda: slo_engine.evaluations,
         )
         self._trace_lock = threading.Lock()
         self._trace: deque[TraceEntry] = deque(maxlen=trace_capacity)

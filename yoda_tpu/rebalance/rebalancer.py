@@ -422,6 +422,7 @@ class Rebalancer:
                     report.drained.append(name)
                     if self.metrics is not None:
                         self.metrics.gang_repairs.inc(mode="drain")
+                        self.metrics.slo.observe_repair(now=self.clock())
                 continue
             # Plain/elastic gang: requeue whole — admission re-places it
             # off the fenced node. Only when live capacity fits it now
@@ -444,6 +445,7 @@ class Rebalancer:
             report.drained.append(name)
             if self.metrics is not None:
                 self.metrics.gang_repairs.inc(mode="drain")
+                self.metrics.slo.observe_repair(now=self.clock())
             log.info(
                 "rebalance: drained gang %s off %s (requeued whole)",
                 name, sorted({h for _, h in members if h in draining}),
@@ -688,10 +690,14 @@ class Rebalancer:
                         },
                     )
         if self.metrics is not None:
-            self.metrics.rebalance_preemptions.inc(
-                sum(len(u.members) for u in chosen)
-            )
+            n_preempted = sum(len(u.members) for u in chosen)
+            self.metrics.rebalance_preemptions.inc(n_preempted)
             self.metrics.preempted_weight.inc(weight)
+            # SLO engine: priority preemptions feed the fleet
+            # preemption-rate SLI alongside PostFilter evictions.
+            self.metrics.slo.observe_preemption(
+                n_preempted, now=self.clock()
+            )
         log.info(
             "rebalance: preempted %d pod(s) in %d unit(s) (weight %d) to "
             "admit gang %s",

@@ -1,0 +1,7 @@
+"""Fleet SLO engine (ISSUE 12): sliding-window SLIs computed from the
+events the scheduler already emits, evaluated against declarative targets
+with multi-window burn-rate alerting. See yoda_tpu/slo/engine.py."""
+
+from yoda_tpu.slo.engine import SloEngine, SloTargets
+
+__all__ = ["SloEngine", "SloTargets"]

@@ -30,6 +30,36 @@ from yoda_tpu.plugins.yoda.preemption import TpuPreemption
 from yoda_tpu.rebalance import Rebalancer
 
 
+def _metrics_from_config(
+    config: SchedulerConfig, clock=time.monotonic
+) -> SchedulingMetrics:
+    """One SchedulingMetrics with the config-derived tracer AND fleet SLO
+    engine. Used both for a stack's own metrics and for the SHARED
+    registry of profile stacks / federation members — the tracer, the
+    why-pending index, and the SLO engine must each be ONE object across
+    every serve loop that can touch a tenant's pods."""
+    from yoda_tpu.slo import SloEngine
+    from yoda_tpu.tracing import Tracer
+
+    return SchedulingMetrics(
+        tracer=Tracer(
+            sample_rate=config.trace_sample_rate,
+            capacity=config.trace_capacity,
+            sink=config.trace_sink or None,
+            sink_max_bytes=config.trace_sink_max_bytes,
+        ),
+        slo=SloEngine(
+            targets=config.slo_targets,
+            enabled=config.slo_enabled,
+            starvation_window_s=config.slo_starvation_window_s,
+            fast_window_s=config.slo_burn_fast_window_s,
+            slow_window_s=config.slo_burn_slow_window_s,
+            burn_threshold=config.slo_burn_threshold,
+            clock=clock,
+        ),
+    )
+
+
 @dataclass
 class Stack:
     cluster: FakeCluster
@@ -94,15 +124,7 @@ def build_stack(
     # fronts.
     own_metrics = metrics is None
     if own_metrics:
-        from yoda_tpu.tracing import Tracer
-
-        metrics = SchedulingMetrics(
-            tracer=Tracer(
-                sample_rate=config.trace_sample_rate,
-                capacity=config.trace_capacity,
-                sink=config.trace_sink or None,
-            )
-        )
+        metrics = _metrics_from_config(config, clock)
     # Scheduling Events (kubectl describe pod): the reference got these from
     # the upstream scheduler's recorder; here the loop emits its own.
     recorder = (
@@ -180,7 +202,13 @@ def build_stack(
             reserved_fn=accountant.chips_in_use,
             gang_status_fn=gang.gang_status,
             gang_plan_fn=gang.planned_unassigned_hosts,
-            on_evicted=metrics.preemptions.inc,
+            # Eviction counter + the SLO engine's preemption-rate SLI in
+            # one hook (the rebalancer's priority preemptions feed the
+            # same SLI from its own pass).
+            on_evicted=lambda n: (
+                metrics.preemptions.inc(n),
+                metrics.slo.observe_preemption(n),
+            ),
             on_victim=(
                 (lambda v: recorder.preempted(v.pod, v.node))
                 if recorder
@@ -250,6 +278,11 @@ def build_stack(
         quota_fn=quota_fn,
         on_quota_park=on_quota_park,
     )
+    # Fleet SLO engine (ISSUE 12): this stack's queue feeds the
+    # per-tenant pending/starvation side of the SLIs (the engine is
+    # shared across profile stacks and federation members, so every
+    # queue registers into the one engine).
+    metrics.slo.add_queue(queue)
     # Per-tenant dominant-share gauge (accumulator pattern: one family
     # on a shared registry; profile stacks watch the same cluster, so
     # the max over ledgers is the fleet truth). Registered even with
@@ -368,6 +401,9 @@ def build_stack(
         for event in events:
             if event.kind == "Pod" and event.type == "deleted":
                 queue.remove(event.obj.uid)
+                # SLO engine: a pod deleted while pending retires its
+                # enqueue record — a cancelled ask is not an admission.
+                metrics.slo.observe_retired(event.obj)
         # Quick fix (ISSUE 10 satellite): with nothing parked — an idle
         # cluster's heartbeats, or a drained queue under churn — the
         # move is a locked full-sweep to move nothing; skip it. Any
@@ -394,6 +430,9 @@ def build_stack(
             from yoda_tpu.tracing import subject_of
 
             tracer.add(subject_of(pod), "enqueue", attrs={"pod": pod.key})
+        # SLO engine: the enqueue half of the admission-wait SLI (the
+        # bound half fires in the scheduler's bind completion paths).
+        metrics.slo.observe_enqueue(pod)
         queue.add(pod)
 
     informer = InformerCache(
@@ -687,6 +726,9 @@ def build_stack(
         # stack built against a shared registry wins).
         metrics.attach_fleet(informer.snapshot, accountant.chips_in_use)
         metrics._fleet_attached = True
+        # Chip-utilization goodput SLI: the accountant-backed bin-packing
+        # efficiency gauge, sampled by the SLO engine at evaluation time.
+        metrics.slo.goodput_fn = metrics.binpack_efficiency.value
     scheduler = Scheduler(
         framework,
         informer.snapshot,
@@ -819,7 +861,7 @@ def build_federation(
     from yoda_tpu.federation import ClusterHealthMonitor, Federation, FederationMember
 
     config = config or SchedulerConfig()
-    shared_metrics = SchedulingMetrics()
+    shared_metrics = _metrics_from_config(config, clock)
     members: list[FederationMember] = []
     for name, cluster in clusters:
         stack = build_stack(
@@ -883,7 +925,7 @@ def build_profile_stacks(
     # inside TpuPreemption so it is consistent with Reserve; only the
     # eviction round-trips run lock-free (ADVICE r3).
     post_filter_lock = threading.Lock()
-    shared_metrics = SchedulingMetrics()
+    shared_metrics = _metrics_from_config(config, clock)
     stacks = [
         build_stack(
             cluster=cluster,

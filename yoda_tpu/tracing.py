@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
 import zlib
@@ -60,6 +61,39 @@ MAX_SUBJECTS = 8192
 # names sampled per reason — the summary is for operators, not a full dump.
 MAX_REASONS = 16
 MAX_REASON_NODES = 12
+
+# The why-pending verdict taxonomy: every park site MUST record one of
+# these classes, so `explain` output (and the /debug/pending listing's
+# per-class counts) stays interpretable as park sites are added. The
+# checker-style test in tests/test_tracing.py walks the source tree for
+# ``pending.record(kind=...)`` call sites and fails on any class outside
+# this set — a new park site cannot ship unexplained. Documented in
+# docs/OPERATIONS.md ("Tracing and why-pending").
+VERDICT_CLASSES = frozenset(
+    {
+        # Scheduling-cycle outcomes (framework/scheduler.done): Filter
+        # found no feasible node (per-node reasons attached) / a plugin
+        # or kernel error (retried via backoff) / preemption nominated a
+        # node and the pod awaits victim drain.
+        "unschedulable",
+        "error",
+        "nominated",
+        # A Permit-parked member was rejected (gang rollback, bind
+        # failure, fence flip, permit timeout).
+        "permit-rejected",
+        # Gang/topology admission parked the gang whole (no capacity or
+        # no free contiguous ICI block for every member).
+        "admission-park",
+        # The cross-gang joint fit gate restored the gang untouched
+        # (cannot place whole net of higher-priority co-queued gangs).
+        "joint-park",
+        # Per-tenant quota admission parked the entry (DRF queue).
+        "quota-park",
+        # Node failure domains: members lost to a DOWN node awaiting
+        # gang-whole repair.
+        "node-repair",
+    }
+)
 
 
 def subject_of(pod: PodSpec) -> str:
@@ -121,11 +155,19 @@ class Tracer:
         sample_rate: float = 1.0,
         capacity: int = 4096,
         sink: str | None = None,
+        sink_max_bytes: int = 0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.sample_rate = max(0.0, min(float(sample_rate), 1.0))
         self.capacity = max(int(capacity), 16)
         self.sink_path = sink or None
+        # Rotate-on-threshold (config trace_sink_max_bytes): past this
+        # many bytes the sink rotates to "<sink>.1" (two generations —
+        # current + .1 — so a week-long soak is disk-bounded at ~2x the
+        # threshold). 0 = never rotate.
+        self.sink_max_bytes = max(int(sink_max_bytes), 0)
+        self.sink_rotations = 0
+        self._sink_bytes = 0
         self.clock = clock
         self.dropped = 0            # ring overflow count (oldest evicted)
         self._lock = threading.Lock()
@@ -239,8 +281,25 @@ class Tracer:
             with self._lock:
                 if self._sink_file is None:
                     self._sink_file = open(self.sink_path, "a")
-                self._sink_file.write(json.dumps(rec.to_dict()) + "\n")
+                    try:
+                        self._sink_bytes = os.path.getsize(self.sink_path)
+                    except OSError:
+                        self._sink_bytes = 0
+                line = json.dumps(rec.to_dict()) + "\n"
+                self._sink_file.write(line)
                 self._sink_file.flush()
+                self._sink_bytes += len(line)
+                if (
+                    self.sink_max_bytes > 0
+                    and self._sink_bytes >= self.sink_max_bytes
+                ):
+                    # Rotate: current -> .1 (previous .1 overwritten),
+                    # fresh current. Week-long soaks stay disk-bounded.
+                    self._sink_file.close()
+                    os.replace(self.sink_path, self.sink_path + ".1")
+                    self._sink_file = open(self.sink_path, "a")
+                    self._sink_bytes = 0
+                    self.sink_rotations += 1
         except OSError:
             # An unwritable sink must never take the serve path down:
             # disable it and keep the in-memory ring.
@@ -498,3 +557,31 @@ class PendingIndex:
     def keys(self) -> "list[str]":
         with self._lock:
             return list(self._entries)
+
+    def summary(self) -> dict:
+        """Every currently-pending pod/gang key with its verdict class —
+        the no-argument half of why-pending (``GET /debug/pending``,
+        ``explain --list``): before this you had to already KNOW the key
+        to ask why it was pending. Most-recent verdict first; per-class
+        counts let an operator triage a big backlog at a glance."""
+        with self._lock:
+            entries = [
+                {
+                    "key": key,
+                    "kind": e["kind"],
+                    "attempts": e["count"],
+                    "first_wall_unix": round(e["first_wall"], 3),
+                    "last_wall_unix": round(e["last_wall"], 3),
+                    "members": len(e["members"]),
+                }
+                for key, e in self._entries.items()
+            ]
+        entries.sort(key=lambda e: (-e["last_wall_unix"], e["key"]))
+        by_kind: "dict[str, int]" = {}
+        for e in entries:
+            by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+        return {
+            "count": len(entries),
+            "by_kind": dict(sorted(by_kind.items())),
+            "pending": entries,
+        }
