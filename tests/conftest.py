@@ -27,3 +27,88 @@ if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+import threading
+import time
+
+import pytest
+
+# How long a straggler gets to finish its in-flight teardown before it
+# counts as leaked. Generous enough for an executor draining a bind, far
+# below a genuinely-forgotten serve loop's lifetime.
+_LEAK_JOIN_GRACE_S = 2.0
+
+
+@pytest.fixture(autouse=True)
+def _thread_hygiene(request):
+    """Leaked-thread / background-exception gate (ISSUE 13 satellite).
+
+    Every component here owns background threads (serve loops, bind
+    executors, reconcilers, rebalancers, watch pumps); a test that exits
+    while one is still running leaks it into every later test — flaky
+    cross-talk that surfaces hundreds of tests away from the cause. And
+    an exception that kills a background thread is silent by default:
+    the test that caused it can still pass while the stack it drove is
+    half-dead.
+
+    Two checks per test, rather than one sweep per session, so the
+    FAILING TEST is the one that leaked:
+
+    - live non-daemon threads are snapshotted before the test; any new
+      one still alive after a short join grace fails the test
+      (`@pytest.mark.allow_thread_leak` opts out, reason required in
+      the marker args);
+    - ``threading.excepthook`` records every uncaught background-thread
+      exception raised during the test and fails it at teardown
+      (`@pytest.mark.allow_thread_exception` opts out).
+    """
+    before = set(threading.enumerate())
+    uncaught: "list[threading.ExceptHookArgs]" = []
+    prev_hook = threading.excepthook
+
+    def recording_hook(args, /):
+        # SystemExit is the documented "thread asked to stop" path.
+        if args.exc_type is not SystemExit:
+            uncaught.append(args)
+        prev_hook(args)
+
+    threading.excepthook = recording_hook
+    try:
+        yield
+    finally:
+        threading.excepthook = prev_hook
+        leaked = [
+            t
+            for t in threading.enumerate()
+            if t not in before and t.is_alive() and not t.daemon
+        ]
+        deadline = time.monotonic() + _LEAK_JOIN_GRACE_S
+        for t in leaked:
+            t.join(max(deadline - time.monotonic(), 0.0))
+        leaked = [t for t in leaked if t.is_alive()]
+        if leaked and request.node.get_closest_marker(
+            "allow_thread_leak"
+        ) is None:
+            pytest.fail(
+                "test leaked non-daemon thread(s) still alive "
+                f"{_LEAK_JOIN_GRACE_S:.0f}s after teardown: "
+                f"{sorted(t.name for t in leaked)} — stop/join every "
+                "background loop the test started (or mark "
+                "allow_thread_leak with a reason)",
+                pytrace=False,
+            )
+        if uncaught and request.node.get_closest_marker(
+            "allow_thread_exception"
+        ) is None:
+            descs = [
+                f"{a.thread.name if a.thread else '?'}: "
+                f"{a.exc_type.__name__}: {a.exc_value}"
+                for a in uncaught
+            ]
+            pytest.fail(
+                "uncaught exception(s) killed background thread(s) "
+                f"during this test: {descs} — the stack under test is "
+                "half-dead; handle the error or mark "
+                "allow_thread_exception with a reason",
+                pytrace=False,
+            )

@@ -47,7 +47,7 @@ def run_main_bg():
         return t
 
     yield run
-    for stop, t in stops:
+    for stop, _ in stops:
         stop.set()
     for _, t in stops:
         t.join(timeout=10)

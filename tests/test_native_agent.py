@@ -25,7 +25,12 @@ GIB = 1 << 30
 
 @pytest.fixture(scope="module")
 def lib():
-    so = os.path.join(NATIVE, "libyoda_tpuinfo.so")
+    # YODA_TPUINFO_SO points the whole module at an alternate build —
+    # `make native-asan` runs these tests against the sanitizer-
+    # instrumented reader through exactly this hook.
+    so = os.environ.get("YODA_TPUINFO_SO") or os.path.join(
+        NATIVE, "libyoda_tpuinfo.so"
+    )
     if not os.path.exists(so):
         if shutil.which("g++") is None:
             pytest.skip("no g++ toolchain")

@@ -235,19 +235,19 @@ class TestAllFamiliesRegistered:
             assert f"# TYPE {family} " in text, family
 
     def test_checker_list_matches_code(self):
-        """The explicit list above IS what tools/check_metrics.py finds
-        in the source tree — adding a metric without updating this list
-        (and OPERATIONS.md) fails here, not just under make lint."""
+        """The explicit list above IS what yodalint's metrics-drift pass
+        (the migrated tools/check_metrics.py, ISSUE 13) finds in the
+        source tree — adding a metric without updating this list (and
+        OPERATIONS.md) fails here, not just under make lint."""
         import pathlib
-        import sys
 
-        tools = str(pathlib.Path(__file__).parent.parent / "tools")
-        sys.path.insert(0, tools)
-        try:
-            from check_metrics import registered_names
-        finally:
-            sys.path.remove(tools)
-        assert sorted(registered_names()) == sorted(ALL_METRIC_FAMILIES)
+        from tools.yodalint import Project
+        from tools.yodalint.passes.metrics_drift import registered_names
+
+        project = Project(pathlib.Path(__file__).parent.parent)
+        assert sorted(registered_names(project)) == sorted(
+            ALL_METRIC_FAMILIES
+        )
 
 
 class TestIngestAndTenantMetrics:

@@ -464,89 +464,14 @@ class TestSinkRotation:
 
 
 class TestVerdictTaxonomy:
-    """Checker-style pin (ISSUE 12 satellite): every park site in the
-    source tree records a why-pending verdict class from the documented
-    set — a new park site cannot ship an unexplained verdict."""
-
-    def _record_sites(self):
-        """(file, kind-literal-or-None, call-node) for every
-        ``*.record(kind=...)`` call under yoda_tpu/."""
-        import ast
-        import pathlib
-
-        pkg = pathlib.Path(__file__).parent.parent / "yoda_tpu"
-        sites = []
-        for path in sorted(pkg.rglob("*.py")):
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if not (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "record"
-                ):
-                    continue
-                for kw in node.keywords:
-                    if kw.arg == "kind":
-                        literal = (
-                            kw.value.value
-                            if isinstance(kw.value, ast.Constant)
-                            else None
-                        )
-                        sites.append(
-                            (str(path.relative_to(pkg.parent)), literal)
-                        )
-        return sites
-
-    def test_every_park_site_uses_a_documented_class(self):
-        from yoda_tpu.tracing import VERDICT_CLASSES
-
-        sites = self._record_sites()
-        assert sites, "found no pending.record(kind=...) sites — checker broken"
-        # Dynamic-kind sites (kind=<variable>) must be the scheduler's
-        # outcome passthrough, whose domain is pinned below.
-        dynamic_ok = {"yoda_tpu/framework/scheduler.py"}
-        for path, literal in sites:
-            if literal is None:
-                assert path in dynamic_ok, (
-                    f"{path}: pending.record with a non-literal kind — "
-                    "use a VERDICT_CLASSES literal or extend the checker"
-                )
-            else:
-                assert literal in VERDICT_CLASSES, (
-                    f"{path}: verdict class {literal!r} is not in "
-                    "tracing.VERDICT_CLASSES — document it there (and in "
-                    "OPERATIONS.md) or use an existing class"
-                )
-        # The one dynamic site records the cycle outcome, and only the
-        # documented outcome subset reaches it.
-        import pathlib
-
-        sched_src = (
-            pathlib.Path(__file__).parent.parent
-            / "yoda_tpu/framework/scheduler.py"
-        ).read_text()
-        assert (
-            'in ("unschedulable", "error", "nominated")' in sched_src
-        ), "scheduler's dynamic-kind guard changed; re-pin the taxonomy"
-
-    def test_every_class_is_used_and_documented(self):
-        import pathlib
-
-        from yoda_tpu.tracing import VERDICT_CLASSES
-
-        literals = {lit for _, lit in self._record_sites() if lit}
-        literals |= {"unschedulable", "error", "nominated"}  # dynamic site
-        assert literals == set(VERDICT_CLASSES), (
-            f"taxonomy drift: documented {sorted(VERDICT_CLASSES)} vs "
-            f"recorded {sorted(literals)}"
-        )
-        ops = (
-            pathlib.Path(__file__).parent.parent / "docs/OPERATIONS.md"
-        ).read_text()
-        for cls in VERDICT_CLASSES:
-            assert f"`{cls}`" in ops, (
-                f"verdict class {cls} not documented in OPERATIONS.md"
-            )
+    """Runtime pin of the verdict taxonomy (ISSUE 12 satellite). The
+    STATIC half — every ``pending.record(kind=...)`` site uses a
+    documented class, every class is used somewhere, every class is in
+    OPERATIONS.md — migrated to yodalint's verdict-taxonomy pass
+    (tools/yodalint/passes/verdict_taxonomy.py, ISSUE 13): it gates
+    ``make lint`` and is fixture-tested in tests/test_yodalint.py. What
+    stays here is the half static analysis cannot do: driving the real
+    park sites end-to-end."""
 
     def test_runtime_records_stay_in_taxonomy(self):
         """Drive the common park sites end-to-end and assert every

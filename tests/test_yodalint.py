@@ -1,0 +1,515 @@
+"""yodalint checker-of-the-checker (ISSUE 13): every pass must catch its
+planted fixture violation, and the live tree must be clean.
+
+Two failure modes are pinned, the same discipline as the verdict
+taxonomy: a regression in the CODE (a new lock-held sleep, a fence-free
+write, an undocumented knob) fails the live-tree test; a regression in a
+CHECKER (a refactor that blinds a pass) fails its fixture test — the
+pass that no longer sees its planted violation is broken, not the tree.
+
+Fixtures are tiny synthetic projects written to tmp_path with the same
+shape yodalint expects (yoda_tpu/ package, docs/OPERATIONS.md, deploy
+ConfigMap); each pass is invoked directly so fixtures stay minimal and
+one pass's noise never hides another's miss.
+"""
+
+import time
+from pathlib import Path
+
+from tools.yodalint import PASS_NAMES, Project, apply_suppressions, run_all
+from tools.yodalint.passes import (
+    config_drift,
+    fence_before_write,
+    hook_order,
+    lock_discipline,
+    metrics_drift,
+    snapshot_immutability,
+    verdict_taxonomy,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_project(tmp_path, files: "dict[str, str]") -> Project:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return Project(tmp_path)
+
+
+class TestLiveTree:
+    """The acceptance gate: zero findings, under the 5 s budget."""
+
+    def test_zero_findings_on_the_live_tree(self):
+        findings = run_all(Project(REPO))
+        assert findings == [], "\n".join(
+            f.render() for f in findings
+        )
+
+    def test_suite_fits_the_lint_budget(self):
+        t0 = time.monotonic()
+        run_all(Project(REPO))
+        wall = time.monotonic() - t0
+        assert wall < 5.0, f"yodalint took {wall:.2f}s (budget 5s)"
+
+
+class TestLockDiscipline:
+    def test_catches_direct_sleep_under_lock(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading, time\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def pop(self):\n"
+                "        with self._lock:\n"
+                "            time.sleep(1)\n"
+            ),
+        })
+        findings = lock_discipline.run(project)
+        assert any(
+            "time.sleep" in f.message and f.line == 7 for f in findings
+        ), findings
+
+    def test_catches_transitively_reached_blocking_call(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading, time\n"
+                "class GangPlugin:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.RLock()\n"
+                "    def _helper(self, cluster):\n"
+                "        cluster.list_pods()\n"
+                "    def status(self, cluster):\n"
+                "        with self._lock:\n"
+                "            self._helper(cluster)\n"
+            ),
+        })
+        findings = lock_discipline.run(project)
+        assert any(
+            ".list_pods" in f.message and "_helper" in f.message
+            for f in findings
+        ), findings
+
+    def test_catches_lock_order_violation(self, tmp_path):
+        # gang (level 3) acquiring queue (level 1): backwards.
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def depths(self):\n"
+                "        with self._lock:\n"
+                "            return 0\n"
+                "class GangPlugin:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.RLock()\n"
+                "    def status(self, queue):\n"
+                "        with self._lock:\n"
+                "            return queue.depths()\n"
+            ),
+        })
+        findings = lock_discipline.run(project)
+        assert any(
+            "lock-order violation" in f.message for f in findings
+        ), findings
+
+    def test_informer_to_queue_is_the_legal_direction(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def add(self, pod):\n"
+                "        with self._lock:\n"
+                "            return pod\n"
+                "class InformerCache:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.RLock()\n"
+                "    def handle(self, queue):\n"
+                "        with self._lock:\n"
+                "            queue.add(object())\n"
+            ),
+        })
+        assert lock_discipline.run(project) == []
+
+    def test_own_condition_wait_is_exempt(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._cond = threading.Condition(self._lock)\n"
+                "    def pop(self):\n"
+                "        with self._lock:\n"
+                "            self._cond.wait(timeout=1)\n"
+            ),
+        })
+        assert lock_discipline.run(project) == []
+
+    def test_cycle_lock_is_exempt_by_design(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import time\n"
+                "class Scheduler:\n"
+                "    def cycle(self):\n"
+                "        with self.cycle_lock:\n"
+                "            time.sleep(0.1)\n"
+            ),
+        })
+        assert lock_discipline.run(project) == []
+
+
+class TestFenceBeforeWrite:
+    def test_catches_fence_free_mutating_write(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Mover:\n"
+                "    def go(self, cluster, key, node):\n"
+                "        cluster.bind_pod(key, node)\n"
+            ),
+        })
+        findings = fence_before_write.run(project)
+        assert any(
+            ".bind_pod" in f.message and f.line == 3 for f in findings
+        ), findings
+
+    def test_function_local_fence_clears_it(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Mover:\n"
+                "    def go(self, cluster, key, node):\n"
+                "        if self._fenced():\n"
+                "            return\n"
+                "        cluster.bind_pod(key, node)\n"
+            ),
+        })
+        assert fence_before_write.run(project) == []
+
+    def test_caller_level_fence_clears_a_helper(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Mover:\n"
+                "    def _do(self, cluster, key):\n"
+                "        cluster.delete_pod(key)\n"
+                "    def go(self, cluster, key):\n"
+                "        if self._fenced():\n"
+                "            return\n"
+                "        self._do(cluster, key)\n"
+            ),
+        })
+        assert fence_before_write.run(project) == []
+
+    def test_fence_after_the_write_does_not_count(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Mover:\n"
+                "    def go(self, cluster, key, node):\n"
+                "        cluster.bind_pod(key, node)\n"
+                "        return self._fenced()\n"
+            ),
+        })
+        findings = fence_before_write.run(project)
+        assert any(".bind_pod" in f.message for f in findings), findings
+
+
+class TestSnapshotImmutability:
+    def test_catches_mutation_of_a_snapshot_parameter(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "def poison(snapshot):\n"
+                "    snapshot.version = 99\n"
+            ),
+        })
+        findings = snapshot_immutability.run(project)
+        assert any(
+            "snapshot.version" in f.message and f.line == 2
+            for f in findings
+        ), findings
+
+    def test_construction_site_is_whitelisted(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "from yoda_tpu.framework.interfaces import Snapshot\n"
+                "def build(nodes, fence):\n"
+                "    snap = Snapshot(nodes)\n"
+                "    snap.fenced = fence\n"
+                "    return snap\n"
+            ),
+        })
+        assert snapshot_immutability.run(project) == []
+
+    def test_update_rows_is_whitelisted(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Kernel:\n"
+                "    def update_rows(self, arrays, rows):\n"
+                "        arrays.reserved_chips = rows\n"
+            ),
+        })
+        assert snapshot_immutability.run(project) == []
+
+
+class TestConfigDrift:
+    FILES = {
+        "yoda_tpu/config.py": (
+            "from dataclasses import dataclass\n"
+            "@dataclass(frozen=True)\n"
+            "class Weights:\n"
+            "    clock: int = 1\n"
+            "@dataclass(frozen=True)\n"
+            "class SchedulerConfig:\n"
+            "    mode: str = 'batch'\n"
+            "    ghost_knob: int = 0\n"
+            "    @classmethod\n"
+            "    def from_dict(cls, d):\n"
+            "        cfg = cls(**d)\n"
+            "        if cfg.mode not in ('batch',):\n"
+            "            raise ValueError('mode')\n"
+            "        return cfg\n"
+        ),
+        "deploy/yoda-tpu-scheduler.yaml": (
+            "apiVersion: v1\n"
+            "kind: ConfigMap\n"
+            "data:\n"
+            "  config.yaml: |\n"
+            "    mode: batch\n"
+            "    phantom_key: 1\n"
+            "---\n"
+        ),
+        "docs/OPERATIONS.md": (
+            "## Tuning (`SchedulerConfig`, the ConfigMap)\n"
+            "- `mode` — batch or loop.\n"
+            "- `vanished_knob` — documented but long deleted.\n"
+        ),
+    }
+
+    def test_catches_all_four_drift_classes(self, tmp_path):
+        project = make_project(tmp_path, dict(self.FILES))
+        messages = [f.message for f in config_drift.run(project)]
+        # ghost_knob: unvalidated + unshipped + undocumented.
+        assert any(
+            "ghost_knob" in m and "never validated" in m for m in messages
+        ), messages
+        assert any(
+            "ghost_knob" in m and "not shipped" in m for m in messages
+        ), messages
+        assert any(
+            "ghost_knob" in m and "not documented" in m for m in messages
+        ), messages
+        # phantom_key: in the ConfigMap but not in code.
+        assert any(
+            "phantom_key" in m and "ghost config" in m for m in messages
+        ), messages
+        # vanished_knob: documented but not a field.
+        assert any(
+            "vanished_knob" in m and "ghost documentation" in m
+            for m in messages
+        ), messages
+
+    def test_clean_when_everything_lines_up(self, tmp_path):
+        files = dict(self.FILES)
+        files["yoda_tpu/config.py"] = files["yoda_tpu/config.py"].replace(
+            "    ghost_knob: int = 0\n", ""
+        )
+        files["deploy/yoda-tpu-scheduler.yaml"] = files[
+            "deploy/yoda-tpu-scheduler.yaml"
+        ].replace("    phantom_key: 1\n", "")
+        files["docs/OPERATIONS.md"] = files["docs/OPERATIONS.md"].replace(
+            "- `vanished_knob` — documented but long deleted.\n", ""
+        )
+        project = make_project(tmp_path, files)
+        assert config_drift.run(project) == []
+
+
+class TestHookOrder:
+    GOOD = (
+        "def build_stack(accountant, gang, informer, recorder, cluster):\n"
+        "    sinks = []\n"
+        "    sinks.append(accountant.handle)\n"
+        "    sinks.append(gang.handle)\n"
+        "    for s in sinks:\n"
+        "        cluster.add_watcher(s)\n"
+        "    cluster.add_watcher(informer.handle)\n"
+        "    cluster.add_watcher(recorder.handle)\n"
+    )
+
+    def test_catches_swapped_handlers(self, tmp_path):
+        bad = self.GOOD.replace(
+            "    sinks.append(accountant.handle)\n"
+            "    sinks.append(gang.handle)\n",
+            "    sinks.append(gang.handle)\n"
+            "    sinks.append(accountant.handle)\n",
+        )
+        project = make_project(tmp_path, {"yoda_tpu/standalone.py": bad})
+        findings = hook_order.run(project)
+        assert any(
+            "order violated" in f.message for f in findings
+        ), findings
+
+    def test_documented_order_is_clean(self, tmp_path):
+        project = make_project(
+            tmp_path, {"yoda_tpu/standalone.py": self.GOOD}
+        )
+        assert hook_order.run(project) == []
+
+    def test_missing_anchor_is_itself_a_finding(self, tmp_path):
+        project = make_project(
+            tmp_path, {"yoda_tpu/standalone.py": "x = 1\n"}
+        )
+        findings = hook_order.run(project)
+        assert any("no build_stack" in f.message for f in findings)
+
+
+class TestMetricsDrift:
+    def test_catches_unasserted_and_undocumented_series(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "def attach(r):\n"
+                "    r.counter('yoda_ghost_total', 'help')\n"
+            ),
+            "tests/test_observability.py": "# no mention\n",
+            "docs/OPERATIONS.md": "# no mention\n",
+        })
+        messages = [f.message for f in metrics_drift.run(project)]
+        assert any(
+            "yoda_ghost_total" in m and "not asserted" in m
+            for m in messages
+        ), messages
+        assert any(
+            "yoda_ghost_total" in m and "not documented" in m
+            for m in messages
+        ), messages
+
+    def test_clean_when_asserted_and_documented(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "def attach(r):\n"
+                "    r.counter('yoda_ghost_total', 'help')\n"
+            ),
+            "tests/test_observability.py": "yoda_ghost_total\n",
+            "docs/OPERATIONS.md": "yoda_ghost_total\n",
+        })
+        assert metrics_drift.run(project) == []
+
+
+class TestVerdictTaxonomyPass:
+    FILES = {
+        "yoda_tpu/tracing.py": (
+            "VERDICT_CLASSES = frozenset({'admission-park', 'unused-class',"
+            " 'unschedulable', 'error', 'nominated'})\n"
+        ),
+        "yoda_tpu/mod.py": (
+            "def park(pending, key):\n"
+            "    pending.record(key, kind='rogue-kind', message='m')\n"
+        ),
+        "docs/OPERATIONS.md": "`admission-park` `unused-class` "
+        "`unschedulable` `error` `nominated`\n",
+    }
+
+    def test_catches_rogue_unused_and_dynamic_kinds(self, tmp_path):
+        files = dict(self.FILES)
+        files["yoda_tpu/dyn.py"] = (
+            "def done(pending, key, outcome):\n"
+            "    pending.record(key, kind=outcome)\n"
+        )
+        project = make_project(tmp_path, files)
+        messages = [f.message for f in verdict_taxonomy.run(project)]
+        assert any("'rogue-kind'" in m for m in messages), messages
+        assert any(
+            "'unused-class'" in m and "recorded nowhere" in m
+            for m in messages
+        ), messages
+        assert any("non-literal kind" in m for m in messages), messages
+
+    def test_clean_taxonomy(self, tmp_path):
+        files = dict(self.FILES)
+        files["yoda_tpu/tracing.py"] = (
+            "VERDICT_CLASSES = frozenset({'admission-park',"
+            " 'unschedulable', 'error', 'nominated'})\n"
+        )
+        files["yoda_tpu/mod.py"] = (
+            "def park(pending, key):\n"
+            "    pending.record(key, kind='admission-park', message='m')\n"
+        )
+        project = make_project(tmp_path, files)
+        assert verdict_taxonomy.run(project) == []
+
+
+class TestSuppressions:
+    def test_suppression_with_reason_silences_the_pass(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading, time\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def pop(self):\n"
+                "        with self._lock:\n"
+                "            # yodalint: ok lock-discipline fixture-pinned exception\n"
+                "            time.sleep(1)\n"
+            ),
+        })
+        findings = apply_suppressions(
+            project, lock_discipline.run(project), PASS_NAMES
+        )
+        assert findings == [], findings
+
+    def test_suppression_without_reason_is_a_finding(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading, time\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def pop(self):\n"
+                "        with self._lock:\n"
+                "            # yodalint: ok lock-discipline\n"
+                "            time.sleep(1)\n"
+            ),
+        })
+        findings = apply_suppressions(
+            project, lock_discipline.run(project), PASS_NAMES
+        )
+        assert any(
+            f.pass_name == "suppression" and "no reason" in f.message
+            for f in findings
+        ), findings
+
+    def test_suppression_naming_unknown_pass_is_a_finding(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "x = 1  # yodalint: ok not-a-pass because reasons\n"
+            ),
+        })
+        findings = apply_suppressions(project, [], PASS_NAMES)
+        assert any(
+            f.pass_name == "suppression" and "no known pass" in f.message
+            for f in findings
+        ), findings
+
+    def test_suppression_for_a_different_pass_does_not_silence(
+        self, tmp_path
+    ):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading, time\n"
+                "class SchedulingQueue:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def pop(self):\n"
+                "        with self._lock:\n"
+                "            # yodalint: ok metrics-drift wrong pass named\n"
+                "            time.sleep(1)\n"
+            ),
+        })
+        findings = apply_suppressions(
+            project, lock_discipline.run(project), PASS_NAMES
+        )
+        assert any(
+            f.pass_name == "lock-discipline" for f in findings
+        ), findings

@@ -1,0 +1,1 @@
+# Repo tooling package (tools.yodalint et al.) — not shipped in the wheel.

@@ -1,79 +1,36 @@
 #!/usr/bin/env python
-"""Metric-drift check (ISSUE 9 satellite): every ``yoda_*`` series
-registered anywhere in yoda_tpu/ must be (a) asserted in
-tests/test_observability.py and (b) documented in docs/OPERATIONS.md.
+"""Metric-drift check — MIGRATED to the yodalint framework (ISSUE 13).
 
-New metrics silently skipping the test suite or the operator docs is how
-observability rots: the series exists, nobody knows what it means, and a
-rename breaks dashboards without failing CI. This script closes the loop
-and runs under ``make lint``.
-
-Registration sites are found syntactically — the first string argument of
-``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` calls (the Registry
-surface in yoda_tpu/observability.py) — so a metric cannot hide behind an
-accumulator pattern or a lazily-attached family.
-
-Exit 0 when clean; exit 1 listing every undrifted name otherwise.
+This shim keeps the historical entry point (`python tools/check_metrics.py`)
+alive for muscle memory and old CI recipes; the actual analysis is
+yodalint's metrics-drift pass (tools/yodalint/passes/metrics_drift.py),
+which `make lint` runs via `python -m tools.yodalint` alongside the six
+other project-invariant passes.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
-PACKAGE = REPO / "yoda_tpu"
-TEST_FILE = REPO / "tests" / "test_observability.py"
-DOCS_FILE = REPO / "docs" / "OPERATIONS.md"
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# `r.counter(\n    "yoda_x", ...` — \s* spans the line break; the metric
-# name is always the first positional (string literal) argument.
-REGISTRATION = re.compile(
-    r'\b(?:counter|gauge|histogram)\(\s*["\'](yoda_[a-z0-9_]+)["\']'
-)
-
-
-def registered_names() -> "dict[str, list[str]]":
-    """metric name -> files registering it."""
-    names: dict[str, list[str]] = {}
-    for path in sorted(PACKAGE.rglob("*.py")):
-        text = path.read_text()
-        for m in REGISTRATION.finditer(text):
-            names.setdefault(m.group(1), []).append(
-                str(path.relative_to(REPO))
-            )
-    return names
+from tools.yodalint import Project, apply_suppressions, report  # noqa: E402
+from tools.yodalint.passes import PASS_NAMES, metrics_drift  # noqa: E402
 
 
 def main() -> int:
-    names = registered_names()
-    if not names:
-        print("check_metrics: found no registered yoda_* series — the "
-              "registration regex no longer matches the code", file=sys.stderr)
-        return 1
-    test_text = TEST_FILE.read_text()
-    docs_text = DOCS_FILE.read_text()
-    missing_test = sorted(n for n in names if n not in test_text)
-    missing_docs = sorted(n for n in names if n not in docs_text)
-    if not missing_test and not missing_docs:
+    project = Project(Path(__file__).resolve().parent.parent)
+    findings = apply_suppressions(
+        project, metrics_drift.run(project), PASS_NAMES
+    )
+    rc = report(findings)
+    if rc == 0:
         print(
-            f"check_metrics: {len(names)} yoda_* series registered, all "
-            "asserted in tests/test_observability.py and documented in "
-            "docs/OPERATIONS.md"
+            "check_metrics: clean (ran as yodalint's metrics-drift pass; "
+            "`python -m tools.yodalint` runs the full suite)"
         )
-        return 0
-    for n in missing_test:
-        print(
-            f"check_metrics: {n} (registered in {names[n][0]}) is not "
-            f"asserted in {TEST_FILE.relative_to(REPO)}", file=sys.stderr,
-        )
-    for n in missing_docs:
-        print(
-            f"check_metrics: {n} (registered in {names[n][0]}) is not "
-            f"documented in {DOCS_FILE.relative_to(REPO)}", file=sys.stderr,
-        )
-    return 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,64 @@
+"""yodalint — project-invariant static analysis for yoda-tpu (ISSUE 13).
+
+Seven passes over one shared parse + call graph, gating ``make lint``:
+
+1. lock-discipline        — no blocking work under a component lock;
+                            lock acquisitions respect the declared DAG
+2. fence-before-write     — every mutating cluster write is dominated by
+                            a leader-fence check
+3. snapshot-immutability  — no attribute assignment on Snapshot /
+                            FleetArrays outside construction sites
+4. config-drift           — knobs are validated + shipped (ConfigMap) +
+                            documented (OPERATIONS.md), no ghosts
+5. hook-registration-order — build_stack wires accountant -> gang ->
+                            informer -> recorder
+6. metrics-drift          — yoda_* series asserted in tests + documented
+7. verdict-taxonomy       — why-pending kinds stay in the pinned set
+
+Suppress a deliberate exception with ``# yodalint: ok <pass> <reason>``
+on (or directly above) the flagged line; the reason is mandatory.
+
+Run: ``python -m tools.yodalint [--root DIR] [--pass NAME ...]``.
+tests/test_yodalint.py proves each pass catches a planted violation and
+that the live tree is clean.
+"""
+
+from __future__ import annotations
+
+from tools.yodalint.callgraph import CallGraph
+from tools.yodalint.core import (
+    Finding,
+    Project,
+    apply_suppressions,
+    report,
+)
+from tools.yodalint.passes import ALL_PASSES, PASS_NAMES
+
+# The framework's own findings (malformed suppressions) use this name.
+KNOWN_PASS_NAMES = PASS_NAMES | {"suppression"}
+
+
+def run_all(
+    project: Project, only: "set[str] | None" = None
+) -> "list[Finding]":
+    """Run every (or the selected) pass; returns suppression-filtered
+    findings. The call graph is built once and shared."""
+    graph = CallGraph(project)
+    findings: "list[Finding]" = []
+    for p in ALL_PASSES:
+        if only and p.NAME not in only:
+            continue
+        findings.extend(p.run(project, graph))
+    return apply_suppressions(project, findings, PASS_NAMES)
+
+
+__all__ = [
+    "ALL_PASSES",
+    "CallGraph",
+    "Finding",
+    "PASS_NAMES",
+    "Project",
+    "apply_suppressions",
+    "report",
+    "run_all",
+]

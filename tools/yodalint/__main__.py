@@ -1,0 +1,46 @@
+"""CLI: ``python -m tools.yodalint`` — run the suite, print findings,
+exit 1 on any. Gated into ``make lint`` (< 5 s budget, zero findings on
+a clean tree)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from tools.yodalint import PASS_NAMES, Project, report, run_all
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(prog="yodalint")
+    ap.add_argument(
+        "--root",
+        default=str(Path(__file__).resolve().parent.parent.parent),
+        help="repo root (default: this checkout)",
+    )
+    ap.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=sorted(PASS_NAMES),
+        help="run only the named pass (repeatable)",
+    )
+    args = ap.parse_args(argv)
+    t0 = time.monotonic()
+    project = Project(args.root)
+    findings = run_all(project, set(args.passes) if args.passes else None)
+    rc = report(findings)
+    n = len(findings)
+    wall = time.monotonic() - t0
+    print(
+        f"yodalint: {len(project.modules)} modules, "
+        f"{len(args.passes) if args.passes else 7} passes, "
+        f"{n} finding{'s' if n != 1 else ''} ({wall:.2f}s)",
+        file=sys.stderr if n else sys.stdout,
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

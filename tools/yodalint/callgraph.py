@@ -1,0 +1,236 @@
+"""Per-function call graph with method resolution over the package.
+
+Built once per run and shared by the passes that reason about
+reachability (lock-discipline's held-lock closure, fence-before-write's
+helper chasing). Resolution is deliberately *under*-approximate — an
+edge exists only when the callee is statically certain:
+
+- ``self.m()``          -> method ``m`` on the enclosing class or a base
+                           class defined in the package (bases resolved
+                           by name; single-inheritance chains followed).
+- ``name()``            -> a module-level function ``name`` in the same
+                           module, or one imported from a package module
+                           (``from yoda_tpu.x import name``).
+- ``self.attr.m()``     -> method ``m`` of the class ``attr`` was
+                           constructed as in ``__init__``
+                           (``self.attr = ClassName(...)``).
+- ``param.m()``         -> method ``m`` of the class a parameter name
+                           conventionally carries (``PARAM_TYPES``: this
+                           codebase wires components by name — a
+                           parameter called ``informer`` is always the
+                           InformerCache, etc.).
+
+Everything else (callbacks like ``self.on_pod_pending``, duck-typed
+cluster backends, lambdas) stays unresolved: the passes treat missing
+edges as "nothing reachable", never as "anything possible", so added
+precision here only ever *adds* findings. The planted-violation fixtures
+in tests/test_yodalint.py pin the resolution rules this module promises.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.yodalint.core import Module, Project
+
+#: Conventional parameter-name -> class typing (the wiring convention in
+#: standalone.build_stack and every component constructor).
+PARAM_TYPES = {
+    "informer": "InformerCache",
+    "queue": "SchedulingQueue",
+    "accountant": "ChipAccountant",
+    "gang": "GangPlugin",
+    "metrics": "SchedulingMetrics",
+    "scheduler": "Scheduler",
+    "framework": "Framework",
+    "tracer": "Tracer",
+    "ledger": "TenantLedger",
+}
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: Module
+    node: ast.ClassDef
+    base_names: "list[str]" = field(default_factory=list)
+    #: method name -> FunctionInfo
+    methods: "dict[str, FunctionInfo]" = field(default_factory=dict)
+    #: ``self.<attr> = ClassName(...)`` assignments seen in any method
+    attr_types: "dict[str, str]" = field(default_factory=dict)
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "relpath::Class.method" or "relpath::func"
+    module: Module
+    node: "ast.FunctionDef | ast.AsyncFunctionDef"
+    cls: "ClassInfo | None" = None
+
+
+class CallGraph:
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.functions: "dict[str, FunctionInfo]" = {}
+        self.classes_by_name: "dict[str, list[ClassInfo]]" = {}
+        #: per-module: imported name -> (source module relpath suffix)
+        self._imports: "dict[str, dict[str, str]]" = {}
+        self._module_funcs: "dict[str, dict[str, FunctionInfo]]" = {}
+        for mod in project.modules:
+            self._index_module(mod)
+        self._infer_attr_types()
+
+    # ------------------------------------------------------------- index
+
+    def _index_module(self, mod: Module) -> None:
+        funcs: "dict[str, FunctionInfo]" = {}
+        imports: "dict[str, str]" = {}
+        self._module_funcs[mod.relpath] = funcs
+        self._imports[mod.relpath] = imports
+        for node in mod.tree.body:
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.split(".")[0] == self.project.package:
+                    target = node.module.replace(".", "/") + ".py"
+                    for alias in node.names:
+                        imports[alias.asname or alias.name] = target
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FunctionInfo(f"{mod.relpath}::{node.name}", mod, node)
+                funcs[node.name] = fi
+                self.functions[fi.qualname] = fi
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    node.name,
+                    mod,
+                    node,
+                    base_names=[
+                        b.id
+                        for b in node.bases
+                        if isinstance(b, ast.Name)
+                    ],
+                )
+                self.classes_by_name.setdefault(node.name, []).append(ci)
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        fi = FunctionInfo(
+                            f"{mod.relpath}::{node.name}.{item.name}",
+                            mod,
+                            item,
+                            cls=ci,
+                        )
+                        ci.methods[item.name] = fi
+                        self.functions[fi.qualname] = fi
+
+    def _infer_attr_types(self) -> None:
+        """``self.attr = ClassName(...)`` (any method, any known class)
+        -> attr_types so ``self.attr.m()`` resolves."""
+        for classes in self.classes_by_name.values():
+            for ci in classes:
+                for fi in ci.methods.values():
+                    for node in ast.walk(fi.node):
+                        if not (
+                            isinstance(node, ast.Assign)
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Attribute)
+                            and isinstance(
+                                node.targets[0].value, ast.Name
+                            )
+                            and node.targets[0].value.id == "self"
+                            and isinstance(node.value, ast.Call)
+                            and isinstance(node.value.func, ast.Name)
+                            and node.value.func.id in self.classes_by_name
+                        ):
+                            continue
+                        ci.attr_types[node.targets[0].attr] = (
+                            node.value.func.id
+                        )
+
+    # ----------------------------------------------------------- resolve
+
+    def _class_method(
+        self, ci: ClassInfo, name: str, _seen: "frozenset[str]" = frozenset()
+    ) -> "FunctionInfo | None":
+        if name in ci.methods:
+            return ci.methods[name]
+        for base in ci.base_names:
+            if base in _seen:
+                continue
+            for bci in self.classes_by_name.get(base, []):
+                hit = self._class_method(
+                    bci, name, _seen | {ci.name}
+                )
+                if hit is not None:
+                    return hit
+        return None
+
+    def _methods_named(self, name: str, class_name: str) -> "FunctionInfo | None":
+        for ci in self.classes_by_name.get(class_name, []):
+            hit = self._class_method(ci, name)
+            if hit is not None:
+                return hit
+        return None
+
+    def resolve_call(
+        self, call: ast.Call, caller: FunctionInfo
+    ) -> "list[FunctionInfo]":
+        func = call.func
+        # name(...)
+        if isinstance(func, ast.Name):
+            local = self._module_funcs[caller.module.relpath].get(func.id)
+            if local is not None:
+                return [local]
+            src = self._imports[caller.module.relpath].get(func.id)
+            if src is not None:
+                target_mod = self.project.module(src)
+                if target_mod is not None:
+                    hit = self._module_funcs[target_mod.relpath].get(func.id)
+                    if hit is not None:
+                        return [hit]
+            return []
+        if not isinstance(func, ast.Attribute):
+            return []
+        recv = func.value
+        # self.m(...)
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if caller.cls is not None:
+                hit = self._class_method(caller.cls, func.attr)
+                if hit is not None:
+                    return [hit]
+            return []
+        # param.m(...) via the naming convention
+        if isinstance(recv, ast.Name) and recv.id in PARAM_TYPES:
+            hit = self._methods_named(func.attr, PARAM_TYPES[recv.id])
+            return [hit] if hit is not None else []
+        # self.attr.m(...) via __init__-inferred attribute types
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and caller.cls is not None
+        ):
+            tname = caller.cls.attr_types.get(recv.attr)
+            if tname is None and recv.attr in PARAM_TYPES:
+                tname = PARAM_TYPES[recv.attr]
+            if tname is not None:
+                hit = self._methods_named(func.attr, tname)
+                return [hit] if hit is not None else []
+        return []
+
+    def calls_in(self, fn: FunctionInfo) -> "list[ast.Call]":
+        """Every Call node in ``fn``'s body, nested defs excluded (a
+        nested function's body runs when *it* is called, not when the
+        enclosing function is)."""
+        out: "list[ast.Call]" = []
+        stack: list = list(ast.iter_child_nodes(fn.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out

@@ -1,0 +1,186 @@
+"""yodalint core: project loader, findings, suppression syntax, reporter.
+
+The shared infrastructure under the seven project-invariant passes
+(ISSUE 13). A pass is a function ``run(project) -> list[Finding]``
+registered in :mod:`tools.yodalint.passes`; this module owns everything
+the passes share:
+
+- **Project** — one parse of the tree. Every ``yoda_tpu/**/*.py`` module
+  is read and AST-parsed once (``Module``), and the handful of non-code
+  surfaces the drift passes cross-check (docs/OPERATIONS.md, the deploy
+  ConfigMap, tests/test_observability.py) are exposed as paths so passes
+  never invent their own file discovery.
+- **Suppression** — ``# yodalint: ok <pass> <reason>`` on the flagged
+  line (or the line directly above it) silences that pass for that line.
+  The reason is REQUIRED: a bare ``# yodalint: ok lock-discipline`` is
+  itself reported as a finding, as is a suppression naming an unknown
+  pass — an annotation that cannot say why it exists is drift waiting to
+  happen.
+- **Reporter** — ``file:line: [pass] message`` on stderr, sorted, stable.
+
+Passes must be fast (the whole suite gates ``make lint`` at < 5 s) and
+silent on a clean tree: zero findings is the contract tier-1 pins
+(tests/test_yodalint.py runs every pass against the live tree).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: ``# yodalint: ok <pass-name> <reason...>`` (reason validated separately
+#: so a missing one can be reported with a precise message).
+SUPPRESS_RE = re.compile(r"#\s*yodalint:\s*ok\b\s*(\S+)?[ \t]*(.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: where, which pass, and what went wrong."""
+
+    pass_name: str
+    file: str  # repo-relative path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+@dataclass
+class Suppression:
+    pass_name: str | None  # None = malformed (no pass name at all)
+    reason: str
+    line: int
+    used: bool = False
+
+
+class Module:
+    """One parsed source file: text, line list, and AST."""
+
+    def __init__(self, path: Path, relpath: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=relpath)
+        self.suppressions: list[Suppression] = []
+        for i, line in enumerate(self.lines, start=1):
+            # Only comment text counts — a string literal mentioning the
+            # marker (docs, this file) must not create suppressions.
+            hash_pos = line.find("#")
+            if hash_pos < 0:
+                continue
+            m = SUPPRESS_RE.search(line, hash_pos)
+            if m:
+                self.suppressions.append(
+                    Suppression(
+                        pass_name=m.group(1),
+                        reason=(m.group(2) or "").strip(),
+                        line=i,
+                    )
+                )
+
+    def suppressed(self, pass_name: str, line: int) -> bool:
+        """True when ``line`` (or the line above it) carries a well-formed
+        suppression for ``pass_name``. Marks the suppression used."""
+        for s in self.suppressions:
+            if (
+                s.pass_name == pass_name
+                and s.reason
+                and s.line in (line, line - 1)
+            ):
+                s.used = True
+                return True
+        return False
+
+
+class Project:
+    """The analysis root: the package's parsed modules plus the non-code
+    surfaces the drift passes check against. ``root`` is the repo root;
+    fixtures (tests/test_yodalint.py) point it at a temp tree with the
+    same shape."""
+
+    def __init__(self, root: "Path | str", package: str = "yoda_tpu") -> None:
+        self.root = Path(root)
+        self.package = package
+        self.modules: list[Module] = []
+        pkg_dir = self.root / package
+        for path in sorted(pkg_dir.rglob("*.py")):
+            rel = str(path.relative_to(self.root))
+            self.modules.append(Module(path, rel))
+        # Extra single files some passes read (present-or-not is the
+        # pass's problem to report, not the loader's).
+        self.operations_md = self.root / "docs" / "OPERATIONS.md"
+        self.configmap_yaml = self.root / "deploy" / "yoda-tpu-scheduler.yaml"
+        self.observability_test = (
+            self.root / "tests" / "test_observability.py"
+        )
+
+    def module(self, relpath_suffix: str) -> "Module | None":
+        """The unique module whose relpath ends with ``relpath_suffix``."""
+        for m in self.modules:
+            if m.relpath.endswith(relpath_suffix):
+                return m
+        return None
+
+    def read_text(self, path: Path) -> "str | None":
+        try:
+            return path.read_text()
+        except OSError:
+            return None
+
+
+@dataclass
+class PassResult:
+    name: str
+    findings: list[Finding] = field(default_factory=list)
+
+
+def apply_suppressions(
+    project: Project, findings: "list[Finding]", known_passes: "set[str]"
+) -> "list[Finding]":
+    """Drop suppressed findings, then append the framework's own findings:
+    suppressions without a reason, and suppressions naming unknown passes.
+    (An *unused* but well-formed suppression is tolerated — annotations
+    legitimately outlive the exact analysis that required them.)"""
+    by_file = {m.relpath: m for m in project.modules}
+    kept: list[Finding] = []
+    for f in findings:
+        mod = by_file.get(f.file)
+        if mod is not None and mod.suppressed(f.pass_name, f.line):
+            continue
+        kept.append(f)
+    for mod in project.modules:
+        for s in mod.suppressions:
+            if not s.pass_name or s.pass_name not in known_passes:
+                kept.append(
+                    Finding(
+                        "suppression",
+                        mod.relpath,
+                        s.line,
+                        "suppression names no known pass "
+                        f"({s.pass_name!r}); use '# yodalint: ok <pass> "
+                        f"<reason>' with one of {sorted(known_passes)}",
+                    )
+                )
+            elif not s.reason:
+                kept.append(
+                    Finding(
+                        "suppression",
+                        mod.relpath,
+                        s.line,
+                        f"suppression for {s.pass_name!r} has no reason — "
+                        "'# yodalint: ok <pass> <reason>' requires one",
+                    )
+                )
+    return kept
+
+
+def report(findings: "list[Finding]", out=sys.stderr) -> int:
+    """Print findings sorted by location; return the process exit code."""
+    for f in sorted(findings, key=lambda f: (f.file, f.line, f.pass_name)):
+        print(f.render(), file=out)
+    return 1 if findings else 0

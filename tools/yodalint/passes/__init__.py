@@ -1,0 +1,26 @@
+"""yodalint pass registry. Each pass exports NAME and run(project)."""
+
+from __future__ import annotations
+
+from tools.yodalint.passes import (
+    config_drift,
+    fence_before_write,
+    hook_order,
+    lock_discipline,
+    metrics_drift,
+    snapshot_immutability,
+    verdict_taxonomy,
+)
+
+#: Registration order is report order; names are the suppression keys.
+ALL_PASSES = (
+    lock_discipline,
+    fence_before_write,
+    snapshot_immutability,
+    config_drift,
+    hook_order,
+    metrics_drift,
+    verdict_taxonomy,
+)
+
+PASS_NAMES = {p.NAME for p in ALL_PASSES}

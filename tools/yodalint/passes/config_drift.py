@@ -1,0 +1,227 @@
+"""Pass 4 — config-drift: every SchedulerConfig knob is validated,
+shipped in the deploy ConfigMap, and documented in OPERATIONS.md — and
+vice versa (no ghost keys, no ghost docs).
+
+A knob that exists in code but not in the ConfigMap is invisible to
+operators; one documented but gone from code is a lie that breaks the
+next deploy. The four checks:
+
+1. **validated** — the knob's name appears in ``SchedulerConfig.
+   from_dict``'s validation body (the file's convention: every knob is
+   range/type-checked there with its name in the error message).
+   ``weights`` / ``slo_targets`` members are validated as families by
+   their own ``from_dict`` and are exempt per-name.
+2. **shipped** — the knob appears as a key (commented examples count:
+   a ``# knob: value`` line ships the recipe) in the scheduler
+   ConfigMap's ``config.yaml`` block.
+3. **documented** — the knob appears backticked in docs/OPERATIONS.md.
+4. **no ghosts** — every ConfigMap key and every knob-shaped
+   backticked token heading a Tuning-section bullet resolves to a real
+   SchedulerConfig / Weights / SloTargets field.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.yodalint.core import Finding, Project
+
+NAME = "config-drift"
+
+#: ``knob:`` or ``# knob:`` or ``#   - knob:`` inside the config block.
+_KEY_RE = re.compile(r"^\s*#?\s*(?:-\s*)?([a-z_][a-z0-9_]*):")
+#: Backticked lowercase tokens heading a Tuning bullet.
+_DOC_HEAD_RE = re.compile(r"`([a-z_][a-z0-9_.]*)`")
+
+
+def _dataclass_fields(mod, class_name: str) -> "dict[str, int]":
+    """Annotated field name -> line for a dataclass in ``mod``."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {
+                item.target.id: item.lineno
+                for item in node.body
+                if isinstance(item, ast.AnnAssign)
+                and isinstance(item.target, ast.Name)
+                and not item.target.id.startswith("_")
+            }
+    return {}
+
+
+def _method_source(mod, class_name: str, method: str) -> str:
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for item in node.body:
+                if (
+                    isinstance(item, ast.FunctionDef)
+                    and item.name == method
+                ):
+                    return ast.get_source_segment(mod.text, item) or ""
+    return ""
+
+
+def _configmap_block(text: str) -> "tuple[list[tuple[int, str]], bool]":
+    """(line, key) pairs inside the ``config.yaml: |`` block."""
+    keys: "list[tuple[int, str]]" = []
+    inside = False
+    found = False
+    for i, line in enumerate(text.splitlines(), start=1):
+        if re.match(r"^\s*config\.yaml:\s*\|", line):
+            inside = True
+            found = True
+            continue
+        if inside and (line.startswith("---") or re.match(r"^\S", line)):
+            inside = False
+        if inside:
+            m = _KEY_RE.match(line)
+            if m:
+                keys.append((i, m.group(1)))
+    return keys, found
+
+
+def run(project: Project, graph=None) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    cfg_mod = project.module("config.py")
+    if cfg_mod is None:
+        return [Finding(NAME, "yoda_tpu/config.py", 1, "config.py missing")]
+    knobs = _dataclass_fields(cfg_mod, "SchedulerConfig")
+    weight_fields = set(_dataclass_fields(cfg_mod, "Weights"))
+    slo_mod = project.module("slo/engine.py")
+    slo_fields = (
+        set(_dataclass_fields(slo_mod, "SloTargets")) if slo_mod else set()
+    )
+    from_dict_src = _method_source(cfg_mod, "SchedulerConfig", "from_dict")
+
+    # 1. validated ---------------------------------------------------
+    family_validated = {"weights", "slo_targets", "profiles"}
+    for knob, line in knobs.items():
+        if knob in family_validated:
+            continue  # validated by their own from_dict / recursion
+        if not re.search(rf"\b{re.escape(knob)}\b", from_dict_src):
+            findings.append(
+                Finding(
+                    NAME,
+                    cfg_mod.relpath,
+                    line,
+                    f"knob {knob!r} is never validated in "
+                    "SchedulerConfig.from_dict — add a type/range check "
+                    "(every knob is checked there by convention)",
+                )
+            )
+
+    # 2./4a. shipped + ghost ConfigMap keys --------------------------
+    cm_text = project.read_text(project.configmap_yaml)
+    if cm_text is None:
+        findings.append(
+            Finding(
+                NAME,
+                "deploy/yoda-tpu-scheduler.yaml",
+                1,
+                "scheduler ConfigMap missing",
+            )
+        )
+    else:
+        cm_rel = str(
+            project.configmap_yaml.relative_to(project.root)
+        )
+        keys, block_found = _configmap_block(cm_text)
+        if not block_found:
+            findings.append(
+                Finding(
+                    NAME, cm_rel, 1, "no config.yaml block in ConfigMap"
+                )
+            )
+        key_names = {k for _, k in keys}
+        for knob, line in knobs.items():
+            if knob not in key_names:
+                findings.append(
+                    Finding(
+                        NAME,
+                        cfg_mod.relpath,
+                        line,
+                        f"knob {knob!r} is not shipped in the deploy "
+                        "ConfigMap (deploy/yoda-tpu-scheduler.yaml) — "
+                        "add it, commented with its default if it is "
+                        "not part of the default deployment",
+                    )
+                )
+        known = set(knobs) | weight_fields | slo_fields
+        for line, key in keys:
+            if key not in known:
+                findings.append(
+                    Finding(
+                        NAME,
+                        cm_rel,
+                        line,
+                        f"ConfigMap key {key!r} is not a SchedulerConfig"
+                        "/Weights/SloTargets field — ghost config",
+                    )
+                )
+
+    # 3./4b. documented + ghost docs ---------------------------------
+    ops_text = project.read_text(project.operations_md)
+    if ops_text is None:
+        findings.append(
+            Finding(NAME, "docs/OPERATIONS.md", 1, "OPERATIONS.md missing")
+        )
+        return findings
+    for knob, line in knobs.items():
+        # `knob` or `knob:` (the docs write mapping-valued knobs with the
+        # trailing colon, e.g. `profiles:`).
+        if not re.search(rf"`{re.escape(knob)}:?`", ops_text):
+            findings.append(
+                Finding(
+                    NAME,
+                    cfg_mod.relpath,
+                    line,
+                    f"knob {knob!r} is not documented in "
+                    "docs/OPERATIONS.md (Tuning section) — every knob "
+                    "gets an operator-facing bullet",
+                )
+            )
+    # Ghost docs: bullet-head tokens in the Tuning section.
+    lines = ops_text.splitlines()
+    try:
+        start = next(
+            i for i, l in enumerate(lines) if l.startswith("## Tuning")
+        )
+    except StopIteration:
+        return findings
+    end = next(
+        (
+            i
+            for i in range(start + 1, len(lines))
+            if lines[i].startswith("## ")
+        ),
+        len(lines),
+    )
+    known = set(knobs) | slo_fields
+    for i in range(start, end):
+        line = lines[i]
+        if not line.startswith("- "):
+            continue
+        head = line.split("—")[0]
+        if "--" in head:
+            continue  # agent CLI flags, not config knobs
+        for tok in _DOC_HEAD_RE.findall(head):
+            parts = tok.split(".")
+            ok = (
+                parts[0] in known
+                if len(parts) == 1
+                else (
+                    parts[0] == "weights" and parts[1] in weight_fields
+                )
+            )
+            if not ok:
+                findings.append(
+                    Finding(
+                        NAME,
+                        "docs/OPERATIONS.md",
+                        i + 1,
+                        f"Tuning bullet documents {tok!r} which is not "
+                        "a SchedulerConfig/Weights/SloTargets field — "
+                        "ghost documentation",
+                    )
+                )
+    return findings

@@ -1,0 +1,132 @@
+"""Pass 2 — fence-before-write: every mutating cluster-API call site is
+dominated by a leader-fence check.
+
+The PR 3/4 invariant: a scheduler process that is not (or is no longer)
+the leader must never reach the API with a write — a stale leader's bind
+racing the new leader's is exactly the split-brain KEP-624's async-bind
+lineage warns about. Reads may go stale harmlessly; writes must be
+fenced.
+
+Mutating surface: ``bind_pod`` / ``unbind_pod`` / ``create_pod`` /
+``delete_pod`` / ``evict_pod`` (and the preemption plugin's injected
+``self.evict``) called on a cluster object. For each such call site the
+enclosing function must show fence evidence *before* the call line — a
+read of ``_fenced`` / ``fenced_fn`` / ``fence_fn`` / ``gate_fn`` /
+``is_leader`` — or every statically-known caller must show evidence
+before its call into the function (one level of interprocedural
+domination: enough for this codebase's helper shape, and an
+under-approximation never hides a write path that has no fence
+anywhere).
+
+The cluster backends themselves (cluster/fake.py, cluster/kube.py) are
+out of scope — they *implement* the API; the discipline binds their
+callers. Test scaffolding (testing/, demo.py) drives clusters by design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.callgraph import CallGraph, FunctionInfo
+from tools.yodalint.core import Finding, Project
+
+NAME = "fence-before-write"
+
+MUTATING = {
+    "bind_pod",
+    "unbind_pod",
+    "create_pod",
+    "delete_pod",
+    "evict_pod",
+    "evict",
+    "evict_fn",  # the preemption plugin's injected evictor
+}
+
+FENCE_MARKERS = {"_fenced", "fenced_fn", "fence_fn", "gate_fn", "is_leader"}
+
+SKIP_SUFFIXES = ("cluster/fake.py", "cluster/kube.py", "demo.py")
+
+
+def _receiver_is_cluster(func: ast.Attribute) -> bool:
+    """True when the call receiver syntactically reads as a cluster
+    object (``cluster``, ``self.cluster``, ``member.cluster``, ...) or is
+    the preemption plugin's injected evictor (``self.evict``)."""
+    src_parts: "list[str]" = []
+    node: ast.expr = func.value
+    while isinstance(node, ast.Attribute):
+        src_parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        src_parts.append(node.id)
+    if func.attr in ("evict", "evict_fn"):
+        return src_parts == ["self"]
+    return any("cluster" in part for part in src_parts)
+
+
+def _fence_lines(fn: FunctionInfo) -> "list[int]":
+    """Lines in ``fn`` that read a fence marker."""
+    lines = []
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Attribute) and node.attr in FENCE_MARKERS:
+            lines.append(node.lineno)
+        elif isinstance(node, ast.Name) and node.id in FENCE_MARKERS:
+            lines.append(node.lineno)
+    return lines
+
+
+def _call_edges(
+    graph: CallGraph,
+) -> "dict[str, list[tuple[FunctionInfo, int]]]":
+    """callee qualname -> [(caller, call line)] over resolved edges."""
+    rev: "dict[str, list[tuple[FunctionInfo, int]]]" = {}
+    for fn in graph.functions.values():
+        for call in graph.calls_in(fn):
+            for callee in graph.resolve_call(call, fn):
+                rev.setdefault(callee.qualname, []).append(
+                    (fn, call.lineno)
+                )
+    return rev
+
+
+def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
+    graph = graph or CallGraph(project)
+    rev = _call_edges(graph)
+    findings: "list[Finding]" = []
+    for fn in graph.functions.values():
+        rel = fn.module.relpath
+        if rel.endswith(SKIP_SUFFIXES) or "/testing/" in rel:
+            continue
+        fence_before = _fence_lines(fn)
+        for call in graph.calls_in(fn):
+            func = call.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in MUTATING
+                and _receiver_is_cluster(func)
+            ):
+                continue
+            if any(line <= call.lineno for line in fence_before):
+                continue
+            callers = rev.get(fn.qualname, [])
+            if callers and all(
+                any(
+                    fl <= call_line
+                    for fl in _fence_lines(caller)
+                )
+                for caller, call_line in callers
+            ):
+                continue
+            findings.append(
+                Finding(
+                    NAME,
+                    rel,
+                    call.lineno,
+                    f"mutating cluster write .{func.attr}() with no "
+                    "leader-fence check dominating it (no _fenced/"
+                    "fenced_fn/fence_fn/gate_fn read before this line in "
+                    f"{fn.qualname.split('::')[-1]} or its known "
+                    "callers) — a fenced ex-leader could race the new "
+                    "leader's writes",
+                )
+            )
+    return findings

@@ -1,0 +1,106 @@
+"""Pass 5 — hook-registration-order: ``standalone.build_stack`` wires
+watch handlers in the documented accountant -> gang -> informer order.
+
+Reservation releases must land before the informer's view of the same
+event (the accountant/gang only ever run AHEAD of the informer — the
+safe direction: reservations become visible early, never late), and the
+event recorder prunes after the informer has applied. The order is
+enforced at three sites in ``build_stack``:
+
+1. the ``per_event_sinks`` list construction (accountant before gang
+   before the tenant ledger),
+2. the batched ``apply_batch`` closure (sinks loop -> informer
+   ``handle_batch`` -> recorder),
+3. the per-event ``add_watcher`` registrations (sinks -> informer ->
+   recorder).
+
+Because 2. and 3. both iterate ``per_event_sinks`` and then name the
+informer/recorder explicitly, the check reduces to: within
+``build_stack``, the *first textual references* to ``accountant.handle``,
+``gang.handle``, ``ledger.handle`` must appear in that order, and every
+reference to ``informer.handle``/``handle_batch`` must precede every
+``recorder.handle`` in its wiring block while following the sink
+construction. A refactor that swaps any pair flags here before a chaos
+test ever catches the resulting accounting skew.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.core import Finding, Project
+
+NAME = "hook-registration-order"
+
+#: (object, attr) handler references, in required first-appearance order.
+ORDER = [
+    ("accountant", "handle"),
+    ("gang", "handle"),
+    ("ledger", "handle"),
+    ("informer", "handle"),  # handle or handle_batch
+    ("recorder", "handle"),
+]
+
+
+def run(project: Project, graph=None) -> "list[Finding]":
+    mod = project.module("standalone.py")
+    if mod is None:
+        return []
+    build = None
+    for node in mod.tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "build_stack":
+            build = node
+            break
+    if build is None:
+        return [
+            Finding(
+                NAME,
+                mod.relpath,
+                1,
+                "standalone.py has no build_stack — the handler-order "
+                "contract has no anchor; re-point this pass",
+            )
+        ]
+    refs: "list[tuple[int, str]]" = []
+    for node in ast.walk(build):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.attr in ("handle", "handle_batch")
+            and node.value.id in {o for o, _ in ORDER}
+        ):
+            refs.append((node.lineno, node.value.id))
+    refs.sort()
+    first_seen: "dict[str, int]" = {}
+    for line, obj in refs:
+        first_seen.setdefault(obj, line)
+    findings: "list[Finding]" = []
+    required = [o for o, _ in ORDER]
+    present = [o for o in required if o in first_seen]
+    for a, b in zip(present, present[1:]):
+        if first_seen[a] > first_seen[b]:
+            findings.append(
+                Finding(
+                    NAME,
+                    mod.relpath,
+                    first_seen[b],
+                    f"handler wiring order violated in build_stack: "
+                    f"{b}.handle is wired (line {first_seen[b]}) before "
+                    f"{a}.handle (line {first_seen[a]}) — documented "
+                    "order is accountant -> gang -> ledger -> informer "
+                    "-> recorder (reservation releases must precede the "
+                    "informer's view of the same event)",
+                )
+            )
+    if "accountant" not in first_seen or "informer" not in first_seen:
+        findings.append(
+            Finding(
+                NAME,
+                mod.relpath,
+                build.lineno,
+                "build_stack no longer wires accountant.handle and "
+                "informer.handle where this pass can see them — the "
+                "handler-order contract has no anchor; re-point the pass",
+            )
+        )
+    return findings

@@ -1,0 +1,414 @@
+"""Pass 1 — lock-discipline: no blocking work under a component lock, and
+lock acquisitions respect the declared ordering DAG.
+
+The PR 9 serve-path stall was a Histogram quantile computed under a hot
+lock; the bug *class* is any blocking call — sleeps, cluster API I/O,
+subprocess, socket/HTTP, foreign condvar waits, queue gets — reachable
+while one of the scheduler's fine-grained state locks is held. Those
+locks sit on the watch path, the serve path, or the metrics scrape path,
+so one blocked holder stalls every thread behind it.
+
+Two checks:
+
+**Blocking-under-lock.** For every ``with <lock>:`` region whose lock is
+a component state lock (``self._lock`` and friends; see LOCK_ATTRS),
+every call inside the region — and everything statically reachable from
+those calls through the call graph — is screened against the blocking
+primitives. ``Condition.wait`` on the *held* lock's own condition is
+exempt (wait releases it); waits on anything else block a foreign
+holder.
+
+The two *cycle* locks (``cycle_lock`` / ``post_filter_lock``) are
+deliberately NOT screened: they exist to serialize whole scheduling
+cycles across profile loops — kernel dispatch and bind I/O under them is
+the design, not a bug (docs/ARCHITECTURE.md).
+
+**Lock-ordering DAG.** The component locks are ordered
+
+    informer -> queue -> accountant -> gang -> metrics
+
+(watch delivery flows informer->queue; queue admission verdicts flow
+->metrics; nothing may reach *backwards*). Holding a later lock while
+acquiring an earlier one — directly or through the call graph — is a
+potential deadlock and is flagged. Locks outside the five levels
+(rebalancer, federation, nodehealth, backends) are screened for blocking
+calls but carry no order.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.yodalint.callgraph import CallGraph, FunctionInfo
+from tools.yodalint.core import Finding, Project
+
+NAME = "lock-discipline"
+
+#: Attribute names that denote a state lock when acquired via ``with``.
+LOCK_ATTRS = {
+    "_lock",
+    "_cond",
+    "_apply_lock",
+    "_waiting_lock",
+    "_trace_lock",
+    "_activity",
+}
+
+#: Coarse cycle-serialization locks: exempt by design (see docstring).
+EXEMPT_LOCK_NAMES = {"cycle_lock", "post_filter_lock", "select_lock"}
+
+#: The declared ordering DAG (lower acquires before higher; acquiring a
+#: LOWER level while holding a higher one is the violation).
+LOCK_LEVELS = {
+    "informer": 0,
+    "queue": 1,
+    "accountant": 2,
+    "gang": 3,
+    "metrics": 4,
+}
+
+#: Which classes' locks carry which level. Module-level grouping for the
+#: metrics family (one scrape surface, many registry-side classes).
+CLASS_LEVELS = {
+    "InformerCache": "informer",
+    "SchedulingQueue": "queue",
+    "ChipAccountant": "accountant",
+    "GangPlugin": "gang",
+}
+MODULE_LEVELS = {
+    "yoda_tpu/observability.py": "metrics",
+    "yoda_tpu/tracing.py": "metrics",
+    "yoda_tpu/slo/engine.py": "metrics",
+}
+
+#: Cluster-API methods: network round-trips on a real backend.
+CLUSTER_IO = {
+    "bind_pod",
+    "unbind_pod",
+    "create_pod",
+    "delete_pod",
+    "evict_pod",
+    "list_pods",
+    "list_nodes",
+    "list_tpu_metrics",
+    "list_events",
+    "write_event",
+    "set_nominated_node",
+    "put_tpu_metrics",
+    "probe",
+}
+
+SUBPROCESS_FNS = {"run", "Popen", "check_output", "check_call", "call"}
+HTTP_FNS = {"urlopen", "getresponse", "create_connection"}
+
+
+@dataclass(frozen=True)
+class LockKey:
+    """Identity of an acquired lock: the owning class + attribute."""
+
+    owner: str  # class name (or module relpath for module-level locks)
+    attr: str
+    level: "str | None"  # one of LOCK_LEVELS or None
+
+
+@dataclass
+class FnSummary:
+    blocking: "list[tuple[int, str]]" = field(default_factory=list)
+    acquires: "list[tuple[LockKey, int]]" = field(default_factory=list)
+    callees: "list[tuple[FunctionInfo, int]]" = field(default_factory=list)
+
+
+def _expr_src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+def _lock_key_for(ctx: ast.expr, fn: FunctionInfo, cond_assoc) -> "LockKey | None":
+    """LockKey for a with-context expression, or None when it is not a
+    recognized state lock (or is an exempt cycle lock)."""
+    if isinstance(ctx, ast.Attribute) and isinstance(ctx.value, ast.Name):
+        if ctx.attr in EXEMPT_LOCK_NAMES:
+            return None
+        if ctx.value.id == "self" and ctx.attr in LOCK_ATTRS:
+            owner = fn.cls.name if fn.cls else fn.module.relpath
+            level = CLASS_LEVELS.get(owner) or MODULE_LEVELS.get(
+                fn.module.relpath
+            )
+            return LockKey(owner, ctx.attr, level)
+    if isinstance(ctx, ast.Name):
+        if ctx.id in EXEMPT_LOCK_NAMES:
+            return None
+        if ctx.id.endswith("lock") or ctx.id.endswith("cond"):
+            return LockKey(fn.module.relpath, ctx.id, None)
+    return None
+
+
+def _condition_assoc(graph: CallGraph) -> "dict[tuple[str, str], str]":
+    """(class, cond_attr) -> lock_attr for ``self.c = threading.Condition
+    (self.l)`` wirings: waiting on ``c`` releases ``l``, so it is safe
+    while holding ``l``."""
+    assoc: "dict[tuple[str, str], str]" = {}
+    for classes in graph.classes_by_name.values():
+        for ci in classes:
+            for fi in ci.methods.values():
+                for node in ast.walk(fi.node):
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "Condition"
+                        and node.value.args
+                        and isinstance(node.value.args[0], ast.Attribute)
+                        and isinstance(node.value.args[0].value, ast.Name)
+                        and node.value.args[0].value.id == "self"
+                    ):
+                        continue
+                    assoc[(ci.name, node.targets[0].attr)] = (
+                        node.value.args[0].attr
+                    )
+    return assoc
+
+
+def _blocking_reason(
+    call: ast.Call,
+    fn: FunctionInfo,
+    held: "set[str]",
+    cond_assoc,
+) -> "str | None":
+    """Why this call blocks, or None. ``held`` is the set of attr names of
+    locks held in the current region (for condvar-self-wait exemption)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "sleep":
+            return "sleep() while holding the lock"
+        if func.id == "interruptible_sleep":
+            return "interruptible_sleep() while holding the lock"
+        if func.id == "Popen":
+            return "subprocess while holding the lock"
+        return None
+    if isinstance(func, ast.Call):
+        # interruptible_sleep(ev)(delay) — a call of a call
+        if (
+            isinstance(func.func, ast.Name)
+            and func.func.id == "interruptible_sleep"
+        ):
+            return "interruptible_sleep() while holding the lock"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv, attr = func.value, func.attr
+    recv_name = recv.id if isinstance(recv, ast.Name) else None
+    if attr == "sleep" and recv_name == "time":
+        return "time.sleep while holding the lock"
+    if recv_name == "subprocess" and attr in SUBPROCESS_FNS:
+        return f"subprocess.{attr} while holding the lock"
+    if attr in HTTP_FNS:
+        return f"socket/HTTP call .{attr}() while holding the lock"
+    if attr in CLUSTER_IO:
+        return (
+            f"cluster API call .{attr}() (network round-trip on a real "
+            "backend) while holding the lock"
+        )
+    if attr == "wait":
+        # Waiting on the held lock's own condition releases it: safe.
+        if isinstance(recv, ast.Attribute) and isinstance(
+            recv.value, ast.Name
+        ) and recv.value.id == "self":
+            if recv.attr in held:
+                return None
+            if fn.cls is not None and cond_assoc.get(
+                (fn.cls.name, recv.attr)
+            ) in held:
+                return None
+        return f"blocking wait on {_expr_src(recv)} while holding the lock"
+    if attr == "acquire":
+        return None  # handled as an acquisition by the ordering check
+    if attr == "get" and any(
+        kw.arg in ("block", "timeout") for kw in call.keywords
+    ):
+        return "blocking queue get while holding the lock"
+    if attr == "join" and not isinstance(recv, ast.Constant):
+        # str.join is ubiquitous; flag joins on self-attributes that an
+        # __init__ typed as threads, nothing else.
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and fn.cls is not None
+            and "Thread" in fn.cls.attr_types.get(recv.attr, "")
+        ):
+            return "thread join while holding the lock"
+    return None
+
+
+def _summaries(
+    graph: CallGraph, cond_assoc
+) -> "dict[str, FnSummary]":
+    out: "dict[str, FnSummary]" = {}
+    for qual, fn in graph.functions.items():
+        s = FnSummary()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    key = _lock_key_for(
+                        item.context_expr, fn, cond_assoc
+                    )
+                    if key is not None:
+                        s.acquires.append((key, node.lineno))
+        for call in graph.calls_in(fn):
+            reason = _blocking_reason(call, fn, set(), cond_assoc)
+            if reason is not None:
+                s.blocking.append((call.lineno, reason))
+            for callee in graph.resolve_call(call, fn):
+                s.callees.append((callee, call.lineno))
+        out[qual] = s
+    return out
+
+
+def _walk_region(body: "list[ast.stmt]"):
+    """Yield nodes in a with-region, not descending into nested defs."""
+    stack: list = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
+    graph = graph or CallGraph(project)
+    cond_assoc = _condition_assoc(graph)
+    summaries = _summaries(graph, cond_assoc)
+    findings: "list[Finding]" = []
+
+    def reachable(
+        fn: FunctionInfo, *, want: str, seen: "set[str]"
+    ) -> "list[tuple[str, str]]":
+        """(description, via-chain) for blocking calls / acquisitions
+        reachable from ``fn`` inclusive. ``want`` is 'blocking' or
+        'acquires'."""
+        if fn.qualname in seen:
+            return []
+        seen.add(fn.qualname)
+        s = summaries.get(fn.qualname)
+        if s is None:
+            return []
+        hits: "list[tuple[str, str]]" = []
+        if want == "blocking":
+            for _line, why in s.blocking:
+                hits.append((why, fn.qualname))
+        else:
+            for key, _line in s.acquires:
+                hits.append((key, fn.qualname))  # type: ignore[arg-type]
+        for callee, _line in s.callees:
+            for why, via in reachable(callee, want=want, seen=seen):
+                hits.append((why, via))
+        return hits
+
+    for mod in project.modules:
+        if "/testing/" in mod.relpath or mod.relpath.endswith("demo.py"):
+            continue
+        for fn in [
+            f for f in graph.functions.values() if f.module is mod
+        ]:
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.With):
+                    continue
+                keys = [
+                    _lock_key_for(item.context_expr, fn, cond_assoc)
+                    for item in node.items
+                ]
+                keys = [k for k in keys if k is not None]
+                if not keys:
+                    continue
+                held_attrs = {k.attr for k in keys}
+                for sub in _walk_region(node.body):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    # Direct blocking call in the region.
+                    why = _blocking_reason(sub, fn, held_attrs, cond_assoc)
+                    if why is not None:
+                        findings.append(
+                            Finding(
+                                NAME,
+                                mod.relpath,
+                                sub.lineno,
+                                f"{why} ({keys[0].owner}.{keys[0].attr} "
+                                f"held since line {node.lineno})",
+                            )
+                        )
+                    for callee in graph.resolve_call(sub, fn):
+                        # Transitive blocking.
+                        for why2, via in reachable(
+                            callee, want="blocking", seen=set()
+                        ):
+                            findings.append(
+                                Finding(
+                                    NAME,
+                                    mod.relpath,
+                                    sub.lineno,
+                                    f"{why2} — reached via {via} while "
+                                    f"{keys[0].owner}.{keys[0].attr} is "
+                                    f"held (line {node.lineno})",
+                                )
+                            )
+                        # Transitive ordering violations.
+                        for key2, via in reachable(
+                            callee, want="acquires", seen=set()
+                        ):
+                            _check_order(
+                                findings, mod, sub.lineno, keys, key2, via
+                            )
+                    # Direct nested with handled when the walker reaches
+                    # it as its own With node below (ordering only).
+                # Nested with-stmts inside this region: ordering check.
+                for sub in _walk_region(node.body):
+                    if not isinstance(sub, ast.With):
+                        continue
+                    for item in sub.items:
+                        key2 = _lock_key_for(
+                            item.context_expr, fn, cond_assoc
+                        )
+                        if key2 is not None:
+                            _check_order(
+                                findings,
+                                mod,
+                                sub.lineno,
+                                keys,
+                                key2,
+                                fn.qualname,
+                            )
+    # De-duplicate (the same reachable hit can surface through several
+    # call expressions on one line).
+    return sorted(set(findings), key=lambda f: (f.file, f.line, f.message))
+
+
+def _check_order(findings, mod, line, held_keys, acquired, via) -> None:
+    if not isinstance(acquired, LockKey) or acquired.level is None:
+        return
+    for held in held_keys:
+        if held.level is None:
+            continue
+        if held.owner == acquired.owner:
+            continue  # re-entry on the same component (RLocks)
+        if LOCK_LEVELS[acquired.level] < LOCK_LEVELS[held.level]:
+            findings.append(
+                Finding(
+                    NAME,
+                    mod.relpath,
+                    line,
+                    f"lock-order violation: acquiring {acquired.level} "
+                    f"lock ({acquired.owner}.{acquired.attr}, via {via}) "
+                    f"while holding {held.level} lock ({held.owner}."
+                    f"{held.attr}) — declared order is "
+                    "informer -> queue -> accountant -> gang -> metrics",
+                )
+            )

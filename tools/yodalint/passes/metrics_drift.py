@@ -1,0 +1,78 @@
+"""Pass 6 — metrics-drift (migrated from tools/check_metrics.py,
+ISSUE 9): every ``yoda_*`` series registered anywhere in the package
+must be asserted in tests/test_observability.py and documented in
+docs/OPERATIONS.md.
+
+New metrics silently skipping the test suite or the operator docs is how
+observability rots: the series exists, nobody knows what it means, and a
+rename breaks dashboards without failing CI.
+
+Registration sites are found syntactically — the first string argument
+of ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` calls (the
+Registry surface in yoda_tpu/observability.py) — so a metric cannot hide
+behind an accumulator pattern or a lazily-attached family.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tools.yodalint.core import Finding, Project
+
+NAME = "metrics-drift"
+
+REGISTRATION = re.compile(
+    r'\b(?:counter|gauge|histogram)\(\s*["\'](yoda_[a-z0-9_]+)["\']'
+)
+
+
+def registered_names(project: Project) -> "dict[str, tuple[str, int]]":
+    """Every registered ``yoda_*`` series -> (file, line) of its first
+    registration site. Also consumed by tests/test_observability.py's
+    pinned-list check."""
+    names: "dict[str, tuple[str, int]]" = {}
+    for mod in project.modules:
+        for m in REGISTRATION.finditer(mod.text):
+            line = mod.text.count("\n", 0, m.start()) + 1
+            names.setdefault(m.group(1), (mod.relpath, line))
+    return names
+
+
+def run(project: Project, graph=None) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    names = registered_names(project)
+    if not names:
+        return [
+            Finding(
+                NAME,
+                f"{project.package}/observability.py",
+                1,
+                "found no registered yoda_* series — the registration "
+                "regex no longer matches the code; re-pin this pass",
+            )
+        ]
+    test_text = project.read_text(project.observability_test) or ""
+    docs_text = project.read_text(project.operations_md) or ""
+    for name in sorted(names):
+        rel, line = names[name]
+        if name not in test_text:
+            findings.append(
+                Finding(
+                    NAME,
+                    rel,
+                    line,
+                    f"metric {name} is not asserted in "
+                    "tests/test_observability.py",
+                )
+            )
+        if name not in docs_text:
+            findings.append(
+                Finding(
+                    NAME,
+                    rel,
+                    line,
+                    f"metric {name} is not documented in "
+                    "docs/OPERATIONS.md",
+                )
+            )
+    return findings

@@ -1,0 +1,140 @@
+"""Pass 3 — snapshot-immutability: no attribute assignment on Snapshot /
+FleetArrays instances outside whitelisted construction sites.
+
+A Snapshot is the immutable-per-cycle cluster view (PR 7's
+device-resident fleet state and PR 8/11's admission caches key on
+``snapshot.version`` identity); FleetArrays rows are mutated only
+through the kernels' ``update_rows`` / ``fill_row`` delta paths. An ad
+hoc ``snap.x = ...`` anywhere else silently invalidates every consumer
+that cached against the snapshot's identity.
+
+Detection: attribute assignments (``x.attr = ...``, augmented included)
+whose target is snapshot-typed —
+
+- bound in the same function from ``Snapshot(...)`` /
+  ``FleetArrays(...)`` / ``FleetArrays.from_snapshot(...)`` /
+  ``*.with_dynamic(...)`` / a ``*.snapshot()`` call,
+- or annotated ``Snapshot`` / ``FleetArrays`` (parameters included),
+- or named ``snap`` / ``snapshot`` / ``arrays`` (the tree's naming
+  convention for these objects).
+
+Whitelisted: methods of the two classes themselves, functions named
+``fill_row`` / ``update_rows`` (the sanctioned mutation paths), and —
+construction sites — assignments in the *same function* that constructed
+the instance (the informer finishes a snapshot it just built before
+publishing it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.callgraph import CallGraph
+from tools.yodalint.core import Finding, Project
+
+NAME = "snapshot-immutability"
+
+TYPED_NAMES = {"snap", "snapshot", "arrays"}
+PROTECTED_CLASSES = {"Snapshot", "FleetArrays"}
+MUTATOR_FUNCS = {"fill_row", "update_rows"}
+
+#: Value expressions that bind a snapshot-typed name.
+CONSTRUCTOR_CALLS = {"Snapshot", "FleetArrays"}
+CONSTRUCTOR_METHODS = {"from_snapshot", "with_dynamic", "snapshot"}
+
+
+def _constructed_names(fn_node: ast.AST) -> "set[str]":
+    """Names bound from a Snapshot/FleetArrays constructor in this
+    function (construction site: finishing touches are allowed)."""
+    out: "set[str]" = set()
+    for node in ast.walk(fn_node):
+        if not (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        func = node.value.func
+        hit = (
+            isinstance(func, ast.Name) and func.id in CONSTRUCTOR_CALLS
+        ) or (
+            isinstance(func, ast.Attribute)
+            and func.attr in (CONSTRUCTOR_CALLS | CONSTRUCTOR_METHODS)
+        )
+        if not hit:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _annotated_names(fn_node) -> "set[str]":
+    """Parameters / locals annotated Snapshot or FleetArrays."""
+    out: "set[str]" = set()
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+        ):
+            ann = a.annotation
+            text = (
+                ann.value
+                if isinstance(ann, ast.Constant)
+                else (ast.unparse(ann) if ann is not None else "")
+            )
+            if any(c in str(text) for c in PROTECTED_CLASSES):
+                out.add(a.arg)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            text = ast.unparse(node.annotation)
+            if any(c in text for c in PROTECTED_CLASSES):
+                out.add(node.target.id)
+    return out
+
+
+def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
+    graph = graph or CallGraph(project)
+    findings: "list[Finding]" = []
+    for fn in graph.functions.values():
+        rel = fn.module.relpath
+        if "/testing/" in rel:
+            continue
+        if fn.node.name in MUTATOR_FUNCS:
+            continue
+        if fn.cls is not None and fn.cls.name in PROTECTED_CLASSES:
+            continue
+        constructed = _constructed_names(fn.node)
+        typed = (
+            (_annotated_names(fn.node) | TYPED_NAMES | constructed)
+            - constructed
+        )
+        for node in ast.walk(fn.node):
+            targets: "list[ast.expr]" = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in typed
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        node.lineno,
+                        f"attribute assignment {t.value.id}.{t.attr} on "
+                        "a Snapshot/FleetArrays instance outside its "
+                        "construction site — snapshots are immutable per "
+                        "cycle (admission caches and resident fleet "
+                        "state key on snapshot identity)",
+                    )
+                )
+    return findings

@@ -1,0 +1,155 @@
+"""Pass 7 — verdict-taxonomy (migrated from the ISSUE 12 checker test):
+every why-pending park site records a verdict class from the documented
+taxonomy, every class is actually recorded somewhere, and every class is
+documented in OPERATIONS.md.
+
+The why-pending index is only explainable if its ``kind`` vocabulary is
+closed: a park site shipping an unexplained verdict class gives the
+operator a word the runbook has never seen. The taxonomy lives in
+``tracing.VERDICT_CLASSES``; the one dynamic-kind site (the scheduler's
+cycle-outcome passthrough) is pinned to the documented outcome subset by
+a source guard this pass re-checks.
+
+tests/test_yodalint.py drives this pass against planted fixtures and the
+live tree; tests/test_tracing.py keeps the *runtime* half (driving real
+park sites end-to-end) — one taxonomy, two enforcement layers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.core import Finding, Project
+
+NAME = "verdict-taxonomy"
+
+#: The dynamic-kind site's pinned guard (framework/scheduler.py): only
+#: the documented outcome subset reaches ``pending.record(kind=<var>)``.
+DYNAMIC_OK_FILES = {"framework/scheduler.py"}
+DYNAMIC_GUARD = 'in ("unschedulable", "error", "nominated")'
+DYNAMIC_KINDS = {"unschedulable", "error", "nominated"}
+
+
+def _verdict_classes(project: Project) -> "tuple[set[str], str, int]":
+    mod = project.module("tracing.py")
+    if mod is None:
+        return set(), "yoda_tpu/tracing.py", 1
+    for node in mod.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "VERDICT_CLASSES"
+        ):
+            classes = {
+                c.value
+                for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+            return classes, mod.relpath, node.lineno
+    return set(), mod.relpath, 1
+
+
+def run(project: Project, graph=None) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    classes, classes_file, classes_line = _verdict_classes(project)
+    if not classes:
+        return [
+            Finding(
+                NAME,
+                classes_file,
+                classes_line,
+                "tracing.VERDICT_CLASSES not found — the taxonomy "
+                "anchor moved; re-pin this pass",
+            )
+        ]
+    recorded: "set[str]" = set()
+    sites = 0
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "kind":
+                    continue
+                sites += 1
+                if isinstance(kw.value, ast.Constant):
+                    literal = kw.value.value
+                    recorded.add(literal)
+                    if literal not in classes:
+                        findings.append(
+                            Finding(
+                                NAME,
+                                mod.relpath,
+                                node.lineno,
+                                f"verdict class {literal!r} is not in "
+                                "tracing.VERDICT_CLASSES — document it "
+                                "there (and in OPERATIONS.md) or use an "
+                                "existing class",
+                            )
+                        )
+                elif not any(
+                    mod.relpath.endswith(f) for f in DYNAMIC_OK_FILES
+                ):
+                    findings.append(
+                        Finding(
+                            NAME,
+                            mod.relpath,
+                            node.lineno,
+                            "pending.record with a non-literal kind — "
+                            "use a VERDICT_CLASSES literal (only the "
+                            "scheduler's pinned outcome passthrough may "
+                            "pass a variable)",
+                        )
+                    )
+    if not sites:
+        findings.append(
+            Finding(
+                NAME,
+                classes_file,
+                classes_line,
+                "found no pending.record(kind=...) sites — the checker "
+                "no longer matches the code; re-pin this pass",
+            )
+        )
+        return findings
+    # The dynamic site's guard must still pin its domain.
+    sched = project.module("framework/scheduler.py")
+    if sched is not None and DYNAMIC_GUARD not in sched.text:
+        findings.append(
+            Finding(
+                NAME,
+                sched.relpath,
+                1,
+                "the scheduler's dynamic-kind guard "
+                f"({DYNAMIC_GUARD}) changed — re-pin the taxonomy",
+            )
+        )
+    recorded |= DYNAMIC_KINDS
+    for unused in sorted(classes - recorded):
+        findings.append(
+            Finding(
+                NAME,
+                classes_file,
+                classes_line,
+                f"verdict class {unused!r} is documented in "
+                "VERDICT_CLASSES but recorded nowhere — dead taxonomy",
+            )
+        )
+    ops_text = project.read_text(project.operations_md) or ""
+    for cls in sorted(classes):
+        if f"`{cls}`" not in ops_text:
+            findings.append(
+                Finding(
+                    NAME,
+                    classes_file,
+                    classes_line,
+                    f"verdict class {cls!r} is not documented in "
+                    "docs/OPERATIONS.md",
+                )
+            )
+    return findings

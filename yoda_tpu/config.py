@@ -357,8 +357,70 @@ class SchedulerConfig:
         cfg = cls(**d, weights=Weights.from_dict(w) if w else Weights())
         if cfg.mode not in ("batch", "loop"):
             raise ValueError(f"mode must be 'batch' or 'loop', got {cfg.mode!r}")
+        if not isinstance(cfg.scheduler_name, str) or not cfg.scheduler_name:
+            raise ValueError(
+                f"scheduler_name must be a non-empty string, got "
+                f"{cfg.scheduler_name!r}"
+            )
         if cfg.gang_permit_timeout_s <= 0:
             raise ValueError("gang_permit_timeout_s must be positive")
+        if not isinstance(
+            cfg.max_metrics_age_s, (int, float)
+        ) or isinstance(
+            cfg.max_metrics_age_s, bool
+        ) or cfg.max_metrics_age_s < 0:
+            raise ValueError(
+                "max_metrics_age_s must be >= 0 (0 disables staleness "
+                f"filtering), got {cfg.max_metrics_age_s!r}"
+            )
+        if not isinstance(cfg.enable_preemption, bool):
+            raise ValueError(
+                f"enable_preemption must be a bool, got "
+                f"{cfg.enable_preemption!r}"
+            )
+        if cfg.kernel_device_min_elems is not None and (
+            isinstance(cfg.kernel_device_min_elems, bool)
+            or not isinstance(cfg.kernel_device_min_elems, int)
+            or cfg.kernel_device_min_elems < 1
+        ):
+            raise ValueError(
+                "kernel_device_min_elems must be a positive int or None "
+                "(None defers to the batch plugin's threshold), got "
+                f"{cfg.kernel_device_min_elems!r}"
+            )
+        if (
+            isinstance(cfg.bind_retry_attempts, bool)
+            or not isinstance(cfg.bind_retry_attempts, int)
+            or not 0 <= cfg.bind_retry_attempts <= 100
+        ):
+            raise ValueError(
+                "bind_retry_attempts must be an int in [0, 100] (0 "
+                f"disables retry), got {cfg.bind_retry_attempts!r}"
+            )
+        retry_waits = (cfg.bind_retry_base_s, cfg.bind_retry_cap_s)
+        if any(
+            isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0
+            for v in retry_waits
+        ) or not retry_waits[0] <= retry_waits[1]:
+            raise ValueError(
+                "bind retry backoff must satisfy 0 < bind_retry_base_s "
+                f"<= bind_retry_cap_s, got {retry_waits}"
+            )
+        if not isinstance(cfg.federation_spillover, bool):
+            raise ValueError(
+                f"federation_spillover must be a bool, got "
+                f"{cfg.federation_spillover!r}"
+            )
+        if not isinstance(cfg.rebalance_preemption, bool):
+            raise ValueError(
+                f"rebalance_preemption must be a bool, got "
+                f"{cfg.rebalance_preemption!r}"
+            )
+        if not isinstance(cfg.rebalance_elastic, bool):
+            raise ValueError(
+                f"rebalance_elastic must be a bool, got "
+                f"{cfg.rebalance_elastic!r}"
+            )
         if (
             isinstance(cfg.percentage_nodes_to_score, bool)
             or not isinstance(cfg.percentage_nodes_to_score, int)

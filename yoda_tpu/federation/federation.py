@@ -207,12 +207,23 @@ class Federation:
     def _drain_retry_deletes(self) -> None:
         with self._lock:
             pending, self._retry_deletes = self._retry_deletes, []
+        kept: "list[tuple[FederationMember, str]]" = []
         for member, key in pending:
+            # Fence-before-write (PR 3/4 discipline): no longer leader
+            # for this cluster front means no API writes — the lingering
+            # home copy is inert (no queue entry), so it keeps until
+            # leadership returns or the new leader's drift reconciler
+            # retires it.
+            if member.stack.scheduler._fenced():
+                kept.append((member, key))
+                continue
             try:
                 member.cluster.delete_pod(key)
             except Exception:  # noqa: BLE001 — keep retrying
-                with self._lock:
-                    self._retry_deletes.append((member, key))
+                kept.append((member, key))
+        if kept:
+            with self._lock:
+                self._retry_deletes.extend(kept)
 
     def run_forever(
         self, stop: threading.Event, *, period_s: float = 1.0

@@ -95,6 +95,82 @@ class WaitingPod:
         self._on_resolved(self, Status.unschedulable(message))
 
 
+class _DaemonPool:
+    """Minimal ``ThreadPoolExecutor`` stand-in with DAEMON worker
+    threads and the same Future-returning ``submit`` contract.
+
+    stdlib pools deliberately join their (non-daemon) workers at
+    interpreter shutdown; for the bind pipeline that policy inverts the
+    failure mode we care about — an executor whose owner dropped it
+    without ``shutdown()`` keeps idle non-daemon workers alive forever
+    (the tests/conftest.py thread-hygiene gate flags exactly this), and
+    a stalled bind round-trip can then block process exit. Bind tasks
+    need no exit-time draining: in-flight work is bounded by the API
+    client's request timeout, reservations roll back through resync, and
+    the stop_event already aborts backoff sleeps."""
+
+    def __init__(self, max_workers: int, thread_name_prefix: str) -> None:
+        import queue as _queue
+
+        self._max_workers = max_workers
+        self._prefix = thread_name_prefix
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._threads: "list[threading.Thread]" = []
+        self._lock = threading.Lock()
+        self._down = False
+
+    def submit(self, fn: Callable[[], object]):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        with self._lock:
+            if self._down:
+                raise RuntimeError("cannot submit after shutdown")
+            self._q.put((fut, fn))
+            if len(self._threads) < self._max_workers:
+                t = threading.Thread(
+                    target=self._worker,
+                    name=f"{self._prefix}_{len(self._threads)}",
+                    daemon=True,
+                )
+                self._threads.append(t)
+                t.start()
+        return fut
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+
+    def shutdown(self, wait: bool = True, cancel_futures: bool = False) -> None:
+        import queue as _queue
+
+        with self._lock:
+            self._down = True
+            threads = list(self._threads)
+        if cancel_futures:
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is not None:
+                    item[0].cancel()
+        for _ in threads:
+            self._q.put(None)
+        if wait:
+            for t in threads:
+                t.join()
+
+
 class BindExecutor:
     """Bounded-concurrency bind fan-out — the bind pipeline (config
     ``bind_workers``).
@@ -119,7 +195,11 @@ class BindExecutor:
       waits promptly instead of draining up to ``retry_cap_s`` each.
 
     Workers are created lazily on the first submit, so pipeline-disabled
-    stacks and tests never pay the threads.
+    stacks and tests never pay the threads. They are DAEMON threads (see
+    ``_DaemonPool``): an executor whose owner forgot ``shutdown()`` — a
+    dropped test stack, a SIGTERM mid-drain — must never wedge
+    interpreter exit or trip the tests/conftest.py thread-hygiene gate;
+    orderly shutdown still exists and is what cli.py uses.
     """
 
     def __init__(
@@ -147,9 +227,7 @@ class BindExecutor:
         the resolution chain, not the future."""
         with self._lock:
             if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-
-                self._pool = ThreadPoolExecutor(
+                self._pool = _DaemonPool(
                     max_workers=self.workers,
                     thread_name_prefix=f"{self._name}-worker",
                 )

@@ -843,7 +843,7 @@ class NodeHealthMonitor:
         requeued lost members then admit straight onto the installed
         plan's free hosts."""
         pinned = {}
-        for pod, host in healthy:
+        for _pod, host in healthy:
             if host not in snapshot:
                 return None  # a kept host left the snapshot: replan whole
             ni = snapshot.get(host)

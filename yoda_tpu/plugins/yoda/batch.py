@@ -168,6 +168,7 @@ def _host_admission(
         if key is not None:
             cache = getattr(snapshot, "_admission_cache", None)
             if cache is None:
+                # yodalint: ok snapshot-immutability memoization keyed on snapshot identity, not a fleet-state mutation; rebuilt with the snapshot on every watch event
                 cache = snapshot._admission_cache = {}
             hit = cache.get(key)
             # Entries pin their FleetArrays (identity-checked, never by

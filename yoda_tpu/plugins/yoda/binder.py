@@ -146,7 +146,17 @@ class ClusterBinder(BindPlugin):
     def unbind(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
         """Reverse a bind (gang rollback). Best-effort with the same
         transient-retry policy; backends without any rollback surface
-        report an error and the caller logs the stranded pod."""
+        report an error and the caller logs the stranded pod.
+
+        Deliberately NOT fenced (the one exception to fence-before-
+        write): these are rollbacks of THIS process's own landed binds,
+        and an ex-leader that refuses to unwind them strands bound
+        members and their chips until the new leader's resync — the
+        pinned semantics are that a fence flip mid-release unwinds the
+        landed half immediately (tests/test_chaos.py
+        test_fence_flips_during_fanout). The write moves cluster state
+        toward the pre-gang truth both leaders agree on, so it cannot
+        race the new leader the way a forward bind can."""
         target = getattr(self.cluster, "unbind_pod", None)
         if target is None:
             # No unbind and no delete: nothing this backend can do.

@@ -146,6 +146,12 @@ class TpuPreemption(PostFilterPlugin):
     ) -> None:
         self.evict_fn = evict_fn
         self.pdbs_fn = pdbs_fn
+        # Leader fence, re-checked immediately before the eviction round-
+        # trips (they run outside the cycle lock, so leadership can flip
+        # between victim selection and the API writes). Assigned post-
+        # construction by standalone.build_stack (the scheduler exists
+        # later); None = unfenced (single-process tests).
+        self.fenced_fn: "Callable[[], bool] | None" = None
         # Held during victim SELECTION (pure snapshot/reserved_fn reads) —
         # pass the scheduler's shared cycle lock so selection cannot race
         # another profile's Filter->Reserve (a Reserve landing between the
@@ -867,6 +873,15 @@ class TpuPreemption(PostFilterPlugin):
         remaining occupancy. Hard errors (RBAC 403, connection loss) are
         logged so a permanent failure is diagnosable, not mistaken for a
         disruption budget."""
+        # Fence-before-write (PR 3/4): selection ran under the cycle
+        # lock, but the evictions are API writes that may land after a
+        # leadership flip — an ex-leader must not evict anyone.
+        if self.fenced_fn is not None and self.fenced_fn():
+            log.warning(
+                "scheduler fenced (not leader); dropping %d planned "
+                "eviction(s)", len(victims),
+            )
+            return 0
         evicted = 0
         for v in victims:
             try:

@@ -759,6 +759,10 @@ def build_stack(
     binder.fenced_fn = scheduler._fenced
     binder.on_fenced = metrics.fenced_binds.inc
     binder.observe_wall_ms = metrics.bind_wall.observe
+    # Same worker-side fence for preemption's evictions: victim selection
+    # runs under the cycle lock, the eviction round-trips do not.
+    if preemption is not None:
+        preemption.fenced_fn = scheduler._fenced
     # Crash-safe failover: the warm-start resync + drift reconciler for
     # this stack. Built but NOT started — cli.py wires resync() as
     # scheduler.on_serve_start (so it runs after promotion, before the
