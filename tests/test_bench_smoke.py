@@ -250,3 +250,22 @@ def test_slo_matrix_smoke_invariants():
         assert out[f"slo_{scen}_p99_worst_s"] <= 60.0
     assert out["slo_rolling_upgrade_drained_nodes"] > 0
     assert out["slo_deadline_gangs_p99_s"] <= 30.0
+
+
+def test_shard_scaling_smoke_invariants():
+    import bench
+
+    # ISSUE 14: the shard-out smoke slice (1 vs 2 shards at a reduced
+    # bind-latency-bound shape; `make shard-bench` runs the 1/2/4/8
+    # standard shape with the >= 3x-at-4 acceptance). The scenario
+    # asserts its own invariants inline — every gang bound whole, no
+    # oversubscription, no staged-claim residue — and the ratio guards
+    # gross scaling regressions with slack for 1-core CI noise.
+    out = bench._shard_scaling_scenario(
+        shard_counts=(1, 2), gangs=8, members=4, hosts=8,
+        latency_s=0.06, reps=1,
+    )
+    assert out["shard1_pods_per_s"] > 0
+    assert out["shard2_pods_per_s"] > 0
+    assert out["shard_scaling_2x"] >= 1.3, out
+    assert out["shard1_commit_commits"] > 0

@@ -54,6 +54,17 @@ class TestSchedulerManifest:
         assert cfg.tenant_quota_chips == 0
         assert cfg.tenant_quota_hbm_gib == 0
 
+    def test_configmap_shard_knob_validates_and_defaults_off(self):
+        """ISSUE 14: the shard-out knob ships explicitly (so operators
+        see the rollback knob) at the conservative default — 1 = the
+        classic single serve loop — and VALIDATES; a drifted ConfigMap
+        would crash-loop the Deployment."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert cfg.shard_count == 1
+
     def test_deployment_mounts_config_and_probes_healthz(self):
         (dep,) = by_kind(self.docs, "Deployment")
         spec = dep["spec"]["template"]["spec"]

@@ -201,6 +201,12 @@ ALL_METRIC_FAMILIES = (
     "yoda_resync_rolled_back_gangs",
     "yoda_scheduling_attempts_total",
     "yoda_scheduling_latency_seconds",
+    "yoda_shard_binds",
+    "yoda_shard_commit_commits_total",
+    "yoda_shard_commit_conflicts_total",
+    "yoda_shard_commit_rollbacks_total",
+    "yoda_shard_cycles",
+    "yoda_shard_queue_depth",
     "yoda_sharded_dispatches_total",
     "yoda_slo_admission_wait_p99_seconds",
     "yoda_slo_alerts_firing",

@@ -1,0 +1,438 @@
+"""Scheduler shard-out (ISSUE 14): partition map, router, optimistic
+claim->validate->commit at the shared accountant, sharded assembly, and
+the starved-work rescue path.
+
+The deterministic protocol tests stage claims through REAL tagged cycle
+states (the exact path a shard's Reserve takes), so a refactor of the
+staging plumbing cannot quietly pass while the serve path diverges. The
+chaos-grade concurrency sweeps live in tests/test_chaos.py
+(cross_shard_contention mode)."""
+
+import pytest
+
+from yoda_tpu.agent.fake_publisher import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework.cyclestate import (
+    SHARD_STATE_KEY,
+    CycleState,
+    ShardTag,
+)
+from yoda_tpu.framework.shards import (
+    GLOBAL_LANE,
+    ShardMap,
+    shard_name,
+)
+from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+from yoda_tpu.standalone import build_sharded_stacks
+
+
+def make_shard_set(shard_count=2, *, shard_map=None, **cfg):
+    cfg.setdefault("batch_requests", 8)
+    ss = build_sharded_stacks(
+        config=SchedulerConfig(shard_count=shard_count, **cfg),
+        shard_map=shard_map,
+    )
+    return ss, FakeTpuAgent(ss.global_stack.cluster)
+
+
+def fleet(agent, *, slices=4, hosts=4, chips=8):
+    for s in range(slices):
+        agent.add_slice(
+            f"v5p-{s}", generation="v5p", host_topology=(2, 2, 1)
+        )
+    for i in range(hosts):
+        agent.add_host(f"h{i}", generation="v5e", chips=chips)
+    agent.publish_all()
+
+
+def gang_pods(tag, n=4, *, topology="2x2", chips=4):
+    labels = {"tpu/gang": tag, "tpu/chips": str(chips)}
+    if topology:
+        labels["tpu/topology"] = topology
+    else:
+        labels["tpu/gang-size"] = str(n)
+    return [
+        PodSpec(f"{tag}-{m}", labels=dict(labels)) for m in range(n)
+    ]
+
+
+class TestShardMap:
+    def test_assignment_is_deterministic_and_total(self):
+        a, b = ShardMap(4), ShardMap(4)
+        for i in range(200):
+            pool = f"slice-{i}"
+            assert a.shard_of_pool(pool) == b.shard_of_pool(pool)
+            assert 0 <= a.shard_of_pool(pool) < 4
+
+    def test_fleet_change_moves_nothing(self):
+        # The rendezvous property's strongest form: assignment is a pure
+        # function of (pool, shard_count) — other pools coming or going
+        # cannot move an existing pool.
+        m = ShardMap(4)
+        before = {f"p{i}": m.shard_of_pool(f"p{i}") for i in range(50)}
+        for i in range(50, 500):
+            m.shard_of_pool(f"p{i}")  # "fleet growth"
+        assert before == {
+            f"p{i}": m.shard_of_pool(f"p{i}") for i in range(50)
+        }
+
+    def test_shard_count_change_moves_about_one_nth(self):
+        m4, m5 = ShardMap(4), ShardMap(5)
+        pools = [f"p{i}" for i in range(2000)]
+        moved = sum(
+            m4.shard_of_pool(p) != m5.shard_of_pool(p) for p in pools
+        )
+        # Rendezvous: growing 4 -> 5 moves ~1/5 of pools (generous band).
+        assert 0.10 < moved / len(pools) < 0.35, moved
+
+    def test_hosts_without_a_slice_form_single_host_pools(self):
+        assert ShardMap.pool_of("h7", None) == "host:h7"
+
+    def test_overlap_pins_a_pool_into_extra_shards(self):
+        m = ShardMap(2, overlap={"s-x": (0, 1)})
+        assert set(m.shards_of_pool("s-x")) == {0, 1}
+        f0, f1 = m.node_filter(0), m.node_filter(1)
+
+        class _Tpu:
+            slice_id = "s-x"
+
+        assert f0("n", _Tpu()) and f1("n", _Tpu())
+
+
+class TestShardRouter:
+    def test_gang_members_route_together_and_feasibly(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent)
+        for tag in ("ga", "gb", "gc", "gd"):
+            lanes = {
+                ss.router.route(p) for p in gang_pods(tag)
+            }
+            assert len(lanes) == 1, lanes
+
+    def test_mesh_larger_than_any_shard_goes_global(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=4)
+        # A multislice mesh wider than ANY shard's slice budget (5
+        # disjoint blocks on a 4-slice fleet split across shards) fits
+        # no single shard -> the serialized global lane.
+        big = [
+            PodSpec(
+                f"big-{m}",
+                labels={
+                    "tpu/gang": "big",
+                    "tpu/topology": "2x2",
+                    "tpu/multislice": "5",
+                    "tpu/chips": "4",
+                },
+            )
+            for m in range(20)
+        ]
+        assert {ss.router.route(p) for p in big} == {GLOBAL_LANE}
+
+    def test_malformed_labels_route_global(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent)
+        pod = PodSpec("bad", labels={"tpu/chips": "not-a-number"})
+        assert ss.router.route(pod) == GLOBAL_LANE
+
+    def test_each_pending_pod_enters_exactly_one_queue(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent)
+        for p in gang_pods("gq") + [
+            PodSpec(f"s{i}", labels={"tpu/chips": "4"}) for i in range(6)
+        ]:
+            ss.global_stack.cluster.create_pod(p)
+        depths = [len(st.queue) for st in ss.stacks]
+        assert sum(depths) == 10, depths
+
+
+class TestCommitProtocol:
+    """The optimistic claim->validate->commit core, driven through the
+    REAL Reserve path (tagged cycle states on a shared accountant)."""
+
+    def _stage(self, acct, shard, uid, node, chips):
+        state = CycleState()
+        state.write(SHARD_STATE_KEY, ShardTag(shard))
+        pod = PodSpec(uid, labels={"tpu/chips": str(chips)})
+        from yoda_tpu.api.requests import pod_request
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            REQUEST_KEY,
+            RequestData,
+        )
+
+        state.write(REQUEST_KEY, RequestData(pod_request(pod)))
+        assert acct.reserve(state, pod, node).success
+        return pod
+
+    def _acct(self, cap=8):
+        acct = ChipAccountant()
+        acct.track_capacity = True
+        from yoda_tpu.api.types import make_node
+        from yoda_tpu.cluster.fake import Event
+
+        tpu = make_node("n0", generation="v5e", chips=cap)
+        acct.handle(Event("added", "TpuNodeMetrics", tpu))
+        return acct
+
+    def test_first_staged_wins_second_conflicts(self):
+        acct = self._acct(cap=8)
+        a = self._stage(acct, "s0", "a", "n0", 8)
+        b = self._stage(acct, "s1", "b", "n0", 8)
+        ok, _ = acct.commit_staged([a.uid])
+        assert ok
+        ok, why = acct.commit_staged([b.uid])
+        assert not ok and "earlier-staged" in why
+        assert acct.commit_conflicts == 1
+        # The loser releases through the standard unreserve path.
+        acct.release(b.uid)
+        assert acct.chips_in_use("n0") == 8
+        assert not acct.staged_uids()
+
+    def test_gang_cohort_commits_atomically(self):
+        acct = self._acct(cap=8)
+        a = self._stage(acct, "s0", "a", "n0", 4)
+        b = self._stage(acct, "s0", "b", "n0", 4)
+        ok, _ = acct.commit_staged([a.uid, b.uid])
+        assert ok and acct.commit_commits == 1
+        assert not acct.staged_uids()
+
+    def test_capacity_shrink_fails_the_commit(self):
+        acct = self._acct(cap=8)
+        a = self._stage(acct, "s0", "a", "n0", 8)
+        from yoda_tpu.api.types import make_node
+        from yoda_tpu.cluster.fake import Event
+
+        acct.handle(
+            Event(
+                "modified",
+                "TpuNodeMetrics",
+                make_node("n0", generation="v5e", chips=4),
+            )
+        )
+        ok, _ = acct.commit_staged([a.uid])
+        assert not ok
+
+    def test_unsharded_reserve_never_stages(self):
+        acct = ChipAccountant()
+        state = CycleState()
+        pod = PodSpec("p", labels={"tpu/chips": "2"})
+        from yoda_tpu.api.requests import pod_request
+        from yoda_tpu.plugins.yoda.filter_plugin import (
+            REQUEST_KEY,
+            RequestData,
+        )
+
+        state.write(REQUEST_KEY, RequestData(pod_request(pod)))
+        acct.reserve(state, pod, "n0")
+        assert not acct.staged_uids()
+        ok, _ = acct.commit_staged([pod.uid])
+        assert ok  # vacuous: nothing staged
+
+    def test_watch_bind_event_keeps_claim_staged_until_commit(self):
+        acct = self._acct(cap=8)
+        a = self._stage(acct, "s0", "a", "n0", 4)
+        from yoda_tpu.cluster.fake import Event
+
+        bound = PodSpec("a", node_name="n0", labels={"tpu/chips": "4"})
+        bound.uid = a.uid
+        acct.handle(Event("modified", "Pod", bound))
+        assert a.uid in acct.staged_uids()
+        assert acct.commit_residue(a.uid)
+        assert not acct.staged_uids()
+
+
+class TestShardedAssembly:
+    def test_partitions_disjoint_and_cover_the_fleet(self):
+        ss, agent = make_shard_set(4)
+        fleet(agent, slices=6, hosts=6)
+        parts = [
+            set(st.informer.snapshot().names())
+            for st in ss.shard_stacks
+        ]
+        everything = set(ss.global_stack.informer.snapshot().names())
+        seen = set()
+        for part in parts:
+            assert not (part & seen)
+            seen |= part
+        assert seen == everything
+
+    def test_mixed_load_drains_whole_with_no_oversubscription(self):
+        ss, agent = make_shard_set(2)
+        # Slack beyond the exact demand: at a capacity-EXACT shape a
+        # single routed to a v5e-free shard legitimately takes a slice
+        # host and strands a gang (the rescue test covers tightness);
+        # this test asserts the whole mixed load lands.
+        fleet(agent, hosts=6)
+        cluster = ss.global_stack.cluster
+        pods = [
+            p
+            for g in range(3)
+            for p in gang_pods(f"g{g}")
+        ] + [PodSpec(f"p{i}", labels={"tpu/chips": "4"}) for i in range(8)]
+        for p in pods:
+            cluster.create_pod(p)
+        ss.run_until_idle(max_wall_s=30)
+        bound = [p for p in cluster.list_pods() if p.node_name]
+        if len(bound) != len(pods):  # diagnostic dump for the flake hunt
+            missing = [
+                p.key for p in pods if not cluster.get_pod(p.key).node_name
+            ]
+            state = {
+                "missing": missing,
+                "queues": {
+                    st.scheduler.shard: [
+                        (q.key, a)
+                        for q, a in [
+                            (pp, at)
+                            for pp, at in st.queue.all_entries()
+                        ]
+                    ]
+                    for st in ss.stacks
+                },
+                "waiting": {
+                    st.scheduler.shard: [
+                        w.pod.key for w in st.framework.waiting_pods()
+                    ]
+                    for st in ss.stacks
+                },
+                "gangs": {
+                    st.scheduler.shard: {
+                        n: (sorted(g.bound), sorted(g.waiting))
+                        for n, g in st.gang._gangs.items()
+                    }
+                    for st in ss.stacks
+                },
+                "conflicts": ss.accountant.commit_conflicts,
+                "staged": ss.accountant.staged_uids(),
+            }
+            raise AssertionError(state)
+        for ni in ss.global_stack.informer.snapshot().infos():
+            assert ss.accountant.chips_in_use(ni.name) <= len(
+                ni.tpu.healthy_chips()
+            )
+        assert not ss.accountant.staged_uids()
+        assert ss.accountant.commit_commits > 0
+        ss.close()
+
+    def test_shard_count_one_builds_classic_unsharded_stack(self):
+        from yoda_tpu.standalone import build_stack
+
+        stack = build_stack(config=SchedulerConfig())
+        assert stack.scheduler.shard is None
+        assert stack.scheduler.commit_fn is None
+        assert stack.gang.track_commits is False
+        assert stack.informer.node_filter_fn is None
+
+    def test_per_shard_series_follow_the_live_shard_set(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=2, hosts=2)
+        text = ss.metrics.registry.render_prometheus()
+        for lane in ("global", "s0", "s1"):
+            assert f'yoda_shard_queue_depth{{shard="{lane}"}}' in text
+        assert 'shard="s2"' not in text
+
+    def test_sharding_refused_with_profiles(self):
+        with pytest.raises(ValueError, match="incompatible with profiles"):
+            SchedulerConfig.from_dict(
+                {
+                    "shard_count": 2,
+                    "profiles": [{"scheduler_name": "other"}],
+                }
+            )
+
+
+class TestRerouteAndRescue:
+    def test_structural_fleet_change_reroutes_parked_work(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=2, hosts=2)
+        cluster = ss.global_stack.cluster
+        # A gang routed to some shard; its slices then die -> the
+        # reroute watcher must hand the queued members to a lane that
+        # can still host them (here: whichever still has a slice).
+        pods = gang_pods("gr")
+        target = ss.router.route(pods[0])
+        for p in pods:
+            cluster.create_pod(p)
+        owner = next(
+            st for st in ss.stacks if st.scheduler.shard == target
+        )
+        assert len(owner.queue) == 4
+        # Kill the owner's slices out from under it (agent removes the
+        # CRs; the Node objects go too).
+        for name in list(owner.informer.snapshot().names()):
+            if name.startswith("v5p"):
+                agent.remove_host(name)
+                cluster.delete_node(name)
+        new_lane = ss.router.route(pods[0])
+        assert new_lane != target
+        moved_to = next(
+            st
+            for st in ss.stacks
+            if st.scheduler.shard == new_lane
+        )
+        total = sum(len(st.queue) for st in ss.stacks)
+        assert total == 4
+        assert len(moved_to.queue) == 4, (
+            target, new_lane, [len(st.queue) for st in ss.stacks],
+        )
+
+    def test_starved_whole_gang_rescues_to_global_lane(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=1, hosts=2)  # one slice: contention by design
+        cluster = ss.global_stack.cluster
+        # Two gangs that both statically fit but only one slice exists:
+        # the loser must end up bound too, via the global-lane rescue.
+        for tag in ("ga", "gb"):
+            for p in gang_pods(tag):
+                cluster.create_pod(p)
+        ss.run_until_idle(max_wall_s=30)
+        bound = [p for p in cluster.list_pods() if p.node_name]
+        # One gang holds the slice; the other is whole-queued somewhere
+        # (global after rescue) — never split, never oversubscribed.
+        per_gang = {}
+        for p in bound:
+            per_gang.setdefault(p.labels["tpu/gang"], []).append(p)
+        for members in per_gang.values():
+            assert len(members) == 4
+        for ni in ss.global_stack.informer.snapshot().infos():
+            assert ss.accountant.chips_in_use(ni.name) <= len(
+                ni.tpu.healthy_chips()
+            )
+        ss.close()
+
+
+class TestExplainShardTag:
+    def test_parked_gang_explain_names_the_shard(self):
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=1, hosts=1)
+        cluster = ss.global_stack.cluster
+        # An infeasible-member gang parks with an admission verdict
+        # carrying the owning lane. Its journey: routed to a shard on
+        # slice-shape feasibility, starved there (no host fits a
+        # 16-chip member), rescued to the global lane — whose verdict,
+        # the LAST parker, is what explain must name.
+        pods = gang_pods("gx", chips=16)  # 16 > any host's 4/8 chips
+        for p in pods:
+            cluster.create_pod(p)
+        ss.run_until_idle(max_wall_s=10)
+        entry = ss.metrics.pending.explain("gx")
+        assert entry is not None
+        lanes = {GLOBAL_LANE} | {
+            st.scheduler.shard for st in ss.shard_stacks
+        }
+        assert entry["shard"] in lanes, entry
+        ss.close()
+
+
+class TestShardNames:
+    def test_shard_name_shape(self):
+        assert shard_name(0) == "s0" and shard_name(7) == "s7"
+
+    def test_router_registers_before_stacks(self):
+        # The assembly contract: a pod arriving in the same batch as its
+        # fleet still routes off current data (router watcher first).
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=2, hosts=0)
+        pods = gang_pods("g0")
+        assert ss.router.route(pods[0]) != GLOBAL_LANE

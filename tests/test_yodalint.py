@@ -216,6 +216,120 @@ class TestFenceBeforeWrite:
         findings = fence_before_write.run(project)
         assert any(".bind_pod" in f.message for f in findings), findings
 
+    def test_catches_fence_free_shard_commit(self, tmp_path):
+        # ISSUE 14: the optimistic shard commit is a write-equivalent
+        # decision point — an ex-leader committing staged claims would
+        # launder stale placements past the new leader.
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Loop:\n"
+                "    def flush(self, uids):\n"
+                "        return self.accountant.commit_staged(uids)\n"
+                "    def flush_hook(self, uids):\n"
+                "        return self.commit_fn(uids)\n"
+            ),
+        })
+        findings = fence_before_write.run(project)
+        assert any(
+            ".commit_staged" in f.message and f.line == 3
+            for f in findings
+        ), findings
+        assert any(
+            ".commit_fn" in f.message and f.line == 5 for f in findings
+        ), findings
+
+    def test_fenced_shard_commit_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class Loop:\n"
+                "    def flush(self, uids):\n"
+                "        if self._fenced():\n"
+                "            return False\n"
+                "        return self.accountant.commit_staged(uids)\n"
+            ),
+        })
+        assert fence_before_write.run(project) == []
+
+
+class TestShardCommitLockOrder:
+    """ISSUE 14: the shared-accountant commit path's lock ordering — the
+    accountant (level 2) must never reach back into the informer/router
+    level (0) at commit time; the commit validator's capacity source is
+    a watch-maintained local dict for exactly this reason."""
+
+    def test_catches_informer_reach_back_from_commit(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading\n"
+                "class InformerCache:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.RLock()\n"
+                "    def snapshot(self):\n"
+                "        with self._lock:\n"
+                "            return {}\n"
+                "class ChipAccountant:\n"
+                "    def __init__(self, informer):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.informer = informer\n"
+                "    def commit_staged(self, uids):\n"
+                "        with self._lock:\n"
+                "            snap = self.informer.snapshot()\n"
+                "            return bool(snap)\n"
+            ),
+        })
+        findings = lock_discipline.run(project)
+        assert any(
+            "lock-order violation" in f.message
+            and "informer" in f.message
+            for f in findings
+        ), findings
+
+    def test_catches_router_reach_into_accountant(self, tmp_path):
+        # The router ranks WITH the informer (its lock is taken inside
+        # informer lock regions): reaching from the accountant's commit
+        # into the router is the same backwards edge.
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading\n"
+                "class ShardRouter:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def route(self, pod):\n"
+                "        with self._lock:\n"
+                "            return 's0'\n"
+                "class ChipAccountant:\n"
+                "    def __init__(self, router):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.router = router\n"
+                "    def commit_staged(self, pod):\n"
+                "        with self._lock:\n"
+                "            return self.router.route(pod)\n"
+            ),
+        })
+        findings = lock_discipline.run(project)
+        assert any(
+            "lock-order violation" in f.message for f in findings
+        ), findings
+
+    def test_commit_over_local_capacity_dict_is_clean(self, tmp_path):
+        # The shape the live tree uses: validation against the
+        # accountant's own watch-maintained capacity map.
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "import threading\n"
+                "class ChipAccountant:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._capacity = {}\n"
+                "    def commit_staged(self, uids):\n"
+                "        with self._lock:\n"
+                "            return all(\n"
+                "                self._capacity.get(u, 0) >= 0 for u in uids\n"
+                "            )\n"
+            ),
+        })
+        assert lock_discipline.run(project) == []
+
 
 class TestSnapshotImmutability:
     def test_catches_mutation_of_a_snapshot_parameter(self, tmp_path):

@@ -46,6 +46,10 @@ PARAM_TYPES = {
     "framework": "Framework",
     "tracer": "Tracer",
     "ledger": "TenantLedger",
+    # Scheduler shard-out (ISSUE 14): the router's lock ranks with the
+    # informer level — resolving `self.router.route(...)` lets the
+    # lock-discipline pass see reaches into it from commit paths.
+    "router": "ShardRouter",
 }
 
 

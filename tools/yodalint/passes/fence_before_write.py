@@ -40,6 +40,16 @@ MUTATING = {
     "evict_pod",
     "evict",
     "evict_fn",  # the preemption plugin's injected evictor
+    # Scheduler shard-out (ISSUE 14): the optimistic shard commit is a
+    # WRITE-equivalent decision point — a committed claim licenses the
+    # bind that follows (or blesses binds that already landed), so a
+    # fenced ex-leader committing would launder its stale placements
+    # past the new leader exactly as an unfenced bind would. Every
+    # commit call must be dominated by a fence read, same as the API
+    # writes. (commit_residue is exempt: it finalizes what cluster
+    # truth ALREADY shows bound — the reconciler's recovery path.)
+    "commit_staged",
+    "commit_fn",  # the scheduler's injected commit point
 }
 
 FENCE_MARKERS = {"_fenced", "fenced_fn", "fence_fn", "gate_fn", "is_leader"}
@@ -60,6 +70,12 @@ def _receiver_is_cluster(func: ast.Attribute) -> bool:
         src_parts.append(node.id)
     if func.attr in ("evict", "evict_fn"):
         return src_parts == ["self"]
+    if func.attr in ("commit_staged", "commit_fn"):
+        # The shard commit point: the accountant's method, or the
+        # scheduler's injected hook (self.commit_fn).
+        return src_parts == ["self"] or any(
+            "accountant" in part for part in src_parts
+        )
     return any("cluster" in part for part in src_parts)
 
 

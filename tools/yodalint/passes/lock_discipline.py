@@ -75,6 +75,15 @@ CLASS_LEVELS = {
     "SchedulingQueue": "queue",
     "ChipAccountant": "accountant",
     "GangPlugin": "gang",
+    # Scheduler shard-out (ISSUE 14): the router's fleet-registry lock is
+    # taken from INSIDE informer lock regions (pod routing runs during
+    # handle_batch), so it ranks WITH the informer level — reaching from
+    # it into queue/accountant/gang is forbidden in that direction, and
+    # the shared-accountant commit path (accountant level) must never
+    # reach back into the router/informer. This is what keeps
+    # ChipAccountant.commit_staged's capacity source a watch-maintained
+    # local dict instead of an informer read.
+    "ShardRouter": "informer",
 }
 MODULE_LEVELS = {
     "yoda_tpu/observability.py": "metrics",

@@ -108,13 +108,18 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     Deployment restarts the pod into standby (upstream kube-scheduler
     behavior, reference deploy/yoda-scheduler.yaml:11-14)."""
     from yoda_tpu.metrics_server import MetricsServer
-    from yoda_tpu.standalone import build_federation, build_profile_stacks
+    from yoda_tpu.standalone import (
+        build_federation,
+        build_profile_stacks,
+        build_sharded_stacks,
+    )
 
     config = _load_config(args.config)
     _init_jax(args.jax_platform)
     cluster = _build_kube_cluster()
     clusters = [cluster]
     federation = None
+    shard_set = None
     if args.federate_url:
         # Federated multi-cluster mode: the env-configured cluster is the
         # HOME front; each --federate-url NAME=URL adds a secondary
@@ -144,10 +149,25 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 (name, _build_kube_cluster(url=url, required=False))
             )
         clusters += [c for _, c in remotes]
+        if config.shard_count > 1:
+            print(
+                "yoda-tpu-scheduler: shard_count > 1 is ignored in "
+                "federated mode (each cluster front serves one loop; "
+                "shard within a cluster by running it unfederated)",
+                file=sys.stderr,
+            )
         federation = build_federation(
             [("home", cluster), *remotes], config, stop_event=stop
         )
         stacks = [m.stack for m in federation.members]
+    elif config.shard_count > 1:
+        # Scheduler shard-out: N parallel serve loops over rendezvous-
+        # partitioned slices/pools + the serialized global lane
+        # (stacks[0], which owns resync and the background repair
+        # loops), sharing one accountant through the optimistic
+        # claim->validate->commit protocol.
+        shard_set = build_sharded_stacks(cluster, config, stop_event=stop)
+        stacks = shard_set.stacks
     else:
         # Upstream profiles: one process can serve several schedulerNames,
         # each with its own plugin config (config `profiles:`). The base
@@ -175,6 +195,11 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             return False
         if federation is not None:
             return federation.ready()
+        if shard_set is not None:
+            # Sharded mode: the global lane owns the one warm-start
+            # resync; shard loops are fenced on it (below), so its
+            # completion IS readiness.
+            return stacks[0].reconciler.resynced.is_set()
         return all(st.reconciler.resynced.is_set() for st in stacks)
 
     metrics_srv = None
@@ -197,8 +222,28 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     # raised on a dead remote would kill that member's serve loop for
     # good, exactly the wedge the health ladder exists to avoid.
     if federation is None:
-        for st in stacks:
+        # Sharded mode: ONLY the global lane resyncs (its informer sees
+        # the whole fleet; N per-shard resyncs would each re-classify
+        # every partially-bound gang). Shard loops start fenced on its
+        # completion, so no shard bind can precede it.
+        resync_stacks = stacks[:1] if shard_set is not None else stacks
+        for st in resync_stacks:
             st.scheduler.on_serve_start = st.reconciler.resync
+        if shard_set is not None:
+            # Resync requeues land in the global queue; reroute them to
+            # their owning shards BEFORE any pop (the shard loops are
+            # still fenced on the resynced gate at that instant, so no
+            # lane can admit half a gang meanwhile).
+            _rec = stacks[0].reconciler
+
+            def _sharded_serve_start(rec=_rec, ss=shard_set):
+                rec.resync()
+                ss.reroute()
+
+            stacks[0].scheduler.on_serve_start = _sharded_serve_start
+            g_resynced = _rec.resynced
+            for st in stacks[1:]:
+                st.scheduler.fence_fn = g_resynced.is_set
 
     _install_stop_handlers(stop)
 
@@ -230,6 +275,17 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             # overwriting fence_fn directly would drop the health half.
             if federation is not None:
                 federation.set_leader_gate(elector.is_leader)
+            elif shard_set is not None:
+                # Per-shard fences compose the lease with the global
+                # lane's resync gate (a promoted replica's shards must
+                # not bind before ITS resync ran).
+                g_resynced = stacks[0].reconciler.resynced
+                stacks[0].scheduler.fence_fn = elector.is_leader
+                for st in stacks[1:]:
+                    st.scheduler.fence_fn = (
+                        lambda: elector.is_leader()
+                        and g_resynced.is_set()
+                    )
             else:
                 for st in stacks:
                     st.scheduler.fence_fn = elector.is_leader
@@ -278,11 +334,20 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             threading.Thread(
                 target=st.scheduler.serve_forever,
                 args=(stop,),
-                name=f"scheduler-{st.informer.scheduler_name}",
+                name=(
+                    f"scheduler-{st.scheduler.shard}"
+                    if st.scheduler.shard is not None
+                    else f"scheduler-{st.informer.scheduler_name}"
+                ),
                 daemon=True,
             )
             for st in stacks[1:]
         ]
+        # Sharded mode: the background repair loops (reconciler,
+        # rebalancer, node health) run on the GLOBAL lane only — its
+        # informer sees the whole fleet; per-shard copies would each
+        # repair (and fight over) the same gangs.
+        bg_stacks = stacks[:1] if shard_set is not None else stacks
         # Background drift reconciler: repairs leaked reservations, ghost
         # bindings, and stranded Permit waits while serving. Started here
         # — with (or after) leadership — never on a standby, whose
@@ -296,7 +361,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                     name=f"reconciler-{st.informer.scheduler_name}",
                     daemon=True,
                 )
-                for st in stacks
+                for st in bg_stacks
             )
         # Goodput-driven rebalancer: background ICI defragmentation,
         # priority preemption, elastic resize — one thread per stack,
@@ -312,7 +377,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                     name=f"rebalance-{st.informer.scheduler_name}",
                     daemon=True,
                 )
-                for st in stacks
+                for st in bg_stacks
             )
         # Node health monitor: silence ladder + gang-whole repair of
         # DOWN nodes — one thread per stack, leadership-gated like the
@@ -329,7 +394,19 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                     name=f"nodehealth-{st.informer.scheduler_name}",
                     daemon=True,
                 )
-                for st in stacks
+                for st in bg_stacks
+            )
+        # Shard-set maintenance: the attempts-based rescue backstop
+        # (starved work to the global lane); reroutes ride the
+        # structural-event watcher registered at build time.
+        if shard_set is not None:
+            extra_threads.append(
+                threading.Thread(
+                    target=shard_set.run_forever,
+                    args=(stop,),
+                    name="shard-maintenance",
+                    daemon=True,
+                )
             )
         # Federation control loop: health probes, rejoin resyncs, and
         # spillover migration — ONE background thread, so degradation
@@ -524,9 +601,10 @@ def _run_explain(argv: "list[str]") -> int:
     if data.get("last_wall_unix"):
         dt = datetime.datetime.fromtimestamp(data["last_wall_unix"])
         age = f" (last verdict {dt.isoformat(sep=' ', timespec='seconds')})"
+    shard = f" [shard {data['shard']}]" if data.get("shard") else ""
     print(
         f"{data['key']}: {data['kind']} after {data['attempts']} "
-        f"attempt(s){age}"
+        f"attempt(s){age}{shard}"
     )
     print(f"  last: {data['last_message']}")
     if data.get("members"):

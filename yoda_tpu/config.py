@@ -301,6 +301,17 @@ class SchedulerConfig:
     # with a why-pending verdict until capacity frees. 0 = unlimited.
     tenant_quota_chips: int = 0
     tenant_quota_hbm_gib: float = 0.0
+    # Scheduler shard-out (framework/shards.py, docs/OPERATIONS.md
+    # sharding runbook): partition the node fleet by ICI slice/pool
+    # across this many INDEPENDENT serve loops (rendezvous-hashed
+    # slice->shard assignment; each shard runs its own queue, resident
+    # fleet state, and bind executor), sharing one ChipAccountant
+    # through an optimistic claim->validate->commit protocol. Gangs no
+    # single shard can host fall back to a serialized global lane.
+    # 1 (default) = today's single serve loop, the staging/commit
+    # machinery entirely off. Incompatible with `profiles` (each shard
+    # serves the base profile) and with federated mode.
+    shard_count: int = 1
     # Additional profiles (upstream KubeSchedulerConfiguration profiles):
     # each entry inherits every unspecified key from the base config and
     # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
@@ -718,6 +729,20 @@ class SchedulerConfig:
             raise ValueError(
                 "tenant_quota_* requires tenant_fairness: true (quotas "
                 "are enforced by the tenant-aware queue)"
+            )
+        if (
+            isinstance(cfg.shard_count, bool)
+            or not isinstance(cfg.shard_count, int)
+            or not 1 <= cfg.shard_count <= 64
+        ):
+            raise ValueError(
+                "shard_count must be an int in [1, 64] (1 = single serve "
+                f"loop, sharding off), got {cfg.shard_count!r}"
+            )
+        if cfg.shard_count > 1 and cfg.profiles:
+            raise ValueError(
+                "shard_count > 1 is incompatible with profiles (every "
+                "shard serves the base profile; run profiles unsharded)"
             )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)

@@ -10,12 +10,29 @@ real RLock because binding and Permit approval run off the cycle thread.
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass
 from typing import Protocol, runtime_checkable
 
 
 @runtime_checkable
 class StateData(Protocol):
     def clone(self) -> "StateData": ...
+
+
+# Scheduler shard-out (framework/shards.py): the cycle's owning shard,
+# written by a sharded Scheduler at cycle start and read by the shared
+# ChipAccountant's Reserve hook — a claim made under a shard tag is
+# STAGED (pending the optimistic commit validation) instead of final.
+# Absent on unsharded stacks, so shard_count=1 never stages anything.
+SHARD_STATE_KEY = "yoda-shard/id"
+
+
+@dataclass(frozen=True)
+class ShardTag:
+    shard: str
+
+    def clone(self) -> "ShardTag":
+        return self
 
 
 class CycleState:

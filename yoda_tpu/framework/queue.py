@@ -55,7 +55,11 @@ class QueuedPodInfo:
     unschedulable_message: str = ""
 
     def backoff_seconds(self) -> float:
-        return min(INITIAL_BACKOFF_S * (2 ** max(self.attempts - 1, 0)), MAX_BACKOFF_S)
+        # Exponent capped: a chronically-retried entry (forced drain
+        # loops can push attempts into the thousands) must saturate at
+        # MAX_BACKOFF_S, not overflow float range at 2**1024.
+        exp = min(max(self.attempts - 1, 0), 10)
+        return min(INITIAL_BACKOFF_S * (2 ** exp), MAX_BACKOFF_S)
 
 
 class _HeapItem:
@@ -389,6 +393,25 @@ class SchedulingQueue:
         for qpi in out:
             qpi.attempts += 1
         return out
+
+    def all_entries(self) -> "list[tuple[PodSpec, int]]":
+        """Every queued (pod, attempts) across the three pools (one
+        locked sweep) — the shard-set's reroute pass walks this to find
+        entries whose owning lane changed with the fleet, and its rescue
+        pass to find work a shard has repeatedly failed to place (hand
+        it to the global lane, which sees the whole fleet)."""
+        with self._lock:
+            out: "list[tuple[PodSpec, int]]" = []
+            for heap in self._active.values():
+                out.extend(
+                    (item.qpi.pod, item.qpi.attempts) for item in heap
+                )
+            out.extend((qpi.pod, qpi.attempts) for _, _, qpi in self._backoff)
+            out.extend(
+                (qpi.pod, qpi.attempts)
+                for qpi in self._unschedulable.values()
+            )
+            return out
 
     def find(self, uid: str) -> "PodSpec | None":
         """The queued spec for a pod uid, wherever it is parked (active /

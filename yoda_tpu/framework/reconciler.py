@@ -365,6 +365,20 @@ class Reconciler:
             self.accountant.release(uid)
             report.leaked_reservations += 1
 
+        # Shard-commit residue (scheduler shard-out): a claim still
+        # STAGED whose pod cluster truth shows BOUND means the staging
+        # shard died between the bind landing and its commit — truth
+        # outranks the optimistic protocol, so finalize it (a staged
+        # claim for a pod that is gone releases through the leaked-claim
+        # path above; one that is merely unbound keeps its in-flight
+        # staging — its own commit or rollback is still coming).
+        staged = getattr(self.accountant, "staged_uids", None)
+        if staged:
+            bound_uids = {p.uid for p in pods if p.node_name}
+            for uid in staged():
+                if uid in bound_uids:
+                    self.accountant.commit_residue(uid)
+
         # Adopted gangs past their window and still partial: roll back.
         now = self.clock()
         gangs = self._gang_truth(pods)

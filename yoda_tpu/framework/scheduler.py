@@ -19,7 +19,11 @@ log = logging.getLogger("yoda_tpu.scheduler")
 
 from yoda_tpu.api.requests import gang_name_of
 from yoda_tpu.api.types import PodSpec
-from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.cyclestate import (
+    SHARD_STATE_KEY,
+    CycleState,
+    ShardTag,
+)
 from yoda_tpu.framework.interfaces import (
     Code,
     MAX_NODE_SCORE,
@@ -151,6 +155,18 @@ class Scheduler:
         # serving on un-resynced state risks double-placement, so the
         # process fails closed and restarts into standby.
         self.on_serve_start: "Callable[[], None] | None" = None
+        # Scheduler shard-out (framework/shards.py): when this loop is one
+        # of N parallel shards, `shard` names it (cycles are tagged so the
+        # shared accountant STAGES their claims) and `commit_fn`
+        # (ChipAccountant.commit_staged) is the optimistic
+        # claim->validate->commit point — singletons validate immediately
+        # before their bind write; a gang's cohort validates once every
+        # member's bind has landed (_flush_shard_commits), rolling the
+        # gang back whole through the transactional unbind path on a
+        # conflict. Both None (the default) = today's unsharded path,
+        # nothing staged, nothing committed.
+        self.shard: "str | None" = None
+        self.commit_fn: "Callable[[list], tuple[bool, str]] | None" = None
         self._search_rotor = 0
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
@@ -256,6 +272,11 @@ class Scheduler:
                 self.metrics.attempts.inc(result="gone")
             return r
         state = CycleState()
+        if self.shard is not None:
+            # Tag the cycle so the shared accountant stages (rather than
+            # finalizes) this cycle's Reserve claims for the optimistic
+            # commit validation.
+            state.write(SHARD_STATE_KEY, ShardTag(self.shard))
         snapshot = self.snapshot_fn()
         timer = PhaseTimer(self.clock)
         feasible_count = 0
@@ -327,18 +348,19 @@ class Scheduler:
                 # timer.phases_ms is handed over as-is (the timer dies
                 # with this cycle) — building per-phase attr keys here
                 # costs more than the whole record append.
+                cycle_attrs = {
+                    "pod": pod.key,
+                    "outcome": outcome,
+                    "node": node or "",
+                    "message": message[:200],
+                    "phases_ms": timer.phases_ms,
+                }
+                if self.shard is not None:
+                    # Shard spans (ISSUE 14): which serve loop ran this
+                    # cycle — the trace-side half of explain's shard tag.
+                    cycle_attrs["shard"] = self.shard
                 cycle_id = tracer.add(
-                    subject,
-                    "cycle",
-                    t0=t0,
-                    t1=now,
-                    attrs={
-                        "pod": pod.key,
-                        "outcome": outcome,
-                        "node": node or "",
-                        "message": message[:200],
-                        "phases_ms": timer.phases_ms,
-                    },
+                    subject, "cycle", t0=t0, t1=now, attrs=cycle_attrs,
                 )
                 if outcome == "waiting":
                     tracer.add(
@@ -370,6 +392,7 @@ class Scheduler:
                             if not s.success
                         }
                         or None,
+                        shard=self.shard,
                     )
                 elif outcome == "bound":
                     self.metrics.pending.resolve(pod.key, gang=gang)
@@ -576,6 +599,21 @@ class Scheduler:
                 message="scheduler fenced (not leader); bind aborted before "
                 "the API write",
             )
+        if self.commit_fn is not None:
+            # Optimistic shard commit, singleton form: validate this
+            # cycle's staged claim at the shared accountant BEFORE the
+            # bind write — a conflict (another shard's earlier-staged
+            # claim owns the chips) costs one unreserve + requeue, never
+            # an API write to roll back. The fence check above dominates
+            # this commit (yodalint fence-before-write).
+            ok, why = self.commit_fn([pod.uid])
+            if not ok:
+                self.framework.run_unreserve(state, pod, node_name)
+                return done(
+                    "unschedulable",
+                    node=node_name,
+                    message=f"shard commit conflict: {why}",
+                )
         st = self.framework.run_bind(state, pod, node_name)
         if not st.success:
             self.framework.run_unreserve(state, pod, node_name)
@@ -628,6 +666,7 @@ class Scheduler:
         finally:
             try:
                 self._flush_deferred_rollbacks()
+                self._flush_shard_commits()
             finally:
                 self._signal_activity()
 
@@ -643,6 +682,69 @@ class Scheduler:
                 continue
             for spec, node, why in hook(self.framework):
                 self._rollback_bound(spec, node, None, why)
+
+    def _flush_shard_commits(self) -> None:
+        """Optimistic shard commit, gang form: validate the staged claims
+        of every release cohort whose binds have FULLY landed (the gang
+        plugin arms ``collect_commits`` on the last settle). Runs on
+        whichever thread settled last — exactly the deferred-rollback
+        discipline. A validation conflict (or a fence flip: a new leader
+        owns the truth now) makes the shard the LOSER: every landed
+        member's bind rolls back through the transactional unbind path
+        and the gang requeues whole, counted in
+        ``yoda_shard_commit_rollbacks_total``."""
+        if self.commit_fn is None:
+            return
+        for p in self.framework.permit_plugins:
+            hook = getattr(p, "collect_commits", None)
+            if hook is None:
+                continue
+            for gang_name, cohort in hook(self.framework):
+                fenced = self._fenced()
+                if fenced:
+                    ok, why = False, (
+                        "scheduler fenced (lost leadership) before the "
+                        "shard commit; rolling the gang back"
+                    )
+                else:
+                    ok, why = self.commit_fn(
+                        [spec.uid for spec, _host in cohort]
+                    )
+                if ok:
+                    continue
+                why = f"gang {gang_name}: shard commit conflict: {why}"
+                log.warning(
+                    "%s — rolling back %d landed member(s)",
+                    why, len(cohort),
+                )
+                if self.metrics is not None:
+                    self.metrics.shard_rollbacks.inc(len(cohort))
+                    if self.metrics.tracer.enabled:
+                        self.metrics.tracer.add(
+                            f"gang:{gang_name}", "shard-commit-conflict",
+                            attrs={
+                                "members": len(cohort),
+                                "shard": self.shard or "",
+                                "message": why[:200],
+                            },
+                        )
+                    self.metrics.pending.record(
+                        gang_name,
+                        kind="unschedulable",
+                        message=why,
+                        shard=self.shard,
+                    )
+                # EVERY membership drops BEFORE any member requeues: a
+                # rolled-back member re-admitted while siblings still
+                # read as bound would find a satisfied-looking barrier
+                # and release alone — the split gang this path must
+                # never produce.
+                drop = getattr(p, "drop_membership", None)
+                if drop is not None:
+                    for spec, _host in cohort:
+                        drop(spec)
+                for spec, host in cohort:
+                    self._rollback_bound(spec, host, None, why)
 
     def _do_permit_resolved(self, wp: WaitingPod, status: Status) -> None:
         pod = wp.pod
@@ -713,6 +815,7 @@ class Scheduler:
                 kind="permit-rejected",
                 message=status.message,
                 gang=gang_name_of(pod.labels),
+                shard=self.shard,
             )
             if self.metrics.tracer.enabled:
                 self.metrics.tracer.add(

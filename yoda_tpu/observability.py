@@ -530,6 +530,20 @@ class SchedulingMetrics:
             "stream; the amortization win is the mean of this series)",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
         )
+        # Scheduler shard-out (ISSUE 14, docs/OPERATIONS.md sharding
+        # runbook): landed binds rolled back because a gang's optimistic
+        # shard commit lost its validation (another shard's earlier-staged
+        # claim owned the chips) — every one lands through the
+        # transactional unbind path and the gang requeues whole. The
+        # companion commit/conflict totals read the shared accountant and
+        # are registered in standalone.build_stack (accumulator pattern);
+        # the per-shard queue/cycle/bind gauges live there too.
+        self.shard_rollbacks = r.counter(
+            "yoda_shard_commit_rollbacks_total",
+            "Landed gang-member binds rolled back through the "
+            "transactional unbind path after a shard commit conflict "
+            "(the losing shard requeues the gang whole)",
+        )
         self.tenant_quota_parks = r.counter(
             "yoda_tenant_quota_parks_total",
             "Queue entries parked by per-tenant quota admission (they "

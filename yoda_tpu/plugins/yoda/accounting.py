@@ -25,7 +25,7 @@ from dataclasses import dataclass
 from yoda_tpu.api.requests import LabelParseError, pod_request
 from yoda_tpu.api.types import PodSpec
 from yoda_tpu.cluster.fake import Event
-from yoda_tpu.framework.cyclestate import CycleState
+from yoda_tpu.framework.cyclestate import SHARD_STATE_KEY, CycleState
 from yoda_tpu.framework.interfaces import ReservePlugin, Status
 from yoda_tpu.plugins.yoda.filter_plugin import get_request
 
@@ -34,6 +34,13 @@ from yoda_tpu.plugins.yoda.filter_plugin import get_request
 class _Claim:
     node: str
     chips: int
+    # Scheduler shard-out (framework/shards.py): a claim made by a shard's
+    # cycle is STAGED — charged into _in_use immediately (its own shard's
+    # later cycles must see it) but pending the optimistic commit
+    # validation. ``shard`` is None for committed/legacy claims; ``seq``
+    # is the global stage order (first-staged wins at validation).
+    shard: "str | None" = None
+    seq: int = 0
 
 
 class ChipAccountant(ReservePlugin):
@@ -60,12 +67,31 @@ class ChipAccountant(ReservePlugin):
         # copying the whole map per dispatch.
         self._epoch = 0
         self._changes: deque[tuple[int, str]] = deque(maxlen=65536)
+        # Optimistic claim->validate->commit (scheduler shard-out, ISSUE
+        # 14): the shared commit point N parallel serve loops validate
+        # their staged claims against. _staged indexes the (few) in-flight
+        # staged claims by uid; _stage_seq orders them (the validation's
+        # precedence: a later-staged claim loses to an earlier one on an
+        # oversubscribed node). track_capacity flips on in sharded
+        # assemblies only — it makes handle() maintain per-node healthy
+        # chip capacities from the TPU CR stream so commit_staged can
+        # validate without touching any other component's lock (the lock
+        # DAG forbids informer reads under the accountant lock).
+        self._staged: set[str] = set()
+        self._stage_seq = 0
+        self.track_capacity = False
+        self._capacity: dict[str, int] = {}   # node -> healthy chips
+        self.commit_commits = 0               # committed stage groups
+        self.commit_conflicts = 0             # commits refused (validation)
 
     # --- ReservePlugin ---
 
     def reserve(self, state: CycleState, pod: PodSpec, node_name: str) -> Status:
         req = get_request(state)
-        self._claim(pod.uid, node_name, req.effective_chips)
+        shard = None
+        if state.contains(SHARD_STATE_KEY):
+            shard = state.read(SHARD_STATE_KEY).shard
+        self._claim(pod.uid, node_name, req.effective_chips, shard=shard)
         return Status.ok()
 
     def unreserve(self, state: CycleState, pod: PodSpec, node_name: str) -> None:
@@ -74,6 +100,18 @@ class ChipAccountant(ReservePlugin):
     # --- lifecycle (watch events) ---
 
     def handle(self, event: Event) -> None:
+        if event.kind == "TpuNodeMetrics" and self.track_capacity:
+            # Sharded mode only: per-node healthy chip capacity, the
+            # commit validator's denominator. Maintained here (the
+            # accountant is already a watcher) instead of reading the
+            # informer at commit time — the lock-ordering DAG forbids an
+            # informer acquisition under the accountant lock.
+            tpu = event.obj
+            with self._lock:
+                if event.type == "deleted":
+                    self._capacity.pop(tpu.name, None)
+                else:
+                    self._capacity[tpu.name] = len(tpu.healthy_chips())
         if event.kind != "Pod":
             return
         pod: PodSpec = event.obj  # type: ignore[assignment]
@@ -115,15 +153,27 @@ class ChipAccountant(ReservePlugin):
         self._epoch += 1
         self._changes.append((self._epoch, node))
 
-    def _claim(self, uid: str, node: str, chips: int) -> None:
+    def _claim(
+        self, uid: str, node: str, chips: int, *, shard: "str | None" = None
+    ) -> None:
         with self._lock:
             existing = self._claims.get(uid)
             if existing is not None:
                 if existing.node == node:
-                    return  # reserve->bind transition: single claim
+                    # reserve->bind transition: single claim. A STAGED
+                    # claim stays staged through its own bind's watch
+                    # event — only commit_staged (validation) or the
+                    # reconciler's residue pass finalizes it.
+                    return
                 self._in_use[existing.node] -= existing.chips
                 self._note(existing.node)
-            self._claims[uid] = _Claim(node, chips)
+                self._staged.discard(uid)
+            seq = 0
+            if shard is not None:
+                self._stage_seq += 1
+                seq = self._stage_seq
+                self._staged.add(uid)
+            self._claims[uid] = _Claim(node, chips, shard=shard, seq=seq)
             self._in_use[node] = self._in_use.get(node, 0) + chips
             self._note(node)
 
@@ -131,10 +181,86 @@ class ChipAccountant(ReservePlugin):
         with self._lock:
             claim = self._claims.pop(uid, None)
             if claim is not None:
+                self._staged.discard(uid)
                 self._in_use[claim.node] = max(
                     self._in_use.get(claim.node, 0) - claim.chips, 0
                 )
                 self._note(claim.node)
+
+    # --- optimistic claim -> validate -> commit (scheduler shard-out) ---
+
+    def commit_staged(self, uids) -> "tuple[bool, str]":
+        """Atomically validate-and-commit the STAGED claims of ``uids``
+        (one pod, or a whole gang's release cohort) — the shared commit
+        point of the sharded serve loops. Validation is first-staged-wins
+        under per-node capacity: a claim is valid when its node's total
+        usage, counting committed claims and staged claims staged NO
+        LATER than it, fits the node's healthy-chip capacity; a later
+        claim racing the same chips fails its own commit instead. All
+        claims commit or none do (the caller rolls a refused gang back
+        whole through the transactional unbind path). Claims already
+        committed — or uids with no claim at all — validate vacuously, so
+        unsharded stacks (nothing ever staged) pay one dict probe per
+        uid and the branch below never runs."""
+        with self._lock:
+            mine = [
+                (u, self._claims[u])
+                for u in uids
+                if u in self._claims and self._claims[u].shard is not None
+            ]
+            if not mine:
+                return True, ""
+            staged = [self._claims[u] for u in self._staged]
+            for _u, c in mine:
+                cap = self._capacity.get(c.node)
+                if cap is None:
+                    continue  # capacity unknown (node gone): repair owns it
+                later = sum(
+                    s.chips
+                    for s in staged
+                    if s.node == c.node and s.seq > c.seq
+                )
+                if self._in_use.get(c.node, 0) - later > cap:
+                    self.commit_conflicts += 1
+                    return False, (
+                        f"node {c.node}: {self._in_use.get(c.node, 0)} "
+                        f"chips claimed (net of later stages: "
+                        f"{self._in_use.get(c.node, 0) - later}) > capacity "
+                        f"{cap}; an earlier-staged claim owns the chips"
+                    )
+            for u, c in mine:
+                c.shard = None
+                c.seq = 0
+                self._staged.discard(u)
+            self.commit_commits += 1
+            return True, ""
+
+    def staged_uids(self) -> "dict[str, str]":
+        """uid -> staging shard for every claim still pending commit —
+        the drift reconciler's residue surface: a staged claim whose pod
+        cluster truth shows BOUND is committed (the shard died between
+        the bind landing and its commit), one with no live pod releases
+        through the standard leaked-claim path."""
+        with self._lock:
+            return {
+                u: self._claims[u].shard
+                for u in self._staged
+                if u in self._claims
+            }
+
+    def commit_residue(self, uid: str) -> bool:
+        """Commit ONE staged claim without validation — cluster truth
+        already shows its pod bound (the reconciler's crash-recovery
+        path; truth outranks the optimistic protocol). Returns whether a
+        staged claim was found."""
+        with self._lock:
+            c = self._claims.get(uid)
+            if c is None or c.shard is None:
+                return False
+            c.shard = None
+            c.seq = 0
+            self._staged.discard(uid)
+            return True
 
     def chips_in_use(self, node_name: str) -> int:
         with self._lock:

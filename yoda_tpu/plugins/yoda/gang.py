@@ -140,6 +140,13 @@ class _GangState:
     release_pending: set[str] = field(default_factory=set)
     release_rollbacks: list = field(default_factory=list)  # (spec, host, why)
     rollback_ready: bool = False
+    # Optimistic shard commit (scheduler shard-out, ISSUE 14): armed when
+    # a release cohort FULLY lands (every bind settled, none failed) on a
+    # stack whose gang plugin tracks commits — the scheduler then
+    # validates the cohort's staged claims at the shared accountant and
+    # rolls the gang back whole on a conflict. Never set on unsharded
+    # stacks (track_commits False).
+    commit_ready: bool = False
     # Hosts that died (value: which kinds' deletion marked them — a Node
     # deletion is only cleared by a Node re-add, not by the agent's CR
     # republish, and vice versa). Marked on EVERY gang so a death landing
@@ -192,6 +199,13 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
         # answers "why is this gang parked" with node-level evidence).
         self.tracer = None
         self.pending = None
+        # Scheduler shard-out: which shard this plugin's stack serves
+        # (why-pending verdicts carry it so `explain` names the shard
+        # that parked a gang), and whether release cohorts arm the
+        # optimistic-commit handoff (collect_commits). Both wired by the
+        # sharded assembly only; default = unsharded behavior untouched.
+        self.shard: "str | None" = None
+        self.track_commits = False
         self._lock = threading.RLock()
         self._gangs: dict[str, _GangState] = {}
         self._framework = None
@@ -595,6 +609,7 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             message=msg,
             gang=gs.spec.name,
             node_reasons=reasons,
+            shard=self.shard,
         )
 
     # --- Filter: pin topology-gang members to planned hosts ---
@@ -827,6 +842,15 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                 self._maybe_rollback_ready(gs)
                 return False
             gs.release_bound[wp.pod.key] = wp.node_name
+            if (
+                self.track_commits
+                and not gs.release_pending
+                and gs.release_bound
+            ):
+                # The whole cohort LANDED (this settle was the last and
+                # none failed): hand the cohort to the scheduler's
+                # shard-commit flush for atomic validation.
+                gs.commit_ready = True
             return True
 
     def on_bind_failed(self, framework, wp, status: Status) -> "bool | None":
@@ -908,6 +932,31 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
                     self.on_rollback(w.pod, gang_name, why)
                 w.reject(f"gang {why}")
         return True
+
+    def collect_commits(
+        self, framework
+    ) -> "list[tuple[str, list[tuple[PodSpec, str]]]]":
+        """Framework hook, polled by a SHARDED scheduler after every
+        release settle: the (gang name, [(member spec, host), ...])
+        cohorts whose binds have fully landed and now need the optimistic
+        shard-commit validation at the shared accountant. Each cohort is
+        returned exactly once; the scheduler commits it — or, on a
+        validation conflict, rolls every landed member back through the
+        transactional unbind path and requeues the gang whole."""
+        out: "list[tuple[str, list[tuple[PodSpec, str]]]]" = []
+        with self._lock:
+            for name, gs in self._gangs.items():
+                if not gs.commit_ready:
+                    continue
+                gs.commit_ready = False
+                cohort = [
+                    (gs.specs[key], host)
+                    for key, host in gs.release_bound.items()
+                    if key in gs.specs
+                ]
+                if cohort:
+                    out.append((name, cohort))
+        return out
 
     def collect_rollbacks(self, framework) -> "list[tuple[PodSpec, str, str]]":
         """Framework hook, polled by the scheduler after every release
@@ -1038,6 +1087,27 @@ class GangPlugin(PreFilterPlugin, FilterPlugin, PermitPlugin):
             return
         with self._lock:
             gs = self._gangs.get(gang_name)
+            if not pod.node_name:
+                # Bound -> pending transition: the member was UNBOUND
+                # somewhere else — another lane's commit-conflict
+                # rollback, a repair, a reconciler resync (sharded serve
+                # loops: every lane's plugin watches every gang, so a
+                # rollback executed on one stack must drop the phantom
+                # bound membership on ALL of them, or a rescued member
+                # could satisfy a stale barrier alone and release a
+                # split gang). Members currently WAITING here are not
+                # touched — their own resolution chain owns them.
+                if (
+                    gs is not None
+                    and pod.key in gs.bound
+                    and pod.key not in gs.waiting
+                ):
+                    gs.bound.discard(pod.key)
+                    gs.assigned.pop(pod.key, None)
+                    gs.specs.pop(pod.key, None)
+                    if not gs.bound and not gs.waiting:
+                        self._gangs.pop(gang_name, None)
+                return
             if pod.node_name:
                 # Bound member (bind we initiated, or watch replay after a
                 # scheduler restart): reconstruct membership — unless its

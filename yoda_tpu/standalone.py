@@ -102,11 +102,23 @@ def build_stack(
     scheduler_names: "tuple[str, ...] | None" = None,
     clock=time.monotonic,
     stop_event: "threading.Event | None" = None,
+    shard: "str | None" = None,
+    node_filter_fn=None,
+    pod_route_fn=None,
 ) -> Stack:
     """Build a fully-wired scheduler stack against ``cluster`` (a fresh
     FakeCluster by default). Watchers are registered list-then-watch, so a
     stack built against a populated cluster reconstructs accounting state
     from existing bound pods (scheduler-restart statelessness, SURVEY.md §5).
+
+    ``shard`` (with ``node_filter_fn`` / ``pod_route_fn``) builds the
+    stack as ONE shard of a sharded assembly (build_sharded_stacks): its
+    informer restricts snapshots to the shard's node partition and
+    queues only the shard's routed pods, its scheduler tags cycles with
+    the shard and commits staged claims through the shared accountant's
+    optimistic claim->validate->commit, and its gang plugin arms release
+    cohorts for the commit flush. All default to None = the classic
+    unsharded stack, bit-path-identical to before sharding existed.
     """
     cluster = cluster or FakeCluster()
     config = config or SchedulerConfig()
@@ -164,6 +176,9 @@ def build_stack(
         parallel_release=pipelined,
         bind_executor=bind_executor,
     )
+    if shard is not None:
+        gang.shard = shard
+        gang.track_commits = True
     plugins = default_plugins(
         mode=config.mode,
         weights=config.effective_weights(),
@@ -353,6 +368,63 @@ def build_stack(
         )
     bacc.append(binder)
 
+    # Scheduler shard-out (ISSUE 14): the shared commit point's
+    # commit/conflict totals (lazy sums over the — usually one, shared —
+    # accountant) and the per-shard serve-loop gauges. Families register
+    # on every stack so one scrape schema holds across configurations;
+    # the per-shard series follow the LIVE shard list (a shrunk
+    # shard_count retires its series on the next scrape — the PR 12
+    # bounded-cardinality pattern), and both render empty/zero on
+    # unsharded stacks.
+    cacc = getattr(metrics, "_commit_accountants", None)
+    if cacc is None:
+        cacc = metrics._commit_accountants = []
+        metrics.registry.counter(
+            "yoda_shard_commit_commits_total",
+            "Optimistic shard-commit groups validated and committed at "
+            "the shared accountant (a singleton's pre-bind commit or a "
+            "gang's fully-landed release cohort)",
+            lambda: sum(a.commit_commits for a in cacc),
+        )
+        metrics.registry.counter(
+            "yoda_shard_commit_conflicts_total",
+            "Shard commits REFUSED by validation (an earlier-staged "
+            "claim owned the chips): the losing shard unreserves (or "
+            "rolls landed binds back) and requeues the gang whole",
+            lambda: sum(a.commit_conflicts for a in cacc),
+        )
+    if accountant not in cacc:
+        cacc.append(accountant)
+    sacc = getattr(metrics, "_shard_loops", None)
+    if sacc is None:
+        sacc = metrics._shard_loops = []
+
+        def _per_shard(fn):
+            return lambda: {
+                (("shard", sh),): float(fn(sched, q))
+                for sh, sched, q in sacc
+            }
+
+        metrics.registry.gauge(
+            "yoda_shard_queue_depth",
+            "Queued pods per scheduler shard (active + backoff + parked "
+            "pools of the shard's DRF queue); series follow the live "
+            "shard set",
+            _per_shard(lambda sched, q: len(q)),
+        )
+        metrics.registry.gauge(
+            "yoda_shard_cycles",
+            "Scheduling cycles completed per shard serve loop "
+            "(monotonic; series follow the live shard set)",
+            _per_shard(lambda sched, q: len(sched.stats.results)),
+        )
+        metrics.registry.gauge(
+            "yoda_shard_binds",
+            "Pods bound per shard serve loop (monotonic; series follow "
+            "the live shard set)",
+            _per_shard(lambda sched, q: sched.stats.binds),
+        )
+
     # Bind-pipeline gauge: binds currently in flight on the executor(s)
     # (accumulator pattern, as above — one family, summed over profiles).
     if bind_executor is not None:
@@ -439,6 +511,10 @@ def build_stack(
         scheduler_name=config.scheduler_name,
         on_pod_pending=on_pod_pending,
         on_change_batch=on_change_batch,
+        # Scheduler shard-out: partition-restricted snapshots + one-queue
+        # pod routing (both None on unsharded stacks).
+        node_filter_fn=node_filter_fn,
+        pod_route_fn=pod_route_fn,
         # In-process backends with a PVC surface (FakeCluster.put_pvc)
         # always enforce the minimal volume filter. KubeCluster upgrades
         # the flag at runtime via the "synced" sentinel its PVC watch
@@ -759,6 +835,13 @@ def build_stack(
     binder.fenced_fn = scheduler._fenced
     binder.on_fenced = metrics.fenced_binds.inc
     binder.observe_wall_ms = metrics.bind_wall.observe
+    if shard is not None:
+        # Scheduler shard-out: tag this loop's cycles (the shared
+        # accountant stages their claims) and wire the optimistic commit
+        # point. The per-shard gauges pick the loop up here.
+        scheduler.shard = shard
+        scheduler.commit_fn = accountant.commit_staged
+        sacc.append((shard, scheduler, queue))
     # Same worker-side fence for preemption's evictions: victim selection
     # runs under the cycle lock, the eviction round-trips do not.
     if preemption is not None:
@@ -894,6 +977,355 @@ def build_federation(
         spillover=config.federation_spillover,
         clock=clock,
     )
+
+
+@dataclass
+class ShardSet:
+    """N parallel shard stacks + the serialized global lane over ONE
+    cluster (scheduler shard-out, ISSUE 14). ``stacks[0]`` is the global
+    lane (full-fleet informer — it owns the fleet gauges, the started
+    reconciler/rebalancer/nodehealth loops, and every cross-shard gang);
+    ``stacks[1:]`` are the shards in index order. All share one
+    ChipAccountant (the optimistic commit point) and one metrics
+    registry; each has its OWN cycle lock, queue, bind executor, and
+    partition-restricted resident fleet state — that independence is the
+    whole point."""
+
+    stacks: "list[Stack]"
+    router: object            # framework.shards.ShardRouter
+    shard_map: object         # framework.shards.ShardMap
+    accountant: ChipAccountant
+    metrics: SchedulingMetrics
+    config: SchedulerConfig
+
+    @property
+    def global_stack(self) -> Stack:
+        return self.stacks[0]
+
+    @property
+    def shard_stacks(self) -> "list[Stack]":
+        return self.stacks[1:]
+
+    def reroute(self) -> int:
+        """Move queued entries whose owning lane is not the router's
+        answer: a shard that lost its last feasible slice hands its
+        parked gangs to a lane that can still host them, and a
+        GLOBAL-lane entry that belongs to a shard (the reconciler's
+        resync/repair requeues land in the global stack's queue, while
+        never-bound siblings replay into their shard's — a gang must
+        never sit split across two lanes' barriers) moves home. Global
+        entries with attempts > 0 stay put: those are rescue_starved's
+        deliberate fallbacks, and rerouting them back to the shard that
+        starved them would ping-pong forever. Called from the shard
+        set's structural-event watcher and the rescue pass; cheap when
+        queues are shallow. Returns entries moved."""
+        from yoda_tpu.framework.shards import GLOBAL_LANE
+
+        lanes = {GLOBAL_LANE: self.stacks[0]}
+        for st in self.stacks[1:]:
+            lanes[st.scheduler.shard] = st
+        from yoda_tpu.framework.queue import QueuedPodInfo
+
+        moved = 0
+        for st in self.stacks:
+            own = st.scheduler.shard
+            for pod, attempts in st.queue.all_entries():
+                if own == GLOBAL_LANE and attempts > 0:
+                    continue  # rescued work: the global lane owns it
+                want = self.router.route(pod)
+                if want == own:
+                    continue
+                target = lanes.get(want)
+                if target is None or not st.queue.remove(pod.uid):
+                    continue
+                # Attempts PRESERVED across the move: resetting them
+                # would erase the rescue marker (global entries with
+                # attempts > 0 stay put) and ping-pong a rescued entry
+                # between the global lane and a full home shard forever.
+                target.queue.readd(
+                    QueuedPodInfo(pod=pod, attempts=attempts)
+                )
+                moved += 1
+        return moved
+
+    def rescue_starved(self, *, min_attempts: int = 3) -> int:
+        """Hand work a shard has REPEATEDLY failed to place to the
+        global lane. Static routing is capacity-shape feasibility only —
+        a gang can route to a shard whose slices are then occupied by
+        earlier work — so the dynamic half of the contract lives here:
+        a gang whose members are ALL queued (never mid-Permit: taking
+        half a gang would split its barrier across lanes) after
+        ``min_attempts`` local failures migrates whole via the
+        federation-spillover take_gang primitive; starved singletons
+        move individually. The global lane sees the whole fleet, so no
+        workload is ever wedged behind a partition boundary. Returns
+        entries moved."""
+        from yoda_tpu.api.requests import (
+            LabelParseError,
+            gang_name_of,
+            pod_request,
+        )
+        from yoda_tpu.framework.queue import QueuedPodInfo
+
+        g = self.stacks[0]
+        # Misrouted entries first (a resync/repair requeue in the global
+        # queue whose siblings replay into a shard's): a gang must be
+        # whole in ONE lane before starvation can even be judged.
+        moved = self.reroute()
+        for st in self.shard_stacks:
+            for name, (count, attempts) in st.queue.pending_gangs().items():
+                if attempts < min_attempts:
+                    continue
+                probe = next(
+                    (
+                        pod
+                        for pod, _a in st.queue.all_entries()
+                        if gang_name_of(pod.labels) == name
+                    ),
+                    None,
+                )
+                if probe is None:
+                    continue
+                try:
+                    spec = pod_request(probe).gang
+                except LabelParseError:
+                    continue
+                if spec is None or count < spec.size:
+                    continue  # members mid-flight: never split the gang
+                taken = st.queue.take_gang(name)
+                for qpi in taken:
+                    g.queue.readd(qpi)
+                moved += len(taken)
+            for pod, attempts in st.queue.all_entries():
+                if attempts < min_attempts or gang_name_of(pod.labels):
+                    continue
+                if st.queue.remove(pod.uid):
+                    # Attempts preserved: they ARE the rescue marker
+                    # (reroute leaves global entries with attempts > 0
+                    # alone — see reroute's ping-pong note).
+                    g.queue.readd(
+                        QueuedPodInfo(pod=pod, attempts=attempts)
+                    )
+                    moved += 1
+        return moved
+
+    def run_until_idle(self, *, max_wall_s: float = 30.0) -> None:
+        """Drive every lane to idle concurrently (test/bench driver; the
+        production loops are cli-started serve_forever threads plus the
+        maintenance loop). Threads are required — a losing shard's
+        rollback requeues work that another lane must then pick up.
+        Starved work is rescued to the global lane between drain rounds,
+        so a capacity-imbalanced routing never wedges the drain."""
+        deadline = time.monotonic() + max_wall_s
+        last_binds = -1
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            # Rescue BEFORE draining too (mirrors the production
+            # serve-start ordering): a resync/repair requeue sitting
+            # misrouted in the global queue must move home before any
+            # lane can admit half a gang to a Permit barrier.
+            self.rescue_starved(min_attempts=1)
+            threads = [
+                threading.Thread(
+                    target=st.scheduler.run_until_idle,
+                    kwargs={"max_wall_s": remaining},
+                    name=f"shard-drain-{st.scheduler.shard}",
+                    daemon=True,
+                )
+                for st in self.stacks
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=max(deadline - time.monotonic(), 0.0) + 5.0)
+            moved = self.rescue_starved(min_attempts=1)
+            # Cross-lane reactivation (the set-level fixed point): lane
+            # A binding — or rolling reservations back — changes what
+            # lane B's parked entries could fit, but no watch event
+            # carries "reservations moved"; each scheduler's own
+            # fixed-point check only sees its own binds. While ANY lane
+            # made progress this round, re-arm every parked queue and
+            # drain again; idle means no moves AND no new binds with
+            # work still parked.
+            total_binds = sum(
+                st.scheduler.stats.binds for st in self.stacks
+            )
+            parked = any(st.queue.has_parked() for st in self.stacks)
+            if moved == 0 and (
+                not parked or total_binds == last_binds
+            ):
+                return
+            last_binds = total_binds
+            if parked:
+                for st in self.stacks:
+                    if st.queue.has_parked():
+                        st.queue.move_all_to_active(force=True)
+
+    def run_forever(
+        self, stop: "threading.Event", *, period_s: float = 5.0
+    ) -> None:
+        """The shard-set maintenance loop (cli thread): periodically
+        rescue starved work to the global lane. Reroutes ride the
+        structural-event watcher; this loop is the attempts-based
+        backstop, cheap when queues are shallow."""
+        last_binds = -1
+        while not stop.is_set():
+            try:
+                self.rescue_starved()
+                # Cross-lane reactivation tick: another lane's binds or
+                # rollbacks change what this lane's parked entries could
+                # fit, and no watch event carries reservation movement.
+                # Only when binds advanced since the last tick (an idle
+                # fleet pays nothing), and through the event cutoff
+                # (never force) so chronic unschedulables stay bounded
+                # by their own backoff.
+                total_binds = sum(
+                    st.scheduler.stats.binds for st in self.stacks
+                )
+                if total_binds != last_binds:
+                    last_binds = total_binds
+                    for st in self.stacks:
+                        if st.queue.has_parked():
+                            st.queue.move_all_to_active()
+            except Exception:  # noqa: BLE001 — maintenance must not die
+                import logging
+
+                logging.getLogger("yoda_tpu.shards").exception(
+                    "shard-set rescue pass failed"
+                )
+            stop.wait(period_s)
+
+    def close(self) -> None:
+        for st in self.stacks:
+            st.gang.close()
+            if st.ingestor is not None:
+                st.ingestor.stop()
+
+
+def build_sharded_stacks(
+    cluster=None,
+    config: SchedulerConfig | None = None,
+    *,
+    clock=time.monotonic,
+    stop_event: "threading.Event | None" = None,
+    shard_map=None,
+) -> ShardSet:
+    """Assemble the sharded scheduler: ``config.shard_count`` parallel
+    serve loops over rendezvous-partitioned ICI slices/pools, plus the
+    serialized global lane, sharing one ChipAccountant through the
+    optimistic claim->validate->commit protocol (every lane — global
+    included — stages its Reserve claims and validates at commit; a
+    losing gang rolls back through the transactional unbind path and
+    requeues whole). ``shard_map`` overrides the default
+    ``ShardMap(config.shard_count)`` — the cross_shard_contention chaos
+    mode passes one with a pinned-open overlap window."""
+    from yoda_tpu.framework.shards import (
+        GLOBAL_LANE,
+        ShardMap,
+        ShardRouter,
+        shard_name,
+    )
+
+    cluster = cluster or FakeCluster()
+    config = config or SchedulerConfig()
+    shard_map = shard_map or ShardMap(config.shard_count)
+    router = ShardRouter(shard_map)
+    # The router's fleet registry must be current before any informer
+    # routes a pod from the same event batch: register it FIRST (watchers
+    # run in registration order), replay included.
+    cluster.add_watcher(router.observe, batch_fn=router.observe_batch)
+    # One accountant across every lane — the commit point. Registered
+    # before any stack's informer (build_profile_stacks discipline:
+    # reservation releases precede the informer's view of the same
+    # event); capacity tracking feeds the commit validator.
+    accountant = ChipAccountant(scheduler_name=config.scheduler_name)
+    accountant.track_capacity = True
+    cluster.add_watcher(accountant.handle)
+    shared_metrics = _metrics_from_config(config, clock)
+    # Global lane first: full fleet view (it owns the fleet gauges), pods
+    # no shard can host, and the only started background repair loops.
+    stacks = [
+        build_stack(
+            cluster=cluster,
+            config=config,
+            accountant=accountant,
+            metrics=shared_metrics,
+            clock=clock,
+            stop_event=stop_event,
+            shard=GLOBAL_LANE,
+            pod_route_fn=lambda pod: router.route(pod) == GLOBAL_LANE,
+        )
+    ]
+    for i in range(config.shard_count):
+        name = shard_name(i)
+        stacks.append(
+            build_stack(
+                cluster=cluster,
+                config=config,
+                accountant=accountant,
+                metrics=shared_metrics,
+                clock=clock,
+                stop_event=stop_event,
+                shard=name,
+                node_filter_fn=shard_map.node_filter(i),
+                pod_route_fn=(
+                    lambda pod, _n=name: router.route(pod) == _n
+                ),
+            )
+        )
+    # Cross-lane pending-placement visibility (the build_profile_stacks
+    # contract): a gang member of ANY lane parked at Permit is invisible
+    # in snapshots, and every other lane's evaluators must see it.
+    from yoda_tpu.plugins.yoda import YodaBatch
+    from yoda_tpu.plugins.yoda.filter_plugin import YodaPreFilter
+
+    gangs = [st.gang for st in stacks]
+
+    def all_pending() -> list:
+        out: list = []
+        for g in gangs:
+            out.extend(g.pending_placements())
+        return out
+
+    for st in stacks:
+        for p in st.framework.pre_filter_plugins:
+            if isinstance(p, YodaPreFilter):
+                p.pending_fn = all_pending
+        for p in st.framework.batch_plugins:
+            if isinstance(p, YodaBatch):
+                p.pending_fn = all_pending
+    shard_set = ShardSet(
+        stacks=stacks,
+        router=router,
+        shard_map=shard_map,
+        accountant=accountant,
+        metrics=shared_metrics,
+        config=config,
+    )
+
+    # Structural fleet changes re-route queued entries whose owning lane
+    # changed (and keep the router's aggregates fresh). Registered LAST:
+    # by the time it fires, every informer has applied the same batch.
+    def on_fleet_event(event) -> None:
+        if event.kind in ("TpuNodeMetrics", "Node") and event.type in (
+            "added", "deleted",
+        ):
+            shard_set.reroute()
+
+    def on_fleet_batch(events) -> None:
+        if any(
+            e.kind in ("TpuNodeMetrics", "Node")
+            and e.type in ("added", "deleted")
+            for e in events
+        ):
+            shard_set.reroute()
+
+    cluster.add_watcher(
+        on_fleet_event, replay=False, batch_fn=on_fleet_batch
+    )
+    return shard_set
 
 
 def build_profile_stacks(

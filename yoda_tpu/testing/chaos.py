@@ -89,6 +89,15 @@ _DEFAULT_KINDS = {
     "node_death": ("death",),
     "heartbeat_stop": ("stop", "flap"),
     "chip_degrade": ("degrade",),
+    # Scheduler shard-out fault mode (cross_shard_contention, ISSUE 14):
+    # a scheduled shard_crash fault kills the whole sharded "process" on
+    # its Nth bind — kind mid_commit lands the bind FIRST (the worst
+    # case: a gang's member binds reached the API, the staged claims and
+    # the pending commit die with the process) — and the sweep respawns
+    # a fresh ShardSet over the same backing cluster whose global-lane
+    # resync (PR 5) must recover the half-committed state. Mechanically
+    # this rides the crash machinery (ChaosCluster._maybe_crash).
+    "shard_crash": ("mid_commit",),
 }
 
 
@@ -332,12 +341,21 @@ class ChaosCluster:
             raise SchedulerCrashed(f"scheduler process is dead: {detail}")
 
     def _maybe_crash(self, pod_key: str, node_name: str) -> None:
-        if not self.plan.has_op("crash"):
+        op = None
+        if self.plan.has_op("crash"):
+            op = "crash"
+        elif self.plan.has_op("shard_crash"):
+            # cross_shard_contention mode: same crash machinery, sharded
+            # flavor — mid_commit lands the bind first, so the staged
+            # claims and the pending shard commit die with the process
+            # while the write survives on the cluster.
+            op = "shard_crash"
+        if op is None:
             return
-        f = self.plan.next("crash")
+        f = self.plan.next(op)
         if f is None:
             return
-        if f.kind == "after_bind":
+        if f.kind in ("after_bind", "mid_commit"):
             # The write reached the API; the process died before the
             # result could update any in-memory state.
             self._inner.bind_pod(pod_key, node_name)
@@ -526,6 +544,115 @@ def maybe_node_fault(
             agent.fail_chips(name, [0])
         fired.append((op, f.kind, name))
     return fired
+
+
+def build_cross_shard_contention(
+    seed: int,
+    *,
+    shards: int = 2,
+    contended_slices: int = 1,
+    slice_topology: "tuple[int, int, int]" = (2, 2, 1),
+    hosts: int = 2,
+    chips: int = 8,
+    plan: "ChaosPlan | None" = None,
+    config=None,
+    bind_latency_s: float = 0.0,
+):
+    """The ``cross_shard_contention`` chaos mode (scheduler shard-out,
+    ISSUE 14): a ShardSet over a ChaosCluster whose contended slice(s)
+    are pinned into EVERY shard's partition — the stale-shard-map window
+    a live rendezvous rebalance opens, held open — so seeded arrival
+    streams steer two shards' placements at the same ICI block and the
+    accountant's optimistic claim->validate->commit is the only thing
+    between them and a double-booked host. Returns ``(shard_set, agent,
+    contended)``: drive arrivals with :func:`contention_stream`, crash
+    the "process" mid-commit with a scheduled ``shard_crash`` fault, and
+    respawn via a fresh ``build_sharded_stacks`` over
+    ``shard_set.global_stack.cluster.respawn()``.
+
+    Fleet: ``contended_slices`` v5p slices (every shard sees them) plus
+    ``hosts`` v5e singleton hosts of ``chips`` chips (rendezvous-owned,
+    for background singleton traffic)."""
+    from yoda_tpu.agent.fake_publisher import FakeTpuAgent
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.framework.shards import ShardMap
+    from yoda_tpu.standalone import build_sharded_stacks
+
+    config = config or SchedulerConfig(
+        shard_count=shards, batch_requests=8
+    )
+    overlap = {
+        f"v5p-{i}": tuple(range(shards))
+        for i in range(contended_slices)
+    }
+    from yoda_tpu.cluster.fake import FakeCluster
+
+    shard_map = ShardMap(config.shard_count, overlap=overlap)
+    # Bind latency must sit on the inner cluster BEFORE the stacks are
+    # built: the bind-pipeline auto decision reads it at assembly time,
+    # and the latency IS the stage->commit window the mid-commit faults
+    # need open.
+    cluster = ChaosCluster(
+        inner=FakeCluster(bind_latency_s=bind_latency_s),
+        plan=plan or ChaosPlan(seed=seed),
+    )
+    shard_set = build_sharded_stacks(
+        cluster=cluster, config=config, shard_map=shard_map
+    )
+    agent = FakeTpuAgent(cluster)
+    for i in range(contended_slices):
+        agent.add_slice(
+            f"v5p-{i}", generation="v5p", host_topology=slice_topology
+        )
+    for i in range(hosts):
+        agent.add_host(f"h{i}", generation="v5e", chips=chips)
+    agent.publish_all()
+    return shard_set, agent, sorted(overlap)
+
+
+def contention_stream(
+    seed: int,
+    round_idx: int,
+    *,
+    gangs: int = 2,
+    singles: int = 2,
+    topology: str = "2x2",
+    chips: int = 4,
+):
+    """One round of the seeded arrival stream for the contention sweep:
+    ``gangs`` topology gangs whose names are CHOSEN so the router spreads
+    them across different shards (steering both serve loops at the
+    contended slice set) plus ``singles`` background singletons. Same
+    seed + round -> same pods, so a failing sweep's log is its repro.
+    Returns a list of PodSpec."""
+    import random as _random
+
+    from yoda_tpu.api.types import PodSpec
+
+    rng = _random.Random((seed << 16) ^ round_idx)
+    pods = []
+    base = rng.randrange(1 << 30)
+    for g in range(gangs):
+        tag = f"r{round_idx}-g{base + g}"
+        for m in range(4):
+            pods.append(
+                PodSpec(
+                    f"{tag}-{m}",
+                    labels={
+                        "tpu/gang": tag,
+                        "tpu/topology": topology,
+                        "tpu/chips": str(chips),
+                    },
+                )
+            )
+    for s in range(singles):
+        pods.append(
+            PodSpec(
+                f"r{round_idx}-p{base + s}",
+                labels={"tpu/chips": str(chips)},
+            )
+        )
+    return pods
 
 
 def maybe_drop_watch(plan: ChaosPlan, server) -> bool:

@@ -325,6 +325,7 @@ def replay(
     eval_every_s: float = 30.0,
     drive_rebalancer: bool = False,
     max_wall_s: float = 900.0,
+    shard_count: int = 1,
 ) -> ReplayReport:
     """Drive one full scheduler stack with the spec's generated stream.
 
@@ -334,9 +335,18 @@ def replay(
     faults/drains -> ingest flush -> deterministic settle -> node-health
     pass (and rebalancer pass when ``drive_rebalancer``); the SLO engine
     evaluates every ``eval_every_s`` so starvation windows accrue on the
-    virtual timeline."""
+    virtual timeline.
+
+    ``shard_count > 1`` replays the SAME stream through a sharded
+    assembly (standalone.build_sharded_stacks): every lane's queue
+    settles round-robin on the replay thread — deterministic like the
+    single-stack drive — with the starved-work rescue pass between
+    rounds, the node-health/rebalancer passes on the global lane only,
+    and the one shared SLO engine aggregating across the
+    shard-partitioned DRF queues (exactly what the sharded flash-crowd
+    scenario asserts fairness over)."""
     from yoda_tpu.agent import FakeTpuAgent
-    from yoda_tpu.standalone import build_stack
+    from yoda_tpu.standalone import build_sharded_stacks, build_stack
 
     t_start = time.monotonic()
     clock = ReplayClock()
@@ -345,7 +355,40 @@ def replay(
         "the replay exists to drive the BATCHED ingest path; set "
         "ingest_batch_window_ms > 0"
     )
-    stack = build_stack(config=config, clock=clock)
+    shard_set = None
+    if shard_count > 1:
+        from dataclasses import replace as _replace
+
+        config = _replace(config, shard_count=shard_count)
+        shard_set = build_sharded_stacks(config=config, clock=clock)
+        stack = shard_set.global_stack
+        all_stacks = shard_set.stacks
+    else:
+        stack = build_stack(config=config, clock=clock)
+        all_stacks = [stack]
+
+    def flush_all() -> None:
+        for st in all_stacks:
+            st.ingestor.flush()
+
+    def settle_all() -> None:
+        if shard_set is None:
+            _settle(stack, clock)
+            return
+        # Round-robin over lanes until a full quiet round: a losing
+        # lane's conflict rollback (or a rescue move) requeues work
+        # another lane must then drain — same fixed point as the
+        # threaded production drain, single-threaded for determinism.
+        for _ in range(64):
+            for st in all_stacks:
+                _settle(st, clock)
+            flush_all()
+            moved = shard_set.rescue_starved(min_attempts=1)
+            if moved == 0 and all(
+                st.queue.depths()[0] == 0 for st in all_stacks
+            ):
+                return
+        raise RuntimeError("sharded replay settle did not converge")
     agent = FakeTpuAgent(stack.cluster)
     for i in range(hosts):
         agent.add_host(f"h{i:03d}", generation="v5e", chips=chips_per_host)
@@ -354,8 +397,8 @@ def replay(
             f"v5p-{s}", generation="v5p", host_topology=slice_topology
         )
     agent.publish_all()
-    stack.ingestor.flush()
-    _settle(stack, clock)
+    flush_all()
+    settle_all()
 
     report = ReplayReport()
     rng2 = random.Random(spec.seed + 1)  # replay-side picks (kills/drains)
@@ -468,30 +511,35 @@ def replay(
                 stack.nodehealth.cancel_drain(name)
                 draining.discard(name)
                 recoveries.remove((t_rec, name))
-        stack.ingestor.flush()
-        _settle(stack, clock)
+        flush_all()
+        settle_all()
         stack.nodehealth.run_once()
         if drive_rebalancer:
             stack.rebalancer.run_once()
         # Repairs/moves requeue pods; settle them in the same step.
-        stack.ingestor.flush()
-        _settle(stack, clock)
+        flush_all()
+        settle_all()
         if now >= next_eval or now >= spec.duration_s:
             engine.evaluate(now)
             next_eval += eval_every_s
 
     check_invariants(stack)
-    report.binds = stack.scheduler.stats.binds
+    if shard_set is not None:
+        assert not shard_set.accountant.staged_uids(), (
+            "staged shard claims leaked past the replay's settle"
+        )
+    report.binds = sum(st.scheduler.stats.binds for st in all_stacks)
     m = stack.metrics
     report.preemptions = int(
         m.preemptions.total() + m.rebalance_preemptions.total()
     )
     report.repairs = int(m.gang_repairs.total())
-    report.ingest_events = stack.ingestor.events_in
-    report.ingest_batches = stack.ingestor.batches
+    report.ingest_events = sum(st.ingestor.events_in for st in all_stacks)
+    report.ingest_batches = sum(st.ingestor.batches for st in all_stacks)
     report.slo = engine.evaluate(spec.duration_s)
     report.wall_s = time.monotonic() - t_start
-    stack.gang.close()
-    stack.ingestor.stop()
+    for st in all_stacks:
+        st.gang.close()
+        st.ingestor.stop()
     stack.metrics.tracer.close()
     return report

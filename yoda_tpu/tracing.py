@@ -461,19 +461,27 @@ class PendingIndex:
         gang: str | None = None,
         node_reasons: "Mapping[str, str] | None" = None,
         member: str | None = None,
+        shard: str | None = None,
     ) -> None:
         """Record one rejection verdict for ``key`` (a pod key or a gang
         name). ``gang`` mirrors the verdict onto the gang's own entry so
-        ``explain <gang>`` aggregates across members."""
+        ``explain <gang>`` aggregates across members. ``shard`` names the
+        scheduler shard that issued the verdict (sharded serve loops,
+        ISSUE 14) so ``explain`` answers WHICH shard parked a gang."""
         now = self.wall()
         with self._lock:
-            self._record_locked(key, kind, message, node_reasons, now, member)
+            self._record_locked(
+                key, kind, message, node_reasons, now, member, shard
+            )
             if gang and gang != key:
                 self._record_locked(
-                    gang, kind, message, node_reasons, now, member or key
+                    gang, kind, message, node_reasons, now, member or key,
+                    shard,
                 )
 
-    def _record_locked(self, key, kind, message, node_reasons, now, member):
+    def _record_locked(
+        self, key, kind, message, node_reasons, now, member, shard=None
+    ):
         e = self._entries.get(key)
         if e is None:
             e = {
@@ -483,6 +491,7 @@ class PendingIndex:
                 "last_wall": now,
                 "last_message": message,
                 "members": set(),
+                "shard": shard,
                 # normalized reason -> [count, set(node names)]
                 "reasons": OrderedDict(),
             }
@@ -495,6 +504,8 @@ class PendingIndex:
         e["count"] += 1
         e["last_wall"] = now
         e["last_message"] = message
+        if shard is not None:
+            e["shard"] = shard
         if member:
             e["members"].add(member)
             if len(e["members"]) > 64:
@@ -549,6 +560,7 @@ class PendingIndex:
                 "last_wall_unix": round(e["last_wall"], 3),
                 "last_message": e["last_message"],
                 "members": members,
+                "shard": e.get("shard"),
             }
         reasons.sort(key=lambda r: -r["count"])
         out["top_reasons"] = reasons
