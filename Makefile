@@ -9,7 +9,7 @@ TAG ?= latest
 PY ?= python
 CXX ?= g++
 
-.PHONY: all test lint native native-asan bench bench-scale rebalance-bench slo-bench shard-bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native native-asan bench bench-scale rebalance-bench slo-bench shard-bench overload-bench smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -98,6 +98,17 @@ slo-bench:
 shard-bench:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --shards
 
+# Overload brownout ladder + live shard resize evidence (CPU-pinned):
+# the seeded 10x flash-crowd replay with the ladder on vs off (prod
+# admission p99 within its steady-state SLO while spot-tier sheds, vs
+# degradation with the ladder off; zero oversubscription, whole gangs,
+# shed = deferral never loss) plus a live shard_count resize under the
+# same load (movement <= 1.5/N of routed pods, no dropped gangs, zero
+# staged-claim leaks). The 0.5-scale slice rides `make smoke`. One
+# JSON line.
+overload-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --overload
+
 # Fault-injection suite (fixed seed, replayable): gang bind rollback,
 # transient-error retry, dispatch fallback chain, leader fencing, the
 # seeded stress sweep, the scheduler_crash failover sweep (leader killed
@@ -113,7 +124,7 @@ shard-bench:
 # seed via CHAOS_SEED (the test reads its default from the source; the
 # seed is printed on failure for replay).
 chaos:
-	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py tests/test_rebalance.py tests/test_tenancy.py tests/test_node_health.py tests/test_shards.py -q
+	$(PY) -m pytest tests/test_chaos.py tests/test_failover.py tests/test_federation.py tests/test_rebalance.py tests/test_tenancy.py tests/test_node_health.py tests/test_shards.py tests/test_overload.py -q
 
 demo:
 	$(PY) -m yoda_tpu.cli --demo

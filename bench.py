@@ -2761,6 +2761,221 @@ def _agent_hw_probe() -> dict:
     return out
 
 
+def _overload_storm_scenario(*, scale: float = 1.0, seed: int = 11) -> dict:
+    """Overload brownout ladder + live shard resize (ISSUE 15).
+
+    Part 1 — **the ladder under a 10x flash crowd** (tracegen replay,
+    virtual clock, same seed with the ladder ON vs OFF): a steady prod
+    tenant (priority 10, gangs included) and a batch tenant share the
+    fleet; mid-replay a spot-tier crowd floods at ~10x the steady rate.
+    With the ladder ON it must climb to SHED (crowd draws park with
+    ``overload-shed`` verdicts) and the prod tenant's admission-wait p99
+    stays within its steady-state SLO; with the ladder OFF the same
+    seed lets the crowd occupy the fleet and prod p99 degrades —
+    ``overload_prod_p99_ratio`` reports off/on. Invariants both runs:
+    zero oversubscription (replay-wide), every bound gang whole, queue
+    fully drained at the end (shed is deferral — nothing wedges; the
+    every-shed-pod-binds-after-the-storm form with controlled
+    departures is the slow ``overload_storm`` sweep in
+    tests/test_overload.py).
+
+    Part 2 — **live ``shard_count`` resize under the same load**: a
+    4-shard assembly with queued storm load resizes to 5 mid-flight
+    (``ShardSet.resize``); the rendezvous movement bound is asserted
+    (≤ 1.5/N of routed pods move), no gang is dropped or split, zero
+    staged-claim leaks, and everything drains whole afterwards."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.overload import SHED
+    from yoda_tpu.slo import SloTargets
+    from yoda_tpu.testing.tracegen import (
+        FlashCrowd,
+        TenantMix,
+        TraceSpec,
+        replay,
+    )
+
+    duration = max(300.0 * scale, 90.0)
+    hosts = 12 if scale >= 1.0 else 6
+    prod_target_s = 60.0
+
+    def spec(s):
+        return TraceSpec(
+            seed=s,
+            duration_s=duration,
+            base_rate_per_s=0.8 * (hosts / 12.0),
+            tenants=(
+                # Gang-heavy prod: whole-gang admission needs capacity
+                # to ALIGN, which is exactly what a crowd-saturated
+                # fleet denies — the degradation the ladder prevents.
+                TenantMix(
+                    "prod", weight=1.0, priority=10, chips=(2,),
+                    gang_fraction=0.5, gang_sizes=(2,),
+                ),
+                TenantMix("batch", weight=1.0, priority=0, chips=(1, 2)),
+            ),
+            lifetime_s=(20.0, 50.0),
+            flash_crowds=(
+                FlashCrowd(
+                    t0=duration * 0.3,
+                    duration_s=duration * 0.25,
+                    extra_rate_per_s=8.0 * (hosts / 12.0),  # ~10x steady
+                    tenant="crowd",
+                    chips=2,
+                    priority=0,
+                    # Bounded lifetimes: unbound crowd asks expire in
+                    # the calm tail (the no-immortal-entry assertion),
+                    # while bound ones hold chips long enough to starve
+                    # gang alignment with the ladder off.
+                    lifetime_s=(30.0, 60.0),
+                ),
+            ),
+        )
+
+    def cfg(ladder: bool):
+        return SchedulerConfig(
+            mode="batch",
+            batch_requests=16,
+            ingest_batch_window_ms=10_000.0,
+            ingest_batch_max=2048,
+            trace_sample_rate=0.0,
+            node_suspect_after_s=1e9,
+            node_down_after_s=1e9,
+            enable_preemption=False,
+            slo_targets=SloTargets(admission_wait_p99_s=prod_target_s),
+            slo_burn_fast_window_s=60.0,
+            slo_burn_slow_window_s=max(duration, 60.0),
+            # Ladder OFF = every signal disabled (pressure identically
+            # 0); the monitor never leaves NOMINAL so the runs differ by
+            # the ladder alone.
+            overload_queue_high=(2 * hosts) if ladder else 0,
+            overload_ingest_high=0,
+            overload_cycle_ms_high=0.0,
+            overload_step_down_hold_s=30.0,
+            overload_brownout_admit_per_s=10.0,
+            overload_shed_priority=10,
+        )
+
+    def gangs_whole(stack_cluster) -> None:
+        members: dict = {}
+        for p in stack_cluster.list_pods():
+            g = p.labels.get("tpu/gang")
+            if g:
+                members.setdefault(g, []).append(p)
+        for g, pods in members.items():
+            bound = [p for p in pods if p.node_name]
+            assert len(bound) in (0, len(pods)), (
+                f"gang {g} split: {len(bound)}/{len(pods)} bound"
+            )
+
+    out: dict = {"overload_scale": scale, "overload_seed": seed}
+    runs: dict = {}
+    for label, ladder in (("on", True), ("off", False)):
+        rep = replay(
+            spec(seed),
+            config=cfg(ladder),
+            hosts=hosts,
+            drive_overload=ladder,
+        )
+        prod = rep.slo["tenants"]["prod"]
+        assert prod["admissions_total"] > 0, "prod never admitted"
+        # Nothing wedged: no entry has been pending past its natural
+        # lifetime — shed parks must still honor deletions (the
+        # delete-event fast path) and requeue on step-down; an immortal
+        # queued entry here would mean shed lost track of a pod. (Late
+        # tail arrivals may legitimately still be queued.)
+        for tenant, row in rep.slo["tenants"].items():
+            assert row["oldest_wait_s"] <= 130.0, (label, tenant, row)
+        runs[label] = rep
+        out[f"overload_{label}_prod_p99_s"] = prod["admission_wait_p99_s"]
+        out[f"overload_{label}_binds"] = rep.binds
+        out[f"overload_{label}_shed"] = rep.shed
+        out[f"overload_{label}_peak_level"] = rep.overload_peak_level
+    on, off = runs["on"], runs["off"]
+    assert on.overload_peak_level == SHED, (
+        f"the storm never drove the ladder to SHED "
+        f"(peak {on.overload_peak_level})"
+    )
+    assert on.shed > 0
+    assert off.shed == 0 and off.overload_peak_level == 0
+    on_p99 = out["overload_on_prod_p99_s"]
+    off_p99 = out["overload_off_prod_p99_s"]
+    assert on_p99 <= prod_target_s, (
+        f"ladder ON: prod p99 {on_p99}s blew the steady-state "
+        f"{prod_target_s}s SLO during the storm"
+    )
+    assert off_p99 > on_p99, (
+        f"ladder OFF should degrade prod p99 (off {off_p99}s vs on "
+        f"{on_p99}s) — the storm shape is too gentle to prove anything"
+    )
+    # Floor the denominator at half a settle step: admissions quantize
+    # to the replay's 5 s settle cadence, and a 0.0 p99 would print an
+    # absurd ratio.
+    out["overload_prod_p99_ratio"] = round(off_p99 / max(on_p99, 2.5), 2)
+
+    # --- Part 2: live shard resize under storm load -------------------
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.standalone import build_sharded_stacks
+
+    old_n, new_n = 4, 5
+    ss = build_sharded_stacks(
+        config=SchedulerConfig(shard_count=old_n, batch_requests=8)
+    )
+    agent = FakeTpuAgent(ss.global_stack.cluster)
+    for i in range(6):
+        agent.add_slice(f"v5p-{i}", generation="v5p", host_topology=(2, 2, 1))
+    for i in range(24):
+        agent.add_host(f"h{i}", generation="v5e", chips=8)
+    agent.publish_all()
+    cluster = ss.global_stack.cluster
+    pods = []
+    for g in range(4):
+        labels = {
+            "tpu/gang": f"rz{g}", "tpu/topology": "2x2", "tpu/chips": "4",
+        }
+        for m in range(4):
+            p = PodSpec(f"rz{g}-{m}", labels=dict(labels))
+            pods.append(p)
+            cluster.create_pod(p)
+    for i in range(14):
+        p = PodSpec(f"rzs{i}", labels={"tpu/chips": "4"})
+        pods.append(p)
+        cluster.create_pod(p)
+    t0 = time.monotonic()
+    report = ss.resize(new_n)
+    resize_ms = (time.monotonic() - t0) * 1e3
+    assert report["resized"] and report["shards"] == new_n
+    moved_frac = report["moved_entries"] / max(report["total_entries"], 1)
+    bound_frac = 1.5 / new_n
+    assert moved_frac <= bound_frac + 0.05, (
+        f"resize moved {report['moved_entries']}/"
+        f"{report['total_entries']} routed pods ({moved_frac:.2f} > "
+        f"1.5/N bound {bound_frac:.2f})"
+    )
+    ss.run_until_idle(max_wall_s=30)
+    bound = [p for p in cluster.list_pods() if p.node_name]
+    assert len(bound) == len(pods), (
+        f"resize dropped {len(pods) - len(bound)} pod(s)"
+    )
+    gangs_whole(cluster)
+    for ni in ss.global_stack.informer.snapshot().infos():
+        assert ss.accountant.chips_in_use(ni.name) <= len(
+            ni.tpu.healthy_chips()
+        )
+    assert not ss.accountant.staged_uids(), "staged-claim leak across resize"
+    ss.close()
+    out["overload_resize_moved_pods"] = report["moved_entries"]
+    out["overload_resize_total_pods"] = report["total_entries"]
+    out["overload_resize_moved_frac"] = round(moved_frac, 3)
+    out["overload_resize_pools_moved"] = report["pools_moved"]
+    out["overload_resize_pools_total"] = report["pools_total"]
+    out["overload_resize_ms"] = round(resize_ms, 1)
+    return out
+
+
 def run_bench() -> dict:
     from yoda_tpu.agent import FakeTpuAgent
     from yoda_tpu.api.types import PodSpec
@@ -2852,6 +3067,8 @@ def run_bench() -> dict:
     print(f"SLO trace-replay matrix (smoke slice): {slo_matrix}", file=sys.stderr)
     shard = _shard_scaling_scenario()
     print(f"scheduler shard-out scaling (1/2/4/8): {shard}", file=sys.stderr)
+    storm = _overload_storm_scenario()
+    print(f"overload brownout ladder + live resize: {storm}", file=sys.stderr)
     http = _http_gang_scenario()
     print(f"gang over real HTTP wire path: {http}", file=sys.stderr)
     probe = _device_probe()
@@ -2888,6 +3105,7 @@ def run_bench() -> dict:
         **slo_over,
         **slo_matrix,
         **shard,
+        **storm,
         **http,
         **probe,
         **pallas,
@@ -2923,6 +3141,12 @@ def run_smoke() -> dict:
     out.update(_observability_overhead_scenario())
     out.update(_slo_overhead_scenario())
     out.update(_slo_scenario_matrix(scale=0.2))
+    # Overload brownout ladder + live shard resize smoke slice (the
+    # full shape is `make overload-bench`): the scenario's own
+    # assertions guard the ladder contract (SHED reached, prod p99
+    # within its steady-state SLO, ladder-off strictly worse, resize
+    # movement bound, no dropped gangs, zero staged-claim leaks).
+    out.update(_overload_storm_scenario(scale=0.5))
     # Scheduler shard-out smoke slice: 1 vs 2 shards at a reduced shape
     # (the full 1/2/4/8 sweep is `make shard-bench`); the scenario's own
     # assertions guard the invariants, the ratio guards gross scaling
@@ -2982,6 +3206,27 @@ def run_slo() -> dict:
     }
 
 
+def run_overload() -> dict:
+    """``bench.py --overload`` / ``make overload-bench``: the overload
+    brownout ladder + live shard resize evidence at the standard shape —
+    a 10x flash-crowd flood replayed with the ladder on vs off (prod
+    admission p99 within its steady-state SLO while spot sheds, vs
+    degradation with the ladder off), plus a live ``shard_count``
+    resize under the same load (movement <= 1.5/N of routed pods, no
+    dropped gangs, zero staged-claim leaks). Every acceptance bar is
+    asserted inside the scenario; this just shapes the JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = _overload_storm_scenario(scale=1.0)
+    return {
+        "metric": "overload_prod_p99_ratio",
+        "value": out["overload_prod_p99_ratio"],
+        "unit": "ratio",
+        **out,
+    }
+
+
 def run_rebalance() -> dict:
     """``bench.py --rebalance`` / ``make rebalance-bench``: the long form
     of the seeded churn replay (more rounds than the smoke's 16) plus the
@@ -3028,6 +3273,9 @@ def main() -> int:
         return 0
     if "--shards" in sys.argv:
         print(json.dumps(run_shards()))
+        return 0
+    if "--overload" in sys.argv:
+        print(json.dumps(run_overload()))
         return 0
     if "--run" in sys.argv:
         return _child(force_cpu="--cpu" in sys.argv)

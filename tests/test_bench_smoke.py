@@ -269,3 +269,29 @@ def test_shard_scaling_smoke_invariants():
     assert out["shard2_pods_per_s"] > 0
     assert out["shard_scaling_2x"] >= 1.3, out
     assert out["shard1_commit_commits"] > 0
+
+
+def test_overload_storm_smoke_invariants():
+    import bench
+
+    # ISSUE 15 acceptance (smoke slice; `make overload-bench` runs the
+    # standard shape): under the 10x flash-crowd flood the ladder must
+    # reach SHED and shed spot-tier draws while the prod tenant's
+    # admission p99 holds its steady-state SLO; the SAME seed with the
+    # ladder off degrades prod; the live shard resize under queued load
+    # moves <= 1.5/N of routed pods, drops no gang, and leaks no staged
+    # claim. All asserted inside the scenario; here we pin the evidence
+    # shape.
+    from yoda_tpu.overload import SHED
+
+    out = bench._overload_storm_scenario(scale=0.5)
+    assert out["overload_on_peak_level"] == SHED
+    assert out["overload_on_shed"] > 0
+    assert out["overload_off_shed"] == 0
+    assert out["overload_on_prod_p99_s"] <= 60.0
+    assert (
+        out["overload_off_prod_p99_s"] > out["overload_on_prod_p99_s"]
+    )
+    assert out["overload_resize_moved_frac"] <= 1.5 / 5 + 0.05
+    assert out["overload_resize_pools_total"] > 0
+    assert out["overload_resize_ms"] < 5_000

@@ -201,6 +201,81 @@ class TestAgentMode:
         assert obj["status"]["chipCount"] == 8
 
 
+class TestOverloadHealthz:
+    def test_healthz_and_readyz_stay_200_in_brownout_and_shed(
+        self, server, tmp_path, run_main_bg
+    ):
+        """ISSUE 15: the overload ladder is self-protection, not
+        sickness — a kubelet restarting (or un-routing) a correctly
+        degrading scheduler would turn an overload into an outage. Drive
+        the CLI scheduler to SHED over real HTTP and assert /healthz and
+        /readyz both keep answering 200 while /metrics reports the
+        ladder at 3."""
+        import socket
+        import urllib.request
+
+        seed = KubeCluster(
+            KubeApiClient(
+                KubeApiConfig(base_url=server.base_url, watch_timeout_s=2)
+            )
+        )
+        # One tiny node: the spot flood below cannot fit, so it piles
+        # into backoff — exactly the queue pressure the ladder reads.
+        seed.put_tpu_metrics(make_node("ov-1", chips=1))
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(
+            "overload_queue_high: 1\n"
+            "overload_period_s: 0.05\n"
+            "overload_step_down_hold_s: 600\n"
+        )
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        run_main_bg(
+            ["--config", str(cfg), "--metrics-port", str(port)]
+        )
+        base = f"http://127.0.0.1:{port}"
+
+        def http_status(path: str) -> int:
+            try:
+                return urllib.request.urlopen(
+                    f"{base}{path}", timeout=1
+                ).status
+            except Exception:  # noqa: BLE001 — not up yet / 503
+                return 0
+
+        _wait_until(
+            lambda: http_status("/readyz") == 200,
+            timeout_s=60.0,
+            msg="/readyz ready before the storm",
+        )
+        for i in range(8):
+            seed.create_pod(
+                PodSpec(
+                    f"flood-{i}",
+                    labels={"tpu/chips": "8", "tpu/priority": "0"},
+                )
+            )
+
+        def at_shed() -> bool:
+            try:
+                text = (
+                    urllib.request.urlopen(f"{base}/metrics", timeout=2)
+                    .read()
+                    .decode()
+                )
+            except Exception:  # noqa: BLE001
+                return False
+            return "yoda_overload_level 3.0" in text
+
+        _wait_until(at_shed, timeout_s=60.0, msg="ladder reached SHED")
+        # The regression under test: liveness AND readiness stay green
+        # while the scheduler is deliberately degrading.
+        assert http_status("/healthz") == 200
+        assert http_status("/readyz") == 200
+        seed.stop()
+
+
 class TestFederatedSchedulerMode:
     def test_readyz_follows_degraded_readiness_with_dead_remote(
         self, server, tmp_path, run_main_bg

@@ -65,6 +65,36 @@ class TestSchedulerManifest:
         )
         assert cfg.shard_count == 1
 
+    def test_configmap_overload_knobs_validate(self):
+        """ISSUE 15: the shipped overload-ladder knobs must pass
+        SchedulerConfig validation — a drifted ConfigMap would
+        crash-loop the Deployment (and, being hot-reloadable, silently
+        no-op a SIGHUP)."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert cfg.overload_period_s > 0
+        assert cfg.overload_queue_high > 0
+        assert cfg.overload_ingest_high > 0
+        assert cfg.overload_cycle_ms_high > 0
+        assert cfg.overload_step_down_hold_s > 0
+        assert cfg.overload_brownout_admit_per_s > 0
+        assert cfg.pending_index_max >= 16
+        # Every shipped overload knob is declared hot-reloadable.
+        from yoda_tpu.config import RELOADABLE_KNOBS
+
+        assert {
+            "overload_period_s",
+            "overload_queue_high",
+            "overload_ingest_high",
+            "overload_cycle_ms_high",
+            "overload_step_down_hold_s",
+            "overload_brownout_admit_per_s",
+            "overload_shed_priority",
+            "pending_index_max",
+        } <= RELOADABLE_KNOBS
+
     def test_deployment_mounts_config_and_probes_healthz(self):
         (dep,) = by_kind(self.docs, "Deployment")
         spec = dep["spec"]["template"]["spec"]

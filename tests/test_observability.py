@@ -177,6 +177,10 @@ ALL_METRIC_FAMILIES = (
     "yoda_node_state",
     "yoda_node_transitions_total",
     "yoda_overlap_cycles_total",
+    "yoda_overload_level",
+    "yoda_overload_transitions_total",
+    "yoda_overload_shed_total",
+    "yoda_pending_evicted_total",
     "yoda_preempted_priority_weight_total",
     "yoda_preemptions_total",
     "yoda_queue_active_pods",
@@ -296,6 +300,59 @@ class TestIngestAndTenantMetrics:
         # Why-pending verdict recorded for the parked pod.
         entry = stack.metrics.pending.explain("team-a/a2")
         assert entry is not None and entry["kind"] == "quota-park"
+
+
+class TestOverloadMetrics:
+    """ISSUE 15: the brownout-ladder series carry real values when the
+    ladder engages (the default-stack schema test above covers the
+    always-rendered families)."""
+
+    def test_level_and_transitions_follow_the_ladder(self):
+        stack, agent = make_stack(overload_queue_high=1)
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        ov = stack.metrics.overload
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_overload_level 0.0" in text
+        # Two queued entries on queue_high=1 -> pressure 2.0 -> the
+        # ladder climbs one level per evaluation.
+        stack.cluster.create_pod(
+            PodSpec("a", labels={"tpu/chips": "64"})
+        )
+        stack.cluster.create_pod(
+            PodSpec("b", labels={"tpu/chips": "64"})
+        )
+        ov.evaluate()
+        ov.evaluate()
+        assert ov.level == "BROWNOUT"
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_overload_level 2.0" in text
+        assert "yoda_overload_transitions_total 2.0" in text
+
+    def test_shed_total_counts_parked_draws(self):
+        stack, agent = make_stack(overload_queue_high=1)
+        agent.add_host("host", generation="v5e", chips=4)
+        agent.publish_all()
+        ov = stack.metrics.overload
+        for lvl in range(3):
+            ov._transition_locked(lvl + 1)  # force SHED directly
+        stack.cluster.create_pod(
+            PodSpec("spot", labels={"tpu/chips": "1"})
+        )
+        assert stack.queue.pop(timeout=0.0) is None  # shed, not served
+        assert ov.shed_total == 1
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_overload_shed_total 1.0" in text
+
+    def test_pending_index_evictions_counted(self):
+        stack, _agent = make_stack(pending_index_max=16)
+        pending = stack.metrics.pending
+        for i in range(20):
+            pending.record(f"ns/p{i}", kind="unschedulable", message="m")
+        assert pending.evicted == 4
+        assert len(pending.keys()) == 16
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_pending_evicted_total 4.0" in text
 
 
 class TestNodeHealthMetrics:

@@ -425,6 +425,180 @@ class TestExplainShardTag:
         ss.close()
 
 
+class TestLiveResize:
+    """ISSUE 15: zero-downtime `shard_count` resize (ShardSet.resize) —
+    the PR 14 follow-up drill. 4 -> 8 -> 3 under seeded queued load:
+    the rendezvous movement bound holds per step, no gang is ever
+    dropped or split, and the accountant leaks zero staged claims."""
+
+    def _loaded_set(self, shard_count=4):
+        ss, agent = make_shard_set(shard_count)
+        # Many pools so the movement fraction is statistically
+        # meaningful: 6 slices + 24 single-host pools = 30 pools.
+        fleet(agent, slices=6, hosts=24)
+        cluster = ss.global_stack.cluster
+        pods = []
+        for g in range(4):
+            for p in gang_pods(f"rg{g}"):
+                pods.append(p)
+                cluster.create_pod(p)
+        for i in range(12):
+            p = PodSpec(f"rp{i}", labels={"tpu/chips": "4"})
+            pods.append(p)
+            cluster.create_pod(p)
+        return ss, cluster, pods
+
+    @staticmethod
+    def _movement_bound(report, old_n, new_n):
+        # Rendezvous: k -> m moves an expected |m-k|/max(m,k) of pools
+        # (~1/N for a +-1 step). Assert <= 1.5x expected plus a small
+        # absolute allowance for the finite pool count. Deterministic
+        # for fixed pool names, so this is a regression pin, not a
+        # statistical gamble.
+        expected = abs(new_n - old_n) / max(new_n, old_n)
+        bound = 1.5 * expected + 0.10
+        frac = report["pools_moved"] / max(report["pools_total"], 1)
+        assert frac <= bound, (
+            f"{old_n}->{new_n}: moved {report['pools_moved']}/"
+            f"{report['pools_total']} pools ({frac:.2f} > bound {bound:.2f})"
+        )
+        assert report["pools_moved"] > 0  # a resize that moves nothing is broken
+
+    def test_resize_drill_4_8_3_under_load(self):
+        ss, cluster, pods = self._loaded_set(4)
+        total0 = sum(len(st.queue) for st in ss.stacks)
+        assert total0 == len(pods)
+        rep = ss.resize(8)
+        assert rep["resized"] and rep["shards"] == 8
+        self._movement_bound(rep, 4, 8)
+        # No entry lost or duplicated by the move.
+        assert sum(len(st.queue) for st in ss.stacks) == len(pods)
+        # Per-shard series follow the live lane set immediately.
+        text = ss.metrics.registry.render_prometheus()
+        assert 'yoda_shard_queue_depth{shard="s7"}' in text
+        # Gangs stay whole in ONE lane across the move.
+        by_lane: dict = {}
+        for st in ss.stacks:
+            for pod, _a in st.queue.all_entries():
+                g = pod.labels.get("tpu/gang")
+                if g:
+                    by_lane.setdefault(g, set()).add(st.scheduler.shard)
+        for g, lanes in by_lane.items():
+            assert len(lanes) == 1, (g, lanes)
+        rep = ss.resize(3)
+        assert rep["resized"] and rep["shards"] == 3
+        self._movement_bound(rep, 8, 3)
+        assert sum(len(st.queue) for st in ss.stacks) == len(pods)
+        text = ss.metrics.registry.render_prometheus()
+        assert 'shard="s7"' not in text  # dissolved lanes' series retired
+        assert 'shard="s2"' in text
+        # The drill's payoff: everything drains whole afterwards.
+        ss.run_until_idle(max_wall_s=30)
+        bound = [p for p in cluster.list_pods() if p.node_name]
+        assert len(bound) == len(pods), (
+            len(bound),
+            [p.key for p in pods if not cluster.get_pod(p.key).node_name],
+        )
+        per_gang: dict = {}
+        for p in bound:
+            g = p.labels.get("tpu/gang")
+            if g:
+                per_gang.setdefault(g, []).append(p)
+        for g, members in per_gang.items():
+            assert len(members) == 4, (g, len(members))
+        for ni in ss.global_stack.informer.snapshot().infos():
+            assert ss.accountant.chips_in_use(ni.name) <= len(
+                ni.tpu.healthy_chips()
+            )
+        # Zero staged-claim leaks across both resizes.
+        assert not ss.accountant.staged_uids()
+        ss.close()
+
+    def test_resize_retires_dissolved_lanes(self):
+        ss, cluster, pods = self._loaded_set(4)
+        retired = ss.shard_stacks[3]
+        ss.resize(2)
+        assert retired.scheduler.retired.is_set()
+        assert retired.scheduler._fenced()
+        assert len(retired.queue) == 0  # drained by the resizer
+        assert retired not in ss.stacks
+        # A serve thread on the retired loop exits promptly.
+        import threading
+
+        stop = __import__("threading").Event()
+        t = threading.Thread(
+            target=retired.scheduler.serve_forever, args=(stop,),
+        )
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        ss.close()
+
+    def test_resize_waits_for_inflight_gangs_on_staged_claims(self):
+        # A gang mid-Permit on a SURVIVING shard rides through the
+        # resize untouched: its staged claims stay valid (validation is
+        # partition-agnostic) and it completes after the swap.
+        ss, agent = make_shard_set(4)
+        fleet(agent, slices=4, hosts=8)
+        cluster = ss.global_stack.cluster
+        pods = gang_pods("inflight")
+        # Route 3 of 4 members in: the gang reserves and parks at the
+        # Permit barrier with staged claims.
+        lane = ss.router.route(pods[0])
+        owner = next(
+            st for st in ss.stacks if st.scheduler.shard == lane
+        )
+        for p in pods[:3]:
+            cluster.create_pod(p)
+        owner.scheduler.run_until_idle(max_wall_s=5)
+        assert len(owner.framework.waiting_pods()) == 3
+        assert ss.accountant.staged_count() == 3
+        rep = ss.resize(5, quiesce_timeout_s=0.5)
+        assert rep["resized"]
+        # The last member arrives; the gang completes whole wherever its
+        # members are parked (the barrier never split).
+        cluster.create_pod(pods[3])
+        ss.run_until_idle(max_wall_s=20)
+        bound = [
+            p
+            for p in cluster.list_pods()
+            if p.node_name and p.labels.get("tpu/gang") == "inflight"
+        ]
+        assert len(bound) == 4, [p.key for p in bound]
+        assert not ss.accountant.staged_uids()
+        ss.close()
+
+    def test_occupancy_tie_break_steers_off_deep_queues(self):
+        from yoda_tpu.framework.shards import ShardRouter
+
+        ss, agent = make_shard_set(2)
+        fleet(agent, slices=4, hosts=8)
+        depths = {0: 0, 1: 0}
+        ss.router.depth_fn = lambda i: depths[i]
+        # Balanced depths: pure rendezvous.
+        base = {
+            tag: ss.router.route(gang_pods(tag)[0])
+            for tag in (f"t{i}" for i in range(12))
+        }
+        assert set(base.values()) <= {"s0", "s1"}
+        # One shard deep past the occupancy quantum: NEW gangs (fresh
+        # keys — memoized decisions stay pinned) all steer to the
+        # shallow shard, deterministically given the depth snapshot.
+        deep = next(int(v[1]) for v in base.values())
+        depths[deep] = 10 * ShardRouter.OCCUPANCY_QUANTUM
+        shallow = f"s{1 - deep}"
+        routed = {
+            tag: ss.router.route(gang_pods(tag)[0])
+            for tag in (f"fresh{i}" for i in range(12))
+        }
+        assert set(routed.values()) == {shallow}, routed
+        # Memoized gangs keep their lane (whole-gang consistency beats
+        # load steering for already-routed work).
+        again = {tag: ss.router.route(gang_pods(tag)[0]) for tag in base}
+        assert again == base
+        ss.close()
+
+
 class TestShardNames:
     def test_shard_name_shape(self):
         assert shard_name(0) == "s0" and shard_name(7) == "s7"

@@ -23,6 +23,7 @@ from tools.yodalint.passes import (
     hook_order,
     lock_discipline,
     metrics_drift,
+    reload_safety,
     snapshot_immutability,
     verdict_taxonomy,
 )
@@ -552,6 +553,134 @@ class TestVerdictTaxonomyPass:
         )
         project = make_project(tmp_path, files)
         assert verdict_taxonomy.run(project) == []
+
+
+class TestReloadSafety:
+    """ISSUE 15: the hot-reload classification must be coherent and
+    every RELOADABLE knob genuinely live (re-applied in
+    standalone.apply_reloadable, never captured at build time)."""
+
+    CONFIG = (
+        "from dataclasses import dataclass\n"
+        "RELOADABLE_KNOBS = frozenset({'alpha', 'beta'})\n"
+        "RESIZE_KNOBS = frozenset({'shard_count'})\n"
+        "IMMUTABLE_KNOBS = frozenset({'mode'})\n"
+        "@dataclass(frozen=True)\n"
+        "class SchedulerConfig:\n"
+        "    mode: str = 'batch'\n"
+        "    alpha: float = 1.0\n"
+        "    beta: int = 2\n"
+        "    shard_count: int = 1\n"
+    )
+    APPLY = (
+        "def apply_reloadable(stacks, config):\n"
+        "    for st in stacks:\n"
+        "        st.alpha = config.alpha\n"
+        "        st.beta = config.beta\n"
+    )
+
+    def _project(self, tmp_path, **overrides):
+        files = {
+            "yoda_tpu/config.py": self.CONFIG,
+            "yoda_tpu/standalone.py": self.APPLY,
+        }
+        files.update(overrides)
+        return make_project(tmp_path, files)
+
+    def test_clean_fixture_is_clean(self, tmp_path):
+        assert reload_safety.run(self._project(tmp_path)) == []
+
+    def test_catches_build_time_capture(self, tmp_path):
+        project = self._project(
+            tmp_path,
+            **{
+                "yoda_tpu/mod.py": (
+                    "class Loop:\n"
+                    "    def __init__(self, config):\n"
+                    "        self._alpha = config.alpha\n"
+                ),
+            },
+        )
+        findings = reload_safety.run(project)
+        assert any(
+            "'alpha'" in f.message and "build-time capture" in f.message
+            and f.file.endswith("mod.py")
+            for f in findings
+        ), findings
+
+    def test_catches_reloadable_knob_never_reapplied(self, tmp_path):
+        project = self._project(
+            tmp_path,
+            **{
+                "yoda_tpu/standalone.py": (
+                    "def apply_reloadable(stacks, config):\n"
+                    "    for st in stacks:\n"
+                    "        st.alpha = config.alpha\n"
+                    # beta declared reloadable but never re-applied
+                ),
+            },
+        )
+        findings = reload_safety.run(project)
+        assert any(
+            "'beta'" in f.message and "never" in f.message
+            for f in findings
+        ), findings
+
+    def test_catches_undeclared_live_apply(self, tmp_path):
+        project = self._project(
+            tmp_path,
+            **{
+                "yoda_tpu/standalone.py": self.APPLY
+                + "        st.mode = config.mode\n",
+            },
+        )
+        findings = reload_safety.run(project)
+        assert any(
+            "'mode'" in f.message and "not in RELOADABLE_KNOBS" in f.message
+            for f in findings
+        ), findings
+
+    def test_catches_ghost_classification_and_overlap(self, tmp_path):
+        project = self._project(
+            tmp_path,
+            **{
+                "yoda_tpu/config.py": self.CONFIG.replace(
+                    "IMMUTABLE_KNOBS = frozenset({'mode'})",
+                    "IMMUTABLE_KNOBS = frozenset({'mode', 'alpha',"
+                    " 'ghost_knob'})",
+                ),
+            },
+        )
+        findings = reload_safety.run(project)
+        assert any(
+            "'ghost_knob'" in f.message and "ghost classification" in f.message
+            for f in findings
+        ), findings
+        assert any(
+            "'alpha'" in f.message and "both" in f.message
+            for f in findings
+        ), findings
+
+    def test_missing_apply_site_is_a_finding(self, tmp_path):
+        project = self._project(
+            tmp_path, **{"yoda_tpu/standalone.py": "x = 1\n"}
+        )
+        findings = reload_safety.run(project)
+        assert any(
+            "apply_reloadable not found" in f.message for f in findings
+        ), findings
+
+    def test_testing_modules_may_build_configs_freely(self, tmp_path):
+        project = self._project(
+            tmp_path,
+            **{
+                "yoda_tpu/testing/gen.py": (
+                    "def spec(config):\n"
+                    "    return config.alpha\n"
+                ),
+            },
+        )
+        assert reload_safety.run(project) == []
 
 
 class TestSuppressions:

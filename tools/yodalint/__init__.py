@@ -1,6 +1,6 @@
 """yodalint — project-invariant static analysis for yoda-tpu (ISSUE 13).
 
-Seven passes over one shared parse + call graph, gating ``make lint``:
+Eight passes over one shared parse + call graph, gating ``make lint``:
 
 1. lock-discipline        — no blocking work under a component lock;
                             lock acquisitions respect the declared DAG
@@ -14,6 +14,8 @@ Seven passes over one shared parse + call graph, gating ``make lint``:
                             informer -> recorder
 6. metrics-drift          — yoda_* series asserted in tests + documented
 7. verdict-taxonomy       — why-pending kinds stay in the pinned set
+8. reload-safety          — hot-reload classification is coherent and
+                            every RELOADABLE knob is genuinely live
 
 Suppress a deliberate exception with ``# yodalint: ok <pass> <reason>``
 on (or directly above) the flagged line; the reason is mandatory.

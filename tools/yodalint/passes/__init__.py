@@ -8,6 +8,7 @@ from tools.yodalint.passes import (
     hook_order,
     lock_discipline,
     metrics_drift,
+    reload_safety,
     snapshot_immutability,
     verdict_taxonomy,
 )
@@ -21,6 +22,7 @@ ALL_PASSES = (
     hook_order,
     metrics_drift,
     verdict_taxonomy,
+    reload_safety,
 )
 
 PASS_NAMES = {p.NAME for p in ALL_PASSES}

@@ -1,0 +1,201 @@
+"""Pass 8 — reload-safety (ISSUE 15): the hot-reload classification in
+``yoda_tpu/config.py`` must be coherent, and every knob declared
+RELOADABLE must actually be live.
+
+A knob declared reloadable but captured into a serve-path local/attr at
+build time is the worst kind of lie: the operator SIGHUPs a new value,
+the reloader reports it applied, and the old value keeps serving. Four
+checks:
+
+1. **classification is real** — every name in ``RELOADABLE_KNOBS`` /
+   ``RESIZE_KNOBS`` / ``IMMUTABLE_KNOBS`` is a ``SchedulerConfig``
+   field, and the sets are pairwise disjoint (one knob, one class).
+2. **reloadable knobs are re-applied** — each ``RELOADABLE_KNOBS`` name
+   is read off the config object inside
+   ``standalone.apply_reloadable`` (THE apply site the ConfigReloader
+   drives); a declared-reloadable knob missing there would never reach
+   its consumer on reload.
+3. **nothing undeclared applies live** — a ``config.<knob>`` read in
+   ``apply_reloadable`` whose knob is NOT declared reloadable is drift
+   in the other direction (live semantics nobody classified).
+4. **no build-time capture** — outside the assembly/reload layer
+   (config.py, overload.py, standalone.py, cli.py, testing/), no module
+   may read ``config.<knob>`` / ``cfg.<knob>`` for a reloadable knob:
+   consumers must hold the live attribute the apply site writes, never
+   a boot-time copy.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.core import Finding, Project
+from tools.yodalint.passes.config_drift import _dataclass_fields
+
+NAME = "reload-safety"
+
+#: Modules allowed to read reloadable knobs off a config object: the
+#: assembly seeds initial values (re-applied on reload), the reload
+#: layer applies them, and the testing harness builds configs freely.
+ALLOWED_SUFFIXES = (
+    "config.py",
+    "overload.py",
+    "standalone.py",
+    "cli.py",
+)
+ALLOWED_DIRS = ("/testing/",)
+
+_SET_NAMES = ("RELOADABLE_KNOBS", "RESIZE_KNOBS", "IMMUTABLE_KNOBS")
+
+
+def _knob_sets(mod) -> "dict[str, tuple[set[str], int]]":
+    """{set name: (names, line)} for the classification frozensets."""
+    out: dict[str, tuple[set[str], int]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id in _SET_NAMES
+            ):
+                names: set[str] = set()
+                for const in ast.walk(node.value):
+                    if isinstance(const, ast.Constant) and isinstance(
+                        const.value, str
+                    ):
+                        names.add(const.value)
+                out[target.id] = (names, node.lineno)
+    return out
+
+
+def _apply_fn(mod) -> "ast.FunctionDef | None":
+    for node in mod.tree.body:
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "apply_reloadable"
+        ):
+            return node
+    return None
+
+
+def _config_attr_reads(tree) -> "dict[str, int]":
+    """Attribute names read off a variable named config/cfg -> first line."""
+    reads: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("config", "cfg")
+        ):
+            reads.setdefault(node.attr, node.lineno)
+    return reads
+
+
+def run(project: Project, graph=None) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    cfg_mod = project.module("config.py")
+    if cfg_mod is None:
+        return [Finding(NAME, "yoda_tpu/config.py", 1, "config.py missing")]
+    knobs = set(_dataclass_fields(cfg_mod, "SchedulerConfig"))
+    sets = _knob_sets(cfg_mod)
+    for set_name in _SET_NAMES:
+        if set_name not in sets:
+            findings.append(
+                Finding(
+                    NAME,
+                    cfg_mod.relpath,
+                    1,
+                    f"{set_name} not found in config.py — the hot-reload "
+                    "classification sets are required",
+                )
+            )
+    if any(s not in sets for s in _SET_NAMES):
+        return findings
+    # 1. real fields + disjoint.
+    for set_name, (names, line) in sets.items():
+        for name in sorted(names - knobs):
+            findings.append(
+                Finding(
+                    NAME,
+                    cfg_mod.relpath,
+                    line,
+                    f"{set_name} names {name!r} which is not a "
+                    "SchedulerConfig field — ghost classification",
+                )
+            )
+    for i, a in enumerate(_SET_NAMES):
+        for b in _SET_NAMES[i + 1:]:
+            overlap = sets[a][0] & sets[b][0]
+            for name in sorted(overlap):
+                findings.append(
+                    Finding(
+                        NAME,
+                        cfg_mod.relpath,
+                        sets[b][1],
+                        f"knob {name!r} is classified in both {a} and "
+                        f"{b} — one knob, one reload class",
+                    )
+                )
+    reloadable = sets["RELOADABLE_KNOBS"][0] & knobs
+
+    # 2./3. the apply site.
+    sa_mod = project.module("standalone.py")
+    apply_node = _apply_fn(sa_mod) if sa_mod is not None else None
+    if apply_node is None:
+        findings.append(
+            Finding(
+                NAME,
+                "yoda_tpu/standalone.py",
+                1,
+                "standalone.apply_reloadable not found — the hot-reload "
+                "apply site is required",
+            )
+        )
+        return findings
+    applied = _config_attr_reads(apply_node)
+    for knob in sorted(reloadable - set(applied)):
+        findings.append(
+            Finding(
+                NAME,
+                sa_mod.relpath,
+                apply_node.lineno,
+                f"knob {knob!r} is declared RELOADABLE but never "
+                "re-applied in apply_reloadable — a reload would report "
+                "it applied while the old value keeps serving",
+            )
+        )
+    for knob, line in sorted(applied.items()):
+        if knob in knobs and knob not in reloadable:
+            findings.append(
+                Finding(
+                    NAME,
+                    sa_mod.relpath,
+                    line,
+                    f"apply_reloadable applies {knob!r} live but it is "
+                    "not in RELOADABLE_KNOBS — classify it",
+                )
+            )
+
+    # 4. no build-time capture outside the assembly/reload layer.
+    for mod in project.modules:
+        rel = mod.relpath.replace("\\", "/")
+        if rel.endswith(ALLOWED_SUFFIXES) or any(
+            d in rel for d in ALLOWED_DIRS
+        ):
+            continue
+        for knob, line in _config_attr_reads(mod.tree).items():
+            if knob in reloadable:
+                findings.append(
+                    Finding(
+                        NAME,
+                        mod.relpath,
+                        line,
+                        f"reloadable knob {knob!r} read off a config "
+                        "object outside the assembly/reload layer — a "
+                        "build-time capture a hot-reload cannot reach; "
+                        "consume it through the live attribute "
+                        "apply_reloadable writes",
+                    )
+                )
+    return findings

@@ -89,6 +89,75 @@ def _init_jax(platform: str) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def _install_reload_handler(reload_event: threading.Event) -> None:
+    """SIGHUP -> config hot-reload (the classic daemon contract). Main
+    thread only, like the stop handlers; embedded callers trigger
+    reloads through the ConfigMap-watch mtime path instead."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+    sighup = getattr(signal, "SIGHUP", None)
+    if sighup is not None:
+        signal.signal(sighup, lambda *_: reload_event.set())
+
+
+def _config_reload_loop(
+    path: "str | None",
+    reload_event: threading.Event,
+    reloader,
+    stop: threading.Event,
+    *,
+    period_s: float = 2.0,
+) -> None:
+    """The hot-reload trigger loop: fires ``reloader.reload()`` on
+    SIGHUP (reload_event) or when the mounted config file's mtime moves
+    (a ConfigMap update re-projects the file — this IS the
+    ConfigMap-watch). A failed load keeps the running config; the
+    report is logged either way."""
+    last_mtime = None
+    if path:
+        try:
+            last_mtime = os.stat(path).st_mtime
+        except OSError:
+            last_mtime = None
+    while not stop.is_set():
+        if stop.wait(period_s):
+            return
+        trigger = reload_event.is_set()
+        if path:
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                mtime = last_mtime
+            if mtime != last_mtime:
+                last_mtime = mtime
+                trigger = True
+        if not trigger:
+            continue
+        reload_event.clear()
+        report = reloader.reload()
+        if report.get("error"):
+            print(
+                f"yoda-tpu-scheduler: config reload FAILED (kept the "
+                f"running config): {report['error']}",
+                file=sys.stderr,
+            )
+        else:
+            resized = report.get("resized")
+            print(
+                "yoda-tpu-scheduler: config reload: "
+                f"applied={report['applied'] or '-'} "
+                f"requires-drain={report['requires_drain'] or '-'} "
+                f"immutable-kept={report['immutable'] or '-'}"
+                + (
+                    f" resized-to={resized['shards']} "
+                    f"(moved {resized['moved_entries']} queued entr(ies))"
+                    if resized
+                    else ""
+                ),
+                file=sys.stderr,
+            )
+
+
 def _install_stop_handlers(stop: threading.Event) -> None:
     """SIGTERM/SIGINT -> orderly drain. Signals can only be bound from the
     main thread; tests drive main() from worker threads and stop the loop
@@ -230,6 +299,9 @@ def _run_scheduler(args, stop: threading.Event) -> int:
         for st in resync_stacks:
             st.scheduler.on_serve_start = st.reconciler.resync
         if shard_set is not None:
+            shard_set.shard_fence_fn = (
+                stacks[0].reconciler.resynced.is_set
+            )
             # Resync requeues land in the global queue; reroute them to
             # their owning shards BEFORE any pop (the shard loops are
             # still fenced on the resynced gate at that instant, so no
@@ -278,14 +350,15 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             elif shard_set is not None:
                 # Per-shard fences compose the lease with the global
                 # lane's resync gate (a promoted replica's shards must
-                # not bind before ITS resync ran).
+                # not bind before ITS resync ran). Recorded on the shard
+                # set too, so lanes added by a live resize inherit it.
                 g_resynced = stacks[0].reconciler.resynced
                 stacks[0].scheduler.fence_fn = elector.is_leader
+                shard_set.shard_fence_fn = (
+                    lambda: elector.is_leader() and g_resynced.is_set()
+                )
                 for st in stacks[1:]:
-                    st.scheduler.fence_fn = (
-                        lambda: elector.is_leader()
-                        and g_resynced.is_set()
-                    )
+                    st.scheduler.fence_fn = shard_set.shard_fence_fn
             else:
                 for st in stacks:
                     st.scheduler.fence_fn = elector.is_leader
@@ -408,6 +481,66 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                     daemon=True,
                 )
             )
+        # Overload brownout ladder (ISSUE 15): ONE evaluation loop for
+        # the shared monitor (it rides the shared metrics object like
+        # the tracer/SLO engine). Not leadership-gated — a standby's
+        # ladder just reads empty queues; the verdict hooks only bite on
+        # a serving leader's pops anyway. Started unconditionally: the
+        # loop idles at overload_period_s <= 0, and the knob is
+        # hot-reloadable — a reload from 0 must be able to wake it.
+        extra_threads.append(
+            threading.Thread(
+                target=stack.metrics.overload.run_forever,
+                args=(stop,),
+                name="overload-monitor",
+                daemon=True,
+            )
+        )
+        # Config hot-reload (ISSUE 15): SIGHUP + ConfigMap-watch. Live
+        # (RELOADABLE) knobs apply atomically via apply_reloadable;
+        # shard_count goes through ShardSet.resize (sharded mode);
+        # requires-drain / immutable changes are reported and kept.
+        # Federated mode reloads live knobs too (its stacks share the
+        # apply surface); resize stays sharded-only.
+        from yoda_tpu.overload import ConfigReloader, LiveConfig
+        from yoda_tpu.standalone import apply_reloadable
+
+        reload_event = threading.Event()
+        _install_reload_handler(reload_event)
+
+        def _start_resized_shard(st) -> None:
+            t = threading.Thread(
+                target=st.scheduler.serve_forever,
+                args=(stop,),
+                name=f"scheduler-{st.scheduler.shard}",
+                daemon=True,
+            )
+            t.start()
+            extra_threads.append(t)
+
+        live = LiveConfig(config)
+        reloader = ConfigReloader(
+            lambda: _load_config(args.config),
+            live,
+            lambda cfg: apply_reloadable(stacks, cfg),
+            resize_fn=(
+                (
+                    lambda n: shard_set.resize(
+                        n, start_fn=_start_resized_shard
+                    )
+                )
+                if shard_set is not None
+                else None
+            ),
+        )
+        extra_threads.append(
+            threading.Thread(
+                target=_config_reload_loop,
+                args=(args.config, reload_event, reloader, stop),
+                name="config-reload",
+                daemon=True,
+            )
+        )
         # Federation control loop: health probes, rejoin resyncs, and
         # spillover migration — ONE background thread, so degradation
         # never serializes against any member's serve loop.

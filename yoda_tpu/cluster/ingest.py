@@ -181,6 +181,13 @@ class EventBatcher:
         if flush_now:
             self.flush()
 
+    def backlog(self) -> int:
+        """Coalesced events buffered and not yet applied — the overload
+        monitor's ingest-pressure signal (a flood the apply chain is not
+        keeping up with shows here first)."""
+        with self._lock:
+            return self._pending
+
     # --- draining ---
 
     def _take_locked(self) -> "tuple[list[Event], int]":

@@ -700,6 +700,17 @@ class KubeCluster:
         LIST reconcile diff (``_list_rv``) arrive as ONE list call — the
         batched-ingest pipeline's list plumbing. Live watch events still
         deliver per-event via ``fn``."""
+        self._do_add_watcher(fn, replay=replay, batch_fn=batch_fn)
+
+    def remove_watcher(self, fn) -> None:
+        """Unregister a watcher by its per-event fn (live shard resize
+        retiring a dissolved lane); unknown fns are ignored."""
+        with self._lock:
+            self._watchers = [
+                (f, b) for f, b in self._watchers if f is not fn
+            ]
+
+    def _do_add_watcher(self, fn, *, replay: bool = True, batch_fn=None) -> None:
         with self._lock:
             self._watchers.append((fn, batch_fn))
             if replay:

@@ -13,6 +13,80 @@ from dataclasses import dataclass, field, fields
 
 from yoda_tpu.slo.engine import SloTargets
 
+# --- hot-reload classification (ISSUE 15) ----------------------------------
+#
+# Every SchedulerConfig knob belongs to exactly one reload class; the
+# classes drive `SchedulerConfig.diff()` and the SIGHUP/ConfigMap-watch
+# hot-reload surface (yoda_tpu/overload.ConfigReloader):
+#
+# - RELOADABLE_KNOBS apply to a RUNNING scheduler atomically via
+#   `standalone.apply_reloadable` — each one is re-read by its consumer at
+#   use time (a live attribute), never captured into a serve-path local at
+#   build time. The yodalint `reload-safety` pass enforces both directions:
+#   every knob here must be re-applied in apply_reloadable, and no module
+#   outside the assembly/reload layer may read it off a config object.
+# - RESIZE_KNOBS change live through a dedicated topology path
+#   (`shard_count` -> ShardSet.resize: quiesce commits, rebuild the
+#   rendezvous map, reroute the moved ~1/N, resume).
+# - IMMUTABLE_KNOBS define the process identity (mode, kernel backend,
+#   profile set); a reload that changes one is refused with the old value
+#   kept.
+# - Everything else is REQUIRES-DRAIN: correct only through a restart via
+#   the PR 5 failover path (reported by the reloader, never half-applied).
+
+RELOADABLE_KNOBS = frozenset(
+    {
+        "trace_sample_rate",
+        "slo_enabled",
+        "slo_burn_threshold",
+        "immediate_retry_attempts",
+        "bind_retry_attempts",
+        "bind_retry_base_s",
+        "bind_retry_cap_s",
+        "rebalance_min_gain",
+        "rebalance_max_moves",
+        "rebalance_max_victims",
+        "rebalance_preemption",
+        "rebalance_elastic",
+        "node_repair",
+        "node_drain_deadline_s",
+        "overload_period_s",
+        "overload_queue_high",
+        "overload_ingest_high",
+        "overload_cycle_ms_high",
+        "overload_step_down_hold_s",
+        "overload_brownout_admit_per_s",
+        "overload_shed_priority",
+        "pending_index_max",
+    }
+)
+RESIZE_KNOBS = frozenset({"shard_count"})
+IMMUTABLE_KNOBS = frozenset(
+    {
+        "mode",
+        "scheduler_name",
+        "weights",
+        "scoring_strategy",
+        "kernel_platform",
+        "kernel_device_min_elems",
+        "kernel_backend",
+        "mesh_devices",
+        "profiles",
+    }
+)
+
+
+def classify_knob(name: str) -> str:
+    """The reload class of one knob: ``reloadable`` | ``resize`` |
+    ``immutable`` | ``requires-drain``."""
+    if name in RELOADABLE_KNOBS:
+        return "reloadable"
+    if name in RESIZE_KNOBS:
+        return "resize"
+    if name in IMMUTABLE_KNOBS:
+        return "immutable"
+    return "requires-drain"
+
 
 @dataclass(frozen=True)
 class Weights:
@@ -301,6 +375,36 @@ class SchedulerConfig:
     # with a why-pending verdict until capacity frees. 0 = unlimited.
     tenant_quota_chips: int = 0
     tenant_quota_hbm_gib: float = 0.0
+    # Overload brownout ladder (yoda_tpu/overload.py, docs/OPERATIONS.md
+    # "Overload brownout + hot-reload" runbook): the scheduler's own
+    # self-protection under flash-crowd floods. Pressure = the max of the
+    # normalized signals below (plus the SLO engine's burn-rate alert);
+    # the ladder climbs NOMINAL -> ELEVATED (pause the rebalancer /
+    # node-health repair passes, drop trace sampling to 0) -> BROWNOUT
+    # (cap per-tenant admission through the DRF quota path) -> SHED (park
+    # new non-prod-tier arrivals with an `overload-shed` why-pending
+    # verdict; they requeue when the ladder steps down). Step-up is one
+    # level per evaluation; step-down is debounced by
+    # overload_step_down_hold_s of sustained calm, so flapping load
+    # cannot thrash features. overload_period_s drives the background
+    # evaluation loop (0 disables it; the monitor can still be driven
+    # manually). Signal thresholds: 0 disables that signal.
+    overload_period_s: float = 1.0
+    overload_queue_high: int = 10000       # queued (non-shed) entries
+    overload_ingest_high: int = 50000      # buffered ingest events
+    overload_cycle_ms_high: float = 250.0  # serve-cycle p99 wall ms
+    overload_step_down_hold_s: float = 15.0
+    # BROWNOUT admission cap: scheduling draws admitted per tenant per
+    # second (token bucket on the monitor clock); over-cap draws park
+    # with a quota verdict until the bucket refills or the ladder drops.
+    overload_brownout_admit_per_s: float = 10.0
+    # Pods whose tpu/priority is at least this are PROD-TIER: never shed
+    # (and effectively exempt from brownout caps sized above their rate).
+    overload_shed_priority: int = 10
+    # Why-pending index bound (tracing.PendingIndex): LRU over keys, so a
+    # million-pod shed flood cannot grow why-pending state without limit;
+    # evictions count into yoda_pending_evicted_total.
+    pending_index_max: int = 2048
     # Scheduler shard-out (framework/shards.py, docs/OPERATIONS.md
     # sharding runbook): partition the node fleet by ICI slice/pool
     # across this many INDEPENDENT serve loops (rendezvous-hashed
@@ -752,7 +856,62 @@ class SchedulerConfig:
             raise ValueError(
                 f"mesh_devices must be a positive int, got {cfg.mesh_devices!r}"
             )
+        for knob in (
+            "overload_period_s",
+            "overload_cycle_ms_high",
+            "overload_step_down_hold_s",
+        ):
+            v = getattr(cfg, knob)
+            if isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0:
+                raise ValueError(
+                    f"{knob} must be >= 0 (0 disables it), got {v!r}"
+                )
+        for knob in ("overload_queue_high", "overload_ingest_high"):
+            v = getattr(cfg, knob)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                raise ValueError(
+                    f"{knob} must be an int >= 0 (0 disables the signal), "
+                    f"got {v!r}"
+                )
+        if isinstance(
+            cfg.overload_brownout_admit_per_s, bool
+        ) or not isinstance(
+            cfg.overload_brownout_admit_per_s, (int, float)
+        ) or cfg.overload_brownout_admit_per_s <= 0:
+            raise ValueError(
+                "overload_brownout_admit_per_s must be > 0, got "
+                f"{cfg.overload_brownout_admit_per_s!r}"
+            )
+        if isinstance(cfg.overload_shed_priority, bool) or not isinstance(
+            cfg.overload_shed_priority, int
+        ):
+            raise ValueError(
+                "overload_shed_priority must be an int (pods at or above "
+                f"it are never shed), got {cfg.overload_shed_priority!r}"
+            )
+        if (
+            isinstance(cfg.pending_index_max, bool)
+            or not isinstance(cfg.pending_index_max, int)
+            or cfg.pending_index_max < 16
+        ):
+            raise ValueError(
+                f"pending_index_max must be an int >= 16, got "
+                f"{cfg.pending_index_max!r}"
+            )
         return cfg
+
+    def diff(self, new: "SchedulerConfig") -> "dict[str, str]":
+        """Changed knobs between this config and ``new``, each mapped to
+        its reload class (:func:`classify_knob`) — the hot-reload
+        surface's decision table: ``reloadable`` knobs apply live via
+        ``standalone.apply_reloadable``, ``resize`` goes through
+        ``ShardSet.resize``, ``requires-drain`` / ``immutable`` are
+        reported and kept at their old values."""
+        out: dict[str, str] = {}
+        for f in fields(self):
+            if getattr(self, f.name) != getattr(new, f.name):
+                out[f.name] = classify_knob(f.name)
+        return out
 
     def effective_weights(self) -> Weights:
         """The weights the score path actually runs with: under

@@ -92,6 +92,8 @@ class SchedulingQueue:
         share_fn: "Callable[[str], float] | None" = None,
         quota_fn: "Callable[[str, PodSpec], str | None] | None" = None,
         on_quota_park: "Callable[[QueuedPodInfo, str], None] | None" = None,
+        shed_fn: "Callable[[PodSpec], str | None] | None" = None,
+        on_shed: "Callable[[QueuedPodInfo, str], None] | None" = None,
     ) -> None:
         if sort_plugin is not None:
             self._less = sort_plugin.less
@@ -120,6 +122,19 @@ class SchedulingQueue:
         self._quota_fn = quota_fn
         self.on_quota_park = on_quota_park
         self.quota_parks = 0  # total entries quota-parked (metrics)
+        # Overload shed (ISSUE 15, yoda_tpu/overload.py): shed_fn(pod)
+        # returns a why-pending message when the entry must PARK at pop
+        # time instead of scheduling (the brownout ladder's SHED level) —
+        # checked per ITEM (unlike quota_fn's per-tenant probe, the
+        # verdict depends on the pod's tier), parking into the
+        # unresolvable pool so the entry requeues on the ladder's
+        # step-down (an explicit move_all_to_active) like any other
+        # capacity event. on_shed(qpi, why) is the observability hook
+        # (counter + overload-shed pending verdict), fired under the
+        # queue lock — it must not re-enter the queue.
+        self._shed_fn = shed_fn
+        self.on_shed = on_shed
+        self.shed_parks = 0           # lifetime shed count
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._seq = itertools.count()
@@ -161,6 +176,39 @@ class SchedulingQueue:
             return tenants
         return sorted(tenants, key=lambda t: (self._share(t), t))
 
+    def _shed(self, pod: PodSpec) -> "str | None":
+        if self._shed_fn is None:
+            return None
+        try:
+            return self._shed_fn(pod)
+        except Exception:  # noqa: BLE001 — a bad hook must never wedge pops
+            return None
+
+    def _shed_park_locked(self, qpi: QueuedPodInfo, why: str) -> None:
+        """Park a shed entry in the unresolvable pool (lock held): it
+        re-enters on the ladder's step-down (move_all_to_active) or any
+        capacity event, and re-takes the shed check at its next pop."""
+        qpi.unschedulable_message = why
+        self._unschedulable[qpi.pod.key] = qpi
+        self.shed_parks += 1
+        if self.on_shed is not None:
+            try:
+                self.on_shed(qpi, why)
+            except Exception:  # noqa: BLE001 — observability must not wedge pops
+                pass
+
+    def overload_depth(self) -> int:
+        """Entries actively contending for the serve path (active +
+        backoff) — the overload monitor's queue-pressure signal. The
+        parked-unresolvable pool is EXCLUDED on purpose: shed and
+        quota-capped work is already parked by the ladder itself, and
+        counting it would wedge the step-down that requeues it (the
+        ladder would hold SHED forever against its own backlog)."""
+        with self._lock:
+            return sum(len(h) for h in self._active.values()) + len(
+                self._backoff
+            )
+
     def _quota_park_locked(self, qpi: QueuedPodInfo, why: str) -> None:
         """Park an over-quota entry in the unresolvable pool (lock held):
         no backoff ladder — it re-enters the active queue on the next
@@ -186,6 +234,10 @@ class SchedulingQueue:
             item = heapq.heappop(heap)
             if not heap:
                 del self._active[tenant]
+            shed_why = self._shed(item.qpi.pod)
+            if shed_why is not None:
+                self._shed_park_locked(item.qpi, shed_why)
+                continue
             if self._quota_fn is not None:
                 why = self._quota_fn(tenant, item.qpi.pod)
                 if why is not None:
@@ -361,6 +413,13 @@ class SchedulingQueue:
                 for item in heap:
                     if not pred(item.qpi.pod):
                         keep.append(item)
+                        continue
+                    shed_why = self._shed(item.qpi.pod)
+                    if shed_why is not None:
+                        # Per-item (the verdict is tier-dependent): a
+                        # prod gang gathers past a shed spot sibling
+                        # tenant-mate without inheriting its verdict.
+                        self._shed_park_locked(item.qpi, shed_why)
                     elif quota_why is not None:
                         self._quota_park_locked(item.qpi, quota_why)
                     elif limit is None or n_taken < limit:
@@ -383,7 +442,11 @@ class SchedulingQueue:
                     if (
                         limit is None or n_taken + len(back_taken) < limit
                     ) and pred(entry[2].pod):
-                        back_taken.append(entry[2])
+                        shed_why = self._shed(entry[2].pod)
+                        if shed_why is not None:
+                            self._shed_park_locked(entry[2], shed_why)
+                        else:
+                            back_taken.append(entry[2])
                     else:
                         still.append(entry)
                 if back_taken:

@@ -258,6 +258,13 @@ class BindExecutor:
         drain on the worker; the in-flight HTTP call is bounded by the API
         client's request timeout either way."""
         self.stop_event.set()
+        self.release()
+
+    def release(self) -> None:
+        """Shut the worker pool WITHOUT firing ``stop_event`` — the live
+        shard resize retires one lane's executor while the process-wide
+        stop event (shared by every lane's interruptible sleeps) must
+        stay unset. Idle daemon workers exit on their sentinels."""
         with self._lock:
             pool, self._pool = self._pool, None
         if pool is not None:

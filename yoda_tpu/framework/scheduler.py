@@ -167,7 +167,14 @@ class Scheduler:
         # nothing staged, nothing committed.
         self.shard: "str | None" = None
         self.commit_fn: "Callable[[list], tuple[bool, str]] | None" = None
+        # Live shard resize (ShardSet.resize): a dissolved shard's loop
+        # is RETIRED — permanently fenced (no bind can start) and its
+        # serve_forever thread exits at the next turn. Queued work was
+        # already rerouted by the resizer; anything that straggles in
+        # parks fenced until the final reroute sweep moves it.
+        self.retired = threading.Event()
         self._search_rotor = 0
+        # (retire() lives below with the loop methods.)
         # pod uid -> node nominated by preemption this session; consulted at
         # bind time so a pod that ends up on a DIFFERENT node gets its
         # stale status.nominatedNodeName cleared (phantom earmarked
@@ -184,7 +191,10 @@ class Scheduler:
     def _fenced(self) -> bool:
         """True when a leader gate is wired and this process does NOT hold
         leadership right now: no bind may hit the API. A raising fence
-        check counts as fenced — fail closed."""
+        check counts as fenced — fail closed. A RETIRED loop (its shard
+        dissolved by a live resize) is fenced forever."""
+        if self.retired.is_set():
+            return True
         fn = self.fence_fn
         if fn is None:
             return False
@@ -647,6 +657,12 @@ class Scheduler:
             and self.on_nominated is not None
         ):
             self.on_nominated(pod, None)
+
+    def retire(self) -> None:
+        """Permanently fence this loop and make its serve thread exit
+        (a live shard resize dissolved its lane). Idempotent."""
+        self.retired.set()
+        self._signal_activity()
 
     def _signal_activity(self) -> None:
         with self._activity:
@@ -1153,6 +1169,10 @@ class Scheduler:
         in-flight members' reservations stay charged to the accountant, so
         the overlapped evaluation already sees their capacity as consumed."""
         while not stop.is_set():
+            if self.retired.is_set():
+                # Dissolved by a live shard resize: the thread exits; the
+                # resizer already rerouted this lane's queue.
+                return
             if self._fenced():
                 # Leader fencing: park the queue until leadership returns.
                 # Permit expirations still sweep so parked gangs cannot

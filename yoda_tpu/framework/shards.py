@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from collections import OrderedDict
 from typing import Callable, Iterable, Mapping
 
 from yoda_tpu.api.requests import LabelParseError, gang_name_of, pod_request
@@ -169,6 +170,18 @@ class ShardRouter:
     back into a component lock).
     """
 
+    #: Occupancy tie-break quantum: queue depths bucket at log2 of
+    #: (depth // QUANTUM), so routing ignores depth noise below a real
+    #: backlog and behaves exactly like pure rendezvous on balanced or
+    #: drained fleets — only genuine skew (a starved shard hundreds of
+    #: entries deep) re-steers new arrivals.
+    OCCUPANCY_QUANTUM = 64
+
+    #: Bound on remembered gang routing decisions (whole-gang
+    #: consistency under the occupancy tie-break: the first member's
+    #: answer pins the gang until a structural generation bump).
+    MAX_GANG_MEMO = 4096
+
     def __init__(self, shard_map: ShardMap) -> None:
         self.map = shard_map
         self._lock = threading.Lock()
@@ -179,6 +192,37 @@ class ShardRouter:
         self._pools: dict[str, _PoolAgg] = {}
         self._by_shard: dict[int, list[str]] = {}
         self.generation = 0  # bumped per aggregate rebuild (reroute gate)
+        # Occupancy-aware routing (ISSUE 15 satellite): per-shard live
+        # queue depth, wired by build_sharded_stacks (ShardSet.queue_
+        # depth). None = pure rendezvous. Rendezvous "ties" are broken
+        # by depth BUCKET: among capacity-feasible shards, only those in
+        # the lowest occupancy bucket stay candidates, then rendezvous
+        # picks deterministically — deterministic given the depth
+        # snapshot, and starved work stops defaulting to the global lane.
+        self.depth_fn: "Callable[[int], int] | None" = None
+        # gang routing key -> (generation, lane): every member of a gang
+        # must compute the SAME lane even as depths move between member
+        # arrivals; the memo pins the first member's answer until a
+        # structural fleet change (generation bump) or a map swap.
+        self._gang_memo: "OrderedDict[str, tuple[int, str]]" = OrderedDict()
+
+    def swap_map(self, new_map: ShardMap) -> None:
+        """Install a new rendezvous map (live shard resize): aggregates
+        rebuild lazily, gang memos drop (fresh decisions under the new
+        topology), and the generation bumps so reroute passes treat
+        every queued entry as re-routable."""
+        with self._lock:
+            self.map = new_map
+            self._dirty = True
+            self._gang_memo.clear()
+            self.generation += 1
+
+    def pools_snapshot(self) -> "list[str]":
+        """The live pool ids (resize movement accounting)."""
+        with self._lock:
+            if self._dirty:
+                self._rebuild_locked()
+            return list(self._pools)
 
     # --- watch feed ---
 
@@ -289,9 +333,44 @@ class ShardRouter:
                     if slots >= need:
                         feasible.append(s)
                 key = gang_name_of(pod.labels) or pod.uid
+            is_gang = gang is not None
+            gen = self.generation
+            if is_gang:
+                memo = self._gang_memo.get(key)
+                if memo is not None and memo[0] == gen:
+                    # Whole-gang consistency: later members (and reroute
+                    # passes) repeat the first member's answer until a
+                    # structural change invalidates it.
+                    self._gang_memo.move_to_end(key)
+                    return memo[1]
+            lane = self._pick_locked(feasible, key)
+            if is_gang:
+                self._gang_memo[key] = (gen, lane)
+                while len(self._gang_memo) > self.MAX_GANG_MEMO:
+                    self._gang_memo.popitem(last=False)
+            return lane
+
+    def _pick_locked(self, feasible: "list[int]", key: str) -> str:
+        """Choose among capacity-feasible shards: lowest occupancy
+        BUCKET first (quantized live queue depth — the tie-break that
+        steers work off starved shards), then keyed rendezvous.
+        Deterministic given the depth snapshot; pure rendezvous when no
+        depth source is wired or depths are balanced."""
         if not feasible:
             return GLOBAL_LANE
+        candidates = feasible
+        depth_fn = self.depth_fn
+        if depth_fn is not None and len(feasible) > 1:
+            buckets: dict[int, int] = {}
+            for s in feasible:
+                try:
+                    depth = max(int(depth_fn(s)), 0)
+                except Exception:  # noqa: BLE001 — a sick depth source reads as empty
+                    depth = 0
+                buckets[s] = (depth // self.OCCUPANCY_QUANTUM).bit_length()
+            best = min(buckets.values())
+            candidates = [s for s in feasible if buckets[s] == best]
         chosen = max(
-            feasible, key=lambda s: _digest("route", key, str(s))
+            candidates, key=lambda s: _digest("route", key, str(s))
         )
         return shard_name(chosen)

@@ -291,7 +291,9 @@ class SchedulingMetrics:
         tracer=None,
         pending=None,
         slo=None,
+        overload=None,
     ):
+        from yoda_tpu.overload import OverloadMonitor
         from yoda_tpu.slo import SloEngine
         from yoda_tpu.tracing import PendingIndex, Tracer
 
@@ -303,6 +305,17 @@ class SchedulingMetrics:
         # per-tenant SLIs across every profile stack and federation
         # member that can bind the tenant's pods.
         self.slo = slo if slo is not None else SloEngine()
+        # Overload brownout ladder (ISSUE 15, yoda_tpu/overload.py): ONE
+        # ladder across every serve loop sharing this registry — a shard
+        # lane shedding while its sibling admits would defeat the
+        # self-protection. build_stack registers queues/ingestors as
+        # pressure sources and composes the repair-pause gates.
+        self.overload = (
+            overload if overload is not None else OverloadMonitor()
+        )
+        self.overload.attach(
+            tracer=self.tracer, slo=self.slo
+        )
         r = self.registry
         self.attempts = r.counter(
             "yoda_scheduling_attempts_total",
@@ -624,6 +637,39 @@ class SchedulingMetrics:
             "+ lifecycle span ring) before being read — raise "
             "trace_capacity if this climbs during incidents",
             collect_fn=lambda: self._trace_drops + self.tracer.dropped,
+        )
+        # Overload brownout ladder (ISSUE 15, docs/OPERATIONS.md
+        # "Overload brownout + hot-reload" runbook): all lazy reads of
+        # the shared monitor / pending index.
+        ov = self.overload
+        ov.attach(latency=self.latency)
+        self.overload_level = r.gauge(
+            "yoda_overload_level",
+            "Brownout-ladder position (0=nominal 1=elevated 2=brownout "
+            "3=shed): at 1+ the repair passes pause and trace sampling "
+            "drops to 0, at 2+ per-tenant admission is capped, at 3 new "
+            "non-prod arrivals park with overload-shed verdicts",
+            collect_fn=lambda: float(ov.level_idx),
+        )
+        self.overload_transitions = r.counter(
+            "yoda_overload_transitions_total",
+            "Brownout-ladder level changes (rapid climbing means the "
+            "overload_* high-water marks sit below steady-state load; "
+            "step-down flapping should be impossible by debounce)",
+            collect_fn=lambda: float(ov.transitions),
+        )
+        self.overload_shed = r.counter(
+            "yoda_overload_shed_total",
+            "Non-prod scheduling draws parked by SHED (they requeue "
+            "when the ladder steps down — shed is deferral, never loss)",
+            collect_fn=lambda: float(ov.shed_total),
+        )
+        self.pending_evicted = r.counter(
+            "yoda_pending_evicted_total",
+            "Why-pending entries LRU-evicted at the pending_index_max "
+            "bound (a shed flood recycles oldest keys; `explain` then "
+            "answers 'aged out' for them)",
+            collect_fn=lambda: float(self.pending.evicted),
         )
 
     # --- fleet gauges (lazy, fed by the informer at scrape time) ---

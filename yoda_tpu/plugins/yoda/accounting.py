@@ -79,6 +79,15 @@ class ChipAccountant(ReservePlugin):
         # DAG forbids informer reads under the accountant lock).
         self._staged: set[str] = set()
         self._stage_seq = 0
+        # Live shard resize (ShardSet.resize): the commit QUIESCE
+        # barrier. Cleared, commit_staged waits (bounded) before
+        # validating, so the resizer gets one instant where no commit is
+        # mid-validation while it swaps the rendezvous map and reroutes
+        # queues. Staged claims themselves stay valid across the swap —
+        # validation is partition-agnostic — which is how in-flight
+        # gangs complete on their staged claims through a resize.
+        self._commit_gate = threading.Event()
+        self._commit_gate.set()
         self.track_capacity = False
         self._capacity: dict[str, int] = {}   # node -> healthy chips
         self.commit_commits = 0               # committed stage groups
@@ -202,6 +211,12 @@ class ChipAccountant(ReservePlugin):
         committed — or uids with no claim at all — validate vacuously, so
         unsharded stacks (nothing ever staged) pay one dict probe per
         uid and the branch below never runs."""
+        # Resize quiesce: wait (never under any lock) while the barrier
+        # is held. Bounded — a wedged resizer must not wedge commits
+        # forever; after the timeout the commit proceeds, still correct
+        # (validation does not read the shard map).
+        if not self._commit_gate.is_set():
+            self._commit_gate.wait(timeout=10.0)
         with self._lock:
             mine = [
                 (u, self._claims[u])
@@ -234,6 +249,18 @@ class ChipAccountant(ReservePlugin):
                 self._staged.discard(u)
             self.commit_commits += 1
             return True, ""
+
+    def hold_commits(self) -> None:
+        """Close the resize quiesce barrier: commit_staged callers wait
+        (bounded) until :meth:`resume_commits`."""
+        self._commit_gate.clear()
+
+    def resume_commits(self) -> None:
+        self._commit_gate.set()
+
+    def staged_count(self) -> int:
+        with self._lock:
+            return len(self._staged)
 
     def staged_uids(self) -> "dict[str, str]":
         """uid -> staging shard for every claim still pending commit —

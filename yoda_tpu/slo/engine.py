@@ -196,6 +196,13 @@ class SloEngine:
             if queue not in self._queues:
                 self._queues.append(queue)
 
+    def remove_queue(self, queue) -> None:
+        """Retire a queue (a shard lane dissolved by a live resize): its
+        pending work no longer feeds the starvation SLIs."""
+        with self._lock:
+            if queue in self._queues:
+                self._queues.remove(queue)
+
     # --- the record paths (serve-path cheap) ---
 
     def observe_enqueue(self, pod, *, now: "float | None" = None) -> None:
@@ -517,6 +524,16 @@ class SloEngine:
         if cache is not None and now - at < self.cache_ttl_s:
             return cache
         return self.evaluate(now)
+
+    def burn_snapshot(self) -> "tuple[float, float]":
+        """(fast, slow) fleet burn rates from the cached evaluation —
+        the overload monitor's burn-pressure signal (cheap: at most one
+        window walk per cache_ttl_s across every consumer)."""
+        fleet = self._cached().get("fleet", {})
+        return (
+            float(fleet.get("burn_fast", 0.0) or 0.0),
+            float(fleet.get("burn_slow", 0.0) or 0.0),
+        )
 
     # --- Prometheus views (lazy collect_fns, observability.py) ---
 

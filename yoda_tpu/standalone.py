@@ -33,13 +33,14 @@ from yoda_tpu.rebalance import Rebalancer
 def _metrics_from_config(
     config: SchedulerConfig, clock=time.monotonic
 ) -> SchedulingMetrics:
-    """One SchedulingMetrics with the config-derived tracer AND fleet SLO
-    engine. Used both for a stack's own metrics and for the SHARED
-    registry of profile stacks / federation members — the tracer, the
-    why-pending index, and the SLO engine must each be ONE object across
-    every serve loop that can touch a tenant's pods."""
+    """One SchedulingMetrics with the config-derived tracer, fleet SLO
+    engine, overload monitor, and why-pending index. Used both for a
+    stack's own metrics and for the SHARED registry of profile stacks /
+    federation members / shard lanes — each of these must be ONE object
+    across every serve loop that can touch a tenant's pods."""
+    from yoda_tpu.overload import OverloadMonitor
     from yoda_tpu.slo import SloEngine
-    from yoda_tpu.tracing import Tracer
+    from yoda_tpu.tracing import PendingIndex, Tracer
 
     return SchedulingMetrics(
         tracer=Tracer(
@@ -48,6 +49,7 @@ def _metrics_from_config(
             sink=config.trace_sink or None,
             sink_max_bytes=config.trace_sink_max_bytes,
         ),
+        pending=PendingIndex(capacity=config.pending_index_max),
         slo=SloEngine(
             targets=config.slo_targets,
             enabled=config.slo_enabled,
@@ -55,6 +57,16 @@ def _metrics_from_config(
             fast_window_s=config.slo_burn_fast_window_s,
             slow_window_s=config.slo_burn_slow_window_s,
             burn_threshold=config.slo_burn_threshold,
+            clock=clock,
+        ),
+        overload=OverloadMonitor(
+            queue_high=config.overload_queue_high,
+            ingest_high=config.overload_ingest_high,
+            cycle_ms_high=config.overload_cycle_ms_high,
+            step_down_hold_s=config.overload_step_down_hold_s,
+            brownout_admit_per_s=config.overload_brownout_admit_per_s,
+            shed_priority_floor=config.overload_shed_priority,
+            period_s=config.overload_period_s,
             clock=clock,
         ),
     )
@@ -88,6 +100,10 @@ class Stack:
     # first watch event); the background ladder/repair loop is started
     # by cli.py when node_health_period_s > 0.
     nodehealth: NodeHealthMonitor | None = None
+    # The watcher fns build_stack registered on the cluster for THIS
+    # stack — what ShardSet.resize unregisters when it retires a
+    # dissolved shard lane (cluster.remove_watcher by fn identity).
+    watch_fns: tuple = ()
 
 
 def build_stack(
@@ -257,32 +273,72 @@ def build_stack(
     # runbook): the watch-driven TenantLedger feeds dominant-share
     # ordering and quota admission into the queue. Off (the default) the
     # queue runs tenant-blind, bit-identical to the pre-tenant behavior.
+    from yoda_tpu.api.requests import gang_name_of
+
     ledger = None
-    quota_fn = None
-    on_quota_park = None
+    tenant_quota_fn = None
     if config.tenant_fairness:
         ledger = TenantLedger()
         if config.tenant_quota_chips or config.tenant_quota_hbm_gib:
             hbm_cap_mib = int(config.tenant_quota_hbm_gib * 1024)
-            quota_fn = lambda tenant, pod: ledger.quota_verdict(  # noqa: E731
+            tenant_quota_fn = lambda tenant, pod: ledger.quota_verdict(  # noqa: E731
                 tenant,
                 pod,
                 chips_cap=config.tenant_quota_chips,
                 hbm_cap_mib=hbm_cap_mib,
             )
 
-        from yoda_tpu.api.requests import gang_name_of
+    # Overload brownout ladder (ISSUE 15, yoda_tpu/overload.py): the
+    # SHARED monitor (one per metrics registry, like the tracer) rides
+    # the queue's verdict hooks — BROWNOUT caps per-tenant admission
+    # through the quota path, SHED parks non-prod draws per item. At
+    # NOMINAL both hooks are one attribute compare.
+    overload = metrics.overload
 
-        def on_quota_park(qpi, why: str) -> None:
-            # Fired under the queue lock: counter bump + why-pending
-            # verdict only, never back into the queue.
-            metrics.tenant_quota_parks.inc()
-            metrics.pending.record(
-                qpi.pod.key,
-                kind="quota-park",
-                message=why,
-                gang=gang_name_of(qpi.pod.labels),
-            )
+    def quota_fn(tenant: str, pod) -> "str | None":
+        why = overload.quota_verdict(tenant)
+        if why is not None:
+            return why
+        if tenant_quota_fn is not None:
+            return tenant_quota_fn(tenant, pod)
+        return None
+
+    def on_quota_park(qpi, why: str) -> None:
+        # Fired under the queue lock: counter bump + why-pending
+        # verdict only, never back into the queue.
+        metrics.tenant_quota_parks.inc()
+        metrics.pending.record(
+            qpi.pod.key,
+            kind="quota-park",
+            message=why,
+            gang=gang_name_of(qpi.pod.labels),
+            shard=shard,
+        )
+
+    def shed_fn(pod) -> "str | None":
+        why = overload.shed_verdict(pod)
+        if why is None:
+            return None
+        g = gang_name_of(pod.labels)
+        if g:
+            status = gang.gang_status(g)
+            if status is not None and (status[1] or status[2]):
+                # Members already mid-flight (Permit-parked or bound):
+                # shedding the rest would strand the barrier until the
+                # permit timeout — admit instead, the whole-gang
+                # atomicity half of the shed contract.
+                return None
+        return why
+
+    def on_shed(qpi, why: str) -> None:
+        overload.note_shed()
+        metrics.pending.record(
+            qpi.pod.key,
+            kind="overload-shed",
+            message=why,
+            gang=gang_name_of(qpi.pod.labels),
+            shard=shard,
+        )
 
     queue = SchedulingQueue(
         framework.queue_sort,
@@ -292,7 +348,12 @@ def build_stack(
         share_fn=ledger.dominant_share if ledger is not None else None,
         quota_fn=quota_fn,
         on_quota_park=on_quota_park,
+        shed_fn=shed_fn,
+        on_shed=on_shed,
     )
+    # The queue is a pressure source for the ladder (its overload_depth
+    # excludes already-shed entries) and a step-down reactivation target.
+    overload.add_queue(queue)
     # Fleet SLO engine (ISSUE 12): this stack's queue feeds the
     # per-tenant pending/starvation side of the SLIs (the engine is
     # shared across profile stacks and federation members, so every
@@ -760,6 +821,7 @@ def build_stack(
     per_event_sinks.append(gang.handle)
     if ledger is not None:
         per_event_sinks.append(ledger.handle)
+    registered_fns: list = []  # -> Stack.watch_fns (resize retirement)
     ingestor = None
     if config.ingest_batch_window_ms > 0:
 
@@ -784,18 +846,23 @@ def build_stack(
             on_batch=on_ingest_batch,
         )
         cluster.add_watcher(ingestor.offer, batch_fn=ingestor.offer_batch)
+        registered_fns.append(ingestor.offer)
+        overload.add_ingestor(ingestor)
     else:
         for sink in per_event_sinks:
             cluster.add_watcher(sink)
+            registered_fns.append(sink)
         # batch_fn lets list-shaped deliveries (startup replay, a relist
         # after 410/partition) apply under one informer lock even with
         # the live stream per-event.
         cluster.add_watcher(
             informer.handle, batch_fn=informer.handle_batch
         )
+        registered_fns.append(informer.handle)
         if recorder is not None:
             # Prune aggregation state for deleted pods (ADVICE r2).
             cluster.add_watcher(recorder.handle)
+            registered_fns.append(recorder.handle)
 
     if not getattr(metrics, "_fleet_attached", False):
         # Fleet gauges are profile-independent; attach once (the first
@@ -888,8 +955,13 @@ def build_stack(
         preemption=config.rebalance_preemption,
         elastic=config.rebalance_elastic,
         max_victims=config.rebalance_max_victims,
+        # The overload ladder's first degradation step: at ELEVATED and
+        # above the background repack/preemption pass yields its cycles
+        # to the serve loops (repairs_paused composes into the gate).
         gate_fn=lambda: (
-            not scheduler._fenced() and reconciler.resynced.is_set()
+            not scheduler._fenced()
+            and reconciler.resynced.is_set()
+            and not overload.repairs_paused()
         ),
         # Graceful drain: the rebalancer's pass migrates bound gangs off
         # DRAINING nodes proactively, before the monitor's deadline
@@ -902,7 +974,9 @@ def build_stack(
     # warm-start contract — no repair on un-resynced state.
     nodehealth.scheduler = scheduler
     nodehealth.gate_fn = lambda: (
-        not scheduler._fenced() and reconciler.resynced.is_set()
+        not scheduler._fenced()
+        and reconciler.resynced.is_set()
+        and not overload.repairs_paused()
     )
     return Stack(
         cluster,
@@ -922,7 +996,53 @@ def build_stack(
         ingestor=ingestor,
         tenants=ledger,
         nodehealth=nodehealth,
+        watch_fns=tuple(registered_fns),
     )
+
+
+def apply_reloadable(stacks: "list[Stack]", config: SchedulerConfig) -> None:
+    """Apply every RELOADABLE knob of ``config`` to a RUNNING assembly
+    (profile stacks, shard lanes — ``stacks`` is the live list). This is
+    THE hot-reload apply site: the yodalint ``reload-safety`` pass
+    cross-checks that every knob in ``config.RELOADABLE_KNOBS`` is
+    re-applied here and that nothing outside it applies an undeclared
+    knob live. Each assignment lands on an attribute its consumer
+    re-reads at use time, so the apply is atomic per knob — no consumer
+    ever sees a half-reloaded composite."""
+    metrics = stacks[0].metrics
+    ov = metrics.overload
+    ov.period_s = float(config.overload_period_s)
+    ov.queue_high = int(config.overload_queue_high)
+    ov.ingest_high = int(config.overload_ingest_high)
+    ov.cycle_ms_high = float(config.overload_cycle_ms_high)
+    ov.step_down_hold_s = float(config.overload_step_down_hold_s)
+    ov.brownout_admit_per_s = float(config.overload_brownout_admit_per_s)
+    ov.shed_priority_floor = int(config.overload_shed_priority)
+    # Routed through the monitor so a reload during a feature-pause
+    # updates the step-down restore value instead of unpausing tracing.
+    ov.set_base_sample_rate(config.trace_sample_rate)
+    metrics.slo.enabled = config.slo_enabled
+    metrics.slo.burn_threshold = config.slo_burn_threshold
+    metrics.pending.capacity = max(config.pending_index_max, 16)
+    from yoda_tpu.cluster.retry import BackoffPolicy
+
+    for st in stacks:
+        st.queue.immediate_retry_attempts = config.immediate_retry_attempts
+        if st.binder is not None:
+            st.binder.policy = BackoffPolicy(
+                attempts=max(config.bind_retry_attempts, 0),
+                base_s=config.bind_retry_base_s,
+                cap_s=config.bind_retry_cap_s,
+            )
+        if st.rebalancer is not None:
+            st.rebalancer.min_gain = config.rebalance_min_gain
+            st.rebalancer.max_moves = config.rebalance_max_moves
+            st.rebalancer.max_victims = config.rebalance_max_victims
+            st.rebalancer.enable_preemption = config.rebalance_preemption
+            st.rebalancer.enable_elastic = config.rebalance_elastic
+        if st.nodehealth is not None:
+            st.nodehealth.repair = config.node_repair
+            st.nodehealth.drain_deadline_s = config.node_drain_deadline_s
 
 
 def build_federation(
@@ -997,6 +1117,12 @@ class ShardSet:
     accountant: ChipAccountant
     metrics: SchedulingMetrics
     config: SchedulerConfig
+    # Live-resize plumbing (ISSUE 15): the assembly inputs resize() needs
+    # to build new shard stacks, and the fence new lanes inherit (cli
+    # sets it to its leadership+resync composition).
+    clock: object = time.monotonic
+    stop_event: "threading.Event | None" = None
+    shard_fence_fn: object = None
 
     @property
     def global_stack(self) -> Stack:
@@ -1005,6 +1131,17 @@ class ShardSet:
     @property
     def shard_stacks(self) -> "list[Stack]":
         return self.stacks[1:]
+
+    def queue_depth(self, shard_idx: int) -> int:
+        """Live queue depth of shard ``s<idx>`` — the router's occupancy
+        tie-break signal (0 for unknown/retired lanes)."""
+        from yoda_tpu.framework.shards import shard_name
+
+        name = shard_name(shard_idx)
+        for st in self.stacks[1:]:
+            if st.scheduler.shard == name:
+                return len(st.queue)
+        return 0
 
     def reroute(self) -> int:
         """Move queued entries whose owning lane is not the router's
@@ -1037,6 +1174,12 @@ class ShardSet:
                     continue
                 target = lanes.get(want)
                 if target is None or not st.queue.remove(pod.uid):
+                    continue
+                if target.queue.find(pod.uid) is not None:
+                    # Already queued on the target lane (a replay or a
+                    # requeue raced the move): dropping the source entry
+                    # IS the dedupe — one pod, one queue entry.
+                    moved += 1
                     continue
                 # Attempts PRESERVED across the move: resetting them
                 # would erase the rescue marker (global entries with
@@ -1108,6 +1251,207 @@ class ShardSet:
                     )
                     moved += 1
         return moved
+
+    def resize(
+        self,
+        new_count: int,
+        *,
+        start_fn=None,
+        quiesce_timeout_s: float = 5.0,
+    ) -> dict:
+        """Live ``shard_count`` resize — zero downtime, no restart
+        (ISSUE 15). The sequence:
+
+        1. **Quiesce commits at the ChipAccountant barrier**: new
+           commit validations wait; in-flight bind fan-outs are given
+           ``quiesce_timeout_s`` to land. Staged claims stay valid
+           across the swap (validation never reads the shard map), so
+           in-flight gangs complete on their staged claims — nothing
+           mid-flight is aborted.
+        2. **Rebuild the rendezvous map**: a fresh ``ShardMap(n)``
+           swaps into the router (gang memos cleared, generation
+           bumped) and every surviving shard's informer gets its new
+           partition filter (snapshots invalidated, rebuilt lazily).
+        3. **Grow/shrink lanes**: new shard stacks are built against
+           the same cluster/accountant/metrics (``start_fn`` spawns
+           their serve threads in cli mode); dissolved lanes have their
+           Permit waiters force-expired — those gangs requeue WHOLE
+           through the standard rejection cascade (the only work a
+           resize requeues) — then retire: scheduler permanently
+           fenced, serve thread exits, watchers unregistered, metric
+           series and SLO/overload sources dropped.
+        4. **Reroute the moved ~1/N**: one reroute pass moves exactly
+           the queued entries whose rendezvous owner changed (the
+           movement bound the drill asserts); everything else stays put.
+        5. **Resume** commits.
+
+        Returns a report with the movement accounting."""
+        from yoda_tpu.framework.shards import GLOBAL_LANE, ShardMap, shard_name
+
+        with self._resize_lock():
+            old_count = len(self.shard_stacks)
+            if new_count == old_count or new_count < 1:
+                return {
+                    "resized": False, "shards": old_count,
+                    "moved_entries": 0, "total_entries": 0,
+                    "pools_moved": 0, "pools_total": 0,
+                }
+            old_map = self.shard_map
+            cluster = self.global_stack.cluster
+            total_entries = sum(len(st.queue) for st in self.stacks)
+            self.accountant.hold_commits()
+            try:
+                deadline = time.monotonic() + quiesce_timeout_s
+                while time.monotonic() < deadline and any(
+                    st.bind_executor is not None
+                    and st.bind_executor.inflight() > 0
+                    for st in self.stacks
+                ):
+                    time.sleep(0.005)
+                pools = self.router.pools_snapshot()
+                new_map = ShardMap(new_count)
+                pools_moved = sum(
+                    1
+                    for p in pools
+                    if old_map.shard_of_pool(p) != new_map.shard_of_pool(p)
+                )
+                # Dissolving lanes: force-expire their Permit waiters
+                # BEFORE the swap — rejections cascade through the gang
+                # plugin (reservations released, members requeued whole
+                # into this lane's queue) and the reroute below carries
+                # them home. The resolutions run synchronously here.
+                retiring = (
+                    self.stacks[1 + new_count:]
+                    if new_count < old_count
+                    else []
+                )
+                for st in retiring:
+                    st.framework.expire_waiting(now=float("inf"))
+                # Grow FIRST, swap SECOND: a new lane's informer replays
+                # the cluster list-then-watch, and its pod_route_fn asks
+                # the router at replay time — with the OLD map still
+                # installed the router never answers a new lane's name,
+                # so the replay queues nothing and the reroute pass below
+                # is the single owner of every moved entry (no
+                # double-queued pods).
+                for i in range(old_count, new_count):
+                    name = shard_name(i)
+                    st = build_stack(
+                        cluster=cluster,
+                        config=self.config,
+                        accountant=self.accountant,
+                        metrics=self.metrics,
+                        clock=self.clock,
+                        stop_event=self.stop_event,
+                        shard=name,
+                        node_filter_fn=new_map.node_filter(i),
+                        pod_route_fn=(
+                            lambda pod, _n=name: self.router.route(pod) == _n
+                        ),
+                    )
+                    all_pending = getattr(self, "_all_pending", None)
+                    if all_pending is not None:
+                        _wire_stack_pending(st, all_pending)
+                    if self.shard_fence_fn is not None:
+                        st.scheduler.fence_fn = self.shard_fence_fn
+                    self.stacks.append(st)
+                    if start_fn is not None:
+                        start_fn(st)
+                # The swap: router first (event-time routing follows the
+                # new map immediately), then the surviving informers'
+                # partition filters (snapshots rebuilt lazily).
+                self.shard_map = new_map
+                self.router.swap_map(new_map)
+                for i, st in enumerate(self.stacks[1:]):
+                    if i < min(old_count, new_count):
+                        st.informer.node_filter_fn = new_map.node_filter(i)
+                        st.informer.invalidate_snapshot()
+                # Shrink: retire dissolved lanes.
+                for st in retiring:
+                    self.stacks.remove(st)
+                    st.scheduler.retire()
+                # Reroute queued entries whose owner changed — surviving
+                # lanes via the standard pass, dissolved lanes drained
+                # explicitly (they are no longer in self.stacks).
+                moved = self.reroute()
+                lanes = {GLOBAL_LANE: self.stacks[0]}
+                for st in self.stacks[1:]:
+                    lanes[st.scheduler.shard] = st
+                from yoda_tpu.framework.queue import QueuedPodInfo
+
+                for st in retiring:
+                    for pod, attempts in st.queue.all_entries():
+                        if not st.queue.remove(pod.uid):
+                            continue
+                        want = self.router.route(pod)
+                        target = lanes.get(want, self.stacks[0])
+                        if target.queue.find(pod.uid) is not None:
+                            moved += 1
+                            continue
+                        target.queue.readd(
+                            QueuedPodInfo(pod=pod, attempts=attempts)
+                        )
+                        moved += 1
+                for st in retiring:
+                    self._retire_stack(st, cluster)
+            finally:
+                self.accountant.resume_commits()
+            return {
+                "resized": True,
+                "shards": new_count,
+                "moved_entries": moved,
+                "total_entries": total_entries,
+                "pools_moved": pools_moved,
+                "pools_total": len(pools),
+            }
+
+    def _resize_lock(self):
+        lock = getattr(self, "_resize_mutex", None)
+        if lock is None:
+            lock = self._resize_mutex = threading.Lock()
+        return lock
+
+    def _retire_stack(self, st: Stack, cluster) -> None:
+        """Detach a dissolved lane from every shared surface: watchers,
+        metric accumulators (its per-shard series retire on the next
+        scrape — the PR 12 bounded-cardinality pattern), SLO/overload
+        pressure sources, its ingest drain thread, and its executor pool
+        (released WITHOUT firing the shared stop event)."""
+        remove = getattr(cluster, "remove_watcher", None)
+        if remove is not None:
+            for fn in st.watch_fns:
+                remove(fn)
+        m = self.metrics
+        for acc_name, obj in (
+            ("_queues", st.queue),
+            ("_binders", st.binder),
+            ("_bind_executors", st.bind_executor),
+        ):
+            acc = getattr(m, acc_name, None)
+            if acc is not None and obj in acc:
+                acc.remove(obj)
+        sacc = getattr(m, "_shard_loops", None)
+        if sacc is not None:
+            sacc[:] = [
+                row for row in sacc if row[1] is not st.scheduler
+            ]
+        bacc = getattr(m, "_batch_plugins", None)
+        if bacc is not None:
+            from yoda_tpu.plugins.yoda import YodaBatch
+
+            mine = {
+                id(p)
+                for p in st.framework.batch_plugins
+                if isinstance(p, YodaBatch)
+            }
+            bacc[:] = [p for p in bacc if id(p) not in mine]
+        m.slo.remove_queue(st.queue)
+        m.overload.remove_queue(st.queue)
+        if st.ingestor is not None:
+            m.overload.remove_ingestor(st.ingestor)
+            st.ingestor.stop()
+        if st.bind_executor is not None:
+            st.bind_executor.release()
 
     def run_until_idle(self, *, max_wall_s: float = 30.0) -> None:
         """Drive every lane to idle concurrently (test/bench driver; the
@@ -1277,25 +1621,18 @@ def build_sharded_stacks(
         )
     # Cross-lane pending-placement visibility (the build_profile_stacks
     # contract): a gang member of ANY lane parked at Permit is invisible
-    # in snapshots, and every other lane's evaluators must see it.
-    from yoda_tpu.plugins.yoda import YodaBatch
-    from yoda_tpu.plugins.yoda.filter_plugin import YodaPreFilter
-
-    gangs = [st.gang for st in stacks]
-
+    # in snapshots, and every other lane's evaluators must see it. The
+    # closure walks the LIVE stacks list (the same object ShardSet
+    # mutates in place on a live resize), so lanes added or retired by
+    # resize() stay visible/invisible automatically.
     def all_pending() -> list:
         out: list = []
-        for g in gangs:
-            out.extend(g.pending_placements())
+        for st in stacks:
+            out.extend(st.gang.pending_placements())
         return out
 
     for st in stacks:
-        for p in st.framework.pre_filter_plugins:
-            if isinstance(p, YodaPreFilter):
-                p.pending_fn = all_pending
-        for p in st.framework.batch_plugins:
-            if isinstance(p, YodaBatch):
-                p.pending_fn = all_pending
+        _wire_stack_pending(st, all_pending)
     shard_set = ShardSet(
         stacks=stacks,
         router=router,
@@ -1303,7 +1640,16 @@ def build_sharded_stacks(
         accountant=accountant,
         metrics=shared_metrics,
         config=config,
+        clock=clock,
+        stop_event=stop_event,
     )
+    shard_set._all_pending = all_pending
+    # Occupancy-aware routing (ISSUE 15 satellite): rendezvous ties
+    # break by live shard queue depth, so a starved shard stops
+    # attracting new gangs (and starved work stops defaulting to the
+    # serialized global lane). Reads the live lanes through the shard
+    # set, so a resize re-targets it automatically.
+    router.depth_fn = shard_set.queue_depth
 
     # Structural fleet changes re-route queued entries whose owning lane
     # changed (and keep the router's aggregates fresh). Registered LAST:
@@ -1326,6 +1672,21 @@ def build_sharded_stacks(
         on_fleet_event, replay=False, batch_fn=on_fleet_batch
     )
     return shard_set
+
+
+def _wire_stack_pending(stack: Stack, all_pending) -> None:
+    """Point one stack's evaluators at the cross-lane pending view
+    (build_sharded_stacks at assembly; ShardSet.resize for lanes added
+    live)."""
+    from yoda_tpu.plugins.yoda import YodaBatch
+    from yoda_tpu.plugins.yoda.filter_plugin import YodaPreFilter
+
+    for p in stack.framework.pre_filter_plugins:
+        if isinstance(p, YodaPreFilter):
+            p.pending_fn = all_pending
+    for p in stack.framework.batch_plugins:
+        if isinstance(p, YodaBatch):
+            p.pending_fn = all_pending
 
 
 def build_profile_stacks(

@@ -236,6 +236,9 @@ class ChaosCluster:
         self._partitioned = threading.Event()
         self.lost = threading.Event()
         self.dropped_events = 0
+        # original fn -> partition-gate wrapper (remove_watcher needs
+        # the mapping: callers unregister by the fn they registered).
+        self._gated_watchers: dict = {}
 
     def __getattr__(self, name: str):
         return getattr(self._inner, name)
@@ -294,7 +297,15 @@ class ChaosCluster:
                     return
                 batch_fn(events)
 
+        self._gated_watchers[fn] = gated
         self._inner.add_watcher(gated, replay=replay, batch_fn=gated_batch)
+
+    def remove_watcher(self, fn) -> None:
+        """Unregister by the ORIGINAL fn (the gate wrapper is internal)."""
+        gated = self._gated_watchers.pop(fn, None)
+        remove = getattr(self._inner, "remove_watcher", None)
+        if gated is not None and remove is not None:
+            remove(gated)
 
     def probe(self) -> None:
         """The health monitor's probe: times out while partitioned/lost
@@ -653,6 +664,109 @@ def contention_stream(
             )
         )
     return pods
+
+
+def build_overload_storm(
+    seed: int,
+    *,
+    hosts: int = 4,
+    chips: int = 8,
+    queue_high: int = 8,
+    step_down_hold_s: float = 10.0,
+    config=None,
+):
+    """The ``overload_storm`` chaos mode (ISSUE 15): a single stack on a
+    virtual clock whose overload ladder is tuned to engage under the
+    seeded flood :func:`storm_stream` produces — the sweep drives rounds
+    of prod trickle + spot flood, ticks the monitor at explicit virtual
+    times, and asserts the ladder's contract: prod keeps binding, spot
+    sheds (never drops), everything binds after the storm. Returns
+    ``(stack, agent, clock)``."""
+    from yoda_tpu.agent.fake_publisher import FakeTpuAgent
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+    from yoda_tpu.testing.tracegen import ReplayClock
+
+    clock = ReplayClock()
+    config = config or SchedulerConfig(
+        batch_requests=8,
+        overload_queue_high=queue_high,
+        overload_step_down_hold_s=step_down_hold_s,
+        overload_cycle_ms_high=0.0,   # wall time is meaningless here
+        overload_brownout_admit_per_s=4.0,
+        overload_shed_priority=10,
+        trace_sample_rate=1.0,        # proves the ELEVATED pause/restore
+        # The burn signal is unit-tested on its own; here it would pin
+        # BROWNOUT for the whole (virtual-time-huge) slow window after
+        # the storm and hide the ladder's recovery mechanics.
+        slo_enabled=False,
+        # The sweep's zero-lost-pods ledger needs every created pod to
+        # stay alive until its own departure: PostFilter eviction
+        # DELETES victims on a FakeCluster (no controller recreates
+        # them), which would read as loss. Priority still orders the
+        # queue, so prod pops first when departures free capacity.
+        enable_preemption=False,
+    )
+    stack = build_stack(config=config, clock=clock)
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(hosts):
+        agent.add_host(f"h{i}", generation="v5e", chips=chips)
+    agent.publish_all()
+    return stack, agent, clock
+
+
+def storm_stream(
+    seed: int,
+    round_idx: int,
+    *,
+    prod: int = 1,
+    spot: int = 8,
+    spot_gangs: int = 1,
+    chips: int = 2,
+):
+    """One round of the seeded flash-crowd stream: ``prod`` prod-tier
+    singletons (tpu/priority 10 — never shed), ``spot`` spot singletons
+    and ``spot_gangs`` plain spot gangs of 4 (priority 0 — shed at
+    SHED). Same seed + round -> same pods; a failing sweep's log is its
+    repro. Returns (prod_pods, spot_pods)."""
+    import random as _random
+
+    from yoda_tpu.api.types import PodSpec
+
+    rng = _random.Random((seed << 20) ^ round_idx)
+    base = rng.randrange(1 << 30)
+    prod_pods = [
+        PodSpec(
+            f"prod-r{round_idx}-{base + i}",
+            namespace="prod",
+            labels={"tpu/chips": str(chips), "tpu/priority": "10"},
+        )
+        for i in range(prod)
+    ]
+    spot_pods = [
+        PodSpec(
+            f"spot-r{round_idx}-{base + i}",
+            namespace="spot",
+            labels={"tpu/chips": str(chips), "tpu/priority": "0"},
+        )
+        for i in range(spot)
+    ]
+    for g in range(spot_gangs):
+        tag = f"sg-r{round_idx}-{base + g}"
+        spot_pods.extend(
+            PodSpec(
+                f"{tag}-{m}",
+                namespace="spot",
+                labels={
+                    "tpu/chips": str(chips),
+                    "tpu/priority": "0",
+                    "tpu/gang": tag,
+                    "tpu/gang-size": "4",
+                },
+            )
+            for m in range(4)
+        )
+    return prod_pods, spot_pods
 
 
 def maybe_drop_watch(plan: ChaosPlan, server) -> bool:

@@ -222,6 +222,10 @@ class ReplayReport:
     repairs: int = 0
     ingest_events: int = 0       # raw watch events through batched ingest
     ingest_batches: int = 0
+    # Overload ladder (drive_overload): draws shed + the peak level the
+    # ladder reached during the replay (0 = never left NOMINAL).
+    shed: int = 0
+    overload_peak_level: int = 0
     killed_nodes: "list[str]" = field(default_factory=list)
     drained_nodes: "list[str]" = field(default_factory=list)
     # Pods still bound on a drained node when its upgrade finished (0 =
@@ -324,6 +328,7 @@ def replay(
     settle_every_s: float = 5.0,
     eval_every_s: float = 30.0,
     drive_rebalancer: bool = False,
+    drive_overload: bool = False,
     max_wall_s: float = 900.0,
     shard_count: int = 1,
 ) -> ReplayReport:
@@ -512,6 +517,16 @@ def replay(
                 draining.discard(name)
                 recoveries.remove((t_rec, name))
         flush_all()
+        if drive_overload:
+            # The brownout ladder ticks BEFORE the settle: shed/brownout
+            # verdicts apply to this step's pops, exactly as the
+            # background monitor thread would beat a production cycle.
+            # The monitor runs on the replay clock (deterministic).
+            ov = stack.metrics.overload
+            ov.evaluate(now)
+            report.overload_peak_level = max(
+                report.overload_peak_level, ov.level_idx
+            )
         settle_all()
         stack.nodehealth.run_once()
         if drive_rebalancer:
@@ -536,6 +551,7 @@ def replay(
     report.repairs = int(m.gang_repairs.total())
     report.ingest_events = sum(st.ingestor.events_in for st in all_stacks)
     report.ingest_batches = sum(st.ingestor.batches for st in all_stacks)
+    report.shed = int(stack.metrics.overload.shed_total)
     report.slo = engine.evaluate(spec.duration_s)
     report.wall_s = time.monotonic() - t_start
     for st in all_stacks:

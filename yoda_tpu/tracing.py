@@ -92,6 +92,9 @@ VERDICT_CLASSES = frozenset(
         # Node failure domains: members lost to a DOWN node awaiting
         # gang-whole repair.
         "node-repair",
+        # Overload brownout ladder (yoda_tpu/overload.py): a non-prod
+        # arrival parked at SHED; requeues when the ladder steps down.
+        "overload-shed",
     }
 )
 
@@ -449,6 +452,11 @@ class PendingIndex:
     ) -> None:
         self.capacity = max(int(capacity), 16)
         self.wall = wall
+        # LRU evictions (config pending_index_max): a million-pod shed
+        # flood recycles the oldest keys instead of growing the index —
+        # counted into yoda_pending_evicted_total so operators can tell
+        # "aged out" from "never seen".
+        self.evicted = 0
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, dict]" = OrderedDict()
 
@@ -498,6 +506,7 @@ class PendingIndex:
             self._entries[key] = e
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
+                self.evicted += 1
         else:
             self._entries.move_to_end(key)
         e["kind"] = kind
