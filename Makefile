@@ -9,7 +9,7 @@ TAG ?= latest
 PY ?= python
 CXX ?= g++
 
-.PHONY: all test lint native native-asan bench bench-scale rebalance-bench slo-bench shard-bench overload-bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native native-asan bench bench-scale serve-bench rebalance-bench slo-bench shard-bench overload-bench smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -69,6 +69,17 @@ smoke:
 bench-scale:
 	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) bench.py --scale
+
+# Sub-millisecond serve evidence (CPU-pinned): hot-shape singles served
+# cold (speculation kill switch on — every arrival pays the fused
+# filter/score dispatch) vs warm (the rebalancer-tick producer parks a
+# validated plan between serves). Asserts every warm serve a cache hit,
+# ZERO kernel dispatches across the warm phase, cache-hit decision p99
+# < 1 ms, and the 1k-vs-100k-node warm decision-chain median flat
+# (<= 2x). The reduced slice rides `make smoke`; the flatness sweep
+# also rides `make bench-scale`. One JSON line.
+serve-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve
 
 # Goodput-driven rebalancer evidence (CPU-pinned): the seeded long-churn
 # replay (fragmentation-score series with the rebalancer on vs off over

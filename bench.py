@@ -510,6 +510,104 @@ def _burst_with_gang_scenario(
     }
 
 
+def _subms_serve_scenario(
+    *, hosts: int = 16, cold: int = 101, warm: int = 120
+) -> dict:
+    """Sub-millisecond serve (speculative placement cache, ISSUE 17):
+    hot-shape singles served cold (cache disabled — every arrival pays
+    the fused filter/score dispatch) vs warm (the rebalancer-tick
+    producer parks a plan between serves, the arrival binds from it).
+
+    Reported on the bases the metrics define: cold is the full
+    scheduling-cycle p99 (yoda_scheduling_latency_seconds, phase=total —
+    the ~2.5 ms headline the cache attacks), warm is the cache-hit
+    decision p99 (yoda_spec_bind_ms: lookup -> epoch check -> one-node
+    spot check -> Reserve — the spans the fast path still runs; the
+    O(fleet) filter/score spans it skips entirely).
+
+    Asserted inline: every serve bound, every warm serve a cache hit,
+    ZERO kernel dispatches across the warm phase (the proof the fused
+    kernel was skipped, not just fast), and warm p99 < 1 ms (the ISSUE
+    17 acceptance bar).
+
+      subms_cold_p99_ms       full-path cycle p99, cache disabled
+      subms_warm_p99_ms       cache-hit decision p99 (< 1 ms asserted)
+      subms_speedup           cold / warm
+      subms_warm_hits         cache hits in the warm phase (== warm)
+      subms_cold_dispatches   fused-kernel dispatches, cold phase
+      subms_warm_dispatches   fused-kernel dispatches, warm phase (== 0)
+
+    ``bench.py --serve`` / ``make serve-bench`` runs this at full shape
+    plus the 1k/100k flatness sweep; ``--smoke`` runs a reduced slice."""
+    import time as _time  # noqa: F401 — parity with sibling scenarios
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.standalone import build_stack
+
+    stack = build_stack(config=SchedulerConfig())
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(hosts):
+        agent.add_host(f"v5e-{i}", generation="v5e", chips=8)
+    agent.publish_all()
+    spec = stack.speculation
+    yb = stack.framework.batch_plugins[0]
+
+    def serve(name: str) -> None:
+        stack.cluster.create_pod(PodSpec(name, labels={"tpu/chips": "1"}))
+        stack.scheduler.run_until_idle(max_wall_s=60)
+        pod = stack.cluster.get_pod(f"default/{name}")
+        assert pod.node_name, f"{name} did not bind"
+        stack.cluster.delete_pod(pod.key)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+
+    # Compile the fused kernel at this fleet bucket outside measurement,
+    # then drop its ~0.5 s compile sample from the cycle-latency ring so
+    # the cold p99 reads only steady-state full-path cycles.
+    serve("warm-compile")
+    stack.metrics.latency._series.clear()
+
+    # COLD: kill switch on — every serve takes the full path, so the
+    # cycle-latency ring holds only full-path samples.
+    spec.configure(enabled=False)
+    d0 = yb.dispatch_count
+    for i in range(cold):
+        serve(f"cold-{i}")
+    cold_disp = yb.dispatch_count - d0
+    cold_p99_ms = stack.metrics.latency.quantile(0.99, phase="total") * 1e3
+
+    # WARM: one seed serve records the shape (a miss), then every serve
+    # rides a plan the producer tick parked just before it — the same
+    # cadence the rebalancer's sub-tick drives in production.
+    spec.configure(enabled=True)
+    serve("seed")
+    d0 = yb.dispatch_count
+    h0 = spec.hits
+    for i in range(warm):
+        assert spec.speculate_once() >= 1, f"producer parked no plan at {i}"
+        serve(f"hot-{i}")
+    warm_hits = spec.hits - h0
+    warm_disp = yb.dispatch_count - d0
+    assert warm_hits == warm, f"cache hits {warm_hits}/{warm} in warm phase"
+    assert warm_disp == 0, (
+        f"warm phase dispatched the kernel {warm_disp}x — fast path not taken"
+    )
+    warm_p99_ms = stack.metrics.spec_bind.quantile(0.99)
+    assert stack.metrics.spec_bind.count() == warm
+    assert warm_p99_ms < 1.0, (
+        f"warm cache-hit p99 {warm_p99_ms:.3f} ms — sub-millisecond bar missed"
+    )
+    return {
+        "subms_cold_p99_ms": round(cold_p99_ms, 3),
+        "subms_warm_p99_ms": round(warm_p99_ms, 3),
+        "subms_speedup": round(cold_p99_ms / max(warm_p99_ms, 1e-6), 1),
+        "subms_warm_hits": warm_hits,
+        "subms_cold_dispatches": cold_disp,
+        "subms_warm_dispatches": warm_disp,
+    }
+
+
 def _observability_overhead_scenario(
     *, slices: int = 2, singles: int = 4, burst_pods: int = 40
 ) -> dict:
@@ -1370,6 +1468,81 @@ def _sharded_scale_sweep(
     }
 
 
+def _spec_scale_sweep(sizes=(1000, 100_000), serves=200, reps=5) -> dict:
+    """Warm-path flatness at datacenter scale (ISSUE 17 acceptance): the
+    cache-hit decision chain — lookup, epoch check against both delta
+    feeds, single-node admission + staged-claim spot check, consume —
+    timed against 1k- and 100k-node informers. Every step is O(1) or
+    O(delta ring) by construction, never O(fleet), so the per-chain cost
+    must not move with fleet size (ratio <= 2x asserted). The chain runs
+    ~20 us, far below single-shot timer noise, so each sample is a
+    ``serves``-chain block and the reported per-chain cost is the
+    best-of-``reps`` block (the same best-of discipline as the overhead
+    scenarios — isolates the machinery from host scheduling spikes). The
+    speculate-pass column records the O(fleet) producer cost each hit
+    AVOIDS paying on the serve thread."""
+    from yoda_tpu.api.types import PodSpec, make_node
+    from yoda_tpu.cluster import Event, InformerCache
+    from yoda_tpu.config import Weights
+    from yoda_tpu.framework.speculation import SpeculativeCache
+    from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+
+    out: dict = {}
+    for n in sizes:
+        informer = InformerCache()
+        for i in range(n):
+            informer.handle(
+                Event(
+                    "added", "TpuNodeMetrics",
+                    make_node(f"n{i:06d}", chips=8, now=0.0),
+                )
+            )
+        accountant = ChipAccountant()
+        cache = SpeculativeCache(
+            snapshot_fn=informer.snapshot,
+            changes_fn=informer.changes_since,
+            admission_changes_fn=informer.admission_changes_since,
+            reserved_fn=accountant.chips_in_use,
+            reserved_map_fn=accountant.chips_by_node,
+            claimed_fn=informer.claimed_hbm_mib,
+            claimed_map_fn=informer.claimed_hbm_mib_map,
+            weights=Weights(),
+        )
+        pod = PodSpec("probe", labels={"tpu/chips": "2"})
+        assert cache.lookup(pod) is None  # miss records the shape
+        t0 = time.monotonic()
+        assert cache.speculate_once() == 1
+        spec_pass_ms = (time.monotonic() - t0) * 1e3
+        snapshot = informer.snapshot()
+        best_ms = float("inf")
+        for _ in range(reps):
+            t0 = time.monotonic()
+            for _ in range(serves):
+                plan = cache.lookup(pod)
+                ok = (
+                    plan is not None
+                    and cache.epoch_valid(plan)
+                    and cache.revalidate(plan, pod, snapshot)
+                )
+                node = cache.consume_plan(plan) if ok else None
+                assert node is not None, "warm chain failed mid-sweep"
+                # Bench-only reinsert: measure the consumer chain per
+                # serve without re-running the producer between chains.
+                cache._plans[plan.key] = plan
+            block_ms = (time.monotonic() - t0) * 1e3
+            best_ms = min(best_ms, block_ms / serves)
+        out[str(n)] = {
+            "warm_chain_ms": round(best_ms, 4),
+            "speculate_pass_ms": round(spec_pass_ms, 2),
+        }
+    lo, hi = str(sizes[0]), str(sizes[-1])
+    flat = out[hi]["warm_chain_ms"] / max(out[lo]["warm_chain_ms"], 1e-6)
+    assert flat <= 2.0, (
+        f"warm decision chain not fleet-flat: {flat:.2f}x at {hi} nodes"
+    )
+    return {"spec_scale_sweep": out, "spec_warm_flat_ratio": round(flat, 2)}
+
+
 def run_scale() -> dict:
     """``bench.py --scale`` / ``make bench-scale``: the synthetic 10k- and
     100k-node sweeps behind the device-resident state + node-axis
@@ -1384,6 +1557,8 @@ def run_scale() -> dict:
     print(f"sharded joint sweep: {sharded}", file=sys.stderr)
     ingest = _ingest_scale_sweep()
     print(f"ingest scale sweep: {ingest}", file=sys.stderr)
+    spec = _spec_scale_sweep()
+    print(f"speculative warm-path scale sweep: {spec}", file=sys.stderr)
     out = {
         "metric": "scale_delta_apply_ms",
         "value": resident["scale_sweep"]["100000"]["delta_apply_ms"],
@@ -1391,6 +1566,7 @@ def run_scale() -> dict:
         **resident,
         **sharded,
         **ingest,
+        **spec,
     }
     return out
 
@@ -3049,6 +3225,8 @@ def run_bench() -> dict:
     print(f"anti-affinity gang latency: {constrained}", file=sys.stderr)
     burst = _burst_scenario()
     print(f"multi-pod burst throughput: {burst}", file=sys.stderr)
+    subms = _subms_serve_scenario()
+    print(f"sub-millisecond serve (cold vs cache-hit): {subms}", file=sys.stderr)
     multi = _multi_gang_contended_scenario()
     print(f"multi-gang contended joint placement: {multi}", file=sys.stderr)
     degraded = _degraded_chaos_scenario()
@@ -3096,6 +3274,7 @@ def run_bench() -> dict:
         **mixed,
         **constrained,
         **burst,
+        **subms,
         **multi,
         **degraded,
         **bindpipe,
@@ -3130,6 +3309,11 @@ def run_smoke() -> dict:
 
     jax.config.update("jax_platforms", "cpu")
     out = _burst_with_gang_scenario(slices=2, singles=4, burst_pods=24)
+    # Sub-millisecond serve smoke slice (full shape + the 1k/100k
+    # flatness sweep is `make serve-bench`): the scenario's own asserts
+    # guard the contract — every warm serve a cache hit, zero kernel
+    # dispatches warm, cache-hit decision p99 < 1 ms.
+    out.update(_subms_serve_scenario(hosts=4, cold=15, warm=40))
     out.update(_multi_gang_contended_scenario(slices=2, gangs=2))
     out.update(_degraded_chaos_scenario(hosts=4, gangs=2, singles=8))
     out.update(_bind_latency_scenario())
@@ -3227,6 +3411,28 @@ def run_overload() -> dict:
     }
 
 
+def run_serve() -> dict:
+    """``bench.py --serve`` / ``make serve-bench``: the sub-millisecond
+    serve evidence (ISSUE 17) at full shape — the cold-vs-warm scenario
+    (16 hosts, 60 cold + 120 cache-hit serves; warm decision p99 < 1 ms,
+    zero warm kernel dispatches, every warm serve a hit — all asserted
+    inside) plus the 1k/100k-node warm-path flatness sweep (median
+    decision-chain ratio <= 2x asserted). CPU-pinned: the warm path by
+    design never touches the accelerator, and the cold comparator should
+    not inherit tunnel variance."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = _subms_serve_scenario()
+    out.update(_spec_scale_sweep())
+    return {
+        "metric": "subms_warm_p99_ms",
+        "value": out["subms_warm_p99_ms"],
+        "unit": "ms",
+        **out,
+    }
+
+
 def run_rebalance() -> dict:
     """``bench.py --rebalance`` / ``make rebalance-bench``: the long form
     of the seeded churn replay (more rounds than the smoke's 16) plus the
@@ -3264,6 +3470,9 @@ def main() -> int:
         return 0
     if "--scale" in sys.argv:
         print(json.dumps(run_scale()))
+        return 0
+    if "--serve" in sys.argv:
+        print(json.dumps(run_serve()))
         return 0
     if "--rebalance" in sys.argv:
         print(json.dumps(run_rebalance()))

@@ -95,6 +95,26 @@ class TestSchedulerManifest:
             "pending_index_max",
         } <= RELOADABLE_KNOBS
 
+    def test_configmap_speculation_knobs_validate(self):
+        """ISSUE 17: the shipped speculation knob turns the cache ON at
+        its defaults and VALIDATES, and all three spec_* knobs are
+        declared hot-reloadable — the runbook's kill switch
+        (spec_enabled: false via reload) must actually be live."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        cfg = SchedulerConfig.from_dict(
+            yaml.safe_load(cm["data"]["config.yaml"])
+        )
+        assert cfg.spec_enabled is True
+        assert cfg.spec_cache_size >= 1
+        assert cfg.spec_shapes_max >= 1
+        from yoda_tpu.config import RELOADABLE_KNOBS
+
+        assert {
+            "spec_enabled",
+            "spec_cache_size",
+            "spec_shapes_max",
+        } <= RELOADABLE_KNOBS
+
     def test_deployment_mounts_config_and_probes_healthz(self):
         (dep,) = by_kind(self.docs, "Deployment")
         spec = dep["spec"]["template"]["spec"]

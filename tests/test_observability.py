@@ -57,6 +57,28 @@ class TestRegistry:
         assert h.count(phase="filter") == 1
         assert h.count(phase="score") == 1
 
+    def test_histogram_ring_is_preallocated_and_allocation_free(self):
+        """ISSUE 17 micro-assert: observe() must not grow or replace the
+        quantile ring — the serve path observes on every cycle, and the
+        old deque paid a node allocation per sample. The ring object's
+        identity and length must be stable across > RING observations,
+        while count/sum/quantiles stay exact over the window."""
+        h = Histogram("lat", "latency", buckets=(1.0,))
+        h.observe(0.5)
+        series = h._series[()]
+        ring = series[3]
+        assert len(ring) == Histogram.RING
+        for i in range(Histogram.RING + 10):
+            h.observe(float(i))
+        assert h._series[()][3] is ring, "observe() replaced the ring"
+        assert len(ring) == Histogram.RING, "observe() resized the ring"
+        assert h.count() == Histogram.RING + 11
+        # The window holds the most recent RING values (wrap order is
+        # irrelevant to quantiles): min survived the wrap, the seed 0.5
+        # and the earliest overwritten samples did not.
+        assert h.quantile(0.0) >= 10.0 - 1.0
+        assert h.quantile(1.0) == float(Histogram.RING + 9)
+
 
 class TestSchedulerMetrics:
     def test_cycle_metrics_populated(self):
@@ -144,6 +166,9 @@ class TestSchedulerMetrics:
 # appears BOTH here and in docs/OPERATIONS.md, so a new metric cannot
 # silently skip the test suite or the operator docs.
 ALL_METRIC_FAMILIES = (
+    "yoda_admission_cache_patched_total",
+    "yoda_admission_cache_rebuilds_total",
+    "yoda_admission_cache_reuse_total",
     "yoda_bind_inflight",
     "yoda_bind_wall_ms",
     "yoda_binds_total",
@@ -221,6 +246,10 @@ ALL_METRIC_FAMILIES = (
     "yoda_slo_repair_rate_per_min",
     "yoda_slo_starved_windows",
     "yoda_snapshot_reuse_total",
+    "yoda_spec_bind_ms",
+    "yoda_spec_cache_hits_total",
+    "yoda_spec_cache_invalidations_total",
+    "yoda_spec_cache_misses_total",
     "yoda_spillover_gangs_total",
     "yoda_tenant_dominant_share",
     "yoda_tenant_quota_parks_total",

@@ -1,0 +1,433 @@
+"""Speculative placement cache (ISSUE 17): the sub-millisecond serve
+fast path must never bind stale.
+
+Three layers:
+
+- Unit: SpecPlan epoch/consume semantics against stub delta feeds — the
+  exact invalidation matrix (structural, ring-behind, touched-node,
+  unwired feeds), the pop-wins-once consume contract, configure/flush
+  bounds.
+- Stack: the serve loop's hit path end-to-end on a real assembly — a
+  hot shape binds from a plan (counters + histogram move), node churn
+  and staged-claim drift invalidate BEFORE binding, the reload kill
+  switch flushes.
+- Drills: the seeded staleness sweep (churn racing cache hits: no
+  oversubscription, accounting exactly matches bound pods) and the
+  shard-resize flush drill (a partition-boundary move may not leave any
+  plan behind).
+"""
+
+import random
+import threading
+import time
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import K8sNode, PodSpec
+from yoda_tpu.cluster.informer import FleetDelta
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.framework.speculation import (
+    SpecPlan,
+    SpeculativeCache,
+    speculation_key,
+)
+from yoda_tpu.standalone import apply_reloadable, build_stack
+
+
+def make_stack(**cfg):
+    stack = build_stack(config=SchedulerConfig(**cfg))
+    agent = FakeTpuAgent(stack.cluster)
+    return stack, agent
+
+
+def chip_pod(name, chips=1, **labels):
+    return PodSpec(name, labels={"tpu/chips": str(chips), **labels})
+
+
+def make_cache(**over):
+    """A cache with clean stub feeds: epochs never move, nothing ever
+    changes, every node shows zero reserved chips."""
+    kw = dict(
+        changes_fn=lambda e: FleetDelta(
+            epoch=e, changed=frozenset(), structural=False
+        ),
+        admission_changes_fn=lambda e: (e, frozenset()),
+        reserved_fn=lambda node: 0,
+    )
+    kw.update(over)
+    return SpeculativeCache(**kw)
+
+
+def plant(cache, node="n0", base_reserved=0, key=("shape",)):
+    plan = SpecPlan(
+        key=key,
+        node=node,
+        epoch_m=1,
+        epoch_a=1,
+        base_reserved=base_reserved,
+        score=5,
+    )
+    cache._plans[key] = plan
+    return plan
+
+
+class TestSpeculationKey:
+    def test_plain_chip_pod_is_in_scope_and_shape_stable(self):
+        a = speculation_key(chip_pod("a", 2))
+        b = speculation_key(chip_pod("b", 2))
+        c = speculation_key(chip_pod("c", 4))
+        assert a is not None
+        assert a == b, "same shape must key identically"
+        assert a != c
+
+    def test_gang_pods_are_out_of_scope(self):
+        pod = PodSpec(
+            "g0", labels={"tpu/chips": "4", "tpu/gang": "g", "tpu/gang-size": "2"}
+        )
+        assert speculation_key(pod) is None
+
+    def test_pending_resource_pods_are_out_of_scope(self):
+        # cpu/mem requests interact with concurrent cycles' pending
+        # resources, which a between-cycles evaluation cannot see.
+        pod = PodSpec(
+            "c", labels={"tpu/chips": "1"}, cpu_milli_request=500
+        )
+        assert speculation_key(pod) is None
+
+    def test_host_port_and_pvc_pods_are_out_of_scope(self):
+        pod = PodSpec("hp", labels={"tpu/chips": "1"}, host_ports=(8080,))
+        assert speculation_key(pod) is None
+        pod = PodSpec("pv", labels={"tpu/chips": "1"}, pvc_names=("claim",))
+        assert speculation_key(pod) is None
+
+
+class TestEpochValidity:
+    def test_clean_feeds_restamp_the_plan_forward(self):
+        cache = make_cache(
+            changes_fn=lambda e: FleetDelta(
+                epoch=9, changed=frozenset(), structural=False
+            ),
+            admission_changes_fn=lambda e: (7, frozenset()),
+        )
+        plan = plant(cache)
+        assert cache.epoch_valid(plan)
+        assert plan.epoch_m == 9 and plan.epoch_a == 7
+        assert cache._plans[plan.key] is plan
+
+    def test_touched_node_invalidates(self):
+        cache = make_cache(
+            changes_fn=lambda e: FleetDelta(
+                epoch=2, changed=frozenset({"n0"}), structural=False
+            )
+        )
+        plan = plant(cache, node="n0")
+        assert not cache.epoch_valid(plan)
+        assert plan.key not in cache._plans
+        assert cache.invalidations == 1
+
+    def test_admission_touch_invalidates(self):
+        cache = make_cache(
+            admission_changes_fn=lambda e: (3, frozenset({"n0"}))
+        )
+        plan = plant(cache, node="n0")
+        assert not cache.epoch_valid(plan)
+        assert cache.invalidations == 1
+
+    def test_structural_delta_invalidates(self):
+        cache = make_cache(
+            changes_fn=lambda e: FleetDelta(
+                epoch=2, changed=frozenset(), structural=True
+            )
+        )
+        assert not cache.epoch_valid(plant(cache))
+
+    def test_ring_behind_feeds_fail_closed(self):
+        # A feed that can no longer answer (delta ring evicted the
+        # epoch) must invalidate — unknown history is stale history.
+        cache = make_cache(changes_fn=lambda e: None)
+        assert not cache.epoch_valid(plant(cache))
+        cache = make_cache(admission_changes_fn=lambda e: (4, None))
+        assert not cache.epoch_valid(plant(cache))
+
+    def test_unwired_feeds_fail_closed(self):
+        cache = make_cache(changes_fn=None)
+        assert not cache.epoch_valid(plant(cache))
+
+
+class TestConsumeContract:
+    def test_consume_pops_and_wins_exactly_once(self):
+        cache = make_cache()
+        plan = plant(cache)
+        assert cache.consume_plan(plan) == "n0"
+        assert cache.consume_plan(plan) is None
+        assert cache.hits == 1
+
+    def test_consume_of_a_replaced_plan_loses(self):
+        # A newer plan for the same shape invalidates a stale reference:
+        # identity, not key equality, is the win condition.
+        cache = make_cache()
+        stale = plant(cache)
+        fresh = plant(cache)  # same key, new object
+        assert cache.consume_plan(stale) is None
+        assert cache.consume_plan(fresh) == "n0"
+
+    def test_reserve_rejection_counts_as_invalidation(self):
+        cache = make_cache()
+        plan = plant(cache)
+        cache.consume_plan(plan)
+        cache.reserve_rejected(plan)
+        assert cache.reserve_rejects == 1
+        assert cache.invalidations == 1
+
+
+class TestLifecycle:
+    def test_flush_drops_plans_and_shapes_and_counts(self):
+        cache = make_cache()
+        plant(cache, key=("a",))
+        plant(cache, key=("b",))
+        cache._shapes[("a",)] = chip_pod("a")
+        assert cache.flush() == 2
+        assert cache._plans == {} and cache._shapes == {}
+        assert cache.invalidations == 2
+
+    def test_configure_shrink_evicts_oldest_inserted(self):
+        cache = make_cache()
+        for i in range(4):
+            plant(cache, key=(f"k{i}",))
+        cache.configure(size=2)
+        assert set(cache._plans) == {("k2",), ("k3",)}
+        assert cache.invalidations == 2
+
+    def test_configure_disable_flushes(self):
+        cache = make_cache()
+        plant(cache)
+        cache.configure(enabled=False)
+        assert not cache.enabled and cache._plans == {}
+        assert cache.lookup(chip_pod("p")) is None  # disabled: no tracking
+        assert cache._shapes == {}
+
+    def test_lookup_tracks_shapes_bounded(self):
+        cache = make_cache()
+        cache.configure(shapes_max=2)
+        for i in range(5):
+            cache.lookup(chip_pod(f"p{i}", chips=i + 1))
+        assert len(cache._shapes) == 2
+        assert cache.misses == 5
+
+
+class TestServeFastPath:
+    def test_hot_shape_binds_from_cached_plan(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        # Cold serve records the shape as a speculation candidate.
+        stack.cluster.create_pod(chip_pod("cold"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/cold").node_name == "h0"
+        assert spec.misses >= 1 and spec.hits == 0
+        # Producer tick parks a validated plan for the shape.
+        assert spec.speculate_once() == 1
+        # Hot serve binds from it.
+        stack.cluster.create_pod(chip_pod("hot"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/hot").node_name == "h0"
+        assert spec.hits == 1
+        # The bind latency histogram and the counter families moved.
+        assert stack.metrics.spec_bind.count() == 1
+        text = stack.metrics.registry.render_prometheus()
+        assert "yoda_spec_cache_hits_total 1.0" in text
+
+    def test_consumed_plan_is_single_use(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        stack.cluster.create_pod(chip_pod("cold"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        spec.speculate_once()
+        stack.cluster.create_pod(chip_pod("hot-1"))
+        stack.cluster.create_pod(chip_pod("hot-2"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        # Both bind; at most one rode the plan (the second consumed
+        # nothing — the plan popped on first use).
+        assert stack.cluster.get_pod("default/hot-1").node_name == "h0"
+        assert stack.cluster.get_pod("default/hot-2").node_name == "h0"
+        assert spec.hits == 1
+
+    def test_cordon_invalidates_before_binding(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        stack.cluster.create_pod(chip_pod("cold"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert spec.speculate_once() == 1
+        # Node churn lands AFTER the plan: the admission delta feed (or
+        # the per-node spot check) must catch it at consume time.
+        stack.cluster.put_node(K8sNode("h0", unschedulable=True))
+        stack.cluster.create_pod(chip_pod("hot"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/hot").node_name is None
+        assert spec.hits == 0
+        assert spec.invalidations >= 1
+
+    def test_staged_claim_drift_fails_the_equality(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        stack.cluster.create_pod(chip_pod("cold"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert spec.speculate_once() == 1
+        # A foreign claim the epoch feeds cannot see (accountant state
+        # is not an informer event): the consume-time equality against
+        # the live accountant is the only guard, and it must fail
+        # closed — the pod still binds, via the FULL path.
+        spec.reserved_fn = lambda node: 999
+        stack.cluster.create_pod(chip_pod("hot"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/hot").node_name == "h0"
+        assert spec.hits == 0
+        assert spec.invalidations >= 1
+
+    def test_disabled_cache_reverts_to_baseline(self):
+        stack, agent = make_stack(spec_enabled=False)
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        stack.cluster.create_pod(chip_pod("p"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert stack.cluster.get_pod("default/p").node_name == "h0"
+        assert spec.hits == 0 and spec.misses == 0
+        assert spec.speculate_once() == 0
+
+
+class TestReload:
+    def test_kill_switch_flushes_live(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        stack.cluster.create_pod(chip_pod("cold"))
+        stack.scheduler.run_until_idle(max_wall_s=5)
+        assert spec.speculate_once() == 1
+        apply_reloadable([stack], SchedulerConfig(spec_enabled=False))
+        assert not spec.enabled and spec._plans == {}
+        apply_reloadable(
+            [stack], SchedulerConfig(spec_cache_size=4, spec_shapes_max=8)
+        )
+        assert spec.enabled and spec.size == 4 and spec.shapes_max == 8
+
+
+class TestRebalancerSubTick:
+    def test_subtick_speculates_between_rebalance_passes(self):
+        stack, agent = make_stack()
+        agent.add_host("h0", generation="v5e", chips=8)
+        agent.publish_all()
+        rb = stack.rebalancer
+        rb.gate_fn = None  # leadership/resync gating is not under test
+        calls = {"spec": 0, "run": 0}
+        rb.speculator = type(
+            "S", (), {"speculate_once": lambda self: calls.__setitem__(
+                "spec", calls["spec"] + 1
+            )}
+        )()
+        rb.run_once = lambda: calls.__setitem__("run", calls["run"] + 1)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=rb.run_forever,
+            args=(stop,),
+            kwargs={"period_s": 0.08, "spec_period_s": 0.02},
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.6)
+        stop.set()
+        t.join(timeout=2)
+        assert calls["run"] >= 1, "rebalance pass starved by sub-ticks"
+        assert calls["spec"] > calls["run"], (
+            "speculation must tick FASTER than the rebalance pass",
+            calls,
+        )
+
+
+class TestSeededStalenessSweep:
+    def test_churn_racing_cache_hits_never_oversubscribes(self):
+        """The acceptance drill: seeded churn (cordons, metric
+        republishes, mixed shapes) racing speculative binds. After every
+        round the accountant must show no node above capacity and
+        accounting EXACTLY equal to the chips of bound pods — a stale
+        bind would break one or the other."""
+        rng = random.Random(17)
+        stack, agent = make_stack()
+        hosts = [f"h{i}" for i in range(6)]
+        for h in hosts:
+            agent.add_host(h, generation="v5e", chips=8)
+        agent.publish_all()
+        spec = stack.speculation
+        cordoned: set[str] = set()
+        made = 0
+        for rnd in range(25):
+            for _ in range(rng.randint(1, 3)):
+                stack.cluster.create_pod(
+                    chip_pod(f"p{made}", chips=rng.choice([1, 1, 1, 2]))
+                )
+                made += 1
+            if rng.random() < 0.7:
+                spec.speculate_once()
+            if rng.random() < 0.3:
+                h = rng.choice(hosts)
+                if h in cordoned:
+                    cordoned.discard(h)
+                    stack.cluster.put_node(K8sNode(h))
+                else:
+                    cordoned.add(h)
+                    stack.cluster.put_node(K8sNode(h, unschedulable=True))
+            if rng.random() < 0.3:
+                agent.publish_all()
+            stack.scheduler.run_until_idle(max_wall_s=10)
+            by_node = stack.accountant.chips_by_node()
+            for node, used in by_node.items():
+                assert used <= 8, (rnd, node, used)
+            bound_chips = sum(
+                int(p.labels["tpu/chips"])
+                for p in stack.cluster.list_pods()
+                if p.node_name is not None
+            )
+            assert sum(by_node.values()) == bound_chips, (
+                "leaked or lost reservations",
+                rnd,
+            )
+        # The fast path genuinely participated in the sweep, and churn
+        # genuinely invalidated plans — both sides of the race ran.
+        assert spec.hits >= 1, spec.stats()
+        assert spec.invalidations >= 1, spec.stats()
+
+
+class TestShardResizeFlushDrill:
+    def test_resize_flushes_every_lane(self):
+        from tests.test_shards import fleet, make_shard_set
+
+        ss, agent = make_shard_set(2)
+        fleet(agent)
+        for i in range(4):
+            ss.global_stack.cluster.create_pod(chip_pod(f"p{i}"))
+        ss.run_until_idle(max_wall_s=10)
+        planned = sum(
+            st.speculation.speculate_once()
+            for st in ss.stacks
+            if st.speculation is not None
+        )
+        assert planned >= 1, "no lane produced a plan to flush"
+        inv_before = sum(
+            st.speculation.invalidations for st in ss.stacks
+        )
+        report = ss.resize(3)
+        assert report["resized"]
+        for st in ss.stacks:
+            assert st.speculation is not None
+            assert st.speculation._plans == {}, st.scheduler.shard
+        assert (
+            sum(st.speculation.invalidations for st in ss.stacks)
+            >= inv_before + planned - 1
+        )

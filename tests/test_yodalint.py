@@ -25,6 +25,7 @@ from tools.yodalint.passes import (
     metrics_drift,
     reload_safety,
     snapshot_immutability,
+    speculation_safety,
     verdict_taxonomy,
 )
 
@@ -681,6 +682,158 @@ class TestReloadSafety:
             },
         )
         assert reload_safety.run(project) == []
+
+
+class TestSpeculationSafety:
+    """ISSUE 17: consuming a speculative plan without the leader fence or
+    the epoch check is a stale/split-brain bind; the informer calling
+    into the cache inverts the lock DAG."""
+
+    def test_catches_unfenced_consume(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/sched.py": (
+                "class Loop:\n"
+                "    def serve(self, spec, plan):\n"
+                "        if spec.epoch_valid(plan):\n"
+                "            return spec.consume_plan(plan)\n"
+            ),
+        })
+        findings = speculation_safety.run(project)
+        assert any(
+            "leader-fence" in f.message and f.line == 4 for f in findings
+        ), findings
+
+    def test_catches_epoch_free_consume(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/sched.py": (
+                "class Loop:\n"
+                "    def serve(self, spec, plan):\n"
+                "        if self._fenced():\n"
+                "            return None\n"
+                "        return spec.consume_plan(plan)\n"
+            ),
+        })
+        findings = speculation_safety.run(project)
+        assert any(
+            "epoch_valid" in f.message and f.line == 5 for f in findings
+        ), findings
+
+    def test_fully_guarded_consume_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/sched.py": (
+                "class Loop:\n"
+                "    def serve(self, spec, plan):\n"
+                "        if self._fenced():\n"
+                "            return None\n"
+                "        if not spec.epoch_valid(plan):\n"
+                "            return None\n"
+                "        return spec.consume_plan(plan)\n"
+            ),
+        })
+        assert speculation_safety.run(project) == []
+
+    def test_guards_after_the_consume_do_not_count(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/sched.py": (
+                "class Loop:\n"
+                "    def serve(self, spec, plan):\n"
+                "        node = spec.consume_plan(plan)\n"
+                "        if self._fenced() or not spec.epoch_valid(plan):\n"
+                "            return None\n"
+                "        return node\n"
+            ),
+        })
+        findings = speculation_safety.run(project)
+        assert len(findings) == 2, findings
+
+    def test_defining_module_is_exempt(self, tmp_path):
+        # consume_plan's own implementation (and any internal use) is
+        # the mechanism under test, not a call site to guard.
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/speculation.py": (
+                "class SpeculativeCache:\n"
+                "    def consume_plan(self, plan):\n"
+                "        return plan.node\n"
+                "    def _drain(self, plan):\n"
+                "        return self.consume_plan(plan)\n"
+            ),
+        })
+        assert speculation_safety.run(project) == []
+
+    def test_catches_informer_callback_into_cache(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/cluster/informer.py": (
+                "class InformerCache:\n"
+                "    def handle_batch(self, events):\n"
+                "        self.speculation.flush()\n"
+            ),
+        })
+        findings = speculation_safety.run(project)
+        assert any(
+            "pull-based" in f.message and f.line == 3 for f in findings
+        ), findings
+
+    def test_informer_spec_free_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/cluster/informer.py": (
+                "class InformerCache:\n"
+                "    def handle_batch(self, events):\n"
+                "        self.buffer.flush()\n"
+            ),
+        })
+        assert speculation_safety.run(project) == []
+
+
+class TestSpeculationLockOrder:
+    """ISSUE 17: speculation is the BOTTOM lock level — informer code
+    reaching into the cache's lock is an ordering violation; the cache
+    pulling informer feeds while holding its own lock is the legal
+    direction."""
+
+    def test_catches_informer_reach_into_speculation(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class SpeculativeCache:\n"
+                "    def __init__(self):\n"
+                "        self._lock = None\n"
+                "    def _invalidate(self, key):\n"
+                "        with self._lock:\n"
+                "            pass\n"
+                "class InformerCache:\n"
+                "    def __init__(self, spec):\n"
+                "        self._lock = None\n"
+                "        self.spec = spec\n"
+                "    def handle(self, key):\n"
+                "        with self._lock:\n"
+                "            self.spec._invalidate(key)\n"
+            ),
+        })
+        findings = lock_discipline.run(project)
+        assert any(
+            "lock-order violation" in f.message
+            and "speculation" in f.message
+            for f in findings
+        ), findings
+
+    def test_speculation_pulling_informer_feed_is_legal(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/mod.py": (
+                "class InformerCache:\n"
+                "    def __init__(self):\n"
+                "        self._lock = None\n"
+                "    def changes_since(self, epoch):\n"
+                "        with self._lock:\n"
+                "            return None\n"
+                "class SpeculativeCache:\n"
+                "    def __init__(self, informer):\n"
+                "        self._lock = None\n"
+                "        self.informer = informer\n"
+                "    def sweep(self):\n"
+                "        with self._lock:\n"
+                "            return self.informer.changes_since(0)\n"
+            ),
+        })
+        assert lock_discipline.run(project) == []
 
 
 class TestSuppressions:

@@ -1,6 +1,6 @@
 """yodalint — project-invariant static analysis for yoda-tpu (ISSUE 13).
 
-Eight passes over one shared parse + call graph, gating ``make lint``:
+Nine passes over one shared parse + call graph, gating ``make lint``:
 
 1. lock-discipline        — no blocking work under a component lock;
                             lock acquisitions respect the declared DAG
@@ -16,6 +16,9 @@ Eight passes over one shared parse + call graph, gating ``make lint``:
 7. verdict-taxonomy       — why-pending kinds stay in the pinned set
 8. reload-safety          — hot-reload classification is coherent and
                             every RELOADABLE knob is genuinely live
+9. speculation-safety     — speculative plan consumption is dominated by
+                            the leader fence AND the epoch check; the
+                            informer never calls into the cache
 
 Suppress a deliberate exception with ``# yodalint: ok <pass> <reason>``
 on (or directly above) the flagged line; the reason is mandatory.

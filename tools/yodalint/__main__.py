@@ -9,7 +9,7 @@ import sys
 import time
 from pathlib import Path
 
-from tools.yodalint import PASS_NAMES, Project, report, run_all
+from tools.yodalint import ALL_PASSES, PASS_NAMES, Project, report, run_all
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -35,7 +35,7 @@ def main(argv: "list[str] | None" = None) -> int:
     wall = time.monotonic() - t0
     print(
         f"yodalint: {len(project.modules)} modules, "
-        f"{len(args.passes) if args.passes else 8} passes, "
+        f"{len(args.passes) if args.passes else len(ALL_PASSES)} passes, "
         f"{n} finding{'s' if n != 1 else ''} ({wall:.2f}s)",
         file=sys.stderr if n else sys.stdout,
     )

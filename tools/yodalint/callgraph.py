@@ -50,6 +50,14 @@ PARAM_TYPES = {
     # informer level — resolving `self.router.route(...)` lets the
     # lock-discipline pass see reaches into it from commit paths.
     "router": "ShardRouter",
+    # Sub-millisecond serve (ISSUE 17): the speculative cache sits at
+    # the BOTTOM of the lock DAG — resolving its conventional receivers
+    # (`self.speculation`, the rebalancer's `self.speculator`, the serve
+    # path's local `spec`) lets lock-discipline see reaches into its
+    # lock from higher levels.
+    "speculation": "SpeculativeCache",
+    "speculator": "SpeculativeCache",
+    "spec": "SpeculativeCache",
 }
 
 

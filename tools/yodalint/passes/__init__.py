@@ -10,6 +10,7 @@ from tools.yodalint.passes import (
     metrics_drift,
     reload_safety,
     snapshot_immutability,
+    speculation_safety,
     verdict_taxonomy,
 )
 
@@ -23,6 +24,7 @@ ALL_PASSES = (
     metrics_drift,
     verdict_taxonomy,
     reload_safety,
+    speculation_safety,
 )
 
 PASS_NAMES = {p.NAME for p in ALL_PASSES}

@@ -25,14 +25,18 @@ the design, not a bug (docs/ARCHITECTURE.md).
 
 **Lock-ordering DAG.** The component locks are ordered
 
-    informer -> queue -> accountant -> gang -> metrics
+    speculation -> informer -> queue -> accountant -> gang -> metrics
 
 (watch delivery flows informer->queue; queue admission verdicts flow
 ->metrics; nothing may reach *backwards*). Holding a later lock while
 acquiring an earlier one — directly or through the call graph — is a
-potential deadlock and is flagged. Locks outside the five levels
-(rebalancer, federation, nodehealth, backends) are screened for blocking
-calls but carry no order.
+potential deadlock and is flagged. The speculation level (ISSUE 17)
+sits at the BOTTOM: the speculative cache pulls from the informer's
+delta feeds, so holding its lock while taking informer locks is legal,
+and the informer must never call back into the cache (the companion
+``speculation-safety`` pass pins that direction). Locks outside the six
+levels (rebalancer, federation, nodehealth, backends) are screened for
+blocking calls but carry no order.
 """
 
 from __future__ import annotations
@@ -61,11 +65,12 @@ EXEMPT_LOCK_NAMES = {"cycle_lock", "post_filter_lock", "select_lock"}
 #: The declared ordering DAG (lower acquires before higher; acquiring a
 #: LOWER level while holding a higher one is the violation).
 LOCK_LEVELS = {
-    "informer": 0,
-    "queue": 1,
-    "accountant": 2,
-    "gang": 3,
-    "metrics": 4,
+    "speculation": 0,
+    "informer": 1,
+    "queue": 2,
+    "accountant": 3,
+    "gang": 4,
+    "metrics": 5,
 }
 
 #: Which classes' locks carry which level. Module-level grouping for the
@@ -84,6 +89,14 @@ CLASS_LEVELS = {
     # ChipAccountant.commit_staged's capacity source a watch-maintained
     # local dict instead of an informer read.
     "ShardRouter": "informer",
+    # Sub-millisecond serve (ISSUE 17): the speculative placement cache
+    # is PULL-only — its producer/consumer paths read the informer feeds
+    # and the accountant while holding nothing above speculation level,
+    # so its lock ranks below everything. A reach from any higher level
+    # back into SpeculativeCache._lock (e.g. an informer-side
+    # invalidation callback) is exactly the deadlock the ordering
+    # forbids.
+    "SpeculativeCache": "speculation",
 }
 MODULE_LEVELS = {
     "yoda_tpu/observability.py": "metrics",
@@ -417,7 +430,7 @@ def _check_order(findings, mod, line, held_keys, acquired, via) -> None:
                     f"lock-order violation: acquiring {acquired.level} "
                     f"lock ({acquired.owner}.{acquired.attr}, via {via}) "
                     f"while holding {held.level} lock ({held.owner}."
-                    f"{held.attr}) — declared order is "
+                    f"{held.attr}) — declared order is speculation -> "
                     "informer -> queue -> accountant -> gang -> metrics",
                 )
             )

@@ -1,0 +1,150 @@
+"""Pass 9 — speculation-safety: speculative plan consumption is always
+behind the full validity chain, and the informer never reaches into the
+cache.
+
+The sub-millisecond serve fast path (ISSUE 17,
+framework/speculation.py) binds a pod from a plan computed BETWEEN serve
+cycles. Its whole safety argument is the consume-time chain — leader
+fence, per-plan epoch check against both informer delta feeds, O(1)
+staged-claim spot check — so a call site that consumes a plan without
+the chain is a stale-bind (or split-brain bind) waiting for fleet churn
+to expose it. Two rules:
+
+**A. Guarded consumption.** Every ``.consume_plan(...)`` call site
+outside the cache's own module must be dominated, within the enclosing
+function, by BOTH a leader-fence read (the fence-before-write marker
+set: ``_fenced`` / ``fence_fn`` / ...) and an epoch-validity read
+(``epoch_valid``). The revalidate spot check is deliberately NOT a
+marker: it is advisory ranking hygiene, while the fence and the epoch
+feeds are the correctness half — and requiring exactly the load-bearing
+pair keeps the rule enforceable without taint analysis.
+
+**B. Pull-only invalidation.** ``cluster/informer.py`` must not call
+speculation-cache methods (on any receiver whose spelling mentions
+``spec``). Invalidation is pull-based off the delta feeds by design: an
+informer→cache callback would run under the informer lock and acquire
+the speculation lock BELOW it, inverting the lock DAG the
+lock-discipline pass declares (speculation -> informer -> ...).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.callgraph import CallGraph, FunctionInfo
+from tools.yodalint.core import Finding, Project
+
+NAME = "speculation-safety"
+
+#: Same marker set as fence-before-write: evidence the enclosing function
+#: checked leadership before the consume.
+FENCE_MARKERS = {"_fenced", "fenced_fn", "fence_fn", "gate_fn", "is_leader"}
+
+#: Evidence the plan's epochs were checked against the delta feeds.
+EPOCH_MARKERS = {"epoch_valid"}
+
+#: The cache's mutating/consuming surface, for Rule B.
+SPEC_METHODS = {
+    "lookup",
+    "consume_plan",
+    "reserve_rejected",
+    "speculate_once",
+    "sweep",
+    "flush",
+    "configure",
+    "_invalidate",
+}
+
+#: The module that defines the cache: its internal consume logic is the
+#: mechanism, not a call site.
+DEFINING_SUFFIX = "framework/speculation.py"
+
+
+def _marker_lines(fn: FunctionInfo, markers: "set[str]") -> "list[int]":
+    lines = []
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Attribute) and node.attr in markers:
+            lines.append(node.lineno)
+        elif isinstance(node, ast.Name) and node.id in markers:
+            lines.append(node.lineno)
+    return lines
+
+
+def _receiver_mentions_spec(func: ast.Attribute) -> bool:
+    parts: "list[str]" = []
+    node: ast.expr = func.value
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return any("spec" in p for p in parts)
+
+
+def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
+    graph = graph or CallGraph(project)
+    findings: "list[Finding]" = []
+    for fn in graph.functions.values():
+        rel = fn.module.relpath
+        if rel.endswith(DEFINING_SUFFIX) or "/testing/" in rel:
+            continue
+        fence_lines = None  # computed lazily: most functions never consume
+        epoch_lines = None
+        for call in graph.calls_in(fn):
+            func = call.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr == "consume_plan"
+            ):
+                continue
+            if fence_lines is None:
+                fence_lines = _marker_lines(fn, FENCE_MARKERS)
+                epoch_lines = _marker_lines(fn, EPOCH_MARKERS)
+            if not any(line <= call.lineno for line in fence_lines):
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        call.lineno,
+                        "speculative .consume_plan() with no leader-fence "
+                        "check dominating it (no _fenced/fenced_fn/"
+                        "fence_fn/gate_fn read before this line in "
+                        f"{fn.qualname.split('::')[-1]}) — a fenced "
+                        "ex-leader could bind a speculated placement",
+                    )
+                )
+            if not any(line <= call.lineno for line in epoch_lines):
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        call.lineno,
+                        "speculative .consume_plan() with no epoch_valid "
+                        "check dominating it in "
+                        f"{fn.qualname.split('::')[-1]} — a plan stale "
+                        "against the informer delta feeds could bind",
+                    )
+                )
+    informer = project.module("cluster/informer.py")
+    if informer is not None:
+        for node in ast.walk(informer.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in SPEC_METHODS
+                and _receiver_mentions_spec(node.func)
+            ):
+                continue
+            findings.append(
+                Finding(
+                    NAME,
+                    informer.relpath,
+                    node.lineno,
+                    f"informer calls speculation cache method "
+                    f".{node.func.attr}() — invalidation is pull-based "
+                    "off the delta feeds; an informer-side callback "
+                    "acquires the speculation lock under the informer "
+                    "lock, inverting the declared lock DAG",
+                )
+            )
+    return findings

@@ -169,6 +169,17 @@ class InformerCache:
         # epoch bumped per claimed-total change, ring of (epoch, node).
         self._claim_epoch = 0
         self._claim_ring: deque[tuple[int, str]] = deque(maxlen=65536)
+        # Admission delta feed: epoch bumped per change that can flip a
+        # node's ADMISSION verdict without touching the metrics feed —
+        # Node-object events (cordon/taint/label/allocatable flips ride
+        # "modified", which the metrics ring deliberately elides) and
+        # per-node pod-set changes (count/cpu/mem/hostPort usage). The
+        # cross-snapshot admission-vector cache (plugins/yoda/batch.py)
+        # patches only these nodes' rows instead of re-running its O(N)
+        # loop per snapshot rebuild. Fence flips are NOT here: the fence
+        # set is stamped per snapshot and consumers diff it directly.
+        self._admission_epoch = 0
+        self._admission_ring: deque[tuple[int, str]] = deque(maxlen=65536)
         # NodeInfo reuse across snapshots: rebuilding 10^5 NodeInfo objects
         # (plus their pod-list copies) per watch event dominated snapshot()
         # at datacenter scale. Entries are invalidated per node on the
@@ -312,6 +323,11 @@ class InformerCache:
             self._nodes[node.name] = node
         self._ni_cache.pop(node.name, None)
         self._batch_dirty = True
+        # EVERY Node event (modified included) feeds the admission ring:
+        # cordon/taint/label flips change admission verdicts even though
+        # the metrics arrays don't care.
+        self._admission_epoch += 1
+        self._admission_ring.append((self._admission_epoch, node.name))
         if event.type in ("added", "deleted"):
             # The candidate-node SET changed (a CR may enter/leave the
             # snapshot), which invalidates the fleet arrays keyed on
@@ -418,6 +434,8 @@ class InformerCache:
         self._pod_nodes[pod.uid] = (node, claim)
         self._claimed_mib[node] = self._claimed_mib.get(node, 0) + claim
         self._ni_cache.pop(node, None)
+        self._admission_epoch += 1
+        self._admission_ring.append((self._admission_epoch, node))
         if claim:
             self._claim_epoch += 1
             self._claim_ring.append((self._claim_epoch, node))
@@ -427,6 +445,8 @@ class InformerCache:
         self._pods_by_node.get(node, {}).pop(uid, None)
         self._claimed_mib[node] = max(self._claimed_mib.get(node, 0) - claim, 0)
         self._ni_cache.pop(node, None)
+        self._admission_epoch += 1
+        self._admission_ring.append((self._admission_epoch, node))
         if claim:
             self._claim_epoch += 1
             self._claim_ring.append((self._claim_epoch, node))
@@ -511,6 +531,39 @@ class InformerCache:
                     break
                 nodes.add(name)
             return cur, {n: self._claimed_mib.get(n, 0) for n in nodes}
+
+    @property
+    def admission_epoch(self) -> int:
+        with self._lock:
+            return self._admission_epoch
+
+    def admission_changes_since(
+        self, epoch: int
+    ) -> "tuple[int, frozenset[str] | None]":
+        """Delta feed over admission-relevant node state (Node-object
+        events + per-node pod-set changes — everything the metrics ring
+        elides that can still flip an admission verdict): returns
+        ``(current_epoch, changed_nodes)`` for epochs ``(epoch, current]``,
+        or ``(current_epoch, None)`` when the bounded ring no longer
+        reaches back (or the consumer is ahead — epoch skew): the
+        consumer must rebuild its vector from the snapshot. Consumers
+        read the SNAPSHOT-STAMPED epoch (``snapshot.admission_epoch``),
+        not this live one, so a patched vector is exactly as fresh as the
+        snapshot it was patched from."""
+        with self._lock:
+            cur = self._admission_epoch
+            if epoch == cur:
+                return cur, frozenset()
+            if epoch > cur or not self._admission_ring:
+                return cur, None
+            if self._admission_ring[0][0] > epoch + 1:
+                return cur, None
+            nodes: set[str] = set()
+            for e, name in reversed(self._admission_ring):
+                if e <= epoch:
+                    break
+                nodes.add(name)
+            return cur, frozenset(nodes)
 
     def claimed_hbm_mib(self, node_name: str) -> int:
         with self._lock:
@@ -652,6 +705,11 @@ class InformerCache:
                 ),
             )
             snap.metrics_version = self._metrics_version
+            # Admission-feed epoch AT BUILD, under the same lock: a
+            # consumer that patches a cached vector from this snapshot
+            # stamps this epoch, so events landing after the build are
+            # re-applied on the next patch instead of silently skipped.
+            snap.admission_epoch = self._admission_epoch
             if self.fence_fn is not None:
                 try:
                     snap.fenced = frozenset(self.fence_fn())

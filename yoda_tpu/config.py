@@ -48,6 +48,9 @@ RELOADABLE_KNOBS = frozenset(
         "rebalance_max_victims",
         "rebalance_preemption",
         "rebalance_elastic",
+        "spec_enabled",
+        "spec_cache_size",
+        "spec_shapes_max",
         "node_repair",
         "node_drain_deadline_s",
         "overload_period_s",
@@ -282,6 +285,20 @@ class SchedulerConfig:
     # Victim budget per admitted gang: the preemption pass gives up
     # rather than evict more than this many pods for one parked gang.
     rebalance_max_victims: int = 8
+    # Speculative placement cache (framework/speculation.py,
+    # docs/OPERATIONS.md "Sub-millisecond serve" runbook): between serve
+    # cycles the rebalancer thread's idle capacity pre-validates one
+    # placement per recently-seen single-pod shape; a hot-shape arrival
+    # binds from the cached plan after the epoch + staged-claim
+    # revalidation, skipping the O(fleet) filter/score spans. All three
+    # knobs hot-reload; spec_enabled=False flushes every cached plan
+    # atomically (the operator kill switch).
+    spec_enabled: bool = True
+    # Bound on cached plans (one per shape; shapes beyond the bound serve
+    # at the fused-dispatch baseline).
+    spec_cache_size: int = 256
+    # Bound on tracked miss shapes the speculator re-plans per tick.
+    spec_shapes_max: int = 64
     # Node failure domains (yoda_tpu/nodehealth): the per-node health
     # ladder's silence thresholds. A node whose agent has been silent
     # past node_suspect_after_s is SUSPECT — fenced from NEW placements
@@ -656,6 +673,28 @@ class SchedulerConfig:
             raise ValueError(
                 "rebalance_max_victims must be an int >= 1, got "
                 f"{cfg.rebalance_max_victims!r}"
+            )
+        if not isinstance(cfg.spec_enabled, bool):
+            raise ValueError(
+                f"spec_enabled must be a bool, got {cfg.spec_enabled!r}"
+            )
+        if (
+            isinstance(cfg.spec_cache_size, bool)
+            or not isinstance(cfg.spec_cache_size, int)
+            or cfg.spec_cache_size < 1
+        ):
+            raise ValueError(
+                "spec_cache_size must be an int >= 1, got "
+                f"{cfg.spec_cache_size!r}"
+            )
+        if (
+            isinstance(cfg.spec_shapes_max, bool)
+            or not isinstance(cfg.spec_shapes_max, int)
+            or cfg.spec_shapes_max < 1
+        ):
+            raise ValueError(
+                "spec_shapes_max must be an int >= 1, got "
+                f"{cfg.spec_shapes_max!r}"
             )
         node_thresholds = (cfg.node_suspect_after_s, cfg.node_down_after_s)
         if any(

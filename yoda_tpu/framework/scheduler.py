@@ -136,6 +136,11 @@ class Scheduler:
         # may already be acting on the same pods) and the serve loop parks
         # the queue until leadership returns. Settable post-construction.
         self.fence_fn = fence_fn
+        # Speculative placement cache (framework/speculation.py): wired by
+        # the stack builder; None disables the fast path entirely. The
+        # consume chain in _schedule_one_locked (lookup -> fence ->
+        # epoch_valid -> revalidate -> consume_plan) is the only reader.
+        self.speculation = None
         # Bind pipeline (ISSUE 4): when wired, gang releases fan their
         # member binds out on this executor and the serve loop OVERLAPS
         # the next cycle (snapshot refresh + kernel dispatch) with the
@@ -477,108 +482,158 @@ class Scheduler:
                 unresolvable=st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE,
             )
 
-        # Fused batch filter+score (TPU-native hot path), else per-node loops.
-        with timer.span("filter"):
-            try:
-                batch = self.framework.run_batch_filter_score(
-                    state, pod, snapshot
-                )
-            except Exception as e:  # noqa: BLE001 — keep the loop serving
-                # The batch plugin's own fallback chain (YodaBatch._dispatch)
-                # already demoted through every kernel backend; reaching
-                # here means even the host evaluator failed. The pod
-                # retries via the error path; the loop survives.
-                log.exception(
-                    "batch filter/score failed for %s; retrying via backoff",
-                    pod.key,
-                )
-                return done("error", message=f"batch filter/score failed: {e}")
-            if batch is not None:
-                statuses, batch_scores = batch
-                feasible = sorted(batch_scores)
-            else:
-                limit = self._search_limit(len(snapshot))
-                statuses = self.framework.run_filters(
-                    state, pod, snapshot,
-                    stop_after_feasible=limit,
-                    start_index=self._search_start(len(snapshot)),
-                )
-                if limit:
-                    # run_filters records a status per node VISITED, so the
-                    # map's size is the processed count (upstream advances
-                    # nextStartNodeIndex the same way).
-                    self._advance_search(len(statuses))
-                batch_scores = {}
-                feasible = sorted(
-                    n for n, s in statuses.items() if s.success
-                )
-        feasible_count = len(feasible)
-        # The reference's V(3) per-node decision detail (scheduler.go:67).
-        # Under search truncation, statuses covers only the scanned window
-        # — say so, or 12/1000 reads as 988 infeasible nodes.
-        if log.isEnabledFor(logging.DEBUG):
-            log.debug(
-                "pod %s: %d/%d scanned nodes feasible (fleet %d)",
-                pod.key, feasible_count, len(statuses), len(snapshot),
-            )
-            for n in sorted(statuses):
-                s = statuses[n]
-                if not s.success:
-                    log.debug("pod %s: node %s rejected: %s", pod.key, n, s.message)
+        # Speculative placement cache (framework/speculation.py): a hot,
+        # constraint-free shape can bind from a plan the rebalancer's idle
+        # capacity pre-validated between cycles, skipping the O(fleet)
+        # filter/score spans entirely. Consumption is gated on the leader
+        # fence, the plan's epoch validity against BOTH informer delta
+        # feeds, and an O(1) admission + staged-claim spot check on the
+        # single chosen node; a failed Reserve falls through to the full
+        # path below — a speculative miss never parks the pod.
+        best: str | None = None
+        spec = self.speculation
+        if spec is not None and spec.enabled:
+            t_spec = self.clock()
+            with timer.span("spec"):
+                node = None
+                plan = spec.lookup(pod)
+                if (
+                    plan is not None
+                    and not self._fenced()
+                    and spec.epoch_valid(plan)
+                    and spec.revalidate(plan, pod, snapshot)
+                ):
+                    node = spec.consume_plan(plan)
+            if node is not None:
+                with timer.span("reserve"):
+                    st = self.framework.run_reserve(state, pod, node)
+                if st.success:
+                    best = node
+                    feasible_count = 1
+                    spec.record_bound((self.clock() - t_spec) * 1e3)
+                else:
+                    # A foreign claim raced the window between the spot
+                    # check and Reserve; the plan was already consumed.
+                    spec.reserve_rejected(plan)
 
-        if not feasible:
-            # As above: no Reserve on the infeasible path — release before
-            # the preemption API round-trips.
-            release_cycle_lock()
-            with timer.span("postfilter"), self.post_filter_lock:
-                nominated, pf_st = self.framework.run_post_filter(
-                    state, pod, snapshot, statuses
+        if best is None:
+            # Fused batch filter+score (TPU-native hot path), else per-node
+            # loops.
+            with timer.span("filter"):
+                try:
+                    batch = self.framework.run_batch_filter_score(
+                        state, pod, snapshot
+                    )
+                except Exception as e:  # noqa: BLE001 — keep the loop serving
+                    # The batch plugin's own fallback chain
+                    # (YodaBatch._dispatch) already demoted through every
+                    # kernel backend; reaching here means even the host
+                    # evaluator failed. The pod retries via the error path;
+                    # the loop survives.
+                    log.exception(
+                        "batch filter/score failed for %s; retrying via "
+                        "backoff",
+                        pod.key,
+                    )
+                    return done(
+                        "error", message=f"batch filter/score failed: {e}"
+                    )
+                if batch is not None:
+                    statuses, batch_scores = batch
+                    feasible = sorted(batch_scores)
+                else:
+                    limit = self._search_limit(len(snapshot))
+                    statuses = self.framework.run_filters(
+                        state, pod, snapshot,
+                        stop_after_feasible=limit,
+                        start_index=self._search_start(len(snapshot)),
+                    )
+                    if limit:
+                        # run_filters records a status per node VISITED, so
+                        # the map's size is the processed count (upstream
+                        # advances nextStartNodeIndex the same way).
+                        self._advance_search(len(statuses))
+                    batch_scores = {}
+                    feasible = sorted(
+                        n for n, s in statuses.items() if s.success
+                    )
+            feasible_count = len(feasible)
+            # The reference's V(3) per-node decision detail (scheduler.go:67).
+            # Under search truncation, statuses covers only the scanned window
+            # — say so, or 12/1000 reads as 988 infeasible nodes.
+            if log.isEnabledFor(logging.DEBUG):
+                log.debug(
+                    "pod %s: %d/%d scanned nodes feasible (fleet %d)",
+                    pod.key, feasible_count, len(statuses), len(snapshot),
                 )
-            if nominated:
-                return done("nominated", node=nominated, message=pf_st.message)
-            return done("unschedulable", message=summarize_failure(statuses))
+                for n in sorted(statuses):
+                    s = statuses[n]
+                    if not s.success:
+                        log.debug(
+                            "pod %s: node %s rejected: %s",
+                            pod.key, n, s.message,
+                        )
 
-        with timer.span("score"):
-            st = self.framework.run_pre_score(state, pod, snapshot, feasible)
-            totals = {}
-            if st.success:
-                totals, st = self.framework.run_scores(
+            if not feasible:
+                # As above: no Reserve on the infeasible path — release
+                # before the preemption API round-trips.
+                release_cycle_lock()
+                with timer.span("postfilter"), self.post_filter_lock:
+                    nominated, pf_st = self.framework.run_post_filter(
+                        state, pod, snapshot, statuses
+                    )
+                if nominated:
+                    return done(
+                        "nominated", node=nominated, message=pf_st.message
+                    )
+                return done(
+                    "unschedulable", message=summarize_failure(statuses)
+                )
+
+            with timer.span("score"):
+                st = self.framework.run_pre_score(
                     state, pod, snapshot, feasible
                 )
-        # Outside the span: returning from inside it would drop the score
-        # phase from this cycle's trace entry and latency histogram.
-        if not st.success:
-            return done("error", message=st.message)
-        if batch_scores:
-            if self.framework.score_plugins:
-                # Combining with per-node plugins: bring the batch total onto
-                # the same [0,100] scale.
-                normalized = _normalize(batch_scores)
-                for n in feasible:
-                    totals[n] = totals.get(n, 0) + normalized[n]
-            else:
-                # Batch is the only scorer (the normal fused mode): its
-                # scores are already normalized+tiered; re-normalizing would
-                # only quantize away within-tier ordering.
-                totals = dict(batch_scores)
+                totals = {}
+                if st.success:
+                    totals, st = self.framework.run_scores(
+                        state, pod, snapshot, feasible
+                    )
+            # Outside the span: returning from inside it would drop the
+            # score phase from this cycle's trace entry and latency
+            # histogram.
+            if not st.success:
+                return done("error", message=st.message)
+            if batch_scores:
+                if self.framework.score_plugins:
+                    # Combining with per-node plugins: bring the batch total
+                    # onto the same [0,100] scale.
+                    normalized = _normalize(batch_scores)
+                    for n in feasible:
+                        totals[n] = totals.get(n, 0) + normalized[n]
+                else:
+                    # Batch is the only scorer (the normal fused mode): its
+                    # scores are already normalized+tiered; re-normalizing
+                    # would only quantize away within-tier ordering.
+                    totals = dict(batch_scores)
 
-        best = max(feasible, key=lambda n: (totals.get(n, 0), n))
-        # Final scores (the reference's V(3) score log, scheduler.go:143).
-        if log.isEnabledFor(logging.DEBUG):
-            ranked = sorted(
-                ((totals.get(n, 0), n) for n in feasible), reverse=True
-            )
-            log.debug(
-                "pod %s: scores %s -> %s",
-                pod.key,
-                [(n, s) for s, n in ranked[:8]],
-                best,
-            )
+            best = max(feasible, key=lambda n: (totals.get(n, 0), n))
+            # Final scores (the reference's V(3) score log, scheduler.go:143).
+            if log.isEnabledFor(logging.DEBUG):
+                ranked = sorted(
+                    ((totals.get(n, 0), n) for n in feasible), reverse=True
+                )
+                log.debug(
+                    "pod %s: scores %s -> %s",
+                    pod.key,
+                    [(n, s) for s, n in ranked[:8]],
+                    best,
+                )
 
-        with timer.span("reserve"):
-            st = self.framework.run_reserve(state, pod, best)
-        if not st.success:
-            return done("unschedulable", node=best, message=st.message)
+            with timer.span("reserve"):
+                st = self.framework.run_reserve(state, pod, best)
+            if not st.success:
+                return done("unschedulable", node=best, message=st.message)
 
         # Reservation charged: other profiles' cycles now see the claim.
         release_cycle_lock()

@@ -152,6 +152,10 @@ class Gauge:
 
 
 class Histogram:
+    # Recent-observation ring size per label series (exact quantiles up
+    # to this many samples; the BASELINE p99 is computed from it).
+    RING = 4096
+
     def __init__(
         self,
         name: str,
@@ -162,13 +166,18 @@ class Histogram:
         self.help = help_
         self.buckets = tuple(sorted(buckets))
         self._lock = threading.Lock()
-        # label-key -> (per-bucket counts, count, sum, recent ring for quantiles)
+        # label-key -> [per-bucket counts, count, sum, quantile ring].
+        # The ring is a PREALLOCATED list written by index (count % RING)
+        # — after a series' first observation the hot path allocates
+        # nothing (the old deque paid a node box per append), which the
+        # sub-millisecond serve budget cares about: observe() runs on
+        # every cycle for every phase.
         self._series: dict[tuple[tuple[str, str], ...], list] = {}
 
     def _series_for(self, key):
         s = self._series.get(key)
         if s is None:
-            s = [[0] * len(self.buckets), 0, 0.0, deque(maxlen=4096)]
+            s = [[0] * len(self.buckets), 0, 0.0, [0.0] * self.RING]
             self._series[key] = s
         return s
 
@@ -179,9 +188,11 @@ class Histogram:
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     s[0][i] += 1
+            # Ring slot BEFORE the count bump: slot = total observations
+            # so far, mod ring size — allocation-free in-place write.
+            s[3][s[1] % self.RING] = value
             s[1] += 1
             s[2] += value
-            s[3].append(value)
 
     def count(self, **labels: str) -> int:
         key = tuple(sorted(labels.items()))
@@ -190,18 +201,19 @@ class Histogram:
             return s[1] if s else 0
 
     def quantile(self, q: float, **labels: str) -> float:
-        """Quantile over the recent-observation ring (exact for <=4096
+        """Quantile over the recent-observation ring (exact for <=RING
         samples — the BASELINE p99 is computed from this, not from bucket
-        interpolation). The ring is COPIED under the metric lock and
-        sorted outside it: the O(n log n) sort used to run inside the
+        interpolation). The live slots are COPIED under the metric lock
+        and sorted outside it: the O(n log n) sort used to run inside the
         lock, so a scrape/quantile burst could stall every ``observe()``
-        on the serve path behind 4096-sample sorts."""
+        on the serve path behind 4096-sample sorts. Wrap order does not
+        matter — a quantile is order-blind over the window."""
         key = tuple(sorted(labels.items()))
         with self._lock:
             s = self._series.get(key)
-            if not s or not s[3]:
+            if not s or not s[1]:
                 return 0.0
-            data = list(s[3])
+            data = s[3][: min(s[1], self.RING)]  # the slice is the copy
         data.sort()
         return data[min(int(len(data) * q), len(data) - 1)]
 
@@ -370,6 +382,21 @@ class SchedulingMetrics:
             "executor workers, not the scheduling thread)",
             buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                      1000.0, 5000.0),
+        )
+        # Speculative placement cache (framework/speculation.py,
+        # docs/OPERATIONS.md "Sub-millisecond serve" runbook): wall time
+        # of one cache-hit bind, end to end (lookup -> epoch validity ->
+        # single-node revalidation -> Reserve). The companion
+        # yoda_spec_cache_{hits,misses,invalidations}_total counters read
+        # the per-stack caches and are registered in
+        # standalone.build_stack (accumulator pattern).
+        self.spec_bind = r.histogram(
+            "yoda_spec_bind_ms",
+            "Wall milliseconds of one speculative cache-hit bind (lookup, "
+            "epoch validity, single-node revalidation, Reserve) — the "
+            "sub-millisecond serve fast path; the full filter/score path "
+            "reports under yoda_scheduling_latency_seconds instead",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0),
         )
         self.overlap_cycles = r.counter(
             "yoda_overlap_cycles_total",

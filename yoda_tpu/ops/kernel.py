@@ -477,6 +477,164 @@ def evaluate_joint_via_burst(
     ]
 
 
+def joint_fit_vectors(
+    requests: "list[KernelRequest]", offsets: "list[int]"
+) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+    """Per-row side inputs for :func:`joint_fit_scan`, aligned with a
+    :func:`stack_joint_burst` stacking: chip demand per member row
+    (``max(number, 1)``, the same floor the host fit gate applies), a
+    group-start flag at each ``offsets[g]``, and a validity flag (padding
+    rows are invalid: zero demand, never picked, and they cannot fail the
+    — nonexistent — gang they pad)."""
+    k = len(requests)
+    chips = np.array([max(r.number, 1) for r in requests], dtype=np.int32)
+    starts = np.zeros(k, dtype=np.int32)
+    valid = np.zeros(k, dtype=np.int32)
+    for g in range(len(offsets) - 1):
+        starts[offsets[g]] = 1
+    valid[: offsets[-1]] = 1
+    chips *= valid
+    return chips, starts, valid
+
+
+def joint_fit_scan(
+    feas_k, scores_k, claim_k, chips_k, starts_k, valid_k, xp=jnp
+):
+    """The host-side joint fit gate (plugins/yoda/batch.py
+    ``_joint_gang_fits``, plain gangs) as a scan over the stacked member
+    rows — the block-plan half of the fused decision kernel. Semantics are
+    member-for-member identical to the Python loop: each gang starts from
+    the chips consumed by every earlier FITTING gang, each member greedily
+    claims the highest-scoring node with enough claimable chips left (ties
+    -> first index, matching ``np.argmax``), and a gang with any
+    unplaceable member consumes nothing. Inputs are the per-member
+    [K, N] feasibility/score/claimable rows (kernel_packed_burst layout)
+    plus the :func:`joint_fit_vectors` side inputs. Returns
+    ``(picks, group_ok, sim)``: the node index each row claimed (-1 when
+    it claimed nothing), the gang's running fit verdict after each row
+    (read it at the gang's LAST row), and the [N] chips consumed by all
+    fitting gangs. ``xp`` selects jnp (jax.lax.scan, jittable — the fused
+    device program) or numpy (the host twin every fallback rung shares)."""
+    if xp is jnp:
+        feas_k = feas_k.astype(bool)
+        starts_k = starts_k.astype(bool)
+        valid_k = valid_k.astype(bool)
+        n = feas_k.shape[-1]
+        zeros = jnp.zeros(n, dtype=jnp.int32)
+
+        def step(carry, xs):
+            sim, tent, gok = carry
+            feas, scores, claim, chips, start, valid = xs
+            # Group boundary: commit the previous gang iff it fit, then
+            # restart the tentative ledger from the committed state.
+            sim = jnp.where(start & gok, tent, sim)
+            gok = start | gok
+            tent = jnp.where(start, sim, tent)
+            ok = feas & ((claim.astype(jnp.int32) - tent) >= chips) & valid
+            any_ok = ok.any()
+            pick = jnp.argmax(jnp.where(ok, scores, -1)).astype(jnp.int32)
+            take = any_ok & gok & valid
+            tent = tent.at[pick].add(jnp.where(take, chips, 0))
+            gok = gok & (any_ok | ~valid)
+            return (sim, tent, gok), (jnp.where(take, pick, -1), gok)
+
+        (sim, tent, gok), (picks, gok_k) = jax.lax.scan(
+            step,
+            (zeros, zeros, jnp.bool_(True)),
+            (feas_k, scores_k, claim_k, chips_k, starts_k, valid_k),
+        )
+        sim = jnp.where(gok, tent, sim)
+        return picks, gok_k, sim
+
+    feas_k = np.asarray(feas_k).astype(bool)
+    scores_k = np.asarray(scores_k)
+    claim_k = np.asarray(claim_k).astype(np.int32)
+    chips_k = np.asarray(chips_k)
+    starts_k = np.asarray(starts_k).astype(bool)
+    valid_k = np.asarray(valid_k).astype(bool)
+    k, n = feas_k.shape
+    sim = np.zeros(n, dtype=np.int32)
+    tent = sim.copy()
+    gok = True
+    picks = np.full(k, -1, dtype=np.int32)
+    gok_k = np.zeros(k, dtype=bool)
+    for i in range(k):
+        if starts_k[i]:
+            if gok:
+                sim = tent.copy()
+            gok = True
+            tent = sim.copy()
+        if valid_k[i]:
+            ok = feas_k[i] & ((claim_k[i] - tent) >= chips_k[i])
+            any_ok = bool(ok.any())
+            if any_ok and gok:
+                pick = int(np.argmax(np.where(ok, scores_k[i], -1)))
+                tent[pick] += int(chips_k[i])
+                picks[i] = pick
+            gok = gok and any_ok
+        gok_k[i] = gok
+    if gok:
+        sim = tent.copy()
+    return picks, gok_k, sim
+
+
+def kernel_joint_plan(
+    static: dict, dyn, host_ok_k, reqs_k, chips_k, starts_k, valid_k,
+    weights: Weights,
+):
+    """Admission + score + block-plan in ONE program: the K-row burst
+    evaluation (:func:`kernel_packed_burst`) feeding the joint fit scan
+    (:func:`joint_fit_scan`) without leaving the device — this is the
+    fused decision kernel that retires the last per-member Python loop on
+    the gang serve path. Returns ``(packed [K, 6, N], picks [K],
+    group_ok [K] int32, sim [N])``."""
+    packed = kernel_packed_burst(static, dyn, host_ok_k, reqs_k, weights=weights)
+    picks, gok_k, sim = joint_fit_scan(
+        packed[:, 0].astype(bool), packed[:, 3], packed[:, 5],
+        chips_k, starts_k, valid_k,
+    )
+    return packed, picks, gok_k.astype(jnp.int32), sim
+
+
+_kernel_joint_plan = functools.partial(jax.jit, static_argnames=("weights",))(
+    kernel_joint_plan
+)
+
+
+def evaluate_joint_plan_via_burst(
+    kern,
+    dyn: np.ndarray,
+    host_ok_groups: "list[np.ndarray]",
+    request_groups: "list[list[KernelRequest]]",
+    minimum: int = 1,
+) -> "tuple[list[list[KernelResult]], list[bool], list[np.ndarray]]":
+    """Fit-gated joint evaluation for backends without a fully fused
+    lowering (numpy fallback, Pallas, mesh-sharded): member rows go
+    through the backend's own ``evaluate_burst`` (still one dispatch), and
+    the block-plan scan runs host-side over the trimmed results — picks
+    and fits are identical to the fused program's, since padding rows are
+    infeasible everywhere. Returns ``(results_per_gang, fit_per_gang,
+    picks_per_gang)``."""
+    host_ok_k, requests, offsets = stack_joint_burst(
+        host_ok_groups, request_groups, minimum
+    )
+    flat = kern.evaluate_burst(dyn, host_ok_k, requests)
+    chips_k, starts_k, valid_k = joint_fit_vectors(requests, offsets)
+    m = offsets[-1]
+    picks, gok_k, _sim = joint_fit_scan(
+        np.stack([r.feasible for r in flat[:m]]),
+        np.stack([r.scores for r in flat[:m]]),
+        np.stack([r.claimable for r in flat[:m]]),
+        chips_k[:m], starts_k[:m], valid_k[:m],
+        xp=np,
+    )
+    g_count = len(request_groups)
+    grouped = [flat[offsets[g] : offsets[g + 1]] for g in range(g_count)]
+    fits = [bool(gok_k[offsets[g + 1] - 1]) for g in range(g_count)]
+    picks_g = [picks[offsets[g] : offsets[g + 1]] for g in range(g_count)]
+    return grouped, fits, picks_g
+
+
 def row_update_bucket(n_rows: int) -> int:
     """Compile bucket for a row-update scatter: next power of two, so a
     steady trickle of 1-3 changed rows per cycle shares one compiled
@@ -671,6 +829,55 @@ class DeviceFleetKernel:
             self, dyn, host_ok_groups, request_groups, minimum
         )
 
+    def evaluate_joint_plan(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "tuple[list[list[KernelResult]], list[bool], list[np.ndarray]]":
+        """G gangs' admission + scoring + cross-gang block-plan fit gate
+        in ONE fused dispatch (:func:`kernel_joint_plan`): the host-side
+        per-member fit loop becomes an in-program scan, so a joint gang
+        cycle costs one round trip regardless of member count."""
+        if self._static is None:
+            raise RuntimeError(
+                "put_static() must run before evaluate_joint_plan()"
+            )
+        host_ok_k, requests, offsets = stack_joint_burst(
+            host_ok_groups, request_groups, minimum
+        )
+        chips_k, starts_k, valid_k = joint_fit_vectors(requests, offsets)
+        reqs_k = np.stack([pack_request(r) for r in requests])
+        host_ok_k = host_ok_k.astype(np.int32)
+        if self._needs_put:
+            dyn = jax.device_put(dyn, self.device)
+            host_ok_k = jax.device_put(host_ok_k, self.device)
+            reqs_k = jax.device_put(reqs_k, self.device)
+            chips_k = jax.device_put(chips_k, self.device)
+            starts_k = jax.device_put(starts_k, self.device)
+            valid_k = jax.device_put(valid_k, self.device)
+        packed, picks, gok_k, _sim = _kernel_joint_plan(
+            self._static, dyn, host_ok_k, reqs_k, chips_k, starts_k,
+            valid_k, weights=self.weights,
+        )
+        packed = np.asarray(packed)
+        picks = np.asarray(picks)
+        gok = np.asarray(gok_k).astype(bool)
+        g_count = len(request_groups)
+        grouped = [
+            [
+                result_from_packed(self._names, packed[k])
+                for k in range(offsets[g], offsets[g + 1])
+            ]
+            for g in range(g_count)
+        ]
+        fits = [bool(gok[offsets[g + 1] - 1]) for g in range(g_count)]
+        picks_g = [
+            picks[offsets[g] : offsets[g + 1]] for g in range(g_count)
+        ]
+        return grouped, fits, picks_g
+
 
 class NumpyFleetKernel:
     """Pure-host evaluator with the same output contract as the jitted
@@ -770,6 +977,19 @@ class NumpyFleetKernel:
         minimum: int = 1,
     ) -> "list[list[KernelResult]]":
         return evaluate_joint_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
+
+    def evaluate_joint_plan(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "tuple[list[list[KernelResult]], list[bool], list[np.ndarray]]":
+        """Degraded-mode twin of the fused plan kernel: the numpy burst
+        loop plus the host-side fit scan, same results contract."""
+        return evaluate_joint_plan_via_burst(
             self, dyn, host_ok_groups, request_groups, minimum
         )
 

@@ -534,6 +534,24 @@ class PallasFleetKernel:
             self, dyn, host_ok_groups, request_groups, minimum
         )
 
+    def evaluate_joint_plan(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "tuple[list[list[KernelResult]], list[bool], list[np.ndarray]]":
+        """Fit-gated joint pass on the Mosaic backend: member rows through
+        the Pallas burst program (one dispatch), block-plan scan host-side
+        (ops.kernel.evaluate_joint_plan_via_burst) — the same split as
+        this backend's ``_epilogue``, which already finishes selection on
+        host."""
+        from yoda_tpu.ops.kernel import evaluate_joint_plan_via_burst
+
+        return evaluate_joint_plan_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
+
 
 def fused_filter_score_pallas(
     arrays: FleetArrays,

@@ -259,6 +259,25 @@ class ShardedDeviceFleetKernel:
             self, dyn, host_ok_groups, request_groups, minimum
         )
 
+    def evaluate_joint_plan(
+        self,
+        dyn: np.ndarray,
+        host_ok_groups: "list[np.ndarray]",
+        request_groups: "list[list[KernelRequest]]",
+        minimum: int = 1,
+    ) -> "tuple[list[list[KernelResult]], list[bool], list[np.ndarray]]":
+        """Fit-gated joint pass on the mesh backend: member rows through
+        the sharded burst program (one collective dispatch), block-plan
+        scan host-side over the gathered results
+        (ops.kernel.evaluate_joint_plan_via_burst) — the scan is O(K)
+        tiny and serial, so lowering it into the sharded program would
+        only add per-step collectives."""
+        from yoda_tpu.ops.kernel import evaluate_joint_plan_via_burst
+
+        return evaluate_joint_plan_via_burst(
+            self, dyn, host_ok_groups, request_groups, minimum
+        )
+
 
 def sharded_filter_score(
     arrays: FleetArrays,

@@ -135,6 +135,37 @@ def _admission_key(pod: PodSpec) -> "tuple | None":
     return key
 
 
+def _node_admission_ok(
+    name: str,
+    snapshot: Snapshot,
+    fenced: "frozenset | None",
+    pod: PodSpec,
+    aff: "AffinityData | None" = None,
+    pending_res: dict | None = None,
+) -> bool:
+    """ONE node's admission verdict — the per-row unit of
+    :func:`_host_admission`, factored out so the cross-snapshot admission
+    cache (YodaBatch._admission_vec) and the speculation revalidator can
+    re-check single rows without re-running the fleet loop."""
+    # Node-health fence (yoda_tpu/nodehealth): SUSPECT/DRAINING/DOWN
+    # hosts take no new placements. Cache-safe: the set is stamped
+    # per snapshot and fence flips invalidate the snapshot.
+    if fenced and name in fenced:
+        return False
+    if name not in snapshot:
+        return True
+    ni = snapshot.get(name)
+    if not pod_admits_on(ni.node, pod)[0]:
+        return False
+    if not node_fits_resources(ni, pod, pending_res)[0]:
+        return False
+    if pod.host_ports and not node_fits_host_ports(
+        ni, pod, aff.pending_ports if aff is not None else None
+    )[0]:
+        return False
+    return aff is None or aff.feasible(ni)[0]
+
+
 def _host_admission(
     static: FleetArrays,
     snapshot: Snapshot,
@@ -178,28 +209,11 @@ def _host_admission(
                 return hit[1]
 
     fenced = getattr(snapshot, "fenced", None)
-
-    def _ok(name: str) -> bool:
-        # Node-health fence (yoda_tpu/nodehealth): SUSPECT/DRAINING/DOWN
-        # hosts take no new placements. Cache-safe: the set is stamped
-        # per snapshot and fence flips invalidate the snapshot.
-        if fenced and name in fenced:
-            return False
-        if name not in snapshot:
-            return True
-        ni = snapshot.get(name)
-        if not pod_admits_on(ni.node, pod)[0]:
-            return False
-        if not node_fits_resources(ni, pod, pending_res)[0]:
-            return False
-        if pod.host_ports and not node_fits_host_ports(
-            ni, pod, aff.pending_ports if aff is not None else None
-        )[0]:
-            return False
-        return aff is None or aff.feasible(ni)[0]
-
     vec = np.array(
-        [_ok(name) for name in static.names]
+        [
+            _node_admission_ok(name, snapshot, fenced, pod, aff, pending_res)
+            for name in static.names
+        ]
         + [True] * (static.node_valid.shape[0] - len(static.names)),
         dtype=bool,
     )
@@ -310,6 +324,7 @@ class YodaBatch(BatchFilterScorePlugin):
         changes_fn: "Callable | None" = None,
         reserved_delta_fn: "Callable | None" = None,
         claimed_delta_fn: "Callable | None" = None,
+        admission_changes_fn: "Callable | None" = None,
     ) -> None:
         if batch_requests < 1:
             raise ValueError(f"batch_requests must be >= 1, got {batch_requests}")
@@ -388,6 +403,17 @@ class YodaBatch(BatchFilterScorePlugin):
         self.changes_fn = changes_fn
         self.reserved_delta_fn = reserved_delta_fn
         self.claimed_delta_fn = claimed_delta_fn
+        # Cross-snapshot admission-vector cache (ISSUE 17 satellite):
+        # constraint key -> [static, metrics epoch, admission epoch,
+        # fenced set, vec]. Valid only while the admission delta feed
+        # (InformerCache.admission_changes_since) is wired; entries are
+        # patched per changed host instead of rebuilt per snapshot.
+        self.admission_changes_fn = admission_changes_fn
+        self._adm_cache: dict = {}
+        self._adm_index: "tuple | None" = None
+        self.admission_reuse = 0      # vectors carried across snapshots
+        self.admission_patched = 0    # rows re-checked during carries
+        self.admission_rebuilds = 0   # full O(N) loop runs
         self._resident: "object | None" = None  # lazy FleetStateCache
         # Resident-state counters (classic-path restacks/reuse counted
         # here too, so yoda_snapshot_reuse_total / yoda_restack_total
@@ -433,6 +459,10 @@ class YodaBatch(BatchFilterScorePlugin):
         self.joint_dispatches = 0   # multi-gang kernel dispatches
         self.joint_gangs = 0        # gangs whose rows came from a joint one
         self.joint_parked = 0       # gangs parked whole by the joint fit gate
+        # Fused decision kernel (ISSUE 17): joint dispatches whose fit
+        # gate ran inside the kernel program (evaluate_joint_plan) instead
+        # of the host-side per-member loop.
+        self.fused_plan_dispatches = 0
         # Dispatch fallback chain + circuit breaker (failure-domain
         # hardening): counters feed yoda_dispatch_* metrics; _fb_* cache
         # the demoted kernels and the static arrays they last uploaded.
@@ -865,7 +895,8 @@ class YodaBatch(BatchFilterScorePlugin):
         # inter-pod affinity/spread + resource fit + host ports + volume
         # pins vs THIS pod) is per (pod, cycle): one packed upload.
         dyn = self._dyn_for(
-            static, host_ok=_host_admission(static, snapshot, pod, aff, pending_res)
+            static,
+            host_ok=self._admission_vec(static, snapshot, pod, aff, pending_res),
         )
         result = self._dispatch(static, lambda kern: kern.evaluate(dyn, reqk))
         self.dispatch_count += 1
@@ -1063,7 +1094,7 @@ class YodaBatch(BatchFilterScorePlugin):
         host_ok_k = np.zeros((k, n_pad), dtype=np.int32)
         requests: list[KernelRequest] = []
         for i, (pod, reqk) in enumerate(candidates):
-            host_ok_k[i] = _host_admission(static, snapshot, pod)
+            host_ok_k[i] = self._admission_vec(static, snapshot, pod)
             requests.append(reqk)
         # Pad to the fixed compile bucket: all-False host_ok rows are
         # infeasible everywhere and their results are never read.
@@ -1349,6 +1380,86 @@ class YodaBatch(BatchFilterScorePlugin):
             [list(g) for g in groups], snapshot, fit_gate=True
         )
 
+    def _admission_vec(
+        self,
+        static: FleetArrays,
+        snapshot: Snapshot,
+        pod: PodSpec,
+        aff: "AffinityData | None" = None,
+        pending_res: dict | None = None,
+    ) -> np.ndarray:
+        """:func:`_host_admission` with a CROSS-SNAPSHOT cache (ISSUE 17
+        satellite): entries key on the pod's constraint tuple and carry
+        the informer epochs STAMPED ON the snapshot they were built from.
+        A later snapshot whose deltas touch none of this fleet's hosts
+        reuses the vector as-is; one that touches a few re-checks only
+        those rows — steady-state cycles skip the O(N) Python loop
+        entirely. Three signals together cover every input of the
+        per-node check: the metrics delta feed (candidate-set changes are
+        structural -> full rebuild), the admission delta feed
+        (Node-object and pod-set changes per node — the classes the
+        metrics ring deliberately elides), and the snapshot-stamped fence
+        set, diffed directly (fence flips ride snapshot invalidation, not
+        a ring). Falls back to the per-snapshot cache when a feed or a
+        snapshot stamp is missing (bare constructions, foreign snapshot
+        providers) or on ring-behind/structural deltas."""
+        if aff is not None or pending_res:
+            # Per-cycle inputs a cached row cannot track: full loop.
+            return _host_admission(static, snapshot, pod, aff, pending_res)
+        key = _admission_key(pod)
+        m_epoch = getattr(snapshot, "metrics_version", None)
+        a_epoch = getattr(snapshot, "admission_epoch", None)
+        if (
+            key is None
+            or self.changes_fn is None
+            or self.admission_changes_fn is None
+            or not m_epoch
+            or a_epoch is None
+        ):
+            return _host_admission(static, snapshot, pod)
+        fenced = getattr(snapshot, "fenced", None) or frozenset()
+        entry = self._adm_cache.get(key)
+        if entry is not None and entry[0] is static:
+            _e_static, e_m, e_a, e_fenced, vec = entry
+            if e_m == m_epoch and e_a == a_epoch and e_fenced == fenced:
+                self.admission_reuse += 1
+                return vec
+            mdelta = self.changes_fn(e_m)
+            _acur, achanged = self.admission_changes_fn(e_a)
+            if (
+                mdelta is not None
+                and not mdelta.structural
+                and achanged is not None
+            ):
+                idx = self._adm_index
+                if idx is None or idx[0] is not static:
+                    idx = (
+                        static,
+                        {nm: i for i, nm in enumerate(static.names)},
+                    )
+                    self._adm_index = idx
+                touched = set(mdelta.changed) | set(achanged)
+                touched |= fenced ^ e_fenced
+                for nm in touched:
+                    i = idx[1].get(nm)
+                    if i is not None:
+                        vec[i] = _node_admission_ok(nm, snapshot, fenced, pod)
+                        self.admission_patched += 1
+                # Stamp the SNAPSHOT's epochs, not the feeds' live ones:
+                # events landing after this snapshot's build are simply
+                # re-patched on the next carry.
+                entry[1] = m_epoch
+                entry[2] = a_epoch
+                entry[3] = fenced
+                self.admission_reuse += 1
+                return vec
+        vec = _host_admission(static, snapshot, pod)
+        self.admission_rebuilds += 1
+        if len(self._adm_cache) >= 256:  # constraint-diversity backstop
+            self._adm_cache.clear()
+        self._adm_cache[key] = [static, m_epoch, a_epoch, fenced, vec.copy()]
+        return vec
+
     def _prepare_groups(
         self,
         groups: "list[list[PodSpec]]",
@@ -1391,14 +1502,34 @@ class YodaBatch(BatchFilterScorePlugin):
         for i in eligible:
             ok = np.zeros((len(cands[i]), n_pad), dtype=np.int32)
             for m, (pod, _req, _reqk) in enumerate(cands[i]):
-                ok[m] = _host_admission(static, snapshot, pod)
+                ok[m] = self._admission_vec(static, snapshot, pod)
             host_ok_groups.append(ok)
             request_groups.append([reqk for _, _, reqk in cands[i]])
+        # Fused decision path (ISSUE 17): when the fit gate is on and no
+        # eligible gang needs the host-side topology block planner, the
+        # per-member fit loop (_joint_gang_fits) runs INSIDE the kernel
+        # program (ops.kernel.kernel_joint_plan) — admission rows, scoring,
+        # and the cross-gang block plan leave in one dispatch. Topology
+        # gangs (plan_multislice_placement is host-only) and kernels
+        # without the method take the classic split. Every rung of the
+        # fallback chain offers evaluate_joint_plan, so a demoted dispatch
+        # keeps the same results contract.
+        use_fused = fit_gate and all(
+            cands[i][0][1].gang is None
+            or cands[i][0][1].gang.topology is None
+            for i in eligible
+        )
+
         def run_joint(kern):
+            if use_fused and hasattr(kern, "evaluate_joint_plan"):
+                grouped, fits, _picks = kern.evaluate_joint_plan(
+                    dyn, host_ok_groups, request_groups, self.batch_requests
+                )
+                return grouped, fits
             if hasattr(kern, "evaluate_joint"):
                 return kern.evaluate_joint(
                     dyn, host_ok_groups, request_groups, self.batch_requests
-                )
+                ), None
             # Burst-capable kernel without the grouped convenience: stack
             # and regroup host-side (ops.kernel owns the layout).
             from yoda_tpu.ops.kernel import evaluate_joint_via_burst
@@ -1406,10 +1537,12 @@ class YodaBatch(BatchFilterScorePlugin):
             return evaluate_joint_via_burst(
                 kern, dyn, host_ok_groups, request_groups,
                 self.batch_requests,
-            )
+            ), None
 
         td0 = time.monotonic()
-        grouped = self._dispatch(static, run_joint)
+        grouped, joint_fits = self._dispatch(static, run_joint)
+        if joint_fits is not None:
+            self.fused_plan_dispatches += 1
         self.dispatch_count += 1
         if len(eligible) >= 2:
             self.joint_dispatches += 1
@@ -1445,10 +1578,16 @@ class YodaBatch(BatchFilterScorePlugin):
                 verdicts.append("solo")
                 continue
             rows = grouped[gi]
+            if not fit_gate:
+                fit_ok = True
+            elif joint_fits is not None:
+                fit_ok = joint_fits[gi]
+            else:
+                fit_ok = self._joint_gang_fits(
+                    cand, rows, static, snapshot, sim
+                )
             gi += 1
-            if fit_gate and not self._joint_gang_fits(
-                cand, rows, static, snapshot, sim
-            ):
+            if not fit_ok:
                 verdicts.append("park")
                 self.joint_parked += 1
                 log.debug(

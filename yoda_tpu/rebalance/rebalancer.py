@@ -164,6 +164,11 @@ class Rebalancer:
         # run_forever's per-tick admission gate (cli wires leadership +
         # resynced); run_once ignores it — direct drivers decide themselves.
         self.gate_fn = gate_fn
+        # Speculative placement cache (framework/speculation.py), wired by
+        # the stack builder: this thread's idle capacity between passes
+        # drives its producer tick — settable post-construction like
+        # gate_fn.
+        self.speculator = None
         # Node health integration (yoda_tpu/nodehealth): nodes under a
         # graceful drain — the pass migrates bound gangs off them
         # PROACTIVELY (rolling-upgrade support), before the monitor's
@@ -216,18 +221,42 @@ class Rebalancer:
         return report
 
     def run_forever(
-        self, stop: threading.Event, *, period_s: float = 30.0
+        self,
+        stop: threading.Event,
+        *,
+        period_s: float = 30.0,
+        spec_period_s: float = 1.0,
     ) -> None:
         """The background loop (cli.py puts this on a thread once
         leadership is held). Gate checked per tick; exceptions logged,
-        never fatal — a rebalancer crash must not take the scheduler."""
+        never fatal — a rebalancer crash must not take the scheduler.
+
+        When a speculator is wired, this thread's idle capacity between
+        rebalance passes drives the speculative placement cache on the
+        much faster ``spec_period_s`` sub-tick — plans stale at
+        fleet-churn speed, so a 30 s refresh would never hit. Both ticks
+        share the leadership gate: followers neither rebalance nor
+        speculate. Without a speculator the loop is byte-for-byte the old
+        one-pass-per-period behavior."""
+        ticks = 0
         while not stop.is_set():
-            if stop.wait(period_s):
+            spec = self.speculator
+            ratio = (
+                max(1, round(period_s / spec_period_s))
+                if spec is not None
+                else 1
+            )
+            if stop.wait(spec_period_s if spec is not None else period_s):
                 return
             try:
                 if self.gate_fn is not None and not self.gate_fn():
                     continue
-                self.run_once()
+                if spec is not None:
+                    spec.speculate_once()
+                ticks += 1
+                if ticks >= ratio:
+                    ticks = 0
+                    self.run_once()
             except Exception:  # noqa: BLE001 — background loop must survive
                 log.exception("rebalance pass failed; will retry")
 

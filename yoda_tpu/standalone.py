@@ -19,6 +19,7 @@ from yoda_tpu.cluster.ingest import EventBatcher
 from yoda_tpu.config import SchedulerConfig
 from yoda_tpu.framework import BindExecutor, Framework, Scheduler, SchedulingQueue
 from yoda_tpu.framework.reconciler import Reconciler
+from yoda_tpu.framework.speculation import SpeculativeCache
 from yoda_tpu.framework.tenancy import TenantLedger, tenant_of
 from yoda_tpu.nodehealth import NodeHealthMonitor
 from yoda_tpu.observability import SchedulingMetrics
@@ -100,6 +101,10 @@ class Stack:
     # first watch event); the background ladder/repair loop is started
     # by cli.py when node_health_period_s > 0.
     nodehealth: NodeHealthMonitor | None = None
+    # Speculative placement cache (framework/speculation.py): produced on
+    # the rebalancer's idle tick, consumed by the serve loop's fast path.
+    # Flushed whole on shard-set resize and on spec_enabled=False reload.
+    speculation: "SpeculativeCache | None" = None
     # The watcher fns build_stack registered on the cluster for THIS
     # stack — what ShardSet.resize unregisters when it retires a
     # dissolved shard lane (cluster.remove_watcher by fn identity).
@@ -652,6 +657,12 @@ def build_stack(
             # kernel's device in place; a full re-stack happens only on
             # epoch skew, node add/delete, or bucket growth.
             p.changes_fn = informer.changes_since
+        if p.admission_changes_fn is None:
+            # Companion admission feed (ISSUE 17): Node-object events and
+            # pod-set changes the metrics ring elides — lets the host_ok
+            # admission cache survive snapshot rebuilds by patching only
+            # the touched rows.
+            p.admission_changes_fn = informer.admission_changes_since
     if batches:
         # Accumulator pattern so a SHARED metrics registry (profiles)
         # registers each family once and sums over every stack's plugins.
@@ -766,6 +777,24 @@ def build_stack(
                 "fleet (metrics epoch unchanged since the last dispatch) "
                 "— the device-resident state's steady-state hit path",
                 lambda: sum(p.snapshot_reuse for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_admission_cache_reuse_total",
+                "Host-admission vectors reused ACROSS snapshot rebuilds "
+                "(both informer feeds report the entry's epochs current)",
+                lambda: sum(p.admission_reuse for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_admission_cache_patched_total",
+                "Host-admission vectors carried across snapshots by "
+                "re-checking only the delta-feed-touched rows",
+                lambda: sum(p.admission_patched for p in acc),
+            )
+            metrics.registry.counter(
+                "yoda_admission_cache_rebuilds_total",
+                "Full O(fleet) host-admission rebuilds (structural churn, "
+                "feed ring eviction, or first sight of a shape)",
+                lambda: sum(p.admission_rebuilds for p in acc),
             )
             metrics.registry.counter(
                 "yoda_restack_total",
@@ -968,6 +997,56 @@ def build_stack(
         # forces a DOWN-style evacuation.
         draining_fn=nodehealth.draining_nodes,
     )
+    # Speculative placement cache (framework/speculation.py, ISSUE 17):
+    # the rebalancer thread's idle sub-tick pre-validates one placement
+    # per recently-seen single-pod shape against a PRIVATE resident
+    # mirror; the serve loop's fast path consumes plans behind the
+    # fence + epoch + staged-claim revalidation chain. Wired to the SAME
+    # feeds the batch plugin uses so the two views cannot diverge on
+    # sourcing.
+    speculation = SpeculativeCache(
+        snapshot_fn=informer.snapshot,
+        changes_fn=informer.changes_since,
+        admission_changes_fn=informer.admission_changes_since,
+        reserved_fn=accountant.chips_in_use,
+        reserved_map_fn=accountant.chips_by_node,
+        claimed_fn=informer.claimed_hbm_mib,
+        claimed_map_fn=informer.claimed_hbm_mib_map,
+        last_updated_map_fn=informer.last_updated_map,
+        weights=config.weights,
+        max_metrics_age_s=config.max_metrics_age_s,
+        enabled=config.spec_enabled,
+        size=config.spec_cache_size,
+        shapes_max=config.spec_shapes_max,
+    )
+    speculation.bind_observe = metrics.spec_bind.observe
+    scheduler.speculation = speculation
+    rebalancer.speculator = speculation
+    spec_acc = getattr(metrics, "_speculations", None)
+    if spec_acc is None:
+        # Accumulator pattern (same as _batch_plugins): one family per
+        # shared registry, summed over every stack's cache.
+        spec_acc = metrics._speculations = []
+        metrics.registry.counter(
+            "yoda_spec_cache_hits_total",
+            "Serve cycles bound from a speculative placement plan (the "
+            "sub-millisecond fast path: filter/score spans skipped)",
+            lambda: sum(s.hits for s in spec_acc),
+        )
+        metrics.registry.counter(
+            "yoda_spec_cache_misses_total",
+            "Speculation lookups finding no plan for an in-scope shape "
+            "(the miss records the shape for the next producer tick)",
+            lambda: sum(s.misses for s in spec_acc),
+        )
+        metrics.registry.counter(
+            "yoda_spec_cache_invalidations_total",
+            "Speculative plans dropped before consumption (delta-feed "
+            "touch, failed revalidation, Reserve race, flush) — staleness "
+            "caught, never bound",
+            lambda: sum(s.invalidations for s in spec_acc),
+        )
+    spec_acc.append(speculation)
     # Late wiring (the scheduler/reconciler are built after the informer
     # the monitor hangs off): repair runs through the scheduler's unbind
     # path, and the background loop's gate composes leadership with the
@@ -996,6 +1075,7 @@ def build_stack(
         ingestor=ingestor,
         tenants=ledger,
         nodehealth=nodehealth,
+        speculation=speculation,
         watch_fns=tuple(registered_fns),
     )
 
@@ -1043,6 +1123,14 @@ def apply_reloadable(stacks: "list[Stack]", config: SchedulerConfig) -> None:
         if st.nodehealth is not None:
             st.nodehealth.repair = config.node_repair
             st.nodehealth.drain_deadline_s = config.node_drain_deadline_s
+        if st.speculation is not None:
+            # configure() flushes on disable and evicts on shrink, so a
+            # live reload can never leave plans beyond the new bounds.
+            st.speculation.configure(
+                enabled=config.spec_enabled,
+                size=config.spec_cache_size,
+                shapes_max=config.spec_shapes_max,
+            )
 
 
 def build_federation(
@@ -1366,6 +1454,14 @@ class ShardSet:
                     if i < min(old_count, new_count):
                         st.informer.node_filter_fn = new_map.node_filter(i)
                         st.informer.invalidate_snapshot()
+                # Every lane's speculative plans were computed against
+                # the OLD partition map — a plan's node may no longer
+                # belong to its lane — so the resize flushes them
+                # wholesale rather than trusting per-plan revalidation
+                # to notice a boundary move.
+                for st in self.stacks:
+                    if st.speculation is not None:
+                        st.speculation.flush()
                 # Shrink: retire dissolved lanes.
                 for st in retiring:
                     self.stacks.remove(st)
@@ -1435,6 +1531,9 @@ class ShardSet:
             sacc[:] = [
                 row for row in sacc if row[1] is not st.scheduler
             ]
+        spacc = getattr(m, "_speculations", None)
+        if spacc is not None and st.speculation is not None:
+            spacc[:] = [s for s in spacc if s is not st.speculation]
         bacc = getattr(m, "_batch_plugins", None)
         if bacc is not None:
             from yoda_tpu.plugins.yoda import YodaBatch
