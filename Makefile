@@ -140,9 +140,13 @@ chaos:
 demo:
 	$(PY) -m yoda_tpu.cli --demo
 
-# Randomized-seed concurrency sweep (the CI stress suite runs fixed seeds).
+# Randomized-seed concurrency sweep (the CI stress suite runs fixed
+# seeds) plus the 24h-equivalent durable-journal endurance run: diurnal
+# trace, restart, warm-start promotion, flat journal size — all
+# asserted inside bench.run_soak.
 soak:
 	$(PY) tools/soak.py $(SOAK_ROUNDS)
+	env JAX_PLATFORMS=cpu $(PY) bench.py --soak
 
 # Real-cluster smoke test: kind + docker + kubectl required (optional in
 # CI — runs where Docker exists). tools/kind-e2e.sh --keep to retain the

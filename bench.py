@@ -1749,6 +1749,169 @@ def _rebalance_churn_scenario(*, seed: int = 7, rounds: int = 40) -> dict:
     }
 
 
+def _journal_soak_scenario(*, scale: float = 1.0, seed: int = 18) -> dict:
+    """Durable-claim-journal endurance run (ISSUE 18, `make soak` at
+    ``scale=1.0``): a 24h-equivalent virtual-clock tracegen replay —
+    diurnal arrival waves, two failure bursts, a rolling-drain fleet
+    resize (drain + rejoin) — over a journal-enabled stack, then a
+    restart: the leader stops, a standby is built over the SAME cluster
+    and journal dir, and warm-start replay must hand it the pre-restart
+    accountant fingerprint with zero cold rebuilds before it serves a
+    continued churn segment. Asserts: zero staged residue in both
+    phases, no oversubscription, compactions > 0, zero torn records on
+    the clean restart, and flat journal size — the on-disk tail stays
+    bounded by the segment threshold (snapshot-headed segments, older
+    ones deleted) while total appended bytes keep growing.
+
+    ``bench.py --smoke`` / ``make smoke`` runs the 30-minute-equivalent
+    slice (``scale=1/48``); the scenario's own assertions are the
+    contract at every scale."""
+    import shutil
+    import tempfile
+    from dataclasses import replace
+
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.standalone import build_stack
+    from yoda_tpu.testing.tracegen import (
+        ReplayClock,
+        TenantMix,
+        TraceSpec,
+        _default_config,
+        _settle,
+        check_invariants,
+        replay,
+    )
+
+    dur = 86_400.0 * scale
+    spec = TraceSpec(
+        seed=seed,
+        duration_s=dur,
+        base_rate_per_s=0.5,
+        diurnal_amplitude=0.6,
+        diurnal_period_s=dur / 4.0,
+        tenants=(
+            TenantMix(
+                "prod", weight=1.0, priority=100,
+                gang_fraction=0.25, gang_sizes=(2, 4),
+            ),
+            TenantMix("spot", weight=2.0, chips=(1, 2)),
+        ),
+        lifetime_s=(40.0, 120.0),
+        failure_bursts=((dur * 0.3, 1), (dur * 0.7, 1)),
+        drains=((dur * 0.45, 2),),
+        drain_recover_s=dur / 20.0,
+    )
+    seg_bytes = max(32_768, int(262_144 * min(scale, 1.0)))
+    jdir = tempfile.mkdtemp(prefix="yoda-journal-soak-")
+    cfg = replace(
+        _default_config(),
+        journal_path=jdir,
+        journal_sync="batch",
+        journal_segment_bytes=seg_bytes,
+    )
+    def _stop(stack) -> None:
+        stack.gang.close()
+        stack.ingestor.stop()
+        stack.metrics.tracer.close()
+        if stack.journal is not None:
+            stack.accountant.journal = None
+            stack.journal.close()
+
+    leader = standby = None
+    try:
+        rep1 = replay(
+            spec, config=cfg, hosts=16,
+            settle_every_s=max(10.0, dur / 720.0),
+            eval_every_s=max(30.0, dur / 96.0),
+            max_wall_s=1_800.0, keep_stack=True,
+        )
+        leader = rep1.stack
+        j1 = leader.journal
+        assert not leader.accountant.staged_uids(), (
+            "staged residue leaked past the endurance replay's settle"
+        )
+        assert j1.compactions > 0, (
+            f"no compaction in {j1.appends} appends "
+            f"(segment_bytes={seg_bytes})"
+        )
+        assert j1.size_bytes() <= 2 * seg_bytes, (
+            f"journal not flat: {j1.size_bytes()}B on disk after "
+            f"{j1.compactions} compactions (threshold {seg_bytes}B)"
+        )
+        fp = leader.accountant.claims_snapshot()
+        bytes1, appends1 = j1.bytes_written, j1.appends
+
+        # Restart: stop the leader and release the journal dir
+        # (sync=batch flushes its tail on close — torn-tail crash
+        # recovery is tests/test_journal.py's boundary sweep).
+        cluster = leader.cluster
+        _stop(leader)
+        leader = None
+
+        clock = ReplayClock(start=dur)
+        standby = build_stack(cluster=cluster, config=cfg, clock=clock)
+        j2 = standby.journal
+        assert j2.torn_records == 0, (
+            f"clean restart replayed {j2.torn_records} torn record(s)"
+        )
+        assert standby.accountant.claims_snapshot() == fp, (
+            "warm-start replay diverged from the pre-restart fingerprint"
+        )
+        r = standby.reconciler.resync()
+        assert r.warm and r.rebuilt_reservations == 0, (
+            f"promotion fell back to cold rebuild: warm={r.warm} "
+            f"rebuilt={r.rebuilt_reservations}"
+        )
+
+        # Continued churn on the promoted stack: the journal keeps
+        # appending, rotating, and compacting across the generation.
+        standby.ingestor.flush()
+        _settle(standby, clock)
+        live: "list[str]" = []
+        for rnd in range(24):
+            clock.now += 60.0
+            tag = f"soak2-g{rnd}"
+            labels = {"tpu/gang": tag, "tpu/gang-size": "2",
+                      "tpu/chips": "2"}
+            for m in range(2):
+                pod = PodSpec(
+                    f"{tag}-{m}", namespace="prod", labels=dict(labels)
+                )
+                standby.cluster.create_pod(pod)
+                live.append(pod.key)
+            while len(live) > 16:
+                standby.cluster.delete_pod(live.pop(0))
+            standby.ingestor.flush()
+            _settle(standby, clock)
+        standby.reconciler.reconcile(relist=False)
+        check_invariants(standby)
+        assert not standby.accountant.staged_uids(), (
+            "staged residue leaked on the promoted stack"
+        )
+        assert j2.size_bytes() <= 2 * seg_bytes, (
+            f"journal not flat across restart: {j2.size_bytes()}B "
+            f"(threshold {seg_bytes}B)"
+        )
+        return {
+            "journal_soak_virtual_s": int(dur),
+            "journal_soak_lifecycles": rep1.lifecycles,
+            "journal_soak_binds": rep1.binds,
+            "journal_soak_killed": len(rep1.killed_nodes),
+            "journal_soak_drained": len(rep1.drained_nodes),
+            "journal_soak_appends": appends1 + j2.appends,
+            "journal_soak_bytes_appended": bytes1 + j2.bytes_written,
+            "journal_soak_compactions": j1.compactions + j2.compactions,
+            "journal_soak_size_bytes": j2.size_bytes(),
+            "journal_soak_restored_claims": len(fp),
+            "journal_soak_replay_ms": round(j2.replay_ms, 3),
+        }
+    finally:
+        for st in (leader, standby):
+            if st is not None:
+                _stop(st)
+        shutil.rmtree(jdir, ignore_errors=True)
+
+
 def _preemption_admit_scenario(*, hosts: int = 4) -> dict:
     """Background priority preemption admitting a parked whole gang: a
     full fleet of low-priority singletons, then a high-priority gang that
@@ -3331,6 +3494,12 @@ def run_smoke() -> dict:
     # within its steady-state SLO, ladder-off strictly worse, resize
     # movement bound, no dropped gangs, zero staged-claim leaks).
     out.update(_overload_storm_scenario(scale=0.5))
+    # Durable-claim-journal soak smoke slice (the 24h-equivalent full
+    # shape is `make soak`): a 30-minute-equivalent diurnal trace over a
+    # journal-enabled stack, restart, warm-start promotion, continued
+    # churn — zero staged residue, zero cold rebuilds, flat journal
+    # size, all asserted inside the scenario.
+    out.update(_journal_soak_scenario(scale=1 / 48))
     # Scheduler shard-out smoke slice: 1 vs 2 shards at a reduced shape
     # (the full 1/2/4/8 sweep is `make shard-bench`); the scenario's own
     # assertions guard the invariants, the ratio guards gross scaling
@@ -3433,6 +3602,27 @@ def run_serve() -> dict:
     }
 
 
+def run_soak() -> dict:
+    """``bench.py --soak`` / ``make soak``: the 24h-equivalent
+    virtual-clock durable-journal endurance run at full shape — diurnal
+    waves, failure bursts, a rolling-drain fleet resize, restart +
+    warm-start promotion, continued churn. Zero staged residue, zero
+    cold rebuilds on promotion, torn-free clean restart, and flat
+    journal size across compactions are all asserted inside the
+    scenario; this shapes the JSON line. CPU-pinned — the replay is
+    ingest/Python-bound."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = _journal_soak_scenario(scale=1.0)
+    return {
+        "metric": "journal_soak_lifecycles",
+        "value": out["journal_soak_lifecycles"],
+        "unit": "lifecycles",
+        **out,
+    }
+
+
 def run_rebalance() -> dict:
     """``bench.py --rebalance`` / ``make rebalance-bench``: the long form
     of the seeded churn replay (more rounds than the smoke's 16) plus the
@@ -3485,6 +3675,9 @@ def main() -> int:
         return 0
     if "--overload" in sys.argv:
         print(json.dumps(run_overload()))
+        return 0
+    if "--soak" in sys.argv:
+        print(json.dumps(run_soak()))
         return 0
     if "--run" in sys.argv:
         return _child(force_cpu="--cpu" in sys.argv)

@@ -328,3 +328,28 @@ def test_overload_storm_smoke_invariants():
     assert out["overload_resize_moved_frac"] <= 1.5 / 5 + 0.05
     assert out["overload_resize_pools_total"] > 0
     assert out["overload_resize_ms"] < 5_000
+
+
+def test_journal_soak_smoke_invariants():
+    import bench
+
+    # ISSUE 18 endurance evidence (smoke slice; `make soak` runs the
+    # 24h-equivalent shape): a diurnal journal-enabled trace with
+    # failure bursts and a rolling-drain resize, then a restart whose
+    # warm-start promotion must inherit the pre-restart fingerprint
+    # with zero cold rebuilds, zero torn records, zero staged residue,
+    # and a journal kept flat by compaction. All asserted inside the
+    # scenario; here we pin the evidence shape.
+    out = bench._journal_soak_scenario(scale=1 / 48)
+    assert out["journal_soak_lifecycles"] > 500
+    assert out["journal_soak_binds"] > 0
+    assert out["journal_soak_killed"] == 2
+    assert out["journal_soak_drained"] == 2
+    assert out["journal_soak_compactions"] > 0
+    # Flat: the on-disk tail is a fraction of what was ever appended.
+    assert (
+        out["journal_soak_size_bytes"]
+        < out["journal_soak_bytes_appended"]
+    )
+    assert out["journal_soak_restored_claims"] > 0
+    assert out["journal_soak_replay_ms"] < 1_000.0

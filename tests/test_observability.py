@@ -195,6 +195,12 @@ ALL_METRIC_FAMILIES = (
     "yoda_joint_dispatches_total",
     "yoda_joint_gangs_fused_total",
     "yoda_joint_gangs_parked_total",
+    "yoda_journal_appends_total",
+    "yoda_journal_bytes_total",
+    "yoda_journal_compactions_total",
+    "yoda_journal_fsyncs_total",
+    "yoda_journal_replay_ms_total",
+    "yoda_journal_torn_records_total",
     "yoda_kernel_dispatch_floor_ms",
     "yoda_kernel_dispatches_total",
     "yoda_kernel_on_accelerator",

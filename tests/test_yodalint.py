@@ -21,6 +21,7 @@ from tools.yodalint.passes import (
     config_drift,
     fence_before_write,
     hook_order,
+    journal_discipline,
     lock_discipline,
     metrics_drift,
     reload_safety,
@@ -834,6 +835,71 @@ class TestSpeculationLockOrder:
             ),
         })
         assert lock_discipline.run(project) == []
+
+
+class TestJournalDiscipline:
+    """ISSUE 18: the durable claim journal has exactly one writer (the
+    accountant) and accountant claim state exactly one owner — a second
+    appender or an external state mutation breaks the write-ahead
+    crash-consistency argument."""
+
+    def test_catches_rogue_journal_append(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/sched.py": (
+                "class Loop:\n"
+                "    def serve(self, journal, uid):\n"
+                "        journal.record_commit([uid])\n"
+            ),
+        })
+        findings = journal_discipline.run(project)
+        assert any(
+            "record_commit" in f.message and f.line == 3 for f in findings
+        ), findings
+
+    def test_catches_external_claim_state_mutation(self, tmp_path):
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/sched.py": (
+                "class Loop:\n"
+                "    def patch(self, acct, uid):\n"
+                "        acct._claims.pop(uid, None)\n"
+            ),
+        })
+        findings = journal_discipline.run(project)
+        assert any(
+            "_claims" in f.message and f.line == 3 for f in findings
+        ), findings
+
+    def test_accountant_and_journal_modules_are_exempt(self, tmp_path):
+        # The accountant appending + touching its own state is the
+        # mechanism; the journal package defines the interface.
+        project = make_project(tmp_path, {
+            "yoda_tpu/plugins/yoda/accounting.py": (
+                "class ChipAccountant:\n"
+                "    def release(self, uid):\n"
+                "        self.journal.record_release(uid)\n"
+                "        self._claims.pop(uid, None)\n"
+            ),
+            "yoda_tpu/journal/journal.py": (
+                "class FileJournal:\n"
+                "    def record_release(self, uid):\n"
+                "        self._append('R', uid)\n"
+                "    def reopen(self):\n"
+                "        self.record_release('x')\n"
+            ),
+        })
+        assert journal_discipline.run(project) == []
+
+    def test_own_private_attr_sharing_a_spelling_is_legal(self, tmp_path):
+        # A module's own self._stage_seq (the journal keeps one) is its
+        # private state, not a reach into the accountant.
+        project = make_project(tmp_path, {
+            "yoda_tpu/other.py": (
+                "class Tracker:\n"
+                "    def bump(self):\n"
+                "        self._stage_seq += 1\n"
+            ),
+        })
+        assert journal_discipline.run(project) == []
 
 
 class TestSuppressions:

@@ -32,7 +32,7 @@ from __future__ import annotations
 import ast
 from dataclasses import dataclass, field
 
-from tools.yodalint.core import Module, Project
+from tools.yodalint.core import Module, Project, walk_cached
 
 #: Conventional parameter-name -> class typing (the wiring convention in
 #: standalone.build_stack and every component constructor).
@@ -89,6 +89,7 @@ class CallGraph:
         #: per-module: imported name -> (source module relpath suffix)
         self._imports: "dict[str, dict[str, str]]" = {}
         self._module_funcs: "dict[str, dict[str, FunctionInfo]]" = {}
+        self._calls_cache: "dict[str, list[ast.Call]]" = {}
         for mod in project.modules:
             self._index_module(mod)
         self._infer_attr_types()
@@ -141,7 +142,7 @@ class CallGraph:
         for classes in self.classes_by_name.values():
             for ci in classes:
                 for fi in ci.methods.values():
-                    for node in ast.walk(fi.node):
+                    for node in walk_cached(fi.node):
                         if not (
                             isinstance(node, ast.Assign)
                             and len(node.targets) == 1
@@ -233,7 +234,11 @@ class CallGraph:
     def calls_in(self, fn: FunctionInfo) -> "list[ast.Call]":
         """Every Call node in ``fn``'s body, nested defs excluded (a
         nested function's body runs when *it* is called, not when the
-        enclosing function is)."""
+        enclosing function is). Memoized — several passes ask for the
+        same functions' calls against the one shared graph."""
+        cached = self._calls_cache.get(fn.qualname)
+        if cached is not None:
+            return cached
         out: "list[ast.Call]" = []
         stack: list = list(ast.iter_child_nodes(fn.node))
         while stack:
@@ -245,4 +250,5 @@ class CallGraph:
             if isinstance(node, ast.Call):
                 out.append(node)
             stack.extend(ast.iter_child_nodes(node))
+        self._calls_cache[fn.qualname] = out
         return out

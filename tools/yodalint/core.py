@@ -57,6 +57,18 @@ class Suppression:
     used: bool = False
 
 
+def walk_cached(node: ast.AST) -> "list[ast.AST]":
+    """``list(ast.walk(node))``, memoized on the node. Passes re-walk the
+    same (immutable) subtrees — module roots, function bodies — many
+    times per run; the first walk pays, the rest iterate a list. Keeps
+    the whole suite inside the lint budget as passes accumulate."""
+    cached = getattr(node, "_yl_walk", None)
+    if cached is None:
+        cached = list(ast.walk(node))
+        node._yl_walk = cached
+    return cached
+
+
 class Module:
     """One parsed source file: text, line list, and AST."""
 

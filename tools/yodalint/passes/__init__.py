@@ -6,6 +6,7 @@ from tools.yodalint.passes import (
     config_drift,
     fence_before_write,
     hook_order,
+    journal_discipline,
     lock_discipline,
     metrics_drift,
     reload_safety,
@@ -25,6 +26,7 @@ ALL_PASSES = (
     verdict_taxonomy,
     reload_safety,
     speculation_safety,
+    journal_discipline,
 )
 
 PASS_NAMES = {p.NAME for p in ALL_PASSES}

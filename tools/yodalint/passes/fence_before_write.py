@@ -28,7 +28,7 @@ from __future__ import annotations
 import ast
 
 from tools.yodalint.callgraph import CallGraph, FunctionInfo
-from tools.yodalint.core import Finding, Project
+from tools.yodalint.core import Finding, Project, walk_cached
 
 NAME = "fence-before-write"
 
@@ -82,7 +82,7 @@ def _receiver_is_cluster(func: ast.Attribute) -> bool:
 def _fence_lines(fn: FunctionInfo) -> "list[int]":
     """Lines in ``fn`` that read a fence marker."""
     lines = []
-    for node in ast.walk(fn.node):
+    for node in walk_cached(fn.node):
         if isinstance(node, ast.Attribute) and node.attr in FENCE_MARKERS:
             lines.append(node.lineno)
         elif isinstance(node, ast.Name) and node.id in FENCE_MARKERS:

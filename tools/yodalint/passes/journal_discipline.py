@@ -1,0 +1,109 @@
+"""Pass 10 — journal-discipline: the durable claim journal has exactly
+one writer, and accountant claim state has exactly one owner.
+
+The crash-consistency argument of the durable claim journal (ISSUE 18,
+yoda_tpu/journal/) is write-ahead ordering: every accountant state
+mutation appends its record BEFORE the in-memory mutation applies, all
+under the accountant's lock. That argument survives only while both
+monopolies hold:
+
+**A. Append monopoly.** No module outside ``yoda_tpu/journal/`` and the
+accountant implementation (``plugins/yoda/accounting.py``) may call the
+``CommitLog`` write surface (``record_stage`` / ``record_commit`` /
+``record_release`` / ``record_rollback``). A second appender writes
+records that do not correspond to accountant mutations — replay then
+rebuilds state the process never held, and the standby inherits phantom
+claims.
+
+**B. Claim-state monopoly.** No module outside ``accounting.py`` may
+touch the accountant's claim-state attributes (``_claims`` / ``_in_use``
+/ ``_staged`` / ``_stage_seq``) on a non-``self`` receiver. An external
+mutation bypasses the journal entirely: the on-disk log and memory
+diverge, and the next warm-start replay resurrects state the mutation
+removed (or drops state it added). Same-module ``self`` access is the
+mechanism, not a violation — and a module's own private attr that
+happens to share a spelling (the journal's own ``_stage_seq``) stays
+legal for the same reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.yodalint.callgraph import CallGraph
+from tools.yodalint.core import Finding, Project, walk_cached
+
+NAME = "journal-discipline"
+
+#: The CommitLog write surface (journal/journal.py CommitLog).
+RECORD_METHODS = {
+    "record_stage",
+    "record_commit",
+    "record_release",
+    "record_rollback",
+}
+
+#: The accountant's claim state (plugins/yoda/accounting.py). The
+#: journal's replay is the ONLY other legal reconstruction path, and it
+#: goes through accountant.restore(), not these attrs.
+CLAIM_STATE_ATTRS = {"_claims", "_in_use", "_staged", "_stage_seq"}
+
+#: Modules allowed to call the write surface: the journal package
+#: (defines it) and the accountant (the one legal appender).
+APPEND_EXEMPT = ("yoda_tpu/journal/", "plugins/yoda/accounting.py")
+
+STATE_OWNER_SUFFIX = "plugins/yoda/accounting.py"
+
+
+def _exempt_from_append(rel: str) -> bool:
+    return any(part in rel for part in APPEND_EXEMPT)
+
+
+def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    for module in project.modules:
+        rel = module.relpath
+        for node in walk_cached(module.tree):
+            # Rule A: journal appends outside the journal/accountant.
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in RECORD_METHODS
+                and not _exempt_from_append(rel)
+            ):
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        node.lineno,
+                        f"journal append .{node.func.attr}() outside the "
+                        "accountant — the CommitLog has exactly one "
+                        "writer (plugins/yoda/accounting.py); a second "
+                        "appender writes records no accountant mutation "
+                        "backs, and replay resurrects phantom claims",
+                    )
+                )
+            # Rule B: accountant claim state touched from outside.
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in CLAIM_STATE_ATTRS
+                and not rel.endswith(STATE_OWNER_SUFFIX)
+                and not (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                )
+            ):
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        node.lineno,
+                        f"accountant claim state .{node.attr} touched "
+                        "outside plugins/yoda/accounting.py — mutations "
+                        "that bypass the accountant bypass the journal's "
+                        "write-ahead append, so the on-disk log and "
+                        "memory diverge and the next warm-start replay "
+                        "rebuilds the wrong claims",
+                    )
+                )
+    return findings

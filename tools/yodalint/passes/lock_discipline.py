@@ -45,7 +45,7 @@ import ast
 from dataclasses import dataclass, field
 
 from tools.yodalint.callgraph import CallGraph, FunctionInfo
-from tools.yodalint.core import Finding, Project
+from tools.yodalint.core import Finding, Project, walk_cached
 
 NAME = "lock-discipline"
 
@@ -176,7 +176,7 @@ def _condition_assoc(graph: CallGraph) -> "dict[tuple[str, str], str]":
     for classes in graph.classes_by_name.values():
         for ci in classes:
             for fi in ci.methods.values():
-                for node in ast.walk(fi.node):
+                for node in walk_cached(fi.node):
                     if not (
                         isinstance(node, ast.Assign)
                         and len(node.targets) == 1
@@ -276,7 +276,7 @@ def _summaries(
     out: "dict[str, FnSummary]" = {}
     for qual, fn in graph.functions.items():
         s = FnSummary()
-        for node in ast.walk(fn.node):
+        for node in walk_cached(fn.node):
             if isinstance(node, ast.With):
                 for item in node.items:
                     key = _lock_key_for(
@@ -341,7 +341,7 @@ def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
         for fn in [
             f for f in graph.functions.values() if f.module is mod
         ]:
-            for node in ast.walk(fn.node):
+            for node in walk_cached(fn.node):
                 if not isinstance(node, ast.With):
                     continue
                 keys = [

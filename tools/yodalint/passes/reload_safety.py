@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import ast
 
-from tools.yodalint.core import Finding, Project
+from tools.yodalint.core import Finding, Project, walk_cached
 from tools.yodalint.passes.config_drift import _dataclass_fields
 
 NAME = "reload-safety"
@@ -51,7 +51,7 @@ _SET_NAMES = ("RELOADABLE_KNOBS", "RESIZE_KNOBS", "IMMUTABLE_KNOBS")
 def _knob_sets(mod) -> "dict[str, tuple[set[str], int]]":
     """{set name: (names, line)} for the classification frozensets."""
     out: dict[str, tuple[set[str], int]] = {}
-    for node in ast.walk(mod.tree):
+    for node in walk_cached(mod.tree):
         if not isinstance(node, ast.Assign):
             continue
         for target in node.targets:
@@ -82,7 +82,7 @@ def _apply_fn(mod) -> "ast.FunctionDef | None":
 def _config_attr_reads(tree) -> "dict[str, int]":
     """Attribute names read off a variable named config/cfg -> first line."""
     reads: dict[str, int] = {}
-    for node in ast.walk(tree):
+    for node in walk_cached(tree):
         if (
             isinstance(node, ast.Attribute)
             and isinstance(node.value, ast.Name)

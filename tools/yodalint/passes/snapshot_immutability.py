@@ -30,7 +30,7 @@ from __future__ import annotations
 import ast
 
 from tools.yodalint.callgraph import CallGraph
-from tools.yodalint.core import Finding, Project
+from tools.yodalint.core import Finding, Project, walk_cached
 
 NAME = "snapshot-immutability"
 
@@ -47,7 +47,7 @@ def _constructed_names(fn_node: ast.AST) -> "set[str]":
     """Names bound from a Snapshot/FleetArrays constructor in this
     function (construction site: finishing touches are allowed)."""
     out: "set[str]" = set()
-    for node in ast.walk(fn_node):
+    for node in walk_cached(fn_node):
         if not (
             isinstance(node, ast.Assign)
             and isinstance(node.value, ast.Call)
@@ -86,7 +86,7 @@ def _annotated_names(fn_node) -> "set[str]":
             )
             if any(c in str(text) for c in PROTECTED_CLASSES):
                 out.add(a.arg)
-    for node in ast.walk(fn_node):
+    for node in walk_cached(fn_node):
         if isinstance(node, ast.AnnAssign) and isinstance(
             node.target, ast.Name
         ):
@@ -112,7 +112,7 @@ def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
             (_annotated_names(fn.node) | TYPED_NAMES | constructed)
             - constructed
         )
-        for node in ast.walk(fn.node):
+        for node in walk_cached(fn.node):
             targets: "list[ast.expr]" = []
             if isinstance(node, ast.Assign):
                 targets = node.targets

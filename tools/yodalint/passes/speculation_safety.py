@@ -32,7 +32,7 @@ from __future__ import annotations
 import ast
 
 from tools.yodalint.callgraph import CallGraph, FunctionInfo
-from tools.yodalint.core import Finding, Project
+from tools.yodalint.core import Finding, Project, walk_cached
 
 NAME = "speculation-safety"
 
@@ -62,7 +62,7 @@ DEFINING_SUFFIX = "framework/speculation.py"
 
 def _marker_lines(fn: FunctionInfo, markers: "set[str]") -> "list[int]":
     lines = []
-    for node in ast.walk(fn.node):
+    for node in walk_cached(fn.node):
         if isinstance(node, ast.Attribute) and node.attr in markers:
             lines.append(node.lineno)
         elif isinstance(node, ast.Name) and node.id in markers:
@@ -127,7 +127,7 @@ def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
                 )
     informer = project.module("cluster/informer.py")
     if informer is not None:
-        for node in ast.walk(informer.tree):
+        for node in walk_cached(informer.tree):
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)

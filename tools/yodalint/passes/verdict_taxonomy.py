@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import ast
 
-from tools.yodalint.core import Finding, Project
+from tools.yodalint.core import Finding, Project, walk_cached
 
 NAME = "verdict-taxonomy"
 
@@ -66,7 +66,7 @@ def run(project: Project, graph=None) -> "list[Finding]":
     recorded: "set[str]" = set()
     sites = 0
     for mod in project.modules:
-        for node in ast.walk(mod.tree):
+        for node in walk_cached(mod.tree):
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)

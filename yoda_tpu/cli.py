@@ -274,7 +274,12 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     metrics_srv = None
     if args.metrics_port >= 0:
         metrics_srv = MetricsServer(
-            stack.metrics, port=args.metrics_port, ready_fn=_ready
+            stack.metrics,
+            port=args.metrics_port,
+            ready_fn=_ready,
+            # /debug/journal: the durable claim journal summary (None =
+            # journal_path unset, served as {"enabled": false}).
+            journal_fn=lambda: getattr(stack.accountant, "journal", None),
         )
         metrics_srv.start()
         print(f"metrics on :{metrics_srv.port}/metrics", file=sys.stderr)

@@ -61,6 +61,8 @@ RELOADABLE_KNOBS = frozenset(
         "overload_brownout_admit_per_s",
         "overload_shed_priority",
         "pending_index_max",
+        "journal_sync",
+        "journal_segment_bytes",
     }
 )
 RESIZE_KNOBS = frozenset({"shard_count"})
@@ -75,6 +77,10 @@ IMMUTABLE_KNOBS = frozenset(
         "kernel_backend",
         "mesh_devices",
         "profiles",
+        # The journal directory identifies ONE durable log; repointing a
+        # live process would split the record across two logs (neither
+        # replayable alone) — restart to move it.
+        "journal_path",
     }
 )
 
@@ -299,6 +305,27 @@ class SchedulerConfig:
     spec_cache_size: int = 256
     # Bound on tracked miss shapes the speculator re-plans per tick.
     spec_shapes_max: int = 64
+    # Durable claim journal (yoda_tpu/journal, docs/OPERATIONS.md
+    # "Durability and warm-start" runbook): directory for the append-only
+    # commit log of every claim mutation. "" (the default) = journal OFF
+    # — the in-memory accountant is the commit log, today's behavior,
+    # zero new hot-path work. Set (typically a PVC mount) the commit
+    # point write-ahead-journals staged-claim/commit/rollback/release
+    # records and a promoted standby warm-starts by REPLAY instead of
+    # the full-LIST cold resync.
+    journal_path: str = ""
+    # fsync policy per append: "always" (every record durable before the
+    # claim applies — strongest, slowest), "batch" (fsync on commit and
+    # snapshot records plus every ~64 appends — the default; at most a
+    # batch of uncommitted stage records can be lost, which replay +
+    # divergence resync repair), "off" (OS page cache only — fastest,
+    # survives process crash but not host crash).
+    journal_sync: str = "batch"
+    # Segment rotation threshold: when the active segment exceeds this
+    # many bytes the journal rotates to a fresh segment headed by a full
+    # snapshot record and deletes older segments (compaction) — steady-
+    # state disk use stays ~flat at snapshot + one segment of deltas.
+    journal_segment_bytes: int = 4 * 1024 * 1024
     # Node failure domains (yoda_tpu/nodehealth): the per-node health
     # ladder's silence thresholds. A node whose agent has been silent
     # past node_suspect_after_s is SUSPECT — fenced from NEW placements
@@ -695,6 +722,25 @@ class SchedulerConfig:
             raise ValueError(
                 "spec_shapes_max must be an int >= 1, got "
                 f"{cfg.spec_shapes_max!r}"
+            )
+        if not isinstance(cfg.journal_path, str):
+            raise ValueError(
+                f"journal_path must be a directory path string ('' "
+                f"disables the journal), got {cfg.journal_path!r}"
+            )
+        if cfg.journal_sync not in ("always", "batch", "off"):
+            raise ValueError(
+                "journal_sync must be 'always', 'batch', or 'off', got "
+                f"{cfg.journal_sync!r}"
+            )
+        if (
+            isinstance(cfg.journal_segment_bytes, bool)
+            or not isinstance(cfg.journal_segment_bytes, int)
+            or cfg.journal_segment_bytes < 4096
+        ):
+            raise ValueError(
+                "journal_segment_bytes must be an int >= 4096, got "
+                f"{cfg.journal_segment_bytes!r}"
             )
         node_thresholds = (cfg.node_suspect_after_s, cfg.node_down_after_s)
         if any(

@@ -62,6 +62,9 @@ class ResyncReport:
     rebuilt_reservations: int = 0
     released_reservations: int = 0
     duration_ms: float = 0.0
+    # True when this pass ran as the WARM divergence check against
+    # journal-replayed state instead of the from-scratch rebuild.
+    warm: bool = False
 
 
 @dataclass
@@ -223,39 +226,86 @@ class Reconciler:
         standby whose caches tracked the whole time resyncs to a no-op."""
         t0 = self.clock()
         report = ResyncReport()
-        pods = self._list_truth(relist=True)
+        # Warm start (durable claim journal, ISSUE 18): when the
+        # accountant was seeded from a journal replay, the full-LIST
+        # from-scratch rebuild collapses to a DIVERGENCE CHECK — one
+        # bulk claims snapshot diffed against the watch cache's truth,
+        # repair events only for the (rare) divergent pods, and no API
+        # re-LIST blackout (the journal is the durable record; the
+        # periodic drift pass still re-LISTs as backstop).
+        warm = bool(getattr(self.accountant, "replayed", False))
+        report.warm = warm
+        pods = self._list_truth(relist=not warm)
         live = {p.uid for p in pods}
 
         # 1. Reservations: every bound pod must be charged. The watch
         # replay normally did this at stack build; this covers binds the
         # dead leader landed that the stream has not delivered yet.
-        for p in pods:
-            if not p.node_name:
-                continue
-            missing = not self.accountant.has_claim(p.uid)
-            if missing or not self.informer.counts_bound(p.uid):
-                self._repair_event("modified", p)
-            if missing and self.accountant.has_claim(p.uid):
-                report.rebuilt_reservations += 1
+        if warm:
+            claims = self.accountant.claims_snapshot()
+            for p in pods:
+                if not p.node_name:
+                    continue
+                c = claims.get(p.uid)
+                if c is None or c[0] != p.node_name:
+                    self._repair_event("modified", p)
+                    if c is None and self.accountant.has_claim(p.uid):
+                        report.rebuilt_reservations += 1
+        else:
+            for p in pods:
+                if not p.node_name:
+                    continue
+                missing = not self.accountant.has_claim(p.uid)
+                if missing or not self.informer.counts_bound(p.uid):
+                    self._repair_event("modified", p)
+                if missing and self.accountant.has_claim(p.uid):
+                    report.rebuilt_reservations += 1
 
         # 2. Claims with no live pod behind them (the dead leader reserved
         # and the pod is gone, or a drop): release.
         for uid in self.accountant.claimed_uids() - live:
             self.accountant.release(uid)
             report.released_reservations += 1
+        if warm:
+            # The dead leader's reserve that never reached a bind: a
+            # restored COMMITTED claim whose pod is live but UNBOUND.
+            # No bind event will ever finalize it, and no reserve is in
+            # flight this early (resync precedes the first queue pop),
+            # so it would sit as phantom usage forever — release; the
+            # promoted scheduler re-reserves when it pops the pod.
+            # STAGED claims stay: they are the mid-gang resume cohort
+            # that step 3 below adopts or rolls back whole.
+            bound_uids = {p.uid for p in pods if p.node_name}
+            staged_uids = set(self.accountant.staged_uids())
+            for uid in (
+                self.accountant.claimed_uids() - bound_uids - staged_uids
+            ):
+                self.accountant.release(uid)
+                report.released_reservations += 1
 
-        # 3. Partially-bound gangs: adopt or roll back whole.
+        # 3. Partially-bound gangs: adopt or roll back whole. With a
+        # journal replay, a gang whose unbound members still hold STAGED
+        # claims resumes from them — the mid-gang crash continues in
+        # place instead of rolling the whole gang back.
+        replayed_gangs = (
+            getattr(self.accountant, "replayed_gangs", {}) if warm else {}
+        )
         now = self.clock()
         hosts = {t.name for t in self.cluster.list_tpu_metrics()}
         for name, (size, bound, _unbound) in self._gang_truth(pods).items():
             if not bound or len(bound) >= size:
                 continue  # nothing placed yet, or already complete
             hosts_alive = all(p.node_name in hosts for p in bound)
-            if self.adopt_window_s > 0 and hosts_alive:
+            if (self.adopt_window_s > 0 or name in replayed_gangs) and hosts_alive:
+                window = (
+                    self.adopt_window_s
+                    if self.adopt_window_s > 0
+                    # Adoption disabled but the journal holds the gang's
+                    # staged claims: resume mid-gang anyway, bounded.
+                    else 60.0
+                )
                 with self._lock:
-                    self._adopt_deadlines.setdefault(
-                        name, now + self.adopt_window_s
-                    )
+                    self._adopt_deadlines.setdefault(name, now + window)
                 report.adopted_gangs.append(name)
                 log.info(
                     "failover: adopted partial gang %s (%d/%d bound; "

@@ -1,0 +1,38 @@
+"""Durable claim journal (ISSUE 18): the commit point behind an interface.
+
+Two implementations of :class:`CommitLog`: the in-memory accountant's
+default (``NullCommitLog`` — journal off, zero durability, today's
+behavior) and :class:`FileJournal` — an append-only, CRC-checksummed,
+segment-rotated on-disk log of every claim mutation, replayed on standby
+promotion to warm-start the accountant before the first queue pop.
+"""
+
+from yoda_tpu.journal.journal import (
+    CLAIM_CHIPS,
+    CLAIM_GANG,
+    CLAIM_NODE,
+    CLAIM_SEQ,
+    CLAIM_SHARD,
+    CommitLog,
+    FileJournal,
+    JournalFault,
+    NullCommitLog,
+    RealJournalIO,
+    ReplayedState,
+    claim,
+)
+
+__all__ = [
+    "CLAIM_CHIPS",
+    "CLAIM_GANG",
+    "CLAIM_NODE",
+    "CLAIM_SEQ",
+    "CLAIM_SHARD",
+    "CommitLog",
+    "FileJournal",
+    "JournalFault",
+    "NullCommitLog",
+    "RealJournalIO",
+    "ReplayedState",
+    "claim",
+]

@@ -39,6 +39,13 @@ endpoint is first-party and dependency-free (stdlib http.server):
                      burn rates, and firing alerts. Backend of
                      `yoda-tpu-scheduler slo`; the same numbers export
                      as the yoda_slo_* Prometheus series.
+    GET /debug/journal -> the durable claim journal's summary (head/tail
+                     sequence, segment count, on-disk size, last
+                     compaction, fsync policy, append/fsync/torn-record
+                     counters) via the wired ``journal_fn``;
+                     ``{"enabled": false}`` when ``journal_path`` is
+                     unset. Reading it is covered in the durability
+                     runbook (docs/OPERATIONS.md).
 """
 
 from __future__ import annotations
@@ -63,12 +70,17 @@ class MetricsServer:
         host: str = "",
         port: int = 10259,
         ready_fn: "Callable[[], bool] | None" = None,
+        journal_fn: "Callable[[], object] | None" = None,
     ):
         self.metrics = metrics
         # None = no readiness concept wired (agent mode, tests): /readyz
         # answers 200 like /healthz. A raising ready_fn reads as NOT
         # ready — fail closed, never route to a broken standby.
         self.ready_fn = ready_fn
+        # Returns the stack's FileJournal (or None when journal_path is
+        # unset) — a callable, not a reference, because live resizes can
+        # retire the stack that owned the journal at wiring time.
+        self.journal_fn = journal_fn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -104,6 +116,17 @@ class MetricsServer:
                         json.dumps(outer.metrics.slo.summary(), indent=1)
                         + "\n"
                     )
+                    ctype = "application/json"
+                elif path == "/debug/journal":
+                    journal = (
+                        outer.journal_fn() if outer.journal_fn else None
+                    )
+                    summary = (
+                        journal.summary()
+                        if journal is not None
+                        else {"enabled": False}
+                    )
+                    body = json.dumps(summary, indent=1) + "\n"
                     ctype = "application/json"
                 elif path in ("/debug/pending", PENDING_PREFIX):
                     # No key: list EVERY currently-pending pod/gang key

@@ -73,6 +73,30 @@ def _metrics_from_config(
     )
 
 
+def _attach_journal(accountant: ChipAccountant, config: SchedulerConfig):
+    """Durable claim journal (ISSUE 18): when ``journal_path`` is set,
+    open (replaying + tail-repairing) the on-disk CommitLog, seed the
+    accountant from the replayed state, and attach the journal so every
+    later claim mutation is write-ahead recorded. MUST run before any
+    watcher registers — the list-then-watch replay then layers
+    idempotently over the restored claims. Returns the journal (or None,
+    journal off — the accountant keeps today's in-memory-only behavior,
+    zero new hot-path work)."""
+    if not config.journal_path:
+        return None
+    from yoda_tpu.journal import FileJournal
+
+    journal = FileJournal(
+        config.journal_path,
+        sync=config.journal_sync,
+        segment_bytes=config.journal_segment_bytes,
+    )
+    state = journal.open()
+    accountant.restore(state)
+    accountant.journal = journal
+    return journal
+
+
 @dataclass
 class Stack:
     cluster: FakeCluster
@@ -109,6 +133,11 @@ class Stack:
     # stack — what ShardSet.resize unregisters when it retires a
     # dissolved shard lane (cluster.remove_watcher by fn identity).
     watch_fns: tuple = ()
+    # Durable claim journal (yoda_tpu/journal): the accountant's on-disk
+    # CommitLog, None with journal_path unset. Shared-accountant
+    # assemblies (profiles, shards) share one journal through the one
+    # accountant.
+    journal: object = None
 
 
 def build_stack(
@@ -149,6 +178,12 @@ def build_stack(
     own_accountant = accountant is None
     if own_accountant:
         accountant = ChipAccountant(scheduler_name=config.scheduler_name)
+        # Durable claim journal: replay + restore BEFORE the watcher
+        # registration below — warm-start state must exist before the
+        # list-then-watch replay layers over it. Shared accountants
+        # (profiles/shards) had theirs attached by their own builder.
+        _attach_journal(accountant, config)
+    journal = getattr(accountant, "journal", None)
     # A provided metrics registry is SHARED across profile stacks (one
     # /metrics endpoint aggregates every profile — series would otherwise
     # be created per stack and silently unreachable). The lifecycle
@@ -461,6 +496,58 @@ def build_stack(
         )
     if accountant not in cacc:
         cacc.append(accountant)
+
+    # Durable claim journal (ISSUE 18): the commit log's disk-side
+    # counters. Families register on every stack (one scrape schema
+    # across configurations — they render 0 with the journal off); the
+    # accumulator sums over the — usually one, shared — attached
+    # journal(s).
+    jacc = getattr(metrics, "_journals", None)
+    if jacc is None:
+        jacc = metrics._journals = []
+        metrics.registry.counter(
+            "yoda_journal_appends_total",
+            "Records appended to the durable claim journal (staged-claim"
+            " / commit / rollback / release / snapshot): every commit-"
+            "point state mutation, write-ahead of the in-memory apply",
+            lambda: sum(j.appends for j in jacc),
+        )
+        metrics.registry.counter(
+            "yoda_journal_bytes_total",
+            "Bytes appended to the journal (length-prefixed, CRC-"
+            "checksummed frames); divide by appends for mean record size",
+            lambda: sum(j.bytes_written for j in jacc),
+        )
+        metrics.registry.counter(
+            "yoda_journal_fsyncs_total",
+            "fsync calls issued by the journal — rate tracks appends "
+            "under journal_sync=always, commit edges + every ~64 appends"
+            " under batch, and stays flat under off",
+            lambda: sum(j.fsyncs for j in jacc),
+        )
+        metrics.registry.counter(
+            "yoda_journal_replay_ms_total",
+            "Wall milliseconds spent replaying the journal at open "
+            "(warm-start promotion cost; compare yoda_resync_duration_ms"
+            " for the cold-path blackout it replaces)",
+            lambda: sum(j.replay_ms for j in jacc),
+        )
+        metrics.registry.counter(
+            "yoda_journal_torn_records_total",
+            "Torn/corrupt records repaired by truncate at replay (short "
+            "header, truncated payload, or CRC mismatch; later segments "
+            "discarded). Nonzero after a crash is normal; climbing "
+            "during steady state means disk trouble",
+            lambda: sum(j.torn_records for j in jacc),
+        )
+        metrics.registry.counter(
+            "yoda_journal_compactions_total",
+            "Segment rotations compacted into a snapshot-headed fresh "
+            "segment (older segments deleted — journal size stays flat)",
+            lambda: sum(j.compactions for j in jacc),
+        )
+    if journal is not None and journal not in jacc:
+        jacc.append(journal)
     sacc = getattr(metrics, "_shard_loops", None)
     if sacc is None:
         sacc = metrics._shard_loops = []
@@ -1077,6 +1164,7 @@ def build_stack(
         nodehealth=nodehealth,
         speculation=speculation,
         watch_fns=tuple(registered_fns),
+        journal=journal,
     )
 
 
@@ -1104,6 +1192,14 @@ def apply_reloadable(stacks: "list[Stack]", config: SchedulerConfig) -> None:
     metrics.slo.enabled = config.slo_enabled
     metrics.slo.burn_threshold = config.slo_burn_threshold
     metrics.pending.capacity = max(config.pending_index_max, 16)
+    # Durable claim journal: sync policy + rotation threshold are live
+    # attributes the journal re-reads per append (journal_path itself is
+    # IMMUTABLE — repointing a live log would split the durable record).
+    for st in stacks:
+        j = getattr(st.accountant, "journal", None)
+        if j is not None:
+            j.sync = config.journal_sync
+            j.segment_bytes = int(config.journal_segment_bytes)
     from yoda_tpu.cluster.retry import BackoffPolicy
 
     for st in stacks:
@@ -1685,6 +1781,10 @@ def build_sharded_stacks(
     # event); capacity tracking feeds the commit validator.
     accountant = ChipAccountant(scheduler_name=config.scheduler_name)
     accountant.track_capacity = True
+    # Durable journal before the watcher: replayed claims (per-lane
+    # staged residue included) must exist before the list-then-watch
+    # replay layers over them.
+    _attach_journal(accountant, config)
     cluster.add_watcher(accountant.handle)
     shared_metrics = _metrics_from_config(config, clock)
     # Global lane first: full fleet view (it owns the fleet gauges), pods
@@ -1808,6 +1908,8 @@ def build_profile_stacks(
     shared = ChipAccountant(
         scheduler_name=config.scheduler_name, scheduler_names=names
     )
+    # Durable journal before the watcher (same order as build_stack).
+    _attach_journal(shared, config)
     # Registered once, before any stack's informer, so reservation releases
     # precede the informer's view of the same event (build_stack's order).
     cluster.add_watcher(shared.handle)

@@ -25,7 +25,8 @@ produces, on a seeded schedule a test can replay exactly:
 
 Ops recognized by the built-in wrappers: ``bind``, ``unbind``,
 ``metrics``, ``dispatch``, ``watch``, ``crash``, ``cluster_partition``,
-``cluster_loss``. Each retry of a faulted call counts as a fresh
+``cluster_loss``, ``journal`` (disk faults against the durable claim
+journal, consumed by ``FaultyJournalIO``). Each retry of a faulted call counts as a fresh
 invocation — a ``count=1`` bind conflict fails once and the binder's
 first retry succeeds; ``count > retry budget`` forces the genuine-failure
 path (gang rollback).
@@ -98,6 +99,16 @@ _DEFAULT_KINDS = {
     # resync (PR 5) must recover the half-committed state. Mechanically
     # this rides the crash machinery (ChaosCluster._maybe_crash).
     "shard_crash": ("mid_commit",),
+    # Journal disk-fault mode (durable claim journal, ISSUE 18):
+    # consumed by FaultyJournalIO, one invocation per journal append.
+    # short_write leaves a torn frame on disk (the journal fail-stops;
+    # recovery truncate-repairs the tail); fsync_error is the device
+    # refusing durability (fail-stop, nothing torn); crash_after_append
+    # dies AFTER the record is durable but BEFORE the accountant learns
+    # — the worst case: the replayed journal knows a claim the dead
+    # process's memory never held, and the promoted standby must adopt
+    # it without double-binding.
+    "journal": ("short_write", "fsync_error", "crash_after_append"),
 }
 
 
@@ -492,6 +503,66 @@ def install_chaos_kernel(batch_plugin, plan: ChaosPlan) -> ChaosKernel:
     if resident is not None and resident.kern is inner:
         resident.kern = wrapped
     return wrapped
+
+
+class FaultyJournalIO:
+    """A ``journal.RealJournalIO`` front that injects disk faults per
+    plan (op ``journal``, one invocation per append — the ``write`` call
+    draws the fault and pins its kind for the rest of that append's
+    ops):
+
+    - ``short_write`` writes half the frame and reports the short count;
+      the journal detects it, fail-stops, and leaves a TORN frame on
+      disk for recovery to truncate-repair.
+    - ``fsync_error`` raises from fsync — the device refused durability,
+      the journal fail-stops with a clean tail. Only observable when the
+      append's sync policy actually fsyncs (use ``journal_sync=always``
+      in sweeps that schedule it).
+    - ``crash_after_append`` raises from ``ack()``: the record IS
+      durable but the caller dies before learning so — the in-memory
+      mutation never applies, and only the standby's replay knows the
+      claim existed. The double-bind trap the warm resync must not fall
+      into.
+    """
+
+    def __init__(self, plan: ChaosPlan, inner=None) -> None:
+        from yoda_tpu.journal import RealJournalIO
+
+        self.plan = plan
+        self.inner = inner if inner is not None else RealJournalIO()
+        self._pending: "str | None" = None
+
+    def write(self, fobj, data: bytes) -> int:
+        self._pending = None
+        if self.plan.has_op("journal"):
+            f = self.plan.next("journal")
+            if f is not None:
+                self._pending = f.kind
+        if self._pending == "short_write":
+            self._pending = None
+            n = len(data) // 2
+            self.inner.write(fobj, data[:n])
+            return n
+        return self.inner.write(fobj, data)
+
+    def flush(self, fobj) -> None:
+        self.inner.flush(fobj)
+
+    def fsync(self, fobj) -> None:
+        if self._pending == "fsync_error":
+            self._pending = None
+            raise OSError("chaos: injected fsync failure")
+        self.inner.fsync(fobj)
+
+    def ack(self) -> None:
+        if self._pending == "crash_after_append":
+            from yoda_tpu.journal import JournalFault
+
+            self._pending = None
+            raise JournalFault(
+                "chaos: process crashed between append and ack"
+            )
+        self.inner.ack()
 
 
 def maybe_cluster_fault(plan: ChaosPlan, cluster: ChaosCluster) -> "str | None":

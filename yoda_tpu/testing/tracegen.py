@@ -233,6 +233,10 @@ class ReplayReport:
     drain_leftover: int = 0
     slo: dict = field(default_factory=dict)   # final engine evaluation
     wall_s: float = 0.0
+    # The still-open stack when ``replay(..., keep_stack=True)`` — the
+    # caller owns its shutdown (gang.close / ingestor.stop /
+    # tracer.close). None on normal runs; never in fingerprint().
+    stack: "object | None" = None
 
     def fingerprint(self) -> dict:
         """The determinism contract: identical seeds must produce THIS
@@ -331,6 +335,7 @@ def replay(
     drive_overload: bool = False,
     max_wall_s: float = 900.0,
     shard_count: int = 1,
+    keep_stack: bool = False,
 ) -> ReplayReport:
     """Drive one full scheduler stack with the spec's generated stream.
 
@@ -554,6 +559,12 @@ def replay(
     report.shed = int(stack.metrics.overload.shed_total)
     report.slo = engine.evaluate(spec.duration_s)
     report.wall_s = time.monotonic() - t_start
+    if keep_stack:
+        # Hand the live stack (and its cluster/journal) to the caller —
+        # the journal soak promotes a standby over them after this run.
+        assert shard_count == 1, "keep_stack is single-stack only"
+        report.stack = stack
+        return report
     for st in all_stacks:
         st.gang.close()
         st.ingestor.stop()
