@@ -9,7 +9,7 @@ TAG ?= latest
 PY ?= python
 CXX ?= g++
 
-.PHONY: all test lint native native-asan bench bench-scale serve-bench rebalance-bench slo-bench shard-bench overload-bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native native-asan bench bench-scale serve-bench rebalance-bench slo-bench shard-bench proc-bench overload-bench smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -20,9 +20,10 @@ test:
 # container image may not ship ruff — fall back to a byte-compile sweep so
 # `make all` still gates on syntax-clean sources everywhere. yodalint
 # (tools/yodalint, docs/OPERATIONS.md "Static analysis gates") runs the
-# seven project-invariant passes — lock discipline, fence-before-write,
+# ten project-invariant passes — lock discipline, fence-before-write,
 # snapshot immutability, config/metrics/doc drift, hook order, verdict
-# taxonomy — in < 5 s with zero findings required on a clean tree.
+# taxonomy, reload safety, speculation safety, journal discipline — in
+# < 5 s with zero findings required on a clean tree.
 lint:
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
 		$(PY) -m ruff check yoda_tpu tests bench.py __graft_entry__.py; \
@@ -108,6 +109,17 @@ slo-bench:
 # `make smoke`. One JSON line.
 shard-bench:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --shards
+
+# Multi-process shard serve evidence (CPU-pinned): the 8-shard shape
+# drained by 8 worker PROCESSES over the commit RPC vs the SAME shape
+# as 8 serve-loop threads, zero injected bind latency so the drain is
+# pure scheduler CPU (the GIL-bound regime). Asserts >= 1.5x aggregate
+# pods/s on multi-CPU hosts (the gate self-skips on one core, where
+# threads lose nothing to the GIL); zero staged residue / chip leaks
+# assert everywhere. The 2-worker slice rides `make smoke`. Also runs
+# inside `make shard-bench`. One JSON line.
+proc-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --proc
 
 # Overload brownout ladder + live shard resize evidence (CPU-pinned):
 # the seeded 10x flash-crowd replay with the ladder on vs off (prod

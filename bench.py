@@ -2262,6 +2262,197 @@ def _shard_scaling_scenario(
     return out
 
 
+def _proc_serve_scenario(
+    *,
+    workers: int = 8,
+    gangs: int = 24,
+    members: int = 4,
+    hosts: int = 16,
+    chips: int = 8,
+    reps: int = 1,
+) -> dict:
+    """Multi-process shard serve vs the threaded baseline (ISSUE 19):
+    the SAME N-shard shape drained two ways — N serve-loop THREADS in
+    one interpreter (``build_sharded_stacks``, the PR-14 shape) vs N
+    worker PROCESSES each running its own serve loop over a private
+    partition and reaching the parent's journal-owning accountant
+    through the commit RPC (``framework/procserve.py``). Zero injected
+    bind latency: the drain is pure scheduler CPU, which is exactly the
+    regime where the threaded lanes serialize on the GIL and the
+    process split should not.
+
+    Workers get disjoint round-robin host partitions and whole-gang
+    round-robin pod assignments (each worker's cluster holds only its
+    own fleet, so no cross-worker routing is exercised here — that is
+    the thread scenario's job; this one isolates the commit-path and
+    GIL economics). Aggregate pods/s = total timed pods / slowest
+    worker's timed wall, every worker released from a start barrier
+    AFTER its warmup drain so process startup skew never pollutes the
+    clock.
+
+    Reported: ``proc_pods_per_s`` vs ``proc_thread_pods_per_s``, the
+    ``proc_vs_thread`` ratio, per-worker admission p99, commit-RPC
+    conflict count, and ``proc_cpu_count``. The >= 1.5x acceptance gate
+    asserts ONLY on hosts with >= 2 CPUs: on a single core the GIL
+    costs the threads nothing (there is no parallelism to lose), so the
+    ratio is reported but the gate records itself as skipped.
+    Correctness invariants (zero staged residue, all chips released,
+    every worker's full drain) assert unconditionally."""
+    import json as _json
+    import os as _os
+    import subprocess as _sp
+    import tempfile as _tf
+
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.cluster.fake import FakeCluster
+    from yoda_tpu.framework.procserve import CommitRPCServer
+    from yoda_tpu.framework.shards import shard_name
+    from yoda_tpu.plugins.yoda.accounting import ChipAccountant
+
+    assert hosts % workers == 0, "even host partition"
+    assert gangs % workers == 0, "even gang assignment"
+    cpu_count = _os.cpu_count() or 1
+    out: dict = {
+        "proc_workers": workers,
+        "proc_gangs": gangs,
+        "proc_gang_members": members,
+        "proc_cpu_count": cpu_count,
+    }
+
+    # --- threaded baseline: the identical shape through the identical
+    # machinery, lanes as threads (latency_s=0 -> CPU-bound).
+    base = _shard_scaling_scenario(
+        shard_counts=(workers,),
+        gangs=gangs,
+        members=members,
+        hosts=hosts,
+        chips=chips,
+        latency_s=0.0,
+        reps=reps,
+    )
+    thread_rate = base[f"shard{workers}_pods_per_s"]
+    out["proc_thread_pods_per_s"] = thread_rate
+
+    # --- process mode: parent control plane in THIS process (full-
+    # fleet capacity view + commit RPC server), one spec worker process
+    # per lane.
+    cluster = FakeCluster()
+    accountant = ChipAccountant()
+    accountant.track_capacity = True
+    cluster.add_watcher(accountant.handle)
+    agent = FakeTpuAgent(cluster)
+    host_rows = [
+        {"name": f"ph-{i}", "chips": chips} for i in range(hosts)
+    ]
+    for h in host_rows:
+        agent.add_host(h["name"], generation="v5e", chips=chips)
+    agent.publish_all()
+
+    tmpdir = _tf.mkdtemp(prefix="yoda-proc-bench-")
+    sock = _os.path.join(tmpdir, "c.sock")
+    server = CommitRPCServer(
+        accountant,
+        sock,
+        fence_fn=lambda: True,
+        expected_workers=workers,
+    )
+    server.start()
+    procs: "list[_sp.Popen]" = []
+    try:
+        per_gang = gangs // workers
+        for w in range(workers):
+            my_hosts = host_rows[w::workers]
+
+            def gang_pods(tag):
+                rows = []
+                for g in range(per_gang):
+                    nm = f"{tag}{w}-{g}"
+                    rows.extend(
+                        {
+                            "name": f"{nm}-{m}",
+                            "labels": {
+                                "tpu/gang": nm,
+                                "tpu/gang-size": str(members),
+                                "tpu/chips": "1",
+                            },
+                        }
+                        for m in range(members)
+                    )
+                return rows
+
+            spec = {
+                "socket": sock,
+                "shard_index": w,
+                "workers": workers,
+                "barrier_timeout_s": 600.0,
+                "config": {
+                    "mode": "batch",
+                    "batch_requests": 16,
+                    "bind_workers": max(members, 4),
+                },
+                "hosts": my_hosts,
+                "warmup_pods": gang_pods("pw"),
+                "pods": gang_pods("pr"),
+            }
+            spec_path = _os.path.join(tmpdir, f"w{w}.json")
+            with open(spec_path, "w") as f:
+                _json.dump(spec, f)
+            procs.append(
+                _sp.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "yoda_tpu.framework.procserve",
+                        "--serve-spec",
+                        spec_path,
+                    ],
+                    env={**_os.environ, "JAX_PLATFORMS": "cpu"},
+                )
+            )
+        for p in procs:
+            assert p.wait(timeout=900) == 0, f"worker rc={p.returncode}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        reports = dict(server.reports)
+        server.stop()
+
+    assert len(reports) == workers, sorted(reports)
+    # Invariants: every worker drained everything, committed through
+    # the parent, and released on teardown — zero staged residue, zero
+    # chips still charged, no conflicts (partitions are disjoint).
+    for lane, r in sorted(reports.items()):
+        assert r["pods"] == per_gang * members, (lane, r)
+        assert r["staged_residue"] == 0, (lane, r)
+        out[f"proc_{lane}_pods_per_s"] = r["pods_per_s"]
+        out[f"proc_{lane}_admission_p99_s"] = r["admission_p99_s"]
+    assert accountant.staged_count() == 0, accountant.staged_uids()
+    leaked = {n: c for n, c in accountant.chips_by_node().items() if c}
+    assert not leaked, leaked
+    out["proc_commit_conflicts"] = accountant.commit_conflicts
+
+    slowest = max(r["wall_s"] for r in reports.values())
+    agg = round(gangs * members / slowest, 1)
+    out["proc_pods_per_s"] = agg
+    out["proc_vs_thread"] = round(agg / thread_rate, 2)
+    if cpu_count >= 2:
+        assert out["proc_vs_thread"] >= 1.5, (
+            f"process-mode aggregate only {out['proc_vs_thread']}x the "
+            f"threaded baseline on {cpu_count} CPUs (acceptance >= 1.5x)"
+        )
+    else:
+        # One core: threads lose nothing to the GIL (nothing runs in
+        # parallel either way), so the ratio gate cannot hold honestly.
+        # Report the measured ratio; the gate records itself skipped.
+        out["proc_ratio_gate"] = (
+            "skipped: single-CPU host — GIL-free split needs >= 2 cores "
+            "to beat threads; ratio reported unasserted"
+        )
+    return out
+
+
 def _slo_scenario_matrix(*, scale: float = 1.0, seed: int = 7) -> dict:
     """Fleet SLO engine + trace-replay scenario matrix (ISSUE 12): four
     seeded million-pod-lifecycle replays (testing/tracegen.py) driven
@@ -3408,6 +3599,8 @@ def run_bench() -> dict:
     print(f"SLO trace-replay matrix (smoke slice): {slo_matrix}", file=sys.stderr)
     shard = _shard_scaling_scenario()
     print(f"scheduler shard-out scaling (1/2/4/8): {shard}", file=sys.stderr)
+    procserve = _proc_serve_scenario(workers=2, gangs=4, hosts=4)
+    print(f"multi-process shard serve (2-worker slice): {procserve}", file=sys.stderr)
     storm = _overload_storm_scenario()
     print(f"overload brownout ladder + live resize: {storm}", file=sys.stderr)
     http = _http_gang_scenario()
@@ -3447,6 +3640,7 @@ def run_bench() -> dict:
         **slo_over,
         **slo_matrix,
         **shard,
+        **procserve,
         **storm,
         **http,
         **probe,
@@ -3511,6 +3705,12 @@ def run_smoke() -> dict:
         )
     )
     assert out["shard_scaling_2x"] >= 1.3, out["shard_scaling_2x"]
+    # Multi-process shard serve smoke slice: 2 worker processes over
+    # the commit RPC vs the same shape threaded (the full 8-worker
+    # shape is `make proc-bench`). Correctness (zero staged residue,
+    # all chips released, full drains) asserts inside the scenario;
+    # the >= 1.5x ratio gate self-skips on single-CPU hosts.
+    out.update(_proc_serve_scenario(workers=2, gangs=4, hosts=4))
     return {"metric": "smoke_burst_with_gang_pods_per_s", **out}
 
 
@@ -3521,7 +3721,9 @@ def run_shards() -> dict:
     assemblies, aggregate pods/s + commit conflict/rollback totals +
     admission p99 per count. Acceptance: >= 3x aggregate pods/s at 4
     shards vs 1 (the 1-shard baseline is the SAME machinery, so the
-    ratio isolates sharding itself)."""
+    ratio isolates sharding itself). Also runs the PROCESS-mode serve
+    of the same 8-shard shape (ISSUE 19): GIL-free aggregate pods/s vs
+    the threaded baseline is the headline number there."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -3530,9 +3732,34 @@ def run_shards() -> dict:
         f"shard scaling regressed: {out['shard_scaling_4x']}x at 4 "
         "shards (acceptance >= 3x)"
     )
+    out.update(_proc_serve_scenario(workers=8))
     return {
         "metric": "shard_scaling_4x",
         "value": out["shard_scaling_4x"],
+        "unit": "ratio",
+        **out,
+    }
+
+
+def run_proc() -> dict:
+    """``bench.py --proc`` / ``make proc-bench``: the multi-process
+    shard serve evidence (ISSUE 19) at the standard 8-shard shape — 8
+    worker PROCESSES, each its own serve loop over a private partition,
+    reaching the parent's journal-owning accountant through the commit
+    RPC, vs the SAME shape as 8 serve-loop threads in one interpreter.
+    Zero injected bind latency so the drain is pure scheduler CPU: the
+    regime where the threads serialize on the GIL and the processes do
+    not. Acceptance (>= 1.5x aggregate pods/s, asserted inside the
+    scenario) gates only on multi-CPU hosts; correctness invariants —
+    zero staged residue, all chips released, full per-worker drains —
+    assert unconditionally."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = _proc_serve_scenario(workers=8)
+    return {
+        "metric": "proc_vs_thread",
+        "value": out["proc_vs_thread"],
         "unit": "ratio",
         **out,
     }
@@ -3672,6 +3899,9 @@ def main() -> int:
         return 0
     if "--shards" in sys.argv:
         print(json.dumps(run_shards()))
+        return 0
+    if "--proc" in sys.argv:
+        print(json.dumps(run_proc()))
         return 0
     if "--overload" in sys.argv:
         print(json.dumps(run_overload()))

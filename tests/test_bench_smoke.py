@@ -304,6 +304,31 @@ def test_shard_scaling_smoke_invariants():
     assert out["shard1_commit_commits"] > 0
 
 
+def test_proc_serve_smoke_invariants():
+    import bench
+
+    # ISSUE 19: the multi-process shard serve smoke slice (2 worker
+    # processes over the commit RPC vs the same shape threaded; `make
+    # proc-bench` runs the 8-worker standard shape). The scenario
+    # asserts correctness inline — every worker's full drain, zero
+    # staged residue, zero chip leaks — unconditionally, and holds the
+    # >= 1.5x ratio gate only on multi-CPU hosts (on one core the GIL
+    # costs threads nothing, so the gate records itself skipped).
+    import os
+
+    out = bench._proc_serve_scenario(workers=2, gangs=4, hosts=4)
+    assert out["proc_pods_per_s"] > 0
+    assert out["proc_thread_pods_per_s"] > 0
+    assert out["proc_commit_conflicts"] == 0
+    assert out["proc_s0_pods_per_s"] > 0
+    assert out["proc_s1_pods_per_s"] > 0
+    if (os.cpu_count() or 1) >= 2:
+        assert out["proc_vs_thread"] >= 1.5, out
+        assert "proc_ratio_gate" not in out
+    else:
+        assert out["proc_ratio_gate"].startswith("skipped")
+
+
 def test_overload_storm_smoke_invariants():
     import bench
 

@@ -65,6 +65,24 @@ class TestSchedulerManifest:
         )
         assert cfg.shard_count == 1
 
+    def test_configmap_ships_shard_mode_at_thread_default(self):
+        """ISSUE 19: shard_mode ships (commented, so operators see the
+        process-mode knob next to shard_count) at the thread default —
+        byte-identical classic sharding — and the shipped value
+        VALIDATES; a drifted ConfigMap would crash-loop the
+        Deployment."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        text = cm["data"]["config.yaml"]
+        assert "# shard_mode: thread" in text
+        cfg = SchedulerConfig.from_dict(yaml.safe_load(text))
+        assert cfg.shard_mode == "thread"
+        # The commented value round-trips through validation too.
+        enabled = yaml.safe_load(
+            text.replace("# shard_mode: thread", "shard_mode: process")
+        )
+        enabled["shard_count"] = 2
+        assert SchedulerConfig.from_dict(enabled).shard_mode == "process"
+
     def test_configmap_overload_knobs_validate(self):
         """ISSUE 15: the shipped overload-ladder knobs must pass
         SchedulerConfig validation — a drifted ConfigMap would

@@ -177,6 +177,9 @@ ALL_METRIC_FAMILIES = (
     "yoda_burst_served_total",
     "yoda_cluster_state",
     "yoda_cluster_transitions_total",
+    "yoda_commit_rpc_calls_total",
+    "yoda_commit_rpc_conflicts_total",
+    "yoda_commit_rpc_latency_ms",
     "yoda_delta_apply_ms",
     "yoda_dispatch_backend_level",
     "yoda_dispatch_errors_total",
@@ -616,6 +619,76 @@ class TestMetricsServer:
             assert "phases_ms" in entries[-1]
         finally:
             server.stop()
+
+    def test_debug_shards_endpoint(self):
+        """ISSUE 19: GET /debug/shards serves the per-shard worker view
+        (lane, pid, heartbeat age, staged count) from the injected
+        shards_fn — the process-mode answer to "which worker owns what
+        right now"."""
+        import json
+
+        stack, agent = make_stack()
+        view = {
+            "mode": "process",
+            "workers": [
+                {
+                    "shard": "s0",
+                    "pid": 4242,
+                    "heartbeat_age_s": 0.4,
+                    "staged": 2,
+                    "alive": True,
+                    "restarts": 1,
+                }
+            ],
+        }
+        server = MetricsServer(
+            stack.metrics, host="127.0.0.1", port=0,
+            shards_fn=lambda: view,
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            body = urllib.request.urlopen(f"{base}/debug/shards").read()
+            got = json.loads(body.decode())
+            assert got == view
+        finally:
+            server.stop()
+
+    def test_debug_shards_without_fn_reports_disabled(self):
+        import json
+
+        stack, agent = make_stack()
+        server = MetricsServer(stack.metrics, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            body = urllib.request.urlopen(f"{base}/debug/shards").read()
+            assert json.loads(body.decode()) == {"enabled": False}
+        finally:
+            server.stop()
+
+    def test_commit_rpc_families_render_with_op_and_shard_labels(self):
+        """ISSUE 19: the commit-RPC server's observability surface —
+        calls counted per (op, shard), conflicts per shard, latency as
+        a per-op histogram in milliseconds."""
+        from yoda_tpu.observability import SchedulingMetrics
+
+        m = SchedulingMetrics()
+        m.commit_rpc_calls.inc(op="stage", shard="s0")
+        m.commit_rpc_calls.inc(op="stage", shard="s0")
+        m.commit_rpc_calls.inc(op="commit", shard="s1")
+        m.commit_rpc_conflicts.inc(shard="s1")
+        m.commit_rpc_latency.observe(0.7, op="commit")
+        text = m.registry.render_prometheus()
+        assert (
+            'yoda_commit_rpc_calls_total{op="stage",shard="s0"} 2' in text
+        )
+        assert (
+            'yoda_commit_rpc_calls_total{op="commit",shard="s1"} 1' in text
+        )
+        assert 'yoda_commit_rpc_conflicts_total{shard="s1"} 1' in text
+        assert 'yoda_commit_rpc_latency_ms_bucket' in text
+        assert 'yoda_commit_rpc_latency_ms_count{op="commit"} 1' in text
 
     def test_trace_dropped_counter_counts_ring_overflow(self):
         from yoda_tpu.observability import SchedulingMetrics, TraceEntry

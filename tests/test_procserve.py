@@ -1,0 +1,703 @@
+"""Multi-process shard serve (ISSUE 19): the commit RPC contract, the
+SIGKILL chaos sweep, worker respawn, and parent-death fencing.
+
+The scenarios here are the ISSUE's acceptance criteria:
+
+- the commit RPC unit contract: stage/commit/conflict/rollback through
+  the socket behaves exactly like the in-process accountant — same
+  first-staged-wins outcomes, same state, and the parent journals every
+  decision write-ahead (a claim staged over the RPC survives replay);
+- SIGKILL-a-worker chaos: a worker killed at the staged barrier or
+  mid-commit (the parent holding the commit gate closed) leaves staged
+  residue that journal replay + the reconciler warm path recovers — no
+  oversubscription, no split gangs, zero leaked staged claims — while
+  surviving workers keep committing;
+- worker respawn: the supervisor respawns a killed worker with backoff,
+  and the replacement (same lane, fresh process) stages and commits
+  against the recovered state like a promoted standby;
+- parent-death fencing: a worker whose parent stops answering (or whose
+  heartbeat verdict flips) stops binding — fail-closed on staleness.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.framework.procserve import (
+    CommitRPCClient,
+    CommitRPCError,
+    CommitRPCServer,
+    WorkerFence,
+)
+from yoda_tpu.framework.shards import WorkerSupervisor
+from yoda_tpu.journal import FileJournal
+from yoda_tpu.plugins.yoda.accounting import ChipAccountant, RemoteAccountant
+from yoda_tpu.testing.chaos import DriveWorker
+
+
+def make_parent(hosts=2, chips=8, journal_dir=None):
+    """The parent control plane's accountant half: capacity tracked from
+    its own full-fleet view, journal attached (replay-first) when a
+    directory is given — the same discipline as _attach_journal."""
+    cluster = FakeCluster()
+    acc = ChipAccountant()
+    acc.track_capacity = True
+    if journal_dir is not None:
+        j = FileJournal(str(journal_dir))
+        state = j.open()
+        if state.claims:
+            acc.restore(state)
+        acc.journal = j
+    cluster.add_watcher(acc.handle)
+    agent = FakeTpuAgent(cluster)
+    for i in range(hosts):
+        agent.add_host(f"host-{i}", generation="v5e", chips=chips)
+    agent.publish_all()
+    return cluster, acc
+
+
+class _Server:
+    """One CommitRPCServer on a short /tmp socket (AF_UNIX paths cap at
+    ~107 chars; pytest tmp_path nesting can blow that)."""
+
+    def __init__(self, acc, **kw):
+        self.dir = tempfile.mkdtemp(prefix="yoda-rpc-")
+        self.sock = os.path.join(self.dir, "c.sock")
+        self.server = CommitRPCServer(acc, self.sock, **kw)
+        self.server.start()
+
+    def client(self, shard="s0"):
+        return CommitRPCClient(self.sock, shard=shard)
+
+    def close(self):
+        self.server.stop()
+        try:
+            os.rmdir(self.dir)
+        except OSError:
+            pass
+
+
+class TestCommitRPCContract:
+    """Stage/commit/conflict/rollback over the socket == the in-process
+    accountant, decision for decision."""
+
+    def test_stage_commit_release_parity_with_local_accountant(self):
+        # The same claim script against (a) a plain accountant and (b) a
+        # RemoteAccountant fronting a parent over the RPC must produce
+        # identical outcomes and identical chip state.
+        def script(acc):
+            out = []
+            acc._claim("default/a", "host-0", 4, shard="s0", gang="g1")
+            acc._claim("default/b", "host-0", 4, shard="s0", gang="g1")
+            out.append(acc.commit_staged(["default/a", "default/b"]))
+            acc._claim("default/c", "host-1", 6, shard="s0")
+            out.append(acc.commit_staged(["default/c"]))
+            acc.release("default/a")
+            out.append(acc.chips_by_node())
+            out.append(acc.staged_count())
+            return out
+
+        _, local = make_parent()
+        want = script(local)
+
+        _, parent = make_parent()
+        srv = _Server(parent)
+        try:
+            cl = srv.client()
+            remote = RemoteAccountant(cl)
+            got = script(remote)
+            assert got == want
+            # The parent's (authoritative) view converged to the same
+            # chip state as the worker's mirror.
+            assert parent.chips_by_node() == want[2]
+            assert parent.staged_count() == want[3]
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_first_staged_wins_across_worker_lanes(self):
+        # Two lanes race for the same 8-chip host: the earlier-staged
+        # lane's commit wins, the later one conflicts and rolls back —
+        # exactly the threaded shard-out protocol, across sockets.
+        _, parent = make_parent(hosts=1)
+        srv = _Server(parent)
+        try:
+            a, b = srv.client("s0"), srv.client("s1")
+            ra, rb = RemoteAccountant(a), RemoteAccountant(b)
+            ra._claim("default/w0", "host-0", 6, shard="s0")
+            rb._claim("default/w1", "host-0", 6, shard="s1")
+            ok_b, why_b = rb.commit_staged(["default/w1"])
+            ok_a, why_a = ra.commit_staged(["default/w0"])
+            assert not ok_b and "earlier-staged" in why_b
+            assert ok_a, why_a
+            # The in-process contract: a refused gang rolls back whole
+            # through the CALLER's transactional unbind path — the
+            # loser releases, and the rollback propagates to the
+            # parent's (journaled) state.
+            rb.release("default/w1")
+            assert parent.chips_in_use("host-0") == 6
+            assert parent.staged_count() == 0
+            assert ra.staged_count() == 0 and rb.staged_count() == 0
+            a.close()
+            b.close()
+        finally:
+            srv.close()
+
+    def test_stage_is_journaled_write_ahead_at_the_parent(self, tmp_path):
+        # A claim staged over the RPC is durable BEFORE the worker acts
+        # on it: kill everything, replay the journal, the claim is back.
+        _, parent = make_parent(journal_dir=tmp_path)
+        srv = _Server(parent)
+        try:
+            cl = srv.client()
+            cl.stage("default/p1", "host-0", 4, "s0", gang="g1")
+            cl.close()
+        finally:
+            srv.close()
+        parent.journal.close()
+        state = FileJournal(str(tmp_path)).open()
+        assert list(state.claims) == ["default/p1"]
+        node, chips, shard, _seq, gang = state.claims["default/p1"]
+        assert (node, chips, shard, gang) == ("host-0", 4, "s0", "g1")
+
+    def test_rpc_failure_reads_as_refused_commit(self):
+        # A dead parent is a refused decision, never silent local state:
+        # commit returns (False, why), stage raises, and the worker's
+        # mirror stays consistent for the retry after reconnect.
+        _, parent = make_parent()
+        srv = _Server(parent)
+        cl = srv.client()
+        ra = RemoteAccountant(cl)
+        ra._claim("default/p1", "host-0", 4, shard="s0")
+        srv.close()
+        ok, why = ra.commit_staged(["default/p1"])
+        assert not ok and "commit rpc failed" in why
+        assert ra.staged_count() == 1  # still staged; retry-able
+        with pytest.raises(CommitRPCError):
+            cl.stage("default/p2", "host-0", 2, "s0")
+        cl.close()
+
+    def test_fenced_parent_refuses_commits(self):
+        # The parent's own leader fence gates the commit point: while
+        # fenced (lost lease / resync pending) every commit is refused,
+        # and staged claims stay staged for the fence to reopen.
+        fenced = [True]
+        _, parent = make_parent()
+        srv = _Server(parent, fence_fn=lambda: not fenced[0])
+        try:
+            cl = srv.client()
+            cl.stage("default/p1", "host-0", 4, "s0")
+            ok, why = cl.commit(["default/p1"])
+            assert not ok and "fenced" in why
+            assert parent.staged_count() == 1
+            fenced[0] = False
+            ok, _t = cl.commit(["default/p1"])
+            assert ok
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_commit_residue_over_the_rpc(self):
+        _, parent = make_parent()
+        srv = _Server(parent)
+        try:
+            cl = srv.client()
+            cl.stage("default/p1", "host-0", 4, "s0")
+            assert cl.residue("default/p1") is True
+            assert cl.residue("default/ghost") is False
+            assert parent.chips_in_use("host-0") == 4
+            assert parent.staged_count() == 0
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_rpc_metrics_and_debug_view(self):
+        from yoda_tpu.observability import SchedulingMetrics
+
+        m = SchedulingMetrics()
+        _, parent = make_parent(hosts=1)
+        srv = _Server(parent, metrics=m)
+        try:
+            cl = srv.client()
+            cl.hello()
+            cl.stage("default/p1", "host-0", 6, "s0")
+            cl.stage("default/p2", "host-0", 6, "s0")
+            ok, _ = cl.commit(["default/p1"])
+            assert ok
+            ok2, _ = cl.commit(["default/p2"])
+            assert not ok2
+            assert cl.heartbeat({"queue_depth": 3, "binds": 1}) is True
+            text = m.registry.render_prometheus()
+            assert 'yoda_commit_rpc_calls_total{op="stage",shard="s0"} 2' in text
+            assert (
+                'yoda_commit_rpc_conflicts_total{shard="s0"} 1' in text
+            )
+            assert "yoda_commit_rpc_latency_ms" in text
+            view = srv.server.debug()
+            assert view["enabled"] and view["mode"] == "process"
+            (row,) = view["workers"]
+            assert row["lane"] == "s0"
+            assert row["pid"] == os.getpid()
+            assert row["queue_depth"] == 3 and row["binds"] == 1
+            # p2's refused claim stays staged until the caller rolls it
+            # back — and the debug view shows exactly that residue.
+            assert row["staged"] == 1
+            assert row["heartbeat_age_s"] is not None
+            cl.close()
+        finally:
+            srv.close()
+
+
+class TestWorkerFence:
+    """Leadership AND parent liveness, fail-closed."""
+
+    def test_follows_the_parent_heartbeat_verdict(self):
+        serving = [True]
+        _, parent = make_parent()
+        srv = _Server(parent, fence_fn=lambda: serving[0])
+        try:
+            cl = srv.client()
+            fence = WorkerFence(cl, shard="s0")
+            assert fence.serving() is False  # no heartbeat yet: fenced
+            fence.beat()
+            assert fence.serving() is True
+            serving[0] = False
+            fence.beat()
+            assert fence.serving() is False
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_stale_heartbeat_fences_fail_closed(self):
+        # A worker that cannot hear the parent stops binding once the
+        # last good verdict ages past liveness_s — even though that
+        # verdict said serve.
+        now = [100.0]
+        _, parent = make_parent()
+        srv = _Server(parent, fence_fn=lambda: True)
+        cl = srv.client()
+        fence = WorkerFence(
+            cl, shard="s0", liveness_s=3.0, clock=lambda: now[0]
+        )
+        fence.beat()
+        assert fence.serving() is True
+        srv.close()  # parent gone: beats fail, verdict goes stale
+        fence.beat()
+        assert fence.serving() is True  # within liveness window
+        now[0] += 3.5
+        assert fence.serving() is False
+        cl.close()
+
+    def test_orphaned_worker_is_fenced_and_notified(self):
+        # getppid() changing means the parent died and we were
+        # re-parented: fence immediately and fire on_orphaned once
+        # (production workers use it to exit).
+        _, parent = make_parent()
+        srv = _Server(parent, fence_fn=lambda: True)
+        try:
+            cl = srv.client()
+            orphaned = []
+            fence = WorkerFence(
+                cl, shard="s0", on_orphaned=lambda: orphaned.append(1)
+            )
+            fence.beat()
+            assert fence.serving() is True
+            fence._ppid = -1  # simulate re-parenting
+            fence.beat()
+            assert fence.serving() is False
+            fence.beat()
+            assert orphaned == [1]
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_heartbeat_thread_lifecycle(self):
+        _, parent = make_parent()
+        srv = _Server(parent, fence_fn=lambda: True)
+        try:
+            cl = srv.client()
+            fence = WorkerFence(cl, shard="s0", period_s=0.05)
+            fence.start()
+            deadline = time.monotonic() + 5.0
+            while not fence.serving() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert fence.serving() is True
+            fence.stop()
+            cl.close()
+        finally:
+            srv.close()
+
+
+class TestWorkerSupervisor:
+    """Spawn/poll/respawn-with-backoff/kill/stop over fake processes."""
+
+    class FakeProc:
+        def __init__(self, pid):
+            self.pid = pid
+            self.rc = None
+            self.signals = []
+
+        def poll(self):
+            return self.rc
+
+        def send_signal(self, sig):
+            self.signals.append(sig)
+            self.rc = -sig
+
+        def kill(self):
+            self.send_signal(9)
+
+        def wait(self, timeout=None):
+            return self.rc
+
+    def test_respawn_with_backoff_and_budget(self):
+        import signal as _signal
+
+        now = [0.0]
+        spawned = []
+
+        def spawn(i):
+            p = self.FakeProc(pid=1000 + len(spawned))
+            spawned.append((i, p))
+            return p
+
+        sup = WorkerSupervisor(
+            spawn, 2, max_respawns=2, clock=lambda: now[0]
+        )
+        sup.start()
+        assert sup.alive() == 2 and len(spawned) == 2
+        assert sup.poll() == []  # everyone alive: nothing to do
+
+        sup.kill(0)  # SIGKILL by default
+        assert spawned[0][1].signals == [_signal.SIGKILL]
+        assert sup.alive() == 1
+        # First poll only ARMS the backoff; the respawn fires once the
+        # backoff window has elapsed.
+        assert sup.poll() == []
+        assert sup.poll() == []  # still inside the window
+        now[0] += WorkerSupervisor.RESPAWN_BACKOFF_S + 0.01
+        assert sup.poll() == [0]
+        assert sup.alive() == 2 and len(spawned) == 3
+
+        # Budget: after max_respawns the lane stays down.
+        for _ in range(2):
+            sup.kill(0)
+            sup.poll()  # arm
+            now[0] += WorkerSupervisor.RESPAWN_BACKOFF_MAX_S + 0.01
+            sup.poll()
+        rows = {r["shard"]: r for r in sup.debug()}
+        assert rows["s0"]["restarts"] == 2
+        assert rows["s0"]["alive"] is False
+        assert rows["s1"]["alive"] is True
+
+        sup.stop()
+        assert sup.alive() == 0
+        assert sup.poll() == []  # stopped: no respawns ever again
+
+
+def wait_for(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def assert_recovered_invariants(parent, capacity_by_node):
+    """The standing chaos invariants after recovery: zero staged
+    residue, no oversubscription, and per-gang all-or-nothing."""
+    assert parent.staged_count() == 0, parent.staged_uids()
+    for node, used in parent.chips_by_node().items():
+        cap = capacity_by_node.get(node, 0)
+        assert used <= cap, f"{node} oversubscribed: {used}/{cap}"
+
+
+@pytest.mark.slow
+class TestSigkillChaosSweep:
+    """kill -9 a worker with staged (and mid-commit) claims: the journal
+    replay + warm recovery leaves no residue, no oversubscription, no
+    split gangs — and the surviving / replacement workers keep going."""
+
+    def gang_claims(self, gang, node, members=2, chips=3):
+        return [
+            {
+                "uid": f"default/{gang}-{m}",
+                "node": node,
+                "chips": chips,
+                "gang": gang,
+            }
+            for m in range(members)
+        ]
+
+    def test_sigkill_at_staged_barrier_is_recovered_by_replay(
+        self, tmp_path
+    ):
+        _, parent = make_parent(hosts=2, chips=8, journal_dir=tmp_path)
+        srv = _Server(parent, expected_workers=2)
+        victim = survivor = None
+        try:
+            victim = DriveWorker(
+                srv.sock,
+                "s0",
+                self.gang_claims("ga", "host-0"),
+                tmpdir=str(tmp_path),
+            )
+            survivor = DriveWorker(
+                srv.sock,
+                "s1",
+                self.gang_claims("gb", "host-1"),
+                tmpdir=str(tmp_path),
+            )
+            victim.wait_staged()
+            survivor.wait_staged()
+            assert parent.staged_count() == 4
+            # kill -9 the victim AT the staged barrier: its gang's
+            # staged claims are now residue only the journal knows how
+            # to attribute.
+            victim.sigkill()
+            # The survivor's commit is untouched by the victim's death.
+            ok, why = survivor.commit()
+            assert ok, why
+            assert parent.chips_in_use("host-1") == 6
+            survivor.exit()
+        finally:
+            if victim is not None:
+                victim.close()
+            if survivor is not None:
+                survivor.close()
+            srv.close()
+        parent.journal.close()
+
+        # --- recovery: replay the journal into a fresh parent (the
+        # promoted-standby path) and run the staged-residue warm sweep
+        # the reconciler runs: residue of gangs with zero committed
+        # members rolls back whole (no split gangs).
+        _, standby = make_parent(hosts=2, chips=8, journal_dir=tmp_path)
+        assert standby.staged_count() == 2  # the victim's residue
+        assert standby.chips_in_use("host-1") == 6  # survivor's commit
+        for uid, _lane in sorted(standby.staged_uids().items()):
+            standby.release(uid)  # rollback path: staged -> B record
+        assert_recovered_invariants(
+            standby, {"host-0": 8, "host-1": 8}
+        )
+        assert standby.chips_in_use("host-0") == 0  # whole gang gone
+        assert standby.chips_in_use("host-1") == 6  # commit survived
+        standby.journal.close()
+
+        # The rollbacks are themselves journaled: one more replay shows
+        # a clean log — recovery is idempotent across a second crash.
+        state = FileJournal(str(tmp_path)).open()
+        staged_left = [c for c in state.claims.values() if c[2]]
+        assert staged_left == []
+
+    def test_sigkill_mid_commit_with_the_gate_held(self, tmp_path):
+        # The worst window: the worker dies INSIDE commit_staged —
+        # after the RPC reached the parent, before the reply. The
+        # parent holds the commit gate closed to pin the worker there.
+        _, parent = make_parent(hosts=1, chips=8, journal_dir=tmp_path)
+        srv = _Server(parent)
+        w = None
+        try:
+            w = DriveWorker(
+                srv.sock,
+                "s0",
+                self.gang_claims("ga", "host-0"),
+                tmpdir=str(tmp_path),
+            )
+            w.wait_staged()
+            parent.hold_commits()
+            w.send_commit()  # child blocks inside the RPC at the gate
+            time.sleep(0.3)  # let the request reach the gate
+            w.sigkill()
+            parent.resume_commits()
+            # The parent's commit proceeds (first-staged-wins validation
+            # doesn't care that the caller died); the reply hits a dead
+            # socket, which the server absorbs.
+            wait_for(
+                lambda: parent.staged_count() == 0,
+                what="commit to land after gate resume",
+            )
+            assert parent.chips_in_use("host-0") == 6
+        finally:
+            if w is not None:
+                w.close()
+            srv.close()
+        parent.journal.close()
+
+        # Replay: the commit is durable — the claims are committed
+        # (shard cleared), chips charged exactly once. A replacement
+        # worker on the same lane warm-starts against this state and
+        # keeps committing.
+        _, standby = make_parent(hosts=1, chips=8, journal_dir=tmp_path)
+        assert standby.staged_count() == 0
+        assert standby.chips_in_use("host-0") == 6
+        srv2 = _Server(standby)
+        try:
+            replacement = DriveWorker(
+                srv2.sock,
+                "s0",
+                [
+                    {
+                        "uid": "default/gc-0",
+                        "node": "host-0",
+                        "chips": 2,
+                        "gang": "gc",
+                    }
+                ],
+                tmpdir=str(tmp_path),
+            )
+            replacement.wait_staged()
+            ok, why = replacement.commit()
+            assert ok, why
+            assert standby.chips_in_use("host-0") == 8
+            # And over-capacity stays refused: the recovered state is
+            # really enforcing first-staged-wins against the replayed
+            # claims.
+            cl = srv2.client("s1")
+            cl.stage("default/over", "host-0", 4, "s1")
+            ok2, why2 = cl.commit(["default/over"])
+            assert not ok2 and "capacity" in why2
+            cl.release("default/over")  # the caller's rollback half
+            cl.close()
+            replacement.exit()
+        finally:
+            srv2.close()
+        standby.journal.close()
+        assert_recovered_invariants(standby, {"host-0": 8})
+
+    def test_worker_respawn_warm_start_over_recovered_state(
+        self, tmp_path
+    ):
+        # Full loop: worker stages, dies; parent recovers the residue
+        # IN PLACE (same process — the reconciler warm path, not a
+        # restart); the supervisor-respawned worker re-stages the same
+        # gang and commits.
+        _, parent = make_parent(hosts=1, chips=8, journal_dir=tmp_path)
+        srv = _Server(parent)
+        procs = []
+
+        def spawn(i):
+            w = DriveWorker(
+                srv.sock,
+                "s0",
+                self.gang_claims("ga", "host-0"),
+                tmpdir=str(tmp_path),
+            )
+            procs.append(w)
+            return w.proc
+
+        now = [0.0]
+        sup = WorkerSupervisor(spawn, 1, clock=lambda: now[0])
+        try:
+            sup.start()
+            procs[0].wait_staged()
+            procs[0].sigkill()
+            # In-place recovery of the dead worker's residue (what the
+            # reconciler's staged-residue sweep does between respawns).
+            for uid, _lane in sorted(parent.staged_uids().items()):
+                parent.release(uid)
+            assert parent.staged_count() == 0
+            # Supervisor: arm backoff, elapse it, respawn.
+            sup.poll()
+            now[0] += WorkerSupervisor.RESPAWN_BACKOFF_S + 0.01
+            assert sup.poll() == [0]
+            assert len(procs) == 2
+            procs[1].wait_staged()
+            ok, why = procs[1].commit()
+            assert ok, why
+            assert parent.chips_in_use("host-0") == 6
+            assert {r["shard"]: r["restarts"] for r in sup.debug()} == {
+                "s0": 1
+            }
+            procs[1].exit()
+        finally:
+            sup.stop()
+            for w in procs:
+                w.close()
+            srv.close()
+        parent.journal.close()
+        assert_recovered_invariants(parent, {"host-0": 8})
+
+
+@pytest.mark.slow
+class TestSpecWorkerEndToEnd:
+    """One real spec worker process drains a pod set against its own
+    FakeCluster partition, committing through the parent — the exact
+    harness `bench.py --proc` and the smoke slice run."""
+
+    def test_spec_worker_drains_and_reports(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+
+        hosts = [{"name": "wh-0", "chips": 8}, {"name": "wh-1", "chips": 8}]
+        cluster = FakeCluster()
+        parent = ChipAccountant()
+        parent.track_capacity = True
+        cluster.add_watcher(parent.handle)
+        agent = FakeTpuAgent(cluster)
+        for h in hosts:
+            agent.add_host(h["name"], generation="v5e", chips=h["chips"])
+        agent.publish_all()
+
+        srv = _Server(parent, expected_workers=1, fence_fn=lambda: True)
+        try:
+            pods = [
+                {
+                    "name": f"g{g}-{m}",
+                    "labels": {
+                        "tpu/gang": f"g{g}",
+                        "tpu/gang-size": "2",
+                        "tpu/chips": "2",
+                    },
+                }
+                for g in range(3)
+                for m in range(2)
+            ]
+            spec = {
+                "socket": srv.sock,
+                "shard_index": 0,
+                "workers": 1,
+                "config": {"mode": "batch"},
+                "hosts": hosts,
+                "pods": pods,
+            }
+            spec_path = tmp_path / "w0.json"
+            spec_path.write_text(json.dumps(spec))
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "yoda_tpu.framework.procserve",
+                    "--serve-spec",
+                    str(spec_path),
+                ],
+                capture_output=True,
+                text=True,
+                timeout=240,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            report = srv.server.reports.get("s0")
+            assert report is not None
+            assert report["pods"] == 6
+            assert report["pods_per_s"] > 0
+            assert report["staged_residue"] == 0
+            assert report["commit_conflicts"] == 0
+            # Every commit went through the parent: its state matches
+            # the worker's final (all pods deleted -> all released).
+            assert parent.staged_count() == 0
+            assert all(
+                v == 0 for v in parent.chips_by_node().values()
+            ), parent.chips_by_node()
+            view = srv.server.debug()
+            assert view["workers"][0]["lane"] == "s0"
+        finally:
+            srv.close()

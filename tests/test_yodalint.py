@@ -901,6 +901,56 @@ class TestJournalDiscipline:
         })
         assert journal_discipline.run(project) == []
 
+    def test_commit_rpc_server_handlers_are_append_exempt(self, tmp_path):
+        # ISSUE 19: the commit RPC server fronts the accountant for
+        # shard worker processes — code lexically inside
+        # CommitRPCServer (framework/procserve.py) may reach the
+        # CommitLog write surface.
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/procserve.py": (
+                "class CommitRPCServer:\n"
+                "    def _op_commit(self, req):\n"
+                "        self.journal.record_commit(req['uids'])\n"
+                "        return {'ok': True}\n"
+            ),
+        })
+        assert journal_discipline.run(project) == []
+
+    def test_rpc_exemption_is_class_scoped_not_module_scoped(self, tmp_path):
+        # Planted violation: a journal append in procserve.py OUTSIDE
+        # the CommitRPCServer class (the RPC client, a worker entry) is
+        # a second writer running outside the accountant's lock — still
+        # a finding.
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/procserve.py": (
+                "class CommitRPCServer:\n"
+                "    def _op_commit(self, req):\n"
+                "        return {'ok': True}\n"
+                "class CommitRPCClient:\n"
+                "    def commit(self, journal, uids):\n"
+                "        journal.record_commit(uids)\n"
+            ),
+        })
+        findings = journal_discipline.run(project)
+        assert any(
+            "record_commit" in f.message and f.line == 6 for f in findings
+        ), findings
+
+    def test_rpc_class_name_elsewhere_grants_nothing(self, tmp_path):
+        # The exemption is (module, class) — a CommitRPCServer class in
+        # any OTHER module gets no append rights.
+        project = make_project(tmp_path, {
+            "yoda_tpu/framework/other.py": (
+                "class CommitRPCServer:\n"
+                "    def _op_commit(self, req, journal):\n"
+                "        journal.record_commit(req['uids'])\n"
+            ),
+        })
+        findings = journal_discipline.run(project)
+        assert any(
+            "record_commit" in f.message and f.line == 3 for f in findings
+        ), findings
+
 
 class TestSuppressions:
     def test_suppression_with_reason_silences_the_pass(self, tmp_path):

@@ -15,6 +15,16 @@ records that do not correspond to accountant mutations — replay then
 rebuilds state the process never held, and the standby inherits phantom
 claims.
 
+One scoped exception to A (ISSUE 19): the commit RPC server —
+``class CommitRPCServer`` in ``yoda_tpu/framework/procserve.py`` — is
+the parent-side front of the accountant for ``shard_mode=process``
+workers, and its handlers are the only non-accountant path allowed to
+reach the CommitLog write surface. The exemption is CLASS-scoped, not
+module-scoped: the RPC *client*, the worker entries, and anything else
+in procserve.py that touched the journal directly would be a second
+writer running OUTSIDE the accountant's lock, exactly the split-log
+hazard rule A exists for.
+
 **B. Claim-state monopoly.** No module outside ``accounting.py`` may
 touch the accountant's claim-state attributes (``_claims`` / ``_in_use``
 / ``_staged`` / ``_stage_seq``) on a non-``self`` receiver. An external
@@ -54,22 +64,50 @@ APPEND_EXEMPT = ("yoda_tpu/journal/", "plugins/yoda/accounting.py")
 
 STATE_OWNER_SUFFIX = "plugins/yoda/accounting.py"
 
+#: Class-scoped append exemption (ISSUE 19): inside THIS module, only
+#: code lexically within THIS class may reach the write surface — the
+#: commit RPC server fronts the accountant for worker processes; the
+#: client and the worker entries in the same file stay forbidden.
+RPC_SERVER_MODULE_SUFFIX = "framework/procserve.py"
+RPC_SERVER_CLASS = "CommitRPCServer"
+
 
 def _exempt_from_append(rel: str) -> bool:
     return any(part in rel for part in APPEND_EXEMPT)
+
+
+def _rpc_server_spans(tree) -> "list[tuple[int, int]]":
+    """Line spans of ``class CommitRPCServer`` definitions (top level or
+    nested) — the only lexical scope in procserve.py with append
+    rights."""
+    return [
+        (node.lineno, node.end_lineno or node.lineno)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ClassDef) and node.name == RPC_SERVER_CLASS
+    ]
 
 
 def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
     findings: "list[Finding]" = []
     for module in project.modules:
         rel = module.relpath
+        rpc_spans = (
+            _rpc_server_spans(module.tree)
+            if rel.endswith(RPC_SERVER_MODULE_SUFFIX)
+            else []
+        )
         for node in walk_cached(module.tree):
-            # Rule A: journal appends outside the journal/accountant.
+            # Rule A: journal appends outside the journal/accountant —
+            # with the one class-scoped exception: CommitRPCServer
+            # handlers in framework/procserve.py.
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr in RECORD_METHODS
                 and not _exempt_from_append(rel)
+                and not any(
+                    lo <= node.lineno <= hi for lo, hi in rpc_spans
+                )
             ):
                 findings.append(
                     Finding(

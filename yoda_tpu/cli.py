@@ -179,6 +179,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     from yoda_tpu.metrics_server import MetricsServer
     from yoda_tpu.standalone import (
         build_federation,
+        build_proc_parent,
         build_profile_stacks,
         build_sharded_stacks,
     )
@@ -189,6 +190,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
     clusters = [cluster]
     federation = None
     shard_set = None
+    proc_server = None
     if args.federate_url:
         # Federated multi-cluster mode: the env-configured cluster is the
         # HOME front; each --federate-url NAME=URL adds a secondary
@@ -229,6 +231,74 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             [("home", cluster), *remotes], config, stop_event=stop
         )
         stacks = [m.stack for m in federation.members]
+    elif config.shard_count > 1 and config.shard_mode == "process":
+        # Multi-process shard serve (ISSUE 19): THIS process is the
+        # control plane — global lane, journal-owning accountant,
+        # repair loops, metrics. Each shard lane is a supervised worker
+        # process (framework/procserve.py) with its own informer/queue/
+        # BindExecutor, reaching the commit point through the local
+        # commit RPC socket; workers fence on leadership AND parent
+        # liveness, so they may start (and warm their caches) now.
+        import subprocess
+        import tempfile
+
+        from yoda_tpu.framework.procserve import CommitRPCServer
+        from yoda_tpu.framework.shards import WorkerSupervisor
+
+        shard_set = build_proc_parent(cluster, config, stop_event=stop)
+        stacks = shard_set.stacks
+        sock_path = os.path.join(
+            tempfile.gettempdir(), f"yoda-commit-{os.getpid()}.sock"
+        )
+
+        def _worker_serve() -> bool:
+            # The heartbeat verdict workers fence on: the composed
+            # leadership + resync gate (shard_fence_fn is swapped in
+            # below, before any worker can pass resync). Fail-closed
+            # while unset or stopping.
+            fence = shard_set.shard_fence_fn
+            return (
+                not stop.is_set()
+                and fence is not None
+                and bool(fence())
+            )
+
+        proc_server = CommitRPCServer(
+            shard_set.accountant,
+            sock_path,
+            metrics=shard_set.metrics,
+            fence_fn=_worker_serve,
+            expected_workers=config.shard_count,
+        )
+        proc_server.start()
+
+        def _spawn_worker(i: int):
+            cmd = [
+                sys.executable,
+                "-m",
+                "yoda_tpu.framework.procserve",
+                "--socket",
+                sock_path,
+                "--shard-index",
+                str(i),
+                "--shard-count",
+                str(config.shard_count),
+                "--jax-platform",
+                args.jax_platform,
+            ]
+            if args.config:
+                cmd += ["--config", args.config]
+            return subprocess.Popen(cmd)
+
+        shard_set.supervisor = WorkerSupervisor(
+            _spawn_worker, config.shard_count
+        )
+        shard_set.supervisor.start()
+        print(
+            f"yoda-tpu-scheduler: shard_mode=process — "
+            f"{config.shard_count} worker processes over {sock_path}",
+            file=sys.stderr,
+        )
     elif config.shard_count > 1:
         # Scheduler shard-out: N parallel serve loops over rendezvous-
         # partitioned slices/pools + the serialized global lane
@@ -271,6 +341,67 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             return stacks[0].reconciler.resynced.is_set()
         return all(st.reconciler.resynced.is_set() for st in stacks)
 
+    # /debug/shards: the shard-lane process view. Process mode serves
+    # the commit RPC server's worker registry (heartbeat-fed) merged
+    # with the supervisor's liveness/restart rows; thread mode reports
+    # the in-process lanes under the shared pid; unsharded/federated
+    # mode reports {"enabled": false}.
+    shards_fn = None
+    if proc_server is not None:
+        def _proc_shards_view(ps=proc_server, ss=shard_set) -> dict:
+            view = ps.debug()
+            sup = (
+                {r["shard"]: r for r in ss.supervisor.debug()}
+                if ss.supervisor is not None
+                else {}
+            )
+            known = set()
+            for row in view["workers"]:
+                known.add(row["lane"])
+                s = sup.get(row["lane"])
+                if s is not None:
+                    row["alive"] = s["alive"]
+                    row["restarts"] = s["restarts"]
+            for lane in sorted(set(sup) - known):
+                # Spawned but never said hello (still importing, or
+                # died pre-handshake): the supervisor row is all we
+                # have, and hiding it would hide the crash loop.
+                s = sup[lane]
+                view["workers"].append(
+                    {
+                        "lane": lane,
+                        "pid": s["pid"],
+                        "alive": s["alive"],
+                        "restarts": s["restarts"],
+                        "heartbeat_age_s": None,
+                        "staged": 0,
+                    }
+                )
+            view["workers"].sort(key=lambda r: r["lane"])
+            return view
+
+        shards_fn = _proc_shards_view
+    elif shard_set is not None:
+        def _thread_shards_view(ss=shard_set) -> dict:
+            staged_by_lane: dict = {}
+            for _uid, lane in ss.accountant.staged_uids().items():
+                staged_by_lane[lane] = staged_by_lane.get(lane, 0) + 1
+            rows = [
+                {
+                    "lane": st.scheduler.shard,
+                    "pid": os.getpid(),
+                    "alive": True,
+                    "queue_depth": len(st.queue),
+                    "cycles": len(st.scheduler.stats.results),
+                    "binds": st.scheduler.stats.binds,
+                    "staged": staged_by_lane.get(st.scheduler.shard, 0),
+                }
+                for st in ss.stacks[1:]
+            ]
+            return {"enabled": True, "mode": "thread", "workers": rows}
+
+        shards_fn = _thread_shards_view
+
     metrics_srv = None
     if args.metrics_port >= 0:
         metrics_srv = MetricsServer(
@@ -280,6 +411,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             # /debug/journal: the durable claim journal summary (None =
             # journal_path unset, served as {"enabled": false}).
             journal_fn=lambda: getattr(stack.accountant, "journal", None),
+            shards_fn=shards_fn,
         )
         metrics_srv.start()
         print(f"metrics on :{metrics_srv.port}/metrics", file=sys.stderr)
@@ -534,7 +666,10 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                         n, start_fn=_start_resized_shard
                     )
                 )
-                if shard_set is not None
+                # Process mode: lanes are OS processes, not stacks a
+                # live resize can build — shard_count changes report as
+                # requires-drain like any other topology change.
+                if shard_set is not None and proc_server is None
                 else None
             ),
         )
@@ -565,6 +700,14 @@ def _run_scheduler(args, stop: threading.Event) -> int:
         for t in extra_threads:
             t.join(timeout=10)
     finally:
+        # Process mode: workers first (SIGTERM, wait, SIGKILL), then the
+        # RPC server — a worker mid-commit gets its reply or a clean
+        # socket death, never a half-written frame; any staged residue
+        # is the journal's to recover on the next start.
+        if shard_set is not None and shard_set.supervisor is not None:
+            shard_set.supervisor.stop()
+        if proc_server is not None:
+            proc_server.stop()
         for st in stacks:
             # Release the bind-pipeline executor without waiting on a
             # possibly stalled bind round-trip (GangPlugin.close sets the
@@ -581,6 +724,16 @@ def _run_scheduler(args, stop: threading.Event) -> int:
             # Drain pending Scheduled/FailedScheduling/Preempted events so a
             # SIGTERM right after a decision doesn't lose its trail.
             stack.events.close(timeout_s=5.0)
+        # Graceful journal close AFTER every bind pipeline stopped: under
+        # journal_sync=batch this flushes + fsyncs the pending tail
+        # frames, so a clean shutdown never drops staged/commit records
+        # a crash would have recovered from the previous fsync.
+        seen_journals = set()
+        for st in stacks:
+            j = getattr(st.accountant, "journal", None)
+            if j is not None and id(j) not in seen_journals:
+                seen_journals.add(id(j))
+                j.close()
         if metrics_srv is not None:
             metrics_srv.stop()
         if elector_thread is not None:

@@ -460,6 +460,15 @@ class SchedulerConfig:
     # machinery entirely off. Incompatible with `profiles` (each shard
     # serves the base profile) and with federated mode.
     shard_count: int = 1
+    # How the shard serve loops are hosted (ISSUE 19, OPERATIONS.md
+    # "Multi-process shard serve"): "thread" (default) runs all lanes
+    # in one interpreter — the PR-14 shape, byte-identical behavior;
+    # "process" runs each shard lane as its own OS process (GIL-free
+    # bind pipelines) reaching the parent's journal-owning accountant
+    # through the local commit RPC (framework/procserve.py). Ignored
+    # when shard_count == 1. Requires-drain: changing the process
+    # topology of a live scheduler means a restart.
+    shard_mode: str = "thread"
     # Additional profiles (upstream KubeSchedulerConfiguration profiles):
     # each entry inherits every unspecified key from the base config and
     # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
@@ -932,6 +941,11 @@ class SchedulerConfig:
             raise ValueError(
                 "shard_count > 1 is incompatible with profiles (every "
                 "shard serves the base profile; run profiles unsharded)"
+            )
+        if cfg.shard_mode not in ("thread", "process"):
+            raise ValueError(
+                "shard_mode must be 'thread' or 'process', got "
+                f"{cfg.shard_mode!r}"
             )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)

@@ -1,0 +1,791 @@
+"""Multi-process shard serve: GIL-free scheduling over the durable
+claim journal (ISSUE 19).
+
+PR 14's shard-out runs N serve loops as THREADS in one interpreter, so
+aggregate throughput saturates against the GIL once binds stop being
+I/O. This module splits the lanes across OS processes while keeping the
+crash-consistency story of the durable claim journal (PR 18) intact:
+
+- The PARENT control-plane process keeps the global lane, the
+  journal-owning ChipAccountant (the single CommitLog writer), the
+  reconciler/rebalancer/nodehealth loops, and the metrics server.
+- Each SHARD WORKER process runs its own informer/queue/BindExecutor
+  serve loop over its rendezvous partition (a pure function of
+  ``shard_count`` — workers compute routing independently, zero
+  coordination) and reaches the commit point through the thin commit
+  RPC below: stage-at-Reserve, first-staged-wins ``commit``,
+  rollback/release. Every decision is journaled by the parent before it
+  applies, so a ``kill -9``'d worker's staged residue is recovered by
+  journal replay plus the reconciler's warm path, and a replacement
+  worker warm-starts exactly like a promoted standby.
+
+Wire protocol: newline-delimited JSON over a local Unix domain socket —
+one request line, one response line, one persistent connection per
+worker (the serve loop's stage/commit calls serialize on it, which is
+the ordering the optimistic protocol wants anyway). The parent handles
+each connection on its own daemon thread; handler work is a dict probe
+plus one accountant call, so the socket — not the GIL — is the only
+serialization point workers share.
+
+Fencing: a worker binds only while :class:`WorkerFence` says so —
+leadership/resync verdict shipped back on every heartbeat AND parent
+liveness (heartbeat freshness + a ``getppid`` re-parent check), so
+orphaned workers stop binding even when the parent dies without a
+word. Fail-closed: a worker that cannot hear the parent is fenced.
+
+The yodalint ``journal-discipline`` pass recognizes exactly one
+non-accountant module on the commit path: :class:`CommitRPCServer`'s
+handlers in this file. Everything else — the client, the worker
+entries — must go through the accountant's public surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+
+class CommitRPCError(RuntimeError):
+    """A commit RPC failed (socket death, parent refusal, or a handler
+    error). Callers treat it as a refused decision — never as state."""
+
+
+def _encode(msg: dict) -> bytes:
+    return json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+
+
+class CommitRPCServer:
+    """Parent-side commit RPC endpoint wrapping the journal-owning
+    accountant. One daemon accept thread + one daemon handler thread
+    per worker connection; every handler is a dict probe plus one
+    accountant call (which journals write-ahead under its own lock).
+
+    Also the parent's worker registry: heartbeats carry each worker's
+    pid/queue-depth/cycle/bind snapshot, and ``debug()`` serves the
+    ``/debug/shards`` process view (pid, lane, last-heartbeat, staged
+    count). ``fence_fn`` is the parent's serve fence — leadership AND
+    warm-start resync — refusing commits while fenced and echoed to
+    workers on every heartbeat, so workers fence on it too.
+    """
+
+    def __init__(
+        self,
+        accountant,
+        socket_path: str,
+        *,
+        metrics=None,
+        fence_fn=None,
+        expected_workers: int = 0,
+        clock=time.monotonic,
+    ) -> None:
+        self.accountant = accountant
+        self.socket_path = socket_path
+        self.metrics = metrics
+        self.fence_fn = fence_fn
+        self.expected_workers = int(expected_workers)
+        self.clock = clock
+        self.workers: dict[str, dict] = {}   # lane -> registry row
+        self.reports: dict[str, dict] = {}   # lane -> shipped result
+        self._lock = threading.Lock()
+        self._listener: "socket.socket | None" = None
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._stopping = False
+        # Start-line barrier (bench/test synchronization): workers park
+        # here until every expected worker arrives, so process startup
+        # skew never pollutes a timed drain.
+        self._barrier_cond = threading.Condition()
+        self._barrier_counts: dict[str, int] = {}
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(64)
+        t = threading.Thread(
+            target=self._accept_loop, name="commit-rpc-accept", daemon=True
+        )
+        self._threads.append(t)
+        t.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        with self._barrier_cond:
+            self._barrier_cond.notify_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutdown
+            with self._lock:
+                self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn,
+                args=(conn,),
+                name="commit-rpc-conn",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        try:
+            for line in rfile:
+                if self._stopping:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    req = json.loads(line)
+                    resp = self._dispatch(req)
+                except Exception as e:  # noqa: BLE001 — a bad request must not kill the conn
+                    req = {}
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                op = str(req.get("op", "?"))
+                lane = str(req.get("shard", ""))
+                if self.metrics is not None:
+                    self.metrics.commit_rpc_calls.inc(op=op, shard=lane)
+                    self.metrics.commit_rpc_latency.observe(
+                        (time.perf_counter() - t0) * 1e3, op=op
+                    )
+                try:
+                    conn.sendall(_encode(resp))
+                except OSError:
+                    return  # worker died mid-reply: its residue is journaled
+        finally:
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    # --- dispatch ---
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        lane = str(req.get("shard", ""))
+        if op == "stage":
+            seq = self.accountant.stage(
+                req["uid"],
+                req["node"],
+                int(req["chips"]),
+                lane or req.get("lane", ""),
+                req.get("gang", ""),
+            )
+            return {"ok": True, "seq": seq}
+        if op == "commit":
+            # The parent's own leader fence gates the commit point: a
+            # fenced ex-leader's accountant must not validate placements
+            # the new leader no longer backs (the worker additionally
+            # fences itself on the heartbeat verdict, but that check is
+            # advisory-latency — THIS one is authoritative).
+            if self.fence_fn is not None and not bool(self.fence_fn()):
+                return {
+                    "ok": True,
+                    "committed": False,
+                    "why": "parent fenced (not leading or not resynced)",
+                }
+            committed, why = self.accountant.commit_staged(
+                list(req.get("uids", ()))
+            )
+            if not committed and self.metrics is not None:
+                self.metrics.commit_rpc_conflicts.inc(shard=lane)
+            return {"ok": True, "committed": committed, "why": why}
+        if op == "release":
+            # The parent decides rollback-vs-release from its OWN
+            # (authoritative, journal-backed) claim state.
+            self.accountant.release(req["uid"])
+            return {"ok": True}
+        if op == "residue":
+            return {
+                "ok": True,
+                "found": self.accountant.commit_residue(req["uid"]),
+            }
+        if op == "hello":
+            self._note_worker(lane, req, hello=True)
+            return {"ok": True}
+        if op == "heartbeat":
+            self._note_worker(lane, req)
+            serve = True if self.fence_fn is None else bool(self.fence_fn())
+            return {"ok": True, "serve": serve}
+        if op == "report":
+            with self._lock:
+                self.reports[lane] = dict(req.get("result") or {})
+            return {"ok": True}
+        if op == "barrier":
+            return self._op_barrier(req)
+        if op == "debug":
+            return {"ok": True, "workers": self.debug()["workers"]}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _note_worker(self, lane: str, req: dict, *, hello: bool = False) -> None:
+        now = self.clock()
+        with self._lock:
+            row = self.workers.setdefault(lane, {"lane": lane})
+            row["pid"] = int(req.get("pid", row.get("pid", 0)))
+            row["last_heartbeat"] = now
+            if hello:
+                row["connected_at"] = now
+            for k in ("queue_depth", "cycles", "binds", "staged"):
+                if k in req:
+                    row[k] = int(req[k])
+
+    def _op_barrier(self, req: dict) -> dict:
+        name = str(req.get("name", "default"))
+        deadline = time.monotonic() + float(req.get("timeout_s", 120.0))
+        need = max(int(req.get("expected", self.expected_workers)), 1)
+        with self._barrier_cond:
+            self._barrier_counts[name] = (
+                self._barrier_counts.get(name, 0) + 1
+            )
+            self._barrier_cond.notify_all()
+            while self._barrier_counts[name] < need and not self._stopping:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {
+                        "ok": False,
+                        "error": (
+                            f"barrier {name!r}: "
+                            f"{self._barrier_counts[name]}/{need} arrived"
+                        ),
+                    }
+                self._barrier_cond.wait(remaining)
+        return {"ok": True}
+
+    # --- introspection (GET /debug/shards) ---
+
+    def debug(self) -> dict:
+        """The process view: one row per worker lane — pid, lane,
+        seconds since the last heartbeat, the worker's last serve-loop
+        snapshot, and the parent accountant's live staged count for the
+        lane (counted HERE, not trusted from the heartbeat: the staged
+        residue of a dead worker must stay visible)."""
+        staged_by_lane: dict[str, int] = {}
+        for _uid, lane in self.accountant.staged_uids().items():
+            staged_by_lane[lane] = staged_by_lane.get(lane, 0) + 1
+        now = self.clock()
+        with self._lock:
+            rows = []
+            for lane, row in sorted(self.workers.items()):
+                hb = row.get("last_heartbeat")
+                rows.append(
+                    {
+                        "lane": lane,
+                        "pid": row.get("pid", 0),
+                        "heartbeat_age_s": (
+                            round(now - hb, 3) if hb is not None else None
+                        ),
+                        "queue_depth": row.get("queue_depth", 0),
+                        "cycles": row.get("cycles", 0),
+                        "binds": row.get("binds", 0),
+                        "staged": staged_by_lane.get(lane, 0),
+                    }
+                )
+        return {"enabled": True, "mode": "process", "workers": rows}
+
+
+class CommitRPCClient:
+    """Worker-side commit RPC client: one persistent connection, one
+    request in flight (the serve loop's decisions serialize on the
+    lane anyway). Reconnects lazily after a socket death — the parent
+    respawning is indistinguishable from a blip — and raises
+    :class:`CommitRPCError` when the parent cannot be reached, which
+    every caller treats as a refused decision."""
+
+    def __init__(
+        self, socket_path: str, *, shard: str = "", timeout_s: float = 10.0
+    ) -> None:
+        self.socket_path = socket_path
+        self.shard = shard
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sock: "socket.socket | None" = None
+        self._rfile = None
+
+    def _connect_locked(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout_s)
+        s.connect(self.socket_path)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+
+    def _drop_locked(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+            self._rfile = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, op: str, **fields) -> dict:
+        req = {"op": op, "shard": self.shard}
+        req.update(fields)
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect_locked()
+                self._sock.sendall(_encode(req))
+                line = self._rfile.readline()
+            except OSError as e:
+                self._drop_locked()
+                raise CommitRPCError(f"commit rpc {op}: {e}") from e
+            if not line:
+                self._drop_locked()
+                raise CommitRPCError(
+                    f"commit rpc {op}: connection closed by parent"
+                )
+        try:
+            resp = json.loads(line)
+        except ValueError as e:
+            raise CommitRPCError(f"commit rpc {op}: bad reply") from e
+        if not resp.get("ok"):
+            raise CommitRPCError(
+                f"commit rpc {op}: {resp.get('error', 'refused')}"
+            )
+        return resp
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_locked()
+
+    # --- the RemoteAccountant collaborator surface ---
+
+    def stage(self, uid, node, chips, shard, gang="") -> int:
+        return int(
+            self.call(
+                "stage", uid=uid, node=node, chips=int(chips),
+                shard=shard, gang=gang,
+            )["seq"]
+        )
+
+    def commit(self, uids) -> "tuple[bool, str]":
+        resp = self.call("commit", uids=list(uids))
+        return bool(resp["committed"]), str(resp.get("why", ""))
+
+    def release(self, uid) -> None:
+        self.call("release", uid=uid)
+
+    def residue(self, uid) -> bool:
+        return bool(self.call("residue", uid=uid)["found"])
+
+    # --- worker lifecycle surface ---
+
+    def hello(self, pid: "int | None" = None) -> None:
+        self.call("hello", pid=pid if pid is not None else os.getpid())
+
+    def heartbeat(self, info: "dict | None" = None) -> bool:
+        return bool(
+            self.call("heartbeat", pid=os.getpid(), **(info or {}))["serve"]
+        )
+
+    def barrier(self, name: str = "default", *, timeout_s: float = 120.0,
+                expected: "int | None" = None) -> None:
+        fields = {"name": name, "timeout_s": timeout_s}
+        if expected is not None:
+            fields["expected"] = expected
+        self.call("barrier", **fields)
+
+    def report(self, result: dict) -> None:
+        self.call("report", result=result)
+
+
+class WorkerFence:
+    """Per-worker serve fence: leadership AND parent liveness.
+
+    ``serving()`` — wired as the worker scheduler's ``fence_fn`` — is
+    True only while ALL hold:
+
+    - the parent's last heartbeat verdict said serve (leadership held
+      and the global warm-start resync complete),
+    - that verdict is FRESH (within ``liveness_s`` — a worker that
+      cannot hear the parent is fenced, fail-closed), and
+    - the parent process is still our parent (``getppid`` unchanged; a
+      dead parent re-parents us, and an orphaned worker must stop
+      binding even though its socket may linger).
+
+    The heartbeat loop runs on its own daemon thread and ships the
+    worker's serve-loop snapshot (``info_fn``) for ``/debug/shards``.
+    ``on_orphaned`` (optional) fires once when the parent is detected
+    gone — production workers use it to exit instead of idling fenced.
+    """
+
+    def __init__(
+        self,
+        client: CommitRPCClient,
+        *,
+        shard: str,
+        liveness_s: float = 3.0,
+        period_s: float = 0.5,
+        info_fn=None,
+        on_orphaned=None,
+        clock=time.monotonic,
+    ) -> None:
+        self.client = client
+        self.shard = shard
+        self.liveness_s = liveness_s
+        self.period_s = period_s
+        self.info_fn = info_fn
+        self.on_orphaned = on_orphaned
+        self.clock = clock
+        self._ppid = os.getppid()
+        self._last_ok: "float | None" = None
+        self._serve = False
+        self._orphaned = False
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name=f"worker-fence-{self.shard}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self.period_s)
+
+    def beat(self) -> None:
+        """One heartbeat round-trip (the loop's body; tests drive it
+        directly)."""
+        if os.getppid() != self._ppid:
+            self._orphaned = True
+            self._serve = False
+            if self.on_orphaned is not None:
+                cb, self.on_orphaned = self.on_orphaned, None
+                cb()
+            return
+        info = {}
+        if self.info_fn is not None:
+            try:
+                info = self.info_fn()
+            except Exception:  # noqa: BLE001 — a sick snapshot must not stop heartbeats
+                info = {}
+        try:
+            self._serve = self.client.heartbeat(info)
+            self._last_ok = self.clock()
+        except CommitRPCError:
+            # Leave _last_ok as-is: staleness fences after liveness_s.
+            pass
+
+    def serving(self) -> bool:
+        if self._orphaned or os.getppid() != self._ppid:
+            return False
+        if not self._serve or self._last_ok is None:
+            return False
+        return (self.clock() - self._last_ok) <= self.liveness_s
+
+
+# --- worker process entries ---
+
+
+def _worker_info_fn(stack):
+    def info() -> dict:
+        return {
+            "queue_depth": len(stack.queue),
+            "cycles": len(stack.scheduler.stats.results),
+            "binds": stack.scheduler.stats.binds,
+            "staged": stack.accountant.staged_count(),
+        }
+
+    return info
+
+
+def _build_worker_stack(cluster, config, client, lane, *, stop_event=None):
+    """One shard stack around a RemoteAccountant — the worker-process
+    analog of one build_sharded_stacks lane. The accountant's watcher
+    registers BEFORE build_stack's informer (the build_sharded_stacks
+    discipline: reservation releases precede the informer's view of the
+    same event)."""
+    from yoda_tpu.plugins.yoda.accounting import RemoteAccountant
+    from yoda_tpu.standalone import build_stack
+
+    accountant = RemoteAccountant(
+        client, scheduler_name=config.scheduler_name
+    )
+    cluster.add_watcher(accountant.handle)
+    stack = build_stack(
+        cluster=cluster,
+        config=config,
+        accountant=accountant,
+        stop_event=stop_event,
+        shard=lane,
+    )
+    return stack
+
+
+def _run_spec_worker(spec: dict) -> int:
+    """Bench/test worker: build a private FakeCluster fleet from the
+    spec (the parent pre-partitioned hosts and pre-routed pods — the
+    rendezvous map is a pure function, so the split is exactly what the
+    in-process router would compute), drain a warmup round, park at the
+    start barrier until every worker is built, then drain the timed
+    round and ship the measurements back over the RPC."""
+    from yoda_tpu.agent import FakeTpuAgent
+    from yoda_tpu.api.types import PodSpec
+    from yoda_tpu.cluster.fake import FakeCluster
+    from yoda_tpu.config import SchedulerConfig
+    from yoda_tpu.framework.shards import shard_name
+
+    lane = shard_name(int(spec.get("shard_index", 0)))
+    client = CommitRPCClient(spec["socket"], shard=lane)
+    client.hello()
+    config = SchedulerConfig.from_dict(dict(spec.get("config") or {}))
+    cluster = FakeCluster(
+        bind_latency_s=float(spec.get("bind_latency_s", 0.0))
+    )
+    stack = _build_worker_stack(cluster, config, client, lane)
+    agent = FakeTpuAgent(cluster)
+    for h in spec.get("hosts", ()):
+        agent.add_host(
+            h["name"],
+            generation=h.get("generation", "v5e"),
+            chips=int(h.get("chips", 8)),
+        )
+    agent.publish_all()
+
+    def make_pods(rows):
+        return [
+            PodSpec(p["name"], labels=dict(p.get("labels") or {}))
+            for p in rows
+        ]
+
+    def drain(pods, timeout_s=240.0) -> float:
+        for pod in pods:
+            cluster.create_pod(pod)
+        t0 = time.monotonic()
+        stack.scheduler.run_until_idle(max_wall_s=timeout_s)
+        dt = time.monotonic() - t0
+        bound = [p for p in cluster.list_pods() if p.node_name]
+        if len(bound) != len(pods):
+            raise RuntimeError(
+                f"{lane}: {len(bound)}/{len(pods)} bound"
+            )
+        for p in bound:
+            cluster.delete_pod(p.key)
+        stack.scheduler.run_until_idle(max_wall_s=30.0)
+        return dt
+
+    heartbeat_info = _worker_info_fn(stack)
+    warmup = make_pods(spec.get("warmup_pods", ()))
+    if warmup:
+        drain(warmup)
+    timed = make_pods(spec.get("pods", ()))
+    client.barrier(
+        "timed",
+        expected=spec.get("workers"),
+        timeout_s=float(spec.get("barrier_timeout_s", 300.0)),
+    )
+    wall_s = drain(timed)
+    slo = stack.metrics.slo.evaluate(time.monotonic())
+    client.report(
+        {
+            "lane": lane,
+            "pid": os.getpid(),
+            "pods": len(timed),
+            "wall_s": round(wall_s, 4),
+            "pods_per_s": round(len(timed) / wall_s, 2) if wall_s else 0.0,
+            "admission_p99_s": slo["fleet"]["admission_wait_p99_s"],
+            "commit_conflicts": stack.accountant.commit_conflicts,
+            "staged_residue": stack.accountant.staged_count(),
+            **heartbeat_info(),
+        }
+    )
+    stack.gang.close()
+    client.close()
+    return 0
+
+
+def _run_drive_worker(spec: dict) -> int:
+    """Scripted chaos driver: stage the spec'd claims over the RPC,
+    announce STAGED on stdout, then execute stdin commands (COMMIT /
+    RELEASE / EXIT) until told to stop. The chaos sweep SIGKILLs this
+    process at deterministic points — at the STAGED barrier, or
+    mid-commit while the parent holds the commit gate closed — to plant
+    staged residue whose recovery the test then proves."""
+    lane = spec["shard"]
+    client = CommitRPCClient(spec["socket"], shard=lane)
+    client.hello()
+    for c in spec.get("claims", ()):
+        client.stage(
+            c["uid"], c["node"], int(c["chips"]), lane, c.get("gang", "")
+        )
+    print("STAGED", flush=True)
+    for line in sys.stdin:
+        cmd = line.strip().split(" ", 1)
+        if not cmd[0]:
+            continue
+        if cmd[0] == "COMMIT":
+            uids = (
+                cmd[1].split(",")
+                if len(cmd) > 1
+                else [c["uid"] for c in spec.get("claims", ())]
+            )
+            try:
+                ok, why = client.commit(uids)
+            except CommitRPCError as e:
+                ok, why = False, str(e)
+            print(f"COMMITTED {int(ok)} {why}", flush=True)
+        elif cmd[0] == "RELEASE" and len(cmd) > 1:
+            client.release(cmd[1])
+            print("RELEASED", flush=True)
+        elif cmd[0] == "EXIT":
+            break
+    client.close()
+    return 0
+
+
+def _run_kube_worker(args) -> int:
+    """Production worker (spawned by cli.py under shard_mode=process):
+    one shard lane against the real API server, fenced on leadership
+    AND parent liveness. Exits when the parent dies (orphan fencing) or
+    on SIGTERM; staged residue either way is the parent's to recover
+    via journal replay + reconciliation."""
+    from yoda_tpu.cli import (
+        _build_kube_cluster,
+        _init_jax,
+        _install_stop_handlers,
+        _load_config,
+    )
+    from yoda_tpu.framework.shards import ShardMap, ShardRouter, shard_name
+
+    config = _load_config(args.config)
+    _init_jax(args.jax_platform)
+    idx = int(args.shard_index)
+    lane = shard_name(idx)
+    client = CommitRPCClient(args.socket, shard=lane)
+    client.hello()
+    stop = threading.Event()
+    _install_stop_handlers(stop)
+    cluster = _build_kube_cluster()
+    # The rendezvous map is a pure function of shard_count: this worker
+    # computes its partition + routing locally, no coordination.
+    shard_map = ShardMap(int(args.shard_count))
+    router = ShardRouter(shard_map)
+    cluster.add_watcher(router.observe, batch_fn=router.observe_batch)
+    from yoda_tpu.plugins.yoda.accounting import RemoteAccountant
+    from yoda_tpu.standalone import build_stack
+
+    accountant = RemoteAccountant(
+        client, scheduler_name=config.scheduler_name
+    )
+    cluster.add_watcher(accountant.handle)
+    stack = build_stack(
+        cluster=cluster,
+        config=config,
+        accountant=accountant,
+        stop_event=stop,
+        shard=lane,
+        node_filter_fn=shard_map.node_filter(idx),
+        pod_route_fn=lambda pod: router.route(pod) == lane,
+    )
+    fence = WorkerFence(
+        client,
+        shard=lane,
+        info_fn=_worker_info_fn(stack),
+        on_orphaned=stop.set,
+    )
+    stack.scheduler.fence_fn = fence.serving
+    fence.start()
+    print(
+        f"yoda-tpu-scheduler: shard worker {lane} serving "
+        f"(pid={os.getpid()})",
+        file=sys.stderr,
+    )
+    try:
+        stack.scheduler.serve_forever(stop)
+    finally:
+        fence.stop()
+        stack.gang.close()
+        if stack.ingestor is not None:
+            stack.ingestor.stop()
+        client.close()
+        cluster.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m yoda_tpu.framework.procserve",
+        description="yoda-tpu shard worker process (shard_mode=process)",
+    )
+    ap.add_argument(
+        "--serve-spec",
+        help="bench/test worker: JSON spec file (private FakeCluster "
+        "fleet, timed drain, result shipped over the commit RPC)",
+    )
+    ap.add_argument(
+        "--drive",
+        help="scripted chaos driver: JSON spec file (stage claims, then "
+        "execute stdin COMMIT/RELEASE/EXIT commands)",
+    )
+    ap.add_argument("--config", help="scheduler config YAML (kube worker)")
+    ap.add_argument("--socket", help="parent commit RPC socket path")
+    ap.add_argument("--shard-index", type=int, default=0)
+    ap.add_argument("--shard-count", type=int, default=1)
+    ap.add_argument("--jax-platform", default="cpu")
+    args = ap.parse_args(argv)
+    if args.serve_spec:
+        with open(args.serve_spec) as f:
+            return _run_spec_worker(json.load(f))
+    if args.drive:
+        with open(args.drive) as f:
+            return _run_drive_worker(json.load(f))
+    if not args.socket:
+        ap.error("--socket is required for a kube shard worker")
+    return _run_kube_worker(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -495,7 +495,27 @@ class FileJournal(CommitLog):
         }
 
     def close(self) -> None:
+        """Graceful close: under ``sync=batch`` up to ``_BATCH_EVERY-1``
+        appended frames may sit un-fsynced (flushed to the page cache
+        but not durable). A clean shutdown must not drop that tail —
+        flush + fsync pending frames before closing the segment. A dead
+        journal skips the sync (the fault already fail-stopped the
+        commit point); a sync failure here marks it dead rather than
+        raising, since close() runs on teardown paths that cannot
+        recover anyway."""
         with self._wlock:
             if self._fobj is not None:
+                if (
+                    not self._dead
+                    and self.sync == "batch"
+                    and self._since_sync > 0
+                ):
+                    try:
+                        self.io.flush(self._fobj)
+                        self.io.fsync(self._fobj)
+                        self.fsyncs += 1
+                        self._since_sync = 0
+                    except (JournalFault, OSError):
+                        self._dead = True
                 self._fobj.close()
                 self._fobj = None

@@ -46,6 +46,16 @@ endpoint is first-party and dependency-free (stdlib http.server):
                      ``{"enabled": false}`` when ``journal_path`` is
                      unset. Reading it is covered in the durability
                      runbook (docs/OPERATIONS.md).
+    GET /debug/shards -> the shard-lane process view via the wired
+                     ``shards_fn``: one row per worker lane — pid,
+                     lane, seconds since last heartbeat, queue depth,
+                     cycle/bind counters, and the parent accountant's
+                     live staged count (a dead worker's residue stays
+                     visible here until replay + reconciliation clears
+                     it). ``{"enabled": false}`` when sharding is off;
+                     thread mode reports the in-process lanes with the
+                     shared pid. Covered in the "Multi-process shard
+                     serve" runbook (docs/OPERATIONS.md).
 """
 
 from __future__ import annotations
@@ -71,6 +81,7 @@ class MetricsServer:
         port: int = 10259,
         ready_fn: "Callable[[], bool] | None" = None,
         journal_fn: "Callable[[], object] | None" = None,
+        shards_fn: "Callable[[], dict] | None" = None,
     ):
         self.metrics = metrics
         # None = no readiness concept wired (agent mode, tests): /readyz
@@ -81,6 +92,10 @@ class MetricsServer:
         # unset) — a callable, not a reference, because live resizes can
         # retire the stack that owned the journal at wiring time.
         self.journal_fn = journal_fn
+        # Returns the /debug/shards dict (CommitRPCServer.debug() in
+        # process mode; a lane summary closure in thread mode) — a
+        # callable for the same retire-on-resize reason as journal_fn.
+        self.shards_fn = shards_fn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -127,6 +142,14 @@ class MetricsServer:
                         else {"enabled": False}
                     )
                     body = json.dumps(summary, indent=1) + "\n"
+                    ctype = "application/json"
+                elif path == "/debug/shards":
+                    view = (
+                        outer.shards_fn()
+                        if outer.shards_fn is not None
+                        else {"enabled": False}
+                    )
+                    body = json.dumps(view, indent=1) + "\n"
                     ctype = "application/json"
                 elif path in ("/debug/pending", PENDING_PREFIX):
                     # No key: list EVERY currently-pending pod/gang key

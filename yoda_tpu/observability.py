@@ -584,6 +584,33 @@ class SchedulingMetrics:
             "transactional unbind path after a shard commit conflict "
             "(the losing shard requeues the gang whole)",
         )
+        # Multi-process shard serve (ISSUE 19, docs/OPERATIONS.md
+        # multi-process runbook): the commit RPC surface worker
+        # PROCESSES reach the journal-owning accountant through
+        # (framework/procserve.py). All three stay empty/zero under
+        # shard_mode=thread — in-process lanes call the accountant
+        # directly.
+        self.commit_rpc_calls = r.counter(
+            "yoda_commit_rpc_calls_total",
+            "Commit RPC requests handled by the parent control plane, "
+            "by op (stage/commit/release/residue/heartbeat) and worker "
+            "lane — the per-lane commit-path traffic of "
+            "shard_mode=process",
+        )
+        self.commit_rpc_conflicts = r.counter(
+            "yoda_commit_rpc_conflicts_total",
+            "Commit RPCs refused by first-staged-wins validation at the "
+            "parent accountant, by worker lane (the process-mode view "
+            "of yoda_shard_commit_conflicts_total)",
+        )
+        self.commit_rpc_latency = r.histogram(
+            "yoda_commit_rpc_latency_ms",
+            "Server-side wall milliseconds per commit RPC (decode -> "
+            "accountant -> journal fsync for commits -> reply); the "
+            "process-mode commit-point overhead a worker pays per "
+            "decision",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100),
+        )
         self.tenant_quota_parks = r.counter(
             "yoda_tenant_quota_parks_total",
             "Queue entries parked by per-tenant quota admission (they "

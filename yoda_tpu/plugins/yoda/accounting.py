@@ -190,6 +190,7 @@ class ChipAccountant(ReservePlugin):
         *,
         shard: "str | None" = None,
         gang: str = "",
+        seq: "int | None" = None,
     ) -> None:
         with self._lock:
             existing = self._claims.get(uid)
@@ -199,7 +200,8 @@ class ChipAccountant(ReservePlugin):
                 # event — only commit_staged (validation) or the
                 # reconciler's residue pass finalizes it.
                 return
-            seq = self._stage_seq + 1 if shard is not None else 0
+            if seq is None:
+                seq = self._stage_seq + 1 if shard is not None else 0
             if self.journal is not None:
                 # Write-ahead: the record is durable before the state
                 # moves; a crash between the two is repaired by the
@@ -210,7 +212,11 @@ class ChipAccountant(ReservePlugin):
                 self._note(existing.node)
                 self._staged.discard(uid)
             if shard is not None:
-                self._stage_seq = seq
+                # max(), not assignment: a RemoteAccountant mirror
+                # applies PARENT-assigned seqs, which may arrive after a
+                # later local observation (another worker staged in
+                # between at the parent).
+                self._stage_seq = max(self._stage_seq, seq)
                 self._staged.add(uid)
             self._claims[uid] = _Claim(
                 node, chips, shard=shard, seq=seq, gang=gang
@@ -238,6 +244,26 @@ class ChipAccountant(ReservePlugin):
                 self._note(claim.node)
 
     # --- optimistic claim -> validate -> commit (scheduler shard-out) ---
+
+    def stage(
+        self,
+        uid: str,
+        node: str,
+        chips: int,
+        shard: str,
+        gang: str = "",
+    ) -> int:
+        """Stage one claim on behalf of a REMOTE shard worker — the
+        commit RPC server's entry point (framework/procserve.py;
+        multi-process shard serve). Identical semantics to a sharded
+        Reserve landing in-process: journaled write-ahead, charged into
+        ``_in_use`` immediately, ordered by the global stage seq.
+        Returns the assigned seq so the worker's local mirror orders
+        its claims exactly as the commit validator will."""
+        self._claim(uid, node, chips, shard=shard, gang=gang)
+        with self._lock:
+            c = self._claims.get(uid)
+            return c.seq if c is not None else 0
 
     def commit_staged(self, uids) -> "tuple[bool, str]":
         """Atomically validate-and-commit the STAGED claims of ``uids``
@@ -423,3 +449,133 @@ class ChipAccountant(ReservePlugin):
                     break
                 nodes.add(name)
             return cur, {n: self._in_use.get(n, 0) for n in nodes}
+
+
+class RemoteAccountant(ChipAccountant):
+    """Worker-side accountant for multi-process shard serve
+    (``shard_mode=process``, framework/procserve.py).
+
+    The worker keeps a FULL local mirror (this class is a real
+    ChipAccountant: filters, depth functions, snapshot builds and the
+    worker's own cycles read it lock-locally — the read path pays zero
+    RPCs), but every claim-state DECISION crosses the commit RPC to the
+    parent's journal-owning accountant first:
+
+    - **stage** (a sharded Reserve): RPC to the parent — which journals
+      write-ahead and assigns the global stage seq — then the local
+      mirror applies with that parent seq, so first-staged-wins ordering
+      is identical on both sides.
+    - **commit** (``commit_staged``): the parent validates against its
+      capacity view and journals the C record; the mirror finalizes only
+      on an ok verdict. An RPC failure reports as a refused commit — the
+      scheduler requeues, exactly a conflict's path — never a crash.
+    - **release / rollback**: best-effort forward (the parent picks
+      rollback-vs-release from its OWN authoritative claim state), then
+      local. A dead parent cannot block local teardown: its journal
+      replay + reconciler own recovery of anything this worker held.
+
+    ``journal`` stays ``None`` here BY CONSTRUCTION — the parent is the
+    CommitLog's single writer (yodalint journal-discipline pass). The
+    ``rpc`` collaborator is duck-typed (``stage`` / ``commit`` /
+    ``release`` / ``residue``) to keep this module import-free of the
+    transport.
+    """
+
+    name = "yoda-accountant"
+
+    def __init__(
+        self,
+        rpc,
+        *,
+        scheduler_name: str = "yoda-tpu",
+        scheduler_names: "tuple[str, ...] | None" = None,
+    ) -> None:
+        super().__init__(
+            scheduler_name=scheduler_name, scheduler_names=scheduler_names
+        )
+        self._rpc = rpc
+
+    def _claim(
+        self,
+        uid: str,
+        node: str,
+        chips: int,
+        *,
+        shard: "str | None" = None,
+        gang: str = "",
+        seq: "int | None" = None,
+    ) -> None:
+        if shard is None or seq is not None:
+            # Committed/legacy claims (bound-pod watch layering) and
+            # already-sequenced applies stay local — the parent's own
+            # informer tracks bound pods independently.
+            super()._claim(uid, node, chips, shard=shard, gang=gang, seq=seq)
+            return
+        with self._lock:
+            existing = self._claims.get(uid)
+            if existing is not None and existing.node == node:
+                return  # reserve->bind duplicate: skip the RPC too
+        # The RPC runs OUTSIDE the accountant lock (lock-ordering DAG:
+        # no I/O under the commit-point lock); the serve loop is the
+        # only staging writer per worker, so the check-then-apply pair
+        # cannot interleave with another stage of the same uid.
+        parent_seq = self._rpc.stage(uid, node, chips, shard, gang)
+        super()._claim(
+            uid, node, chips, shard=shard, gang=gang, seq=parent_seq
+        )
+
+    def release(self, uid: str) -> None:
+        with self._lock:
+            known = uid in self._claims
+        if known:
+            try:
+                self._rpc.release(uid)
+            except Exception:
+                # Parent unreachable: the worker is (or is about to be)
+                # fenced; parent-side replay + reconciliation recover
+                # the claim. Local teardown must still proceed.
+                pass
+        super().release(uid)
+
+    def commit_staged(self, uids) -> "tuple[bool, str]":
+        with self._lock:
+            mine = [
+                u for u in uids
+                if u in self._claims and self._claims[u].shard is not None
+            ]
+        if not mine:
+            return True, ""
+        try:
+            ok, why = self._rpc.commit(mine)
+        except Exception as e:
+            # Indistinguishable from a lost-in-flight commit: refuse, let
+            # the scheduler roll back + requeue. If the parent DID land
+            # it, the journal holds the C record and the reconciler's
+            # residue pass converges the mirror after respawn.
+            return False, f"commit rpc failed: {e}"
+        if ok:
+            with self._lock:
+                for u in mine:
+                    c = self._claims.get(u)
+                    if c is not None:
+                        c.shard = None
+                        c.seq = 0
+                    self._staged.discard(u)
+                self.commit_commits += 1
+        else:
+            self.commit_conflicts += 1
+        return ok, why
+
+    def commit_residue(self, uid: str) -> bool:
+        try:
+            found = self._rpc.residue(uid)
+        except Exception:
+            found = False
+        with self._lock:
+            c = self._claims.get(uid)
+            if c is not None and c.shard is not None:
+                c.shard = None
+                c.seq = 0
+                self._staged.discard(uid)
+                return True
+        return found

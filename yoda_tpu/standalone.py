@@ -1307,6 +1307,11 @@ class ShardSet:
     clock: object = time.monotonic
     stop_event: "threading.Event | None" = None
     shard_fence_fn: object = None
+    # shard_mode=process (ISSUE 19): the worker-process lifecycle
+    # (framework.shards.WorkerSupervisor). None in thread mode; when
+    # set, stacks holds ONLY the global lane — the shard serve loops
+    # live in the supervised worker processes.
+    supervisor: object = None
 
     @property
     def global_stack(self) -> Stack:
@@ -1712,6 +1717,11 @@ class ShardSet:
         last_binds = -1
         while not stop.is_set():
             try:
+                # Process mode: one supervision pass per tick — dead
+                # workers respawn with backoff; their staged residue was
+                # already recovered by journal replay + reconciliation.
+                if self.supervisor is not None:
+                    self.supervisor.poll()
                 self.rescue_starved()
                 # Cross-lane reactivation tick: another lane's binds or
                 # rollbacks change what this lane's parked entries could
@@ -1737,6 +1747,8 @@ class ShardSet:
             stop.wait(period_s)
 
     def close(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
         for st in self.stacks:
             st.gang.close()
             if st.ingestor is not None:
@@ -1870,6 +1882,75 @@ def build_sharded_stacks(
     cluster.add_watcher(
         on_fleet_event, replay=False, batch_fn=on_fleet_batch
     )
+    return shard_set
+
+
+def build_proc_parent(
+    cluster=None,
+    config: SchedulerConfig | None = None,
+    *,
+    clock=time.monotonic,
+    stop_event: "threading.Event | None" = None,
+    shard_map=None,
+) -> ShardSet:
+    """Assemble the PARENT control plane for ``shard_mode=process``
+    (ISSUE 19): the same head as :func:`build_sharded_stacks` — router
+    watcher, journal-owning track-capacity accountant, shared metrics —
+    but only the GLOBAL lane stack is built in this process. The shard
+    serve loops run in worker processes (``framework/procserve.py``)
+    that reach this accountant through the commit RPC; the caller wires
+    a ``CommitRPCServer`` around ``shard_set.accountant`` and attaches
+    a ``WorkerSupervisor`` as ``shard_set.supervisor``.
+
+    The parent keeps everything that must stay singular: the CommitLog
+    writer, the full-fleet informer + fleet gauges, the reconciler /
+    rebalancer / nodehealth repair loops, and the metrics server.
+    Workers own everything per-lane: informer, queue, BindExecutor.
+    """
+    from yoda_tpu.framework.shards import (
+        GLOBAL_LANE,
+        ShardMap,
+        ShardRouter,
+    )
+
+    cluster = cluster or FakeCluster()
+    config = config or SchedulerConfig()
+    shard_map = shard_map or ShardMap(config.shard_count)
+    router = ShardRouter(shard_map)
+    cluster.add_watcher(router.observe, batch_fn=router.observe_batch)
+    # Single journal-owning accountant — the commit point every worker
+    # RPCs into. Same registration discipline as build_sharded_stacks:
+    # journal replay before the watcher, watcher before the informer.
+    accountant = ChipAccountant(scheduler_name=config.scheduler_name)
+    accountant.track_capacity = True
+    _attach_journal(accountant, config)
+    cluster.add_watcher(accountant.handle)
+    shared_metrics = _metrics_from_config(config, clock)
+    stacks = [
+        build_stack(
+            cluster=cluster,
+            config=config,
+            accountant=accountant,
+            metrics=shared_metrics,
+            clock=clock,
+            stop_event=stop_event,
+            shard=GLOBAL_LANE,
+            pod_route_fn=lambda pod: router.route(pod) == GLOBAL_LANE,
+        )
+    ]
+    shard_set = ShardSet(
+        stacks=stacks,
+        router=router,
+        shard_map=shard_map,
+        accountant=accountant,
+        metrics=shared_metrics,
+        config=config,
+        clock=clock,
+        stop_event=stop_event,
+    )
+    # No depth_fn: worker queue depths live in other processes; the
+    # router falls back to pure rendezvous, which is exactly what the
+    # workers themselves compute (same pure function, same answer).
     return shard_set
 
 

@@ -849,3 +849,146 @@ def maybe_drop_watch(plan: ChaosPlan, server) -> bool:
         return False
     server.compact()
     return True
+
+
+class DriveWorker:
+    """One scripted commit-RPC driver subprocess (ISSUE 19 chaos
+    surface: ``python -m yoda_tpu.framework.procserve --drive``). The
+    child stages its spec'd claims over the parent's commit RPC socket,
+    prints ``STAGED``, then executes stdin commands — which gives the
+    sweep deterministic kill points: SIGKILL at the STAGED barrier
+    plants pure staged residue; SIGKILL after sending COMMIT while the
+    parent holds the commit gate closed (``hold_commits``) kills the
+    worker mid-commit, the exact window the journal's write-ahead
+    discipline exists for."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        shard: str,
+        claims: "list[dict]",
+        *,
+        tmpdir: str,
+    ) -> None:
+        import json as _json
+        import os as _os
+        import subprocess as _sp
+        import sys as _sys
+
+        self.shard = shard
+        self.claims = list(claims)
+        self.spec_path = _os.path.join(tmpdir, f"drive-{shard}.json")
+        with open(self.spec_path, "w") as f:
+            _json.dump(
+                {"socket": socket_path, "shard": shard, "claims": claims},
+                f,
+            )
+        self.proc = _sp.Popen(
+            [
+                _sys.executable,
+                "-m",
+                "yoda_tpu.framework.procserve",
+                "--drive",
+                self.spec_path,
+            ],
+            stdin=_sp.PIPE,
+            stdout=_sp.PIPE,
+            stderr=_sp.DEVNULL,
+            text=True,
+            bufsize=1,
+        )
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def _read_line(self, timeout_s: float) -> str:
+        import select as _select
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            r, _, _ = _select.select([self.proc.stdout], [], [], 0.1)
+            if r:
+                line = self.proc.stdout.readline()
+                if line:
+                    return line.strip()
+                break  # EOF: child died
+            if self.proc.poll() is not None:
+                break
+        raise ChaosTimeout(
+            f"drive worker {self.shard}: no output within {timeout_s}s "
+            f"(alive={self.proc.poll() is None})"
+        )
+
+    def wait_staged(self, timeout_s: float = 30.0) -> None:
+        line = self._read_line(timeout_s)
+        if line != "STAGED":
+            raise SchedulerCrashed(
+                f"drive worker {self.shard}: expected STAGED, got {line!r}"
+            )
+
+    def send(self, cmd: str) -> None:
+        self.proc.stdin.write(cmd + "\n")
+        self.proc.stdin.flush()
+
+    def commit(
+        self, uids: "list[str] | None" = None, *, timeout_s: float = 30.0
+    ) -> "tuple[bool, str]":
+        """Send COMMIT and wait for the result line. With the parent's
+        commit gate held, the send returns immediately while the child
+        blocks inside the RPC — SIGKILL it THERE for mid-commit."""
+        self.send_commit(uids)
+        return self.read_commit_result(timeout_s=timeout_s)
+
+    def send_commit(self, uids: "list[str] | None" = None) -> None:
+        if uids is None:
+            self.send("COMMIT")
+        else:
+            self.send("COMMIT " + ",".join(uids))
+
+    def read_commit_result(
+        self, *, timeout_s: float = 30.0
+    ) -> "tuple[bool, str]":
+        line = self._read_line(timeout_s)
+        if not line.startswith("COMMITTED"):
+            raise SchedulerCrashed(
+                f"drive worker {self.shard}: expected COMMITTED, "
+                f"got {line!r}"
+            )
+        parts = line.split(" ", 2)
+        ok = parts[1] == "1"
+        why = parts[2] if len(parts) > 2 else ""
+        return ok, why
+
+    def sigkill(self) -> None:
+        """kill -9: the worker dies without a word; its staged residue
+        is the parent journal's to recover."""
+        import signal as _signal
+
+        try:
+            self.proc.send_signal(_signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
+        self.proc.wait(timeout=10.0)
+
+    def exit(self, timeout_s: float = 10.0) -> int:
+        try:
+            self.send("EXIT")
+        except (OSError, ValueError, BrokenPipeError):
+            pass
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        for f in (self.proc.stdin, self.proc.stdout):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10.0)
