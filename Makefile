@@ -9,7 +9,7 @@ TAG ?= latest
 PY ?= python
 CXX ?= g++
 
-.PHONY: all test lint native native-asan bench bench-scale serve-bench rebalance-bench slo-bench shard-bench proc-bench overload-bench smoke chaos demo soak image push format clean
+.PHONY: all test lint native native-asan bench bench-scale serve-bench rebalance-bench slo-bench shard-bench proc-bench failover-bench overload-bench smoke chaos demo soak image push format clean
 
 all: native lint test
 
@@ -120,6 +120,15 @@ shard-bench:
 # inside `make shard-bench`. One JSON line.
 proc-bench:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --proc
+
+# Multi-host control-plane failover evidence (CPU-pinned): a 100k-claim
+# parent killed behind a journal-tailing standby — warm (mirror
+# promotion) vs cold (disk replay) parent-kill -> first-worker-commit
+# latency, < 1 s warm and >= 5x vs cold asserted — plus the AF_UNIX vs
+# loopback-TCP commit p99 comparison (<= 2x asserted). The reduced
+# slice rides `make smoke`. One JSON line.
+failover-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --failover
 
 # Overload brownout ladder + live shard resize evidence (CPU-pinned):
 # the seeded 10x flash-crowd replay with the ladder on vs off (prod

@@ -83,6 +83,32 @@ class TestSchedulerManifest:
         enabled["shard_count"] = 2
         assert SchedulerConfig.from_dict(enabled).shard_mode == "process"
 
+    def test_configmap_ships_multihost_knobs_commented(self):
+        """ISSUE 20: the multi-host knobs ship commented (so operators
+        see the TCP transport and standby-tail endpoints next to
+        shard_mode) at the empty defaults — AF_UNIX transport, no tail
+        — and the commented values round-trip through validation; a
+        drifted ConfigMap would crash-loop the Deployment."""
+        (cm,) = by_kind(self.docs, "ConfigMap")
+        text = cm["data"]["config.yaml"]
+        assert "# commit_listen: 0.0.0.0:7607" in text
+        assert "# commit_endpoint: yoda-tpu-scheduler-leader:7607" in text
+        cfg = SchedulerConfig.from_dict(yaml.safe_load(text))
+        assert cfg.commit_listen == ""
+        assert cfg.commit_endpoint == ""
+        enabled = yaml.safe_load(
+            text.replace(
+                "# commit_listen: 0.0.0.0:7607",
+                "commit_listen: 0.0.0.0:7607",
+            ).replace(
+                "# commit_endpoint: yoda-tpu-scheduler-leader:7607",
+                "commit_endpoint: yoda-tpu-scheduler-leader:7607",
+            )
+        )
+        cfg2 = SchedulerConfig.from_dict(enabled)
+        assert cfg2.commit_listen == "0.0.0.0:7607"
+        assert cfg2.commit_endpoint == "yoda-tpu-scheduler-leader:7607"
+
     def test_configmap_overload_knobs_validate(self):
         """ISSUE 15: the shipped overload-ladder knobs must pass
         SchedulerConfig validation — a drifted ConfigMap would

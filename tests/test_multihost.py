@@ -1,0 +1,1020 @@
+"""Multi-host control plane (ISSUE 20): the TCP commit transport, epoch
+term fencing, the journal-tailing hot standby, and partition residue.
+
+The scenarios here are the ISSUE's acceptance criteria:
+
+- transport parity: stage/commit/conflict/rollback over loopback TCP
+  behaves exactly like the AF_UNIX path — same verdicts, same state —
+  and read deadlines surface a hung link as a refused call, never a
+  hung serve loop;
+- remote worker fencing: a TCP worker is NOT fenced by local
+  re-parenting (getppid is the wrong parent across machines) and IS
+  fenced by term regression + heartbeat staleness — fail-closed both
+  ways;
+- reconnect backoff: full-jitter (cluster/retry.py policy) between
+  reconnect attempts, and the worker's stop event interrupts a pending
+  backoff immediately (SIGTERM never waits it out);
+- the journal-tailing standby: streams committed frames into a warm
+  mirror, survives ring-overrun via snapshot catch-up, detects frame
+  gaps, and promotes O(1) — term bump first (the promoted journal's
+  FIRST frame), then the accountant handover;
+- kill-at-every-frame term fencing: after promotion, the OLD parent's
+  lingering socket keeps answering — every stale-term commit is
+  refused, and journaled by NOBODY;
+- partition residue: a worker that staged claims under the old term
+  ships its staged-intent log to the promoted parent on reconnect and
+  the parent reconciles it (release abandoned / adopt unknown /
+  finalize committed);
+- the seeded chaos sweep: rpc_partition (half-open TCP), rpc_slow, and
+  parent_kill -> promote -> reconnect cycles with no oversubscription,
+  no split gangs, and zero staged-claim leaks at the end.
+"""
+
+from __future__ import annotations
+
+import os
+import socket as socket_mod
+import tempfile
+import threading
+import time
+
+import pytest
+
+from yoda_tpu.agent import FakeTpuAgent
+from yoda_tpu.cluster.fake import FakeCluster
+from yoda_tpu.cluster.retry import BackoffPolicy
+from yoda_tpu.framework.procserve import (
+    CommitRPCClient,
+    CommitRPCError,
+    CommitRPCServer,
+    TcpTransport,
+    UnixTransport,
+    WorkerFence,
+    make_transport,
+)
+from yoda_tpu.journal import FileJournal
+from yoda_tpu.journal.tail import JournalTailer, TailDiverged
+from yoda_tpu.plugins.yoda.accounting import ChipAccountant, RemoteAccountant
+from yoda_tpu.testing.chaos import ChaosPlan, ChaosTcpProxy, maybe_rpc_fault
+
+CHIPS = 8
+
+
+def make_parent(hosts=2, chips=CHIPS, journal_dir=None):
+    """A parent control-plane accountant over a small fake fleet, with
+    the durable journal attached (replay-first) when a dir is given."""
+    cluster = FakeCluster()
+    acc = ChipAccountant()
+    acc.track_capacity = True
+    if journal_dir is not None:
+        j = FileJournal(str(journal_dir))
+        state = j.open()
+        if state.claims:
+            acc.restore(state)
+        acc.journal = j
+    cluster.add_watcher(acc.handle)
+    agent = FakeTpuAgent(cluster)
+    for i in range(hosts):
+        agent.add_host(f"host-{i}", generation="v5e", chips=chips)
+    agent.publish_all()
+    return cluster, acc
+
+
+class _TcpServer:
+    """One CommitRPCServer on a kernel-assigned loopback TCP port."""
+
+    def __init__(self, acc, endpoint="127.0.0.1:0", **kw):
+        self.server = CommitRPCServer(acc, endpoint, **kw)
+        self.server.start()
+        self.endpoint = self.server.endpoint
+
+    def client(self, shard="s0", **kw):
+        return CommitRPCClient(self.endpoint, shard=shard, **kw)
+
+    def close(self):
+        self.server.stop()
+
+
+class _UnixServer:
+    def __init__(self, acc, **kw):
+        self.dir = tempfile.mkdtemp(prefix="yoda-mh-")
+        self.sock = os.path.join(self.dir, "c.sock")
+        self.server = CommitRPCServer(acc, self.sock, **kw)
+        self.server.start()
+        self.endpoint = self.sock
+
+    def client(self, shard="s0", **kw):
+        return CommitRPCClient(self.sock, shard=shard, **kw)
+
+    def close(self):
+        self.server.stop()
+        try:
+            os.rmdir(self.dir)
+        except OSError:
+            pass
+
+
+class TestTransportSeam:
+    """make_transport parsing and unix/TCP behavioral parity."""
+
+    def test_endpoint_parse(self):
+        assert isinstance(make_transport("/tmp/x.sock"), UnixTransport)
+        assert isinstance(make_transport("127.0.0.1:9000"), TcpTransport)
+        assert isinstance(make_transport("tcp://10.0.0.1:80"), TcpTransport)
+        # No digit port -> a (weird but legal) relative unix path.
+        assert isinstance(make_transport("not-a-port:abc"), UnixTransport)
+        t = make_transport("tcp://10.0.0.1:80")
+        assert (t.host, t.port) == ("10.0.0.1", 80)
+
+    def test_server_reports_kernel_assigned_port(self):
+        _, acc = make_parent()
+        srv = _TcpServer(acc)
+        try:
+            host, _, port = srv.endpoint.rpartition(":")
+            assert host == "127.0.0.1"
+            assert int(port) > 0
+        finally:
+            srv.close()
+
+    def test_stage_commit_parity_unix_vs_tcp(self):
+        # The same claim script over both transports must produce
+        # identical verdicts and identical parent state.
+        def script(acc):
+            out = []
+            acc._claim("default/a", "host-0", 4, shard="s0", gang="g1")
+            acc._claim("default/b", "host-0", 4, shard="s0", gang="g1")
+            out.append(acc.commit_staged(["default/a", "default/b"]))
+            acc._claim("default/c", "host-1", 6, shard="s0")
+            out.append(acc.commit_staged(["default/c"]))
+            acc.release("default/a")
+            out.append(acc.chips_by_node())
+            out.append(acc.staged_count())
+            return out
+
+        results = {}
+        for kind, factory in (("unix", _UnixServer), ("tcp", _TcpServer)):
+            _, parent = make_parent()
+            srv = factory(parent)
+            try:
+                assert srv.server.transport.kind == kind
+                cl = srv.client()
+                remote = RemoteAccountant(cl)
+                results[kind] = (script(remote), parent.chips_by_node())
+                cl.close()
+            finally:
+                srv.close()
+        assert results["unix"] == results["tcp"]
+
+    def test_oversubscribe_refused_over_tcp(self):
+        _, parent = make_parent(hosts=1)
+        srv = _TcpServer(parent)
+        try:
+            a = RemoteAccountant(srv.client("s0"), scheduler_name="yoda-tpu")
+            b = RemoteAccountant(srv.client("s1"), scheduler_name="yoda-tpu")
+            a._claim("default/x", "host-0", 6, shard="s0")
+            b._claim("default/y", "host-0", 6, shard="s1")
+            ok_a, _ = a.commit_staged(["default/x"])
+            ok_b, _ = b.commit_staged(["default/y"])
+            assert ok_a != ok_b  # first-staged-wins: exactly one lands
+            # The loser rolls its staged claim back; committed usage
+            # then fits capacity exactly.
+            (b if ok_a else a).release("default/y" if ok_a else "default/x")
+            assert parent.chips_in_use("host-0") == 6
+            assert parent.staged_count() == 0
+        finally:
+            srv.close()
+
+    def test_read_deadline_surfaces_as_refused_call(self):
+        # A listener that accepts and then says nothing: the half-open
+        # link. The client's read deadline must fire (a refused call),
+        # not hang the caller.
+        lst = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+        try:
+            cl = CommitRPCClient(
+                f"127.0.0.1:{port}", shard="s0", timeout_s=0.2
+            )
+            t0 = time.monotonic()
+            with pytest.raises(CommitRPCError):
+                cl.call("heartbeat", pid=1)
+            assert time.monotonic() - t0 < 5.0
+            cl.close()
+        finally:
+            lst.close()
+
+    def test_large_frame_round_trip(self):
+        # A residue_sync shipping hundreds of staged intents rides one
+        # length-prefixed frame — far past any single-line heuristics.
+        _, parent = make_parent(hosts=64, chips=1024)
+        srv = _TcpServer(parent)
+        try:
+            cl = srv.client("s0")
+            staged = [
+                {
+                    "uid": f"default/p{i}",
+                    "node": f"host-{i % 64}",
+                    "chips": 1,
+                    "gang": "",
+                }
+                for i in range(500)
+            ]
+            verdicts = cl.residue_sync(staged)
+            assert len(verdicts) == 500
+            assert set(verdicts.values()) == {"staged"}
+            cl.close()
+        finally:
+            srv.close()
+
+
+class TestTermFencing:
+    """The bidirectional epoch-term fence."""
+
+    def test_client_tracks_term_and_refuses_regression(self):
+        _, parent = make_parent()
+        srv = _TcpServer(parent, term=4)
+        try:
+            cl = srv.client()
+            cl.hello()
+            assert cl.term_seen == 4
+            # The deposed parent's lingering socket still answers — at
+            # its OLD term. The client must read that as a fence, drop
+            # the connection, and refuse the call.
+            srv.server.set_term(2)
+            with pytest.raises(CommitRPCError, match="fenced"):
+                cl.call("heartbeat", pid=1)
+            assert cl.term_seen == 4  # never regresses
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_server_refuses_mutations_from_newer_term(self):
+        # A request stamped with a NEWER term proves a promoted parent
+        # exists: the stale parent must refuse before touching the
+        # accountant or the journal, and a commit refusal must be
+        # SHAPED like a fence refusal (rollback + requeue), not an
+        # error.
+        _, parent = make_parent()
+        srv = _TcpServer(parent, term=1)
+        try:
+            cl = srv.client()
+            cl._term_seen = 3  # a worker that already met term 3
+            with pytest.raises(CommitRPCError, match="stale parent"):
+                cl.stage("default/a", "host-0", 2, "s0")
+            # commit: the response says refused... but the stamped term
+            # (1 < 3) trips the client-side fence first — either way the
+            # caller sees a refused decision and nothing was journaled.
+            with pytest.raises(CommitRPCError):
+                cl.commit(["default/a"])
+            assert parent.staged_count() == 0
+            assert parent.chips_by_node() == {}
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_non_mutating_ops_pass_under_newer_term(self):
+        # heartbeat/tail are read-only: a worker ahead of a stale parent
+        # still hears it (and then fences on the stamped term itself).
+        _, parent = make_parent()
+        srv = _TcpServer(parent, term=5)
+        try:
+            cl = srv.client()
+            cl._term_seen = 5
+            assert cl.heartbeat() is True
+            cl.close()
+        finally:
+            srv.close()
+
+
+class TestRemoteWorkerFence:
+    """getppid is the wrong parent across machines."""
+
+    def test_remote_worker_not_fenced_by_local_reparenting(self):
+        _, parent = make_parent()
+        srv = _TcpServer(parent)
+        try:
+            cl = srv.client()
+            orphaned = []
+            fence = WorkerFence(
+                cl, shard="s0", on_orphaned=lambda: orphaned.append(1)
+            )
+            assert fence.remote is True  # derived from the transport
+            # The local supervisor (not the scheduler parent) died and
+            # we re-parented: across machines that means NOTHING.
+            fence._ppid = -1
+            fence.beat()
+            assert fence.serving() is True
+            assert orphaned == []
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_local_worker_is_fenced_by_reparenting(self):
+        _, parent = make_parent()
+        srv = _UnixServer(parent)
+        try:
+            cl = srv.client()
+            orphaned = []
+            fence = WorkerFence(
+                cl, shard="s0", on_orphaned=lambda: orphaned.append(1)
+            )
+            assert fence.remote is False
+            fence._ppid = -1
+            fence.beat()
+            assert fence.serving() is False
+            assert orphaned == [1]
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_remote_worker_fenced_by_term_regression(self):
+        _, parent = make_parent()
+        srv = _TcpServer(parent, term=2)
+        try:
+            cl = srv.client()
+            fence = WorkerFence(cl, shard="s0", liveness_s=0.1)
+            fence.beat()
+            assert fence.serving() is True
+            # The endpoint now answers at a LOWER term (the deposed
+            # parent's lingering socket): heartbeats start failing and
+            # staleness fences the worker — fail-closed.
+            srv.server.set_term(1)
+            fence.beat()
+            time.sleep(0.15)
+            fence.beat()
+            assert fence.serving() is False
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_on_new_term_fires_once_per_promotion(self):
+        _, parent = make_parent()
+        srv = _TcpServer(parent, term=1)
+        try:
+            cl = srv.client()
+            seen = []
+            fence = WorkerFence(cl, shard="s0", on_new_term=seen.append)
+            fence.beat()      # first beat: term 1 is not a promotion
+            fence.beat()
+            assert seen == []
+            srv.server.set_term(2)
+            fence.beat()
+            fence.beat()
+            assert seen == [2]
+            cl.close()
+        finally:
+            srv.close()
+
+
+class TestReconnectBackoff:
+    """Full-jitter reconnect backoff, interruptible by the stop event."""
+
+    class _FixedPolicy:
+        """A policy whose delay is deterministic (duck-types
+        BackoffPolicy.delay_s)."""
+
+        def __init__(self, delay):
+            self.delay = delay
+
+        def delay_s(self, attempt, rng):
+            return self.delay
+
+    def _dead_endpoint(self):
+        lst = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+        lst.bind(("127.0.0.1", 0))
+        port = lst.getsockname()[1]
+        lst.close()  # nothing listens here anymore
+        return f"127.0.0.1:{port}"
+
+    def test_stop_event_aborts_pending_backoff(self):
+        stop = threading.Event()
+        cl = CommitRPCClient(
+            self._dead_endpoint(),
+            shard="s0",
+            stop_event=stop,
+            reconnect_policy=self._FixedPolicy(30.0),
+        )
+        with pytest.raises(CommitRPCError):
+            cl.call("hello", pid=1)  # first failure: no backoff yet
+        stop.set()
+        t0 = time.monotonic()
+        with pytest.raises(CommitRPCError, match="stopping"):
+            cl.call("hello", pid=1)  # 30 s backoff due — aborted at once
+        assert time.monotonic() - t0 < 5.0
+        cl.close()
+
+    def test_stop_event_interrupts_sleep_midway(self):
+        stop = threading.Event()
+        cl = CommitRPCClient(
+            self._dead_endpoint(),
+            shard="s0",
+            stop_event=stop,
+            reconnect_policy=self._FixedPolicy(30.0),
+        )
+        with pytest.raises(CommitRPCError):
+            cl.call("hello", pid=1)
+        threading.Timer(0.1, stop.set).start()
+        t0 = time.monotonic()
+        with pytest.raises(CommitRPCError, match="stopping"):
+            cl.call("hello", pid=1)
+        assert time.monotonic() - t0 < 10.0  # not the 30 s delay
+        cl.close()
+
+    def test_full_jitter_delays_grow_with_failures(self):
+        import random
+
+        policy = BackoffPolicy(attempts=0, base_s=0.05, cap_s=2.0)
+        rng = random.Random(7)
+        # delay_s(k) is uniform(0, min(base * 2^k, cap)): the CEILING
+        # grows exponentially and clamps at the cap.
+        caps = [min(0.05 * 2**k, 2.0) for k in range(10)]
+        for k, cap in enumerate(caps):
+            for _ in range(20):
+                assert 0 <= policy.delay_s(k, rng) <= cap
+
+    def test_reconnects_after_parent_respawn_on_same_port(self):
+        _, parent = make_parent()
+        srv = _TcpServer(parent)
+        endpoint = srv.endpoint
+        cl = CommitRPCClient(endpoint, shard="s0", timeout_s=2.0)
+        cl.hello()
+        srv.close()
+        with pytest.raises(CommitRPCError):
+            cl.call("heartbeat", pid=1)
+        # The promoted parent comes up on the SAME address (service
+        # VIP): the next call reconnects through the backoff path.
+        _, parent2 = make_parent()
+        srv2 = _TcpServer(parent2, endpoint=endpoint, term=2)
+        try:
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    assert cl.heartbeat() is True
+                    break
+                except CommitRPCError:
+                    if time.monotonic() > deadline:
+                        raise
+            assert cl.term_seen == 2
+            cl.close()
+        finally:
+            srv2.close()
+
+
+def _stage_and_commit(acc, n, *, committed_frac=0.5, gang_every=4):
+    """Drive n staged claims (some committed, some left staged, a few
+    gangs) through the journal-owning accountant."""
+    commit_at = max(int(n * committed_frac), 0)
+    for i in range(n):
+        gang = f"g{i // gang_every}" if i % gang_every < 2 else ""
+        acc.stage(
+            f"default/p{i}", f"host-{i % 2}", 1, f"s{i % 2}", gang
+        )
+    uids = [f"default/p{i}" for i in range(commit_at)]
+    if uids:
+        ok, why = acc.commit_staged(uids)
+        assert ok, why
+
+
+class TestJournalTailer:
+    """The hot standby's warm mirror: stream, catch up, promote."""
+
+    def _parent(self, tmp_path, n=12):
+        _, acc = make_parent(hosts=2, chips=64, journal_dir=tmp_path / "j")
+        _stage_and_commit(acc, n)
+        srv = _TcpServer(acc)
+        return acc, srv
+
+    def test_tailer_streams_to_zero_lag(self, tmp_path):
+        acc, srv = self._parent(tmp_path)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            assert tailer.lag_frames == 0
+            assert tailer.synced
+            # Both mirrors converged to the parent's exact state.
+            assert tailer.divergence() is None
+            want = {
+                n: v for n, v in acc.chips_by_node().items() if v
+            }
+            assert {n: v for n, v in tailer.in_use.items() if v} == want
+            assert set(tailer.staged) == set(acc.staged_uids())
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_tailer_applies_deltas_incrementally(self, tmp_path):
+        acc, srv = self._parent(tmp_path, n=4)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            frames_before = tailer.frames_applied
+            # New activity after the first catch-up: the next poll must
+            # apply only the delta.
+            acc.stage("default/new", "host-0", 2, "s0", "")
+            ok, why = acc.commit_staged(["default/new"])
+            assert ok, why
+            applied = tailer.poll_once()
+            assert applied == 2  # one S, one C — not a re-sync
+            assert tailer.frames_applied == frames_before + 2
+            assert "default/new" in tailer.claims
+            assert tailer.claims["default/new"].shard is None
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_fresh_follower_of_reopened_journal_snapshots(self, tmp_path):
+        # A journal replayed from disk has state but an empty ship ring:
+        # the follower must catch up via ship_state, not frames.
+        _, acc = make_parent(chips=64, journal_dir=tmp_path / "j")
+        _stage_and_commit(acc, 8)
+        acc.journal.close()
+        _, acc2 = make_parent(chips=64, journal_dir=tmp_path / "j")
+        srv = _TcpServer(acc2)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            assert tailer.snapshots == 1
+            assert tailer.divergence() is None
+            assert len(tailer.claims) == 8
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_seq_gap_resets_and_resyncs(self, tmp_path):
+        acc, srv = self._parent(tmp_path, n=6)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            sep = "\x1f"
+            gap_seq = tailer.state.tail_seq + 7
+            with pytest.raises(TailDiverged):
+                tailer._apply(
+                    sep.join(("S", str(gap_seq), "default/zz", "host-0",
+                              "1", "s0", "99", ""))
+                )
+            assert tailer.state.tail_seq == 0  # reset
+            tailer.poll_once()  # re-sync from scratch
+            assert tailer.divergence() is None
+            assert len(tailer.claims) == 6
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_promotion_writes_term_bump_as_first_frame(self, tmp_path):
+        acc, srv = self._parent(tmp_path)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            # The standby's own (fresh) journal + accountant.
+            _, standby = make_parent(hosts=2, chips=64)
+            sj = FileJournal(str(tmp_path / "standby"))
+            sj.open()
+            standby.journal = sj
+            new_term = tailer.promote_into(standby, sj, snapshot="none")
+            assert new_term == 2  # old parent served at term 1
+            # T is the promoted journal's FIRST frame, at a seq that
+            # CONTINUES the shipped tail (no seq reuse across terms).
+            assert sj.summary()["term"] == 2
+            assert sj.summary()["head_seq"] == sj.summary()["tail_seq"]
+            assert sj.summary()["head_seq"] > 0
+            seg = os.path.join(str(tmp_path / "standby"), "seg-00000001.log")
+            with open(seg, "rb") as f:
+                raw = f.read()
+            payload = raw[8:].decode()  # one frame: 8-byte header + body
+            kind, seq, term_s = payload.split("\x1f")
+            assert kind == "T"
+            assert int(term_s) == 2
+            assert int(seq) == sj.summary()["tail_seq"]
+            # The accountant adopted the warm mirror wholesale.
+            assert standby.chips_by_node() == acc.chips_by_node()
+            assert set(standby.staged_uids()) == set(acc.staged_uids())
+            # The term is durable at once even before any snapshot
+            # (snapshot="none" defers the mirror's replayability — a
+            # crash in that window falls back to the warm resync).
+            sj.close()
+            state = FileJournal(str(tmp_path / "standby")).open()
+            assert state.term == 2
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_sync_snapshot_promotion_replays_full_state(self, tmp_path):
+        acc, srv = self._parent(tmp_path)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            _, standby = make_parent(hosts=2, chips=64)
+            sj = FileJournal(str(tmp_path / "standby"))
+            sj.open()
+            standby.journal = sj
+            tailer.promote_into(standby, sj, snapshot="sync")
+            sj.close()
+            # snapshot="sync" rotates inline: the promoted journal is
+            # immediately replayable to the adopted state AND the term.
+            state = FileJournal(str(tmp_path / "standby")).open()
+            assert state.term == 2
+            assert len(state.claims) == len(acc.claims_snapshot())
+            replayed_staged = {
+                u for u, c in state.claims.items() if c[2]
+            }
+            assert replayed_staged == set(acc.staged_uids())
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_promotion_refused_on_divergence(self, tmp_path):
+        acc, srv = self._parent(tmp_path, n=4)
+        try:
+            cl = srv.client("standby")
+            tailer = JournalTailer(cl)
+            tailer.poll_once()
+            tailer.in_use["host-0"] = 999  # corrupt the usage mirror
+            _, standby = make_parent()
+            before = standby.chips_by_node()
+            with pytest.raises(TailDiverged, match="mismatch"):
+                tailer.promote_into(standby, None)
+            assert standby.chips_by_node() == before  # untouched
+            cl.close()
+        finally:
+            srv.close()
+
+
+class TestStaleParentEveryFrame:
+    """Kill-at-every-frame: whatever frame the old parent died at, its
+    lingering socket can keep answering — but after promotion every
+    stale-term mutation is refused and journaled by NOBODY."""
+
+    SCRIPT_LEN = 6
+
+    def _drive(self, acc, upto):
+        """The first ``upto`` frames of a fixed claim script."""
+        ops = []
+        for i in range(3):
+            ops.append(
+                ("stage", f"default/k{i}", f"host-{i % 2}", 2, "s0",
+                 "gk" if i < 2 else "")
+            )
+        ops.append(("commit", ["default/k0", "default/k1"]))
+        ops.append(("stage", "default/k3", "host-0", 1, "s0", ""))
+        ops.append(("release", "default/k2"))
+        assert len(ops) == self.SCRIPT_LEN
+        for op in ops[:upto]:
+            if op[0] == "stage":
+                acc.stage(*op[1:])
+            elif op[0] == "commit":
+                ok, why = acc.commit_staged(op[1])
+                assert ok, why
+            else:
+                acc.release(op[1])
+
+    @pytest.mark.parametrize("kill_at", range(SCRIPT_LEN + 1))
+    def test_stale_commits_refused_at_every_kill_point(
+        self, tmp_path, kill_at
+    ):
+        _, old = make_parent(journal_dir=tmp_path / "old")
+        old_srv = _TcpServer(old, term=1)
+        try:
+            self._drive(old, kill_at)
+            # The standby tailed everything up to the kill point, then
+            # promoted (the old parent "died" — but its socket stays
+            # up, the lingering-process case).
+            tcl = old_srv.client("standby")
+            tailer = JournalTailer(tcl)
+            tailer.poll_once()
+            _, new = make_parent()
+            nj = FileJournal(str(tmp_path / "new"))
+            nj.open()
+            new.journal = nj
+            new_term = tailer.promote_into(new, nj, snapshot="none")
+            assert new_term == 2
+            tcl.close()
+
+            old_summary = old.journal.summary()
+            new_tail = nj.summary()["tail_seq"]
+
+            # A worker that reconnected to the promoted parent (term 2)
+            # falls back to the OLD endpoint mid-flap. Every mutating
+            # op must be refused — by the server fence (req term 2 >
+            # parent term 1) AND the client fence (response stamped 1).
+            wcl = old_srv.client("s0")
+            wcl._term_seen = new_term
+            with pytest.raises(CommitRPCError):
+                wcl.stage("default/stale", "host-1", 1, "s0")
+            with pytest.raises(CommitRPCError):
+                wcl.commit(["default/k3"])
+            with pytest.raises(CommitRPCError):
+                wcl.release("default/k3")
+            wcl.close()
+
+            # Journaled by nobody: neither journal moved.
+            assert old.journal.summary() == old_summary
+            assert nj.summary()["tail_seq"] == new_tail
+            assert not old.has_claim("default/stale")
+            assert not new.has_claim("default/stale")
+        finally:
+            old_srv.close()
+
+
+class TestResidueSync:
+    """Partition residue: the staged-intent log shipped on reconnect."""
+
+    def test_set_reconciliation_semantics(self):
+        _, parent = make_parent(chips=64)
+        # Parent state: a+b staged by s0, c committed, d staged by s1.
+        parent.stage("default/a", "host-0", 2, "s0", "")
+        parent.stage("default/b", "host-0", 2, "s0", "")
+        parent.stage("default/c", "host-1", 2, "s0", "")
+        ok, why = parent.commit_staged(["default/c"])
+        assert ok, why
+        parent.stage("default/d", "host-1", 2, "s1", "")
+        srv = _TcpServer(parent)
+        try:
+            cl = srv.client("s0")
+            # The worker's log: b (still staged), c (it staged, parent
+            # committed), e (staged under the old term, parent never
+            # heard of it). a is ABSENT: the worker abandoned it.
+            verdicts = cl.residue_sync(
+                [
+                    {"uid": "default/b", "node": "host-0", "chips": 2,
+                     "gang": ""},
+                    {"uid": "default/c", "node": "host-1", "chips": 2,
+                     "gang": ""},
+                    {"uid": "default/e", "node": "host-0", "chips": 2,
+                     "gang": ""},
+                ]
+            )
+            assert verdicts == {
+                "default/b": "staged",
+                "default/c": "committed",
+                "default/e": "staged",
+            }
+            staged = parent.staged_uids()
+            assert "default/a" not in staged        # released (abandoned)
+            assert staged.get("default/b") == "s0"  # kept
+            assert staged.get("default/e") == "s0"  # adopted, fresh seq
+            assert staged.get("default/d") == "s1"  # other lane untouched
+            assert parent.has_claim("default/c")
+            cl.close()
+        finally:
+            srv.close()
+
+    def test_worker_ships_residue_on_promotion(self):
+        # End to end: worker stages under term 1; the endpoint is
+        # respawned at term 2 with NO claim state (the promoted parent
+        # missed the partitioned worker's stages); the fence's
+        # on_new_term hook ships the staged-intent log and the parent
+        # adopts it.
+        _, parent = make_parent()
+        srv = _TcpServer(parent, term=1)
+        endpoint = srv.endpoint
+        cl = CommitRPCClient(endpoint, shard="s0", timeout_s=2.0)
+        worker = RemoteAccountant(cl)
+
+        def sync(term):
+            worker.apply_residue_verdicts(
+                cl.residue_sync(worker.staged_intents())
+            )
+
+        fence = WorkerFence(cl, shard="s0", on_new_term=sync)
+        fence.beat()
+        worker._claim("default/w", "host-0", 4, shard="s0", gang="")
+        assert parent.staged_uids() == {"default/w": "s0"}
+        srv.close()  # the old parent dies with the staged claim
+
+        _, promoted = make_parent()
+        srv2 = _TcpServer(promoted, endpoint=endpoint, term=2)
+        try:
+            assert promoted.staged_count() == 0
+            deadline = time.monotonic() + 10.0
+            while fence.client.term_seen < 2:
+                fence.beat()
+                assert time.monotonic() < deadline
+            # The hook adopted the residue into the promoted parent.
+            assert promoted.staged_uids() == {"default/w": "s0"}
+            ok, why = worker.commit_staged(["default/w"])
+            assert ok, why
+            assert promoted.chips_in_use("host-0") == 4
+            cl.close()
+        finally:
+            srv2.close()
+
+
+class TestChaosSweep:
+    """Seeded kill -> promote -> reconnect cycles through a half-open-
+    capable TCP proxy: no oversubscription, no split gangs, zero staged
+    leaks."""
+
+    GANG_SIZE = 2
+
+    def _invariants(self, acc, hosts=2, chips=CHIPS):
+        # COMMITTED usage must fit capacity (staged claims charge
+        # optimistically and are allowed to overshoot until the commit
+        # validator refuses them — that refusal is the mechanism).
+        committed_use: dict = {}
+        gangs: dict = {}
+        for uid, c in acc._claims.items():
+            if c.shard is None:
+                committed_use[c.node] = (
+                    committed_use.get(c.node, 0) + c.chips
+                )
+            if c.gang:
+                gangs.setdefault(c.gang, []).append(c.shard is not None)
+        for node, used in committed_use.items():
+            assert used <= chips, f"oversubscribed {node}: {used}>{chips}"
+        # Gang atomicity over COMMITTED members: a gang with any
+        # committed member must have all members committed.
+        for gang, flags in gangs.items():
+            committed = [f for f in flags if not f]
+            assert len(committed) in (0, len(flags)), (
+                f"split gang {gang}: {flags}"
+            )
+
+    @pytest.mark.parametrize("seed", [11, 23])
+    def test_kill_promote_reconnect_cycles(self, tmp_path, seed):
+        rounds = 8
+        plan = ChaosPlan.seeded(
+            seed,
+            ops=("rpc_partition", "rpc_slow", "parent_kill"),
+            horizon=rounds,
+            rate=0.35,
+        )
+        jdir = tmp_path / "j1"
+        _, acc = make_parent(chips=CHIPS, journal_dir=jdir)
+        term = 1
+        srv = _TcpServer(acc, term=term)
+        proxy = ChaosTcpProxy(srv.endpoint)
+        stop = threading.Event()
+        workers = []
+        for i in range(2):
+            wcl = CommitRPCClient(
+                proxy.endpoint,
+                shard=f"s{i}",
+                timeout_s=0.5,
+                stop_event=stop,
+            )
+            workers.append((wcl, RemoteAccountant(wcl)))
+        standby_cl = srv.client("standby")  # direct: not proxied
+        tailer = JournalTailer(standby_cl)
+        gen = 1
+        uid_n = 0
+        try:
+            for r in range(rounds):
+                fired = maybe_rpc_fault(plan, proxy)
+                # One gang attempt per worker per round.
+                for wi, (wcl, wacc) in enumerate(workers):
+                    gang = f"g{seed}-{r}-{wi}"
+                    uids = []
+                    try:
+                        for m in range(self.GANG_SIZE):
+                            uid = f"default/p{uid_n}"
+                            uid_n += 1
+                            wacc._claim(
+                                uid, f"host-{(r + m) % 2}", 1,
+                                shard=f"s{wi}", gang=gang,
+                            )
+                            uids.append(uid)
+                        ok, _why = wacc.commit_staged(uids)
+                        if not ok:
+                            for uid in uids:
+                                wacc.release(uid)
+                    except CommitRPCError:
+                        # Refused decision (partition/deadline): roll
+                        # the local mirror back; parent-side residue is
+                        # the residue_sync / invariant checks' problem.
+                        for uid in uids:
+                            wacc.release(uid)
+                if fired == "rpc_partition":
+                    proxy.heal()
+                elif fired == "rpc_slow":
+                    proxy.heal()
+                # parent_kill: SIGKILL the live parent, promote the
+                # tailing standby onto the SAME address, reconnect.
+                if plan.has_op("parent_kill") and (
+                    plan.next("parent_kill") is not None
+                ):
+                    try:
+                        tailer.poll_once()
+                    except (CommitRPCError, TailDiverged):
+                        pass
+                    endpoint = srv.endpoint
+                    srv.close()
+                    standby_cl.close()
+                    if tailer.synced and tailer.divergence() is None:
+                        jdir = tmp_path / f"j{gen + 1}"
+                        _, acc2 = make_parent(chips=CHIPS, journal_dir=jdir)
+                        term = tailer.promote_into(
+                            acc2, acc2.journal, snapshot="sync"
+                        )
+                    else:
+                        # Cold path: replay the old journal fresh.
+                        acc.journal.close()
+                        _, acc2 = make_parent(chips=CHIPS, journal_dir=jdir)
+                        term += 1
+                        acc2.journal.record_term_bump(term)
+                    gen += 1
+                    acc = acc2
+                    srv = _TcpServer(acc, endpoint=endpoint, term=term)
+                    standby_cl = srv.client("standby")
+                    tailer = JournalTailer(standby_cl)
+                    # Reconnecting workers ship their staged residue.
+                    for wcl, wacc in workers:
+                        try:
+                            wacc.apply_residue_verdicts(
+                                wcl.residue_sync(wacc.staged_intents())
+                            )
+                        except CommitRPCError:
+                            pass
+                else:
+                    try:
+                        tailer.poll_once()
+                    except (CommitRPCError, TailDiverged):
+                        pass
+                self._invariants(acc)
+
+            # Drain: heal everything, reconcile every worker, then no
+            # staged claim may remain anywhere (zero leaks).
+            proxy.heal()
+            for wcl, wacc in workers:
+                deadline = time.monotonic() + 10.0
+                while True:
+                    try:
+                        wacc.apply_residue_verdicts(
+                            wcl.residue_sync(wacc.staged_intents())
+                        )
+                        break
+                    except CommitRPCError:
+                        assert time.monotonic() < deadline
+                uids = list(wacc.staged_uids())
+                if uids:
+                    ok, _why = wacc.commit_staged(uids)
+                    if not ok:
+                        for uid in uids:
+                            wacc.release(uid)
+            self._invariants(acc)
+            assert acc.staged_count() == 0, acc.staged_uids()
+            # The live journal replays to exactly the live state.
+            live = acc.chips_by_node()
+            acc.journal.close()
+            state = FileJournal(str(jdir)).open()
+            replayed: dict = {}
+            for uid, c in state.claims.items():
+                replayed[c[0]] = replayed.get(c[0], 0) + int(c[1])
+            assert {n: v for n, v in replayed.items() if v} == {
+                n: v for n, v in live.items() if v
+            }
+            # A journal only carries a T record once a promotion wrote
+            # one; an unkilled parent's journal stays at term 0.
+            assert state.term == (term if gen > 1 else 0)
+        finally:
+            stop.set()
+            for wcl, _ in workers:
+                wcl.close()
+            try:
+                standby_cl.close()
+            except OSError:
+                pass
+            proxy.close()
+            srv.close()
+
+
+class TestReplayedTermResume:
+    """A restart is not a promotion: a parent whose journal lived
+    through one must resume AT the replayed term, not at the default."""
+
+    def test_journal_term_property_survives_reopen(self, tmp_path):
+        j = FileJournal(str(tmp_path))
+        j.open()
+        assert j.term == 0
+        j.record_term_bump(3)
+        j.close()
+        j2 = FileJournal(str(tmp_path))
+        state = j2.open()
+        assert state.term == 3
+        assert j2.term == 3
+        j2.close()
+
+    def test_build_stack_publishes_replayed_term_gauge(self, tmp_path):
+        from yoda_tpu.config import SchedulerConfig
+        from yoda_tpu.standalone import build_stack
+
+        j = FileJournal(str(tmp_path))
+        j.open()
+        j.record_term_bump(2)
+        j.close()
+        stack = build_stack(
+            config=SchedulerConfig(
+                mode="batch", journal_path=str(tmp_path)
+            )
+        )
+        try:
+            text = stack.metrics.registry.render_prometheus()
+            assert "yoda_commit_term 2" in text
+        finally:
+            stack.accountant.journal.close()

@@ -180,6 +180,7 @@ ALL_METRIC_FAMILIES = (
     "yoda_commit_rpc_calls_total",
     "yoda_commit_rpc_conflicts_total",
     "yoda_commit_rpc_latency_ms",
+    "yoda_commit_term",
     "yoda_delta_apply_ms",
     "yoda_dispatch_backend_level",
     "yoda_dispatch_errors_total",
@@ -260,6 +261,7 @@ ALL_METRIC_FAMILIES = (
     "yoda_spec_cache_invalidations_total",
     "yoda_spec_cache_misses_total",
     "yoda_spillover_gangs_total",
+    "yoda_standby_lag_frames",
     "yoda_tenant_dominant_share",
     "yoda_tenant_quota_parks_total",
     "yoda_tpu_binpack_efficiency",
@@ -689,6 +691,47 @@ class TestMetricsServer:
         assert 'yoda_commit_rpc_conflicts_total{shard="s1"} 1' in text
         assert 'yoda_commit_rpc_latency_ms_bucket' in text
         assert 'yoda_commit_rpc_latency_ms_count{op="commit"} 1' in text
+
+    def test_commit_rpc_series_carry_transport_label(self):
+        """ISSUE 20: the commit RPC server stamps every call with the
+        transport that carried it (unix vs tcp), so an operator can
+        split local-lane from cross-host commit latency."""
+        from yoda_tpu.observability import SchedulingMetrics
+
+        m = SchedulingMetrics()
+        m.commit_rpc_calls.inc(op="stage", shard="s0", transport="unix")
+        m.commit_rpc_calls.inc(op="stage", shard="s0", transport="tcp")
+        m.commit_rpc_latency.observe(0.4, op="stage", transport="tcp")
+        text = m.registry.render_prometheus()
+        assert (
+            'yoda_commit_rpc_calls_total'
+            '{op="stage",shard="s0",transport="unix"} 1' in text
+        )
+        assert (
+            'yoda_commit_rpc_calls_total'
+            '{op="stage",shard="s0",transport="tcp"} 1' in text
+        )
+        assert (
+            'yoda_commit_rpc_latency_ms_count'
+            '{op="stage",transport="tcp"} 1' in text
+        )
+
+    def test_commit_term_and_standby_lag_gauges(self):
+        """ISSUE 20: the multi-host control plane's two health gauges —
+        the serving parent's epoch term (a promotion is a visible +1;
+        a REGRESSION on one endpoint is a split brain in progress) and
+        how many journal frames the tailing standby is behind."""
+        from yoda_tpu.observability import SchedulingMetrics
+
+        m = SchedulingMetrics()
+        m.commit_term.set(1.0)
+        m.commit_term.set(2.0)
+        m.standby_lag_frames.set(17.0)
+        text = m.registry.render_prometheus()
+        assert "# TYPE yoda_commit_term gauge" in text
+        assert "yoda_commit_term 2" in text
+        assert "# TYPE yoda_standby_lag_frames gauge" in text
+        assert "yoda_standby_lag_frames 17" in text
 
     def test_trace_dropped_counter_counts_ring_overflow(self):
         from yoda_tpu.observability import SchedulingMetrics, TraceEntry

@@ -235,7 +235,12 @@ class TestCommitRPCContract:
             assert not ok2
             assert cl.heartbeat({"queue_depth": 3, "binds": 1}) is True
             text = m.registry.render_prometheus()
-            assert 'yoda_commit_rpc_calls_total{op="stage",shard="s0"} 2' in text
+            # The server stamps every call with the carrying transport
+            # (ISSUE 20) — AF_UNIX here.
+            assert (
+                'yoda_commit_rpc_calls_total'
+                '{op="stage",shard="s0",transport="unix"} 2' in text
+            )
             assert (
                 'yoda_commit_rpc_conflicts_total{shard="s0"} 1' in text
             )

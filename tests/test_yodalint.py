@@ -951,6 +951,53 @@ class TestJournalDiscipline:
             "record_commit" in f.message and f.line == 3 for f in findings
         ), findings
 
+    def test_catches_term_bump_outside_promotion_path(self, tmp_path):
+        # ISSUE 20 planted violation: the epoch-term record is writable
+        # only from yoda_tpu/journal/ (the promotion path) — a bump
+        # from a CLI branch deposes a healthy leader's term on disk.
+        project = make_project(tmp_path, {
+            "yoda_tpu/cli.py": (
+                "def takeover(journal):\n"
+                "    journal.record_term_bump(99)\n"
+            ),
+        })
+        findings = journal_discipline.run(project)
+        assert any(
+            "record_term_bump" in f.message and f.line == 2
+            for f in findings
+        ), findings
+
+    def test_term_bump_exemption_is_tighter_than_append(self, tmp_path):
+        # Rule C grants NO accountant or CommitRPCServer exemption: the
+        # two scopes rule A exempts are still findings for a term bump,
+        # while the journal package itself stays legal.
+        project = make_project(tmp_path, {
+            "yoda_tpu/plugins/yoda/accounting.py": (
+                "class ChipAccountant:\n"
+                "    def adopt(self, term):\n"
+                "        self.journal.record_term_bump(term)\n"
+            ),
+            "yoda_tpu/framework/procserve.py": (
+                "class CommitRPCServer:\n"
+                "    def _op_promote(self, req):\n"
+                "        self.journal.record_term_bump(req['term'])\n"
+            ),
+            "yoda_tpu/journal/tail.py": (
+                "class JournalTailer:\n"
+                "    def promote_into(self, journal, term):\n"
+                "        journal.record_term_bump(term)\n"
+            ),
+        })
+        findings = journal_discipline.run(project)
+        flagged = {
+            (f.file, f.line)
+            for f in findings
+            if "record_term_bump" in f.message
+        }
+        assert ("yoda_tpu/plugins/yoda/accounting.py", 3) in flagged
+        assert ("yoda_tpu/framework/procserve.py", 3) in flagged
+        assert not any(f == "yoda_tpu/journal/tail.py" for f, _ in flagged)
+
 
 class TestSuppressions:
     def test_suppression_with_reason_silences_the_pass(self, tmp_path):

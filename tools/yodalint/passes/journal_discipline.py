@@ -25,6 +25,17 @@ in procserve.py that touched the journal directly would be a second
 writer running OUTSIDE the accountant's lock, exactly the split-log
 hazard rule A exists for.
 
+**C. Term-bump monopoly (ISSUE 20).** The epoch-term record
+(``record_term_bump``) is the multi-host fencing root: it must be the
+FIRST frame a promoted journal fsyncs, written exactly once per
+promotion, from the promotion path only (``yoda_tpu/journal/`` — the
+journal's own ``promote()`` and the tailer's ``promote_into``). A bump
+written from anywhere else — the accountant, the RPC server, a CLI
+branch — could raise the term WITHOUT the standby handover that
+justifies it, deposing a healthy leader's term on disk and fencing its
+own workers. Rule C is therefore STRICTER than rule A: neither the
+accountant nor the CommitRPCServer exemption extends to it.
+
 **B. Claim-state monopoly.** No module outside ``accounting.py`` may
 touch the accountant's claim-state attributes (``_claims`` / ``_in_use``
 / ``_staged`` / ``_stage_seq``) on a non-``self`` receiver. An external
@@ -52,6 +63,12 @@ RECORD_METHODS = {
     "record_release",
     "record_rollback",
 }
+
+#: The promotion-only term surface (ISSUE 20): writable from the
+#: journal package alone — no accountant or RPC-server exemption.
+TERM_METHODS = {"record_term_bump"}
+
+TERM_EXEMPT = ("yoda_tpu/journal/",)
 
 #: The accountant's claim state (plugins/yoda/accounting.py). The
 #: journal's replay is the ONLY other legal reconstruction path, and it
@@ -119,6 +136,26 @@ def run(project: Project, graph: "CallGraph | None" = None) -> "list[Finding]":
                         "writer (plugins/yoda/accounting.py); a second "
                         "appender writes records no accountant mutation "
                         "backs, and replay resurrects phantom claims",
+                    )
+                )
+            # Rule C: term bumps outside the promotion path — stricter
+            # than A: no accountant or CommitRPCServer exemption.
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in TERM_METHODS
+                and not any(part in rel for part in TERM_EXEMPT)
+            ):
+                findings.append(
+                    Finding(
+                        NAME,
+                        rel,
+                        node.lineno,
+                        f".{node.func.attr}() outside yoda_tpu/journal/ "
+                        "— the epoch-term record is writable only from "
+                        "the promotion path; a bump without a standby "
+                        "handover deposes a healthy leader's term on "
+                        "disk and fences its own workers",
                     )
                 )
             # Rule B: accountant claim state touched from outside.

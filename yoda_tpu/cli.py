@@ -247,7 +247,11 @@ def _run_scheduler(args, stop: threading.Event) -> int:
 
         shard_set = build_proc_parent(cluster, config, stop_event=stop)
         stacks = shard_set.stacks
-        sock_path = os.path.join(
+        # Commit endpoint (ISSUE 20): `commit_listen` (host:port) lifts
+        # the commit point onto TCP so shard workers on OTHER hosts and
+        # a journal-tailing standby can reach it; unset keeps the
+        # per-process AF_UNIX socket — single-host behavior unchanged.
+        sock_path = config.commit_listen or os.path.join(
             tempfile.gettempdir(), f"yoda-commit-{os.getpid()}.sock"
         )
 
@@ -263,14 +267,29 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 and bool(fence())
             )
 
+        # Resume at the journal's replayed epoch term: a restarted
+        # term-N parent serving at the default term 1 would be fenced
+        # as stale by any worker that saw N.
+        replayed_term = getattr(
+            getattr(shard_set.accountant, "journal", None), "term", 0
+        )
         proc_server = CommitRPCServer(
             shard_set.accountant,
             sock_path,
             metrics=shard_set.metrics,
             fence_fn=_worker_serve,
             expected_workers=config.shard_count,
+            term=max(1, int(replayed_term or 0)),
         )
         proc_server.start()
+        # Workers spawned HERE dial the endpoint as resolved after bind
+        # (a TCP listen on port 0 is only addressable once bound, and a
+        # 0.0.0.0 wildcard listen is dialed via loopback locally).
+        # Remote workers are launched by the operator with the same
+        # host:port on their own --socket.
+        worker_endpoint = proc_server.endpoint
+        if worker_endpoint.startswith("0.0.0.0:"):
+            worker_endpoint = "127.0.0.1" + worker_endpoint[len("0.0.0.0"):]
 
         def _spawn_worker(i: int):
             cmd = [
@@ -278,7 +297,7 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 "-m",
                 "yoda_tpu.framework.procserve",
                 "--socket",
-                sock_path,
+                worker_endpoint,
                 "--shard-index",
                 str(i),
                 "--shard-count",
@@ -296,7 +315,8 @@ def _run_scheduler(args, stop: threading.Event) -> int:
         shard_set.supervisor.start()
         print(
             f"yoda-tpu-scheduler: shard_mode=process — "
-            f"{config.shard_count} worker processes over {sock_path}",
+            f"{config.shard_count} worker processes over "
+            f"{proc_server.endpoint}",
             file=sys.stderr,
         )
     elif config.shard_count > 1:
@@ -526,10 +546,81 @@ def _run_scheduler(args, stop: threading.Event) -> int:
                 f"{args.lease_namespace}/{args.lease_name} as {identity}",
                 file=sys.stderr,
             )
+            # Journal-tailing hot standby (ISSUE 20): with a
+            # `commit_endpoint` configured, stream the live leader's
+            # committed journal frames into a warm mirror WHILE waiting
+            # on the lease, so promotion is an O(1) term bump + state
+            # handover instead of a cold re-replay of the whole journal.
+            standby_tailer = None
+            tail_client = None
+            if config.commit_endpoint:
+                from yoda_tpu.framework.procserve import CommitRPCClient
+                from yoda_tpu.journal.tail import JournalTailer, TailDiverged
+
+                tail_client = CommitRPCClient(
+                    config.commit_endpoint, shard="standby", stop_event=stop
+                )
+                standby_tailer = JournalTailer(
+                    tail_client, metrics=stack.metrics
+                )
+                standby_tailer.start()
+                print(
+                    f"yoda-tpu-scheduler: tailing leader journal at "
+                    f"{config.commit_endpoint}",
+                    file=sys.stderr,
+                )
             while not stop.is_set() and not became_leader.wait(0.2):
                 pass
+            if standby_tailer is not None:
+                standby_tailer.stop()
             if stop.is_set() and not became_leader.is_set():
+                if tail_client is not None:
+                    tail_client.close()
                 return 0  # stopped while standby
+            if standby_tailer is not None and not standby_tailer.synced:
+                # Never completed a tail round-trip (leader unreachable
+                # the whole standby window): the mirror is empty, NOT
+                # warm — adopting it would wipe the cold-replayed state.
+                print(
+                    "yoda-tpu-scheduler: standby tail never synced; "
+                    "serving from cold-replayed state",
+                    file=sys.stderr,
+                )
+                tail_client.close()
+            elif standby_tailer is not None:
+                # Lease acquired: promote the warm mirror. The term bump
+                # is written as the promoted journal's FIRST frame —
+                # durable before anything serves — and the old leader's
+                # lingering socket is fenced by it (stale-term commits
+                # are refused and journaled by nobody). A failed
+                # divergence check keeps the cold state replayed at
+                # build time instead of serving on a bad mirror.
+                acc = (
+                    shard_set.accountant
+                    if shard_set is not None
+                    else stack.accountant
+                )
+                try:
+                    new_term = standby_tailer.promote_into(
+                        acc, getattr(acc, "journal", None)
+                    )
+                    if proc_server is not None:
+                        proc_server.set_term(new_term)
+                    print(
+                        f"yoda-tpu-scheduler: promoted warm from tailed "
+                        f"journal (term {new_term}, "
+                        f"{len(standby_tailer.claims)} claims, "
+                        f"lag {standby_tailer.lag_frames} frames)",
+                        file=sys.stderr,
+                    )
+                except TailDiverged as exc:
+                    print(
+                        f"yoda-tpu-scheduler: tailed mirror unusable "
+                        f"({exc}); serving from cold-replayed state",
+                        file=sys.stderr,
+                    )
+                finally:
+                    tail_client.close()
 
         names = [config.scheduler_name] + [
             p.scheduler_name for p in config.profiles

@@ -81,6 +81,12 @@ IMMUTABLE_KNOBS = frozenset(
         # live process would split the record across two logs (neither
         # replayable alone) — restart to move it.
         "journal_path",
+        # The commit transport is the control plane's spine: workers and
+        # the tailing standby hold persistent connections to it, and the
+        # epoch-term fence assumes one endpoint per parent generation —
+        # restart to rewire it.
+        "commit_listen",
+        "commit_endpoint",
     }
 )
 
@@ -469,6 +475,25 @@ class SchedulerConfig:
     # when shard_count == 1. Requires-drain: changing the process
     # topology of a live scheduler means a restart.
     shard_mode: str = "thread"
+    # Multi-host control plane (ISSUE 20, OPERATIONS.md "Multi-host
+    # control plane runbook"). commit_listen: the parent's commit RPC
+    # listen endpoint — "" (default) serves the AF_UNIX socket of PR 19
+    # (single host, byte-identical behavior); "host:port" serves the
+    # length-prefixed TCP transport so shard workers and the tailing
+    # standby can live on other hosts. Every response is stamped with
+    # the parent's epoch term; fencing is bidirectional (see the
+    # runbook). Immutable: the transport is the control plane's spine —
+    # restart to change it.
+    commit_listen: str = ""
+    # Where THIS process reaches the live parent's commit RPC:
+    # "host:port" (TCP) or an AF_UNIX socket path. A leader-elected
+    # standby uses it to TAIL the live parent's journal into a warm
+    # mirror (journal/tail.py), so promotion is an O(1) handover + term
+    # bump instead of a cold replay. "" = no tailing (cold promotion);
+    # parent-spawned workers are handed the parent's own endpoint
+    # regardless of this knob. Immutable for the same reason as
+    # commit_listen.
+    commit_endpoint: str = ""
     # Additional profiles (upstream KubeSchedulerConfiguration profiles):
     # each entry inherits every unspecified key from the base config and
     # serves its own scheduler_name. E.g. a spread-strategy "yoda-tpu"
@@ -947,6 +972,20 @@ class SchedulerConfig:
                 "shard_mode must be 'thread' or 'process', got "
                 f"{cfg.shard_mode!r}"
             )
+        for knob in ("commit_listen", "commit_endpoint"):
+            v = getattr(cfg, knob)
+            if not isinstance(v, str):
+                raise ValueError(
+                    f"{knob} must be a string endpoint ('host:port' or "
+                    f"a socket path), got {v!r}"
+                )
+        if cfg.commit_listen:
+            host, sep, port = cfg.commit_listen.rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(
+                    "commit_listen must be 'host:port' (the TCP commit "
+                    f"transport listen endpoint), got {cfg.commit_listen!r}"
+                )
         if cfg.mesh_devices is not None and (
             isinstance(cfg.mesh_devices, bool)
             or not isinstance(cfg.mesh_devices, int)

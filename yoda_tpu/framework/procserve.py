@@ -19,19 +19,35 @@ crash-consistency story of the durable claim journal (PR 18) intact:
   journal replay plus the reconciler's warm path, and a replacement
   worker warm-starts exactly like a promoted standby.
 
-Wire protocol: newline-delimited JSON over a local Unix domain socket —
-one request line, one response line, one persistent connection per
-worker (the serve loop's stage/commit calls serialize on it, which is
-the ordering the optimistic protocol wants anyway). The parent handles
-each connection on its own daemon thread; handler work is a dict probe
-plus one accountant call, so the socket — not the GIL — is the only
+Wire protocol: one request, one response, one persistent connection
+per worker (the serve loop's stage/commit calls serialize on it, which
+is the ordering the optimistic protocol wants anyway), behind the
+:class:`CommitTransport` seam (ISSUE 20) — newline-delimited JSON over
+a local Unix domain socket (``kind="unix"``, the PR 19 wire format,
+byte-identical), or length-prefixed JSON over TCP (``kind="tcp"``,
+the multi-host path: ``commit_listen`` / ``commit_endpoint`` knobs)
+with connect/read deadlines so a flapping link degrades to refused
+commits, never a hung serve loop. The parent handles each connection
+on its own daemon thread; handler work is a dict probe plus one
+accountant call, so the transport — not the GIL — is the only
 serialization point workers share.
+
+Epoch term: every response is stamped with the parent's integer term
+(bumped by standby promotion, journal/tail.py). The check is
+bidirectional — a worker refuses any parent whose stamped term
+REGRESSES below the highest it has seen, and a deposed parent refuses
+any state-mutating request carrying a NEWER term than its own (the
+classic fencing token: a stale parent's lingering socket can keep
+answering, but it can never journal a commit again).
 
 Fencing: a worker binds only while :class:`WorkerFence` says so —
 leadership/resync verdict shipped back on every heartbeat AND parent
-liveness (heartbeat freshness + a ``getppid`` re-parent check), so
-orphaned workers stop binding even when the parent dies without a
-word. Fail-closed: a worker that cannot hear the parent is fenced.
+liveness (heartbeat freshness, term monotonicity, and — local
+transport only — a ``getppid`` re-parent check), so orphaned workers
+stop binding even when the parent dies without a word. Fail-closed: a
+worker that cannot hear the parent is fenced. Remote (TCP) workers
+skip the ``getppid`` check: across machines it fences on the WRONG
+parent — their fence is heartbeat verdict + term + staleness only.
 
 The yodalint ``journal-discipline`` pass recognizes exactly one
 non-accountant module on the commit path: :class:`CommitRPCServer`'s
@@ -44,19 +60,151 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import socket
+import struct
 import sys
 import threading
 import time
 
+from yoda_tpu.cluster.retry import BackoffPolicy
+
 
 class CommitRPCError(RuntimeError):
-    """A commit RPC failed (socket death, parent refusal, or a handler
-    error). Callers treat it as a refused decision — never as state."""
+    """A commit RPC failed (socket death, parent refusal, a handler
+    error, or a term fence). Callers treat it as a refused decision —
+    never as state."""
 
 
 def _encode(msg: dict) -> bytes:
     return json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+
+
+# --- the CommitTransport seam (ISSUE 20) ---
+
+# TCP frame header: 4-byte LE payload length. Bounded so a corrupt
+# header cannot allocate unbounded memory — the largest legitimate
+# frame is a 100k-claim snapshot ship (~10 MB); 64 MiB is headroom.
+_TCP_HDR = struct.Struct("<I")
+_TCP_MAX_FRAME = 64 * 1024 * 1024
+
+
+class UnixTransport:
+    """The PR 19 wire format, byte-identical: newline-delimited JSON
+    over a local AF_UNIX stream socket."""
+
+    kind = "unix"
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def listen(self) -> socket.socket:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(self.path)
+        s.listen(64)
+        return s
+
+    def connect(self, timeout_s: float) -> socket.socket:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout_s)
+        s.connect(self.path)
+        return s
+
+    def cleanup(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def endpoint(self) -> str:
+        return self.path
+
+    def send(self, sock: socket.socket, msg: dict) -> None:
+        sock.sendall(_encode(msg))
+
+    def recv(self, rfile) -> "bytes | None":
+        """One framed payload from the buffered reader; None on EOF."""
+        line = rfile.readline()
+        return line if line else None
+
+
+class TcpTransport:
+    """Length-prefixed JSON over TCP — the multi-host commit path.
+
+    ``[4-byte LE length][payload]`` framing (newline framing would
+    forbid newlines inside snapshot ships and pay a scan per frame).
+    Connect and read deadlines are mandatory: a half-open link must
+    surface as a timed-out read (= a refused commit) on the worker,
+    never a hung serve loop. ``TCP_NODELAY`` is set on both sides — the
+    protocol is strict request/response, so Nagle only adds latency."""
+
+    kind = "tcp"
+
+    def __init__(
+        self, host: str, port: int, *, connect_timeout_s: float = 5.0
+    ) -> None:
+        self.host = host
+        self.port = int(port)
+        self.connect_timeout_s = connect_timeout_s
+
+    def listen(self) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        # port 0 = kernel-assigned (tests): record what we actually got
+        # so ``endpoint()`` hands workers a reachable address.
+        self.port = s.getsockname()[1]
+        return s
+
+    def connect(self, timeout_s: float) -> socket.socket:
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
+        s.settimeout(timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def cleanup(self) -> None:
+        pass  # nothing on disk
+
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def send(self, sock: socket.socket, msg: dict) -> None:
+        payload = json.dumps(msg, separators=(",", ":")).encode()
+        sock.sendall(_TCP_HDR.pack(len(payload)) + payload)
+
+    def recv(self, rfile) -> "bytes | None":
+        hdr = rfile.read(_TCP_HDR.size)
+        if not hdr:
+            return None  # clean EOF
+        if len(hdr) < _TCP_HDR.size:
+            return None  # torn header mid-close
+        (length,) = _TCP_HDR.unpack(hdr)
+        if length == 0 or length > _TCP_MAX_FRAME:
+            raise OSError(f"commit transport: bad frame length {length}")
+        payload = rfile.read(length)
+        if len(payload) < length:
+            return None  # connection died mid-frame
+        return payload
+
+
+def make_transport(endpoint: str):
+    """``"host:port"`` (optionally ``tcp://``-prefixed) builds the TCP
+    transport; anything else is an AF_UNIX socket path. The one parse
+    the server, the client, and cli.py all share — the knobs
+    (``commit_listen`` / ``commit_endpoint``) are plain strings."""
+    ep = endpoint[6:] if endpoint.startswith("tcp://") else endpoint
+    if not ep.startswith("/"):
+        host, sep, port = ep.rpartition(":")
+        if sep and host and port.isdigit():
+            return TcpTransport(host, int(port))
+    return UnixTransport(endpoint)
 
 
 class CommitRPCServer:
@@ -71,6 +219,11 @@ class CommitRPCServer:
     count). ``fence_fn`` is the parent's serve fence — leadership AND
     warm-start resync — refusing commits while fenced and echoed to
     workers on every heartbeat, so workers fence on it too.
+
+    ``socket_path`` is really an endpoint string: an AF_UNIX path
+    (default, single-host) or ``"host:port"`` for the TCP transport —
+    ``make_transport`` decides. ``term`` is the parent's epoch term,
+    stamped on every response; ``set_term`` installs a promoted term.
     """
 
     def __init__(
@@ -82,9 +235,12 @@ class CommitRPCServer:
         fence_fn=None,
         expected_workers: int = 0,
         clock=time.monotonic,
+        term: int = 1,
     ) -> None:
         self.accountant = accountant
         self.socket_path = socket_path
+        self.transport = make_transport(socket_path)
+        self.term = int(term)
         self.metrics = metrics
         self.fence_fn = fence_fn
         self.expected_workers = int(expected_workers)
@@ -105,24 +261,43 @@ class CommitRPCServer:
     # --- lifecycle ---
 
     def start(self) -> None:
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
-        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._listener.bind(self.socket_path)
-        self._listener.listen(64)
+        self._listener = self.transport.listen()
+        if self.metrics is not None:
+            self.metrics.commit_term.set(float(self.term))
         t = threading.Thread(
             target=self._accept_loop, name="commit-rpc-accept", daemon=True
         )
         self._threads.append(t)
         t.start()
 
+    @property
+    def endpoint(self) -> str:
+        """The reachable endpoint string (TCP reports the kernel-assigned
+        port after a ``:0`` bind) — what the parent hands its workers."""
+        return self.transport.endpoint()
+
+    def set_term(self, term: int) -> None:
+        """Install a new epoch term (the promotion path): every response
+        from here on is stamped with it, and any request still carrying
+        an older worker-side term is simply behind — only requests
+        carrying a NEWER term than ours mark US as the stale parent."""
+        self.term = int(term)
+        if self.metrics is not None:
+            self.metrics.commit_term.set(float(self.term))
+
     def stop(self) -> None:
         self._stopping = True
         with self._barrier_cond:
             self._barrier_cond.notify_all()
         if self._listener is not None:
+            # shutdown BEFORE close: a thread blocked in accept() holds
+            # the kernel file description open past close(), leaving the
+            # port in LISTEN forever — the promoted standby could then
+            # never bind the same address. shutdown wakes the accept.
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
@@ -140,10 +315,7 @@ class CommitRPCServer:
                 pass
         for t in self._threads:
             t.join(timeout=5.0)
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
+        self.transport.cleanup()
 
     def _accept_loop(self) -> None:
         while not self._stopping:
@@ -164,26 +336,35 @@ class CommitRPCServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         rfile = conn.makefile("rb")
+        transport = self.transport
         try:
-            for line in rfile:
-                if self._stopping:
+            while True:
+                try:
+                    raw = transport.recv(rfile)
+                except OSError:
+                    return  # torn frame / dead socket: drop the conn
+                if raw is None or self._stopping:
                     return
                 t0 = time.perf_counter()
                 try:
-                    req = json.loads(line)
+                    req = json.loads(raw)
                     resp = self._dispatch(req)
                 except Exception as e:  # noqa: BLE001 — a bad request must not kill the conn
                     req = {}
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                resp.setdefault("term", self.term)
                 op = str(req.get("op", "?"))
                 lane = str(req.get("shard", ""))
                 if self.metrics is not None:
-                    self.metrics.commit_rpc_calls.inc(op=op, shard=lane)
+                    self.metrics.commit_rpc_calls.inc(
+                        op=op, shard=lane, transport=transport.kind
+                    )
                     self.metrics.commit_rpc_latency.observe(
-                        (time.perf_counter() - t0) * 1e3, op=op
+                        (time.perf_counter() - t0) * 1e3,
+                        op=op, transport=transport.kind,
                     )
                 try:
-                    conn.sendall(_encode(resp))
+                    transport.send(conn, resp)
                 except OSError:
                     return  # worker died mid-reply: its residue is journaled
         finally:
@@ -201,9 +382,30 @@ class CommitRPCServer:
 
     # --- dispatch ---
 
+    # Ops that mutate claim state: all of them carry the term fence —
+    # a request stamped with a NEWER term than ours proves a promoted
+    # parent exists somewhere, so WE are the stale side of a partition
+    # and must refuse before touching the accountant or the journal.
+    _MUTATING_OPS = frozenset(
+        {"stage", "commit", "release", "residue", "residue_sync"}
+    )
+
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
         lane = str(req.get("shard", ""))
+        if op in self._MUTATING_OPS:
+            worker_term = int(req.get("term", 0) or 0)
+            if worker_term > self.term:
+                why = (
+                    f"stale parent: term {self.term} deposed by "
+                    f"term {worker_term}"
+                )
+                if op == "commit":
+                    # Shaped like a fence refusal, not an error: the
+                    # worker rolls back + requeues, same as any refused
+                    # commit. Nothing is journaled here.
+                    return {"ok": True, "committed": False, "why": why}
+                return {"ok": False, "error": why}
         if op == "stage":
             seq = self.accountant.stage(
                 req["uid"],
@@ -254,9 +456,67 @@ class CommitRPCServer:
             return {"ok": True}
         if op == "barrier":
             return self._op_barrier(req)
+        if op == "tail":
+            return self._op_tail(req)
+        if op == "residue_sync":
+            return self._op_residue_sync(lane, req)
         if op == "debug":
             return {"ok": True, "workers": self.debug()["workers"]}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _op_tail(self, req: dict) -> dict:
+        """Journal shipping (the hot standby's feed, journal/tail.py):
+        frames appended after ``since`` served straight from the
+        journal's in-memory ship ring, or a full mirror snapshot when
+        the ring no longer reaches back (a fresh follower, or one that
+        fell too far behind)."""
+        journal = getattr(self.accountant, "journal", None)
+        if journal is None or not hasattr(journal, "frames_since"):
+            return {
+                "ok": False,
+                "error": "journal shipping needs a journal-backed parent",
+            }
+        got = journal.frames_since(int(req.get("since", 0)))
+        if got is None:
+            snap = journal.ship_state()
+            return {"ok": True, "snapshot": snap, "tail_seq": snap["tail_seq"]}
+        frames, tail_seq = got
+        return {"ok": True, "frames": frames, "tail_seq": tail_seq}
+
+    def _op_residue_sync(self, lane: str, req: dict) -> dict:
+        """Reconcile a reconnecting worker's staged-intent log against
+        this (possibly just-promoted) parent's claim state — partition
+        residue repaired NOW instead of waiting for the reconciler's
+        warm path. Set semantics over the shipped uids:
+
+        - a parent-side STAGED claim for this lane absent from the
+          shipped set was abandoned by the worker: released here;
+        - a shipped uid the parent holds STAGED stays staged;
+        - a shipped uid the parent holds COMMITTED tells the worker to
+          finalize its mirror (verdict ``committed``);
+        - a shipped uid the parent never heard of (staged under the old
+          term, lost in the partition) is adopted through the normal
+          validated stage path — fresh seq, so first-staged-wins
+          ordering stays sound.
+        """
+        shipped = {str(row["uid"]): row for row in req.get("staged", ())}
+        staged_now = self.accountant.staged_uids()
+        for uid, owner in staged_now.items():
+            if owner == lane and uid not in shipped:
+                self.accountant.release(uid)
+        verdicts: dict[str, str] = {}
+        for uid, row in shipped.items():
+            if uid in staged_now:
+                verdicts[uid] = "staged"
+            elif self.accountant.has_claim(uid):
+                verdicts[uid] = "committed"
+            else:
+                self.accountant.stage(
+                    uid, str(row["node"]), int(row["chips"]), lane,
+                    str(row.get("gang", "")),
+                )
+                verdicts[uid] = "staged"
+        return {"ok": True, "verdicts": verdicts}
 
     def _note_worker(self, lane: str, req: dict, *, hello: bool = False) -> None:
         now = self.clock()
@@ -328,24 +588,65 @@ class CommitRPCClient:
     """Worker-side commit RPC client: one persistent connection, one
     request in flight (the serve loop's decisions serialize on the
     lane anyway). Reconnects lazily after a socket death — the parent
-    respawning is indistinguishable from a blip — and raises
+    respawning is indistinguishable from a blip — through full-jitter
+    backoff (cluster/retry.py policy) so a dead parent is never
+    hammered by a tight reconnect loop; the ``stop_event`` interrupts a
+    pending backoff at once (SIGTERM must not wait it out). Raises
     :class:`CommitRPCError` when the parent cannot be reached, which
-    every caller treats as a refused decision."""
+    every caller treats as a refused decision.
+
+    Term tracking: every request carries the highest parent term this
+    client has seen; every response's stamped term must be monotonic.
+    A response whose term REGRESSES (a deposed parent's lingering
+    socket still answering) raises — the call reads as refused and the
+    connection drops, so the next call re-resolves the endpoint."""
 
     def __init__(
-        self, socket_path: str, *, shard: str = "", timeout_s: float = 10.0
+        self,
+        socket_path: str,
+        *,
+        shard: str = "",
+        timeout_s: float = 10.0,
+        stop_event: "threading.Event | None" = None,
+        reconnect_policy: "BackoffPolicy | None" = None,
+        rng: "random.Random | None" = None,
     ) -> None:
         self.socket_path = socket_path
+        self.transport = make_transport(socket_path)
         self.shard = shard
         self.timeout_s = timeout_s
         self._lock = threading.Lock()
         self._sock: "socket.socket | None" = None
         self._rfile = None
+        self._stop = stop_event
+        self._policy = reconnect_policy or BackoffPolicy(
+            attempts=0, base_s=0.05, cap_s=2.0
+        )
+        self._rng = rng or random.Random()
+        self._failures = 0      # consecutive transport failures
+        self._term_seen = 0     # highest parent term observed
+
+    @property
+    def term_seen(self) -> int:
+        return self._term_seen
 
     def _connect_locked(self) -> None:
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.settimeout(self.timeout_s)
-        s.connect(self.socket_path)
+        if self._failures:
+            # Full-jitter reconnect backoff: attempt k (k = consecutive
+            # failures - 1) sleeps uniform(0, min(base * 2**k, cap)).
+            # The stop event firing mid-sleep aborts immediately as a
+            # refused call — shutdown never waits a backoff out.
+            delay = self._policy.delay_s(
+                min(self._failures - 1, 16), self._rng
+            )
+            if self._stop is not None:
+                if self._stop.wait(delay):
+                    raise CommitRPCError(
+                        "commit rpc: stopping during reconnect backoff"
+                    )
+            elif delay > 0:
+                time.sleep(delay)
+        s = self.transport.connect(self.timeout_s)
         self._sock = s
         self._rfile = s.makefile("rb")
 
@@ -367,23 +668,41 @@ class CommitRPCClient:
         req = {"op": op, "shard": self.shard}
         req.update(fields)
         with self._lock:
+            req.setdefault("term", self._term_seen)
             try:
                 if self._sock is None:
+                    # yodalint: ok lock-discipline the reconnect backoff sleeps under the client lock ON PURPOSE: the lock serializes exactly one reconnect attempt per client, the wait is stop-interruptible and capped (2 s), and every other would-be caller is headed for the same dead endpoint anyway
                     self._connect_locked()
-                self._sock.sendall(_encode(req))
-                line = self._rfile.readline()
+                self.transport.send(self._sock, req)
+                raw = self.transport.recv(self._rfile)
             except OSError as e:
                 self._drop_locked()
+                self._failures += 1
                 raise CommitRPCError(f"commit rpc {op}: {e}") from e
-            if not line:
+            if raw is None:
                 self._drop_locked()
+                self._failures += 1
                 raise CommitRPCError(
                     f"commit rpc {op}: connection closed by parent"
                 )
-        try:
-            resp = json.loads(line)
-        except ValueError as e:
-            raise CommitRPCError(f"commit rpc {op}: bad reply") from e
+            self._failures = 0
+            try:
+                resp = json.loads(raw)
+            except ValueError as e:
+                raise CommitRPCError(f"commit rpc {op}: bad reply") from e
+            term = resp.get("term")
+            if term is not None:
+                term = int(term)
+                if term < self._term_seen:
+                    # Not a transport failure (no backoff bump): the
+                    # endpoint answered — it is just no longer the
+                    # parent. Drop the conn so the next call re-resolves.
+                    self._drop_locked()
+                    raise CommitRPCError(
+                        f"commit rpc {op}: stale parent term {term} < "
+                        f"{self._term_seen} (fenced)"
+                    )
+                self._term_seen = term
         if not resp.get("ok"):
             raise CommitRPCError(
                 f"commit rpc {op}: {resp.get('error', 'refused')}"
@@ -413,6 +732,16 @@ class CommitRPCClient:
 
     def residue(self, uid) -> bool:
         return bool(self.call("residue", uid=uid)["found"])
+
+    def residue_sync(self, staged) -> "dict[str, str]":
+        """Ship the worker's staged-intent log to a (newly promoted)
+        parent; returns per-uid verdicts (``staged`` / ``committed``)."""
+        resp = self.call("residue_sync", staged=list(staged))
+        return dict(resp.get("verdicts") or {})
+
+    def tail(self, since: int) -> dict:
+        """One journal-shipping poll (the standby tailer's feed)."""
+        return self.call("tail", since=int(since))
 
     # --- worker lifecycle surface ---
 
@@ -445,14 +774,24 @@ class WorkerFence:
       and the global warm-start resync complete),
     - that verdict is FRESH (within ``liveness_s`` — a worker that
       cannot hear the parent is fenced, fail-closed), and
-    - the parent process is still our parent (``getppid`` unchanged; a
-      dead parent re-parents us, and an orphaned worker must stop
-      binding even though its socket may linger).
+    - LOCAL transport only: the parent process is still our parent
+      (``getppid`` unchanged; a dead parent re-parents us, and an
+      orphaned worker must stop binding even though its socket may
+      linger). A REMOTE (TCP) worker was never forked by the parent —
+      across machines the check fences on the WRONG parent, so it is
+      skipped: term monotonicity (the client refuses a regressing
+      term, which then reads as staleness here) plus heartbeat
+      freshness are the remote fence, still fail-closed.
 
     The heartbeat loop runs on its own daemon thread and ships the
     worker's serve-loop snapshot (``info_fn``) for ``/debug/shards``.
     ``on_orphaned`` (optional) fires once when the parent is detected
     gone — production workers use it to exit instead of idling fenced.
+    ``on_new_term`` (optional) fires when a heartbeat lands under a
+    HIGHER parent term than before (standby promotion happened while
+    we were partitioned) — production workers use it to ship their
+    staged-intent log (``residue_sync``); a failed sync re-arms so the
+    next beat retries.
     """
 
     def __init__(
@@ -464,6 +803,8 @@ class WorkerFence:
         period_s: float = 0.5,
         info_fn=None,
         on_orphaned=None,
+        on_new_term=None,
+        remote: "bool | None" = None,
         clock=time.monotonic,
     ) -> None:
         self.client = client
@@ -472,8 +813,16 @@ class WorkerFence:
         self.period_s = period_s
         self.info_fn = info_fn
         self.on_orphaned = on_orphaned
+        self.on_new_term = on_new_term
+        if remote is None:
+            remote = (
+                getattr(getattr(client, "transport", None), "kind", "unix")
+                == "tcp"
+            )
+        self.remote = bool(remote)
         self.clock = clock
         self._ppid = os.getppid()
+        self._term = 0
         self._last_ok: "float | None" = None
         self._serve = False
         self._orphaned = False
@@ -499,7 +848,7 @@ class WorkerFence:
     def beat(self) -> None:
         """One heartbeat round-trip (the loop's body; tests drive it
         directly)."""
-        if os.getppid() != self._ppid:
+        if not self.remote and os.getppid() != self._ppid:
             self._orphaned = True
             self._serve = False
             if self.on_orphaned is not None:
@@ -517,10 +866,21 @@ class WorkerFence:
             self._last_ok = self.clock()
         except CommitRPCError:
             # Leave _last_ok as-is: staleness fences after liveness_s.
-            pass
+            return
+        term = getattr(self.client, "term_seen", 0)
+        if term > self._term:
+            prev, self._term = self._term, term
+            # prev == 0 is the FIRST successful beat, not a promotion.
+            if prev != 0 and self.on_new_term is not None:
+                try:
+                    self.on_new_term(term)
+                except CommitRPCError:
+                    self._term = prev  # re-arm: next beat retries the sync
 
     def serving(self) -> bool:
-        if self._orphaned or os.getppid() != self._ppid:
+        if self._orphaned:
+            return False
+        if not self.remote and os.getppid() != self._ppid:
             return False
         if not self._serve or self._last_ok is None:
             return False
@@ -704,10 +1064,10 @@ def _run_kube_worker(args) -> int:
     _init_jax(args.jax_platform)
     idx = int(args.shard_index)
     lane = shard_name(idx)
-    client = CommitRPCClient(args.socket, shard=lane)
-    client.hello()
     stop = threading.Event()
     _install_stop_handlers(stop)
+    client = CommitRPCClient(args.socket, shard=lane, stop_event=stop)
+    client.hello()
     cluster = _build_kube_cluster()
     # The rendezvous map is a pure function of shard_count: this worker
     # computes its partition + routing locally, no coordination.
@@ -730,11 +1090,22 @@ def _run_kube_worker(args) -> int:
         node_filter_fn=shard_map.node_filter(idx),
         pod_route_fn=lambda pod: router.route(pod) == lane,
     )
+    def _sync_residue(term: int) -> None:
+        # Reconnected under a NEW parent term (a standby promoted while
+        # this worker was partitioned): ship the local staged-intent
+        # log so the promoted parent reconciles our residue immediately
+        # instead of waiting for the reconciler's warm path. A raised
+        # CommitRPCError re-arms the fence to retry on the next beat.
+        accountant.apply_residue_verdicts(
+            client.residue_sync(accountant.staged_intents())
+        )
+
     fence = WorkerFence(
         client,
         shard=lane,
         info_fn=_worker_info_fn(stack),
         on_orphaned=stop.set,
+        on_new_term=_sync_residue,
     )
     stack.scheduler.fence_fn = fence.serving
     fence.start()
@@ -771,7 +1142,11 @@ def main(argv=None) -> int:
         "execute stdin COMMIT/RELEASE/EXIT commands)",
     )
     ap.add_argument("--config", help="scheduler config YAML (kube worker)")
-    ap.add_argument("--socket", help="parent commit RPC socket path")
+    ap.add_argument(
+        "--socket",
+        help="parent commit RPC endpoint: AF_UNIX socket path, or "
+        "host:port for the TCP transport (commit_listen)",
+    )
     ap.add_argument("--shard-index", type=int, default=0)
     ap.add_argument("--shard-count", type=int, default=1)
     ap.add_argument("--jax-platform", default="cpu")

@@ -5,6 +5,12 @@ default (``NullCommitLog`` — journal off, zero durability, today's
 behavior) and :class:`FileJournal` — an append-only, CRC-checksummed,
 segment-rotated on-disk log of every claim mutation, replayed on standby
 promotion to warm-start the accountant before the first queue pop.
+
+``yoda_tpu.journal.tail`` (imported directly, not re-exported here — it
+pulls in the commit transport) holds :class:`~yoda_tpu.journal.tail.
+JournalTailer`, the journal-shipping hot standby that streams committed
+frames from the live parent so promotion is an O(1) warm handover
+instead of a cold replay (ISSUE 20).
 """
 
 from yoda_tpu.journal.journal import (

@@ -16,6 +16,9 @@ record sequence number:
     R seq uid                                   committed claim released
     B seq uid                                   staged claim rolled back
     P seq json                                  snapshot (full mirror state)
+    T seq term                                  epoch term bump — the FIRST
+                                                frame a promoted standby
+                                                writes (journal/tail.py)
 
 Segments rotate at ``segment_bytes``: a new segment opens with a ``P``
 snapshot record of the journal's own mirror state and every older
@@ -44,7 +47,13 @@ import struct
 import threading
 import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
+
+# In-memory ship ring depth (journal shipping, ISSUE 20): the tailing
+# standby polls `frames_since`; a follower more than this many frames
+# behind catches up from a full mirror snapshot instead.
+_SHIP_RING = 4096
 
 _SEP = "\x1f"
 _HDR = struct.Struct("<II")
@@ -85,6 +94,11 @@ class ReplayedState:
     tail_seq: int = 0
     torn_records: int = 0
     replay_ms: float = 0.0
+    # Epoch term of the last T record replayed (0 = none seen — a
+    # pre-multi-host journal). The promoted standby writes its bumped
+    # term as its first frame, so replaying ITS journal recovers the
+    # fencing token too.
+    term: int = 0
 
     def staged_gangs(self) -> "dict[str, set[str]]":
         """gang name -> uids of its still-STAGED claims: the mid-gang
@@ -193,6 +207,13 @@ class FileJournal(CommitLog):
         # lock is held during appends).
         self._mirror: dict[str, list] = {}
         self._stage_seq = 0
+        # Epoch term (multi-host control plane): replayed from the last
+        # T record; bumped only through promote()/record_term_bump.
+        self._term = 0
+        # Journal shipping (the standby tailer's feed): recent frame
+        # payloads by seq, appended under _wlock so a follower's
+        # `frames_since` sees exactly the committed order.
+        self._ship: "deque[tuple[int, str]]" = deque(maxlen=_SHIP_RING)
         # Snapshot frame size of the last rotation: the next rotation
         # waits until the segment holds at least this many DELTA bytes
         # again, or a working set bigger than segment_bytes would
@@ -232,6 +253,7 @@ class FileJournal(CommitLog):
                 self._head_seq = first_seq
         self._seq = state.tail_seq
         self._stage_seq = state.stage_seq
+        self._term = state.term
         # The mirror SHARES the replayed claim lists with the returned
         # state: by the attach contract (standalone._attach_journal) the
         # caller consumes the state via accountant.restore() — which
@@ -331,6 +353,13 @@ class FileJournal(CommitLog):
                     ss = int(snap["stage_seq"])
                     if ss > stage_seq:
                         stage_seq = ss
+                    t = int(snap.get("term", 0))
+                    if t > state.term:
+                        state.term = t
+                elif kind == "T":
+                    t = int(fields[2])
+                    if t > state.term:
+                        state.term = t
                 else:
                     break  # unknown kind = corrupt
                 if first_seq == 0:
@@ -389,6 +418,93 @@ class FileJournal(CommitLog):
         self._append("B", uid)
         self._mirror.pop(uid, None)
 
+    def record_term_bump(self, term: int) -> None:
+        """Append the ``T`` record — the epoch-term fencing token. Only
+        the promotion path (:meth:`promote`, driven by journal/tail.py)
+        may write it; the yodalint journal-discipline pass keeps every
+        module outside ``yoda_tpu/journal/`` off this method. Always
+        fsynced: the term must be durable before the promoted parent
+        answers anything."""
+        term = int(term)
+        self._append("T", str(term), sync_now=True)
+        self._term = term
+
+    # --- journal shipping (the hot-standby tailer's read side) ---
+
+    def frames_since(self, since: int) -> "tuple[list[str], int] | None":
+        """Frame payloads appended after record seq ``since``, served
+        from the in-memory ship ring: ``(frames, tail_seq)``, or
+        ``None`` when the ring no longer reaches back (a fresh follower
+        or one too far behind — it then catches up via
+        :meth:`ship_state`)."""
+        with self._wlock:
+            if since >= self._seq:
+                return [], self._seq
+            if not self._ship or self._ship[0][0] > since + 1:
+                return None
+            return [p for s, p in self._ship if s > since], self._seq
+
+    @property
+    def term(self) -> int:
+        """Epoch term this journal last recorded (replayed from the
+        last ``T`` frame at open; 0 = no promotion ever touched it). A
+        restarted parent must resume serving AT this term — any worker
+        that saw it would fence a term-1 restart as stale."""
+        return self._term
+
+    def ship_state(self) -> dict:
+        """One consistent copy of the journal's own mirror — the
+        follower's snapshot catch-up when the ship ring no longer
+        reaches back. Claim lists are copied: the live mirror mutates
+        under appends while the copy rides an RPC reply."""
+        with self._wlock:
+            return {
+                "claims": {u: list(c) for u, c in self._mirror.items()},
+                "stage_seq": self._stage_seq,
+                "tail_seq": self._seq,
+                "term": self._term,
+            }
+
+    def promote(
+        self, state: ReplayedState, term: int, *, snapshot: str = "defer"
+    ) -> None:
+        """Adopt a tailed mirror and take ownership of the log at a new
+        term — the standby's promotion path (journal/tail.py). O(1) on
+        the blackout path: the mirror is adopted by reference, the seq
+        head continues after the shipped tail (seq continuity across
+        parent generations), and the term-bump record is this journal's
+        FIRST frame, fsynced before the method returns.
+
+        ``snapshot`` controls when the adopted mirror becomes replayable
+        from THIS journal's segments: ``"defer"`` (default) writes the
+        base snapshot on a background thread — a crash inside that
+        window falls back to the reconciler's warm resync, which is the
+        trade that keeps promotion off the ~100 ms 100k-claim
+        serialization; ``"sync"`` rotates inline before returning;
+        ``"none"`` leaves it to the next size-triggered rotation."""
+        with self._wlock:
+            self._mirror = state.claims
+            self._stage_seq = max(self._stage_seq, state.stage_seq)
+            if state.tail_seq > self._seq:
+                self._seq = state.tail_seq
+        self.record_term_bump(term)
+        if snapshot == "sync":
+            self._snapshot_now()
+        elif snapshot == "defer":
+            threading.Thread(
+                target=self._snapshot_now,
+                name="journal-promote-snapshot",
+                daemon=True,
+            ).start()
+
+    def _snapshot_now(self) -> None:
+        with self._wlock:
+            if not self._dead:
+                try:
+                    self._rotate()
+                except JournalFault:
+                    pass  # dead now; the next append fail-stops the commit point
+
     def _append(self, kind: str, *fields: str, sync_now: bool = False) -> None:
         with self._wlock:
             if self._dead:
@@ -405,9 +521,11 @@ class FileJournal(CommitLog):
             ):
                 self._rotate()
             self._seq += 1
-            payload = _SEP.join((kind, str(self._seq)) + fields).encode()
+            payload_s = _SEP.join((kind, str(self._seq)) + fields)
+            payload = payload_s.encode()
             frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
             self._write_frame(frame, sync_now=sync_now)
+            self._ship.append((self._seq, payload_s))
             if not self._head_seq:
                 self._head_seq = self._seq
 
@@ -450,12 +568,18 @@ class FileJournal(CommitLog):
         # is a single json.dumps with no per-claim construction (and replay
         # is a single json.loads).
         snap = json.dumps(
-            {"claims": self._mirror, "stage_seq": self._stage_seq},
+            {
+                "claims": self._mirror,
+                "stage_seq": self._stage_seq,
+                "term": self._term,
+            },
             separators=(",", ":"),
         )
-        payload = _SEP.join(("P", str(self._seq), snap)).encode()
+        payload_s = _SEP.join(("P", str(self._seq), snap))
+        payload = payload_s.encode()
         frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         self._write_frame(frame, sync_now=True)
+        self._ship.append((self._seq, payload_s))
         self._last_snap_bytes = len(frame)
         self._head_seq = self._seq
         self.last_compaction_seq = self._seq
@@ -481,6 +605,7 @@ class FileJournal(CommitLog):
             "path": self.path,
             "head_seq": self._head_seq,
             "tail_seq": self._seq,
+            "term": self._term,
             "segments": len(self._segment_indices()),
             "size_bytes": self.size_bytes(),
             "last_compaction_seq": self.last_compaction_seq,

@@ -611,6 +611,25 @@ class SchedulingMetrics:
             "decision",
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100),
         )
+        # Multi-host control plane (ISSUE 20, docs/OPERATIONS.md
+        # multi-host runbook): the commit RPC series above additionally
+        # carry a `transport` label (unix | tcp); the two gauges below
+        # are the failover observables — a term that jumps is a standby
+        # promotion, a climbing standby lag means journal shipping is
+        # slower than the commit rate and promotion will pay a catch-up.
+        self.commit_term = r.gauge(
+            "yoda_commit_term",
+            "The parent control plane's current epoch term: bumped by "
+            "standby promotion (journal T record); workers refuse any "
+            "parent stamping an OLDER term, and a deposed parent refuses "
+            "state-mutating requests carrying a NEWER one",
+        )
+        self.standby_lag_frames = r.gauge(
+            "yoda_standby_lag_frames",
+            "Journal frames the tailing hot standby is behind the live "
+            "parent's tail (0 = caught up; sustained growth means "
+            "shipping lags the commit rate and promotion pays a catch-up)",
+        )
         self.tenant_quota_parks = r.counter(
             "yoda_tenant_quota_parks_total",
             "Queue entries parked by per-tenant quota admission (they "

@@ -390,6 +390,30 @@ class ChipAccountant(ReservePlugin):
             self.replayed_gangs = state.staged_gangs()
             return len(state.claims)
 
+    def adopt_warm(
+        self, claims, in_use, staged, stage_seq, *, gangs=None
+    ) -> int:
+        """Seed accounting from a journal TAILER's warm mirror (standby
+        promotion, journal/tail.py) — the O(1)-handover sibling of
+        :meth:`restore`: the tailer built accountant-ready ``_Claim``
+        records incrementally while frames streamed in, so promotion
+        installs the dicts wholesale instead of constructing 100k claim
+        objects on the blackout path. Nothing here is journaled — the
+        promoted journal adopted the same mirror via
+        ``FileJournal.promote`` (write-ahead: term durable first).
+        Returns the number of claims adopted."""
+        with self._lock:
+            self._claims = claims
+            self._in_use = dict(in_use)
+            self._staged = set(staged)
+            self._stage_seq = max(self._stage_seq, int(stage_seq))
+            # One delta-feed note per node (restore()'s discipline).
+            for node in self._in_use:
+                self._note(node)
+            self.replayed = True
+            self.replayed_gangs = gangs if gangs is not None else {}
+            return len(claims)
+
     def claims_snapshot(self) -> "dict[str, tuple[str, int]]":
         """uid -> (node, chips) for every claim, one lock acquisition —
         the warm resync's divergence check diffs cluster truth against
@@ -579,3 +603,33 @@ class RemoteAccountant(ChipAccountant):
                 self._staged.discard(uid)
                 return True
         return found
+
+    # --- partition-residue proof (multi-host control plane) ---
+
+    def staged_intents(self) -> "list[dict]":
+        """The worker's local staged-intent log in wire form — every
+        claim still STAGED in the mirror. Shipped to a newly promoted
+        parent (``residue_sync``) on reconnect under a higher term, so
+        the parent reconciles this worker's partition residue at once
+        instead of waiting for the reconciler's warm path."""
+        with self._lock:
+            return [
+                {"uid": u, "node": c.node, "chips": c.chips, "gang": c.gang}
+                for u, c in self._claims.items()
+                if c.shard is not None
+            ]
+
+    def apply_residue_verdicts(self, verdicts: "dict[str, str]") -> None:
+        """Apply a promoted parent's ``residue_sync`` verdicts to the
+        local mirror: ``committed`` finalizes the claim locally (the
+        parent already holds — or replayed — the C record); ``staged``
+        keeps it staged for the normal commit path to finish."""
+        with self._lock:
+            for uid, verdict in verdicts.items():
+                if verdict != "committed":
+                    continue
+                c = self._claims.get(uid)
+                if c is not None and c.shard is not None:
+                    c.shard = None
+                    c.seq = 0
+                    self._staged.discard(uid)

@@ -193,6 +193,12 @@ def build_stack(
     own_metrics = metrics is None
     if own_metrics:
         metrics = _metrics_from_config(config, clock)
+    # Replayed epoch term (multi-host control plane): a journal that
+    # lived through a promotion replays its term — publish it so
+    # yoda_commit_term is correct from the first scrape even before
+    # (or without) a commit RPC server running.
+    if journal is not None and getattr(journal, "term", 0):
+        metrics.commit_term.set(float(journal.term))
     # Scheduling Events (kubectl describe pod): the reference got these from
     # the upstream scheduler's recorder; here the loop emits its own.
     recorder = (

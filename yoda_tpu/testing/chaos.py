@@ -26,7 +26,11 @@ produces, on a seeded schedule a test can replay exactly:
 Ops recognized by the built-in wrappers: ``bind``, ``unbind``,
 ``metrics``, ``dispatch``, ``watch``, ``crash``, ``cluster_partition``,
 ``cluster_loss``, ``journal`` (disk faults against the durable claim
-journal, consumed by ``FaultyJournalIO``). Each retry of a faulted call counts as a fresh
+journal, consumed by ``FaultyJournalIO``), and the multi-host control
+plane ops ``rpc_partition`` / ``rpc_slow`` (commit-transport faults,
+consumed by :func:`maybe_rpc_fault` against a :class:`ChaosTcpProxy`)
+and ``parent_kill`` (the sweep SIGKILLs the live parent and promotes
+the tailing standby). Each retry of a faulted call counts as a fresh
 invocation — a ``count=1`` bind conflict fails once and the binder's
 first retry succeeds; ``count > retry budget`` forces the genuine-failure
 path (gang rollback).
@@ -99,6 +103,21 @@ _DEFAULT_KINDS = {
     # resync (PR 5) must recover the half-committed state. Mechanically
     # this rides the crash machinery (ChaosCluster._maybe_crash).
     "shard_crash": ("mid_commit",),
+    # Multi-host control plane fault modes (ISSUE 20): rpc_partition is
+    # the HALF-OPEN network failure against the TCP commit transport —
+    # via ChaosTcpProxy, established connections silently stop carrying
+    # bytes (reads hang until the client's deadline fires; nothing
+    # refuses, nothing resets — the transport signature of a dropped
+    # path or a dead NIC), until the sweep heals it. rpc_slow stretches
+    # every forwarded chunk by a delay (the degraded-link case backoff
+    # and deadlines must ride out). parent_kill SIGKILLs the live
+    # parent at a frame chosen by the plan — the sweep then promotes
+    # the tailing standby and asserts the term fence against the old
+    # parent's lingering socket. Consumed via maybe_rpc_fault (proxy
+    # modes) and directly by the sweep (parent_kill).
+    "rpc_partition": ("half_open",),
+    "rpc_slow": ("latency",),
+    "parent_kill": ("sigkill",),
     # Journal disk-fault mode (durable claim journal, ISSUE 18):
     # consumed by FaultyJournalIO, one invocation per journal append.
     # short_write leaves a torn frame on disk (the journal fail-stops;
@@ -838,6 +857,161 @@ def storm_stream(
             for m in range(4)
         )
     return prod_pods, spot_pods
+
+
+class ChaosTcpProxy:
+    """A loopback TCP forwarding proxy between a commit RPC client and
+    the parent's TCP commit endpoint — the ``rpc_partition`` /
+    ``rpc_slow`` chaos surface (ISSUE 20). Point the worker's or
+    standby's ``--socket`` at :attr:`endpoint` instead of the parent.
+
+    - :meth:`partition` — the HALF-OPEN failure: established
+      connections silently stop carrying bytes in both directions
+      (in-flight requests are swallowed, responses never arrive, reads
+      hang until the client's deadline fires — no refusal, no reset,
+      exactly what a dropped path looks like). New connects are still
+      accepted (SYN handshakes often survive real partitions) but
+      carry nothing either.
+    - :meth:`slow` — every forwarded chunk is delayed by ``delay_s``
+      (the degraded-link case reconnect backoff and read deadlines
+      must ride out without tripping the fence).
+    - :meth:`heal` — restore normal forwarding. Bytes held during a
+      partition are released (late delivery, like a real route flap);
+      clients that already timed out have dropped the connection, so
+      the late bytes land on a closed socket and vanish.
+    """
+
+    def __init__(self, upstream: str) -> None:
+        import socket as _socket
+
+        host, _, port = upstream.rpartition(":")
+        if host.startswith("tcp://"):
+            host = host[len("tcp://"):]
+        self._up = (host or "127.0.0.1", int(port))
+        self.delay_s = 0.0
+        self._partitioned = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: list = []
+        self._listener = _socket.socket(
+            _socket.AF_INET, _socket.SOCK_STREAM
+        )
+        self._listener.setsockopt(
+            _socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="chaos-tcp-proxy", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        """The ``host:port`` clients dial instead of the real parent."""
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partitioned.is_set()
+
+    def partition(self) -> None:
+        self._partitioned.set()
+
+    def slow(self, delay_s: float = 0.05) -> None:
+        self.delay_s = delay_s
+
+    def heal(self) -> None:
+        self._partitioned.clear()
+        self.delay_s = 0.0
+
+    def _accept_loop(self) -> None:
+        import socket as _socket
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                up = _socket.create_connection(self._up, timeout=5.0)
+            except OSError:
+                conn.close()
+                continue
+            with self._lock:
+                self._conns += [conn, up]
+            for src, dst in ((conn, up), (up, conn)):
+                threading.Thread(
+                    target=self._pump,
+                    args=(src, dst),
+                    name="chaos-tcp-pump",
+                    daemon=True,
+                ).start()
+
+    def _pump(self, src, dst) -> None:
+        import time as _time
+
+        while not self._stop.is_set():
+            try:
+                data = src.recv(65536)
+            except OSError:
+                break
+            if not data:
+                break
+            # Half-open: hold the bytes in transit until heal (or the
+            # proxy closes). The peer's read blocks with the connection
+            # still "established" — the failure deadlines exist for.
+            while self._partitioned.is_set() and not self._stop.is_set():
+                _time.sleep(0.01)
+            if self._stop.is_set():
+                break
+            if self.delay_s:
+                _time.sleep(self.delay_s)
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def maybe_rpc_fault(plan: ChaosPlan, proxy: ChaosTcpProxy) -> "str | None":
+    """Consume one invocation each of the commit-transport fault ops
+    against ``proxy``. A scheduled ``rpc_partition`` fault half-opens
+    the link (the sweep heals it on its own schedule); ``rpc_slow``
+    stretches every chunk. Returns which op fired or None. Ops never
+    scheduled do not consume invocation indices (``has_op``) — the
+    crash-op discipline. ``parent_kill`` is consumed by the sweep
+    itself (it owns the parent process handle)."""
+    if plan.has_op("rpc_partition"):
+        f = plan.next("rpc_partition")
+        if f is not None:
+            proxy.partition()
+            return "rpc_partition"
+    if plan.has_op("rpc_slow"):
+        f = plan.next("rpc_slow")
+        if f is not None:
+            proxy.slow()
+            return "rpc_slow"
+    return None
 
 
 def maybe_drop_watch(plan: ChaosPlan, server) -> bool:
